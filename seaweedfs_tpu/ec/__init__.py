@@ -1,0 +1,17 @@
+from .coder import ErasureCoder, JaxCoder, NumpyCoder, get_coder, register_coder
+from .ec_volume import EcShard, EcVolume, rebuild_ecx_file
+from .geometry import DEFAULT, Geometry, to_ext
+from .locate import Interval, locate_data
+from .striping import (find_dat_file_size, iterate_ecj_file, iterate_ecx_file,
+                       rebuild_ec_files, write_dat_file, write_ec_files,
+                       write_idx_file_from_ec_index, write_sorted_ecx_from_idx)
+
+__all__ = [
+    "ErasureCoder", "JaxCoder", "NumpyCoder", "get_coder", "register_coder",
+    "EcShard", "EcVolume", "rebuild_ecx_file",
+    "DEFAULT", "Geometry", "to_ext",
+    "Interval", "locate_data",
+    "find_dat_file_size", "iterate_ecj_file", "iterate_ecx_file",
+    "rebuild_ec_files", "write_dat_file", "write_ec_files",
+    "write_idx_file_from_ec_index", "write_sorted_ecx_from_idx",
+]

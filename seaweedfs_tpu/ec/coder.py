@@ -1,0 +1,98 @@
+"""Pluggable ErasureCoder interface — the seam where TPU meets storage.
+
+The reference binds directly to klauspost/reedsolomon
+(weed/storage/erasure_coding/ec_encoder.go:8); this build routes all RS math
+through one interface with interchangeable backends:
+
+- NumpyCoder   — pure-python/numpy reference (always available, slow)
+- JaxCoder     — jit'd XLA (CPU or TPU; bitplane-MXU or nibble-LUT method)
+- PallasCoder  — hand-tiled TPU kernel (rs_pallas.py)
+- CppCoder     — native C++ table coder (native/, klauspost-equivalent CPU path)
+
+All backends produce bit-identical shards (enforced by tests), so the choice
+is purely a placement/performance decision.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..ops import gf256, rs_jax
+
+
+class ErasureCoder:
+    """Encode/reconstruct fixed-width stripes of k data + m parity shards."""
+
+    def __init__(self, data_shards: int, parity_shards: int):
+        self.k = data_shards
+        self.m = parity_shards
+
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        """data [k, n] uint8 -> parity [m, n] uint8."""
+        raise NotImplementedError
+
+    def reconstruct(self, shards: Sequence[Optional[np.ndarray]],
+                    data_only: bool = False) -> list[Optional[np.ndarray]]:
+        """Fill None entries from any k survivors; returns full shard list."""
+        raise NotImplementedError
+
+    def verify(self, shards: Sequence[np.ndarray]) -> bool:
+        data = np.stack(shards[:self.k])
+        parity = np.stack(shards[self.k:])
+        return bool(np.array_equal(self.encode(data), parity))
+
+
+class NumpyCoder(ErasureCoder):
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        return gf256.encode_parity(np.asarray(data, dtype=np.uint8), self.m)
+
+    def reconstruct(self, shards, data_only=False):
+        arrs = [None if s is None else np.asarray(s, dtype=np.uint8)
+                for s in shards]
+        return gf256.reconstruct(arrs, self.k, self.m, data_only=data_only)
+
+
+class JaxCoder(ErasureCoder):
+    def __init__(self, data_shards: int, parity_shards: int,
+                 method: str = "bitplane"):
+        super().__init__(data_shards, parity_shards)
+        self.method = method
+
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        out = rs_jax.encode_parity(np.asarray(data, dtype=np.uint8), self.m,
+                                   method=self.method)
+        return np.asarray(out)
+
+    def reconstruct(self, shards, data_only=False):
+        arrs = [None if s is None else np.asarray(s, dtype=np.uint8)
+                for s in shards]
+        out = rs_jax.reconstruct(arrs, self.k, self.m, method=self.method,
+                                 data_only=data_only)
+        return [None if s is None else np.asarray(s) for s in out]
+
+
+_REGISTRY = {}
+
+
+def register_coder(name: str, factory) -> None:
+    _REGISTRY[name] = factory
+
+
+register_coder("numpy", NumpyCoder)
+register_coder("jax", JaxCoder)
+register_coder("jax_lut", lambda k, m: JaxCoder(k, m, method="lut"))
+
+
+def get_coder(name: str, data_shards: int, parity_shards: int) -> ErasureCoder:
+    if name == "auto":
+        for candidate in ("pallas", "jax", "numpy"):
+            if candidate in _REGISTRY:
+                try:
+                    return _REGISTRY[candidate](data_shards, parity_shards)
+                except Exception:
+                    continue
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown coder {name!r}; have {sorted(_REGISTRY)}")
+    return _REGISTRY[name](data_shards, parity_shards)

@@ -1,0 +1,43 @@
+"""EC geometry: RS(k,m) plus the two-tier striping block sizes.
+
+The reference hard-codes RS(10,4) with 1GB large / 1MB small blocks
+(weed/storage/erasure_coding/ec_encoder.go:17-23); here geometry is a value
+so the variable-geometry sweep (BASELINE config 4) and the shrunk-geometry
+test trick (reference ec_test.go:16-19) are first-class.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Geometry:
+    data_shards: int = 10
+    parity_shards: int = 4
+    large_block_size: int = 1024 * 1024 * 1024  # 1GB
+    small_block_size: int = 1024 * 1024         # 1MB
+
+    @property
+    def total_shards(self) -> int:
+        return self.data_shards + self.parity_shards
+
+    @property
+    def large_row_size(self) -> int:
+        return self.large_block_size * self.data_shards
+
+    @property
+    def small_row_size(self) -> int:
+        return self.small_block_size * self.data_shards
+
+    def __post_init__(self):
+        assert self.data_shards > 0 and self.parity_shards > 0
+        assert self.large_block_size % self.small_block_size == 0
+
+
+DEFAULT = Geometry()
+
+
+def to_ext(shard_id: int) -> str:
+    """Shard file extension: .ec00 ... .ec13 (ec_encoder.go ToExt)."""
+    return f".ec{shard_id:02d}"

@@ -1,0 +1,69 @@
+"""Interval addressing: map a needle's (.dat offset, size) onto shard files.
+
+Byte-exact port of the reference addressing scheme
+(weed/storage/erasure_coding/ec_locate.go:15-87): a volume is striped
+row-major, first in rows of k large blocks, then rows of k small blocks for
+the tail. Every needle decomposes into intervals, each living inside one
+block of one shard. This pure math is the contract the TPU kernels and the
+on-disk shard layout share — block index maps to (shard id, offset).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .geometry import Geometry
+
+
+@dataclass(frozen=True)
+class Interval:
+    block_index: int
+    inner_block_offset: int
+    size: int
+    is_large_block: bool
+    large_block_rows_count: int
+
+    def to_shard_id_and_offset(self, g: Geometry) -> tuple[int, int]:
+        offset = self.inner_block_offset
+        row_index = self.block_index // g.data_shards
+        if self.is_large_block:
+            offset += row_index * g.large_block_size
+        else:
+            offset += (self.large_block_rows_count * g.large_block_size
+                       + row_index * g.small_block_size)
+        return self.block_index % g.data_shards, offset
+
+
+def locate_data(g: Geometry, dat_size: int, offset: int,
+                size: int) -> list[Interval]:
+    block_index, is_large, inner = _locate_offset(g, dat_size, offset)
+    # + one small row so the large-row count can be derived from a shard size
+    # that was rounded up to whole small blocks (ec_locate.go:19-20)
+    n_large_rows = (dat_size + g.small_row_size) // g.large_row_size
+
+    intervals: list[Interval] = []
+    while size > 0:
+        block_len = g.large_block_size if is_large else g.small_block_size
+        remaining = block_len - inner
+        take = min(size, remaining)
+        intervals.append(Interval(block_index, inner, take, is_large,
+                                  n_large_rows))
+        size -= take
+        if size <= 0:
+            break
+        block_index += 1
+        if is_large and block_index == n_large_rows * g.data_shards:
+            is_large = False
+            block_index = 0
+        inner = 0
+    return intervals
+
+
+def _locate_offset(g: Geometry, dat_size: int,
+                   offset: int) -> tuple[int, bool, int]:
+    n_large_rows = dat_size // g.large_row_size
+    if offset < n_large_rows * g.large_row_size:
+        return (offset // g.large_block_size, True,
+                offset % g.large_block_size)
+    offset -= n_large_rows * g.large_row_size
+    return offset // g.small_block_size, False, offset % g.small_block_size

@@ -1,0 +1,211 @@
+"""GF(2^8) arithmetic and Reed-Solomon matrix construction.
+
+This is the scalar/numpy reference for the erasure-coding math. The field and
+matrix construction are chosen to be interoperable with the reference system's
+RS coder (klauspost/reedsolomon, used by seaweedfs at
+weed/storage/erasure_coding/ec_encoder.go:8): the field is GF(2^8) with
+reducing polynomial x^8 + x^4 + x^3 + x^2 + 1 (0x11D) and generator 2, and the
+encoding matrix is the systematic form of the Vandermonde matrix
+vm[r][c] = r**c (exponentiation in the field), i.e. `vm @ inv(vm[:k, :k])`.
+Because a maximum-distance-separable code's systematic matrix is unique given
+the field and the Vandermonde seed, shards produced here are bit-identical to
+shards produced by the reference for the same input.
+
+All heavy lifting (bulk encode over megabytes of data) lives in rs_jax.py /
+rs_pallas.py; this module owns the tiny (k+m) x k matrices and their inverses.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+FIELD_POLY = 0x11D  # x^8 + x^4 + x^3 + x^2 + 1
+FIELD_GEN = 2
+
+
+def _build_tables() -> tuple[np.ndarray, np.ndarray]:
+    """exp table (length 512 for wrap-free addition of logs) and log table."""
+    exp = np.zeros(512, dtype=np.uint8)
+    log = np.zeros(256, dtype=np.int32)
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x <<= 1
+        if x & 0x100:
+            x ^= FIELD_POLY
+    exp[255:510] = exp[:255]
+    return exp, log
+
+
+EXP_TABLE, LOG_TABLE = _build_tables()
+
+
+@functools.cache
+def mul_table() -> np.ndarray:
+    """Full 256x256 product table; MUL[a, b] = a*b in GF(2^8)."""
+    la = LOG_TABLE[np.arange(256)]
+    tbl = EXP_TABLE[(la[:, None] + la[None, :])]
+    tbl[0, :] = 0
+    tbl[:, 0] = 0
+    return tbl
+
+
+def gf_mul(a: int, b: int) -> int:
+    if a == 0 or b == 0:
+        return 0
+    return int(EXP_TABLE[LOG_TABLE[a] + LOG_TABLE[b]])
+
+
+def gf_div(a: int, b: int) -> int:
+    if b == 0:
+        raise ZeroDivisionError("GF(2^8) division by zero")
+    if a == 0:
+        return 0
+    return int(EXP_TABLE[LOG_TABLE[a] - LOG_TABLE[b] + 255])
+
+
+def gf_inv(a: int) -> int:
+    return gf_div(1, a)
+
+
+def gf_exp(a: int, n: int) -> int:
+    """a**n in the field, with 0**0 == 1 (matches the reference coder)."""
+    if n == 0:
+        return 1
+    if a == 0:
+        return 0
+    return int(EXP_TABLE[(LOG_TABLE[a] * n) % 255])
+
+
+def gf_matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Matrix product over GF(2^8) for small uint8 matrices."""
+    mul = mul_table()
+    prods = mul[a[:, :, None], b[None, :, :]]
+    return np.bitwise_xor.reduce(prods, axis=1)
+
+
+def gf_mat_inv(a: np.ndarray) -> np.ndarray:
+    """Gauss-Jordan inverse over GF(2^8). Raises if singular."""
+    n = a.shape[0]
+    assert a.shape == (n, n)
+    work = np.concatenate([a.copy(), np.eye(n, dtype=np.uint8)], axis=1)
+    for col in range(n):
+        pivot = None
+        for row in range(col, n):
+            if work[row, col] != 0:
+                pivot = row
+                break
+        if pivot is None:
+            raise np.linalg.LinAlgError("singular matrix over GF(2^8)")
+        if pivot != col:
+            work[[col, pivot]] = work[[pivot, col]]
+        inv_p = gf_inv(int(work[col, col]))
+        work[col] = mul_table()[inv_p, work[col]]
+        for row in range(n):
+            if row != col and work[row, col] != 0:
+                factor = int(work[row, col])
+                work[row] ^= mul_table()[factor, work[col]]
+    return work[:, n:].copy()
+
+
+def vandermonde(rows: int, cols: int) -> np.ndarray:
+    vm = np.zeros((rows, cols), dtype=np.uint8)
+    for r in range(rows):
+        for c in range(cols):
+            vm[r, c] = gf_exp(r, c)
+    return vm
+
+
+@functools.cache
+def rs_matrix(data_shards: int, parity_shards: int) -> np.ndarray:
+    """Systematic RS encoding matrix, (k+m) x k.
+
+    Top k rows are the identity; bottom m rows are the parity coefficients.
+    Construction matches the reference coder's default (Vandermonde made
+    systematic by right-multiplying with the inverse of its top square).
+    """
+    total = data_shards + parity_shards
+    vm = vandermonde(total, data_shards)
+    top_inv = gf_mat_inv(vm[:data_shards, :data_shards])
+    m = gf_matmul(vm, top_inv)
+    assert np.array_equal(m[:data_shards], np.eye(data_shards, dtype=np.uint8))
+    return m
+
+
+@functools.cache
+def parity_matrix(data_shards: int, parity_shards: int) -> np.ndarray:
+    """The m x k bottom of the systematic matrix (what encode multiplies by)."""
+    return rs_matrix(data_shards, parity_shards)[data_shards:].copy()
+
+
+@functools.cache
+def decode_matrix(data_shards: int, parity_shards: int,
+                  present: tuple[int, ...]) -> np.ndarray:
+    """k x k matrix mapping the first k present shards back to the data shards.
+
+    `present` lists the shard ids that survived, ascending. Only the first k
+    are used (like the reference coder's Reconstruct).
+    """
+    if len(present) < data_shards:
+        raise ValueError(
+            f"need at least {data_shards} shards, have {len(present)}")
+    m = rs_matrix(data_shards, parity_shards)
+    rows = m[list(present[:data_shards])]
+    return gf_mat_inv(rows)
+
+
+def encode_parity(data: np.ndarray, parity_shards: int) -> np.ndarray:
+    """data: [k, n] uint8 -> parity [m, n] uint8 (numpy reference path)."""
+    k = data.shape[0]
+    coeff = parity_matrix(k, parity_shards)
+    mul = mul_table()
+    out = np.zeros((parity_shards, data.shape[1]), dtype=np.uint8)
+    for p in range(parity_shards):
+        acc = out[p]
+        for d in range(k):
+            acc ^= mul[coeff[p, d]][data[d]]
+    return out
+
+
+def reconstruct(shards: list[np.ndarray | None], data_shards: int,
+                parity_shards: int,
+                data_only: bool = False) -> list[np.ndarray]:
+    """Fill in missing shards (None entries) from any k survivors.
+
+    Mirrors the reference coder's Reconstruct/ReconstructData semantics:
+    missing data shards are solved via the inverted sub-matrix, then missing
+    parity shards are re-encoded from the recovered data.
+    """
+    total = data_shards + parity_shards
+    assert len(shards) == total
+    present = tuple(i for i, s in enumerate(shards) if s is not None)
+    if len(present) == total:
+        return [s for s in shards]  # type: ignore[misc]
+    if len(present) < data_shards:
+        raise ValueError("too few shards to reconstruct")
+    n = shards[present[0]].shape[0]
+    mul = mul_table()
+
+    out: list[np.ndarray | None] = list(shards)
+    missing_data = [i for i in range(data_shards) if shards[i] is None]
+    if missing_data:
+        dm = decode_matrix(data_shards, parity_shards, present)
+        basis = [shards[i] for i in present[:data_shards]]
+        for tgt in missing_data:
+            acc = np.zeros(n, dtype=np.uint8)
+            for j in range(data_shards):
+                acc ^= mul[dm[tgt, j]][basis[j]]
+            out[tgt] = acc
+    if not data_only:
+        coeff = parity_matrix(data_shards, parity_shards)
+        for p in range(parity_shards):
+            tgt = data_shards + p
+            if out[tgt] is None:
+                acc = np.zeros(n, dtype=np.uint8)
+                for d in range(data_shards):
+                    acc ^= mul[coeff[p, d]][out[d]]
+                out[tgt] = acc
+    return out  # type: ignore[return-value]

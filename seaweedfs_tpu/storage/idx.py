@@ -1,0 +1,53 @@
+""".idx journal format: fixed 16-byte entries (key u64, offset u32, size u32).
+
+Matches the reference index file layout (weed/storage/idx/walk.go:45-50,
+weed/storage/needle_map/needle_value.go:25-31). The journal is append-only;
+a delete is an entry with size == TOMBSTONE (0xFFFFFFFF as stored) and the
+offset of the tombstone needle that recorded the delete in the .dat file.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from typing import Callable, Iterator
+
+from . import types as t
+
+
+def pack_entry(key: int, stored_offset: int, size: int) -> bytes:
+    return t.put_u64(key) + t.put_u32(stored_offset) + t.put_u32(t.size_to_u32(size))
+
+
+def unpack_entry(b: bytes, off: int = 0) -> tuple[int, int, int]:
+    key = t.get_u64(b, off)
+    stored_offset = t.get_u32(b, off + 8)
+    size = t.u32_to_size(t.get_u32(b, off + 12))
+    return key, stored_offset, size
+
+
+def iter_index_bytes(data: bytes) -> Iterator[tuple[int, int, int]]:
+    n = len(data) - len(data) % t.NEEDLE_MAP_ENTRY_SIZE
+    for off in range(0, n, t.NEEDLE_MAP_ENTRY_SIZE):
+        yield unpack_entry(data, off)
+
+
+def walk_index_file(path: str | os.PathLike,
+                    fn: Callable[[int, int, int], None]) -> None:
+    """Stream (key, stored_offset, size) tuples from an .idx file."""
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(t.NEEDLE_MAP_ENTRY_SIZE * 1024)
+            if not chunk:
+                return
+            for entry in iter_index_bytes(chunk):
+                fn(*entry)
+
+
+def iter_index_file(path: str | os.PathLike) -> Iterator[tuple[int, int, int]]:
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(t.NEEDLE_MAP_ENTRY_SIZE * 1024)
+            if not chunk:
+                return
+            yield from iter_index_bytes(chunk)

@@ -1,0 +1,165 @@
+"""Needle maps: in-memory id -> (offset, size) index with .idx journaling.
+
+The reference ships a sectioned CompactMap plus LevelDB variants
+(weed/storage/needle_map.go:14-19, needle_map/compact_map.go). In this
+build the in-memory map is a plain dict (CPython dicts are compact and
+insertion-ordered; the 16B/entry budget of the reference's CompactMap is
+matched closely enough, and a native C++ map slots in behind the same
+interface later). MemDb (weed/storage/needle_map/memdb.go) — the sorted
+offline map used for EC index generation — is `SortedNeedleMap` here.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Callable, Iterator, Optional
+
+from . import idx as idx_mod
+from . import types as t
+
+
+@dataclass(frozen=True)
+class NeedleValue:
+    key: int
+    offset: int  # stored units (multiply by 8 for byte offset)
+    size: int    # signed
+
+
+class NeedleMap:
+    """Live per-volume map, journaling every mutation to the .idx file.
+
+    Mirrors baseNeedleMapper metrics semantics (weed/storage/needle_map.go,
+    needle_map_metric.go): file_count / deleted_count only ever grow with
+    journal entries; *_size track bytes.
+    """
+
+    def __init__(self, index_path: Optional[str] = None):
+        self._map: dict[int, NeedleValue] = {}
+        self._index_file = None
+        self.file_count = 0
+        self.deleted_count = 0
+        self.file_byte_count = 0
+        self.deleted_byte_count = 0
+        self.maximum_key = 0
+        if index_path is not None:
+            self._load(index_path)
+            self._index_file = open(index_path, "ab")
+
+    def _load(self, index_path: str) -> None:
+        if not os.path.exists(index_path):
+            open(index_path, "wb").close()
+            return
+        for key, offset, size in idx_mod.iter_index_file(index_path):
+            self.maximum_key = max(self.maximum_key, key)
+            if offset > 0 and size != t.TOMBSTONE_FILE_SIZE:
+                existing = self._map.get(key)
+                if existing is not None:
+                    self.deleted_count += 1
+                    self.deleted_byte_count += max(existing.size, 0)
+                self._map[key] = NeedleValue(key, offset, size)
+                self.file_count += 1
+                self.file_byte_count += max(size, 0)
+            else:
+                existing = self._map.get(key)
+                if existing is not None and existing.size > 0:
+                    self._map[key] = NeedleValue(key, existing.offset,
+                                                 -existing.size)
+                    self.deleted_count += 1
+                    self.deleted_byte_count += max(existing.size, 0)
+
+    # --- mutation ---
+    def put(self, key: int, stored_offset: int, size: int) -> None:
+        existing = self._map.get(key)
+        if existing is not None and existing.size > 0:
+            # overwriting a live entry orphans its old bytes
+            self.deleted_count += 1
+            self.deleted_byte_count += existing.size
+        self._map[key] = NeedleValue(key, stored_offset, size)
+        self.file_count += 1
+        self.file_byte_count += max(size, 0)
+        self.maximum_key = max(self.maximum_key, key)
+        if self._index_file is not None:
+            self._index_file.write(idx_mod.pack_entry(key, stored_offset, size))
+            self._index_file.flush()
+
+    def delete(self, key: int, tombstone_offset: int = 0) -> bool:
+        """Mark deleted. The entry stays with a negated size so reads can
+        distinguish deleted from never-existed (CompactMap.Delete semantics,
+        weed/storage/needle_map/compact_map.go)."""
+        existing = self._map.get(key)
+        if existing is None or existing.size < 0:
+            return False
+        self._map[key] = NeedleValue(key, existing.offset, -existing.size)
+        self.deleted_count += 1
+        self.deleted_byte_count += max(existing.size, 0)
+        if self._index_file is not None:
+            self._index_file.write(
+                idx_mod.pack_entry(key, tombstone_offset, t.TOMBSTONE_FILE_SIZE))
+            self._index_file.flush()
+        return True
+
+    # --- query ---
+    def get(self, key: int) -> Optional[NeedleValue]:
+        """Returns the entry, with size < 0 when the needle was deleted."""
+        return self._map.get(key)
+
+    def __len__(self) -> int:
+        return sum(1 for nv in self._map.values() if nv.size > 0)
+
+    def __contains__(self, key: int) -> bool:
+        nv = self._map.get(key)
+        return nv is not None and nv.size > 0
+
+    def ascending_visit(self, fn: Callable[[NeedleValue], None]) -> None:
+        for key in sorted(self._map):
+            if self._map[key].size > 0:
+                fn(self._map[key])
+
+    def content_size(self) -> int:
+        return self.file_byte_count
+
+    def close(self) -> None:
+        if self._index_file is not None:
+            self._index_file.close()
+            self._index_file = None
+
+
+class SortedNeedleMap:
+    """Offline sorted map (MemDb equivalent) used to build .ecx files.
+
+    Load an .idx journal (folding deletes), then emit entries ascending by
+    needle id — the invariant the EC index binary search depends on
+    (reference WriteSortedFileFromIdx, ec_encoder.go:27-54).
+    """
+
+    def __init__(self) -> None:
+        self._map: dict[int, NeedleValue] = {}
+
+    @classmethod
+    def from_idx_file(cls, index_path: str) -> "SortedNeedleMap":
+        db = cls()
+        for key, offset, size in idx_mod.iter_index_file(index_path):
+            if offset > 0 and size != t.TOMBSTONE_FILE_SIZE:
+                db.set(key, offset, size)
+            else:
+                db.delete(key)
+        return db
+
+    def set(self, key: int, stored_offset: int, size: int) -> None:
+        self._map[key] = NeedleValue(key, stored_offset, size)
+
+    def delete(self, key: int) -> None:
+        self._map.pop(key, None)
+
+    def get(self, key: int) -> Optional[NeedleValue]:
+        return self._map.get(key)
+
+    def ascending(self) -> Iterator[NeedleValue]:
+        for key in sorted(self._map):
+            yield self._map[key]
+
+    def write_sorted_index(self, path: str) -> None:
+        with open(path, "wb") as f:
+            for nv in self.ascending():
+                f.write(idx_mod.pack_entry(nv.key, nv.offset, nv.size))

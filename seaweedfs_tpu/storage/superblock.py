@@ -1,0 +1,102 @@
+"""Volume superblock: the 8-byte header of every .dat file.
+
+Layout (reference weed/storage/super_block/super_block.go:16-23):
+  byte 0   : version
+  byte 1   : replica placement (XYZ digits packed as X*100+Y*10+Z)
+  bytes 2-3: TTL (count, unit)
+  bytes 4-5: compaction revision (u16)
+  bytes 6-7: extra-size (u16, protobuf blob follows when nonzero)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from . import types as t
+
+SUPER_BLOCK_SIZE = 8
+
+
+@dataclass(frozen=True)
+class ReplicaPlacement:
+    """XYZ code: X copies on other DCs, Y on other racks, Z on same rack
+    (reference weed/storage/super_block/replica_placement.go:9-53)."""
+    diff_data_center_count: int = 0
+    diff_rack_count: int = 0
+    same_rack_count: int = 0
+
+    @classmethod
+    def parse(cls, s: str) -> "ReplicaPlacement":
+        s = (s or "000").ljust(3, "0")
+        vals = [int(c) for c in s[:3]]
+        if any(v < 0 or v > 2 for v in vals):
+            raise ValueError(f"invalid replica placement {s!r}")
+        return cls(*vals)
+
+    @classmethod
+    def from_byte(cls, b: int) -> "ReplicaPlacement":
+        return cls.parse(f"{b:03d}")
+
+    def to_byte(self) -> int:
+        return (self.diff_data_center_count * 100 +
+                self.diff_rack_count * 10 + self.same_rack_count)
+
+    def copy_count(self) -> int:
+        return (self.diff_data_center_count + self.diff_rack_count +
+                self.same_rack_count + 1)
+
+    def __str__(self) -> str:
+        return (f"{self.diff_data_center_count}"
+                f"{self.diff_rack_count}{self.same_rack_count}")
+
+
+@dataclass
+class SuperBlock:
+    version: int = t.CURRENT_VERSION
+    replica_placement: ReplicaPlacement = field(default_factory=ReplicaPlacement)
+    ttl: t.TTL = field(default_factory=lambda: t.EMPTY_TTL)
+    compaction_revision: int = 0
+    extra: bytes = b""
+
+    def block_size(self) -> int:
+        if self.version in (t.VERSION2, t.VERSION3):
+            return SUPER_BLOCK_SIZE + len(self.extra)
+        return SUPER_BLOCK_SIZE
+
+    def to_bytes(self) -> bytes:
+        header = bytearray(SUPER_BLOCK_SIZE)
+        header[0] = self.version
+        header[1] = self.replica_placement.to_byte()
+        header[2:4] = self.ttl.to_bytes()
+        header[4:6] = t.put_u16(self.compaction_revision)
+        if self.extra:
+            if len(self.extra) > 256 * 256 - 2:
+                raise ValueError("superblock extra too large")
+            header[6:8] = t.put_u16(len(self.extra))
+            return bytes(header) + self.extra
+        return bytes(header)
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "SuperBlock":
+        if len(b) < SUPER_BLOCK_SIZE:
+            raise ValueError("superblock truncated")
+        sb = cls(
+            version=b[0],
+            replica_placement=ReplicaPlacement.from_byte(b[1]),
+            ttl=t.TTL.from_bytes(bytes(b[2:4])),
+            compaction_revision=t.get_u16(b, 4),
+        )
+        extra_size = t.get_u16(b, 6)
+        if extra_size:
+            sb.extra = bytes(b[SUPER_BLOCK_SIZE:SUPER_BLOCK_SIZE + extra_size])
+        return sb
+
+    @classmethod
+    def read_from(cls, f) -> "SuperBlock":
+        f.seek(0)
+        head = f.read(SUPER_BLOCK_SIZE)
+        sb = cls.from_bytes(head)
+        extra_size = t.get_u16(head, 6)
+        if extra_size:
+            sb.extra = f.read(extra_size)
+        return sb

@@ -1,0 +1,160 @@
+"""Core on-disk types: sizes, offsets, versions, TTL.
+
+Byte-for-byte compatible with the reference formats (seaweedfs
+weed/storage/types/needle_types.go, offset_4bytes.go, weed/util/bytes.go —
+all integers big-endian; offsets stored as uint32 in units of 8 bytes,
+bounding a volume at 32GB).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+NEEDLE_ID_SIZE = 8
+OFFSET_SIZE = 4
+SIZE_SIZE = 4
+COOKIE_SIZE = 4
+NEEDLE_HEADER_SIZE = COOKIE_SIZE + NEEDLE_ID_SIZE + SIZE_SIZE  # 16
+NEEDLE_MAP_ENTRY_SIZE = NEEDLE_ID_SIZE + OFFSET_SIZE + SIZE_SIZE  # 16
+NEEDLE_CHECKSUM_SIZE = 4
+TIMESTAMP_SIZE = 8
+NEEDLE_PADDING_SIZE = 8
+TOMBSTONE_FILE_SIZE = -1  # Size(-1) marks a deleted needle in the index
+MAX_POSSIBLE_VOLUME_SIZE = 4 * 1024 * 1024 * 1024 * 8  # 32GB
+
+VERSION1 = 1
+VERSION2 = 2
+VERSION3 = 3
+CURRENT_VERSION = VERSION3
+
+_U16 = struct.Struct(">H")
+_U32 = struct.Struct(">I")
+_U64 = struct.Struct(">Q")
+
+
+def put_u16(v: int) -> bytes:
+    return _U16.pack(v)
+
+
+def put_u32(v: int) -> bytes:
+    return _U32.pack(v & 0xFFFFFFFF)
+
+
+def put_u64(v: int) -> bytes:
+    return _U64.pack(v)
+
+
+def get_u16(b: bytes, off: int = 0) -> int:
+    return _U16.unpack_from(b, off)[0]
+
+
+def get_u32(b: bytes, off: int = 0) -> int:
+    return _U32.unpack_from(b, off)[0]
+
+
+def get_u64(b: bytes, off: int = 0) -> int:
+    return _U64.unpack_from(b, off)[0]
+
+
+def size_is_deleted(size: int) -> bool:
+    return size < 0 or size == TOMBSTONE_FILE_SIZE
+
+
+def size_is_valid(size: int) -> bool:
+    return size > 0 and size != TOMBSTONE_FILE_SIZE
+
+
+def size_to_u32(size: int) -> int:
+    """Signed Size -> the uint32 stored on disk (two's complement)."""
+    return size & 0xFFFFFFFF
+
+
+def u32_to_size(v: int) -> int:
+    return v - (1 << 32) if v & 0x80000000 else v
+
+
+def offset_to_stored(actual_offset: int) -> int:
+    """Byte offset -> stored uint32 (units of NEEDLE_PADDING_SIZE)."""
+    assert actual_offset % NEEDLE_PADDING_SIZE == 0, actual_offset
+    stored = actual_offset // NEEDLE_PADDING_SIZE
+    assert stored < (1 << 32), "volume exceeds 32GB addressing"
+    return stored
+
+
+def stored_to_offset(stored: int) -> int:
+    return stored * NEEDLE_PADDING_SIZE
+
+
+def padding_length(needle_size: int, version: int) -> int:
+    if version == VERSION3:
+        used = NEEDLE_HEADER_SIZE + needle_size + NEEDLE_CHECKSUM_SIZE + TIMESTAMP_SIZE
+    else:
+        used = NEEDLE_HEADER_SIZE + needle_size + NEEDLE_CHECKSUM_SIZE
+    return (-used) % NEEDLE_PADDING_SIZE
+
+
+def get_actual_size(needle_size: int, version: int) -> int:
+    if version == VERSION3:
+        base = NEEDLE_HEADER_SIZE + needle_size + NEEDLE_CHECKSUM_SIZE + TIMESTAMP_SIZE
+    else:
+        base = NEEDLE_HEADER_SIZE + needle_size + NEEDLE_CHECKSUM_SIZE
+    return base + padding_length(needle_size, version)
+
+
+# --- TTL (2 bytes on disk: count, unit) — weed/storage/needle/volume_ttl.go ---
+
+TTL_EMPTY = 0
+TTL_MINUTE = 1
+TTL_HOUR = 2
+TTL_DAY = 3
+TTL_WEEK = 4
+TTL_MONTH = 5
+TTL_YEAR = 6
+
+_UNIT_BY_CHAR = {"m": TTL_MINUTE, "h": TTL_HOUR, "d": TTL_DAY,
+                 "w": TTL_WEEK, "M": TTL_MONTH, "y": TTL_YEAR}
+_CHAR_BY_UNIT = {v: k for k, v in _UNIT_BY_CHAR.items()}
+_MINUTES_BY_UNIT = {TTL_EMPTY: 0, TTL_MINUTE: 1, TTL_HOUR: 60,
+                    TTL_DAY: 60 * 24, TTL_WEEK: 60 * 24 * 7,
+                    TTL_MONTH: 60 * 24 * 31, TTL_YEAR: 60 * 24 * 365}
+
+
+@dataclass(frozen=True)
+class TTL:
+    count: int = 0
+    unit: int = TTL_EMPTY
+
+    @classmethod
+    def parse(cls, s: str) -> "TTL":
+        s = s.strip()
+        if not s:
+            return EMPTY_TTL
+        unit_ch = s[-1]
+        if unit_ch.isdigit():
+            count, unit_ch = int(s), "m"
+        else:
+            count = int(s[:-1])
+            if unit_ch not in _UNIT_BY_CHAR:
+                raise ValueError(f"unknown TTL unit {unit_ch!r}")
+        return cls(count, _UNIT_BY_CHAR[unit_ch])
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "TTL":
+        if len(b) != 2 or (b[0] == 0 and b[1] == 0):
+            return EMPTY_TTL
+        return cls(b[0], b[1])
+
+    def to_bytes(self) -> bytes:
+        return bytes([self.count & 0xFF, self.unit & 0xFF])
+
+    def minutes(self) -> int:
+        return self.count * _MINUTES_BY_UNIT.get(self.unit, 0)
+
+    def __str__(self) -> str:
+        if self.count == 0:
+            return ""
+        return f"{self.count}{_CHAR_BY_UNIT.get(self.unit, '')}"
+
+
+EMPTY_TTL = TTL()

@@ -1,0 +1,209 @@
+import os
+
+import pytest
+
+from seaweedfs_tpu.storage import idx, needle_map, types as t
+from seaweedfs_tpu.storage.needle import (
+    FLAG_HAS_LAST_MODIFIED, FLAG_HAS_MIME, FLAG_HAS_NAME, FLAG_HAS_PAIRS,
+    FLAG_HAS_TTL, Needle, crc32c_update, crc_value)
+from seaweedfs_tpu.storage.superblock import ReplicaPlacement, SuperBlock
+from seaweedfs_tpu.storage.volume import (
+    NeedleDeleted, NeedleNotFound, Volume)
+
+
+def test_crc_mask_known_value():
+    # crc32c("123456789") == 0xE3069283 (Castagnoli check value)
+    crc = crc32c_update(0, b"123456789")
+    assert crc == 0xE3069283
+    want = (((0xE3069283 >> 15) | (0xE3069283 << 17)) & 0xFFFFFFFF)
+    want = (want + 0xA282EAD8) & 0xFFFFFFFF
+    assert crc_value(crc) == want
+
+
+def test_ttl_roundtrip():
+    for s, minutes in [("3m", 3), ("4h", 240), ("5d", 5 * 1440),
+                       ("6w", 6 * 10080), ("7M", 7 * 44640),
+                       ("2y", 2 * 525600)]:
+        ttl = t.TTL.parse(s)
+        assert ttl.minutes() == minutes
+        assert t.TTL.from_bytes(ttl.to_bytes()) == ttl
+        assert str(ttl) == s
+    assert t.TTL.parse("") == t.EMPTY_TTL
+    assert t.TTL.parse("90") == t.TTL(90, t.TTL_MINUTE)
+    assert t.EMPTY_TTL.to_bytes() == b"\x00\x00"
+
+
+def test_padding_and_actual_size():
+    # v3 trailer is 4 (crc) + 8 (ns); header 16 -> total must be %8 == 0
+    for size in range(0, 64):
+        actual = t.get_actual_size(size, t.VERSION3)
+        assert actual % 8 == 0
+        assert actual >= 16 + size + 12
+        actual2 = t.get_actual_size(size, t.VERSION2)
+        assert actual2 % 8 == 0
+
+
+@pytest.mark.parametrize("version", [t.VERSION1, t.VERSION2, t.VERSION3])
+def test_needle_roundtrip_simple(version):
+    n = Needle(cookie=0x12345678, id=0xABCDEF, data=b"hello world")
+    rec = n.to_bytes(version)
+    assert len(rec) == t.get_actual_size(n.size, version)
+    back = Needle.from_bytes(rec, version)
+    assert back.cookie == n.cookie
+    assert back.id == n.id
+    assert back.data == n.data
+
+
+def test_needle_roundtrip_all_fields():
+    n = Needle(cookie=7, id=42, data=b"payload" * 100)
+    n.set_flag(FLAG_HAS_NAME)
+    n.name = b"file.jpg"
+    n.set_flag(FLAG_HAS_MIME)
+    n.mime = b"image/jpeg"
+    n.set_flag(FLAG_HAS_LAST_MODIFIED)
+    n.last_modified = 1_700_000_000
+    n.set_flag(FLAG_HAS_TTL)
+    n.ttl = t.TTL.parse("3d")
+    n.set_flag(FLAG_HAS_PAIRS)
+    n.pairs = b'{"Seaweed-k":"v"}'
+    n.append_at_ns = 123456789
+    rec = n.to_bytes(t.VERSION3)
+    back = Needle.from_bytes(rec, t.VERSION3)
+    assert back.data == n.data
+    assert back.name == n.name
+    assert back.mime == n.mime
+    assert back.last_modified == n.last_modified
+    assert back.ttl == n.ttl
+    assert back.pairs == n.pairs
+    assert back.append_at_ns == 123456789
+
+
+def test_needle_crc_detects_corruption():
+    n = Needle(cookie=1, id=2, data=b"data here")
+    rec = bytearray(n.to_bytes(t.VERSION3))
+    rec[t.NEEDLE_HEADER_SIZE + 4] ^= 0xFF  # flip a data byte
+    with pytest.raises(ValueError, match="CRC"):
+        Needle.from_bytes(bytes(rec), t.VERSION3)
+
+
+def test_idx_entry_roundtrip():
+    b = idx.pack_entry(0xDEADBEEF, 1234, -1)
+    assert len(b) == 16
+    key, off, size = idx.unpack_entry(b)
+    assert (key, off, size) == (0xDEADBEEF, 1234, -1)
+    # big-endian layout pinned
+    assert b[:8] == bytes([0, 0, 0, 0, 0xDE, 0xAD, 0xBE, 0xEF])
+    assert b[12:16] == b"\xff\xff\xff\xff"
+
+
+def test_superblock_roundtrip():
+    sb = SuperBlock(version=3, replica_placement=ReplicaPlacement.parse("012"),
+                    ttl=t.TTL.parse("5d"), compaction_revision=7)
+    b = sb.to_bytes()
+    assert len(b) == 8
+    assert b[0] == 3
+    assert b[1] == 12
+    back = SuperBlock.from_bytes(b)
+    assert back.replica_placement == sb.replica_placement
+    assert back.ttl == sb.ttl
+    assert back.compaction_revision == 7
+    assert ReplicaPlacement.parse("012").copy_count() == 4
+
+
+def test_needle_map_journal_and_reload(tmp_path):
+    p = str(tmp_path / "1.idx")
+    nm = needle_map.NeedleMap(p)
+    nm.put(1, 10, 100)
+    nm.put(2, 20, 200)
+    nm.put(3, 30, 300)
+    nm.delete(2, 40)
+    nm.close()
+
+    nm2 = needle_map.NeedleMap(p)
+    assert len(nm2) == 2
+    assert nm2.get(1).size == 100
+    assert nm2.get(2).size == -200  # deleted marker survives reload
+    assert nm2.get(3).offset == 30
+    assert nm2.deleted_count == 1
+    visited = []
+    nm2.ascending_visit(lambda nv: visited.append(nv.key))
+    assert visited == [1, 3]
+    nm2.close()
+
+
+def test_volume_write_read_delete(tmp_path):
+    v = Volume(str(tmp_path), "", 7, create=True)
+    payloads = {i: os.urandom(50 + i * 13) for i in range(1, 20)}
+    for nid, data in payloads.items():
+        off, size, unchanged = v.write_needle(
+            Needle(cookie=0x100 + nid, id=nid, data=data))
+        assert not unchanged
+        assert off % 8 == 0
+    for nid, data in payloads.items():
+        n = v.read_needle(nid, cookie=0x100 + nid)
+        assert n.data == data
+    # duplicate write dedupes
+    _, _, unchanged = v.write_needle(
+        Needle(cookie=0x101, id=1, data=payloads[1]))
+    assert unchanged
+    # delete
+    assert v.delete_needle(Needle(cookie=0x105, id=5)) > 0
+    with pytest.raises(NeedleDeleted):
+        v.read_needle(5)
+    with pytest.raises(NeedleNotFound):
+        v.read_needle(999)
+    v.close()
+
+
+def test_volume_reload_and_integrity(tmp_path):
+    v = Volume(str(tmp_path), "col", 3, create=True)
+    v.write_needle(Needle(cookie=1, id=11, data=b"aaa"))
+    v.write_needle(Needle(cookie=2, id=22, data=b"bbb"))
+    v.delete_needle(Needle(cookie=1, id=11))
+    v.close()
+
+    v2 = Volume(str(tmp_path), "col", 3)
+    assert v2.read_needle(22).data == b"bbb"
+    with pytest.raises(KeyError):
+        v2.read_needle(11)
+    assert v2.file_count() == 1
+    v2.close()
+
+
+def test_volume_compact(tmp_path):
+    v = Volume(str(tmp_path), "", 9, create=True)
+    for i in range(1, 11):
+        v.write_needle(Needle(cookie=i, id=i, data=bytes([i]) * 100))
+    for i in range(1, 6):
+        v.delete_needle(Needle(cookie=i, id=i))
+    assert v.garbage_level() > 0
+    size_before = v.data_file_size()
+    rev_before = v.super_block.compaction_revision
+    v.compact()
+    assert v.data_file_size() < size_before
+    assert v.super_block.compaction_revision == rev_before + 1
+    assert v.garbage_level() == 0
+    for i in range(6, 11):
+        assert v.read_needle(i).data == bytes([i]) * 100
+    for i in range(1, 6):
+        with pytest.raises(KeyError):
+            v.read_needle(i)
+    # survives reload
+    v.close()
+    v3 = Volume(str(tmp_path), "", 9)
+    assert v3.read_needle(10).data == bytes([10]) * 100
+    v3.close()
+
+
+def test_volume_ttl_expiry(tmp_path):
+    v = Volume(str(tmp_path), "", 5, create=True)
+    n = Needle(cookie=1, id=1, data=b"x")
+    n.set_flag(FLAG_HAS_LAST_MODIFIED)
+    n.last_modified = 1000
+    n.set_flag(FLAG_HAS_TTL)
+    n.ttl = t.TTL.parse("1m")
+    v.write_needle(n)
+    assert v.read_needle(1, now=1030).data == b"x"
+    with pytest.raises(NeedleNotFound):
+        v.read_needle(1, now=1061)
+    v.close()
