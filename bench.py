@@ -1,0 +1,65 @@
+#!/usr/bin/env python3
+"""Headline benchmark: RS(10,4) ec.encode throughput on one chip.
+
+Mirrors BASELINE config 2 (batched volumes, 1MB-block stripes -> TPU): feeds
+the fused Pallas GF(2^8) kernel 640MB data batches ([10 x 64MiB] stripes,
+i.e. the coder-visible shape of the reference encode loop
+weed/storage/erasure_coding/ec_encoder.go:162-192) and reports steady-state
+data throughput. Baseline for vs_baseline is the BASELINE.json north-star
+target of 20 GB/s/chip.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N}
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_GBPS = 20.0  # BASELINE.json: ec.encode >= 20 GB/s/chip on v5e
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from seaweedfs_tpu.ops import gf256, rs_pallas
+
+    backend = jax.default_backend()
+    n = 64 * 1024 * 1024 if backend == "tpu" else 1024 * 1024
+    data = jnp.asarray(
+        np.random.default_rng(0).integers(0, 256, (10, n), dtype=np.uint8))
+
+    fn = rs_pallas.gf_apply_pallas(gf256.parity_matrix(10, 4))
+    out = fn(data)
+    out.block_until_ready()  # compile + warm
+
+    # correctness gate: never report speed for wrong parity
+    check = np.asarray(out[:, :65536])
+    want = gf256.encode_parity(np.asarray(data[:, :65536]), 4)
+    if not np.array_equal(check, want):
+        print(json.dumps({"metric": "ec.encode GB/s/chip", "value": 0.0,
+                          "unit": "GB/s", "vs_baseline": 0.0,
+                          "error": "parity mismatch"}))
+        sys.exit(1)
+
+    reps = 10 if backend == "tpu" else 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(data)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / reps
+
+    gbps = (10 * n) / dt / 1e9
+    print(json.dumps({
+        "metric": "ec.encode GB/s/chip",
+        "value": round(gbps, 2),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / BASELINE_GBPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -15,11 +15,16 @@ is purely a placement/performance decision.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 import numpy as np
 
 from ..ops import gf256, rs_jax
+
+# fn(survivors [k, n] uint8) -> rebuilt rows [len(missing), n] uint8
+ApplyFn = Callable[[np.ndarray], np.ndarray]
+# (present_k, missing) -> ApplyFn
+ApplyBuilder = Callable[[tuple, tuple], ApplyFn]
 
 
 class ErasureCoder:
@@ -33,10 +38,42 @@ class ErasureCoder:
         """data [k, n] uint8 -> parity [m, n] uint8."""
         raise NotImplementedError
 
-    def reconstruct(self, shards: Sequence[Optional[np.ndarray]],
-                    data_only: bool = False) -> list[Optional[np.ndarray]]:
-        """Fill None entries from any k survivors; returns full shard list."""
+    def _rec_apply(self, present: tuple, missing: tuple) -> ApplyFn:
+        """Backend hook: build the survivors->missing transform."""
         raise NotImplementedError
+
+    def reconstruct(self, shards: Sequence[Optional[np.ndarray]],
+                    data_only: bool = False,
+                    targets: Optional[Sequence[int]] = None
+                    ) -> list[Optional[np.ndarray]]:
+        """Fill missing (None) entries from any k survivors.
+
+        targets: rebuild only these shard ids (all must be absent); default
+        rebuilds every absent shard (all of them, or data shards only with
+        data_only=True) — matching the reference coder's
+        Reconstruct/ReconstructData split.
+        """
+        total = self.k + self.m
+        assert len(shards) == total
+        present = tuple(i for i, s in enumerate(shards) if s is not None)
+        if targets is not None:
+            missing = tuple(targets)
+            assert all(shards[i] is None for i in missing), missing
+        else:
+            missing = tuple(i for i, s in enumerate(shards) if s is None
+                            and (not data_only or i < self.k))
+        if not missing:
+            return list(shards)
+        if len(present) < self.k:
+            raise ValueError("too few shards to reconstruct")
+        fn = self._rec_apply(present[:self.k], missing)
+        survivors = np.stack([np.asarray(shards[i], dtype=np.uint8)
+                              for i in present[:self.k]])
+        rebuilt = np.asarray(fn(survivors))
+        out = list(shards)
+        for row, tgt in enumerate(missing):
+            out[tgt] = rebuilt[row]
+        return out
 
     def verify(self, shards: Sequence[np.ndarray]) -> bool:
         data = np.stack(shards[:self.k])
@@ -48,10 +85,18 @@ class NumpyCoder(ErasureCoder):
     def encode(self, data: np.ndarray) -> np.ndarray:
         return gf256.encode_parity(np.asarray(data, dtype=np.uint8), self.m)
 
-    def reconstruct(self, shards, data_only=False):
-        arrs = [None if s is None else np.asarray(s, dtype=np.uint8)
-                for s in shards]
-        return gf256.reconstruct(arrs, self.k, self.m, data_only=data_only)
+    def _rec_apply(self, present, missing):
+        rec = gf256.reconstruction_matrix(self.k, self.m, present, missing)
+        mul = gf256.mul_table()
+
+        def apply_fn(survivors: np.ndarray) -> np.ndarray:
+            out = np.zeros((len(missing), survivors.shape[1]), dtype=np.uint8)
+            for r in range(rec.shape[0]):
+                for c in range(rec.shape[1]):
+                    out[r] ^= mul[rec[r, c]][survivors[c]]
+            return out
+
+        return apply_fn
 
 
 class JaxCoder(ErasureCoder):
@@ -65,12 +110,36 @@ class JaxCoder(ErasureCoder):
                                    method=self.method)
         return np.asarray(out)
 
-    def reconstruct(self, shards, data_only=False):
-        arrs = [None if s is None else np.asarray(s, dtype=np.uint8)
-                for s in shards]
-        out = rs_jax.reconstruct(arrs, self.k, self.m, method=self.method,
-                                 data_only=data_only)
-        return [None if s is None else np.asarray(s) for s in out]
+    def _rec_apply(self, present, missing):
+        return rs_jax._reconstruct_fn(self.k, self.m, present, missing,
+                                      self.method)
+
+
+class PallasCoder(ErasureCoder):
+    """Fused TPU kernel path (rs_pallas.py); interpret-mode on CPU."""
+
+    def __init__(self, data_shards: int, parity_shards: int,
+                 tile: int | None = None):
+        super().__init__(data_shards, parity_shards)
+        from ..ops import rs_pallas
+        self._mod = rs_pallas
+        self._tile = tile or rs_pallas.DEFAULT_TILE
+        self._encode = rs_pallas.gf_apply_pallas(
+            gf256.parity_matrix(data_shards, parity_shards), tile=self._tile)
+        self._rec_cache: dict = {}
+
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        return np.asarray(self._encode(np.asarray(data, dtype=np.uint8)))
+
+    def _rec_apply(self, present, missing):
+        key = (present, missing)
+        fn = self._rec_cache.get(key)
+        if fn is None:
+            rec = gf256.reconstruction_matrix(self.k, self.m, present,
+                                              missing)
+            fn = self._mod.gf_apply_pallas(rec, tile=self._tile)
+            self._rec_cache[key] = fn
+        return fn
 
 
 _REGISTRY = {}
@@ -83,16 +152,24 @@ def register_coder(name: str, factory) -> None:
 register_coder("numpy", NumpyCoder)
 register_coder("jax", JaxCoder)
 register_coder("jax_lut", lambda k, m: JaxCoder(k, m, method="lut"))
+register_coder("pallas", PallasCoder)
 
 
 def get_coder(name: str, data_shards: int, parity_shards: int) -> ErasureCoder:
     if name == "auto":
-        for candidate in ("pallas", "jax", "numpy"):
+        import jax
+        # pallas only wins on real TPU; its CPU interpret mode is ~2x slower
+        # than the XLA bitplane path
+        order = (("pallas", "jax", "numpy")
+                 if jax.default_backend() == "tpu"
+                 else ("jax", "numpy"))
+        for candidate in order:
             if candidate in _REGISTRY:
                 try:
                     return _REGISTRY[candidate](data_shards, parity_shards)
                 except Exception:
                     continue
+        raise KeyError("no erasure coder backend available")
     if name not in _REGISTRY:
         raise KeyError(f"unknown coder {name!r}; have {sorted(_REGISTRY)}")
     return _REGISTRY[name](data_shards, parity_shards)

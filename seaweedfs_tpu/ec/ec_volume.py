@@ -208,7 +208,7 @@ class EcVolume:
             raise IOError(
                 f"cannot reconstruct shard {missing_shard}: "
                 f"only {have} of {self.g.data_shards} shards reachable")
-        rebuilt = self.coder.reconstruct(shards)
+        rebuilt = self.coder.reconstruct(shards, targets=(missing_shard,))
         return np.asarray(rebuilt[missing_shard]).tobytes()
 
     # --- delete path ---

@@ -157,6 +157,25 @@ def decode_matrix(data_shards: int, parity_shards: int,
     return gf_mat_inv(rows)
 
 
+def reconstruction_matrix(data_shards: int, parity_shards: int,
+                          present: tuple[int, ...],
+                          missing: tuple[int, ...]) -> np.ndarray:
+    """Matrix mapping the first k present shards to the missing shards.
+
+    Row t rebuilds missing[t]: data shards via the inverted sub-matrix,
+    parity shards by re-encoding through the recovered data.
+    """
+    full = rs_matrix(data_shards, parity_shards)
+    dm = decode_matrix(data_shards, parity_shards, present)
+    rows = []
+    for tgt in missing:
+        if tgt < data_shards:
+            rows.append(dm[tgt])
+        else:
+            rows.append(gf_matmul(full[tgt][None, :], dm)[0])
+    return np.stack(rows).astype(np.uint8)
+
+
 def encode_parity(data: np.ndarray, parity_shards: int) -> np.ndarray:
     """data: [k, n] uint8 -> parity [m, n] uint8 (numpy reference path)."""
     k = data.shape[0]

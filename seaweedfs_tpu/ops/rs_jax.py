@@ -150,18 +150,8 @@ def _reconstruct_fn(data_shards: int, parity_shards: int,
                     present: tuple[int, ...], missing: tuple[int, ...],
                     method: str):
     """Jitted fn: survivors [k, n] (first k present, ascending) -> missing rows."""
-    full = gf256.rs_matrix(data_shards, parity_shards)
-    dm = gf256.decode_matrix(data_shards, parity_shards, present)
-    # rows mapping survivors -> each missing shard id:
-    # data shard i   -> dm[i]
-    # parity shard p -> parity_coeff[p] @ dm  (re-encode through recovered data)
-    rows = []
-    for tgt in missing:
-        if tgt < data_shards:
-            rows.append(dm[tgt])
-        else:
-            rows.append(gf256.gf_matmul(full[tgt][None, :], dm)[0])
-    rec_matrix = np.stack(rows).astype(np.uint8)
+    rec_matrix = gf256.reconstruction_matrix(data_shards, parity_shards,
+                                             present, missing)
     apply_fn = (gf_apply_bitplane if method == "bitplane"
                 else gf_apply_lut)(rec_matrix)
     return jax.jit(apply_fn)

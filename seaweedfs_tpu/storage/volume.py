@@ -54,6 +54,9 @@ class Volume:
             self._dat = open(dat_path, "w+b")
             self._dat.write(self.super_block.to_bytes())
             self._dat.flush()
+            # fresh .dat invalidates any stale journal from a prior volume
+            if os.path.exists(base + ".idx"):
+                os.remove(base + ".idx")
             self.nm = NeedleMap(base + ".idx")
         else:
             self._dat = open(dat_path, "r+b")

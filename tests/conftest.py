@@ -1,13 +1,23 @@
-"""Test harness: run everything on a virtual 8-device CPU mesh.
+"""Test harness: force a true 8-device virtual-CPU mesh.
 
-Must set the XLA flags before jax is imported anywhere, so this sits at the
-top of conftest (pytest imports conftest before test modules).
+The container's site hook eagerly registers the TPU (axon) backend and
+overrides JAX_PLATFORMS, so env vars alone don't select CPU. XLA_FLAGS must
+be set before the first backend init, and the platform is forced via
+jax.config (which wins over the hook).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    assert jax.default_backend() == "cpu", jax.default_backend()
+    assert len(jax.devices()) == 8, jax.devices()
