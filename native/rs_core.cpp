@@ -1,0 +1,180 @@
+// Native CPU core for the TPU-native store: GF(2^8) Reed-Solomon bulk math
+// and CRC32C. This is the build's replacement for the reference's native
+// dependencies (klauspost/reedsolomon SIMD assembly and klauspost/crc32,
+// see seaweedfs go.mod:44-45): the CPU-side ErasureCoder backend used for
+// bit-identity cross-checks against the TPU kernels and for hosts without a
+// chip.
+//
+// Field: GF(2^8), polynomial 0x11D, generator 2 — same arithmetic as
+// gf256.py; matrices are built in Python and passed in, so all backends
+// share one construction.
+//
+// The hot loop is a split-nibble table kernel (the same algorithmic shape
+// klauspost's AVX2 galMulSlice uses, expressed portably so the compiler can
+// auto-vectorize with -O3 -march=native).
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+#if defined(__AVX2__)
+#include <immintrin.h>
+#endif
+
+namespace {
+
+constexpr int kFieldPoly = 0x11D;
+
+struct Tables {
+    // mul[a][b] = a*b in GF(2^8)
+    uint8_t mul[256][256];
+    Tables() {
+        uint8_t exp[512];
+        int log[256] = {0};
+        int x = 1;
+        for (int i = 0; i < 255; i++) {
+            exp[i] = static_cast<uint8_t>(x);
+            log[x] = i;
+            x <<= 1;
+            if (x & 0x100) x ^= kFieldPoly;
+        }
+        for (int i = 255; i < 512; i++) exp[i] = exp[i - 255];
+        for (int a = 0; a < 256; a++) {
+            for (int b = 0; b < 256; b++) {
+                mul[a][b] = (a && b)
+                    ? exp[log[a] + log[b]]
+                    : 0;
+            }
+        }
+    }
+};
+
+const Tables& tables() {
+    static const Tables t;
+    return t;
+}
+
+// out ^= coeff * in, over n bytes, via low/high nibble tables
+void mul_add_row(uint8_t coeff, const uint8_t* in, uint8_t* out, size_t n) {
+    if (coeff == 0) return;
+    const auto& mul = tables().mul;
+    if (coeff == 1) {
+        for (size_t i = 0; i < n; i++) out[i] ^= in[i];
+        return;
+    }
+    alignas(32) uint8_t lo[16], hi[16];
+    for (int v = 0; v < 16; v++) {
+        lo[v] = mul[coeff][v];
+        hi[v] = mul[coeff][v << 4];
+    }
+    size_t i = 0;
+#if defined(__AVX2__)
+    // 32 bytes per step: product = pshufb(lo, b&0xF) ^ pshufb(hi, b>>4)
+    const __m256i vlo = _mm256_broadcastsi128_si256(
+        _mm_load_si128(reinterpret_cast<const __m128i*>(lo)));
+    const __m256i vhi = _mm256_broadcastsi128_si256(
+        _mm_load_si128(reinterpret_cast<const __m128i*>(hi)));
+    const __m256i mask = _mm256_set1_epi8(0x0F);
+    for (; i + 32 <= n; i += 32) {
+        __m256i b = _mm256_loadu_si256(
+            reinterpret_cast<const __m256i*>(in + i));
+        __m256i bl = _mm256_and_si256(b, mask);
+        __m256i bh = _mm256_and_si256(_mm256_srli_epi64(b, 4), mask);
+        __m256i prod = _mm256_xor_si256(_mm256_shuffle_epi8(vlo, bl),
+                                        _mm256_shuffle_epi8(vhi, bh));
+        __m256i o = _mm256_loadu_si256(
+            reinterpret_cast<const __m256i*>(out + i));
+        _mm256_storeu_si256(reinterpret_cast<__m256i*>(out + i),
+                            _mm256_xor_si256(o, prod));
+    }
+#endif
+    for (; i < n; i++) {
+        uint8_t b = in[i];
+        out[i] ^= static_cast<uint8_t>(lo[b & 0x0F] ^ hi[b >> 4]);
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// outputs[r] = sum_c matrix[r*cols+c] * inputs[c]  (GF(2^8), n bytes each).
+// Column-blocked so each (input, output) slice stays L2-resident while all
+// rows x cols coefficient passes run over it.
+void gf_matrix_apply(const uint8_t* matrix, int rows, int cols,
+                     const uint8_t* const* inputs, uint8_t* const* outputs,
+                     size_t n) {
+    constexpr size_t kBlock = 64 * 1024;
+    for (size_t off = 0; off < n; off += kBlock) {
+        size_t len = n - off < kBlock ? n - off : kBlock;
+        for (int r = 0; r < rows; r++) {
+            std::memset(outputs[r] + off, 0, len);
+            for (int c = 0; c < cols; c++) {
+                mul_add_row(matrix[r * cols + c], inputs[c] + off,
+                            outputs[r] + off, len);
+            }
+        }
+    }
+}
+
+// ---- CRC32C (Castagnoli), slice-by-8, matching Go crc32.Update semantics ----
+
+static uint32_t crc32c_table[8][256];
+static bool crc32c_init_done = false;
+
+static void crc32c_init() {
+    if (crc32c_init_done) return;
+    const uint32_t poly = 0x82F63B78u;  // reflected Castagnoli
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t crc = i;
+        for (int j = 0; j < 8; j++) {
+            crc = (crc & 1) ? (crc >> 1) ^ poly : crc >> 1;
+        }
+        crc32c_table[0][i] = crc;
+    }
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t crc = crc32c_table[0][i];
+        for (int k = 1; k < 8; k++) {
+            crc = crc32c_table[0][crc & 0xFF] ^ (crc >> 8);
+            crc32c_table[k][i] = crc;
+        }
+    }
+    crc32c_init_done = true;
+}
+
+uint32_t crc32c_update(uint32_t crc, const uint8_t* data, size_t n) {
+    crc32c_init();
+    crc = ~crc;
+    while (n >= 8) {
+        crc ^= static_cast<uint32_t>(data[0]) |
+               (static_cast<uint32_t>(data[1]) << 8) |
+               (static_cast<uint32_t>(data[2]) << 16) |
+               (static_cast<uint32_t>(data[3]) << 24);
+        uint32_t hi = static_cast<uint32_t>(data[4]) |
+                      (static_cast<uint32_t>(data[5]) << 8) |
+                      (static_cast<uint32_t>(data[6]) << 16) |
+                      (static_cast<uint32_t>(data[7]) << 24);
+        crc = crc32c_table[7][crc & 0xFF] ^
+              crc32c_table[6][(crc >> 8) & 0xFF] ^
+              crc32c_table[5][(crc >> 16) & 0xFF] ^
+              crc32c_table[4][crc >> 24] ^
+              crc32c_table[3][hi & 0xFF] ^
+              crc32c_table[2][(hi >> 8) & 0xFF] ^
+              crc32c_table[1][(hi >> 16) & 0xFF] ^
+              crc32c_table[0][hi >> 24];
+        data += 8;
+        n -= 8;
+    }
+    while (n--) {
+        crc = crc32c_table[0][(crc ^ *data++) & 0xFF] ^ (crc >> 8);
+    }
+    return ~crc;
+}
+
+// masked needle checksum (reference weed/storage/needle/crc.go:23-25)
+uint32_t crc32c_needle_value(uint32_t crc) {
+    uint32_t rot = (crc >> 15) | (crc << 17);
+    return rot + 0xA282EAD8u;
+}
+
+}  // extern "C"

@@ -1,0 +1,275 @@
+"""Command-line interface: the `weed`-equivalent entry point.
+
+Subcommands mirror the reference CLI (weed/command/command.go:10-33):
+master, volume, server (master+volume in one process), upload, download,
+delete, benchmark, shell ops (ec.encode / ec.rebuild / ec.balance /
+ec.decode, volume.vacuum), status.
+
+  python -m seaweedfs_tpu.cli master -port 9333
+  python -m seaweedfs_tpu.cli volume -port 8080 -dir /data -mserver localhost:9333
+  python -m seaweedfs_tpu.cli upload -server localhost:9333 FILE...
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import sys
+
+
+def _run_forever(coro) -> None:
+    loop = asyncio.new_event_loop()
+    loop.run_until_complete(coro)
+    try:
+        loop.run_forever()
+    except KeyboardInterrupt:
+        pass
+
+
+def cmd_master(args) -> None:
+    from .server.master import run_master
+    _run_forever(run_master(
+        args.ip, args.port,
+        volume_size_limit_mb=args.volume_size_limit_mb,
+        default_replication=args.default_replication))
+
+
+def cmd_volume(args) -> None:
+    from .ec.geometry import Geometry
+    from .server.volume_server import run_volume_server
+    from .storage.store import Store
+    geometry = Geometry(
+        large_block_size=args.ec_large_block,
+        small_block_size=args.ec_small_block)
+    store = Store(args.dir.split(","),
+                  max_volume_counts=[args.max] * len(args.dir.split(",")),
+                  coder_name=args.coder, geometry=geometry)
+    _run_forever(run_volume_server(
+        args.ip, args.port, store, args.mserver,
+        data_center=args.data_center, rack=args.rack,
+        pulse_seconds=args.pulse))
+
+
+def cmd_server(args) -> None:
+    """master + volume in one process (weed/command/server.go)."""
+    from .ec.geometry import Geometry
+    from .server.master import run_master
+    from .server.volume_server import run_volume_server
+    from .storage.store import Store
+
+    async def boot():
+        await run_master(args.ip, args.master_port,
+                         default_replication=args.default_replication)
+        geometry = Geometry(large_block_size=args.ec_large_block,
+                            small_block_size=args.ec_small_block)
+        store = Store(args.dir.split(","), coder_name=args.coder,
+                      geometry=geometry)
+        await run_volume_server(args.ip, args.port, store,
+                                f"{args.ip}:{args.master_port}")
+
+    _run_forever(boot())
+
+
+def cmd_upload(args) -> None:
+    from .client import Client
+    c = Client(args.server)
+    out = []
+    for path in args.files:
+        with open(path, "rb") as f:
+            data = f.read()
+        fid = c.upload(data, filename=os.path.basename(path),
+                       collection=args.collection,
+                       replication=args.replication, ttl=args.ttl)
+        out.append({"file": path, "fid": fid, "size": len(data)})
+        print(json.dumps(out[-1]))
+
+
+def cmd_download(args) -> None:
+    from .client import Client
+    c = Client(args.server)
+    data = c.download(args.fid)
+    if args.output == "-":
+        sys.stdout.buffer.write(data)
+    else:
+        with open(args.output, "wb") as f:
+            f.write(data)
+        print(f"{args.fid} -> {args.output} ({len(data)} bytes)")
+
+
+def cmd_delete(args) -> None:
+    from .client import Client
+    c = Client(args.server)
+    for fid in args.fids:
+        c.delete(fid)
+        print(f"deleted {fid}")
+
+
+def cmd_shell(args) -> None:
+    from .client import Client
+    from .ec.geometry import Geometry
+    from .shell.ec_commands import EcCommands
+    c = Client(args.server)
+    geometry = Geometry(large_block_size=args.ec_large_block,
+                        small_block_size=args.ec_small_block)
+    ec = EcCommands(c, geometry)
+    op = args.op
+    if op == "ec.encode":
+        print(json.dumps(ec.encode(args.volume, args.collection,
+                                   apply=not args.dry_run)))
+    elif op == "ec.rebuild":
+        print(json.dumps(ec.rebuild(args.volume, args.collection,
+                                    apply=not args.dry_run)))
+    elif op == "ec.balance":
+        print(json.dumps(ec.balance(args.collection,
+                                    apply=not args.dry_run)))
+    elif op == "ec.decode":
+        print(json.dumps(ec.decode(args.volume, args.collection,
+                                   apply=not args.dry_run)))
+    elif op == "volume.vacuum":
+        for url in c.lookup(args.volume):
+            print(json.dumps(c.volume_admin(url, "vacuum",
+                                            {"volume_id": args.volume})))
+    else:
+        raise SystemExit(f"unknown shell op {op}")
+
+
+def cmd_status(args) -> None:
+    from .client import Client
+    print(json.dumps(Client(args.server).cluster_status(), indent=2))
+
+
+def cmd_benchmark(args) -> None:
+    """Self-validating write/read benchmark (weed/command/benchmark.go)."""
+    import concurrent.futures
+    import hashlib
+    import random
+    import time
+
+    from .client import Client
+    c = Client(args.server)
+    rng = random.Random(42)
+    payloads = {}
+
+    def one_write(i: int) -> float:
+        data = bytes(rng.getrandbits(8) for _ in range(args.size))
+        t0 = time.perf_counter()
+        fid = c.upload(data, filename=f"bench{i}")
+        payloads[fid] = hashlib.sha256(data).hexdigest()
+        return time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(args.concurrency) as pool:
+        lat = list(pool.map(one_write, range(args.n)))
+    wall = time.perf_counter() - t0
+    lat.sort()
+    print(f"writes: {args.n} in {wall:.2f}s -> {args.n/wall:.1f} req/s, "
+          f"p50={lat[len(lat)//2]*1e3:.1f}ms "
+          f"p99={lat[int(len(lat)*0.99)]*1e3:.1f}ms")
+
+    def one_read(fid: str) -> bool:
+        return hashlib.sha256(c.download(fid)).hexdigest() == payloads[fid]
+
+    t0 = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(args.concurrency) as pool:
+        results = list(pool.map(one_read, payloads))
+    wall = time.perf_counter() - t0
+    bad = results.count(False)
+    print(f"reads: {len(results)} in {wall:.2f}s -> "
+          f"{len(results)/wall:.1f} req/s, {bad} corrupt")
+    if bad:
+        raise SystemExit(1)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="seaweedfs-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    m = sub.add_parser("master", help="run a master server")
+    m.add_argument("-ip", default="127.0.0.1")
+    m.add_argument("-port", type=int, default=9333)
+    m.add_argument("-volume_size_limit_mb", type=int, default=30 * 1024)
+    m.add_argument("-default_replication", default="000")
+    m.set_defaults(fn=cmd_master)
+
+    v = sub.add_parser("volume", help="run a volume server")
+    v.add_argument("-ip", default="127.0.0.1")
+    v.add_argument("-port", type=int, default=8080)
+    v.add_argument("-dir", default="./data")
+    v.add_argument("-max", type=int, default=8)
+    v.add_argument("-mserver", default="127.0.0.1:9333")
+    v.add_argument("-dataCenter", dest="data_center", default="")
+    v.add_argument("-rack", default="")
+    v.add_argument("-pulse", type=float, default=5.0)
+    v.add_argument("-coder", default="auto")
+    v.add_argument("-ec_large_block", type=int, default=1024 * 1024 * 1024)
+    v.add_argument("-ec_small_block", type=int, default=1024 * 1024)
+    v.set_defaults(fn=cmd_volume)
+
+    s = sub.add_parser("server", help="master + volume in one process")
+    s.add_argument("-ip", default="127.0.0.1")
+    s.add_argument("-master_port", type=int, default=9333)
+    s.add_argument("-port", type=int, default=8080)
+    s.add_argument("-dir", default="./data")
+    s.add_argument("-default_replication", default="000")
+    s.add_argument("-coder", default="auto")
+    s.add_argument("-ec_large_block", type=int, default=1024 * 1024 * 1024)
+    s.add_argument("-ec_small_block", type=int, default=1024 * 1024)
+    s.set_defaults(fn=cmd_server)
+
+    u = sub.add_parser("upload", help="upload files")
+    u.add_argument("-server", default="127.0.0.1:9333")
+    u.add_argument("-collection", default="")
+    u.add_argument("-replication", default="")
+    u.add_argument("-ttl", default="")
+    u.add_argument("files", nargs="+")
+    u.set_defaults(fn=cmd_upload)
+
+    d = sub.add_parser("download", help="download a file by fid")
+    d.add_argument("-server", default="127.0.0.1:9333")
+    d.add_argument("-output", default="-")
+    d.add_argument("fid")
+    d.set_defaults(fn=cmd_download)
+
+    rm = sub.add_parser("delete", help="delete fids")
+    rm.add_argument("-server", default="127.0.0.1:9333")
+    rm.add_argument("fids", nargs="+")
+    rm.set_defaults(fn=cmd_delete)
+
+    sh = sub.add_parser("shell", help="admin ops")
+    sh.add_argument("-server", default="127.0.0.1:9333")
+    sh.add_argument("op", choices=["ec.encode", "ec.rebuild", "ec.balance",
+                                   "ec.decode", "volume.vacuum"])
+    sh.add_argument("-volume", type=int, default=0)
+    sh.add_argument("-collection", default="")
+    sh.add_argument("-dry_run", action="store_true")
+    sh.add_argument("-ec_large_block", type=int, default=1024 * 1024 * 1024)
+    sh.add_argument("-ec_small_block", type=int, default=1024 * 1024)
+    sh.set_defaults(fn=cmd_shell)
+
+    st = sub.add_parser("status", help="cluster status")
+    st.add_argument("-server", default="127.0.0.1:9333")
+    st.set_defaults(fn=cmd_status)
+
+    b = sub.add_parser("benchmark", help="write/read benchmark")
+    b.add_argument("-server", default="127.0.0.1:9333")
+    b.add_argument("-n", type=int, default=1000)
+    b.add_argument("-size", type=int, default=1024)
+    b.add_argument("-concurrency", type=int, default=16)
+    b.set_defaults(fn=cmd_benchmark)
+
+    return p
+
+
+def main(argv=None) -> None:
+    logging.basicConfig(
+        level=os.environ.get("WEED_TPU_LOGLEVEL", "INFO"),
+        format="%(asctime)s %(levelname).1s %(name)s %(message)s")
+    args = build_parser().parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,167 @@
+"""Synchronous client: assign/lookup/upload/download/delete.
+
+Counterpart of the reference client ops (weed/operation/: Assign, Lookup,
+Upload, DeleteFiles; weed/wdclient/ vid cache). Synchronous on purpose —
+used by the CLI, the shell commands, and tests; servers talk aiohttp.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+import uuid
+from typing import Optional
+
+
+class ClientError(RuntimeError):
+    pass
+
+
+def _get_json(url: str, timeout: float = 30.0) -> dict:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.load(r)
+    except urllib.error.HTTPError as e:
+        try:
+            return json.load(e)
+        except Exception:
+            raise ClientError(f"GET {url}: HTTP {e.code}") from e
+
+
+def _post_json(url: str, body: dict, timeout: float = 300.0) -> dict:
+    data = json.dumps(body).encode()
+    req = urllib.request.Request(url, data=data, method="POST",
+                                 headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.load(r)
+    except urllib.error.HTTPError as e:
+        try:
+            detail = json.load(e)
+        except Exception:
+            detail = {"error": f"HTTP {e.code}"}
+        raise ClientError(f"POST {url}: {detail.get('error')}") from e
+
+
+class Client:
+    def __init__(self, master_url: str):
+        self.master = master_url.rstrip("/")
+        self._vid_cache: dict[int, tuple[list[str], float]] = {}
+        self._vid_cache_ttl = 60.0
+
+    # --- master ops ---
+    def assign(self, count: int = 1, collection: str = "",
+               replication: str = "", ttl: str = "") -> dict:
+        params = {"count": str(count)}
+        if collection:
+            params["collection"] = collection
+        if replication:
+            params["replication"] = replication
+        if ttl:
+            params["ttl"] = ttl
+        out = _get_json(f"http://{self.master}/dir/assign?"
+                        + urllib.parse.urlencode(params))
+        if "error" in out:
+            raise ClientError(out["error"])
+        return out
+
+    def lookup(self, vid: int) -> list[str]:
+        cached = self._vid_cache.get(vid)
+        if cached and time.time() - cached[1] < self._vid_cache_ttl:
+            return cached[0]
+        out = _get_json(f"http://{self.master}/dir/lookup?volumeId={vid}")
+        urls = [loc["url"] for loc in out.get("locations", [])]
+        if not urls:
+            raise ClientError(out.get("error", f"volume {vid} not found"))
+        self._vid_cache[vid] = (urls, time.time())
+        return urls
+
+    def grow(self, count: int = 1, collection: str = "",
+             replication: str = "", ttl: str = "") -> dict:
+        params = {"count": str(count), "collection": collection,
+                  "replication": replication, "ttl": ttl}
+        return _get_json(f"http://{self.master}/vol/grow?"
+                         + urllib.parse.urlencode(params))
+
+    def cluster_status(self) -> dict:
+        return _get_json(f"http://{self.master}/cluster/status")
+
+    # --- blob ops ---
+    def upload_blob(self, url: str, fid: str, data: bytes,
+                    filename: str = "", mime: str = "",
+                    ttl: str = "") -> dict:
+        boundary = uuid.uuid4().hex
+        name = filename or "file"
+        ctype = mime or "application/octet-stream"
+        body = (
+            f"--{boundary}\r\n"
+            f'Content-Disposition: form-data; name="file"; '
+            f'filename="{name}"\r\n'
+            f"Content-Type: {ctype}\r\n\r\n").encode() + data + \
+            f"\r\n--{boundary}--\r\n".encode()
+        target = f"http://{url}/{fid}"
+        if ttl:
+            target += f"?ttl={ttl}"
+        req = urllib.request.Request(
+            target, data=body, method="POST",
+            headers={"Content-Type":
+                     f"multipart/form-data; boundary={boundary}"})
+        try:
+            with urllib.request.urlopen(req, timeout=300) as r:
+                return json.load(r)
+        except urllib.error.HTTPError as e:
+            raise ClientError(f"upload {fid}: HTTP {e.code} "
+                              f"{e.read()[:200]!r}") from e
+
+    def upload(self, data: bytes, filename: str = "", mime: str = "",
+               collection: str = "", replication: str = "",
+               ttl: str = "") -> str:
+        """Assign + upload; returns the fid."""
+        a = self.assign(collection=collection, replication=replication,
+                        ttl=ttl)
+        self.upload_blob(a["url"], a["fid"], data, filename, mime, ttl)
+        return a["fid"]
+
+    def download(self, fid: str) -> bytes:
+        vid = int(fid.split(",")[0])
+        last_err: Optional[Exception] = None
+        for url in self.lookup(vid):
+            try:
+                with urllib.request.urlopen(f"http://{url}/{fid}",
+                                            timeout=300) as r:
+                    return r.read()
+            except urllib.error.HTTPError as e:
+                last_err = e
+                if e.code == 404:
+                    continue
+            except Exception as e:  # connection refused etc: try replica
+                last_err = e
+                self._vid_cache.pop(vid, None)
+        raise ClientError(f"download {fid} failed: {last_err}")
+
+    def delete(self, fid: str) -> None:
+        vid = int(fid.split(",")[0])
+        for url in self.lookup(vid):
+            req = urllib.request.Request(f"http://{url}/{fid}",
+                                         method="DELETE")
+            try:
+                with urllib.request.urlopen(req, timeout=60):
+                    return
+            except urllib.error.HTTPError as e:
+                if e.code == 404:
+                    continue
+                raise ClientError(f"delete {fid}: HTTP {e.code}") from e
+        raise ClientError(f"delete {fid}: no replica accepted")
+
+    # --- volume-server admin (used by shell commands) ---
+    def volume_admin(self, server: str, op: str, body: dict) -> dict:
+        return _post_json(f"http://{server}/admin/{op}", body)
+
+    def ec_lookup(self, vid: int) -> dict:
+        return _get_json(f"http://{self.master}/col/lookup/ec?volumeId={vid}")
+
+    def dir_status(self) -> dict:
+        return _get_json(f"http://{self.master}/dir/status")

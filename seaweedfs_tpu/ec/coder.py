@@ -142,6 +142,26 @@ class PallasCoder(ErasureCoder):
         return fn
 
 
+class CppCoder(ErasureCoder):
+    """Native C++ table kernel (native/rs_core.cpp) — the CPU production
+    path, equivalent in role to the reference's klauspost/reedsolomon."""
+
+    def __init__(self, data_shards: int, parity_shards: int):
+        super().__init__(data_shards, parity_shards)
+        from ..ops import native
+        if not native.available():
+            raise RuntimeError("native core unavailable")
+        self._native = native
+        self._pm = gf256.parity_matrix(data_shards, parity_shards)
+
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        return self._native.gf_matrix_apply(self._pm, data)
+
+    def _rec_apply(self, present, missing):
+        rec = gf256.reconstruction_matrix(self.k, self.m, present, missing)
+        return lambda survivors: self._native.gf_matrix_apply(rec, survivors)
+
+
 _REGISTRY = {}
 
 
@@ -153,6 +173,7 @@ register_coder("numpy", NumpyCoder)
 register_coder("jax", JaxCoder)
 register_coder("jax_lut", lambda k, m: JaxCoder(k, m, method="lut"))
 register_coder("pallas", PallasCoder)
+register_coder("cpp", CppCoder)
 
 
 def get_coder(name: str, data_shards: int, parity_shards: int) -> ErasureCoder:
@@ -162,7 +183,7 @@ def get_coder(name: str, data_shards: int, parity_shards: int) -> ErasureCoder:
         # than the XLA bitplane path
         order = (("pallas", "jax", "numpy")
                  if jax.default_backend() == "tpu"
-                 else ("jax", "numpy"))
+                 else ("cpp", "jax", "numpy"))
         for candidate in order:
             if candidate in _REGISTRY:
                 try:

@@ -1,0 +1,103 @@
+"""ctypes binding to the native C++ core (native/rs_core.cpp).
+
+Builds the shared library on first use (g++ via native/Makefile) and exposes
+the CPU-side GF(2^8) matrix kernel and CRC32C. This is the build's
+counterpart of the reference's native dependencies (klauspost/reedsolomon,
+klauspost/crc32 — seaweedfs go.mod:44-45).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libseaweedtpu.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_load_error: Optional[Exception] = None
+_lock = threading.Lock()
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def _load() -> ctypes.CDLL:
+    global _lib, _load_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_error is not None:
+            # failed once (missing toolchain etc.) — don't re-spawn make on
+            # every coder resolution
+            raise NativeUnavailable(str(_load_error)) from _load_error
+        if not os.path.exists(_SO_PATH) or (
+                os.path.getmtime(_SO_PATH)
+                < os.path.getmtime(os.path.join(_NATIVE_DIR, "rs_core.cpp"))):
+            try:
+                subprocess.run(["make", "-C", _NATIVE_DIR],
+                               check=True, capture_output=True, text=True)
+            except (subprocess.CalledProcessError, FileNotFoundError) as e:
+                detail = getattr(e, "stderr", str(e))
+                _load_error = NativeUnavailable(
+                    f"cannot build native core: {detail}")
+                raise _load_error from e
+        lib = ctypes.CDLL(_SO_PATH)
+        lib.gf_matrix_apply.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
+            ctypes.c_size_t,
+        ]
+        lib.gf_matrix_apply.restype = None
+        lib.crc32c_update.argtypes = [ctypes.c_uint32,
+                                      ctypes.POINTER(ctypes.c_uint8),
+                                      ctypes.c_size_t]
+        lib.crc32c_update.restype = ctypes.c_uint32
+        lib.crc32c_needle_value.argtypes = [ctypes.c_uint32]
+        lib.crc32c_needle_value.restype = ctypes.c_uint32
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    try:
+        _load()
+        return True
+    except NativeUnavailable:
+        return False
+
+
+def gf_matrix_apply(matrix: np.ndarray, inputs: np.ndarray) -> np.ndarray:
+    """matrix [R, C] uint8, inputs [C, n] uint8 -> [R, n] uint8."""
+    lib = _load()
+    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+    inputs = np.ascontiguousarray(inputs, dtype=np.uint8)
+    rows, cols = matrix.shape
+    assert inputs.shape[0] == cols, (matrix.shape, inputs.shape)
+    n = inputs.shape[1]
+    out = np.empty((rows, n), dtype=np.uint8)
+    in_ptrs = (ctypes.c_void_p * cols)(
+        *[inputs[c].ctypes.data for c in range(cols)])
+    out_ptrs = (ctypes.c_void_p * rows)(
+        *[out[r].ctypes.data for r in range(rows)])
+    lib.gf_matrix_apply(
+        matrix.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        rows, cols, in_ptrs, out_ptrs, n)
+    return out
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    lib = _load()
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+    return lib.crc32c_update(crc, buf, len(data))
+
+
+def crc32c_needle_value(crc: int) -> int:
+    return _load().crc32c_needle_value(crc)

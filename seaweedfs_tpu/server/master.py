@@ -1,0 +1,250 @@
+"""Master server: assignment, lookup, topology, growth, EC registry.
+
+HTTP/JSON surface mirroring the reference master's HTTP API
+(weed/server/master_server.go:113-129, master_server_handlers.go) plus JSON
+versions of the gRPC admin RPCs (weed/pb/master.proto:10-34):
+
+  GET  /dir/assign?count&collection&replication&ttl&dataCenter
+  GET  /dir/lookup?volumeId=&collection=
+  GET  /dir/status
+  GET  /vol/grow?count&collection&replication&ttl
+  GET  /col/lookup/ec?volumeId=
+  POST /heartbeat          (volume servers report in, JSON Store payload)
+  GET  /cluster/status
+  GET  /stats/counters     (Prometheus-style text at /metrics)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+from ..storage.file_id import FileId, new_cookie
+from ..topology.sequence import MemorySequencer
+from ..topology.topology import Topology
+from ..utils import metrics as metrics_mod
+
+log = logging.getLogger("master")
+
+
+async def _healthz(request: "web.Request") -> "web.Response":
+    return web.json_response({"ok": True})
+
+
+class MasterServer:
+    def __init__(self, volume_size_limit_mb: int = 30 * 1024,
+                 default_replication: str = "000",
+                 pulse_seconds: float = 5.0,
+                 garbage_threshold: float = 0.3):
+        self.topology = Topology(
+            volume_size_limit=volume_size_limit_mb * 1024 * 1024,
+            pulse_seconds=pulse_seconds)
+        self.sequencer = MemorySequencer()
+        self.default_replication = default_replication
+        self.garbage_threshold = garbage_threshold
+        self._grow_lock = asyncio.Lock()
+        self.metrics = metrics_mod.Registry("master")
+        self.app = self._build_app()
+
+    def _build_app(self) -> web.Application:
+        app = web.Application(client_max_size=64 * 1024 * 1024)
+        app.router.add_get("/dir/assign", self.dir_assign)
+        app.router.add_get("/dir/lookup", self.dir_lookup)
+        app.router.add_get("/dir/status", self.dir_status)
+        app.router.add_get("/vol/grow", self.vol_grow)
+        app.router.add_get("/col/lookup/ec", self.ec_lookup)
+        app.router.add_post("/heartbeat", self.heartbeat)
+        app.router.add_get("/cluster/status", self.cluster_status)
+        app.router.add_get("/metrics", self.metrics_handler)
+        app.router.add_get("/healthz", _healthz)
+        return app
+
+    # --- handlers ---
+    async def dir_assign(self, request: web.Request) -> web.Response:
+        """Assign a write target (dirAssignHandler,
+        weed/server/master_server_handlers.go:96-150)."""
+        self.metrics.count("assign")
+        q = request.query
+        count = int(q.get("count", 1))
+        collection = q.get("collection", "")
+        replication = q.get("replication", self.default_replication)
+        ttl = q.get("ttl", "")
+        data_center = q.get("dataCenter", "")
+
+        picked = self.topology.pick_for_write(collection, replication, ttl)
+        if picked is None:
+            async with self._grow_lock:
+                picked = self.topology.pick_for_write(collection, replication,
+                                                      ttl)
+                if picked is None:
+                    grown = await self._grow(1, collection, replication, ttl,
+                                             data_center)
+                    if not grown:
+                        return web.json_response(
+                            {"error": "no writable volumes and cannot grow"},
+                            status=500)
+                    picked = self.topology.pick_for_write(
+                        collection, replication, ttl)
+        if picked is None:
+            return web.json_response({"error": "no writable volumes"},
+                                     status=500)
+        vid, nodes = picked
+        key = self.sequencer.next_file_id(count)
+        fid = FileId(vid, key, new_cookie())
+        node = nodes[0]
+        return web.json_response({
+            "fid": str(fid),
+            "url": node.url,
+            "publicUrl": node.public_url,
+            "count": count,
+            "replicas": [n.url for n in nodes[1:]],
+        })
+
+    async def dir_lookup(self, request: web.Request) -> web.Response:
+        q = request.query
+        vid_str = q.get("volumeId", q.get("fileId", ""))
+        if "," in vid_str:
+            vid = FileId.parse(vid_str).volume_id
+        else:
+            try:
+                vid = int(vid_str)
+            except ValueError:
+                return web.json_response({"error": "invalid volumeId"},
+                                         status=400)
+        nodes = self.topology.lookup(vid, q.get("collection", ""))
+        if not nodes:
+            # EC volumes are located via the shard registry
+            shards = self.topology.lookup_ec_shards(vid)
+            if shards:
+                urls = []
+                for nlist in shards.values():
+                    for n in nlist:
+                        if n.url not in urls:
+                            urls.append(n.url)
+                return web.json_response({
+                    "volumeId": str(vid),
+                    "locations": [{"url": u, "publicUrl": u} for u in urls],
+                    "ec": True,
+                })
+            return web.json_response(
+                {"volumeId": str(vid), "error": "volume not found"},
+                status=404)
+        return web.json_response({
+            "volumeId": str(vid),
+            "locations": [{"url": n.url, "publicUrl": n.public_url}
+                          for n in nodes],
+        })
+
+    async def dir_status(self, request: web.Request) -> web.Response:
+        return web.json_response(self.topology.to_dict())
+
+    async def vol_grow(self, request: web.Request) -> web.Response:
+        q = request.query
+        count = int(q.get("count", 1))
+        async with self._grow_lock:
+            grown = await self._grow(
+                count, q.get("collection", ""),
+                q.get("replication", self.default_replication),
+                q.get("ttl", ""), q.get("dataCenter", ""))
+        if not grown:
+            return web.json_response({"error": "growth failed"}, status=500)
+        return web.json_response({"count": len(grown),
+                                  "volume_ids": grown})
+
+    async def _grow(self, count: int, collection: str, replication: str,
+                    ttl: str, data_center: str = "") -> list[int]:
+        """AutomaticGrowByType (weed/topology/volume_growth.go:70-208):
+        pick placement-satisfying nodes, allocate on each."""
+        import aiohttp
+        grown: list[int] = []
+        for _ in range(count):
+            nodes = self.topology.find_empty_slots(replication, data_center)
+            if not nodes:
+                break
+            vid = self.topology.next_volume_id()
+            ok = True
+            async with aiohttp.ClientSession() as session:
+                for node in nodes:
+                    try:
+                        async with session.post(
+                                f"http://{node.url}/admin/assign_volume",
+                                json={"volume_id": vid,
+                                      "collection": collection,
+                                      "replication": replication,
+                                      "ttl": ttl},
+                                timeout=aiohttp.ClientTimeout(total=10)) as r:
+                            if r.status != 200:
+                                ok = False
+                                break
+                    except Exception as e:
+                        log.warning("allocate %d on %s failed: %s", vid,
+                                    node.url, e)
+                        ok = False
+                        break
+            if ok:
+                grown.append(vid)
+                self.metrics.count("volumes_grown")
+        return grown
+
+    async def ec_lookup(self, request: web.Request) -> web.Response:
+        """LookupEcVolume (weed/server/master_grpc_server_volume.go:148)."""
+        try:
+            vid = int(request.query.get("volumeId", ""))
+        except ValueError:
+            return web.json_response({"error": "invalid volumeId"},
+                                     status=400)
+        shards = self.topology.lookup_ec_shards(vid)
+        if not shards:
+            return web.json_response({"error": "ec volume not found"},
+                                     status=404)
+        return web.json_response({
+            "volumeId": vid,
+            "shards": {str(sid): [n.url for n in nodes]
+                       for sid, nodes in shards.items()},
+        })
+
+    async def heartbeat(self, request: web.Request) -> web.Response:
+        """Heartbeat intake (weed/server/master_grpc_server.go:20-176).
+        Body: {node_id, url, public_url, data_center, rack,
+               max_volume_count, max_file_key, volumes: [...],
+               ec_shards: [...]}."""
+        self.metrics.count("heartbeat")
+        body = await request.json()
+        self.topology.register_heartbeat(
+            node_id=body["node_id"],
+            url=body["url"],
+            public_url=body.get("public_url", body["url"]),
+            data_center=body.get("data_center", ""),
+            rack=body.get("rack", ""),
+            max_volume_count=body.get("max_volume_count", 8),
+            payload=body,
+        )
+        self.sequencer.set_max(body.get("max_file_key", 0))
+        self.topology.prune_dead_nodes()
+        return web.json_response({
+            "volume_size_limit": self.topology.volume_size_limit,
+        })
+
+    async def cluster_status(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "is_leader": True,
+            "leader": f"{request.host}",
+            "topology": self.topology.to_dict(),
+        })
+
+    async def metrics_handler(self, request: web.Request) -> web.Response:
+        return web.Response(text=self.metrics.render(),
+                            content_type="text/plain")
+
+
+async def run_master(host: str, port: int, **kwargs) -> web.AppRunner:
+    server = MasterServer(**kwargs)
+    runner = web.AppRunner(server.app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    log.info("master listening on %s:%d", host, port)
+    return runner

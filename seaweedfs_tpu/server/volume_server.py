@@ -1,0 +1,619 @@
+"""Volume server: HTTP data path + admin API + master heartbeat loop.
+
+Mirrors the reference volume server surface
+(weed/server/volume_server_handlers_read.go / _write.go for the data path;
+weed/server/volume_grpc_*.go for admin — here as JSON-over-HTTP):
+
+  data:   GET/HEAD/POST/DELETE /<vid>,<fid>
+  admin:  POST /admin/assign_volume       (AllocateVolume)
+          POST /admin/vacuum              (VacuumVolume*)
+          POST /admin/volume/delete
+          POST /admin/volume/readonly
+          POST /admin/ec/generate         (VolumeEcShardsGenerate)
+          POST /admin/ec/mount            (VolumeEcShardsMount)
+          POST /admin/ec/unmount          (VolumeEcShardsUnmount)
+          POST /admin/ec/rebuild          (VolumeEcShardsRebuild)
+          POST /admin/ec/copy             (VolumeEcShardsCopy — pull model)
+          POST /admin/ec/delete_shards    (VolumeEcShardsDelete)
+          POST /admin/ec/blob_delete      (VolumeEcBlobDelete)
+          POST /admin/ec/to_volume        (VolumeEcShardsToVolume)
+          GET  /admin/ec/shard_read?volume=&shard=&offset=&size=
+          GET  /status, /metrics, /healthz
+
+Replicated writes fan out with type=replicate exactly like the reference
+(weed/topology/store_replicate.go:21-161): the first server writes locally
+then POSTs the same body to every replica; all must ack.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import socket
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from ..storage.file_id import FileId
+from ..storage.needle import (FLAG_HAS_LAST_MODIFIED, FLAG_HAS_MIME,
+                              FLAG_HAS_NAME, FLAG_HAS_TTL, Needle)
+from ..storage import types as t
+from ..storage.store import Store
+from ..storage.volume import (NeedleDeleted, NeedleNotFound, VolumeReadOnly)
+from ..utils import metrics as metrics_mod
+
+log = logging.getLogger("volume")
+
+
+async def _healthz(request: "web.Request") -> "web.Response":
+    return web.json_response({"ok": True})
+
+
+class VolumeServer:
+    def __init__(self, store: Store, master_url: str, url: str,
+                 public_url: str = "", data_center: str = "", rack: str = "",
+                 pulse_seconds: float = 5.0, read_redirect: bool = False):
+        self.store = store
+        self.master_url = master_url
+        self.url = url
+        self.public_url = public_url or url
+        self.data_center = data_center
+        self.rack = rack
+        self.pulse_seconds = pulse_seconds
+        self.read_redirect = read_redirect
+        self.volume_size_limit = 30 * 1024 * 1024 * 1024
+        self.metrics = metrics_mod.Registry("volume")
+        self._hb_task: Optional[asyncio.Task] = None
+        self._session: Optional[aiohttp.ClientSession] = None
+        self.app = self._build_app()
+        # the EC read path fetches missing shards from peers through this
+        store._remote_shard_reader = self._make_shard_reader
+
+    def _build_app(self) -> web.Application:
+        app = web.Application(client_max_size=256 * 1024 * 1024)
+        app.router.add_post("/admin/assign_volume", self.admin_assign_volume)
+        app.router.add_post("/admin/vacuum", self.admin_vacuum)
+        app.router.add_post("/admin/volume/delete", self.admin_volume_delete)
+        app.router.add_post("/admin/volume/readonly", self.admin_readonly)
+        app.router.add_post("/admin/ec/generate", self.admin_ec_generate)
+        app.router.add_post("/admin/ec/mount", self.admin_ec_mount)
+        app.router.add_post("/admin/ec/unmount", self.admin_ec_unmount)
+        app.router.add_post("/admin/ec/rebuild", self.admin_ec_rebuild)
+        app.router.add_post("/admin/ec/copy", self.admin_ec_copy)
+        app.router.add_post("/admin/ec/delete_shards",
+                            self.admin_ec_delete_shards)
+        app.router.add_post("/admin/ec/blob_delete", self.admin_ec_blob_delete)
+        app.router.add_post("/admin/ec/to_volume", self.admin_ec_to_volume)
+        app.router.add_get("/admin/ec/shard_read", self.admin_ec_shard_read)
+        app.router.add_get("/admin/file_copy", self.admin_file_copy)
+        app.router.add_get("/status", self.status)
+        app.router.add_get("/metrics", self.metrics_handler)
+        app.router.add_get("/healthz", _healthz)
+        app.router.add_route("*", "/{fid:[^{}]*}", self.data_handler)
+        app.on_startup.append(self._on_startup)
+        app.on_cleanup.append(self._on_cleanup)
+        return app
+
+    async def _on_startup(self, app) -> None:
+        self._session = aiohttp.ClientSession()
+        self._hb_task = asyncio.create_task(self._heartbeat_loop())
+
+    async def _on_cleanup(self, app) -> None:
+        if self._hb_task:
+            self._hb_task.cancel()
+        if self._session:
+            await self._session.close()
+        self.store.close()
+
+    # --- heartbeat (weed/server/volume_grpc_client_to_master.go:50-222) ---
+    async def _heartbeat_loop(self) -> None:
+        while True:
+            try:
+                await self.send_heartbeat()
+            except Exception as e:
+                log.warning("heartbeat to %s failed: %s", self.master_url, e)
+            await asyncio.sleep(self.pulse_seconds)
+
+    async def send_heartbeat(self) -> None:
+        payload = self.store.heartbeat()
+        payload.update({
+            "node_id": self.url,
+            "url": self.url,
+            "public_url": self.public_url,
+            "data_center": self.data_center,
+            "rack": self.rack,
+        })
+        async with self._session.post(
+                f"http://{self.master_url}/heartbeat", json=payload,
+                timeout=aiohttp.ClientTimeout(total=10)) as r:
+            body = await r.json()
+            self.volume_size_limit = body.get("volume_size_limit",
+                                              self.volume_size_limit)
+
+    # --- data path ---
+    async def data_handler(self, request: web.Request) -> web.Response:
+        fid_str = request.match_info["fid"].lstrip("/")
+        if not fid_str or "," not in fid_str:
+            return web.json_response({"error": "missing file id"}, status=400)
+        try:
+            fid = FileId.parse(fid_str)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        if request.method in ("GET", "HEAD"):
+            return await self._read(request, fid)
+        if request.method in ("POST", "PUT"):
+            return await self._write(request, fid)
+        if request.method == "DELETE":
+            return await self._delete(request, fid)
+        return web.json_response({"error": "method not allowed"}, status=405)
+
+    async def _read(self, request: web.Request, fid: FileId) -> web.Response:
+        """GetOrHeadHandler (volume_server_handlers_read.go:28-272)."""
+        self.metrics.count("read")
+        with self.metrics.timed("read"):
+            try:
+                n = await asyncio.get_event_loop().run_in_executor(
+                    None, lambda: self.store.read_needle(
+                        fid.volume_id, fid.key, fid.cookie))
+            except (NeedleNotFound, KeyError):
+                if (self.read_redirect
+                        and self.store.find_volume(fid.volume_id) is None
+                        and self.store.find_ec_volume(fid.volume_id) is None):
+                    url = await self._lookup_replica(fid.volume_id)
+                    if url:
+                        raise web.HTTPMovedPermanently(
+                            f"http://{url}/{fid}")
+                return web.json_response({"error": "not found"}, status=404)
+            except NeedleDeleted:
+                return web.json_response({"error": "deleted"}, status=404)
+        etag = f'"{n.etag()}"'
+        if request.headers.get("If-None-Match") == etag:
+            return web.Response(status=304)
+        headers = {"ETag": etag, "Accept-Ranges": "bytes"}
+        if n.has(FLAG_HAS_LAST_MODIFIED):
+            headers["X-Last-Modified"] = str(n.last_modified)
+        mime = (n.mime.decode("utf-8", "replace")
+                if n.has(FLAG_HAS_MIME) else "application/octet-stream")
+        if n.has(FLAG_HAS_NAME) and n.name:
+            headers["Content-Disposition"] = (
+                f'inline; filename="{n.name.decode("utf-8", "replace")}"')
+        body = n.data
+        if n.is_compressed:
+            headers["Content-Encoding"] = "gzip"
+        # range support
+        rng = request.headers.get("Range")
+        if rng and rng.startswith("bytes=") and not n.is_compressed:
+            try:
+                start_s, _, end_s = rng[6:].partition("-")
+                if not start_s:
+                    # suffix range: last N bytes (RFC 7233)
+                    suffix = int(end_s)
+                    if suffix <= 0:
+                        raise ValueError
+                    start = max(0, len(body) - suffix)
+                    end = len(body) - 1
+                else:
+                    start = int(start_s)
+                    end = int(end_s) if end_s else len(body) - 1
+                end = min(end, len(body) - 1)
+                if start > end:
+                    raise ValueError
+                headers["Content-Range"] = (
+                    f"bytes {start}-{end}/{len(body)}")
+                body = body[start:end + 1]
+                status = 206
+            except ValueError:
+                return web.Response(status=416)
+        else:
+            status = 200
+        if request.method == "HEAD":
+            headers["Content-Length"] = str(len(body))
+            return web.Response(status=status, headers=headers,
+                                content_type=mime)
+        return web.Response(status=status, body=body, headers=headers,
+                            content_type=mime)
+
+    async def _lookup_replica(self, vid: int) -> Optional[str]:
+        try:
+            async with self._session.get(
+                    f"http://{self.master_url}/dir/lookup",
+                    params={"volumeId": str(vid)}) as r:
+                if r.status != 200:
+                    return None
+                body = await r.json()
+                locs = body.get("locations", [])
+                return locs[0]["url"] if locs else None
+        except Exception:
+            return None
+
+    async def _write(self, request: web.Request, fid: FileId) -> web.Response:
+        """PostHandler + ReplicatedWrite (volume_server_handlers_write.go:19,
+        weed/topology/store_replicate.go:21-161)."""
+        self.metrics.count("write")
+        n = Needle(cookie=fid.cookie, id=fid.key)
+        reader = await request.multipart() if request.content_type.startswith(
+            "multipart/") else None
+        if reader is not None:
+            part = await reader.next()
+            if part is None:
+                return web.json_response({"error": "empty multipart body"},
+                                         status=400)
+            n.data = bytes(await part.read(decode=False))
+            filename = part.filename or ""
+            if filename:
+                n.set_flag(FLAG_HAS_NAME)
+                n.name = filename.encode()[:255]
+            ctype = part.headers.get("Content-Type", "")
+            if ctype and ctype != "application/octet-stream":
+                n.set_flag(FLAG_HAS_MIME)
+                n.mime = ctype.encode()[:255]
+        else:
+            n.data = await request.read()
+        if len(n.data) > 32 * 1024 * 1024:
+            return web.json_response({"error": "entry too large"}, status=413)
+        ttl_s = request.query.get("ttl", "")
+        if ttl_s:
+            n.set_flag(FLAG_HAS_TTL)
+            n.ttl = t.TTL.parse(ttl_s)
+        import time as _time
+        n.set_flag(FLAG_HAS_LAST_MODIFIED)
+        n.last_modified = int(_time.time())
+
+        with self.metrics.timed("write"):
+            try:
+                _, size, unchanged = await asyncio.get_event_loop() \
+                    .run_in_executor(None, lambda: self.store.write_needle(
+                        fid.volume_id, n))
+            except KeyError:
+                return web.json_response({"error": "volume not found"},
+                                         status=404)
+            except VolumeReadOnly as e:
+                return web.json_response({"error": str(e)}, status=409)
+            except ValueError as e:
+                return web.json_response({"error": str(e)}, status=409)
+
+        if request.query.get("type") != "replicate":
+            ok = await self._replicate(request, fid, n)
+            if not ok:
+                return web.json_response(
+                    {"error": "replication failed"}, status=500)
+        return web.json_response({"name": (n.name or b"").decode("utf-8",
+                                                                 "replace"),
+                                  "size": len(n.data),
+                                  "eTag": n.etag(),
+                                  "unchanged": unchanged}, status=201)
+
+    async def _replicate(self, request: web.Request, fid: FileId,
+                         n: Needle) -> bool:
+        replicas = await self._replica_urls(fid.volume_id)
+        if not replicas:
+            return True
+
+        def body_for_replica() -> aiohttp.FormData:
+            # re-wrap as multipart so name/mime survive on the replica and
+            # its needle bytes match the primary's
+            form = aiohttp.FormData()
+            form.add_field(
+                "file", n.data,
+                filename=(n.name.decode("utf-8", "replace")
+                          if n.has(FLAG_HAS_NAME) else "file"),
+                content_type=(n.mime.decode("utf-8", "replace")
+                              if n.has(FLAG_HAS_MIME)
+                              else "application/octet-stream"))
+            return form
+
+        results = await asyncio.gather(
+            *[self._session.post(
+                f"http://{url}/{fid}",
+                params={"type": "replicate", **{
+                    k: v for k, v in request.query.items()
+                    if k in ("ttl",)}},
+                data=body_for_replica())
+              for url in replicas], return_exceptions=True)
+        ok = True
+        for url, res in zip(replicas, results):
+            if isinstance(res, Exception):
+                log.warning("replicate %s to %s failed: %s", fid, url, res)
+                ok = False
+            else:
+                if res.status >= 300:
+                    ok = False
+                res.release()
+        return ok
+
+    async def _replica_urls(self, vid: int) -> list[str]:
+        try:
+            async with self._session.get(
+                    f"http://{self.master_url}/dir/lookup",
+                    params={"volumeId": str(vid)}) as r:
+                if r.status != 200:
+                    return []
+                body = await r.json()
+                return [loc["url"] for loc in body.get("locations", [])
+                        if loc["url"] != self.url]
+        except Exception:
+            return []
+
+    async def _delete(self, request: web.Request, fid: FileId) -> web.Response:
+        self.metrics.count("delete")
+        ev = self.store.find_ec_volume(fid.volume_id)
+        if ev is not None and self.store.find_volume(fid.volume_id) is None:
+            # EC delete: local tombstone + propagate to all shard holders
+            try:
+                self.store.ec_blob_delete(fid.volume_id, fid.key)
+            except KeyError:
+                return web.json_response({"error": "not found"}, status=404)
+            if request.query.get("type") != "replicate":
+                await self._propagate_ec_delete(fid)
+            return web.json_response({"size": 0})
+        n = Needle(cookie=fid.cookie, id=fid.key)
+        try:
+            size = await asyncio.get_event_loop().run_in_executor(
+                None, lambda: self.store.delete_needle(fid.volume_id, n))
+        except KeyError:
+            return web.json_response({"error": "volume not found"},
+                                     status=404)
+        if request.query.get("type") != "replicate":
+            replicas = await self._replica_urls(fid.volume_id)
+            for url in replicas:
+                try:
+                    async with self._session.delete(
+                            f"http://{url}/{fid}",
+                            params={"type": "replicate"}) as r:
+                        pass
+                except Exception as e:
+                    log.warning("delete replicate to %s: %s", url, e)
+        return web.json_response({"size": size})
+
+    async def _propagate_ec_delete(self, fid: FileId) -> None:
+        try:
+            async with self._session.get(
+                    f"http://{self.master_url}/col/lookup/ec",
+                    params={"volumeId": str(fid.volume_id)}) as r:
+                if r.status != 200:
+                    return
+                shards = (await r.json()).get("shards", {})
+        except Exception:
+            return
+        urls = {u for us in shards.values() for u in us if u != self.url}
+        for url in urls:
+            try:
+                async with self._session.delete(
+                        f"http://{url}/{fid}",
+                        params={"type": "replicate"}) as r:
+                    pass
+            except Exception as e:
+                log.warning("ec delete propagate to %s: %s", url, e)
+
+    # --- admin ---
+    async def admin_assign_volume(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        try:
+            self.store.add_volume(
+                int(body["volume_id"]), body.get("collection", ""),
+                body.get("replication", "000"), body.get("ttl", ""))
+        except (ValueError, RuntimeError) as e:
+            return web.json_response({"error": str(e)}, status=409)
+        try:
+            await self.send_heartbeat()
+        except Exception as e:
+            # the allocation itself succeeded; the periodic heartbeat will
+            # report it shortly
+            log.warning("post-allocate heartbeat failed: %s", e)
+        return web.json_response({"ok": True})
+
+    async def admin_vacuum(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        vid = int(body["volume_id"])
+        v = self.store.find_volume(vid)
+        if v is None:
+            return web.json_response({"error": "volume not found"},
+                                     status=404)
+        garbage = v.garbage_level()
+        await asyncio.get_event_loop().run_in_executor(None, v.compact)
+        return web.json_response({"ok": True, "garbage_level": garbage})
+
+    async def admin_volume_delete(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        ok = self.store.delete_volume(int(body["volume_id"]))
+        await self.send_heartbeat()
+        return web.json_response({"ok": ok})
+
+    async def admin_readonly(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        ok = self.store.mark_readonly(int(body["volume_id"]),
+                                      body.get("read_only", True))
+        return web.json_response({"ok": ok})
+
+    async def admin_ec_generate(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        vid = int(body["volume_id"])
+        try:
+            shards = await asyncio.get_event_loop().run_in_executor(
+                None, lambda: self.store.ec_generate(vid))
+        except KeyError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        return web.json_response({"ok": True, "shards": shards})
+
+    async def admin_ec_mount(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        try:
+            mounted = self.store.ec_mount(
+                int(body["volume_id"]), body.get("collection", ""),
+                [int(s) for s in body["shard_ids"]])
+        except (KeyError, FileNotFoundError) as e:
+            return web.json_response({"error": str(e)}, status=404)
+        await self.send_heartbeat()
+        return web.json_response({"ok": True, "mounted": mounted})
+
+    async def admin_ec_unmount(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        removed = self.store.ec_unmount(int(body["volume_id"]),
+                                        [int(s) for s in body["shard_ids"]])
+        await self.send_heartbeat()
+        return web.json_response({"ok": True, "unmounted": removed})
+
+    async def admin_ec_rebuild(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        try:
+            rebuilt = await asyncio.get_event_loop().run_in_executor(
+                None, lambda: self.store.ec_rebuild(
+                    int(body["volume_id"]), body.get("collection", "")))
+        except (KeyError, ValueError) as e:
+            return web.json_response({"error": str(e)}, status=409)
+        return web.json_response({"ok": True, "rebuilt": rebuilt})
+
+    async def admin_ec_copy(self, request: web.Request) -> web.Response:
+        """Pull shard files from a source server (VolumeEcShardsCopy,
+        volume_grpc_erasure_coding.go:104 — pull model like the reference)."""
+        body = await request.json()
+        vid = int(body["volume_id"])
+        collection = body.get("collection", "")
+        shard_ids = [int(s) for s in body["shard_ids"]]
+        source = body["source"]
+        copy_ecx = body.get("copy_ecx_file", False)
+        import os
+        from .. import ec as ec_mod
+        loc = self.store.locations[0]
+        prefix = f"{collection}_" if collection else ""
+        base = os.path.join(loc.directory, f"{prefix}{vid}")
+        try:
+            exts = [ec_mod.to_ext(sid) for sid in shard_ids]
+            if copy_ecx:
+                exts += [".ecx", ".ecj"]
+            for ext in exts:
+                async with self._session.get(
+                        f"http://{source}/admin/file_copy",
+                        params={"volume_id": str(vid),
+                                "collection": collection,
+                                "ext": ext}) as r:
+                    if r.status == 404 and ext == ".ecj":
+                        continue  # delete journal is optional
+                    if r.status != 200:
+                        return web.json_response(
+                            {"error": f"copy {ext} from {source}: "
+                             f"{r.status}"}, status=502)
+                    with open(base + ext, "wb") as f:
+                        async for chunk in r.content.iter_chunked(1 << 20):
+                            f.write(chunk)
+        except aiohttp.ClientError as e:
+            return web.json_response({"error": str(e)}, status=502)
+        return web.json_response({"ok": True})
+
+    async def admin_ec_delete_shards(self, request: web.Request
+                                     ) -> web.Response:
+        body = await request.json()
+        self.store.ec_delete_shards(int(body["volume_id"]),
+                                    body.get("collection", ""),
+                                    [int(s) for s in body["shard_ids"]])
+        await self.send_heartbeat()
+        return web.json_response({"ok": True})
+
+    async def admin_ec_blob_delete(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        try:
+            self.store.ec_blob_delete(int(body["volume_id"]),
+                                      int(body["needle_id"]))
+        except KeyError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        return web.json_response({"ok": True})
+
+    async def admin_ec_to_volume(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        try:
+            await asyncio.get_event_loop().run_in_executor(
+                None, lambda: self.store.ec_to_volume(
+                    int(body["volume_id"]), body.get("collection", "")))
+        except (KeyError, FileNotFoundError) as e:
+            return web.json_response({"error": str(e)}, status=404)
+        await self.send_heartbeat()
+        return web.json_response({"ok": True})
+
+    async def admin_ec_shard_read(self, request: web.Request) -> web.Response:
+        q = request.query
+        try:
+            data = self.store.ec_shard_read(
+                int(q["volume"]), int(q["shard"]),
+                int(q.get("offset", 0)), int(q["size"]))
+        except KeyError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        return web.Response(body=data,
+                            content_type="application/octet-stream")
+
+    def _make_shard_reader(self, ev):
+        """Shard reader hitting peers' /admin/ec/shard_read — used by the EC
+        read path for non-local shards (store_ec.go:282-320). Synchronous
+        (runs in executor threads)."""
+        import urllib.request
+
+        def read(shard_id: int, offset: int, size: int) -> Optional[bytes]:
+            try:
+                import json as _json
+                with urllib.request.urlopen(
+                        f"http://{self.master_url}/col/lookup/ec?volumeId="
+                        f"{ev.vid}", timeout=5) as r:
+                    shards = _json.load(r).get("shards", {})
+                urls = [u for u in shards.get(str(shard_id), [])
+                        if u != self.url]
+                for url in urls:
+                    try:
+                        with urllib.request.urlopen(
+                                f"http://{url}/admin/ec/shard_read?volume="
+                                f"{ev.vid}&shard={shard_id}&offset={offset}"
+                                f"&size={size}", timeout=10) as r:
+                            data = r.read()
+                            if len(data) == size:
+                                return data
+                    except Exception:
+                        continue
+            except Exception:
+                return None
+            return None
+
+        return read
+
+    async def admin_file_copy(self, request: web.Request) -> web.StreamResponse:
+        """Stream a volume/shard file to a pulling peer (CopyFile,
+        weed/server/volume_grpc_copy.go:24-281)."""
+        import os
+        q = request.query
+        vid = int(q["volume_id"])
+        collection = q.get("collection", "")
+        ext = q["ext"]
+        if not ext.startswith(".") or "/" in ext or ".." in ext:
+            return web.json_response({"error": "bad ext"}, status=400)
+        prefix = f"{collection}_" if collection else ""
+        for loc in self.store.locations:
+            path = os.path.join(loc.directory, f"{prefix}{vid}{ext}")
+            if os.path.exists(path):
+                resp = web.StreamResponse()
+                resp.headers["Content-Length"] = str(os.path.getsize(path))
+                await resp.prepare(request)
+                with open(path, "rb") as f:
+                    while True:
+                        chunk = f.read(1 << 20)
+                        if not chunk:
+                            break
+                        await resp.write(chunk)
+                await resp.write_eof()
+                return resp
+        return web.json_response({"error": "file not found"}, status=404)
+
+    async def status(self, request: web.Request) -> web.Response:
+        return web.json_response({"url": self.url, **self.store.status()})
+
+    async def metrics_handler(self, request: web.Request) -> web.Response:
+        return web.Response(text=self.metrics.render(),
+                            content_type="text/plain")
+
+
+async def run_volume_server(host: str, port: int, store: Store,
+                            master_url: str, **kwargs) -> web.AppRunner:
+    server = VolumeServer(store, master_url, url=f"{host}:{port}", **kwargs)
+    runner = web.AppRunner(server.app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    log.info("volume server on %s:%d -> master %s", host, port, master_url)
+    return runner

@@ -1,0 +1,43 @@
+"""File ids: "<volumeId>,<needle-key-hex><cookie-8-hex>".
+
+Same textual format as the reference (weed/storage/needle/file_id.go):
+the last 8 hex chars are the cookie, the rest the needle key; the volume id
+precedes the comma. E.g. "3,01637037d6" -> vid=3, key=0x016370, cookie low.
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class FileId:
+    volume_id: int
+    key: int
+    cookie: int
+
+    @classmethod
+    def parse(cls, fid: str) -> "FileId":
+        fid = fid.strip()
+        if "," not in fid:
+            raise ValueError(f"invalid fid {fid!r}")
+        vid_str, rest = fid.split(",", 1)
+        # drop any extension (e.g. "3,0163.jpg")
+        if "." in rest:
+            rest = rest.split(".", 1)[0]
+        # ignore a _suffix (alternate key form)
+        if "_" in rest:
+            rest = rest.split("_", 1)[0]
+        if len(rest) <= 8:
+            raise ValueError(f"invalid fid {fid!r}: key+cookie too short")
+        key = int(rest[:-8], 16)
+        cookie = int(rest[-8:], 16)
+        return cls(int(vid_str), key, cookie)
+
+    def __str__(self) -> str:
+        return f"{self.volume_id},{self.key:x}{self.cookie:08x}"
+
+
+def new_cookie() -> int:
+    return secrets.randbits(32)

@@ -1,0 +1,330 @@
+"""Store: everything one volume server owns on disk.
+
+Facade over one or more storage directories (DiskLocation), routing needle
+operations to normal volumes and EC volumes — capability parity with the
+reference Store (weed/storage/store.go:26-49, disk_location.go:18-30,
+store_ec.go). Also produces the heartbeat payload the master consumes.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import threading
+from typing import Optional
+
+from .. import ec as ec_mod
+from ..ec.coder import ErasureCoder
+from ..ec.ec_volume import EcVolume
+from . import types as t
+from .needle import Needle
+from .superblock import ReplicaPlacement, SuperBlock
+from .volume import Volume
+
+
+class DiskLocation:
+    """One storage directory holding volumes and EC shards
+    (weed/storage/disk_location.go)."""
+
+    def __init__(self, directory: str, max_volume_count: int = 8):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.max_volume_count = max_volume_count
+        self.volumes: dict[int, Volume] = {}
+        self.ec_volumes: dict[int, EcVolume] = {}
+
+    def load_existing(self, coder_factory,
+                      geometry: ec_mod.Geometry) -> None:
+        for dat in glob.glob(os.path.join(self.directory, "*.dat")):
+            name = os.path.basename(dat)[:-4]
+            collection, vid = _parse_volume_file_name(name)
+            if vid is None:
+                continue
+            try:
+                self.volumes[vid] = Volume(self.directory, collection, vid)
+            except Exception:
+                continue
+        for ecx in glob.glob(os.path.join(self.directory, "*.ecx")):
+            name = os.path.basename(ecx)[:-4]
+            collection, vid = _parse_volume_file_name(name)
+            if vid is None or vid in self.volumes:
+                continue
+            try:
+                ev = EcVolume(self.directory, collection, vid, geometry,
+                              coder=coder_factory())
+                for sid in range(ev.g.total_shards):
+                    if os.path.exists(ev.base_file_name() + ec_mod.to_ext(sid)):
+                        ev.add_shard(sid)
+                if ev.shard_ids():
+                    self.ec_volumes[vid] = ev
+                else:
+                    ev.close()
+            except Exception:
+                continue
+
+
+def _parse_volume_file_name(name: str) -> tuple[str, Optional[int]]:
+    if "_" in name:
+        collection, _, vid_str = name.rpartition("_")
+    else:
+        collection, vid_str = "", name
+    try:
+        return collection, int(vid_str)
+    except ValueError:
+        return "", None
+
+
+class Store:
+    def __init__(self, directories: list[str],
+                 max_volume_counts: Optional[list[int]] = None,
+                 coder_name: str = "auto",
+                 geometry: ec_mod.Geometry = ec_mod.DEFAULT):
+        self.geometry = geometry
+        self.coder_name = coder_name
+        self._coder: Optional[ErasureCoder] = None
+        counts = max_volume_counts or [8] * len(directories)
+        self.locations = [DiskLocation(d, c)
+                          for d, c in zip(directories, counts)]
+        self._lock = threading.RLock()
+        for loc in self.locations:
+            loc.load_existing(self.coder, self.geometry)
+
+    def coder(self) -> ErasureCoder:
+        if self._coder is None:
+            self._coder = ec_mod.get_coder(
+                self.coder_name, self.geometry.data_shards,
+                self.geometry.parity_shards)
+        return self._coder
+
+    # --- volume management ---
+    def find_volume(self, vid: int) -> Optional[Volume]:
+        for loc in self.locations:
+            v = loc.volumes.get(vid)
+            if v is not None:
+                return v
+        return None
+
+    def find_ec_volume(self, vid: int) -> Optional[EcVolume]:
+        for loc in self.locations:
+            ev = loc.ec_volumes.get(vid)
+            if ev is not None:
+                return ev
+        return None
+
+    def has_volume(self, vid: int) -> bool:
+        return self.find_volume(vid) is not None
+
+    def add_volume(self, vid: int, collection: str = "",
+                   replica_placement: str = "000", ttl: str = "",
+                   version: int = t.CURRENT_VERSION) -> Volume:
+        """AllocateVolume (weed/server/volume_grpc_admin.go)."""
+        with self._lock:
+            if self.find_volume(vid) is not None:
+                raise ValueError(f"volume {vid} already exists")
+            open_locs = [l for l in self.locations
+                         if len(l.volumes) < l.max_volume_count]
+            if not open_locs:
+                raise RuntimeError("no free volume slots")
+            loc = min(open_locs, key=lambda l: len(l.volumes))
+            sb = SuperBlock(
+                version=version,
+                replica_placement=ReplicaPlacement.parse(replica_placement),
+                ttl=t.TTL.parse(ttl))
+            v = Volume(loc.directory, collection, vid, superblock=sb,
+                       create=True)
+            loc.volumes[vid] = v
+            return v
+
+    def delete_volume(self, vid: int) -> bool:
+        with self._lock:
+            for loc in self.locations:
+                v = loc.volumes.pop(vid, None)
+                if v is not None:
+                    base = v.base_file_name()
+                    v.close()
+                    for ext in (".dat", ".idx"):
+                        if os.path.exists(base + ext):
+                            os.remove(base + ext)
+                    return True
+        return False
+
+    def mark_readonly(self, vid: int, read_only: bool = True) -> bool:
+        v = self.find_volume(vid)
+        if v is None:
+            return False
+        v.read_only = read_only
+        return True
+
+    # --- needle ops ---
+    def write_needle(self, vid: int, n: Needle) -> tuple[int, int, bool]:
+        v = self.find_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        return v.write_needle(n)
+
+    def read_needle(self, vid: int, needle_id: int,
+                    cookie: Optional[int] = None) -> Needle:
+        v = self.find_volume(vid)
+        if v is not None:
+            return v.read_needle(needle_id, cookie=cookie)
+        ev = self.find_ec_volume(vid)
+        if ev is not None:
+            return ev.read_needle(needle_id, cookie=cookie,
+                                  shard_reader=self._remote_shard_reader(ev))
+        raise KeyError(f"volume {vid} not found")
+
+    def delete_needle(self, vid: int, n: Needle) -> int:
+        v = self.find_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        return v.delete_needle(n)
+
+    # hook the server layer overrides to fetch shards from peers
+    def _remote_shard_reader(self, ev: EcVolume):
+        return None
+
+    # --- EC lifecycle (VolumeEcShardsGenerate etc.,
+    #     weed/server/volume_grpc_erasure_coding.go) ---
+    def ec_generate(self, vid: int) -> list[int]:
+        v = self.find_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        v.read_only = True
+        v.sync()
+        base = v.base_file_name()
+        ec_mod.write_ec_files(base, self.coder(), self.geometry)
+        ec_mod.write_sorted_ecx_from_idx(base)
+        return list(range(self.geometry.total_shards))
+
+    def ec_mount(self, vid: int, collection: str,
+                 shard_ids: list[int]) -> list[int]:
+        with self._lock:
+            ev = self.find_ec_volume(vid)
+            if ev is None:
+                loc = self._location_with_ec_files(vid, collection)
+                ev = EcVolume(loc.directory, collection, vid, self.geometry,
+                              coder=self.coder())
+                loc.ec_volumes[vid] = ev
+            mounted = [sid for sid in shard_ids if ev.add_shard(sid)]
+            return mounted
+
+    def _location_with_ec_files(self, vid: int, collection: str):
+        prefix = f"{collection}_" if collection else ""
+        for loc in self.locations:
+            if os.path.exists(os.path.join(loc.directory,
+                                           f"{prefix}{vid}.ecx")):
+                return loc
+        raise KeyError(f"no .ecx for volume {vid} in any location")
+
+    def ec_unmount(self, vid: int, shard_ids: list[int]) -> list[int]:
+        with self._lock:
+            ev = self.find_ec_volume(vid)
+            if ev is None:
+                return []
+            removed = [sid for sid in shard_ids if ev.delete_shard(sid)]
+            if not ev.shard_ids():
+                for loc in self.locations:
+                    loc.ec_volumes.pop(vid, None)
+                ev.close()
+            return removed
+
+    def ec_shard_read(self, vid: int, shard_id: int, offset: int,
+                      size: int) -> bytes:
+        ev = self.find_ec_volume(vid)
+        if ev is None:
+            raise KeyError(f"ec volume {vid} not found")
+        shard = ev.shards.get(shard_id)
+        if shard is None:
+            raise KeyError(f"shard {vid}.{shard_id} not here")
+        return shard.read_at(offset, size)
+
+    def ec_rebuild(self, vid: int, collection: str = "") -> list[int]:
+        loc = self._location_with_ec_files(vid, collection)
+        prefix = f"{collection}_" if collection else ""
+        base = os.path.join(loc.directory, f"{prefix}{vid}")
+        rebuilt = ec_mod.rebuild_ec_files(base, self.coder(), self.geometry)
+        ec_mod.rebuild_ecx_file(base)
+        return rebuilt
+
+    def ec_blob_delete(self, vid: int, needle_id: int) -> None:
+        ev = self.find_ec_volume(vid)
+        if ev is None:
+            raise KeyError(f"ec volume {vid} not found")
+        ev.delete_needle(needle_id)
+
+    def ec_delete_shards(self, vid: int, collection: str,
+                         shard_ids: list[int]) -> None:
+        self.ec_unmount(vid, shard_ids)
+        prefix = f"{collection}_" if collection else ""
+        for loc in self.locations:
+            base = os.path.join(loc.directory, f"{prefix}{vid}")
+            for sid in shard_ids:
+                p = base + ec_mod.to_ext(sid)
+                if os.path.exists(p):
+                    os.remove(p)
+
+    def ec_to_volume(self, vid: int, collection: str = "") -> None:
+        """ShardsToVolume: decode local data shards back into a normal volume
+        (weed/server/volume_grpc_erasure_coding.go:331-391)."""
+        with self._lock:
+            loc = self._location_with_ec_files(vid, collection)
+            prefix = f"{collection}_" if collection else ""
+            base = os.path.join(loc.directory, f"{prefix}{vid}")
+            dat_size = ec_mod.find_dat_file_size(base, t.CURRENT_VERSION)
+            ec_mod.write_dat_file(base, dat_size, self.geometry)
+            ec_mod.write_idx_file_from_ec_index(base)
+            ev = loc.ec_volumes.pop(vid, None)
+            if ev is not None:
+                ev.close()
+            loc.volumes[vid] = Volume(loc.directory, collection, vid)
+
+    # --- heartbeat ---
+    def heartbeat(self) -> dict:
+        """The payload sent to the master (CollectHeartbeat,
+        weed/storage/store.go:198)."""
+        volumes = []
+        ec_shards = []
+        max_file_key = 0
+        for loc in self.locations:
+            for vid, v in loc.volumes.items():
+                max_file_key = max(max_file_key, v.nm.maximum_key)
+                volumes.append({
+                    "id": vid,
+                    "collection": v.collection,
+                    "size": v.data_file_size(),
+                    "file_count": v.file_count(),
+                    "delete_count": v.nm.deleted_count,
+                    "deleted_bytes": v.nm.deleted_byte_count,
+                    "read_only": v.read_only,
+                    "replica_placement": str(
+                        v.super_block.replica_placement),
+                    "ttl": str(v.super_block.ttl),
+                    "version": v.version,
+                })
+            for vid, ev in loc.ec_volumes.items():
+                ec_shards.append({
+                    "id": vid,
+                    "collection": ev.collection,
+                    "shard_ids": ev.shard_ids(),
+                    "shard_size": ev.shard_size(),
+                })
+        return {
+            "volumes": volumes,
+            "ec_shards": ec_shards,
+            "max_file_key": max_file_key,
+            "max_volume_count": sum(l.max_volume_count
+                                    for l in self.locations),
+        }
+
+    def status(self) -> dict:
+        hb = self.heartbeat()
+        return {"volumes": hb["volumes"], "ec_shards": hb["ec_shards"]}
+
+    def close(self) -> None:
+        for loc in self.locations:
+            for v in loc.volumes.values():
+                v.close()
+            for ev in loc.ec_volumes.values():
+                ev.close()
+            loc.volumes.clear()
+            loc.ec_volumes.clear()

@@ -1,0 +1,27 @@
+"""File-key sequencer (weed/sequence/memory_sequencer.go): monotonically
+increasing needle keys, batch-allocated, persisted via heartbeat max_file_key."""
+
+from __future__ import annotations
+
+import threading
+
+
+class MemorySequencer:
+    def __init__(self, start: int = 1):
+        self._next = max(start, 1)
+        self._lock = threading.Lock()
+
+    def next_file_id(self, count: int = 1) -> int:
+        """Returns the first id of a batch of `count` consecutive ids."""
+        with self._lock:
+            first = self._next
+            self._next += count
+            return first
+
+    def set_max(self, seen: int) -> None:
+        with self._lock:
+            if seen >= self._next:
+                self._next = seen + 1
+
+    def peek(self) -> int:
+        return self._next

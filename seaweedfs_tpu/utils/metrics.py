@@ -1,0 +1,83 @@
+"""Minimal Prometheus-text metrics registry.
+
+Counterpart of the reference's central registry (weed/stats/metrics.go:19-118)
+— counters, gauges and duration histograms rendered in Prometheus exposition
+format at /metrics (scrape model; the reference also supports push).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+
+_BUCKETS = [0.0001, 0.001, 0.01, 0.1, 1.0, 10.0]
+
+
+class Registry:
+    def __init__(self, subsystem: str):
+        self.subsystem = subsystem
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = defaultdict(float)
+        self._gauges: dict[str, float] = {}
+        self._hist: dict[str, list[int]] = {}
+        self._hist_sum: dict[str, float] = defaultdict(float)
+        self._hist_count: dict[str, int] = defaultdict(int)
+
+    def count(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] += value
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, seconds: float) -> None:
+        with self._lock:
+            buckets = self._hist.setdefault(name, [0] * (len(_BUCKETS) + 1))
+            for i, b in enumerate(_BUCKETS):
+                if seconds <= b:
+                    buckets[i] += 1
+                    break
+            else:
+                buckets[-1] += 1
+            self._hist_sum[name] += seconds
+            self._hist_count[name] += 1
+
+    def timed(self, name: str):
+        registry = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                registry.observe(name, time.perf_counter() - self.t0)
+
+        return _Timer()
+
+    def render(self) -> str:
+        with self._lock:
+            lines = []
+            p = f"seaweedfs_tpu_{self.subsystem}"
+            for name, v in sorted(self._counters.items()):
+                lines.append(f"# TYPE {p}_{name}_total counter")
+                lines.append(f"{p}_{name}_total {v}")
+            for name, v in sorted(self._gauges.items()):
+                lines.append(f"# TYPE {p}_{name} gauge")
+                lines.append(f"{p}_{name} {v}")
+            for name, buckets in sorted(self._hist.items()):
+                lines.append(f"# TYPE {p}_{name}_seconds histogram")
+                acc = 0
+                for i, b in enumerate(_BUCKETS):
+                    acc += buckets[i]
+                    lines.append(
+                        f'{p}_{name}_seconds_bucket{{le="{b}"}} {acc}')
+                acc += buckets[-1]
+                lines.append(f'{p}_{name}_seconds_bucket{{le="+Inf"}} {acc}')
+                lines.append(
+                    f"{p}_{name}_seconds_sum {self._hist_sum[name]}")
+                lines.append(
+                    f"{p}_{name}_seconds_count {self._hist_count[name]}")
+            return "\n".join(lines) + "\n"

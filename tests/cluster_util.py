@@ -1,0 +1,163 @@
+"""In-process test cluster: master + N volume servers on localhost ports.
+
+The asyncio servers run on a dedicated background loop thread; tests drive
+them synchronously through the Client — the same pattern as the reference's
+out-of-tree live-cluster tests (test/s3/basic), but in-process and CI-safe.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import tempfile
+import threading
+import time
+
+from seaweedfs_tpu.client import Client
+from seaweedfs_tpu.ec.geometry import Geometry
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.storage.store import Store
+
+TEST_GEOMETRY = Geometry(10, 4, large_block_size=64 * 1024,
+                         small_block_size=4 * 1024)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class Cluster:
+    def __init__(self, n_volume_servers: int = 3,
+                 geometry: Geometry = TEST_GEOMETRY,
+                 coder_name: str = "numpy",
+                 default_replication: str = "000",
+                 max_volumes: int = 16,
+                 pulse: float = 0.15):
+        self.geometry = geometry
+        self.coder_name = coder_name
+        self.default_replication = default_replication
+        self.max_volumes = max_volumes
+        self.pulse = pulse
+        self.n = n_volume_servers
+
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self._loop_main, daemon=True)
+        self.thread.start()
+        self.tmpdirs: list[tempfile.TemporaryDirectory] = []
+        self.volume_servers: list[VolumeServer] = []
+        self.runners: list = []
+        self._start()
+
+    def _loop_main(self) -> None:
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def call(self, coro, timeout: float = 60.0):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop) \
+            .result(timeout)
+
+    def _start(self) -> None:
+        from aiohttp import web
+
+        self.master_port = free_port()
+        self.master_url = f"127.0.0.1:{self.master_port}"
+        self.master = MasterServer(
+            volume_size_limit_mb=1,  # tiny: volumes seal quickly
+            default_replication=self.default_replication,
+            pulse_seconds=self.pulse)
+
+        async def boot_master():
+            runner = web.AppRunner(self.master.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", self.master_port)
+            await site.start()
+            return runner
+
+        self.runners.append(self.call(boot_master()))
+
+        for i in range(self.n):
+            self.add_volume_server()
+        self.wait_for_nodes(self.n)
+        self.client = Client(self.master_url)
+
+    def add_volume_server(self, data_center: str = "dc1",
+                          rack: str = "") -> VolumeServer:
+        from aiohttp import web
+
+        tmp = tempfile.TemporaryDirectory(prefix="weedtpu_vs_")
+        self.tmpdirs.append(tmp)
+        port = free_port()
+        store = Store([tmp.name], max_volume_counts=[self.max_volumes],
+                      coder_name=self.coder_name, geometry=self.geometry)
+        vs = VolumeServer(store, self.master_url, url=f"127.0.0.1:{port}",
+                          data_center=data_center,
+                          rack=rack or f"rack{len(self.volume_servers) % 2}",
+                          pulse_seconds=self.pulse)
+
+        async def boot():
+            runner = web.AppRunner(vs.app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", port)
+            await site.start()
+            return runner
+
+        self.runners.append(self.call(boot()))
+        self.volume_servers.append(vs)
+        return vs
+
+    def stop_volume_server(self, index: int) -> None:
+        vs = self.volume_servers[index]
+
+        async def halt():
+            if vs._hb_task:
+                vs._hb_task.cancel()
+            # find its runner (master is runners[0])
+            runner = self.runners[index + 1]
+            await runner.cleanup()
+
+        self.call(halt())
+
+    def wait_for_nodes(self, n: int, timeout: float = 10.0) -> None:
+        import json
+        import urllib.request
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"http://{self.master_url}/dir/status",
+                        timeout=2) as r:
+                    if len(json.load(r).get("nodes", [])) >= n:
+                        return
+            except Exception:
+                pass
+            time.sleep(0.05)
+        raise TimeoutError(f"cluster did not reach {n} nodes")
+
+    def wait_heartbeats(self) -> None:
+        """Wait one full heartbeat round so the master sees current state."""
+        time.sleep(self.pulse * 2 + 0.1)
+
+    def shutdown(self) -> None:
+        async def halt_all():
+            for vs in self.volume_servers:
+                if vs._hb_task:
+                    vs._hb_task.cancel()
+            for runner in self.runners:
+                try:
+                    await runner.cleanup()
+                except Exception:
+                    pass
+
+        try:
+            self.call(halt_all(), timeout=20)
+        finally:
+            self.loop.call_soon_threadsafe(self.loop.stop)
+            self.thread.join(timeout=5)
+            for tmp in self.tmpdirs:
+                try:
+                    tmp.cleanup()
+                except Exception:
+                    pass

@@ -1,0 +1,144 @@
+"""Topology/placement unit tests — in-memory fixtures, no sockets
+(the reference pattern: weed/topology/topology_test.go:25,
+volume_growth_test.go:342)."""
+
+import pytest
+
+from seaweedfs_tpu.shell.ec_commands import (EcNode, plan_balance,
+                                             plan_rebuild, plan_shard_spread)
+from seaweedfs_tpu.topology.sequence import MemorySequencer
+from seaweedfs_tpu.topology.topology import Topology
+
+
+def make_topo(layout):
+    """layout: list of (dc, rack) per node."""
+    topo = Topology()
+    for i, (dc, rack) in enumerate(layout):
+        topo.register_heartbeat(f"n{i}", f"n{i}:80", "", dc, rack, 16, {})
+    return topo
+
+
+def test_placement_constraints():
+    topo = make_topo([("dc1", "r0"), ("dc1", "r1"), ("dc1", "r0"),
+                      ("dc2", "rA")])
+    assert len(topo.find_empty_slots("000")) == 1
+    picked = topo.find_empty_slots("001")
+    assert len(picked) == 2
+    assert picked[0].rack == picked[1].rack
+    picked = topo.find_empty_slots("010")
+    assert len(picked) == 2
+    assert picked[0].data_center == picked[1].data_center
+    assert picked[0].rack != picked[1].rack
+    picked = topo.find_empty_slots("100")
+    assert len(picked) == 2
+    assert picked[0].data_center != picked[1].data_center
+    assert topo.find_empty_slots("002") == []  # 3 same-rack impossible
+    picked = topo.find_empty_slots("110")
+    assert len(picked) == 3
+
+
+def test_heartbeat_register_unregister_layouts():
+    topo = make_topo([("dc1", "r0"), ("dc1", "r1")])
+    payload = {"volumes": [
+        {"id": 1, "size": 100, "replica_placement": "000"},
+        {"id": 2, "size": 100, "replica_placement": "000",
+         "read_only": True},
+    ]}
+    topo.register_heartbeat("n0", "n0:80", "", "dc1", "r0", 16, payload)
+    assert [n.id for n in topo.lookup(1)] == ["n0"]
+    layout = topo._layout_for("", "000", "")
+    assert 1 in layout.writable
+    assert 2 not in layout.writable  # read-only never writable
+    # volume disappears from the next heartbeat -> unregistered
+    topo.register_heartbeat("n0", "n0:80", "", "dc1", "r0", 16,
+                            {"volumes": [{"id": 2, "size": 100,
+                                          "replica_placement": "000"}]})
+    assert topo.lookup(1) == []
+    assert 1 not in layout.writable
+
+
+def test_replicated_volume_not_writable_with_missing_replica():
+    topo = make_topo([("dc1", "r0"), ("dc1", "r0")])
+    vol = {"id": 5, "size": 0, "replica_placement": "001"}
+    topo.register_heartbeat("n0", "n0:80", "", "dc1", "r0", 16,
+                            {"volumes": [vol]})
+    layout = topo._layout_for("", "001", "")
+    assert 5 not in layout.writable  # only one copy present
+    topo.register_heartbeat("n1", "n1:80", "", "dc1", "r0", 16,
+                            {"volumes": [vol]})
+    assert 5 in layout.writable
+    # losing one node makes it read-only again
+    topo.unregister_node("n1")
+    assert 5 not in layout.writable
+
+
+def test_volume_over_size_limit_not_writable():
+    topo = Topology(volume_size_limit=1000)
+    topo.register_heartbeat("n0", "n0:80", "", "d", "r", 16, {"volumes": [
+        {"id": 1, "size": 2000, "replica_placement": "000"}]})
+    assert 1 not in topo._layout_for("", "000", "").writable
+
+
+def test_ec_shard_registry():
+    topo = make_topo([("dc1", "r0"), ("dc1", "r1")])
+    topo.register_heartbeat("n0", "n0:80", "", "dc1", "r0", 16, {
+        "ec_shards": [{"id": 7, "shard_ids": [0, 1, 2]}]})
+    topo.register_heartbeat("n1", "n1:80", "", "dc1", "r1", 16, {
+        "ec_shards": [{"id": 7, "shard_ids": [3, 4]}]})
+    shards = topo.lookup_ec_shards(7)
+    assert sorted(shards) == [0, 1, 2, 3, 4]
+    assert shards[3][0].id == "n1"
+
+
+def test_sequencer():
+    seq = MemorySequencer()
+    a = seq.next_file_id(5)
+    b = seq.next_file_id(1)
+    assert b == a + 5
+    seq.set_max(1000)
+    assert seq.next_file_id() == 1001
+
+
+def test_plan_shard_spread_balanced():
+    nodes = [EcNode("a", 10), EcNode("b", 10), EcNode("c", 10)]
+    plan = plan_shard_spread(nodes, 14, "a")
+    assert sorted(s for sids in plan.values() for s in sids) == list(range(14))
+    counts = sorted(len(s) for s in plan.values())
+    assert counts == [4, 5, 5]
+    # pre-existing shards are counted: loaded node gets fewer
+    nodes = [EcNode("a", 10, {9: list(range(10))}), EcNode("b", 10),
+             EcNode("c", 10)]
+    plan = plan_shard_spread(nodes, 14, "a")
+    assert len(plan.get("a", [])) < len(plan["b"])
+
+
+def test_plan_rebuild():
+    nodes = [
+        EcNode("a", 10, {3: [0, 1, 2, 3, 4]}),
+        EcNode("b", 10, {3: [5, 6, 7, 8]}),
+        EcNode("c", 10, {3: [9, 10]}),
+    ]
+    rebuilder, missing, copy_plan = plan_rebuild(nodes, 3, 14)
+    assert rebuilder == "a"  # most local shards
+    assert missing == [11, 12, 13]
+    copied = sorted(s for sids in copy_plan.values() for s in sids)
+    assert copied == [5, 6, 7, 8, 9, 10]
+    # full set: nothing to do
+    nodes = [EcNode("a", 10, {3: list(range(14))})]
+    _, missing, _ = plan_rebuild(nodes, 3, 14)
+    assert missing == []
+    with pytest.raises(ValueError):
+        plan_rebuild(nodes, 99, 14)
+
+
+def test_plan_balance():
+    nodes = [EcNode("a", 10, {1: list(range(14))}), EcNode("b", 10),
+             EcNode("c", 10)]
+    moves = plan_balance(nodes, 14)
+    assert moves
+    counts = {n.url: n.shard_count() for n in nodes}
+    assert max(counts.values()) - min(counts.values()) <= 1
+    # no duplicate shard placements
+    for n in nodes:
+        for vid, sids in n.shards.items():
+            assert len(sids) == len(set(sids))
