@@ -73,6 +73,29 @@ def cmd_server(args) -> None:
     _run_forever(boot())
 
 
+def cmd_filer(args) -> None:
+    from .server.filer_server import run_filer
+    store_kwargs = {}
+    if args.store == "sqlite":
+        store_kwargs["path"] = args.store_path
+    _run_forever(run_filer(
+        args.ip, args.port, args.mserver, store_name=args.store,
+        store_kwargs=store_kwargs, chunk_size=args.chunk_size_mb * 1024 * 1024,
+        default_replication=args.default_replication,
+        default_collection=args.collection))
+
+
+def cmd_s3(args) -> None:
+    from .s3.s3_server import run_s3
+    if bool(args.access_key) != bool(args.secret_key):
+        raise SystemExit(
+            "-access_key and -secret_key must be provided together "
+            "(omit both for anonymous mode)")
+    _run_forever(run_s3(args.ip, args.port, args.filer,
+                        access_key=args.access_key,
+                        secret_key=args.secret_key))
+
+
 def cmd_upload(args) -> None:
     from .client import Client
     c = Client(args.server)
@@ -218,6 +241,26 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("-ec_large_block", type=int, default=1024 * 1024 * 1024)
     s.add_argument("-ec_small_block", type=int, default=1024 * 1024)
     s.set_defaults(fn=cmd_server)
+
+    f = sub.add_parser("filer", help="run a filer server")
+    f.add_argument("-ip", default="127.0.0.1")
+    f.add_argument("-port", type=int, default=8888)
+    f.add_argument("-mserver", default="127.0.0.1:9333")
+    f.add_argument("-store", default="sqlite",
+                   help="metadata store: sqlite | memory")
+    f.add_argument("-store_path", default="./filer.db")
+    f.add_argument("-chunk_size_mb", type=int, default=8)
+    f.add_argument("-default_replication", default="")
+    f.add_argument("-collection", default="")
+    f.set_defaults(fn=cmd_filer)
+
+    s3p = sub.add_parser("s3", help="run the S3 gateway")
+    s3p.add_argument("-ip", default="127.0.0.1")
+    s3p.add_argument("-port", type=int, default=8333)
+    s3p.add_argument("-filer", default="127.0.0.1:8888")
+    s3p.add_argument("-access_key", default="")
+    s3p.add_argument("-secret_key", default="")
+    s3p.set_defaults(fn=cmd_s3)
 
     u = sub.add_parser("upload", help="upload files")
     u.add_argument("-server", default="127.0.0.1:9333")

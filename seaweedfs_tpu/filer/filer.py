@@ -1,0 +1,232 @@
+"""Filer core: the directory/file namespace over the blob store.
+
+Capability parity with the reference filer (weed/filer/filer.go,
+filer_delete_entry.go, filer_deletion.go, filer_notify.go): CRUD with
+auto-created parent directories, recursive delete that streams freed chunks
+to the blob deleter, rename as a store transaction, and a metadata event
+log every mutation feeds (subscribable; the reference persists it into the
+store itself — here it sits in a bounded in-memory ring plus the KV face).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Iterator, Optional
+
+from .chunks import FileChunk
+from .entry import Attr, Entry, new_directory
+from .stores import FilerStore
+
+log = logging.getLogger("filer")
+
+
+@dataclass
+class MetaEvent:
+    """EventNotification (weed/pb/filer.proto): one namespace mutation."""
+    tsns: int
+    directory: str
+    old_entry: Optional[Entry]
+    new_entry: Optional[Entry]
+    delete_chunks: bool = False
+
+
+class MetaLog:
+    """Bounded in-memory event log with subscriber fanout
+    (role of weed/util/log_buffer + filer_notify.go)."""
+
+    def __init__(self, capacity: int = 8192):
+        self.capacity = capacity
+        self._events: list[MetaEvent] = []
+        self._lock = threading.Lock()
+        self._subscribers: list[Callable[[MetaEvent], None]] = []
+
+    def append(self, event: MetaEvent) -> None:
+        with self._lock:
+            self._events.append(event)
+            if len(self._events) > self.capacity:
+                self._events = self._events[-self.capacity:]
+            subs = list(self._subscribers)
+        for fn in subs:
+            try:
+                fn(event)
+            except Exception:
+                log.exception("meta subscriber failed")
+
+    def subscribe(self, fn: Callable[[MetaEvent], None]) -> None:
+        with self._lock:
+            self._subscribers.append(fn)
+
+    def unsubscribe(self, fn: Callable[[MetaEvent], None]) -> None:
+        with self._lock:
+            if fn in self._subscribers:
+                self._subscribers.remove(fn)
+
+    def events_since(self, tsns: int, prefix: str = "/") -> list[MetaEvent]:
+        with self._lock:
+            return [e for e in self._events
+                    if e.tsns > tsns and e.directory.startswith(prefix)]
+
+
+class Filer:
+    def __init__(self, store: FilerStore,
+                 on_delete_chunks: Optional[Callable[[list[FileChunk]],
+                                                     None]] = None):
+        self.store = store
+        self.meta_log = MetaLog()
+        self.on_delete_chunks = on_delete_chunks or (lambda chunks: None)
+        self._lock = threading.RLock()
+
+    # --- CRUD ---
+    def create_entry(self, entry: Entry,
+                     o_excl: bool = False) -> Entry:
+        """Insert with parent auto-creation (Filer.CreateEntry,
+        weed/filer/filer.go:119-186)."""
+        with self._lock:
+            self._ensure_parents(entry.parent)
+            old = self.store.find_entry(entry.full_path)
+            if old is not None:
+                if o_excl:
+                    raise FileExistsError(entry.full_path)
+                if old.is_directory and not entry.is_directory:
+                    raise IsADirectoryError(entry.full_path)
+            self.store.insert_entry(entry)
+        self._notify(entry.parent, old, entry)
+        return entry
+
+    def _ensure_parents(self, dir_path: str) -> None:
+        if dir_path in ("", "/"):
+            return
+        existing = self.store.find_entry(dir_path)
+        if existing is not None:
+            if not existing.is_directory:
+                raise NotADirectoryError(dir_path)
+            return
+        parent = dir_path.rsplit("/", 1)[0] or "/"
+        self._ensure_parents(parent)
+        d = new_directory(dir_path)
+        self.store.insert_entry(d)
+        self._notify(parent, None, d)
+
+    def update_entry(self, entry: Entry) -> Entry:
+        with self._lock:
+            old = self.store.find_entry(entry.full_path)
+            if old is None:
+                raise FileNotFoundError(entry.full_path)
+            self.store.update_entry(entry)
+        self._notify(entry.parent, old, entry)
+        return entry
+
+    def find_entry(self, path: str) -> Optional[Entry]:
+        path = _norm(path)
+        if path == "/":
+            return new_directory("/")
+        return self.store.find_entry(path)
+
+    def list_directory(self, dir_path: str, start_file: str = "",
+                       include_start: bool = False, limit: int = 1024,
+                       prefix: str = "") -> list[Entry]:
+        return self.store.list_directory_entries(
+            _norm(dir_path), start_file, include_start, limit, prefix)
+
+    # --- delete (recursive, chunk-freeing) ---
+    def delete_entry(self, path: str, recursive: bool = False,
+                     free_chunks: bool = True) -> None:
+        """DeleteEntryMetaAndData (weed/filer/filer_delete_entry.go).
+        free_chunks=False removes metadata only (isDeleteData=false in the
+        reference) — used when chunks were moved into another entry."""
+        path = _norm(path)
+        entry = self.store.find_entry(path)
+        if entry is None:
+            raise FileNotFoundError(path)
+        freed: list[FileChunk] = []
+        with self._lock:
+            if entry.is_directory:
+                children = self.store.list_directory_entries(path, limit=2)
+                if children and not recursive:
+                    raise OSError(f"directory {path} not empty")
+                if free_chunks:
+                    self._collect_chunks_recursive(path, freed)
+                self.store.delete_folder_children(path)
+            elif free_chunks:
+                freed.extend(entry.chunks)
+            self.store.delete_entry(path)
+        if freed:
+            self.on_delete_chunks(freed)
+        self._notify(entry.parent, entry, None, delete_chunks=bool(freed))
+
+    def _collect_chunks_recursive(self, dir_path: str,
+                                  out: list[FileChunk]) -> None:
+        start = ""
+        while True:
+            batch = self.store.list_directory_entries(dir_path, start,
+                                                      limit=1024)
+            if not batch:
+                return
+            for e in batch:
+                if e.is_directory:
+                    self._collect_chunks_recursive(e.full_path, out)
+                else:
+                    out.extend(e.chunks)
+            if len(batch) < 1024:
+                return
+            start = batch[-1].name
+
+    # --- rename (AtomicRenameEntry,
+    #     weed/server/filer_grpc_server_rename.go) ---
+    def rename(self, old_path: str, new_path: str) -> None:
+        old_path, new_path = _norm(old_path), _norm(new_path)
+        with self._lock:
+            entry = self.store.find_entry(old_path)
+            if entry is None:
+                raise FileNotFoundError(old_path)
+            self.store.begin()
+            try:
+                self._move_recursive(entry, new_path)
+                self.store.commit()
+            except Exception:
+                self.store.rollback()
+                raise
+
+    def _move_recursive(self, entry: Entry, new_path: str) -> None:
+        old_path = entry.full_path
+        if entry.is_directory:
+            start = ""
+            while True:
+                batch = self.store.list_directory_entries(old_path, start,
+                                                          limit=1024)
+                if not batch:
+                    break
+                for child in batch:
+                    self._move_recursive(
+                        child, f"{new_path}/{child.name}")
+                if len(batch) < 1024:
+                    break
+                start = batch[-1].name
+        self.store.delete_entry(old_path)
+        moved = Entry(full_path=new_path, attr=entry.attr,
+                      chunks=entry.chunks, extended=entry.extended,
+                      hard_link_id=entry.hard_link_id)
+        self._ensure_parents(moved.parent)
+        self.store.insert_entry(moved)
+        self._notify(moved.parent, entry, moved)
+
+    # --- events ---
+    def _notify(self, directory: str, old: Optional[Entry],
+                new: Optional[Entry], delete_chunks: bool = False) -> None:
+        self.meta_log.append(MetaEvent(
+            tsns=time.time_ns(), directory=directory,
+            old_entry=old, new_entry=new, delete_chunks=delete_chunks))
+
+    def close(self) -> None:
+        self.store.close()
+
+
+def _norm(path: str) -> str:
+    if not path.startswith("/"):
+        path = "/" + path
+    while "//" in path:
+        path = path.replace("//", "/")
+    return path.rstrip("/") or "/"

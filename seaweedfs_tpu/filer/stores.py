@@ -1,0 +1,293 @@
+"""FilerStore plugins: the pluggable metadata backend.
+
+One interface, several implementations — mirroring the reference's
+FilerStore contract (weed/filer/filerstore.go:20-43) and its plugin model
+(leveldb/mysql/postgres/... selected by configuration,
+weed/filer/configuration.go:14-37). Here:
+
+- MemoryStore : dict-backed (tests, ephemeral filers)
+- SqliteStore : stdlib sqlite3 — the embedded persistent store (role of the
+  reference's default leveldb; also the shape of the abstract-SQL stores)
+
+Both support the same contract: entry CRUD by full path, ordered directory
+listing with prefix + pagination, directory-children purge, and a KV face
+used for system metadata (offsets etc., filer.proto KvGet/KvPut).
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+from typing import Callable, Iterator, Optional
+
+from .entry import Entry
+
+_STORES: dict[str, Callable[..., "FilerStore"]] = {}
+
+
+def register_store(name: str, factory) -> None:
+    _STORES[name] = factory
+
+
+def create_store(name: str, **kwargs) -> "FilerStore":
+    if name not in _STORES:
+        raise KeyError(f"unknown filer store {name!r}; have {sorted(_STORES)}")
+    return _STORES[name](**kwargs)
+
+
+class FilerStore:
+    name = "base"
+
+    def insert_entry(self, entry: Entry) -> None:
+        raise NotImplementedError
+
+    def update_entry(self, entry: Entry) -> None:
+        raise NotImplementedError
+
+    def find_entry(self, path: str) -> Optional[Entry]:
+        raise NotImplementedError
+
+    def delete_entry(self, path: str) -> None:
+        raise NotImplementedError
+
+    def delete_folder_children(self, path: str) -> None:
+        raise NotImplementedError
+
+    def list_directory_entries(self, dir_path: str, start_file_name: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        raise NotImplementedError
+
+    def kv_put(self, key: str, value: bytes) -> None:
+        raise NotImplementedError
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def begin(self) -> None:  # transaction hooks (AtomicRenameEntry)
+        pass
+
+    def commit(self) -> None:
+        pass
+
+    def rollback(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+def _split(path: str) -> tuple[str, str]:
+    path = path.rstrip("/") or "/"
+    if path == "/":
+        return "", "/"
+    d, _, name = path.rpartition("/")
+    return d or "/", name
+
+
+class MemoryStore(FilerStore):
+    name = "memory"
+
+    def __init__(self, **_):
+        # dir -> {name -> Entry}
+        self._dirs: dict[str, dict[str, Entry]] = {}
+        self._kv: dict[str, bytes] = {}
+        self._lock = threading.RLock()
+        self._snapshot: Optional[dict] = None
+
+    def begin(self) -> None:
+        with self._lock:
+            self._snapshot = {d: dict(names)
+                              for d, names in self._dirs.items()}
+
+    def commit(self) -> None:
+        self._snapshot = None
+
+    def rollback(self) -> None:
+        with self._lock:
+            if self._snapshot is not None:
+                self._dirs = self._snapshot
+                self._snapshot = None
+
+    def insert_entry(self, entry: Entry) -> None:
+        d, name = _split(entry.full_path)
+        with self._lock:
+            self._dirs.setdefault(d, {})[name] = entry
+
+    update_entry = insert_entry
+
+    def find_entry(self, path: str) -> Optional[Entry]:
+        d, name = _split(path)
+        if name == "/":
+            return None
+        with self._lock:
+            return self._dirs.get(d, {}).get(name)
+
+    def delete_entry(self, path: str) -> None:
+        d, name = _split(path)
+        with self._lock:
+            self._dirs.get(d, {}).pop(name, None)
+
+    def delete_folder_children(self, path: str) -> None:
+        path = path.rstrip("/") or "/"
+        with self._lock:
+            doomed = [d for d in self._dirs
+                      if d == path or d.startswith(path + "/")
+                      or (path == "/" and d)]
+            for d in doomed:
+                self._dirs.pop(d, None)
+
+    def list_directory_entries(self, dir_path: str, start_file_name: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        dir_path = dir_path.rstrip("/") or "/"
+        with self._lock:
+            names = sorted(self._dirs.get(dir_path, {}))
+            out = []
+            for n in names:
+                if prefix and not n.startswith(prefix):
+                    continue
+                if start_file_name:
+                    if n < start_file_name:
+                        continue
+                    if n == start_file_name and not include_start:
+                        continue
+                out.append(self._dirs[dir_path][n])
+                if len(out) >= limit:
+                    break
+            return out
+
+    def kv_put(self, key: str, value: bytes) -> None:
+        self._kv[key] = value
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        return self._kv.get(key)
+
+
+class SqliteStore(FilerStore):
+    name = "sqlite"
+
+    def __init__(self, path: str = "filer.db", **_):
+        self._path = path
+        self._local = threading.local()
+        self._init_schema()
+
+    def _conn(self) -> sqlite3.Connection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self._path, timeout=30)
+            conn.execute("PRAGMA journal_mode=WAL")
+            self._local.conn = conn
+        return conn
+
+    def _in_txn(self) -> bool:
+        return getattr(self._local, "in_txn", False)
+
+    def _commit(self, conn: sqlite3.Connection) -> None:
+        if not self._in_txn():
+            conn.commit()
+
+    def begin(self) -> None:
+        self._conn().execute("BEGIN")
+        self._local.in_txn = True
+
+    def commit(self) -> None:
+        self._local.in_txn = False
+        self._conn().commit()
+
+    def rollback(self) -> None:
+        self._local.in_txn = False
+        self._conn().rollback()
+
+    def _init_schema(self) -> None:
+        conn = self._conn()
+        conn.execute("""
+            CREATE TABLE IF NOT EXISTS entries (
+                dir TEXT NOT NULL,
+                name TEXT NOT NULL,
+                meta TEXT NOT NULL,
+                PRIMARY KEY (dir, name)
+            )""")
+        conn.execute("""
+            CREATE TABLE IF NOT EXISTS kv (
+                k TEXT PRIMARY KEY,
+                v BLOB NOT NULL
+            )""")
+        conn.commit()
+
+    def insert_entry(self, entry: Entry) -> None:
+        d, name = _split(entry.full_path)
+        conn = self._conn()
+        conn.execute(
+            "INSERT OR REPLACE INTO entries (dir, name, meta) VALUES (?,?,?)",
+            (d, name, entry.to_json()))
+        self._commit(conn)
+
+    update_entry = insert_entry
+
+    def find_entry(self, path: str) -> Optional[Entry]:
+        d, name = _split(path)
+        if name == "/":
+            return None
+        row = self._conn().execute(
+            "SELECT meta FROM entries WHERE dir=? AND name=?",
+            (d, name)).fetchone()
+        return Entry.from_json(row[0]) if row else None
+
+    def delete_entry(self, path: str) -> None:
+        d, name = _split(path)
+        conn = self._conn()
+        conn.execute("DELETE FROM entries WHERE dir=? AND name=?", (d, name))
+        self._commit(conn)
+
+    def delete_folder_children(self, path: str) -> None:
+        path = path.rstrip("/") or "/"
+        conn = self._conn()
+        if path == "/":
+            conn.execute("DELETE FROM entries WHERE dir != ''")
+        else:
+            conn.execute("DELETE FROM entries WHERE dir = ? OR dir LIKE ?",
+                         (path, path + "/%"))
+        self._commit(conn)
+
+    def list_directory_entries(self, dir_path: str, start_file_name: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        dir_path = dir_path.rstrip("/") or "/"
+        op = ">=" if include_start else ">"
+        sql = f"SELECT meta FROM entries WHERE dir=? AND name {op} ?"
+        args: list = [dir_path, start_file_name]
+        if prefix:
+            sql += r" AND name LIKE ? ESCAPE '\'"
+            escaped = (prefix.replace("\\", r"\\")
+                       .replace("%", r"\%").replace("_", r"\_"))
+            args.append(escaped + "%")
+        sql += " ORDER BY name LIMIT ?"
+        args.append(limit)
+        rows = self._conn().execute(sql, args).fetchall()
+        return [Entry.from_json(r[0]) for r in rows]
+
+    def kv_put(self, key: str, value: bytes) -> None:
+        conn = self._conn()
+        conn.execute("INSERT OR REPLACE INTO kv (k, v) VALUES (?,?)",
+                     (key, value))
+        conn.commit()
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        row = self._conn().execute("SELECT v FROM kv WHERE k=?",
+                                   (key,)).fetchone()
+        return bytes(row[0]) if row else None
+
+    def close(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+
+
+register_store("memory", MemoryStore)
+register_store("sqlite", SqliteStore)

@@ -1,0 +1,466 @@
+"""Filer HTTP server: POSIX-style file API over the blob cluster.
+
+Mirrors the reference filer server (weed/server/filer_server_handlers_read.go,
+filer_server_handlers_write_autochunk.go:26-233):
+
+  GET    /path/to/file      streamed from chunks, Range/ETag supported
+  GET    /path/to/dir/      JSON listing (?limit=&lastFileName=&prefix=)
+  PUT    /path/to/file      upload; body auto-chunked at -chunk_size
+  POST   /path/to/dir?op=mkdir
+  POST   /path?mv.to=/new   rename (AtomicRenameEntry analog)
+  DELETE /path[?recursive=true]
+
+Uploads are chunked client-transparently: every chunk is assigned by the
+master and written to a volume server; the entry records the chunk list.
+Freed chunks (overwrite/delete) go to a background deletion queue batched
+to the volume servers (weed/filer/filer_deletion.go).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from ..filer.chunks import FileChunk, etag as chunks_etag, read_plan, total_size
+from ..filer.entry import Entry, new_directory, new_file
+from ..filer.filer import Filer, _norm
+from ..filer.stores import create_store
+from ..utils import metrics as metrics_mod
+
+log = logging.getLogger("filer.server")
+
+
+async def _healthz(request: web.Request) -> web.Response:
+    return web.json_response({"ok": True})
+
+
+class FilerServer:
+    def __init__(self, master_url: str, store_name: str = "memory",
+                 store_kwargs: Optional[dict] = None,
+                 chunk_size: int = 8 * 1024 * 1024,
+                 default_replication: str = "",
+                 default_collection: str = ""):
+        self.master_url = master_url
+        self.chunk_size = chunk_size
+        self.default_replication = default_replication
+        self.default_collection = default_collection
+        self.filer = Filer(create_store(store_name, **(store_kwargs or {})),
+                           on_delete_chunks=self._queue_chunk_deletes)
+        self.metrics = metrics_mod.Registry("filer")
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._delete_queue: asyncio.Queue = asyncio.Queue()
+        self._delete_task: Optional[asyncio.Task] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._vid_cache: dict[int, tuple[list[str], float]] = {}
+        self.app = self._build_app()
+
+    def _build_app(self) -> web.Application:
+        app = web.Application(client_max_size=1024 * 1024 * 1024)
+        app.router.add_get("/healthz", _healthz)
+        app.router.add_get("/metrics", self.metrics_handler)
+        # entry-level meta API: the JSON face of the reference's filer gRPC
+        # (weed/pb/filer.proto LookupDirectoryEntry/ListEntries/CreateEntry/
+        # UpdateEntry/DeleteEntry/AtomicRenameEntry) — used by gateways (S3)
+        app.router.add_get("/__meta__/lookup", self.meta_lookup)
+        app.router.add_get("/__meta__/list", self.meta_list)
+        app.router.add_post("/__meta__/create_entry", self.meta_create)
+        app.router.add_post("/__meta__/update_entry", self.meta_update)
+        app.router.add_post("/__meta__/delete", self.meta_delete)
+        app.router.add_post("/__meta__/rename", self.meta_rename)
+        app.router.add_get("/__meta__/events", self.meta_events)
+        app.router.add_route("*", "/{path:.*}", self.dispatch)
+        app.on_startup.append(self._on_startup)
+        app.on_cleanup.append(self._on_cleanup)
+        return app
+
+    # --- meta API handlers ---
+    async def meta_lookup(self, request: web.Request) -> web.Response:
+        entry = await asyncio.get_event_loop().run_in_executor(
+            None, self.filer.find_entry, request.query["path"])
+        if entry is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response(json.loads(entry.to_json()))
+
+    async def meta_list(self, request: web.Request) -> web.Response:
+        q = request.query
+        entries = await asyncio.get_event_loop().run_in_executor(
+            None, lambda: self.filer.list_directory(
+                q["dir"], q.get("start", ""),
+                q.get("include_start") == "true",
+                int(q.get("limit", 1024)), q.get("prefix", "")))
+        return web.json_response(
+            {"entries": [json.loads(e.to_json()) for e in entries]})
+
+    async def meta_create(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        entry = Entry.from_json(json.dumps(body["entry"]))
+        old = await asyncio.get_event_loop().run_in_executor(
+            None, self.filer.find_entry, entry.full_path)
+        try:
+            await asyncio.get_event_loop().run_in_executor(
+                None, lambda: self.filer.create_entry(
+                    entry, o_excl=body.get("o_excl", False)))
+        except FileExistsError:
+            return web.json_response({"error": "exists"}, status=409)
+        except (IsADirectoryError, NotADirectoryError) as e:
+            return web.json_response({"error": str(e)}, status=409)
+        if (old is not None and old.chunks
+                and body.get("free_old_chunks", True)):
+            old_fids = {c.fid for c in old.chunks}
+            new_fids = {c.fid for c in entry.chunks}
+            self._queue_chunk_deletes(
+                [c for c in old.chunks if c.fid not in new_fids])
+        return web.json_response({"ok": True})
+
+    async def meta_update(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        entry = Entry.from_json(json.dumps(body["entry"]))
+        try:
+            await asyncio.get_event_loop().run_in_executor(
+                None, self.filer.update_entry, entry)
+        except FileNotFoundError:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response({"ok": True})
+
+    async def meta_delete(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        try:
+            await asyncio.get_event_loop().run_in_executor(
+                None, lambda: self.filer.delete_entry(
+                    body["path"], recursive=body.get("recursive", False),
+                    free_chunks=body.get("free_chunks", True)))
+        except FileNotFoundError:
+            return web.json_response({"error": "not found"}, status=404)
+        except OSError as e:
+            return web.json_response({"error": str(e)}, status=409)
+        return web.json_response({"ok": True})
+
+    async def meta_rename(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        try:
+            await asyncio.get_event_loop().run_in_executor(
+                None, lambda: self.filer.rename(body["from"], body["to"]))
+        except FileNotFoundError:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response({"ok": True})
+
+    async def meta_events(self, request: web.Request) -> web.Response:
+        """Poll-based metadata subscription (SubscribeMetadata analog)."""
+        since = int(request.query.get("since", 0))
+        prefix = request.query.get("prefix", "/")
+        events = self.filer.meta_log.events_since(since, prefix)
+        return web.json_response({"events": [{
+            "tsns": e.tsns,
+            "directory": e.directory,
+            "old": json.loads(e.old_entry.to_json()) if e.old_entry else None,
+            "new": json.loads(e.new_entry.to_json()) if e.new_entry else None,
+        } for e in events]})
+
+    async def _on_startup(self, app) -> None:
+        self._loop = asyncio.get_event_loop()
+        self._session = aiohttp.ClientSession()
+        self._delete_task = asyncio.create_task(self._deletion_worker())
+
+    async def _on_cleanup(self, app) -> None:
+        if self._delete_task:
+            self._delete_task.cancel()
+        if self._session:
+            await self._session.close()
+        self.filer.close()
+
+    # --- chunk-freeing queue (filer_deletion.go) ---
+    def _queue_chunk_deletes(self, chunks: list[FileChunk]) -> None:
+        if self._loop is None:
+            return
+        for c in chunks:
+            self._loop.call_soon_threadsafe(self._delete_queue.put_nowait, c)
+
+    async def _deletion_worker(self) -> None:
+        while True:
+            chunk: FileChunk = await self._delete_queue.get()
+            try:
+                vid = int(chunk.fid.split(",")[0])
+                for url in await self._lookup(vid):
+                    try:
+                        async with self._session.delete(
+                                f"http://{url}/{chunk.fid}") as r:
+                            if r.status in (200, 202, 404):
+                                break
+                    except aiohttp.ClientError:
+                        continue
+            except Exception as e:
+                log.warning("chunk delete %s failed: %s", chunk.fid, e)
+
+    # --- master/volume plumbing ---
+    async def _lookup(self, vid: int) -> list[str]:
+        cached = self._vid_cache.get(vid)
+        if cached and time.time() - cached[1] < 60:
+            return cached[0]
+        async with self._session.get(
+                f"http://{self.master_url}/dir/lookup",
+                params={"volumeId": str(vid)}) as r:
+            body = await r.json()
+        urls = [loc["url"] for loc in body.get("locations", [])]
+        if urls:
+            self._vid_cache[vid] = (urls, time.time())
+        return urls
+
+    async def _assign(self, collection: str, replication: str,
+                      ttl: str) -> dict:
+        params = {"collection": collection, "replication": replication,
+                  "ttl": ttl}
+        async with self._session.get(
+                f"http://{self.master_url}/dir/assign",
+                params={k: v for k, v in params.items() if v}) as r:
+            body = await r.json()
+        if "error" in body:
+            raise web.HTTPInternalServerError(text=body["error"])
+        return body
+
+    async def _upload_chunk(self, data: bytes, collection: str,
+                            replication: str, ttl: str,
+                            offset: int) -> FileChunk:
+        a = await self._assign(collection, replication, ttl)
+        form = aiohttp.FormData()
+        form.add_field("file", data, filename="chunk",
+                       content_type="application/octet-stream")
+        url = f"http://{a['url']}/{a['fid']}"
+        if ttl:
+            url += f"?ttl={ttl}"
+        async with self._session.post(url, data=form) as r:
+            if r.status >= 300:
+                raise web.HTTPBadGateway(
+                    text=f"chunk upload to {a['url']}: {r.status}")
+            body = await r.json()
+        return FileChunk(fid=a["fid"], offset=offset, size=len(data),
+                         mtime=time.time_ns(), etag=body.get("eTag", ""))
+
+    async def _fetch_view(self, fid: str, offset_in_chunk: int,
+                          size: int) -> bytes:
+        vid = int(fid.split(",")[0])
+        last: Optional[Exception] = None
+        for url in await self._lookup(vid):
+            headers = {"Range":
+                       f"bytes={offset_in_chunk}-"
+                       f"{offset_in_chunk + size - 1}"}
+            try:
+                async with self._session.get(f"http://{url}/{fid}",
+                                             headers=headers) as r:
+                    if r.status in (200, 206):
+                        data = await r.read()
+                        if r.status == 200:
+                            data = data[offset_in_chunk:offset_in_chunk + size]
+                        return data
+                    last = RuntimeError(f"{url}/{fid}: HTTP {r.status}")
+            except aiohttp.ClientError as e:
+                last = e
+        raise web.HTTPBadGateway(text=f"fetch chunk {fid}: {last}")
+
+    # --- request dispatch ---
+    async def dispatch(self, request: web.Request) -> web.StreamResponse:
+        path = "/" + request.match_info["path"]
+        if request.method in ("GET", "HEAD"):
+            return await self.handle_read(request, path)
+        if request.method in ("PUT", "POST"):
+            if request.query.get("op") == "mkdir":
+                return await self.handle_mkdir(request, path)
+            if "mv.to" in request.query:
+                return await self.handle_rename(request, path)
+            return await self.handle_write(request, path)
+        if request.method == "DELETE":
+            return await self.handle_delete(request, path)
+        return web.json_response({"error": "method not allowed"}, status=405)
+
+    async def handle_read(self, request: web.Request,
+                          path: str) -> web.StreamResponse:
+        self.metrics.count("read")
+        entry = await asyncio.get_event_loop().run_in_executor(
+            None, self.filer.find_entry, path)
+        if entry is None:
+            return web.json_response({"error": "not found"}, status=404)
+        if entry.is_directory:
+            return await self._list_dir(request, path)
+        size = entry.size()
+        file_etag = f'"{chunks_etag(entry.chunks)}"' if entry.chunks else '""'
+        if request.headers.get("If-None-Match") == file_etag:
+            return web.Response(status=304)
+        start, length, status = 0, size, 200
+        headers = {"ETag": file_etag, "Accept-Ranges": "bytes"}
+        rng = request.headers.get("Range", "")
+        if rng.startswith("bytes="):
+            try:
+                s, _, e = rng[6:].partition("-")
+                if not s:
+                    length = min(int(e), size)
+                    start = size - length
+                else:
+                    start = int(s)
+                    end = min(int(e) if e else size - 1, size - 1)
+                    length = end - start + 1
+                if start < 0 or length <= 0:
+                    raise ValueError
+                status = 206
+                headers["Content-Range"] = (
+                    f"bytes {start}-{start + length - 1}/{size}")
+            except ValueError:
+                return web.Response(status=416)
+        mime = entry.attr.mime or "application/octet-stream"
+        resp = web.StreamResponse(status=status, headers={
+            **headers, "Content-Type": mime,
+            "Content-Length": str(length)})
+        await resp.prepare(request)
+        if request.method == "HEAD" or length == 0:
+            await resp.write_eof()
+            return resp
+        plan = read_plan(entry.chunks, start, length)
+        written = start
+        for view in plan:
+            if view.logic_offset > written:
+                # sparse hole: zero-fill
+                await resp.write(bytes(view.logic_offset - written))
+                written = view.logic_offset
+            data = await self._fetch_view(view.fid, view.offset_in_chunk,
+                                          view.size)
+            await resp.write(data)
+            written += len(data)
+        if written < start + length:
+            await resp.write(bytes(start + length - written))
+        await resp.write_eof()
+        return resp
+
+    async def _list_dir(self, request: web.Request,
+                        path: str) -> web.Response:
+        q = request.query
+        limit = int(q.get("limit", 1024))
+        entries = await asyncio.get_event_loop().run_in_executor(
+            None, lambda: self.filer.list_directory(
+                path, q.get("lastFileName", ""), False, limit,
+                q.get("prefix", "")))
+        return web.json_response({
+            "Path": _norm(path),
+            "Entries": [{
+                "FullPath": e.full_path,
+                "IsDirectory": e.is_directory,
+                "Size": e.size(),
+                "Mtime": e.attr.mtime,
+                "Mime": e.attr.mime,
+                "Chunks": len(e.chunks),
+            } for e in entries],
+            "LastFileName": entries[-1].name if entries else "",
+            "ShouldDisplayLoadMore": len(entries) >= limit,
+        })
+
+    async def handle_write(self, request: web.Request,
+                           path: str) -> web.Response:
+        """Auto-chunking upload (filer_server_handlers_write_autochunk.go)."""
+        self.metrics.count("write")
+        if path.endswith("/"):
+            return web.json_response({"error": "cannot write a directory"},
+                                     status=400)
+        collection = request.query.get("collection",
+                                       self.default_collection)
+        replication = request.query.get("replication",
+                                        self.default_replication)
+        ttl = request.query.get("ttl", "")
+        mime = request.content_type or "application/octet-stream"
+
+        reader = None
+        if request.content_type.startswith("multipart/"):
+            mp = await request.multipart()
+            part = await mp.next()
+            if part is None:
+                return web.json_response({"error": "empty multipart"},
+                                         status=400)
+            if part.headers.get("Content-Type"):
+                mime = part.headers["Content-Type"]
+            reader = part
+        chunks: list[FileChunk] = []
+        offset = 0
+        old_entry = await asyncio.get_event_loop().run_in_executor(
+            None, self.filer.find_entry, path)
+        try:
+            while True:
+                # accumulate a full chunk: both aiohttp readers return
+                # whatever is buffered, not the requested size
+                buf = bytearray()
+                while len(buf) < self.chunk_size:
+                    want = self.chunk_size - len(buf)
+                    more = (await reader.read_chunk(want) if reader is not None
+                            else await request.content.read(want))
+                    if not more:
+                        break
+                    buf += more
+                data = bytes(buf)
+                if not data:
+                    break
+                chunks.append(await self._upload_chunk(
+                    bytes(data), collection, replication, ttl, offset))
+                offset += len(data)
+        except web.HTTPError:
+            # clean up whatever we uploaded
+            self._queue_chunk_deletes(chunks)
+            raise
+        entry = new_file(_norm(path), chunks, mime=mime,
+                         collection=collection, replication=replication)
+        if request.query.get("ttl"):
+            from ..storage.types import TTL
+            entry.attr.ttl_sec = TTL.parse(ttl).minutes() * 60
+        await asyncio.get_event_loop().run_in_executor(
+            None, self.filer.create_entry, entry)
+        if old_entry is not None and old_entry.chunks:
+            self._queue_chunk_deletes(old_entry.chunks)
+        return web.json_response(
+            {"name": entry.name, "size": offset,
+             "chunks": len(chunks)}, status=201)
+
+    async def handle_mkdir(self, request: web.Request,
+                           path: str) -> web.Response:
+        entry = new_directory(_norm(path))
+        await asyncio.get_event_loop().run_in_executor(
+            None, self.filer.create_entry, entry)
+        return web.json_response({"name": entry.full_path}, status=201)
+
+    async def handle_rename(self, request: web.Request,
+                            path: str) -> web.Response:
+        to = request.query["mv.to"]
+        try:
+            await asyncio.get_event_loop().run_in_executor(
+                None, self.filer.rename, path, to)
+        except FileNotFoundError:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response({"from": _norm(path), "to": _norm(to)})
+
+    async def handle_delete(self, request: web.Request,
+                            path: str) -> web.Response:
+        self.metrics.count("delete")
+        recursive = request.query.get("recursive") == "true"
+        try:
+            await asyncio.get_event_loop().run_in_executor(
+                None, lambda: self.filer.delete_entry(path,
+                                                      recursive=recursive))
+        except FileNotFoundError:
+            return web.json_response({"error": "not found"}, status=404)
+        except OSError as e:
+            return web.json_response({"error": str(e)}, status=409)
+        return web.json_response({"ok": True}, status=202)
+
+    async def metrics_handler(self, request: web.Request) -> web.Response:
+        return web.Response(text=self.metrics.render(),
+                            content_type="text/plain")
+
+
+async def run_filer(host: str, port: int, master_url: str,
+                    **kwargs) -> web.AppRunner:
+    server = FilerServer(master_url, **kwargs)
+    runner = web.AppRunner(server.app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    log.info("filer on %s:%d -> master %s", host, port, master_url)
+    return runner

@@ -49,6 +49,7 @@ class Cluster:
         self.tmpdirs: list[tempfile.TemporaryDirectory] = []
         self.volume_servers: list[VolumeServer] = []
         self.runners: list = []
+        self._vs_runners: list = []
         self._start()
 
     def _loop_main(self) -> None:
@@ -58,6 +59,20 @@ class Cluster:
     def call(self, coro, timeout: float = 60.0):
         return asyncio.run_coroutine_threadsafe(coro, self.loop) \
             .result(timeout)
+
+    def serve(self, app, port: int):
+        """Boot an aiohttp app on the background loop; returns its runner.
+        All runner bookkeeping goes through here so indices stay coherent."""
+        from aiohttp import web
+
+        async def boot():
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", port)
+            await site.start()
+            return runner
+
+        return self.call(boot())
 
     def _start(self) -> None:
         from aiohttp import web
@@ -69,14 +84,7 @@ class Cluster:
             default_replication=self.default_replication,
             pulse_seconds=self.pulse)
 
-        async def boot_master():
-            runner = web.AppRunner(self.master.app)
-            await runner.setup()
-            site = web.TCPSite(runner, "127.0.0.1", self.master_port)
-            await site.start()
-            return runner
-
-        self.runners.append(self.call(boot_master()))
+        self.runners.append(self.serve(self.master.app, self.master_port))
 
         for i in range(self.n):
             self.add_volume_server()
@@ -97,25 +105,33 @@ class Cluster:
                           rack=rack or f"rack{len(self.volume_servers) % 2}",
                           pulse_seconds=self.pulse)
 
-        async def boot():
-            runner = web.AppRunner(vs.app)
-            await runner.setup()
-            site = web.TCPSite(runner, "127.0.0.1", port)
-            await site.start()
-            return runner
-
-        self.runners.append(self.call(boot()))
+        runner = self.serve(vs.app, port)
+        self.runners.append(runner)
+        self._vs_runners.append(runner)
         self.volume_servers.append(vs)
         return vs
 
+    def add_filer(self, store_name: str = "memory",
+                  chunk_size: int = 16 * 1024):
+        from aiohttp import web
+
+        from seaweedfs_tpu.server.filer_server import FilerServer
+
+        port = free_port()
+        fs = FilerServer(self.master_url, store_name=store_name,
+                         chunk_size=chunk_size)
+
+        self.runners.append(self.serve(fs.app, port))
+        fs.url = f"127.0.0.1:{port}"
+        return fs
+
     def stop_volume_server(self, index: int) -> None:
         vs = self.volume_servers[index]
+        runner = self._vs_runners[index]
 
         async def halt():
             if vs._hb_task:
                 vs._hb_task.cancel()
-            # find its runner (master is runners[0])
-            runner = self.runners[index + 1]
             await runner.cleanup()
 
         self.call(halt())

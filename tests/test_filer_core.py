@@ -1,0 +1,256 @@
+"""Filer logic tests: chunk algebra (modeled on the reference's
+filechunks_test.go randomized/merge tests), stores, namespace core."""
+
+import random
+import sqlite3
+
+import pytest
+
+from seaweedfs_tpu.filer.chunks import (FileChunk, compact_chunks, etag,
+                                        non_overlapping_visible_intervals,
+                                        read_plan, total_size)
+from seaweedfs_tpu.filer.entry import new_directory, new_file
+from seaweedfs_tpu.filer.filer import Filer
+from seaweedfs_tpu.filer.stores import MemoryStore, SqliteStore, create_store
+
+
+# ---------- chunk algebra ----------
+
+def test_single_chunk():
+    chunks = [FileChunk("1,ab", 0, 100, mtime=1)]
+    v = non_overlapping_visible_intervals(chunks)
+    assert len(v) == 1 and (v[0].start, v[0].stop) == (0, 100)
+    plan = read_plan(chunks, 10, 50)
+    assert len(plan) == 1
+    assert plan[0].offset_in_chunk == 10 and plan[0].size == 50
+
+
+def test_full_overwrite():
+    chunks = [FileChunk("1,a", 0, 100, mtime=1),
+              FileChunk("2,b", 0, 100, mtime=2)]
+    v = non_overlapping_visible_intervals(chunks)
+    assert len(v) == 1 and v[0].fid == "2,b"
+    live, garbage = compact_chunks(chunks)
+    assert [c.fid for c in live] == ["2,b"]
+    assert [c.fid for c in garbage] == ["1,a"]
+
+
+def test_partial_overwrite_middle():
+    chunks = [FileChunk("1,a", 0, 100, mtime=1),
+              FileChunk("2,b", 30, 40, mtime=2)]
+    v = non_overlapping_visible_intervals(chunks)
+    assert [(x.start, x.stop, x.fid) for x in v] == [
+        (0, 30, "1,a"), (30, 70, "2,b"), (70, 100, "1,a")]
+    plan = read_plan(chunks, 20, 60)
+    assert [(p.fid, p.offset_in_chunk, p.size, p.logic_offset)
+            for p in plan] == [
+        ("1,a", 20, 10, 20), ("2,b", 0, 40, 30), ("1,a", 70, 10, 70)]
+
+
+def test_append_chunks():
+    chunks = [FileChunk("1,a", 0, 100, mtime=1),
+              FileChunk("2,b", 100, 50, mtime=2)]
+    assert total_size(chunks) == 150
+    v = non_overlapping_visible_intervals(chunks)
+    assert len(v) == 2
+
+
+def test_sparse_file_hole():
+    chunks = [FileChunk("1,a", 0, 10, mtime=1),
+              FileChunk("2,b", 100, 10, mtime=2)]
+    assert total_size(chunks) == 110
+    plan = read_plan(chunks, 0, 110)
+    assert [(p.logic_offset, p.size) for p in plan] == [(0, 10), (100, 10)]
+
+
+def test_randomized_overwrites_differential():
+    """Write random ranges into a reference bytearray and via the chunk
+    algebra; reads must agree (the reference's randomized test pattern)."""
+    rng = random.Random(0)
+    size = 1000
+    truth = bytearray(size)
+    data_by_fid = {}
+    chunks = []
+    for i in range(60):
+        off = rng.randrange(0, size - 1)
+        ln = rng.randrange(1, size - off)
+        fid = f"9,{i:04x}0000"
+        payload = bytes([rng.randrange(1, 256)]) * ln
+        truth[off:off + ln] = payload
+        data_by_fid[fid] = payload
+        chunks.append(FileChunk(fid, off, ln, mtime=i + 1))
+
+    for _ in range(50):
+        off = rng.randrange(0, size - 1)
+        ln = rng.randrange(1, size - off)
+        got = bytearray(ln)
+        for view in read_plan(chunks, off, ln):
+            piece = data_by_fid[view.fid][
+                view.offset_in_chunk:view.offset_in_chunk + view.size]
+            got[view.logic_offset - off:
+                view.logic_offset - off + view.size] = piece
+        assert bytes(got) == bytes(truth[off:off + ln]), (off, ln)
+
+
+def test_etag_aggregation():
+    one = [FileChunk("1,a", 0, 10, etag="abcd")]
+    assert etag(one) == "abcd"
+    two = one + [FileChunk("2,b", 10, 10, etag="ef01")]
+    assert etag(two).endswith("-2")
+
+
+# ---------- stores ----------
+
+@pytest.mark.parametrize("make_store", [
+    lambda tmp: MemoryStore(),
+    lambda tmp: SqliteStore(path=str(tmp / "filer.db")),
+])
+def test_store_contract(tmp_path, make_store):
+    s = make_store(tmp_path)
+    s.insert_entry(new_directory("/d"))
+    for name in ["b.txt", "a.txt", "c.txt"]:
+        s.insert_entry(new_file(f"/d/{name}"))
+    assert s.find_entry("/d/a.txt") is not None
+    assert s.find_entry("/d/zzz") is None
+
+    names = [e.name for e in s.list_directory_entries("/d")]
+    assert names == ["a.txt", "b.txt", "c.txt"]
+    # pagination
+    page = s.list_directory_entries("/d", start_file_name="a.txt",
+                                    include_start=False, limit=1)
+    assert [e.name for e in page] == ["b.txt"]
+    # prefix
+    assert [e.name for e in s.list_directory_entries("/d", prefix="c")] == \
+        ["c.txt"]
+
+    s.delete_entry("/d/b.txt")
+    assert s.find_entry("/d/b.txt") is None
+
+    s.insert_entry(new_directory("/d/sub"))
+    s.insert_entry(new_file("/d/sub/x"))
+    s.delete_folder_children("/d")
+    assert s.find_entry("/d/sub/x") is None
+    assert s.find_entry("/d") is not None  # the dir itself survives
+
+    s.kv_put("k1", b"v1")
+    assert s.kv_get("k1") == b"v1"
+    assert s.kv_get("nope") is None
+    s.close()
+
+
+def test_sqlite_store_persistence(tmp_path):
+    p = str(tmp_path / "f.db")
+    s = SqliteStore(path=p)
+    s.insert_entry(new_file("/a/b/c.txt",
+                            [FileChunk("3,abc", 0, 42, etag="e")]))
+    s.close()
+    s2 = SqliteStore(path=p)
+    e = s2.find_entry("/a/b/c.txt")
+    assert e is not None and e.chunks[0].fid == "3,abc"
+    assert e.chunks[0].size == 42
+    s2.close()
+
+
+# ---------- filer core ----------
+
+def make_filer():
+    deleted = []
+    f = Filer(MemoryStore(), on_delete_chunks=deleted.extend)
+    return f, deleted
+
+
+def test_filer_create_with_parents():
+    f, _ = make_filer()
+    f.create_entry(new_file("/a/b/c/file.txt"))
+    assert f.find_entry("/a").is_directory
+    assert f.find_entry("/a/b/c").is_directory
+    assert not f.find_entry("/a/b/c/file.txt").is_directory
+    listing = f.list_directory("/a/b/c")
+    assert [e.name for e in listing] == ["file.txt"]
+
+
+def test_filer_recursive_delete_frees_chunks():
+    f, deleted = make_filer()
+    f.create_entry(new_file("/x/1", [FileChunk("1,a", 0, 10)]))
+    f.create_entry(new_file("/x/sub/2", [FileChunk("2,b", 0, 20)]))
+    with pytest.raises(OSError):
+        f.delete_entry("/x", recursive=False)
+    f.delete_entry("/x", recursive=True)
+    assert f.find_entry("/x") is None
+    assert {c.fid for c in deleted} == {"1,a", "2,b"}
+
+
+def test_filer_rename_tree():
+    f, _ = make_filer()
+    f.create_entry(new_file("/src/d/f1", [FileChunk("1,a", 0, 5)]))
+    f.create_entry(new_file("/src/f2"))
+    f.rename("/src", "/dst")
+    assert f.find_entry("/src") is None
+    assert f.find_entry("/dst/d/f1").chunks[0].fid == "1,a"
+    assert f.find_entry("/dst/f2") is not None
+
+
+def test_filer_events():
+    f, _ = make_filer()
+    seen = []
+    f.meta_log.subscribe(seen.append)
+    f.create_entry(new_file("/ev/file"))
+    f.delete_entry("/ev/file")
+    kinds = [(e.old_entry is not None, e.new_entry is not None)
+             for e in seen]
+    # mkdir /ev, create file, delete file
+    assert (False, True) in kinds and (True, False) in kinds
+    assert f.meta_log.events_since(0, "/ev")
+
+
+def test_filer_excl_and_type_conflicts():
+    f, _ = make_filer()
+    f.create_entry(new_file("/p/f"))
+    with pytest.raises(FileExistsError):
+        f.create_entry(new_file("/p/f"), o_excl=True)
+    f.create_entry(new_directory("/p/d"))
+    with pytest.raises(IsADirectoryError):
+        f.create_entry(new_file("/p/d"))
+    with pytest.raises(NotADirectoryError):
+        f.create_entry(new_file("/p/f/under-file"))
+
+
+def test_rename_rollback_on_failure(tmp_path):
+    """A mid-rename store failure must leave the namespace unchanged
+    (review regression: transaction hooks were no-ops)."""
+    s = SqliteStore(path=str(tmp_path / "txn.db"))
+    f = Filer(s)
+    f.create_entry(new_file("/t/a/f1"))
+    f.create_entry(new_file("/t/a/f2"))
+
+    real_insert = s.insert_entry
+    calls = {"n": 0}
+
+    def failing_insert(entry):
+        calls["n"] += 1
+        if calls["n"] >= 2 and entry.full_path.startswith("/t/b"):
+            raise sqlite3.OperationalError("disk I/O error (injected)")
+        real_insert(entry)
+
+    s.insert_entry = failing_insert
+    with pytest.raises(sqlite3.OperationalError):
+        f.rename("/t/a", "/t/b")
+    s.insert_entry = real_insert
+    # nothing moved, nothing lost
+    assert f.find_entry("/t/a/f1") is not None
+    assert f.find_entry("/t/a/f2") is not None
+    assert f.find_entry("/t/b") is None
+    s.close()
+
+
+def test_sqlite_prefix_with_special_chars(tmp_path):
+    s = SqliteStore(path=str(tmp_path / "p.db"))
+    s.insert_entry(new_file("/d/my_file.txt"))
+    s.insert_entry(new_file("/d/myXfile.txt"))
+    s.insert_entry(new_file("/d/100%.txt"))
+    # '_' must be literal, not a wildcard
+    assert [e.name for e in s.list_directory_entries("/d", prefix="my_")] == \
+        ["my_file.txt"]
+    assert [e.name for e in s.list_directory_entries("/d", prefix="100%")] == \
+        ["100%.txt"]
+    s.close()

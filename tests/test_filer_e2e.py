@@ -1,0 +1,133 @@
+"""Filer HTTP API end-to-end against a live in-process cluster."""
+
+import json
+import random
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cluster_util import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(n_volume_servers=2, pulse=0.15)
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def filer(cluster):
+    # tiny chunk size so multi-chunk files are cheap to produce
+    return cluster.add_filer(chunk_size=16 * 1024)
+
+
+def _put(filer, path, data, ctype="application/octet-stream", query=""):
+    req = urllib.request.Request(
+        f"http://{filer.url}{path}{query}", data=data, method="PUT",
+        headers={"Content-Type": ctype})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.load(r)
+
+
+def _get(filer, path, headers=None):
+    req = urllib.request.Request(f"http://{filer.url}{path}",
+                                 headers=headers or {})
+    return urllib.request.urlopen(req, timeout=60)
+
+
+def test_small_file_roundtrip(filer):
+    out = _put(filer, "/docs/hello.txt", b"hello filer",
+               ctype="text/plain")
+    assert out["chunks"] == 1
+    with _get(filer, "/docs/hello.txt") as r:
+        assert r.read() == b"hello filer"
+        assert r.headers["Content-Type"] == "text/plain"
+
+
+def test_multichunk_file_and_range(filer):
+    rng = random.Random(3)
+    payload = rng.randbytes(70 * 1024)  # > 4 chunks at 16KB
+    out = _put(filer, "/big/blob.bin", payload)
+    assert out["chunks"] == 5
+    with _get(filer, "/big/blob.bin") as r:
+        got = r.read()
+    assert got == payload
+    # range crossing chunk boundaries
+    with _get(filer, "/big/blob.bin",
+              {"Range": "bytes=15000-40000"}) as r:
+        assert r.status == 206
+        assert r.read() == payload[15000:40001]
+    # suffix range
+    with _get(filer, "/big/blob.bin", {"Range": "bytes=-1000"}) as r:
+        assert r.read() == payload[-1000:]
+
+
+def test_overwrite_frees_old_chunks(cluster, filer):
+    rng = random.Random(4)
+    a = rng.randbytes(40 * 1024)
+    b = rng.randbytes(20 * 1024)
+    _put(filer, "/ow/f.bin", a)
+    _put(filer, "/ow/f.bin", b)
+    with _get(filer, "/ow/f.bin") as r:
+        assert r.read() == b
+    cluster.wait_heartbeats()  # let the deletion queue drain
+
+
+def test_directory_listing_and_pagination(filer):
+    for name in ["a", "b", "c", "d"]:
+        _put(filer, f"/listdir/{name}.txt", name.encode())
+    with _get(filer, "/listdir/?limit=2") as r:
+        body = json.load(r)
+    assert [e["FullPath"] for e in body["Entries"]] == \
+        ["/listdir/a.txt", "/listdir/b.txt"]
+    assert body["ShouldDisplayLoadMore"]
+    with _get(filer, f"/listdir/?limit=2&lastFileName=b.txt") as r:
+        body = json.load(r)
+    assert [e["FullPath"] for e in body["Entries"]] == \
+        ["/listdir/c.txt", "/listdir/d.txt"]
+
+
+def test_rename_and_delete(filer):
+    _put(filer, "/mv/src/data.bin", b"move me")
+    req = urllib.request.Request(
+        f"http://{filer.url}/mv/src?mv.to=/mv/dst", method="POST")
+    with urllib.request.urlopen(req) as r:
+        assert r.status == 200
+    with _get(filer, "/mv/dst/data.bin") as r:
+        assert r.read() == b"move me"
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(filer, "/mv/src/data.bin")
+    assert e.value.code == 404
+
+    # non-recursive delete of a non-empty dir is refused
+    req = urllib.request.Request(f"http://{filer.url}/mv/dst",
+                                 method="DELETE")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req)
+    assert e.value.code == 409
+    req = urllib.request.Request(
+        f"http://{filer.url}/mv/dst?recursive=true", method="DELETE")
+    with urllib.request.urlopen(req) as r:
+        assert r.status == 202
+    with pytest.raises(urllib.error.HTTPError):
+        _get(filer, "/mv/dst/data.bin")
+
+
+def test_mkdir(filer):
+    req = urllib.request.Request(
+        f"http://{filer.url}/empty/dir?op=mkdir", method="POST")
+    with urllib.request.urlopen(req) as r:
+        assert r.status == 201
+    with _get(filer, "/empty/dir/") as r:
+        assert json.load(r)["Entries"] == []
+
+
+def test_etag_304(filer):
+    _put(filer, "/etag/f", b"etag body")
+    with _get(filer, "/etag/f") as r:
+        et = r.headers["ETag"]
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(filer, "/etag/f", {"If-None-Match": et})
+    assert e.value.code == 304
