@@ -159,6 +159,18 @@ def cmd_shell(args) -> None:
         raise SystemExit(f"unknown shell op {op}")
 
 
+def cmd_compact(args) -> None:
+    """Offline vacuum of one volume (weed compact, weed/command/compact.go)."""
+    from .storage.volume import Volume
+    v = Volume(args.dir, args.collection, args.volumeId)
+    before = v.data_file_size()
+    v.compact()
+    after = v.data_file_size()
+    v.close()
+    print(json.dumps({"volume": args.volumeId, "bytes_before": before,
+                      "bytes_after": after, "reclaimed": before - after}))
+
+
 def cmd_status(args) -> None:
     from .client import Client
     print(json.dumps(Client(args.server).cluster_status(), indent=2))
@@ -291,6 +303,12 @@ def build_parser() -> argparse.ArgumentParser:
     sh.add_argument("-ec_large_block", type=int, default=1024 * 1024 * 1024)
     sh.add_argument("-ec_small_block", type=int, default=1024 * 1024)
     sh.set_defaults(fn=cmd_shell)
+
+    cp = sub.add_parser("compact", help="offline vacuum of one volume")
+    cp.add_argument("-dir", default="./data")
+    cp.add_argument("-collection", default="")
+    cp.add_argument("-volumeId", type=int, required=True)
+    cp.set_defaults(fn=cmd_compact)
 
     st = sub.add_parser("status", help="cluster status")
     st.add_argument("-server", default="127.0.0.1:9333")

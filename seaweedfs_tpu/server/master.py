@@ -38,14 +38,18 @@ class MasterServer:
     def __init__(self, volume_size_limit_mb: int = 30 * 1024,
                  default_replication: str = "000",
                  pulse_seconds: float = 5.0,
-                 garbage_threshold: float = 0.3):
+                 garbage_threshold: float = 0.3,
+                 vacuum_interval_seconds: float = 900.0):
         self.topology = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds)
         self.sequencer = MemorySequencer()
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
+        self.vacuum_interval_seconds = vacuum_interval_seconds
         self._grow_lock = asyncio.Lock()
+        self._vacuum_lock = asyncio.Lock()
+        self._vacuum_task: Optional[asyncio.Task] = None
         self.metrics = metrics_mod.Registry("master")
         self.app = self._build_app()
 
@@ -55,12 +59,23 @@ class MasterServer:
         app.router.add_get("/dir/lookup", self.dir_lookup)
         app.router.add_get("/dir/status", self.dir_status)
         app.router.add_get("/vol/grow", self.vol_grow)
+        app.router.add_get("/vol/vacuum", self.vol_vacuum)
         app.router.add_get("/col/lookup/ec", self.ec_lookup)
         app.router.add_post("/heartbeat", self.heartbeat)
         app.router.add_get("/cluster/status", self.cluster_status)
         app.router.add_get("/metrics", self.metrics_handler)
         app.router.add_get("/healthz", _healthz)
+        app.on_startup.append(self._on_startup)
+        app.on_cleanup.append(self._on_cleanup)
         return app
+
+    async def _on_startup(self, app) -> None:
+        if self.vacuum_interval_seconds > 0:
+            self._vacuum_task = asyncio.create_task(self._vacuum_loop())
+
+    async def _on_cleanup(self, app) -> None:
+        if self._vacuum_task:
+            self._vacuum_task.cancel()
 
     # --- handlers ---
     async def dir_assign(self, request: web.Request) -> web.Response:
@@ -188,6 +203,89 @@ class MasterServer:
                 grown.append(vid)
                 self.metrics.count("volumes_grown")
         return grown
+
+    async def vol_vacuum(self, request: web.Request) -> web.Response:
+        """Manual vacuum trigger (master /vol/vacuum): compacts every volume
+        whose garbage level exceeds the threshold on all replicas."""
+        threshold = float(
+            request.query.get("garbageThreshold", self.garbage_threshold))
+        done = await self._vacuum_pass(threshold)
+        return web.json_response({"ok": True, "compacted": done})
+
+    async def _vacuum_loop(self) -> None:
+        """Periodic vacuum scan (weed/topology/topology_vacuum.go:17-171,
+        kicked every 15min from topology_event_handling.go:12)."""
+        while True:
+            await asyncio.sleep(self.vacuum_interval_seconds)
+            try:
+                await self._vacuum_pass(self.garbage_threshold)
+            except Exception as e:
+                log.warning("vacuum pass failed: %s", e)
+
+    async def _vacuum_pass(self, threshold: float) -> list[int]:
+        """One orchestrated cycle per over-threshold volume: check all
+        replicas -> compact all (concurrent writes replayed server-side) ->
+        commit all; volume is parked in layout.vacuuming for the cycle so
+        heartbeats can't re-add it to the writable set
+        (batchVacuumVolumeCompact/Commit, topology_vacuum.go:17-103).
+        Passes are serialized; a failure on one volume never aborts the
+        rest of the scan."""
+        import aiohttp
+        compacted: list[int] = []
+        async with self._vacuum_lock, aiohttp.ClientSession() as s:
+            for layout in list(self.topology.layouts.values()):
+                for vid, nodes in list(layout.locations.items()):
+                    if not nodes:
+                        continue
+                    try:
+                        if await self._vacuum_one(
+                                s, layout, vid, [n.url for n in nodes],
+                                threshold):
+                            compacted.append(vid)
+                            self.metrics.count("volumes_vacuumed")
+                    except Exception as e:
+                        log.warning("vacuum of volume %d failed: %s", vid, e)
+        return compacted
+
+    async def _vacuum_one(self, s, layout, vid: int, urls: list[str],
+                          threshold: float) -> bool:
+        levels = []
+        for u in urls:
+            async with s.get(f"http://{u}/admin/vacuum/check",
+                             params={"volume_id": str(vid)}) as r:
+                if r.status != 200:
+                    return False
+                levels.append((await r.json())["garbage_level"])
+        if not levels or min(levels) < threshold:
+            return False
+        layout.vacuuming.add(vid)
+        was_writable = vid in layout.writable
+        layout.writable.discard(vid)
+        try:
+            ok = True
+            for u in urls:
+                async with s.post(f"http://{u}/admin/vacuum/compact",
+                                  json={"volume_id": vid}) as r:
+                    ok = ok and r.status == 200
+            if ok:
+                for u in urls:
+                    async with s.post(f"http://{u}/admin/vacuum/commit",
+                                      json={"volume_id": vid}) as r:
+                        ok = ok and r.status == 200
+            if not ok:
+                # roll back stragglers; replicas that already committed
+                # treat cleanup as a no-op
+                for u in urls:
+                    try:
+                        await s.post(f"http://{u}/admin/vacuum/cleanup",
+                                     json={"volume_id": vid})
+                    except Exception:
+                        pass
+            return ok
+        finally:
+            layout.vacuuming.discard(vid)
+            if was_writable:
+                layout.writable.add(vid)
 
     async def ec_lookup(self, request: web.Request) -> web.Response:
         """LookupEcVolume (weed/server/master_grpc_server_volume.go:148)."""

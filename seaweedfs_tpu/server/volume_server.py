@@ -74,6 +74,12 @@ class VolumeServer:
         app = web.Application(client_max_size=256 * 1024 * 1024)
         app.router.add_post("/admin/assign_volume", self.admin_assign_volume)
         app.router.add_post("/admin/vacuum", self.admin_vacuum)
+        app.router.add_get("/admin/vacuum/check", self.admin_vacuum_check)
+        app.router.add_post("/admin/vacuum/compact",
+                            self.admin_vacuum_compact)
+        app.router.add_post("/admin/vacuum/commit", self.admin_vacuum_commit)
+        app.router.add_post("/admin/vacuum/cleanup",
+                            self.admin_vacuum_cleanup)
         app.router.add_post("/admin/volume/delete", self.admin_volume_delete)
         app.router.add_post("/admin/volume/readonly", self.admin_readonly)
         app.router.add_post("/admin/ec/generate", self.admin_ec_generate)
@@ -110,6 +116,10 @@ class VolumeServer:
     async def _heartbeat_loop(self) -> None:
         while True:
             try:
+                expired = await asyncio.get_event_loop().run_in_executor(
+                    None, self.store.delete_expired_volumes)
+                if expired:
+                    log.info("deleted expired TTL volumes %s", expired)
                 await self.send_heartbeat()
             except Exception as e:
                 log.warning("heartbeat to %s failed: %s", self.master_url, e)
@@ -413,6 +423,56 @@ class VolumeServer:
         garbage = v.garbage_level()
         await asyncio.get_event_loop().run_in_executor(None, v.compact)
         return web.json_response({"ok": True, "garbage_level": garbage})
+
+    async def admin_vacuum_check(self, request: web.Request) -> web.Response:
+        """VacuumVolumeCheck (weed/server/volume_grpc_vacuum.go): report the
+        garbage ratio so the master can decide whether to compact."""
+        try:
+            garbage = self.store.vacuum_check(
+                int(request.query["volume_id"]))
+        except KeyError:
+            return web.json_response({"error": "volume not found"},
+                                     status=404)
+        return web.json_response({"garbage_level": garbage})
+
+    async def admin_vacuum_compact(self,
+                                   request: web.Request) -> web.Response:
+        body = await request.json()
+        vid = int(body["volume_id"])
+        rate = int(body.get("compaction_byte_per_second", 0))
+        try:
+            await asyncio.get_event_loop().run_in_executor(
+                None, lambda: self.store.vacuum_compact(vid, rate))
+        except KeyError:
+            return web.json_response({"error": "volume not found"},
+                                     status=404)
+        except RuntimeError as e:
+            return web.json_response({"error": str(e)}, status=409)
+        return web.json_response({"ok": True})
+
+    async def admin_vacuum_commit(self,
+                                  request: web.Request) -> web.Response:
+        body = await request.json()
+        vid = int(body["volume_id"])
+        try:
+            await asyncio.get_event_loop().run_in_executor(
+                None, lambda: self.store.vacuum_commit(vid))
+        except KeyError:
+            return web.json_response({"error": "volume not found"},
+                                     status=404)
+        except RuntimeError as e:
+            return web.json_response({"error": str(e)}, status=409)
+        return web.json_response({"ok": True})
+
+    async def admin_vacuum_cleanup(self,
+                                   request: web.Request) -> web.Response:
+        body = await request.json()
+        try:
+            self.store.vacuum_cleanup(int(body["volume_id"]))
+        except KeyError:
+            return web.json_response({"error": "volume not found"},
+                                     status=404)
+        return web.json_response({"ok": True})
 
     async def admin_volume_delete(self, request: web.Request) -> web.Response:
         body = await request.json()

@@ -155,6 +155,44 @@ class Store:
         v.read_only = read_only
         return True
 
+    # --- vacuum (VacuumVolume{Check,Compact,Commit,Cleanup},
+    #     weed/server/volume_grpc_vacuum.go) ---
+    def vacuum_check(self, vid: int) -> float:
+        v = self.find_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        return v.garbage_level()
+
+    def vacuum_compact(self, vid: int,
+                       compaction_bytes_per_second: int = 0) -> None:
+        v = self.find_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        v.begin_compact(compaction_bytes_per_second)
+
+    def vacuum_commit(self, vid: int) -> None:
+        v = self.find_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        v.commit_compact()
+
+    def vacuum_cleanup(self, vid: int) -> None:
+        v = self.find_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        v.cleanup_compact()
+
+    def delete_expired_volumes(self, max_delay_minutes: int = 10) -> list[int]:
+        """Drop TTL volumes whose grace period has passed
+        (Store.DeleteExpiredVolumes semantics)."""
+        expired = [vid for loc in self.locations
+                   for vid, v in list(loc.volumes.items())
+                   if v.is_expired() and
+                   v.is_expired_long_enough(max_delay_minutes)]
+        for vid in expired:
+            self.delete_volume(vid)
+        return expired
+
     # --- needle ops ---
     def write_needle(self, vid: int, n: Needle) -> tuple[int, int, bool]:
         v = self.find_volume(vid)

@@ -46,6 +46,9 @@ class Volume:
         self.last_append_at_ns = 0
         self.last_modified_ts = 0
         self._lock = threading.RLock()
+        self._compacting = False
+        self._compact_sb: Optional[SuperBlock] = None
+        self._compact_idx_entries = 0
 
         base = self.base_file_name()
         dat_path = base + ".dat"
@@ -62,6 +65,10 @@ class Volume:
             self._dat = open(dat_path, "r+b")
             self.super_block = SuperBlock.read_from(self._dat)
             self.nm = NeedleMap(base + ".idx")
+            # conservative freshness floor for TTL expiry across restarts:
+            # the .dat mtime bounds the last write even when the index tail
+            # is a tombstone and carries no usable timestamp
+            self.last_modified_ts = int(os.path.getmtime(dat_path))
             self.check_integrity()
         self._dat.seek(0, os.SEEK_END)
         self._append_offset = self._dat.tell()
@@ -247,12 +254,29 @@ class Volume:
             offset += t.get_actual_size(size, self.version)
 
     def compact(self) -> None:
-        """Copy live needles into fresh .dat/.idx, then swap (Compact2 +
-        CommitCompact semantics, volume_vacuum.go:66-120). The engine lock is
-        held throughout: writes that would race are serialized, so the
-        makeupDiff replay of the reference degenerates to the simple path."""
+        """Full vacuum cycle: snapshot copy + commit with concurrent-write
+        replay (Compact2 + CommitCompact, volume_vacuum.go:37-120)."""
+        self.begin_compact()
+        self.commit_compact()
+
+    def begin_compact(self,
+                      compaction_bytes_per_second: int = 0) -> None:
+        """Phase 1 (Compact2, volume_vacuum.go:66-89): copy live needles to
+        .cpd/.cpx from a map snapshot WITHOUT blocking writers. Concurrent
+        appends keep landing in the old .dat and are folded in later by
+        commit_compact's makeupDiff replay. Reads use pread against the
+        append-only .dat, so racing appends are safe."""
+        base = self.base_file_name()
         with self._lock:
-            base = self.base_file_name()
+            if self._compacting:
+                raise RuntimeError(f"volume {self.vid} already compacting")
+            self._compacting = True
+            # journal high-water mark: entries after this index were written
+            # during compaction and must be replayed at commit
+            self._compact_idx_entries = (
+                os.path.getsize(base + ".idx") // t.NEEDLE_MAP_ENTRY_SIZE)
+            snapshot = [nv for nv in self.nm._map.values()
+                        if t.size_is_valid(nv.size)]
             new_sb = SuperBlock(
                 version=self.super_block.version,
                 replica_placement=self.super_block.replica_placement,
@@ -260,22 +284,72 @@ class Volume:
                 compaction_revision=self.super_block.compaction_revision + 1,
                 extra=self.super_block.extra,
             )
+        snapshot.sort(key=lambda nv: nv.offset)
+        throttle_t0 = time.monotonic()
+        copied = 0
+        try:
             with open(base + ".cpd", "w+b") as cpd, \
                     open(base + ".cpx", "wb") as cpx:
                 cpd.write(new_sb.to_bytes())
                 offset = len(new_sb.to_bytes())
-                for key in sorted(self.nm._map,
-                                  key=lambda k: self.nm._map[k].offset):
-                    nv = self.nm.get(key)
-                    if not t.size_is_valid(nv.size):
-                        continue
+                for nv in snapshot:
                     n = self.read_needle_at(t.stored_to_offset(nv.offset),
                                             nv.size)
                     record = n.to_bytes(self.version)
                     cpd.write(record)
                     cpx.write(idx_mod.pack_entry(
-                        key, t.offset_to_stored(offset), nv.size))
+                        nv.key, t.offset_to_stored(offset), nv.size))
                     offset += len(record)
+                    copied += len(record)
+                    if compaction_bytes_per_second > 0:
+                        # WriteThrottler (weed/util/throttler.go): sleep to
+                        # keep the copy under the configured byte rate
+                        due = copied / compaction_bytes_per_second
+                        ahead = due - (time.monotonic() - throttle_t0)
+                        if ahead > 0:
+                            time.sleep(ahead)
+            self._compact_sb = new_sb
+        except Exception:
+            self.cleanup_compact()
+            raise
+
+    def commit_compact(self) -> None:
+        """Phase 2 (CommitCompact + makeupDiff, volume_vacuum.go:91-240):
+        under the engine lock, replay every .idx journal entry appended
+        since begin_compact onto the compacted files, then atomically swap
+        .cpd/.cpx into place and reload."""
+        base = self.base_file_name()
+        with self._lock:
+            if not self._compacting:
+                raise RuntimeError(f"volume {self.vid} has no open compaction")
+            new_sb = self._compact_sb
+            # makeupDiff: writes/deletes that landed during phase 1
+            idx_size = os.path.getsize(base + ".idx")
+            start = self._compact_idx_entries * t.NEEDLE_MAP_ENTRY_SIZE
+            with open(base + ".cpd", "r+b") as cpd, \
+                    open(base + ".cpx", "ab") as cpx:
+                cpd.seek(0, os.SEEK_END)
+                offset = cpd.tell()
+                if start < idx_size:
+                    with open(base + ".idx", "rb") as f:
+                        f.seek(start)
+                        delta = f.read(idx_size - start)
+                    for key, stored_offset, size in \
+                            idx_mod.iter_index_bytes(delta):
+                        if stored_offset > 0 and \
+                                size != t.TOMBSTONE_FILE_SIZE:
+                            n = self.read_needle_at(
+                                t.stored_to_offset(stored_offset),
+                                max(size, 0))
+                            record = n.to_bytes(self.version)
+                            cpd.write(record)
+                            cpx.write(idx_mod.pack_entry(
+                                key, t.offset_to_stored(offset), size))
+                            offset += len(record)
+                        else:
+                            # the .cpx journal folds tombstones on load
+                            cpx.write(idx_mod.pack_entry(
+                                key, 0, t.TOMBSTONE_FILE_SIZE))
             self._dat.close()
             self.nm.close()
             os.replace(base + ".cpd", base + ".dat")
@@ -285,6 +359,47 @@ class Volume:
             self.nm = NeedleMap(base + ".idx")
             self._dat.seek(0, os.SEEK_END)
             self._append_offset = self._dat.tell()
+            self._compacting = False
+
+    def cleanup_compact(self) -> None:
+        """Abort/cleanup leftovers (VacuumVolumeCleanup,
+        volume_vacuum.go:155-165)."""
+        base = self.base_file_name()
+        with self._lock:
+            self._compacting = False
+            for ext in (".cpd", ".cpx"):
+                try:
+                    os.remove(base + ext)
+                except FileNotFoundError:
+                    pass
+
+    def is_expired(self, now: Optional[float] = None) -> bool:
+        """Volume-level TTL expiry (volume.go expired()): a TTL volume whose
+        last write is older than the TTL is garbage as a whole."""
+        minutes = self.super_block.ttl.minutes()
+        if not minutes:
+            return False
+        ref_ts = self.last_modified_ts or (self.last_append_at_ns / 1e9)
+        if ref_ts == 0:
+            # unknown age: never expire — deleting live data on a guess is
+            # worse than keeping an empty volume around
+            return False
+        return (now if now is not None else time.time()) >= \
+            ref_ts + minutes * 60
+
+    def is_expired_long_enough(self, max_delay_minutes: int,
+                               now: Optional[float] = None) -> bool:
+        """Grace period before physically removing an expired TTL volume
+        (volume.go expiredLongEnough)."""
+        minutes = self.super_block.ttl.minutes()
+        if not minutes:
+            return False
+        removal_delay = min(max(minutes // 10, 1), max_delay_minutes)
+        ref_ts = self.last_modified_ts or (self.last_append_at_ns / 1e9)
+        if ref_ts == 0:
+            return False
+        return (now if now is not None else time.time()) >= \
+            ref_ts + (minutes + removal_delay) * 60
 
     def close(self) -> None:
         with self._lock:

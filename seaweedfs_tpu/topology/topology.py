@@ -95,6 +95,8 @@ class VolumeLayout:
         self.volume_size_limit = volume_size_limit
         self.locations: dict[int, list[DataNode]] = {}
         self.writable: set[int] = set()
+        # volumes mid-vacuum: heartbeats must not re-add them to writable
+        self.vacuuming: set[int] = set()
 
     def register(self, vinfo: VolumeInfo, node: DataNode) -> None:
         nodes = self.locations.setdefault(vinfo.id, [])
@@ -103,7 +105,7 @@ class VolumeLayout:
         rp = ReplicaPlacement.parse(vinfo.replica_placement)
         enough_copies = len(nodes) >= rp.copy_count()
         if (not vinfo.read_only and vinfo.size < self.volume_size_limit
-                and enough_copies):
+                and enough_copies and vinfo.id not in self.vacuuming):
             self.writable.add(vinfo.id)
         elif vinfo.read_only or vinfo.size >= self.volume_size_limit:
             self.writable.discard(vinfo.id)
