@@ -159,6 +159,62 @@ def cmd_shell(args) -> None:
         raise SystemExit(f"unknown shell op {op}")
 
 
+def cmd_backup(args) -> None:
+    """Incrementally pull a volume into a local replica directory
+    (weed backup, weed/command/backup.go:64)."""
+    from .client import Client
+    from .storage import volume_backup
+    from .storage.volume import Volume
+    import os
+    c = Client(args.server)
+    create = not os.path.exists(
+        os.path.join(args.dir, (f"{args.collection}_" if args.collection
+                                else "") + f"{args.volumeId}.dat"))
+    v = Volume(args.dir, args.collection, args.volumeId, create=create)
+    applied = volume_backup.incremental_backup(
+        v, 0, lambda since: c.tail_volume(args.volumeId, since))
+    print(json.dumps({"volume": args.volumeId, "applied": applied,
+                      "file_count": v.file_count()}))
+    v.close()
+
+
+def cmd_fix(args) -> None:
+    """Rebuild .idx by scanning .dat (weed fix, weed/command/fix.go:61)."""
+    from .storage import volume_backup
+    count = volume_backup.rebuild_idx(args.dir, args.collection,
+                                      args.volumeId)
+    print(json.dumps({"volume": args.volumeId, "live_needles": count}))
+
+
+def cmd_export(args) -> None:
+    """Export a volume's live needles to a tar archive
+    (weed export, weed/command/export.go:149)."""
+    import tarfile
+    import io
+    from .storage.volume import Volume
+    v = Volume(args.dir, args.collection, args.volumeId)
+    n_out = 0
+    with tarfile.open(args.output, "w") as tar:
+        def visit(n, byte_offset):
+            nonlocal n_out
+            if len(n.data) == 0:
+                return
+            nv = v.nm.get(n.id)
+            if nv is None or nv.size < 0:
+                return  # deleted
+            name = (n.name.decode("utf-8", "replace")
+                    if n.name else f"{v.vid}_{n.id:x}")
+            info = tarfile.TarInfo(name=name)
+            info.size = len(n.data)
+            info.mtime = n.last_modified
+            tar.addfile(info, io.BytesIO(n.data))
+            n_out += 1
+        v.scan(visit)
+    v.close()
+    print(json.dumps({"volume": args.volumeId, "files": n_out,
+                      "tar": args.output}))
+
+
 def cmd_compact(args) -> None:
     """Offline vacuum of one volume (weed compact, weed/command/compact.go)."""
     from .storage.volume import Volume
@@ -303,6 +359,26 @@ def build_parser() -> argparse.ArgumentParser:
     sh.add_argument("-ec_large_block", type=int, default=1024 * 1024 * 1024)
     sh.add_argument("-ec_small_block", type=int, default=1024 * 1024)
     sh.set_defaults(fn=cmd_shell)
+
+    bk = sub.add_parser("backup", help="incrementally pull a volume locally")
+    bk.add_argument("-server", default="127.0.0.1:9333")
+    bk.add_argument("-dir", default="./backup")
+    bk.add_argument("-collection", default="")
+    bk.add_argument("-volumeId", type=int, required=True)
+    bk.set_defaults(fn=cmd_backup)
+
+    fx = sub.add_parser("fix", help="rebuild .idx by scanning .dat")
+    fx.add_argument("-dir", default="./data")
+    fx.add_argument("-collection", default="")
+    fx.add_argument("-volumeId", type=int, required=True)
+    fx.set_defaults(fn=cmd_fix)
+
+    ex = sub.add_parser("export", help="export volume to tar")
+    ex.add_argument("-dir", default="./data")
+    ex.add_argument("-collection", default="")
+    ex.add_argument("-volumeId", type=int, required=True)
+    ex.add_argument("-output", default="volume.tar")
+    ex.set_defaults(fn=cmd_export)
 
     cp = sub.add_parser("compact", help="offline vacuum of one volume")
     cp.add_argument("-dir", default="./data")

@@ -165,3 +165,60 @@ class Client:
 
     def dir_status(self) -> dict:
         return _get_json(f"http://{self.master}/dir/status")
+
+    def batch_delete(self, fids: list[str]) -> list[dict]:
+        """Delete many fids grouped per volume server in one RPC each
+        (operation.DeleteFiles, weed/operation/delete_content.go)."""
+        by_server: dict[str, list[str]] = {}
+        for fid in fids:
+            vid = int(fid.split(",")[0])
+            urls = self.lookup(vid)
+            if urls:
+                by_server.setdefault(urls[0], []).append(fid)
+        results: list[dict] = []
+        for server, group in by_server.items():
+            r = _post_json(f"http://{server}/admin/batch_delete",
+                           {"fids": group})
+            results.extend(r.get("results", []))
+        return results
+
+    def tail_volume(self, vid: int, since_ns: int = 0):
+        """Yield Needle records appended after since_ns
+        (operation.TailVolume, weed/operation/tail_volume.go)."""
+        from .storage import types as t
+        from .storage.needle import Needle
+        urls = self.lookup(vid)
+        if not urls:
+            raise ClientError(f"volume {vid} not found")
+        req = urllib.request.Request(
+            f"http://{urls[0]}/admin/tail?volume_id={vid}"
+            f"&since_ns={since_ns}")
+        with urllib.request.urlopen(req, timeout=300) as r:
+            while True:
+                head = r.read(4)
+                if len(head) < 4:
+                    return
+                rec = r.read(int.from_bytes(head, "big"))
+                yield Needle.from_bytes(rec, t.CURRENT_VERSION)
+
+    def query(self, fids: list[str], filter: Optional[dict] = None,
+              projections: Optional[list[str]] = None) -> list[dict]:
+        """S3-Select-lite over JSON blobs (weed/query)."""
+        import json as json_mod
+        out: list[dict] = []
+        by_server: dict[str, list[str]] = {}
+        for fid in fids:
+            urls = self.lookup(int(fid.split(",")[0]))
+            if urls:
+                by_server.setdefault(urls[0], []).append(fid)
+        for server, group in by_server.items():
+            body = json_mod.dumps({"fids": group, "filter": filter,
+                                   "projections": projections}).encode()
+            req = urllib.request.Request(
+                f"http://{server}/admin/query", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=300) as r:
+                for line in r.read().splitlines():
+                    if line.strip():
+                        out.append(json_mod.loads(line))
+        return out

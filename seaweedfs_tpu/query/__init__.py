@@ -1,0 +1,3 @@
+from .json_query import QueryFilter, project_doc, query_json_lines
+
+__all__ = ["QueryFilter", "project_doc", "query_json_lines"]

@@ -93,6 +93,10 @@ class VolumeServer:
         app.router.add_post("/admin/ec/to_volume", self.admin_ec_to_volume)
         app.router.add_get("/admin/ec/shard_read", self.admin_ec_shard_read)
         app.router.add_get("/admin/file_copy", self.admin_file_copy)
+        app.router.add_get("/admin/tail", self.admin_tail)
+        app.router.add_post("/admin/volume/copy", self.admin_volume_copy)
+        app.router.add_post("/admin/batch_delete", self.admin_batch_delete)
+        app.router.add_post("/admin/query", self.admin_query)
         app.router.add_get("/status", self.status)
         app.router.add_get("/metrics", self.metrics_handler)
         app.router.add_get("/healthz", _healthz)
@@ -659,6 +663,118 @@ class VolumeServer:
                 await resp.write_eof()
                 return resp
         return web.json_response({"error": "file not found"}, status=404)
+
+    async def admin_tail(self, request: web.Request) -> web.StreamResponse:
+        """Stream needle records appended after since_ns, length-framed
+        (VolumeTailSender, weed/server/volume_grpc_tail.go:16-79).
+        Frame: u32 big-endian record length + raw v3 needle record."""
+        from ..storage import volume_backup
+        q = request.query
+        vid = int(q["volume_id"])
+        since_ns = int(q.get("since_ns", 0))
+        v = self.store.find_volume(vid)
+        if v is None:
+            return web.json_response({"error": "volume not found"},
+                                     status=404)
+        resp = web.StreamResponse()
+        resp.headers["Content-Type"] = "application/octet-stream"
+        await resp.prepare(request)
+        loop = asyncio.get_event_loop()
+        records = await loop.run_in_executor(
+            None,
+            lambda: [n.to_bytes(v.version) for n in
+                     volume_backup.iter_needles_since(v, since_ns)])
+        for rec in records:
+            await resp.write(len(rec).to_bytes(4, "big") + rec)
+        await resp.write_eof()
+        return resp
+
+    async def admin_volume_copy(self, request: web.Request) -> web.Response:
+        """Pull a whole volume (.dat + .idx) from a source server and mount
+        it (VolumeCopy pull model, weed/server/volume_grpc_copy.go:24-151)."""
+        import os
+        body = await request.json()
+        vid = int(body["volume_id"])
+        collection = body.get("collection", "")
+        source = body["source"]
+        if self.store.find_volume(vid) is not None:
+            return web.json_response({"error": "volume exists"}, status=409)
+        open_locs = [l for l in self.store.locations
+                     if len(l.volumes) < l.max_volume_count]
+        if not open_locs:
+            return web.json_response({"error": "no free slots"}, status=500)
+        loc = min(open_locs, key=lambda l: len(l.volumes))
+        prefix = f"{collection}_" if collection else ""
+        base = os.path.join(loc.directory, f"{prefix}{vid}")
+        try:
+            for ext in (".dat", ".idx"):
+                async with self._session.get(
+                        f"http://{source}/admin/file_copy",
+                        params={"volume_id": str(vid),
+                                "collection": collection, "ext": ext}) as r:
+                    if r.status != 200:
+                        raise IOError(f"{source} has no {vid}{ext}")
+                    with open(base + ext, "wb") as f:
+                        async for chunk in r.content.iter_chunked(1 << 20):
+                            f.write(chunk)
+            from ..storage.volume import Volume
+            v = await asyncio.get_event_loop().run_in_executor(
+                None, lambda: Volume(loc.directory, collection, vid))
+            loc.volumes[vid] = v
+        except Exception as e:
+            for ext in (".dat", ".idx"):
+                if os.path.exists(base + ext):
+                    os.remove(base + ext)
+            return web.json_response({"error": str(e)}, status=500)
+        await self.send_heartbeat()
+        return web.json_response({"ok": True,
+                                  "file_count": v.file_count()})
+
+    async def admin_batch_delete(self, request: web.Request) -> web.Response:
+        """Delete many fids in one RPC (BatchDelete,
+        weed/server/volume_grpc_batch_delete.go:15)."""
+        body = await request.json()
+        results = []
+        for fid_str in body.get("fids", []):
+            try:
+                fid = FileId.parse(fid_str)
+                n = Needle(cookie=fid.cookie, id=fid.key)
+                size = await asyncio.get_event_loop().run_in_executor(
+                    None,
+                    lambda f=fid, nn=n: self.store.delete_needle(
+                        f.volume_id, nn))
+                results.append({"fid": fid_str, "size": size})
+            except Exception as e:
+                results.append({"fid": fid_str, "error": str(e)})
+        return web.json_response({"results": results})
+
+    async def admin_query(self, request: web.Request) -> web.StreamResponse:
+        """S3-Select-lite over needle payloads (Query,
+        weed/server/volume_grpc_query.go:13-69): filter + project JSON
+        documents named by fid, emitting NDJSON."""
+        from ..query import QueryFilter, query_json_lines
+        body = await request.json()
+        flt = None
+        if body.get("filter"):
+            f = body["filter"]
+            flt = QueryFilter(f["field"], f.get("op", "="), f.get("value"))
+        projections = body.get("projections") or None
+        payloads = []
+        for fid_str in body.get("fids", []):
+            try:
+                fid = FileId.parse(fid_str)
+                n = self.store.read_needle(fid.volume_id, fid.key,
+                                           cookie=fid.cookie)
+                payloads.append(n.data)
+            except Exception:
+                continue
+        resp = web.StreamResponse()
+        resp.headers["Content-Type"] = "application/x-ndjson"
+        await resp.prepare(request)
+        for line in query_json_lines(payloads, flt, projections):
+            await resp.write(line.encode() + b"\n")
+        await resp.write_eof()
+        return resp
 
     async def status(self, request: web.Request) -> web.Response:
         return web.json_response({"url": self.url, **self.store.status()})

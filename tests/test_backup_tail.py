@@ -1,0 +1,169 @@
+"""Volume tail/backup, batch delete, volume copy, and query-engine tests.
+
+Models the reference's incremental-backup and query behavior
+(weed/storage/volume_backup.go, volume_backup_test.go;
+weed/server/volume_grpc_batch_delete.go, volume_grpc_query.go).
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.query import QueryFilter, query_json_lines
+from seaweedfs_tpu.storage import volume_backup
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume
+
+
+def mk(i, data):
+    return Needle(cookie=0x99, id=i, data=data)
+
+
+def test_binary_search_by_append_at_ns(tmp_path):
+    v = Volume(str(tmp_path), "", 1, create=True)
+    marks = []
+    for i in range(1, 21):
+        v.write_needle(mk(i, b"d%d" % i))
+        marks.append(v.last_append_at_ns)
+    # after the 10th write: entries 10..19 are newer
+    idx = volume_backup.binary_search_by_append_at_ns(v, marks[9])
+    assert idx == 10
+    assert volume_backup.binary_search_by_append_at_ns(v, 0) == 0
+    assert volume_backup.binary_search_by_append_at_ns(
+        v, marks[-1]) == 20
+    v.close()
+
+
+def test_iter_needles_since_includes_tombstones(tmp_path):
+    v = Volume(str(tmp_path), "", 2, create=True)
+    for i in range(1, 6):
+        v.write_needle(mk(i, b"x%d" % i))
+    mark = v.last_append_at_ns
+    v.write_needle(mk(10, b"new"))
+    v.delete_needle(mk(2, b""))
+    got = list(volume_backup.iter_needles_since(v, mark))
+    assert [n.id for n in got] == [10, 2]
+    assert got[0].data == b"new"
+    assert got[1].data == b""  # tombstone
+    v.close()
+
+
+def test_incremental_backup_roundtrip(tmp_path):
+    (tmp_path / "src").mkdir(exist_ok=True)
+    src = Volume(str(tmp_path / "src"), "", 3, create=True)
+    for i in range(1, 9):
+        src.write_needle(mk(i, b"payload-%d" % i * 20))
+    src.delete_needle(mk(4, b""))
+
+    dst_dir = tmp_path / "dst"
+    dst_dir.mkdir()
+    dst = Volume(str(dst_dir), "", 3, create=True)
+    applied = volume_backup.incremental_backup(
+        dst, 0, lambda since: volume_backup.iter_needles_since(src, since))
+    assert applied == 9  # 8 writes + 1 tombstone
+    for i in (1, 2, 3, 5, 6, 7, 8):
+        assert dst.read_needle(i).data == b"payload-%d" % i * 20
+    with pytest.raises(KeyError):
+        dst.read_needle(4)
+
+    # second pull is a no-op from the high-water mark
+    applied2 = volume_backup.incremental_backup(
+        dst, dst.last_append_at_ns,
+        lambda since: volume_backup.iter_needles_since(src, since))
+    assert applied2 == 0
+    src.close()
+    dst.close()
+
+
+def test_rebuild_idx(tmp_path):
+    v = Volume(str(tmp_path), "", 4, create=True)
+    for i in range(1, 7):
+        v.write_needle(mk(i, b"f%d" % i * 10))
+    v.delete_needle(mk(5, b""))
+    v.close()
+    import os
+    os.remove(str(tmp_path / "4.idx"))
+    count = volume_backup.rebuild_idx(str(tmp_path), "", 4)
+    assert count == 6  # live entries written before the tombstone folds
+    v2 = Volume(str(tmp_path), "", 4)
+    for i in (1, 2, 3, 4, 6):
+        assert v2.read_needle(i).data == b"f%d" % i * 10
+    with pytest.raises(KeyError):
+        v2.read_needle(5)
+    v2.close()
+
+
+def test_query_engine():
+    docs = [json.dumps({"name": "alice", "age": 31,
+                        "addr": {"city": "oslo"}}).encode(),
+            json.dumps({"name": "bob", "age": 25,
+                        "addr": {"city": "lima"}}).encode(),
+            b"not json at all",
+            json.dumps([{"name": "carol", "age": 40}]).encode()]
+    out = list(query_json_lines(docs, QueryFilter("age", ">", 30)))
+    assert len(out) == 2
+    assert json.loads(out[0])["name"] == "alice"
+    assert json.loads(out[1])["name"] == "carol"
+
+    out = list(query_json_lines(
+        docs, QueryFilter("addr.city", "=", "lima"), ["name", "addr.city"]))
+    assert out == ['{"name":"bob","city":"lima"}']
+
+    out = list(query_json_lines(docs, QueryFilter("name", "contains", "li")))
+    assert len(out) == 1
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    from tests.cluster_util import Cluster
+    c = Cluster(n_volume_servers=2)
+    try:
+        yield c
+    finally:
+        c.shutdown()
+
+
+def test_cluster_batch_delete(cluster):
+    client = cluster.client
+    fids = [client.upload(b"bd-%d" % i * 30) for i in range(6)]
+    results = client.batch_delete(fids[:4])
+    assert len(results) == 4
+    assert all("error" not in r for r in results)
+    for fid in fids[:4]:
+        with pytest.raises(Exception):
+            client.download(fid)
+    for fid in fids[4:]:
+        assert client.download(fid).startswith(b"bd-")
+
+
+def test_cluster_tail_and_volume_copy(cluster):
+    client = cluster.client
+    fid = client.upload(b"tail-me" * 10)
+    vid = int(fid.split(",")[0])
+    got = list(client.tail_volume(vid, 0))
+    assert any(n.data == b"tail-me" * 10 for n in got)
+
+    # copy the volume to the other server
+    src_urls = client.lookup(vid)
+    all_urls = {n["url"] for n in client.dir_status()["nodes"]}
+    others = sorted(all_urls - set(src_urls))
+    if others:  # replication may already have it everywhere
+        r = client.volume_admin(others[0], "volume/copy",
+                                {"volume_id": vid, "source": src_urls[0]})
+        assert r.get("ok"), r
+        cluster.wait_heartbeats()
+        client._vid_cache.pop(vid, None)  # bypass the 60s lookup cache
+        assert set(client.lookup(vid)) > set(src_urls)
+
+
+def test_cluster_query(cluster):
+    client = cluster.client
+    fids = [client.upload(json.dumps(
+        {"kind": "event", "seq": i, "tag": "even" if i % 2 == 0 else "odd"}
+    ).encode()) for i in range(6)]
+    rows = client.query(fids, filter={"field": "tag", "op": "=",
+                                      "value": "even"},
+                        projections=["seq"])
+    assert sorted(r["seq"] for r in rows) == [0, 2, 4]
