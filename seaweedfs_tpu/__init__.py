@@ -1,0 +1,8 @@
+"""seaweedfs_tpu: a TPU-native SeaweedFS-class distributed blob/file store.
+
+Python asyncio services around a C++ storage core and a JAX/Pallas
+erasure-coding engine (RS(10,4) GF(2^8) kernels). On-disk formats are
+byte-compatible with the reference (.dat/.idx/.ec00-13/.ecx/.ecj/.vif).
+"""
+
+__version__ = "0.2.0"

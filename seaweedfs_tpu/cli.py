@@ -29,12 +29,29 @@ def _run_forever(coro) -> None:
         pass
 
 
+def _load_guard():
+    """Build a security Guard from security.toml (weed/command/scaffold.go
+    security section; keys jwt.signing.key etc.)."""
+    from .security.guard import Guard
+    from .utils.config import load_configuration
+    cfg = load_configuration("security")
+    white = cfg.get_string("guard.white_list", "")
+    return Guard(
+        whitelist=[w for w in white.split(",") if w],
+        signing_key=cfg.get_string("jwt.signing.key", ""),
+        expires_seconds=cfg.get_int("jwt.signing.expires_after_seconds", 10),
+        read_signing_key=cfg.get_string("jwt.signing.read.key", ""),
+        read_expires_seconds=cfg.get_int(
+            "jwt.signing.read.expires_after_seconds", 60))
+
+
 def cmd_master(args) -> None:
     from .server.master import run_master
     _run_forever(run_master(
         args.ip, args.port,
         volume_size_limit_mb=args.volume_size_limit_mb,
-        default_replication=args.default_replication))
+        default_replication=args.default_replication,
+        guard=_load_guard()))
 
 
 def cmd_volume(args) -> None:
@@ -50,7 +67,7 @@ def cmd_volume(args) -> None:
     _run_forever(run_volume_server(
         args.ip, args.port, store, args.mserver,
         data_center=args.data_center, rack=args.rack,
-        pulse_seconds=args.pulse))
+        pulse_seconds=args.pulse, guard=_load_guard()))
 
 
 def cmd_server(args) -> None:
@@ -61,14 +78,17 @@ def cmd_server(args) -> None:
     from .storage.store import Store
 
     async def boot():
+        guard = _load_guard()
         await run_master(args.ip, args.master_port,
-                         default_replication=args.default_replication)
+                         default_replication=args.default_replication,
+                         guard=guard)
         geometry = Geometry(large_block_size=args.ec_large_block,
                             small_block_size=args.ec_small_block)
         store = Store(args.dir.split(","), coder_name=args.coder,
                       geometry=geometry)
         await run_volume_server(args.ip, args.port, store,
-                                f"{args.ip}:{args.master_port}")
+                                f"{args.ip}:{args.master_port}",
+                                guard=guard)
 
     _run_forever(boot())
 
@@ -167,6 +187,7 @@ def cmd_backup(args) -> None:
     from .storage.volume import Volume
     import os
     c = Client(args.server)
+    os.makedirs(args.dir, exist_ok=True)
     create = not os.path.exists(
         os.path.join(args.dir, (f"{args.collection}_" if args.collection
                                 else "") + f"{args.volumeId}.dat"))
@@ -195,6 +216,8 @@ def cmd_export(args) -> None:
     v = Volume(args.dir, args.collection, args.volumeId)
     n_out = 0
     with tarfile.open(args.output, "w") as tar:
+        from .storage import types as t
+
         def visit(n, byte_offset):
             nonlocal n_out
             if len(n.data) == 0:
@@ -202,6 +225,8 @@ def cmd_export(args) -> None:
             nv = v.nm.get(n.id)
             if nv is None or nv.size < 0:
                 return  # deleted
+            if t.stored_to_offset(nv.offset) != byte_offset:
+                return  # superseded by a later version of the same fid
             name = (n.name.decode("utf-8", "replace")
                     if n.name else f"{v.vid}_{n.id:x}")
             info = tarfile.TarInfo(name=name)
@@ -274,8 +299,32 @@ def cmd_benchmark(args) -> None:
         raise SystemExit(1)
 
 
+def cmd_scaffold(args) -> None:
+    """Emit commented default TOML templates (weed/command/scaffold.go:30)."""
+    from .utils.scaffold import TEMPLATES
+    name = args.config
+    if name not in TEMPLATES:
+        raise SystemExit(f"unknown config {name}; one of {list(TEMPLATES)}")
+    text = TEMPLATES[name]
+    if args.output:
+        with open(os.path.join(args.output, name + ".toml"), "w") as f:
+            f.write(text)
+    else:
+        sys.stdout.write(text)
+
+
+def cmd_version(args) -> None:
+    from . import __version__
+    print(f"seaweedfs-tpu {__version__}")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="seaweedfs-tpu")
+    p.add_argument("-v", type=int, default=0, dest="verbosity",
+                   help="glog verbosity level")
+    p.add_argument("-vmodule", default="",
+                   help="per-file verbosity, e.g. volume=2,store=4")
+    p.add_argument("-logFile", default="", dest="log_file")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     m = sub.add_parser("master", help="run a master server")
@@ -397,6 +446,17 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("-concurrency", type=int, default=16)
     b.set_defaults(fn=cmd_benchmark)
 
+    sc = sub.add_parser("scaffold", help="emit default TOML config templates")
+    sc.add_argument("-config", default="security",
+                    help="security|filer|master|notification|replication")
+    sc.add_argument("-output", default="",
+                    help="directory to write <config>.toml into "
+                         "(default: stdout)")
+    sc.set_defaults(fn=cmd_scaffold)
+
+    ver = sub.add_parser("version", help="print version")
+    ver.set_defaults(fn=cmd_version)
+
     return p
 
 
@@ -405,6 +465,8 @@ def main(argv=None) -> None:
         level=os.environ.get("WEED_TPU_LOGLEVEL", "INFO"),
         format="%(asctime)s %(levelname).1s %(name)s %(message)s")
     args = build_parser().parse_args(argv)
+    from .utils import glog
+    glog.setup(args.verbosity, args.vmodule, args.log_file)
     args.fn(args)
 
 

@@ -92,7 +92,7 @@ class Client:
     # --- blob ops ---
     def upload_blob(self, url: str, fid: str, data: bytes,
                     filename: str = "", mime: str = "",
-                    ttl: str = "") -> dict:
+                    ttl: str = "", auth: str = "") -> dict:
         boundary = uuid.uuid4().hex
         name = filename or "file"
         ctype = mime or "application/octet-stream"
@@ -102,13 +102,19 @@ class Client:
             f'filename="{name}"\r\n'
             f"Content-Type: {ctype}\r\n\r\n").encode() + data + \
             f"\r\n--{boundary}--\r\n".encode()
-        target = f"http://{url}/{fid}"
+        params = {}
         if ttl:
-            target += f"?ttl={ttl}"
+            params["ttl"] = ttl
+        target = f"http://{url}/{fid}"
+        if params:
+            target += "?" + urllib.parse.urlencode(params)
+        headers = {"Content-Type":
+                   f"multipart/form-data; boundary={boundary}"}
+        if auth:
+            # master-signed per-fid write token (weed/security/jwt.go)
+            headers["Authorization"] = f"BEARER {auth}"
         req = urllib.request.Request(
-            target, data=body, method="POST",
-            headers={"Content-Type":
-                     f"multipart/form-data; boundary={boundary}"})
+            target, data=body, method="POST", headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=300) as r:
                 return json.load(r)
@@ -122,24 +128,48 @@ class Client:
         """Assign + upload; returns the fid."""
         a = self.assign(collection=collection, replication=replication,
                         ttl=ttl)
-        self.upload_blob(a["url"], a["fid"], data, filename, mime, ttl)
+        self.upload_blob(a["url"], a["fid"], data, filename, mime, ttl,
+                         auth=a.get("auth", ""))
         return a["fid"]
+
+    def lookup_with_auth(self, fid: str) -> tuple[list[str], str]:
+        """Per-fid lookup; returns (urls, read_jwt) — the master signs a
+        read token when a read key is configured (weed/security/jwt.go
+        GenReadJwt)."""
+        out = _get_json(f"http://{self.master}/dir/lookup?"
+                        + urllib.parse.urlencode({"fileId": fid}))
+        urls = [loc["url"] for loc in out.get("locations", [])]
+        if not urls:
+            raise ClientError(out.get("error", f"{fid} not found"))
+        return urls, out.get("auth", "")
 
     def download(self, fid: str) -> bytes:
         vid = int(fid.split(",")[0])
         last_err: Optional[Exception] = None
-        for url in self.lookup(vid):
-            try:
-                with urllib.request.urlopen(f"http://{url}/{fid}",
-                                            timeout=300) as r:
-                    return r.read()
-            except urllib.error.HTTPError as e:
-                last_err = e
-                if e.code == 404:
-                    continue
-            except Exception as e:  # connection refused etc: try replica
-                last_err = e
-                self._vid_cache.pop(vid, None)
+        auth = ""
+        urls = self.lookup(vid)
+        for attempt in range(2):
+            for url in urls:
+                req = urllib.request.Request(f"http://{url}/{fid}")
+                if auth:
+                    req.add_header("Authorization", f"BEARER {auth}")
+                try:
+                    with urllib.request.urlopen(req, timeout=300) as r:
+                        return r.read()
+                except urllib.error.HTTPError as e:
+                    last_err = e
+                    if e.code == 404:
+                        continue
+                    if e.code == 401 and attempt == 0:
+                        break  # fetch a read token and retry
+                except Exception as e:  # connection refused etc: try replica
+                    last_err = e
+                    self._vid_cache.pop(vid, None)
+            if (attempt == 0 and isinstance(last_err, urllib.error.HTTPError)
+                    and last_err.code == 401):
+                urls, auth = self.lookup_with_auth(fid)
+                continue
+            break
         raise ClientError(f"download {fid} failed: {last_err}")
 
     def delete(self, fid: str) -> None:

@@ -1,0 +1,2 @@
+from .jwt import GenJwt, DecodeJwt, JwtError  # noqa: F401
+from .guard import Guard  # noqa: F401

@@ -1,0 +1,88 @@
+"""Request guard: IP whitelist + JWT enforcement for HTTP handlers.
+
+Mirrors weed/security/guard.go:53 — a handler wrapper that admits requests
+from whitelisted IPs/CIDRs (empty whitelist = open) and, when a signing key
+is set, requires a valid JWT on guarded mutation endpoints.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from typing import Optional
+
+from . import jwt as jwt_mod
+
+
+class Guard:
+    def __init__(self, whitelist: Optional[list[str]] = None,
+                 signing_key: str = "", expires_seconds: int = 10,
+                 read_signing_key: str = "",
+                 read_expires_seconds: int = 60):
+        self.signing_key = signing_key
+        self.expires_seconds = expires_seconds
+        self.read_signing_key = read_signing_key
+        self.read_expires_seconds = read_expires_seconds
+        self._nets: list[ipaddress._BaseNetwork] = []
+        self._ips: set[str] = set()
+        for item in (whitelist or []):
+            item = item.strip()
+            if not item:
+                continue
+            if "/" in item:
+                self._nets.append(ipaddress.ip_network(item, strict=False))
+            else:
+                self._ips.add(item)
+
+    @property
+    def is_open(self) -> bool:
+        return not (self._ips or self._nets or self.signing_key)
+
+    def check_whitelist(self, remote_ip: str) -> bool:
+        if not self._ips and not self._nets:
+            return True
+        if remote_ip in self._ips:
+            return True
+        try:
+            addr = ipaddress.ip_address(remote_ip)
+        except ValueError:
+            return False
+        return any(addr in net for net in self._nets)
+
+    def sign_write(self, fid: str) -> str:
+        return jwt_mod.GenJwt(self.signing_key, self.expires_seconds, fid)
+
+    def sign_read(self, fid: str) -> str:
+        return jwt_mod.GenJwt(self.read_signing_key,
+                              self.read_expires_seconds, fid)
+
+    def verify_write(self, token: str, fid: str) -> Optional[str]:
+        """None if ok, error string otherwise. No signing key -> open."""
+        if not self.signing_key:
+            return None
+        if not token:
+            return "missing jwt"
+        try:
+            jwt_mod.VerifyFid(self.signing_key, token, fid)
+        except jwt_mod.JwtError as e:
+            return str(e)
+        return None
+
+    def verify_read(self, token: str, fid: str) -> Optional[str]:
+        if not self.read_signing_key:
+            return None
+        if not token:
+            return "missing read jwt"
+        try:
+            jwt_mod.VerifyFid(self.read_signing_key, token, fid)
+        except jwt_mod.JwtError as e:
+            return str(e)
+        return None
+
+
+def token_from_request(headers, query) -> str:
+    """Authorization: BEARER <t> header or ?jwt= query param
+    (weed/security/jwt.go GetJwt)."""
+    auth = headers.get("Authorization", "")
+    if auth.lower().startswith("bearer "):
+        return auth[7:].strip()
+    return query.get("jwt", "")

@@ -1,0 +1,74 @@
+"""Per-fid write/read JWTs (HS256), master-signed, volume-server-verified.
+
+Mirrors weed/security/jwt.go: the master signs a short-lived token binding a
+specific file id; the volume server requires it on writes (and reads when a
+read key is configured). Claims: ``fid`` plus standard ``exp``. Keys come
+from security.toml [jwt.signing] / [jwt.signing.read] (scaffold.go security
+section), loaded via utils.config.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+
+
+class JwtError(Exception):
+    pass
+
+
+def _b64(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+_HEADER = _b64(json.dumps({"alg": "HS256", "typ": "JWT"},
+                          separators=(",", ":")).encode())
+
+
+def GenJwt(signing_key: str, expires_seconds: int, fid: str) -> str:
+    """Sign a token for one file id; empty key means auth disabled -> ''."""
+    if not signing_key:
+        return ""
+    claims = {"fid": fid}
+    if expires_seconds > 0:
+        claims["exp"] = int(time.time()) + expires_seconds
+    payload = _b64(json.dumps(claims, separators=(",", ":")).encode())
+    msg = f"{_HEADER}.{payload}"
+    sig = hmac.new(signing_key.encode(), msg.encode(), hashlib.sha256).digest()
+    return f"{msg}.{_b64(sig)}"
+
+
+def DecodeJwt(signing_key: str, token: str) -> dict:
+    """Verify signature + expiry; returns the claims dict or raises JwtError."""
+    parts = token.split(".")
+    if len(parts) != 3:
+        raise JwtError("malformed token")
+    msg = f"{parts[0]}.{parts[1]}"
+    want = hmac.new(signing_key.encode(), msg.encode(),
+                    hashlib.sha256).digest()
+    if not hmac.compare_digest(want, _unb64(parts[2])):
+        raise JwtError("bad signature")
+    try:
+        claims = json.loads(_unb64(parts[1]))
+    except Exception as e:
+        raise JwtError(f"bad claims: {e}") from e
+    exp = claims.get("exp")
+    if exp is not None and time.time() > exp:
+        raise JwtError("token expired")
+    return claims
+
+
+def VerifyFid(signing_key: str, token: str, fid: str) -> None:
+    """Volume-server side check: token must be valid and bound to this fid
+    (or to no fid, which the reference accepts for legacy tokens)."""
+    claims = DecodeJwt(signing_key, token)
+    bound = claims.get("fid", "")
+    if bound and bound != fid:
+        raise JwtError(f"token bound to {bound}, not {fid}")

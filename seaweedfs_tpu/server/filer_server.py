@@ -233,7 +233,12 @@ class FilerServer:
         url = f"http://{a['url']}/{a['fid']}"
         if ttl:
             url += f"?ttl={ttl}"
-        async with self._session.post(url, data=form) as r:
+        headers = {}
+        if a.get("auth"):
+            # carry the master-signed per-fid write token to the volume
+            # server (weed/security/jwt.go)
+            headers["Authorization"] = f"BEARER {a['auth']}"
+        async with self._session.post(url, data=form, headers=headers) as r:
             if r.status >= 300:
                 raise web.HTTPBadGateway(
                     text=f"chunk upload to {a['url']}: {r.status}")
@@ -245,21 +250,40 @@ class FilerServer:
                           size: int) -> bytes:
         vid = int(fid.split(",")[0])
         last: Optional[Exception] = None
-        for url in await self._lookup(vid):
-            headers = {"Range":
-                       f"bytes={offset_in_chunk}-"
-                       f"{offset_in_chunk + size - 1}"}
-            try:
-                async with self._session.get(f"http://{url}/{fid}",
-                                             headers=headers) as r:
-                    if r.status in (200, 206):
-                        data = await r.read()
-                        if r.status == 200:
-                            data = data[offset_in_chunk:offset_in_chunk + size]
-                        return data
-                    last = RuntimeError(f"{url}/{fid}: HTTP {r.status}")
-            except aiohttp.ClientError as e:
-                last = e
+        read_auth = ""
+        urls = await self._lookup(vid)
+        for attempt in range(2):
+            for url in urls:
+                headers = {"Range":
+                           f"bytes={offset_in_chunk}-"
+                           f"{offset_in_chunk + size - 1}"}
+                if read_auth:
+                    headers["Authorization"] = f"BEARER {read_auth}"
+                try:
+                    async with self._session.get(f"http://{url}/{fid}",
+                                                 headers=headers) as r:
+                        if r.status in (200, 206):
+                            data = await r.read()
+                            if r.status == 200:
+                                data = data[offset_in_chunk:
+                                            offset_in_chunk + size]
+                            return data
+                        last = RuntimeError(f"{url}/{fid}: HTTP {r.status}")
+                        if r.status == 401 and attempt == 0:
+                            break
+                except aiohttp.ClientError as e:
+                    last = e
+            if (attempt == 0 and isinstance(last, RuntimeError)
+                    and "401" in str(last)):
+                # volume server wants a read token: per-fid lookup signs one
+                async with self._session.get(
+                        f"http://{self.master_url}/dir/lookup",
+                        params={"fileId": fid}) as r:
+                    body = await r.json()
+                read_auth = body.get("auth", "")
+                if read_auth:
+                    continue
+            break
         raise web.HTTPBadGateway(text=f"fetch chunk {fid}: {last}")
 
     # --- request dispatch ---

@@ -22,6 +22,7 @@ from typing import Optional
 
 from aiohttp import web
 
+from ..security.guard import Guard
 from ..storage.file_id import FileId, new_cookie
 from ..topology.sequence import MemorySequencer
 from ..topology.topology import Topology
@@ -39,7 +40,8 @@ class MasterServer:
                  default_replication: str = "000",
                  pulse_seconds: float = 5.0,
                  garbage_threshold: float = 0.3,
-                 vacuum_interval_seconds: float = 900.0):
+                 vacuum_interval_seconds: float = 900.0,
+                 guard: Optional[Guard] = None):
         self.topology = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds)
@@ -47,6 +49,7 @@ class MasterServer:
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
         self.vacuum_interval_seconds = vacuum_interval_seconds
+        self.guard = guard or Guard()
         self._grow_lock = asyncio.Lock()
         self._vacuum_lock = asyncio.Lock()
         self._vacuum_task: Optional[asyncio.Task] = None
@@ -110,13 +113,19 @@ class MasterServer:
         key = self.sequencer.next_file_id(count)
         fid = FileId(vid, key, new_cookie())
         node = nodes[0]
-        return web.json_response({
+        resp = {
             "fid": str(fid),
             "url": node.url,
             "publicUrl": node.public_url,
             "count": count,
             "replicas": [n.url for n in nodes[1:]],
-        })
+        }
+        # per-fid write token signed by the master, verified by the volume
+        # server (weed/security/jwt.go; master_server_handlers.go:146)
+        auth = self.guard.sign_write(str(fid))
+        if auth:
+            resp["auth"] = auth
+        return web.json_response(resp)
 
     async def dir_lookup(self, request: web.Request) -> web.Response:
         q = request.query
@@ -129,6 +138,12 @@ class MasterServer:
             except ValueError:
                 return web.json_response({"error": "invalid volumeId"},
                                          status=400)
+        # read token bound to the looked-up fid, when a read key is
+        # configured (filer LookupVolume returns per-fid read jwts in the
+        # reference, weed/security/jwt.go GenReadJwt)
+        read_auth = ""
+        if "," in vid_str and self.guard.read_signing_key:
+            read_auth = self.guard.sign_read(vid_str)
         nodes = self.topology.lookup(vid, q.get("collection", ""))
         if not nodes:
             # EC volumes are located via the shard registry
@@ -147,11 +162,14 @@ class MasterServer:
             return web.json_response(
                 {"volumeId": str(vid), "error": "volume not found"},
                 status=404)
-        return web.json_response({
+        resp = {
             "volumeId": str(vid),
             "locations": [{"url": n.url, "publicUrl": n.public_url}
                           for n in nodes],
-        })
+        }
+        if read_auth:
+            resp["auth"] = read_auth
+        return web.json_response(resp)
 
     async def dir_status(self, request: web.Request) -> web.Response:
         return web.json_response(self.topology.to_dict())

@@ -41,6 +41,7 @@ from ..storage.needle import (FLAG_HAS_LAST_MODIFIED, FLAG_HAS_MIME,
 from ..storage import types as t
 from ..storage.store import Store
 from ..storage.volume import (NeedleDeleted, NeedleNotFound, VolumeReadOnly)
+from ..security.guard import Guard, token_from_request
 from ..utils import metrics as metrics_mod
 
 log = logging.getLogger("volume")
@@ -53,7 +54,8 @@ async def _healthz(request: "web.Request") -> "web.Response":
 class VolumeServer:
     def __init__(self, store: Store, master_url: str, url: str,
                  public_url: str = "", data_center: str = "", rack: str = "",
-                 pulse_seconds: float = 5.0, read_redirect: bool = False):
+                 pulse_seconds: float = 5.0, read_redirect: bool = False,
+                 guard: Optional[Guard] = None):
         self.store = store
         self.master_url = master_url
         self.url = url
@@ -62,6 +64,7 @@ class VolumeServer:
         self.rack = rack
         self.pulse_seconds = pulse_seconds
         self.read_redirect = read_redirect
+        self.guard = guard or Guard()
         self.volume_size_limit = 30 * 1024 * 1024 * 1024
         self.metrics = metrics_mod.Registry("volume")
         self._hb_task: Optional[asyncio.Task] = None
@@ -71,7 +74,19 @@ class VolumeServer:
         store._remote_shard_reader = self._make_shard_reader
 
     def _build_app(self) -> web.Application:
-        app = web.Application(client_max_size=256 * 1024 * 1024)
+        @web.middleware
+        async def guard_mw(request: web.Request, handler):
+            # IP whitelist wraps every route except liveness, admin surface
+            # included (Guard.WhiteList, weed/security/guard.go:53); the
+            # per-fid JWT check on the data path happens in data_handler
+            if request.path != "/healthz":
+                if not self.guard.check_whitelist(request.remote or ""):
+                    return web.json_response({"error": "ip not allowed"},
+                                             status=403)
+            return await handler(request)
+
+        app = web.Application(client_max_size=256 * 1024 * 1024,
+                              middlewares=[guard_mw])
         app.router.add_post("/admin/assign_volume", self.admin_assign_volume)
         app.router.add_post("/admin/vacuum", self.admin_vacuum)
         app.router.add_get("/admin/vacuum/check", self.admin_vacuum_check)
@@ -154,11 +169,22 @@ class VolumeServer:
             fid = FileId.parse(fid_str)
         except ValueError as e:
             return web.json_response({"error": str(e)}, status=400)
+        token = token_from_request(request.headers, request.query)
+        canonical = str(fid)
         if request.method in ("GET", "HEAD"):
+            err = self.guard.verify_read(token, canonical)
+            if err:
+                return web.json_response({"error": err}, status=401)
             return await self._read(request, fid)
         if request.method in ("POST", "PUT"):
+            err = self.guard.verify_write(token, canonical)
+            if err:
+                return web.json_response({"error": err}, status=401)
             return await self._write(request, fid)
         if request.method == "DELETE":
+            err = self.guard.verify_write(token, canonical)
+            if err:
+                return web.json_response({"error": err}, status=401)
             return await self._delete(request, fid)
         return web.json_response({"error": "method not allowed"}, status=405)
 
@@ -317,12 +343,17 @@ class VolumeServer:
                               else "application/octet-stream"))
             return form
 
+        # forward the caller's write jwt (header or query form) so the peer's
+        # guard admits the replicated write (weed/topology/store_replicate.go
+        # fans the original request out, jwt included)
+        fwd = {k: v for k, v in request.query.items() if k == "ttl"}
+        token = token_from_request(request.headers, request.query)
+        if token:
+            fwd["jwt"] = token
         results = await asyncio.gather(
             *[self._session.post(
                 f"http://{url}/{fid}",
-                params={"type": "replicate", **{
-                    k: v for k, v in request.query.items()
-                    if k in ("ttl",)}},
+                params={"type": "replicate", **fwd},
                 data=body_for_replica())
               for url in replicas], return_exceptions=True)
         ok = True
@@ -372,9 +403,13 @@ class VolumeServer:
             replicas = await self._replica_urls(fid.volume_id)
             for url in replicas:
                 try:
+                    fwd = {}
+                    token = token_from_request(request.headers, request.query)
+                    if token:
+                        fwd["jwt"] = token
                     async with self._session.delete(
                             f"http://{url}/{fid}",
-                            params={"type": "replicate"}) as r:
+                            params={"type": "replicate", **fwd}) as r:
                         pass
                 except Exception as e:
                     log.warning("delete replicate to %s: %s", url, e)
@@ -680,11 +715,21 @@ class VolumeServer:
         resp.headers["Content-Type"] = "application/octet-stream"
         await resp.prepare(request)
         loop = asyncio.get_event_loop()
-        records = await loop.run_in_executor(
-            None,
-            lambda: [n.to_bytes(v.version) for n in
-                     volume_backup.iter_needles_since(v, since_ns)])
-        for rec in records:
+        # pull records one at a time off the executor so a full-volume tail
+        # streams in O(record) memory instead of materializing the volume
+        it = volume_backup.iter_needles_since(v, since_ns)
+
+        def next_record():
+            try:
+                n = next(it)
+            except StopIteration:
+                return None
+            return n.to_bytes(v.version)
+
+        while True:
+            rec = await loop.run_in_executor(None, next_record)
+            if rec is None:
+                break
             await resp.write(len(rec).to_bytes(4, "big") + rec)
         await resp.write_eof()
         return resp

@@ -83,12 +83,18 @@ class Volume:
         return self.super_block.version
 
     # --- write path ---
-    def write_needle(self, n: Needle) -> tuple[int, int, bool]:
+    def write_needle(self, n: Needle,
+                     preserve_append_at_ns: bool = False
+                     ) -> tuple[int, int, bool]:
         """Append a needle; returns (byte_offset, size, is_unchanged).
 
         Mirrors doWriteRequest (volume_read_write.go:145-186): dedupe on
         unchanged content, cookie must match any existing entry, then append
         and update the map only if the new offset is larger.
+
+        preserve_append_at_ns keeps the needle's existing timestamp (tail
+        replay onto a replica must not restamp with local time, or the
+        backup high-water mark drifts and records get skipped).
         """
         with self._lock:
             if self.read_only:
@@ -107,7 +113,8 @@ class Volume:
                         f"needle {n.id:x}: cookie mismatch "
                         f"{existing.cookie:#x} != {n.cookie:#x}")
 
-            n.append_at_ns = time.time_ns()
+            if not (preserve_append_at_ns and n.append_at_ns):
+                n.append_at_ns = time.time_ns()
             offset = self._append(n)
             self.last_append_at_ns = n.append_at_ns
             if nv is None or t.stored_to_offset(nv.offset) < offset:
@@ -116,7 +123,8 @@ class Volume:
                 self.last_modified_ts = n.last_modified
             return offset, n.size, False
 
-    def delete_needle(self, n: Needle) -> int:
+    def delete_needle(self, n: Needle,
+                      preserve_append_at_ns: bool = False) -> int:
         """Tombstone delete; returns the freed size (0 if absent).
 
         Appends an empty needle recording the delete, then journals a
@@ -130,7 +138,9 @@ class Volume:
                 return 0
             freed = nv.size
             tomb = Needle(cookie=n.cookie, id=n.id)
-            tomb.append_at_ns = time.time_ns()
+            tomb.append_at_ns = (n.append_at_ns
+                                 if preserve_append_at_ns and n.append_at_ns
+                                 else time.time_ns())
             offset = self._append(tomb)
             self.last_append_at_ns = tomb.append_at_ns
             self.nm.delete(n.id, t.offset_to_stored(offset))

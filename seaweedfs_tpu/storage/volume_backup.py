@@ -108,9 +108,9 @@ def apply_tailed_needle(volume: Volume, n: Needle) -> None:
     else write (the receiver side of volume tailing,
     volume_backup.go IncrementalBackup / volume_grpc_tail.go:81-126)."""
     if len(n.data) == 0:
-        volume.delete_needle(n)
+        volume.delete_needle(n, preserve_append_at_ns=True)
     else:
-        volume.write_needle(n)
+        volume.write_needle(n, preserve_append_at_ns=True)
 
 
 def incremental_backup(volume: Volume, since_ns: int,

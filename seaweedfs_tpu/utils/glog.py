@@ -1,0 +1,111 @@
+"""Leveled, vmodule-filtered logging (glog-style).
+
+The reference vendors a glog fork (weed/glog/glog.go:283): verbosity levels
+``V(0..4)`` gated by a global ``-v`` flag plus per-file overrides via
+``-vmodule=file=N``. This is the same model on top of the stdlib logging
+machinery, with optional rotating file output.
+"""
+
+from __future__ import annotations
+
+import logging
+import logging.handlers
+import os
+import sys
+import threading
+import time
+
+_lock = threading.Lock()
+_verbosity = 0
+_vmodule: dict[str, int] = {}
+_logger = logging.getLogger("seaweedfs_tpu")
+_configured = False
+
+
+class _GlogFormatter(logging.Formatter):
+    """``Lmmdd hh:mm:ss.uuuuuu threadid file:line] msg`` like glog."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        t = time.localtime(record.created)
+        micros = int((record.created % 1) * 1e6)
+        letter = {"DEBUG": "D", "INFO": "I", "WARNING": "W",
+                  "ERROR": "E", "CRITICAL": "F"}.get(record.levelname, "I")
+        return (f"{letter}{t.tm_mon:02d}{t.tm_mday:02d} "
+                f"{t.tm_hour:02d}:{t.tm_min:02d}:{t.tm_sec:02d}.{micros:06d} "
+                f"{record.thread % 100000:5d} "
+                f"{os.path.basename(record.pathname)}:{record.lineno}] "
+                f"{record.getMessage()}")
+
+
+def setup(verbosity: int = 0, vmodule: str = "", log_file: str = "",
+          max_bytes: int = 64 << 20, backup_count: int = 5) -> None:
+    """Configure global verbosity, per-file overrides, and outputs.
+
+    vmodule syntax: ``file1=2,file2=4`` (basename without .py).
+    """
+    global _verbosity, _configured
+    with _lock:
+        _verbosity = verbosity
+        _vmodule.clear()
+        for pair in filter(None, vmodule.split(",")):
+            mod, _, lvl = pair.partition("=")
+            try:
+                _vmodule[mod.strip()] = int(lvl)
+            except ValueError:
+                pass
+        for h in list(_logger.handlers):
+            _logger.removeHandler(h)
+        fmt = _GlogFormatter()
+        sh = logging.StreamHandler(sys.stderr)
+        sh.setFormatter(fmt)
+        _logger.addHandler(sh)
+        if log_file:
+            fh = logging.handlers.RotatingFileHandler(
+                log_file, maxBytes=max_bytes, backupCount=backup_count)
+            fh.setFormatter(fmt)
+            _logger.addHandler(fh)
+        _logger.setLevel(logging.DEBUG)
+        _logger.propagate = False
+        _configured = True
+
+
+def _ensure() -> None:
+    if not _configured:
+        setup(int(os.environ.get("WEED_V", "0")))
+
+
+def v(level: int) -> bool:
+    """True when messages at this verbosity should be emitted (glog V(n))."""
+    _ensure()
+    frame = sys._getframe(1)
+    mod = os.path.splitext(os.path.basename(frame.f_code.co_filename))[0]
+    return level <= _vmodule.get(mod, _verbosity)
+
+
+def vlog(level: int, msg: str, *args) -> None:
+    _ensure()
+    frame = sys._getframe(1)
+    mod = os.path.splitext(os.path.basename(frame.f_code.co_filename))[0]
+    if level <= _vmodule.get(mod, _verbosity):
+        _logger.info(msg, *args, stacklevel=2)
+
+
+def info(msg: str, *args) -> None:
+    _ensure()
+    _logger.info(msg, *args, stacklevel=2)
+
+
+def warning(msg: str, *args) -> None:
+    _ensure()
+    _logger.warning(msg, *args, stacklevel=2)
+
+
+def error(msg: str, *args) -> None:
+    _ensure()
+    _logger.error(msg, *args, stacklevel=2)
+
+
+def fatal(msg: str, *args) -> None:
+    _ensure()
+    _logger.critical(msg, *args, stacklevel=2)
+    raise SystemExit(255)

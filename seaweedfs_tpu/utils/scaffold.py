@@ -1,0 +1,89 @@
+"""Commented default TOML config templates (`weed scaffold` equivalent,
+weed/command/scaffold.go:30). Any key can be overridden by env var
+WEED_<SECTION>_<KEY> (dots -> underscores, upper-cased)."""
+
+SECURITY_TOML = """\
+# security.toml — put in ./ , ~/.seaweedfs/ , or /etc/seaweedfs/
+# Any key can be overridden by env, e.g. WEED_JWT_SIGNING_KEY=...
+
+[jwt.signing]
+# when set, the master signs a per-fid write token on /dir/assign and the
+# volume server requires it on POST/PUT/DELETE
+key = ""
+expires_after_seconds = 10
+
+[jwt.signing.read]
+# when set, reads also require a token
+key = ""
+expires_after_seconds = 60
+
+[guard]
+# comma-separated IPs / CIDRs allowed to talk to servers; empty = open
+white_list = ""
+"""
+
+FILER_TOML = """\
+# filer.toml — metadata store selection; the first enabled store wins
+# (reference: weed/filer/configuration.go)
+
+[memory]
+enabled = false
+
+[sqlite]
+enabled = true
+path = "./filer.db"
+
+[leveldb2]
+# sharded sqlite, 8-way by dir hash
+enabled = false
+dir = "./filerldb2"
+"""
+
+MASTER_TOML = """\
+# master.toml
+
+[master.maintenance]
+# periodic admin scripts, run by the master on a timer
+scripts = \"\"\"
+  ec.encode -fullPercent=95 -quietFor=1h
+  ec.rebuild -force
+  ec.balance -force
+  volume.balance -force
+\"\"\"
+sleep_minutes = 17
+
+[master.sequencer]
+type = "memory"  # memory | snowflake
+"""
+
+NOTIFICATION_TOML = """\
+# notification.toml — outbound queue for filer metadata events
+
+[notification.log]
+enabled = false
+
+[notification.file]
+enabled = false
+directory = "./notifications"
+"""
+
+REPLICATION_TOML = """\
+# replication.toml — cross-cluster replication sink
+
+[sink.filer]
+enabled = false
+grpcAddress = "localhost:8888"
+directory = "/backup"
+
+[sink.local]
+enabled = false
+directory = "./replicated"
+"""
+
+TEMPLATES = {
+    "security": SECURITY_TOML,
+    "filer": FILER_TOML,
+    "master": MASTER_TOML,
+    "notification": NOTIFICATION_TOML,
+    "replication": REPLICATION_TOML,
+}
