@@ -94,15 +94,72 @@ def cmd_server(args) -> None:
 
 
 def cmd_filer(args) -> None:
+    from .notification.queues import load_notifier
     from .server.filer_server import run_filer
+    from .utils.config import load_configuration
     store_kwargs = {}
     if args.store == "sqlite":
         store_kwargs["path"] = args.store_path
+    notifier = load_notifier(load_configuration("notification"))
     _run_forever(run_filer(
         args.ip, args.port, args.mserver, store_name=args.store,
         store_kwargs=store_kwargs, chunk_size=args.chunk_size_mb * 1024 * 1024,
         default_replication=args.default_replication,
-        default_collection=args.collection))
+        default_collection=args.collection,
+        meta_log_path=args.meta_log,
+        peers=[p for p in args.peers.split(",") if p],
+        notifier=notifier))
+
+
+def cmd_watch(args) -> None:
+    """Live-tail filer metadata events (weed watch,
+    weed/command/watch.go:36)."""
+    from .replication.replicator import Replicator
+    r = Replicator(args.filer, None, args.path_prefix)
+    for e in r.subscribe_events(since=args.since):
+        if e.directory.startswith(args.path_prefix):
+            print(json.dumps(e.to_dict()), flush=True)
+
+
+def cmd_filer_replicate(args) -> None:
+    """Continuously replicate one filer into a sink configured by
+    replication.toml (weed filer.replicate)."""
+    from .replication.replicator import Replicator
+    from .replication.sink import load_sink
+    from .utils.config import load_configuration
+    sink = load_sink(load_configuration("replication"))
+    if sink is None:
+        raise SystemExit("no enabled [sink.*] in replication.toml "
+                         "(run scaffold -config replication)")
+    Replicator(args.filer, sink, args.path_prefix).run()
+
+
+def cmd_filer_sync(args) -> None:
+    """Active-active sync of two filers with signature loop prevention
+    (weed filer.sync, weed/command/filer_sync.go:81-330)."""
+    import threading
+    import urllib.request
+
+    from .replication.replicator import Replicator
+    from .replication.sink import FilerSink
+
+    def signature_of(filer: str) -> int:
+        with urllib.request.urlopen(
+                f"http://{filer}/__meta__/info", timeout=10) as r:
+            return int(json.load(r)["signature"])
+
+    sig_a, sig_b = signature_of(args.a), signature_of(args.b)
+
+    def one_direction(src: str, dst: str, dst_sig: int) -> None:
+        # exclude events the destination already processed — the loop break
+        # of filer.sync (filer_sync.go signature filtering)
+        Replicator(src, FilerSink(dst),
+                   args.path_prefix).run(exclude_sig=dst_sig)
+
+    ta = threading.Thread(target=one_direction,
+                          args=(args.a, args.b, sig_b), daemon=True)
+    ta.start()
+    one_direction(args.b, args.a, sig_a)
 
 
 def cmd_s3(args) -> None:
@@ -299,6 +356,16 @@ def cmd_benchmark(args) -> None:
         raise SystemExit(1)
 
 
+def cmd_webdav(args) -> None:
+    from .server.webdav_server import run_webdav
+    _run_forever(run_webdav(args.ip, args.port, args.filer))
+
+
+def cmd_msg_broker(args) -> None:
+    from .messaging.broker import run_broker
+    _run_forever(run_broker(args.ip, args.port, filer_url=args.filer))
+
+
 def cmd_scaffold(args) -> None:
     """Emit commented default TOML templates (weed/command/scaffold.go:30)."""
     from .utils.scaffold import TEMPLATES
@@ -369,7 +436,46 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("-chunk_size_mb", type=int, default=8)
     f.add_argument("-default_replication", default="")
     f.add_argument("-collection", default="")
+    f.add_argument("-meta_log", default="",
+                   help="path for the persisted metadata event log")
+    f.add_argument("-peers", default="",
+                   help="comma-separated peer filer host:port for "
+                        "active-active metadata sync")
     f.set_defaults(fn=cmd_filer)
+
+    w = sub.add_parser("watch", help="live-tail filer metadata events")
+    w.add_argument("-filer", default="127.0.0.1:8888")
+    w.add_argument("-pathPrefix", dest="path_prefix", default="/")
+    w.add_argument("-since", type=int, default=0)
+    w.set_defaults(fn=cmd_watch)
+
+    fr = sub.add_parser("filer.replicate",
+                        help="replicate filer changes into a sink "
+                             "(replication.toml)")
+    fr.add_argument("-filer", default="127.0.0.1:8888")
+    fr.add_argument("-pathPrefix", dest="path_prefix", default="/")
+    fr.set_defaults(fn=cmd_filer_replicate)
+
+    fsync = sub.add_parser("filer.sync",
+                           help="active-active sync between two filers")
+    fsync.add_argument("-a", required=True, help="filer A host:port")
+    fsync.add_argument("-b", required=True, help="filer B host:port")
+    fsync.add_argument("-pathPrefix", dest="path_prefix", default="/")
+    fsync.set_defaults(fn=cmd_filer_sync)
+
+    wd = sub.add_parser("webdav", help="run the WebDAV gateway")
+    wd.add_argument("-ip", default="127.0.0.1")
+    wd.add_argument("-port", type=int, default=7333)
+    wd.add_argument("-filer", default="127.0.0.1:8888")
+    wd.set_defaults(fn=cmd_webdav)
+
+    mb = sub.add_parser("msg.broker", help="run a pub/sub message broker")
+    mb.add_argument("-ip", default="127.0.0.1")
+    mb.add_argument("-port", type=int, default=17777)
+    mb.add_argument("-filer", default="",
+                    help="filer host:port for segment persistence "
+                         "(empty: memory only)")
+    mb.set_defaults(fn=cmd_msg_broker)
 
     s3p = sub.add_parser("s3", help="run the S3 gateway")
     s3p.add_argument("-ip", default="127.0.0.1")
@@ -461,9 +567,6 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> None:
-    logging.basicConfig(
-        level=os.environ.get("WEED_TPU_LOGLEVEL", "INFO"),
-        format="%(asctime)s %(levelname).1s %(name)s %(message)s")
     args = build_parser().parse_args(argv)
     from .utils import glog
     glog.setup(args.verbosity, args.vmodule, args.log_file)

@@ -25,35 +25,109 @@ log = logging.getLogger("filer")
 
 @dataclass
 class MetaEvent:
-    """EventNotification (weed/pb/filer.proto): one namespace mutation."""
+    """EventNotification (weed/pb/filer.proto): one namespace mutation.
+
+    signatures carries the ids of every filer that already applied this
+    event — the loop-prevention mechanism of multi-filer sync
+    (weed/filer/meta_aggregator.go, filer_pb EventNotification.signatures).
+    """
     tsns: int
     directory: str
     old_entry: Optional[Entry]
     new_entry: Optional[Entry]
     delete_chunks: bool = False
+    signatures: tuple[int, ...] = ()
+
+    def to_dict(self) -> dict:
+        import json as _json
+        return {
+            "tsns": self.tsns,
+            "directory": self.directory,
+            "old": (_json.loads(self.old_entry.to_json())
+                    if self.old_entry else None),
+            "new": (_json.loads(self.new_entry.to_json())
+                    if self.new_entry else None),
+            "deleteChunks": self.delete_chunks,
+            "signatures": list(self.signatures),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "MetaEvent":
+        import json as _json
+        old = d.get("old")
+        new = d.get("new")
+        return cls(
+            tsns=int(d["tsns"]),
+            directory=d["directory"],
+            old_entry=Entry.from_json(_json.dumps(old)) if old else None,
+            new_entry=Entry.from_json(_json.dumps(new)) if new else None,
+            delete_chunks=bool(d.get("deleteChunks", False)),
+            signatures=tuple(d.get("signatures", ())))
 
 
 class MetaLog:
-    """Bounded in-memory event log with subscriber fanout
-    (role of weed/util/log_buffer + filer_notify.go)."""
+    """Bounded in-memory event log with subscriber fanout and optional
+    on-disk persistence (role of weed/util/log_buffer + filer_notify.go:
+    memory tail + replayable persisted segments)."""
 
-    def __init__(self, capacity: int = 8192):
+    def __init__(self, capacity: int = 8192, persist_path: str = ""):
         self.capacity = capacity
+        self.persist_path = persist_path
         self._events: list[MetaEvent] = []
         self._lock = threading.Lock()
         self._subscribers: list[Callable[[MetaEvent], None]] = []
+        self._persist_f = None
+        if persist_path:
+            import os as _os
+            _os.makedirs(_os.path.dirname(persist_path) or ".",
+                         exist_ok=True)
+            self._persist_f = open(persist_path, "a", encoding="utf-8")
 
     def append(self, event: MetaEvent) -> None:
         with self._lock:
             self._events.append(event)
             if len(self._events) > self.capacity:
                 self._events = self._events[-self.capacity:]
+            if self._persist_f is not None:
+                import json as _json
+                self._persist_f.write(
+                    _json.dumps(event.to_dict(), separators=(",", ":"))
+                    + "\n")
+                self._persist_f.flush()
             subs = list(self._subscribers)
         for fn in subs:
             try:
                 fn(event)
             except Exception:
                 log.exception("meta subscriber failed")
+
+    def read_persisted_since(self, tsns: int, prefix: str = "/"):
+        """Replay the on-disk segment lazily (ReadPersistedLogBuffer,
+        weed/filer/filer_notify.go:103) — a generator so a reconnecting
+        subscriber never materializes the whole log in memory."""
+        if not self.persist_path:
+            return
+        import json as _json
+        import os as _os
+        if not _os.path.exists(self.persist_path):
+            return
+        with open(self.persist_path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    e = MetaEvent.from_dict(_json.loads(line))
+                except Exception:
+                    continue
+                if e.tsns > tsns and e.directory.startswith(prefix):
+                    yield e
+
+    def close(self) -> None:
+        with self._lock:
+            if self._persist_f is not None:
+                self._persist_f.close()
+                self._persist_f = None
 
     def subscribe(self, fn: Callable[[MetaEvent], None]) -> None:
         with self._lock:
@@ -73,17 +147,25 @@ class MetaLog:
 class Filer:
     def __init__(self, store: FilerStore,
                  on_delete_chunks: Optional[Callable[[list[FileChunk]],
-                                                     None]] = None):
+                                                     None]] = None,
+                 meta_log_path: str = "",
+                 signature: int = 0):
         self.store = store
-        self.meta_log = MetaLog()
+        self.meta_log = MetaLog(persist_path=meta_log_path)
         self.on_delete_chunks = on_delete_chunks or (lambda chunks: None)
+        # unique per-filer id stamped on every event for sync loop
+        # prevention (store "signature" in weed/filer/meta_aggregator.go)
+        import random as _random
+        self.signature = signature or _random.getrandbits(31)
         self._lock = threading.RLock()
 
     # --- CRUD ---
     def create_entry(self, entry: Entry,
-                     o_excl: bool = False) -> Entry:
+                     o_excl: bool = False,
+                     signatures: tuple[int, ...] = ()) -> Entry:
         """Insert with parent auto-creation (Filer.CreateEntry,
-        weed/filer/filer.go:119-186)."""
+        weed/filer/filer.go:119-186). signatures: ids of filers that
+        already processed this mutation (loop prevention in sync)."""
         with self._lock:
             self._ensure_parents(entry.parent)
             old = self.store.find_entry(entry.full_path)
@@ -93,7 +175,7 @@ class Filer:
                 if old.is_directory and not entry.is_directory:
                     raise IsADirectoryError(entry.full_path)
             self.store.insert_entry(entry)
-        self._notify(entry.parent, old, entry)
+        self._notify(entry.parent, old, entry, signatures=signatures)
         return entry
 
     def _ensure_parents(self, dir_path: str) -> None:
@@ -110,13 +192,14 @@ class Filer:
         self.store.insert_entry(d)
         self._notify(parent, None, d)
 
-    def update_entry(self, entry: Entry) -> Entry:
+    def update_entry(self, entry: Entry,
+                     signatures: tuple[int, ...] = ()) -> Entry:
         with self._lock:
             old = self.store.find_entry(entry.full_path)
             if old is None:
                 raise FileNotFoundError(entry.full_path)
             self.store.update_entry(entry)
-        self._notify(entry.parent, old, entry)
+        self._notify(entry.parent, old, entry, signatures=signatures)
         return entry
 
     def find_entry(self, path: str) -> Optional[Entry]:
@@ -133,7 +216,8 @@ class Filer:
 
     # --- delete (recursive, chunk-freeing) ---
     def delete_entry(self, path: str, recursive: bool = False,
-                     free_chunks: bool = True) -> None:
+                     free_chunks: bool = True,
+                     signatures: tuple[int, ...] = ()) -> None:
         """DeleteEntryMetaAndData (weed/filer/filer_delete_entry.go).
         free_chunks=False removes metadata only (isDeleteData=false in the
         reference) — used when chunks were moved into another entry."""
@@ -155,7 +239,8 @@ class Filer:
             self.store.delete_entry(path)
         if freed:
             self.on_delete_chunks(freed)
-        self._notify(entry.parent, entry, None, delete_chunks=bool(freed))
+        self._notify(entry.parent, entry, None, delete_chunks=bool(freed),
+                     signatures=signatures)
 
     def _collect_chunks_recursive(self, dir_path: str,
                                   out: list[FileChunk]) -> None:
@@ -215,12 +300,50 @@ class Filer:
 
     # --- events ---
     def _notify(self, directory: str, old: Optional[Entry],
-                new: Optional[Entry], delete_chunks: bool = False) -> None:
+                new: Optional[Entry], delete_chunks: bool = False,
+                signatures: tuple[int, ...] = ()) -> None:
         self.meta_log.append(MetaEvent(
             tsns=time.time_ns(), directory=directory,
-            old_entry=old, new_entry=new, delete_chunks=delete_chunks))
+            old_entry=old, new_entry=new, delete_chunks=delete_chunks,
+            signatures=tuple(signatures) + (self.signature,)))
+
+    def apply_event(self, event: MetaEvent) -> bool:
+        """Replay a peer filer's mutation into this store
+        (MetaAggregator.MaybeReplicateMetadataChange semantics,
+        weed/filer/meta_aggregator.go:31-207). Returns False when skipped
+        because this filer already saw the event (its signature is on it).
+        """
+        if self.signature in event.signatures:
+            return False
+        sigs = event.signatures
+        old, new = event.old_entry, event.new_entry
+        if new is not None and old is not None \
+                and old.full_path != new.full_path:
+            # rename: drop old path (metadata only), upsert new
+            try:
+                self.delete_entry(old.full_path, recursive=True,
+                                  free_chunks=False, signatures=sigs)
+            except FileNotFoundError:
+                pass
+            self.create_entry(new, signatures=sigs)
+        elif new is not None:
+            existing = self.store.find_entry(new.full_path)
+            if existing is None:
+                self.create_entry(new, signatures=sigs)
+            else:
+                self.update_entry(new, signatures=sigs)
+        elif old is not None:
+            try:
+                # chunks belong to the origin cluster; never free them
+                # from a replay
+                self.delete_entry(old.full_path, recursive=True,
+                                  free_chunks=False, signatures=sigs)
+            except FileNotFoundError:
+                pass
+        return True
 
     def close(self) -> None:
+        self.meta_log.close()
         self.store.close()
 
 

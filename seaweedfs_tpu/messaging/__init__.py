@@ -1,0 +1,2 @@
+from .broker import BrokerServer, run_broker  # noqa: F401
+from .client import Publisher, Subscriber  # noqa: F401

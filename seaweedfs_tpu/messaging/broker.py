@@ -1,0 +1,243 @@
+"""Pub/sub message broker (weed msg.broker equivalent).
+
+Mirrors weed/messaging/broker/: topics are split into partitions; each
+partition is a LogBuffer whose overflow segments persist as log files in
+the filer under /topics/<namespace>/<topic>/<partition>/ (the reference
+stores broker segments in SeaweedFS itself, broker/topic_manager.go:42-116).
+Publish/subscribe are HTTP streams rather than gRPC bidi:
+
+  POST /publish/{ns}/{topic}/{partition}        body: ndjson messages
+  GET  /subscribe/{ns}/{topic}/{partition}?since=  ndjson replay + tail
+  GET  /topics                                  list known topics
+  GET  /stats
+
+Brokers are stateless over the filer: restart replays nothing into memory
+but subscribers transparently read persisted segments first.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from ..utils.log_buffer import LogBuffer, LogEntry
+
+log = logging.getLogger("broker")
+
+
+class TopicPartition:
+    def __init__(self, ns: str, topic: str, partition: int,
+                 persist: Optional["FilerSegmentStore"] = None):
+        self.ns = ns
+        self.topic = topic
+        self.partition = partition
+        self.persist = persist
+        self.buffer = LogBuffer(
+            flush_fn=self._flush_segment if persist else None,
+            flush_bytes=1024 * 1024)
+
+    @property
+    def dir(self) -> str:
+        return f"/topics/{self.ns}/{self.topic}/{self.partition:04d}"
+
+    def _flush_segment(self, segment: list[LogEntry]) -> None:
+        try:
+            self.persist.write_segment(self.dir, segment)
+        except Exception as e:
+            log.warning("segment flush %s failed: %s", self.dir, e)
+
+
+class FilerSegmentStore:
+    """Persist partition segments as ndjson files in the filer."""
+
+    def __init__(self, filer_url: str):
+        import concurrent.futures
+        self.filer = filer_url.rstrip("/")
+        # single-thread pool: flushes must not block the broker's event
+        # loop (the filer may share it in-process) and must stay ordered
+        self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+        self._pending: list = []
+
+    def write_segment(self, dir_path: str, segment: list[LogEntry]) -> None:
+        fut = self._pool.submit(self._write_segment_sync, dir_path, segment)
+        self._pending.append(fut)
+
+    def _write_segment_sync(self, dir_path: str,
+                            segment: list[LogEntry]) -> None:
+        import urllib.request
+        name = f"{segment[0].ts_ns:020d}.log"
+        body = "\n".join(json.dumps(e.to_dict(), separators=(",", ":"))
+                         for e in segment).encode() + b"\n"
+        req = urllib.request.Request(
+            f"http://{self.filer}{dir_path}/{name}", data=body, method="PUT",
+            headers={"Content-Type": "application/x-ndjson"})
+        urllib.request.urlopen(req, timeout=60).close()
+
+    def drain(self) -> None:
+        """Block until queued segment writes have landed (tests, shutdown)."""
+        pending, self._pending = self._pending, []
+        for fut in pending:
+            try:
+                fut.result(timeout=60)
+            except Exception as e:
+                log.warning("segment write failed: %s", e)
+
+    async def read_segments(self, session: aiohttp.ClientSession,
+                            dir_path: str, since_ns: int) -> list[LogEntry]:
+        out: list[LogEntry] = []
+        try:
+            async with session.get(
+                    f"http://{self.filer}/__meta__/list",
+                    params={"dir": dir_path}) as r:
+                if r.status != 200:
+                    return out
+                entries = (await r.json()).get("entries", [])
+        except aiohttp.ClientError:
+            return out
+        names = sorted(
+            e["path"].rsplit("/", 1)[-1] for e in entries
+            # directory-ness is in the mode bits of the entry JSON
+            if (int(e.get("attr", {}).get("mode", 0)) & 0o170000)
+            != 0o040000)
+        for name in names:
+            try:
+                async with session.get(
+                        f"http://{self.filer}{dir_path}/{name}") as r:
+                    if r.status != 200:
+                        continue
+                    text = await r.text()
+            except aiohttp.ClientError:
+                continue
+            for line in text.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    e = LogEntry.from_dict(json.loads(line))
+                except Exception:
+                    continue
+                if e.ts_ns > since_ns:
+                    out.append(e)
+        return out
+
+
+class BrokerServer:
+    def __init__(self, filer_url: str = ""):
+        self.persist = FilerSegmentStore(filer_url) if filer_url else None
+        self.partitions: dict[tuple[str, str, int], TopicPartition] = {}
+        self._session: Optional[aiohttp.ClientSession] = None
+        self.app = self._build_app()
+
+    def _build_app(self) -> web.Application:
+        app = web.Application(client_max_size=64 * 1024 * 1024)
+        app.router.add_post(
+            "/publish/{ns}/{topic}/{partition:\\d+}", self.publish)
+        app.router.add_get(
+            "/subscribe/{ns}/{topic}/{partition:\\d+}", self.subscribe)
+        app.router.add_get("/topics", self.topics)
+        app.router.add_get("/healthz", self._healthz)
+        app.on_startup.append(self._on_startup)
+        app.on_cleanup.append(self._on_cleanup)
+        return app
+
+    async def _healthz(self, request: web.Request) -> web.Response:
+        return web.json_response({"ok": True})
+
+    async def _on_startup(self, app) -> None:
+        self._session = aiohttp.ClientSession()
+
+    async def _on_cleanup(self, app) -> None:
+        for tp in self.partitions.values():
+            tp.buffer.flush()
+        if self._session:
+            await self._session.close()
+
+    def _partition(self, ns: str, topic: str, p: int) -> TopicPartition:
+        key = (ns, topic, p)
+        if key not in self.partitions:
+            self.partitions[key] = TopicPartition(ns, topic, p, self.persist)
+        return self.partitions[key]
+
+    # --- handlers ---
+    async def publish(self, request: web.Request) -> web.Response:
+        tp = self._partition(request.match_info["ns"],
+                             request.match_info["topic"],
+                             int(request.match_info["partition"]))
+        n = 0
+        last_ts = 0
+        async for line in request.content:
+            line = line.strip()
+            if not line:
+                continue
+            d = json.loads(line)
+            e = LogEntry.from_dict(d)
+            added = tp.buffer.add(e.key, e.value, e.headers)
+            last_ts = added.ts_ns
+            n += 1
+        return web.json_response({"published": n, "last_ts": last_ts})
+
+    async def subscribe(self, request: web.Request) -> web.StreamResponse:
+        tp = self._partition(request.match_info["ns"],
+                             request.match_info["topic"],
+                             int(request.match_info["partition"]))
+        since = int(request.query.get("since", 0))
+        resp = web.StreamResponse()
+        resp.headers["Content-Type"] = "application/x-ndjson"
+        await resp.prepare(request)
+
+        queue: asyncio.Queue = asyncio.Queue()
+        loop = asyncio.get_event_loop()
+
+        def on_entry(e: LogEntry) -> None:
+            loop.call_soon_threadsafe(queue.put_nowait, e)
+
+        tp.buffer.subscribe(on_entry)
+        try:
+            last = since
+            # replay persisted segments, then memory, then live tail
+            if self.persist is not None:
+                for e in await self.persist.read_segments(
+                        self._session, tp.dir, since):
+                    last = max(last, e.ts_ns)
+                    await resp.write(
+                        json.dumps(e.to_dict(), separators=(",", ":"))
+                        .encode() + b"\n")
+            for e in tp.buffer.read_since(last):
+                last = max(last, e.ts_ns)
+                await resp.write(
+                    json.dumps(e.to_dict(), separators=(",", ":"))
+                    .encode() + b"\n")
+            while True:
+                e = await queue.get()
+                if e.ts_ns <= last:
+                    continue
+                await resp.write(
+                    json.dumps(e.to_dict(), separators=(",", ":"))
+                    .encode() + b"\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            tp.buffer.unsubscribe(on_entry)
+        return resp
+
+    async def topics(self, request: web.Request) -> web.Response:
+        out: dict[str, list[int]] = {}
+        for (ns, topic, p) in self.partitions:
+            out.setdefault(f"{ns}/{topic}", []).append(p)
+        return web.json_response({"topics": out})
+
+
+async def run_broker(host: str, port: int, filer_url: str = "",
+                     **kwargs) -> web.AppRunner:
+    server = BrokerServer(filer_url=filer_url, **kwargs)
+    runner = web.AppRunner(server.app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    log.info("msg broker on %s:%d (filer=%s)", host, port, filer_url or "-")
+    return runner

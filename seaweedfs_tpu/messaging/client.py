@@ -1,0 +1,119 @@
+"""Messaging client: Publisher / Subscriber over the broker's HTTP API.
+
+Mirrors weed/messaging/msgclient: messages are keyed; the partition is
+picked by hashing the key over the topic's partition count, and the broker
+for a partition is picked from the broker list by consistent hashing
+(broker/consistent_distribution.go — here a rendezvous hash, same
+stability property: adding/removing a broker only moves its own share).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import urllib.parse
+import urllib.request
+from typing import Callable, Iterator, Optional
+
+from ..utils.log_buffer import LogEntry
+
+
+def _hash(*parts: str) -> int:
+    h = hashlib.md5("/".join(parts).encode()).digest()
+    return int.from_bytes(h[:8], "big")
+
+
+def pick_partition(key: bytes, partition_count: int) -> int:
+    if partition_count <= 1:
+        return 0
+    return int.from_bytes(hashlib.md5(key).digest()[:8], "big") \
+        % partition_count
+
+
+def pick_broker(brokers: list[str], ns: str, topic: str,
+                partition: int) -> str:
+    """Rendezvous (highest-random-weight) hashing over the broker list."""
+    if not brokers:
+        raise ValueError("no brokers")
+    return max(brokers,
+               key=lambda b: _hash(b, ns, topic, str(partition)))
+
+
+class Publisher:
+    def __init__(self, brokers: list[str], namespace: str, topic: str,
+                 partition_count: int = 4):
+        self.brokers = brokers
+        self.ns = namespace
+        self.topic = topic
+        self.partition_count = partition_count
+
+    def publish(self, key: bytes, value: bytes,
+                headers: Optional[dict] = None) -> int:
+        """Send one message; returns its broker-assigned timestamp offset."""
+        p = pick_partition(key, self.partition_count)
+        broker = pick_broker(self.brokers, self.ns, self.topic, p)
+        e = LogEntry(0, key, value, headers or {})
+        body = json.dumps(e.to_dict(), separators=(",", ":")).encode() + b"\n"
+        req = urllib.request.Request(
+            f"http://{broker}/publish/{self.ns}/{self.topic}/{p}",
+            data=body, method="POST",
+            headers={"Content-Type": "application/x-ndjson"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return json.load(r)["last_ts"]
+
+    def publish_many(self, messages: list[tuple[bytes, bytes]]) -> int:
+        """Batch publish; groups by partition. Returns count."""
+        groups: dict[int, list[LogEntry]] = {}
+        for key, value in messages:
+            groups.setdefault(pick_partition(key, self.partition_count),
+                              []).append(LogEntry(0, key, value, {}))
+        n = 0
+        for p, entries in groups.items():
+            broker = pick_broker(self.brokers, self.ns, self.topic, p)
+            body = b"".join(
+                json.dumps(e.to_dict(), separators=(",", ":")).encode()
+                + b"\n" for e in entries)
+            req = urllib.request.Request(
+                f"http://{broker}/publish/{self.ns}/{self.topic}/{p}",
+                data=body, method="POST",
+                headers={"Content-Type": "application/x-ndjson"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                n += json.load(r)["published"]
+        return n
+
+
+class Subscriber:
+    def __init__(self, brokers: list[str], namespace: str, topic: str,
+                 partition: int = 0):
+        self.brokers = brokers
+        self.ns = namespace
+        self.topic = topic
+        self.partition = partition
+
+    def stream(self, since: int = 0,
+               timeout: Optional[float] = None) -> Iterator[LogEntry]:
+        """Replay messages after `since`, then tail live. With a timeout
+        the iterator stops at the first idle gap (bounded consumption)."""
+        broker = pick_broker(self.brokers, self.ns, self.topic,
+                             self.partition)
+        url = (f"http://{broker}/subscribe/{self.ns}/{self.topic}/"
+               f"{self.partition}?"
+               + urllib.parse.urlencode({"since": str(since)}))
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as r:
+                for line in r:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    yield LogEntry.from_dict(json.loads(line))
+        except TimeoutError:
+            return
+        except OSError as e:  # socket timeout surfaces as URLError too
+            if "timed out" in str(e):
+                return
+            raise
+
+    def consume(self, handler: Callable[[LogEntry], None],
+                since: int = 0) -> None:
+        for e in self.stream(since):
+            handler(e)

@@ -1,0 +1,9 @@
+"""Outbound notification queues for filer metadata events.
+
+Mirrors weed/notification/: every filer CRUD emits an EventNotification to
+a configured message queue (notification.toml). Implementations here:
+``log`` (glog output) and ``file`` (append ndjson to a spool directory —
+the stand-in for kafka/SQS/pubsub, which need external services).
+"""
+
+from .queues import LogQueue, FileQueue, load_notifier  # noqa: F401

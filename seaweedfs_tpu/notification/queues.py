@@ -1,0 +1,81 @@
+"""Notification queue implementations (weed/notification/configuration.go).
+
+The interface is one method — ``notify(event)`` — invoked synchronously
+from the filer's meta log fanout. Registered by name like the reference's
+side-effect-imported queue plugins (log/kafka/aws_sqs/google_pub_sub/gocdk);
+kafka-class backends need external brokers, so the shippable ones here are
+``log`` and ``file`` (a spool directory any consumer can tail).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from ..utils import glog
+
+
+class LogQueue:
+    """Print every event (notification.log in the reference)."""
+
+    def notify(self, event) -> None:
+        glog.info("filer event: %s", json.dumps(event.to_dict()))
+
+
+class FileQueue:
+    """Append events as ndjson into dated spool files under a directory.
+
+    A durable local queue: cross-cluster replication (`filer.replicate`)
+    can consume these files the way the reference consumes Kafka topics
+    (weed/replication/sub/).
+    """
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        self._f = None
+        self._day = ""
+
+    def _file(self):
+        day = time.strftime("%Y-%m-%d")
+        if self._f is None or day != self._day:
+            if self._f:
+                self._f.close()
+            self._day = day
+            self._f = open(os.path.join(self.directory, f"events-{day}.ndjson"),
+                           "a", encoding="utf-8")
+        return self._f
+
+    def notify(self, event) -> None:
+        with self._lock:
+            f = self._file()
+            f.write(json.dumps(event.to_dict(), separators=(",", ":")) + "\n")
+            f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f:
+                self._f.close()
+                self._f = None
+
+
+QUEUES = {
+    "log": lambda cfg: LogQueue(),
+    "file": lambda cfg: FileQueue(cfg.get_string("directory",
+                                                 "./notifications")),
+}
+
+
+def load_notifier(config) -> Optional[object]:
+    """First enabled [notification.<name>] section wins
+    (weed/notification/configuration.go LoadConfiguration)."""
+    section = config.section("notification")
+    for name in section.keys():
+        sub = section.section(name)
+        if sub.get_bool("enabled") and name in QUEUES:
+            return QUEUES[name](sub)
+    return None

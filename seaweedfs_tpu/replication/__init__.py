@@ -1,0 +1,2 @@
+from .sink import ReplicationSink, LocalSink, FilerSink  # noqa: F401
+from .replicator import Replicator  # noqa: F401

@@ -1,0 +1,144 @@
+"""Replication sinks: targets that filer metadata events are applied to.
+
+Mirrors weed/replication/sink/replication_sink.go:10-18 — interface
+{CreateEntry, UpdateEntry, DeleteEntry} — with two shippable
+implementations: ``LocalSink`` (materialize files into a local directory,
+the analog of the reference's azure/gcs/b2/s3 object sinks, which need
+cloud credentials) and ``FilerSink`` (another seaweedfs_tpu filer over
+HTTP, the analog of sink/filersink).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Callable, Optional
+
+from ..filer.entry import Entry
+
+
+class ReplicationSink:
+    """signatures: filer ids that already processed the mutation — passed
+    through so a filer-class sink can stamp them for loop prevention."""
+
+    def create_entry(self, entry: Entry,
+                     fetch_data: Callable[[], bytes],
+                     signatures: tuple[int, ...] = ()) -> None:
+        raise NotImplementedError
+
+    def update_entry(self, old: Optional[Entry], new: Entry,
+                     fetch_data: Callable[[], bytes],
+                     signatures: tuple[int, ...] = ()) -> None:
+        if old is not None and old.full_path != new.full_path:
+            self.delete_entry(old, signatures)
+        self.create_entry(new, fetch_data, signatures)
+
+    def delete_entry(self, entry: Entry,
+                     signatures: tuple[int, ...] = ()) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class LocalSink(ReplicationSink):
+    """Materialize the replicated tree under a local directory."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, entry_path: str) -> str:
+        return os.path.join(self.directory, entry_path.lstrip("/"))
+
+    def create_entry(self, entry: Entry,
+                     fetch_data: Callable[[], bytes],
+                     signatures: tuple[int, ...] = ()) -> None:
+        p = self._path(entry.full_path)
+        if entry.is_directory:
+            os.makedirs(p, exist_ok=True)
+            return
+        os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
+        with open(p, "wb") as f:
+            f.write(fetch_data())
+
+    def delete_entry(self, entry: Entry,
+                     signatures: tuple[int, ...] = ()) -> None:
+        p = self._path(entry.full_path)
+        try:
+            if entry.is_directory:
+                import shutil
+                shutil.rmtree(p, ignore_errors=True)
+            else:
+                os.remove(p)
+        except FileNotFoundError:
+            pass
+
+
+class FilerSink(ReplicationSink):
+    """Apply events to another filer via its HTTP file API
+    (weed/replication/sink/filersink)."""
+
+    def __init__(self, filer_url: str, directory: str = "/"):
+        self.filer = filer_url.rstrip("/")
+        self.directory = directory.rstrip("/")
+
+    def _url(self, entry_path: str, **params) -> str:
+        qs = urllib.parse.urlencode(
+            {k: v for k, v in params.items() if v})
+        return (f"http://{self.filer}{self.directory}"
+                + urllib.parse.quote(entry_path) + (f"?{qs}" if qs else ""))
+
+    @staticmethod
+    def _sigs(signatures: tuple[int, ...]) -> str:
+        return ",".join(str(s) for s in signatures)
+
+    def create_entry(self, entry: Entry,
+                     fetch_data: Callable[[], bytes],
+                     signatures: tuple[int, ...] = ()) -> None:
+        if entry.is_directory:
+            req = urllib.request.Request(
+                self._url(entry.full_path, op="mkdir",
+                          signatures=self._sigs(signatures)),
+                method="POST")
+            try:
+                urllib.request.urlopen(req, timeout=60).close()
+            except urllib.error.HTTPError:
+                pass
+            return
+        req = urllib.request.Request(
+            self._url(entry.full_path, signatures=self._sigs(signatures)),
+            data=fetch_data(), method="PUT",
+            headers={"Content-Type": "application/octet-stream"})
+        urllib.request.urlopen(req, timeout=300).close()
+
+    def delete_entry(self, entry: Entry,
+                     signatures: tuple[int, ...] = ()) -> None:
+        req = urllib.request.Request(
+            self._url(entry.full_path, recursive="true",
+                      signatures=self._sigs(signatures)),
+            method="DELETE")
+        try:
+            urllib.request.urlopen(req, timeout=60).close()
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+
+
+def load_sink(config) -> Optional[ReplicationSink]:
+    """First enabled [sink.<name>] in replication.toml wins
+    (weed/replication/replicator.go NewReplicator)."""
+    section = config.section("sink")
+    for name in section.keys():
+        sub = section.section(name)
+        if not sub.get_bool("enabled"):
+            continue
+        if name == "local":
+            return LocalSink(sub.get_string("directory", "./replicated"))
+        if name == "filer":
+            return FilerSink(sub.get_string("grpcAddress", "localhost:8888"),
+                             sub.get_string("directory", "/"))
+    return None

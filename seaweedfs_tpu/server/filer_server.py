@@ -40,22 +40,46 @@ async def _healthz(request: web.Request) -> web.Response:
     return web.json_response({"ok": True})
 
 
+def _parse_signatures(request: web.Request) -> tuple[int, ...]:
+    """?signatures=1,2,3 — filer ids that already processed this mutation
+    (filer_pb EventNotification.signatures; used by filer.sync)."""
+    raw = request.query.get("signatures", "")
+    out = []
+    for part in raw.split(","):
+        part = part.strip()
+        if part:
+            try:
+                out.append(int(part))
+            except ValueError:
+                pass
+    return tuple(out)
+
+
 class FilerServer:
     def __init__(self, master_url: str, store_name: str = "memory",
                  store_kwargs: Optional[dict] = None,
                  chunk_size: int = 8 * 1024 * 1024,
                  default_replication: str = "",
-                 default_collection: str = ""):
+                 default_collection: str = "",
+                 meta_log_path: str = "",
+                 peers: Optional[list[str]] = None,
+                 notifier=None):
         self.master_url = master_url
         self.chunk_size = chunk_size
         self.default_replication = default_replication
         self.default_collection = default_collection
         self.filer = Filer(create_store(store_name, **(store_kwargs or {})),
-                           on_delete_chunks=self._queue_chunk_deletes)
+                           on_delete_chunks=self._queue_chunk_deletes,
+                           meta_log_path=meta_log_path)
+        self.peers = [p for p in (peers or []) if p]
+        self.notifier = notifier
+        if notifier is not None:
+            self.filer.meta_log.subscribe(notifier.notify)
         self.metrics = metrics_mod.Registry("filer")
         self._session: Optional[aiohttp.ClientSession] = None
         self._delete_queue: asyncio.Queue = asyncio.Queue()
         self._delete_task: Optional[asyncio.Task] = None
+        self._aggregator_tasks: list[asyncio.Task] = []
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._vid_cache: dict[int, tuple[list[str], float]] = {}
         self.app = self._build_app()
@@ -74,6 +98,8 @@ class FilerServer:
         app.router.add_post("/__meta__/delete", self.meta_delete)
         app.router.add_post("/__meta__/rename", self.meta_rename)
         app.router.add_get("/__meta__/events", self.meta_events)
+        app.router.add_get("/__meta__/subscribe", self.meta_subscribe)
+        app.router.add_get("/__meta__/info", self.meta_info)
         app.router.add_route("*", "/{path:.*}", self.dispatch)
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
@@ -162,14 +188,112 @@ class FilerServer:
             "new": json.loads(e.new_entry.to_json()) if e.new_entry else None,
         } for e in events]})
 
+    async def meta_info(self, request: web.Request) -> web.Response:
+        """Filer identity: the per-store signature used for sync loop
+        prevention (store signature, weed/filer/meta_aggregator.go:169)."""
+        return web.json_response({"signature": self.filer.signature})
+
+    async def meta_subscribe(self, request: web.Request) -> web.StreamResponse:
+        """Streaming metadata subscription: replay persisted + in-memory
+        events since ?since, then tail live mutations as ndjson lines
+        (SubscribeMetadata, weed/server/filer_grpc_server_sub_meta.go).
+        ?exclude_sig=N drops events already processed by filer N (the
+        server-side filter filer.sync relies on)."""
+        since = int(request.query.get("since", 0))
+        prefix = request.query.get("prefix", "/")
+        exclude_sig = int(request.query.get("exclude_sig", 0))
+        resp = web.StreamResponse()
+        resp.headers["Content-Type"] = "application/x-ndjson"
+        await resp.prepare(request)
+
+        queue: asyncio.Queue = asyncio.Queue()
+        loop = asyncio.get_event_loop()
+
+        def on_event(e) -> None:
+            loop.call_soon_threadsafe(queue.put_nowait, e)
+
+        self.filer.meta_log.subscribe(on_event)
+        try:
+            def admit(e) -> bool:
+                return not (exclude_sig and exclude_sig in e.signatures)
+
+            seen = set()
+            # replay: disk segment first, then the memory tail
+            for e in self.filer.meta_log.read_persisted_since(since, prefix):
+                seen.add(e.tsns)
+                if admit(e):
+                    await resp.write(
+                        json.dumps(e.to_dict(), separators=(",", ":"))
+                        .encode() + b"\n")
+            for e in self.filer.meta_log.events_since(since, prefix):
+                if e.tsns in seen:
+                    continue
+                seen.add(e.tsns)
+                if admit(e):
+                    await resp.write(
+                        json.dumps(e.to_dict(), separators=(",", ":"))
+                        .encode() + b"\n")
+            # live tail; `seen` stays (bounded by replay size) so events
+            # that raced into both the replay and the queue never
+            # double-deliver
+            while True:
+                e = await queue.get()
+                if e.tsns in seen:
+                    continue
+                if not e.directory.startswith(prefix) or not admit(e):
+                    continue
+                await resp.write(
+                    json.dumps(e.to_dict(), separators=(",", ":"))
+                    .encode() + b"\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            self.filer.meta_log.unsubscribe(on_event)
+        return resp
+
+    # --- multi-filer sync (MetaAggregator, weed/filer/meta_aggregator.go) ---
+    async def _aggregate_from_peer(self, peer: str) -> None:
+        """Subscribe to one peer filer's meta stream and replay its events
+        into our store, resuming from a persisted per-peer offset."""
+        from ..filer.filer import MetaEvent
+        offset_key = f"meta_progress/{peer}"
+        while True:
+            raw = self.filer.store.kv_get(offset_key)
+            since = int(raw.decode()) if raw else 0
+            try:
+                async with self._session.get(
+                        f"http://{peer}/__meta__/subscribe",
+                        params={"since": str(since)},
+                        timeout=aiohttp.ClientTimeout(total=None,
+                                                      sock_read=None)) as r:
+                    async for line in r.content:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        e = MetaEvent.from_dict(json.loads(line))
+                        await asyncio.get_event_loop().run_in_executor(
+                            None, self.filer.apply_event, e)
+                        self.filer.store.kv_put(offset_key,
+                                                str(e.tsns).encode())
+            except asyncio.CancelledError:
+                raise
+            except Exception as ex:
+                log.debug("meta aggregator peer %s: %s (retrying)", peer, ex)
+            await asyncio.sleep(1.0)
+
     async def _on_startup(self, app) -> None:
         self._loop = asyncio.get_event_loop()
         self._session = aiohttp.ClientSession()
         self._delete_task = asyncio.create_task(self._deletion_worker())
+        for peer in self.peers:
+            self._aggregator_tasks.append(
+                asyncio.create_task(self._aggregate_from_peer(peer)))
 
     async def _on_cleanup(self, app) -> None:
         if self._delete_task:
             self._delete_task.cancel()
+        for t in self._aggregator_tasks:
+            t.cancel()
         if self._session:
             await self._session.close()
         self.filer.close()
@@ -253,6 +377,7 @@ class FilerServer:
         read_auth = ""
         urls = await self._lookup(vid)
         for attempt in range(2):
+            needs_auth = False
             for url in urls:
                 headers = {"Range":
                            f"bytes={offset_in_chunk}-"
@@ -270,11 +395,11 @@ class FilerServer:
                             return data
                         last = RuntimeError(f"{url}/{fid}: HTTP {r.status}")
                         if r.status == 401 and attempt == 0:
+                            needs_auth = True
                             break
                 except aiohttp.ClientError as e:
                     last = e
-            if (attempt == 0 and isinstance(last, RuntimeError)
-                    and "401" in str(last)):
+            if needs_auth:
                 # volume server wants a read token: per-fid lookup signs one
                 async with self._session.get(
                         f"http://{self.master_url}/dir/lookup",
@@ -435,8 +560,9 @@ class FilerServer:
         if request.query.get("ttl"):
             from ..storage.types import TTL
             entry.attr.ttl_sec = TTL.parse(ttl).minutes() * 60
+        sigs = _parse_signatures(request)
         await asyncio.get_event_loop().run_in_executor(
-            None, self.filer.create_entry, entry)
+            None, lambda: self.filer.create_entry(entry, signatures=sigs))
         if old_entry is not None and old_entry.chunks:
             self._queue_chunk_deletes(old_entry.chunks)
         return web.json_response(
@@ -446,8 +572,9 @@ class FilerServer:
     async def handle_mkdir(self, request: web.Request,
                            path: str) -> web.Response:
         entry = new_directory(_norm(path))
+        sigs = _parse_signatures(request)
         await asyncio.get_event_loop().run_in_executor(
-            None, self.filer.create_entry, entry)
+            None, lambda: self.filer.create_entry(entry, signatures=sigs))
         return web.json_response({"name": entry.full_path}, status=201)
 
     async def handle_rename(self, request: web.Request,
@@ -464,10 +591,12 @@ class FilerServer:
                             path: str) -> web.Response:
         self.metrics.count("delete")
         recursive = request.query.get("recursive") == "true"
+        sigs = _parse_signatures(request)
         try:
             await asyncio.get_event_loop().run_in_executor(
                 None, lambda: self.filer.delete_entry(path,
-                                                      recursive=recursive))
+                                                      recursive=recursive,
+                                                      signatures=sigs))
         except FileNotFoundError:
             return web.json_response({"error": "not found"}, status=404)
         except OSError as e:

@@ -140,10 +140,15 @@ class MasterServer:
                                          status=400)
         # read token bound to the looked-up fid, when a read key is
         # configured (filer LookupVolume returns per-fid read jwts in the
-        # reference, weed/security/jwt.go GenReadJwt)
+        # reference, weed/security/jwt.go GenReadJwt). Sign the canonical
+        # form — the volume server verifies against str(FileId.parse(...)),
+        # so extension/padding variants must normalize first.
         read_auth = ""
         if "," in vid_str and self.guard.read_signing_key:
-            read_auth = self.guard.sign_read(vid_str)
+            try:
+                read_auth = self.guard.sign_read(str(FileId.parse(vid_str)))
+            except ValueError:
+                pass
         nodes = self.topology.lookup(vid, q.get("collection", ""))
         if not nodes:
             # EC volumes are located via the shard registry

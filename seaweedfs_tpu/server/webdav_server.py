@@ -1,0 +1,286 @@
+"""WebDAV gateway over the filer (weed webdav equivalent,
+weed/server/webdav_server.go:101 — golang.org/x/net/webdav FileSystem
+backed by filer gRPC; here the same protocol surface over the filer's
+HTTP API).
+
+Implements the RFC 4918 subset real clients (davfs2, macOS Finder,
+Windows explorer, cadaver) use: OPTIONS, PROPFIND (depth 0/1), GET/HEAD,
+PUT, DELETE, MKCOL, MOVE, COPY.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+from urllib.parse import quote, unquote, urlparse
+from xml.sax.saxutils import escape
+
+import aiohttp
+from aiohttp import web
+
+log = logging.getLogger("webdav")
+
+_DAV_HEADERS = {
+    "DAV": "1,2",
+    "MS-Author-Via": "DAV",
+    "Allow": ("OPTIONS, PROPFIND, GET, HEAD, PUT, DELETE, MKCOL, "
+              "MOVE, COPY"),
+}
+
+
+def _rfc1123(ts: float) -> str:
+    import time
+    return time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime(ts))
+
+
+def _is_dir(entry: dict) -> bool:
+    """Directory-ness lives in the mode bits of the filer's entry JSON
+    (S_IFDIR, like os.stat)."""
+    mode = entry.get("attr", {}).get("mode", 0)
+    return (int(mode) & 0o170000) == 0o040000
+
+
+class WebDavServer:
+    def __init__(self, filer_url: str):
+        self.filer = filer_url.rstrip("/")
+        self._session: Optional[aiohttp.ClientSession] = None
+        self.app = self._build_app()
+
+    def _build_app(self) -> web.Application:
+        app = web.Application(client_max_size=1024 * 1024 * 1024)
+        app.router.add_route("*", "/{path:.*}", self.dispatch)
+        app.on_startup.append(self._on_startup)
+        app.on_cleanup.append(self._on_cleanup)
+        return app
+
+    async def _on_startup(self, app) -> None:
+        self._session = aiohttp.ClientSession()
+
+    async def _on_cleanup(self, app) -> None:
+        if self._session:
+            await self._session.close()
+
+    # --- filer meta helpers ---
+    async def _lookup(self, path: str) -> Optional[dict]:
+        async with self._session.get(
+                f"http://{self.filer}/__meta__/lookup",
+                params={"path": path or "/"}) as r:
+            if r.status != 200:
+                return None
+            return await r.json()
+
+    async def _list(self, path: str) -> list[dict]:
+        async with self._session.get(
+                f"http://{self.filer}/__meta__/list",
+                params={"dir": path or "/"}) as r:
+            if r.status != 200:
+                return []
+            return (await r.json()).get("entries", [])
+
+    # --- dispatch ---
+    async def dispatch(self, request: web.Request) -> web.StreamResponse:
+        path = "/" + unquote(request.match_info["path"]).strip("/")
+        method = request.method.upper()
+        handler = {
+            "OPTIONS": self.handle_options,
+            "PROPFIND": self.handle_propfind,
+            "GET": self.handle_get,
+            "HEAD": self.handle_get,
+            "PUT": self.handle_put,
+            "DELETE": self.handle_delete,
+            "MKCOL": self.handle_mkcol,
+            "MOVE": self.handle_move,
+            "COPY": self.handle_copy,
+            "LOCK": self.handle_lock,
+            "UNLOCK": self.handle_unlock,
+            "PROPPATCH": self.handle_proppatch,
+        }.get(method)
+        if handler is None:
+            return web.Response(status=405, headers=_DAV_HEADERS)
+        return await handler(request, path)
+
+    async def handle_options(self, request, path) -> web.Response:
+        return web.Response(status=200, headers=_DAV_HEADERS)
+
+    # --- PROPFIND ---
+    def _prop_xml(self, href: str, entry: dict) -> str:
+        is_dir = _is_dir(entry)
+        attr = entry.get("attr", {})
+        size = sum(c.get("size", 0) for c in entry.get("chunks", []))
+        mtime = attr.get("mtime", 0)
+        ctype = attr.get("mime") or "application/octet-stream"
+        if is_dir and not href.endswith("/"):
+            href += "/"
+        res_type = "<D:collection/>" if is_dir else ""
+        length = ("" if is_dir else
+                  f"<D:getcontentlength>{size}</D:getcontentlength>")
+        return (
+            "<D:response>"
+            f"<D:href>{escape(quote(href))}</D:href>"
+            "<D:propstat><D:prop>"
+            f"<D:resourcetype>{res_type}</D:resourcetype>"
+            f"{length}"
+            f"<D:getlastmodified>{_rfc1123(mtime)}</D:getlastmodified>"
+            f"<D:getcontenttype>{escape(ctype)}</D:getcontenttype>"
+            f"<D:displayname>{escape(href.rstrip('/').rsplit('/', 1)[-1])}"
+            "</D:displayname>"
+            "</D:prop><D:status>HTTP/1.1 200 OK</D:status></D:propstat>"
+            "</D:response>")
+
+    async def handle_propfind(self, request, path) -> web.Response:
+        depth = request.headers.get("Depth", "1")
+        entry = await self._lookup(path)
+        if entry is None:
+            return web.Response(status=404)
+        body = ['<?xml version="1.0" encoding="utf-8"?>',
+                '<D:multistatus xmlns:D="DAV:">',
+                self._prop_xml(path, entry)]
+        if depth != "0" and _is_dir(entry):
+            for child in await self._list(path):
+                child_path = child.get("path", "")
+                body.append(self._prop_xml(child_path, child))
+        body.append("</D:multistatus>")
+        return web.Response(status=207, text="".join(body),
+                            content_type="application/xml",
+                            headers={"DAV": "1,2"})
+
+    # --- data ---
+    async def handle_get(self, request, path) -> web.StreamResponse:
+        entry = await self._lookup(path)
+        if entry is None:
+            return web.Response(status=404)
+        if _is_dir(entry):
+            return web.Response(status=403, text="is a collection")
+        headers = {}
+        if "Range" in request.headers:
+            headers["Range"] = request.headers["Range"]
+        async with self._session.get(
+                f"http://{self.filer}{quote(path)}", headers=headers) as r:
+            resp = web.StreamResponse(status=r.status)
+            for h in ("Content-Type", "Content-Range", "ETag",
+                      "Accept-Ranges"):
+                if h in r.headers:
+                    resp.headers[h] = r.headers[h]
+            await resp.prepare(request)
+            if request.method != "HEAD":
+                async for chunk in r.content.iter_chunked(64 * 1024):
+                    await resp.write(chunk)
+            await resp.write_eof()
+            return resp
+
+    async def handle_put(self, request, path) -> web.Response:
+        data = await request.read()
+        async with self._session.put(
+                f"http://{self.filer}{quote(path)}", data=data,
+                headers={"Content-Type":
+                         request.content_type
+                         or "application/octet-stream"}) as r:
+            return web.Response(status=201 if r.status < 300 else r.status)
+
+    async def handle_delete(self, request, path) -> web.Response:
+        async with self._session.delete(
+                f"http://{self.filer}{quote(path)}",
+                params={"recursive": "true"}) as r:
+            if r.status == 404:
+                return web.Response(status=404)
+            return web.Response(status=204)
+
+    async def handle_mkcol(self, request, path) -> web.Response:
+        if await self._lookup(path) is not None:
+            return web.Response(status=405)
+        async with self._session.post(
+                f"http://{self.filer}{quote(path)}",
+                params={"op": "mkdir"}) as r:
+            return web.Response(status=201 if r.status < 300 else r.status)
+
+    def _dest_path(self, request) -> Optional[str]:
+        dest = request.headers.get("Destination", "")
+        if not dest:
+            return None
+        return "/" + unquote(urlparse(dest).path).strip("/")
+
+    async def handle_move(self, request, path) -> web.Response:
+        dest = self._dest_path(request)
+        if dest is None:
+            return web.Response(status=400, text="missing Destination")
+        existed = await self._lookup(dest) is not None
+        if existed and request.headers.get("Overwrite", "T") == "F":
+            return web.Response(status=412)
+        async with self._session.post(
+                f"http://{self.filer}{quote(path)}",
+                params={"mv.to": dest}) as r:
+            if r.status == 404:
+                return web.Response(status=404)
+            return web.Response(status=204 if existed else 201)
+
+    async def handle_copy(self, request, path) -> web.Response:
+        dest = self._dest_path(request)
+        if dest is None:
+            return web.Response(status=400, text="missing Destination")
+        entry = await self._lookup(path)
+        if entry is None:
+            return web.Response(status=404)
+        if _is_dir(entry):
+            return await self._copy_tree(request, path, dest)
+        existed = await self._lookup(dest) is not None
+        if existed and request.headers.get("Overwrite", "T") == "F":
+            return web.Response(status=412)
+        async with self._session.get(
+                f"http://{self.filer}{quote(path)}") as r:
+            data = await r.read()
+        async with self._session.put(
+                f"http://{self.filer}{quote(dest)}", data=data) as r:
+            return web.Response(status=204 if existed else 201)
+
+    async def _copy_tree(self, request, path, dest) -> web.Response:
+        await self._session.post(f"http://{self.filer}{quote(dest)}",
+                                 params={"op": "mkdir"})
+        for child in await self._list(path):
+            cp = child.get("path", "")
+            name = cp.rsplit("/", 1)[-1]
+            if _is_dir(child):
+                await self._copy_tree(request, cp, f"{dest}/{name}")
+            else:
+                async with self._session.get(
+                        f"http://{self.filer}{quote(cp)}") as r:
+                    data = await r.read()
+                await self._session.put(
+                    f"http://{self.filer}{quote(dest + '/' + name)}",
+                    data=data)
+        return web.Response(status=201)
+
+    # --- lock stubs (class 2 compliance for finder/office clients) ---
+    async def handle_lock(self, request, path) -> web.Response:
+        token = "opaquelocktoken:seaweedfs-tpu-nolock"
+        body = ('<?xml version="1.0" encoding="utf-8"?>'
+                '<D:prop xmlns:D="DAV:"><D:lockdiscovery><D:activelock>'
+                '<D:locktype><D:write/></D:locktype>'
+                '<D:lockscope><D:exclusive/></D:lockscope>'
+                f'<D:locktoken><D:href>{token}</D:href></D:locktoken>'
+                "</D:activelock></D:lockdiscovery></D:prop>")
+        return web.Response(status=200, text=body,
+                            content_type="application/xml",
+                            headers={"Lock-Token": f"<{token}>"})
+
+    async def handle_unlock(self, request, path) -> web.Response:
+        return web.Response(status=204)
+
+    async def handle_proppatch(self, request, path) -> web.Response:
+        body = ('<?xml version="1.0" encoding="utf-8"?>'
+                '<D:multistatus xmlns:D="DAV:"><D:response>'
+                f"<D:href>{escape(quote(path))}</D:href>"
+                "<D:propstat><D:status>HTTP/1.1 200 OK</D:status>"
+                "</D:propstat></D:response></D:multistatus>")
+        return web.Response(status=207, text=body,
+                            content_type="application/xml")
+
+
+async def run_webdav(host: str, port: int, filer_url: str,
+                     **kwargs) -> web.AppRunner:
+    server = WebDavServer(filer_url, **kwargs)
+    runner = web.AppRunner(server.app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    log.info("webdav on %s:%d -> filer %s", host, port, filer_url)
+    return runner

@@ -53,19 +53,24 @@ def setup(verbosity: int = 0, vmodule: str = "", log_file: str = "",
                 _vmodule[mod.strip()] = int(lvl)
             except ValueError:
                 pass
-        for h in list(_logger.handlers):
-            _logger.removeHandler(h)
+        # configure the ROOT logger so every module logger ("master",
+        # "volume", "filer", ...) lands in the same handlers/files — the
+        # servers don't log through the glog API directly
+        root = logging.getLogger()
+        for h in list(root.handlers):
+            root.removeHandler(h)
         fmt = _GlogFormatter()
         sh = logging.StreamHandler(sys.stderr)
         sh.setFormatter(fmt)
-        _logger.addHandler(sh)
+        root.addHandler(sh)
         if log_file:
             fh = logging.handlers.RotatingFileHandler(
                 log_file, maxBytes=max_bytes, backupCount=backup_count)
             fh.setFormatter(fmt)
-            _logger.addHandler(fh)
+            root.addHandler(fh)
+        root.setLevel(logging.DEBUG if verbosity > 0 else logging.INFO)
         _logger.setLevel(logging.DEBUG)
-        _logger.propagate = False
+        _logger.propagate = True
         _configured = True
 
 

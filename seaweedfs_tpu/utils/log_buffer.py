@@ -1,0 +1,104 @@
+"""In-memory message log with time-based offsets, subscriber fanout, and
+segment flush — the role of weed/util/log_buffer/log_buffer.go:41.
+
+Entries are (ts_ns, key, value, headers). A flush callback receives full
+segments (list of entries) when the buffer exceeds its size threshold or
+on explicit flush; readers replay memory since a timestamp and register
+for live fanout. The filer's meta log and the messaging broker's topic
+partitions both sit on this structure in the reference.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+
+@dataclass
+class LogEntry:
+    ts_ns: int
+    key: bytes
+    value: bytes
+    headers: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        import base64
+        return {"ts": self.ts_ns,
+                "key": base64.b64encode(self.key).decode(),
+                "value": base64.b64encode(self.value).decode(),
+                "headers": self.headers}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LogEntry":
+        import base64
+        return cls(ts_ns=int(d["ts"]),
+                   key=base64.b64decode(d.get("key", "")),
+                   value=base64.b64decode(d.get("value", "")),
+                   headers=d.get("headers", {}))
+
+
+class LogBuffer:
+    def __init__(self,
+                 flush_fn: Optional[Callable[[list[LogEntry]], None]] = None,
+                 flush_bytes: int = 4 * 1024 * 1024,
+                 retention: int = 65536):
+        self._entries: list[LogEntry] = []
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self._subscribers: list[Callable[[LogEntry], None]] = []
+        self.flush_fn = flush_fn
+        self.flush_bytes = flush_bytes
+        self.retention = retention
+        self.last_ts_ns = 0
+
+    def add(self, key: bytes, value: bytes,
+            headers: Optional[dict] = None,
+            ts_ns: int = 0) -> LogEntry:
+        with self._lock:
+            ts = ts_ns or time.time_ns()
+            # strictly monotonic so a timestamp is a unique offset
+            if ts <= self.last_ts_ns:
+                ts = self.last_ts_ns + 1
+            self.last_ts_ns = ts
+            e = LogEntry(ts, key, value, headers or {})
+            self._entries.append(e)
+            self._bytes += len(key) + len(value) + 32
+            flush_now = (self.flush_fn is not None
+                         and self._bytes >= self.flush_bytes)
+            if flush_now:
+                segment, self._entries = self._entries, []
+                self._bytes = 0
+            if len(self._entries) > self.retention:
+                self._entries = self._entries[-self.retention:]
+            subs = list(self._subscribers)
+        if flush_now:
+            self.flush_fn(segment)
+        for fn in subs:
+            try:
+                fn(e)
+            except Exception:
+                pass
+        return e
+
+    def flush(self) -> None:
+        with self._lock:
+            if self.flush_fn is None or not self._entries:
+                return
+            segment, self._entries = self._entries, []
+            self._bytes = 0
+        self.flush_fn(segment)
+
+    def read_since(self, ts_ns: int) -> list[LogEntry]:
+        with self._lock:
+            return [e for e in self._entries if e.ts_ns > ts_ns]
+
+    def subscribe(self, fn: Callable[[LogEntry], None]) -> None:
+        with self._lock:
+            self._subscribers.append(fn)
+
+    def unsubscribe(self, fn: Callable[[LogEntry], None]) -> None:
+        with self._lock:
+            if fn in self._subscribers:
+                self._subscribers.remove(fn)

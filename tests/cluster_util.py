@@ -66,7 +66,9 @@ class Cluster:
         from aiohttp import web
 
         async def boot():
-            runner = web.AppRunner(app)
+            # short shutdown timeout: streaming handlers (meta subscribe,
+            # tail) may be parked on a queue and must not stall teardown
+            runner = web.AppRunner(app, shutdown_timeout=1.0)
             await runner.setup()
             site = web.TCPSite(runner, "127.0.0.1", port)
             await site.start()
