@@ -356,6 +356,13 @@ def cmd_benchmark(args) -> None:
         raise SystemExit(1)
 
 
+def cmd_mount(args) -> None:
+    from .mount.fuse_mount import mount
+    mount(args.filer, args.dir, collection=args.collection,
+          replication=args.replication,
+          chunk_size=args.chunk_size_mb * 1024 * 1024)
+
+
 def cmd_webdav(args) -> None:
     from .server.webdav_server import run_webdav
     _run_forever(run_webdav(args.ip, args.port, args.filer))
@@ -462,6 +469,14 @@ def build_parser() -> argparse.ArgumentParser:
     fsync.add_argument("-b", required=True, help="filer B host:port")
     fsync.add_argument("-pathPrefix", dest="path_prefix", default="/")
     fsync.set_defaults(fn=cmd_filer_sync)
+
+    mt = sub.add_parser("mount", help="FUSE-mount a filer path")
+    mt.add_argument("-filer", default="127.0.0.1:8888")
+    mt.add_argument("-dir", required=True, help="local mountpoint")
+    mt.add_argument("-collection", default="")
+    mt.add_argument("-replication", default="")
+    mt.add_argument("-chunk_size_mb", type=int, default=8)
+    mt.set_defaults(fn=cmd_mount)
 
     wd = sub.add_parser("webdav", help="run the WebDAV gateway")
     wd.add_argument("-ip", default="127.0.0.1")

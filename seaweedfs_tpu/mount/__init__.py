@@ -1,0 +1,2 @@
+from .dirty_pages import ContinuousIntervals  # noqa: F401
+from .wfs import WFS, FileHandle  # noqa: F401

@@ -1,0 +1,98 @@
+"""Kernel FUSE adapter for WFS (`weed mount` equivalent,
+weed/command/mount_std.go:51).
+
+Thin: every FUSE callback delegates to the corresponding WFS method. The
+binding library is optional — this container images neither fusepy nor a
+/dev/fuse it could use, so the adapter imports lazily and `weed mount`
+reports a clear error when unavailable. All mount logic lives (and is
+tested) in wfs.py / dirty_pages.py, mirroring how the reference only
+unit-tests the pure-logic layers of weed/filesys/.
+"""
+
+from __future__ import annotations
+
+import errno
+import stat
+
+
+def mount(filer_url: str, mountpoint: str, collection: str = "",
+          replication: str = "", chunk_size: int = 8 * 1024 * 1024,
+          foreground: bool = True) -> None:
+    try:
+        from fuse import FUSE, FuseOSError, Operations  # fusepy
+    except ImportError as e:
+        raise SystemExit(
+            "FUSE mount needs the 'fusepy' package and a /dev/fuse device; "
+            "neither ships in this environment. The full mount VFS is "
+            "available programmatically via seaweedfs_tpu.mount.WFS."
+        ) from e
+
+    from .wfs import WFS, FuseError
+
+    wfs = WFS(filer_url, collection=collection, replication=replication,
+              chunk_size=chunk_size, subscribe=True)
+
+    class WeedFuse(Operations):
+        def _wrap(self, fn, *args):
+            try:
+                return fn(*args)
+            except FuseError as e:
+                raise FuseOSError(e.errno or errno.EIO)
+
+        def getattr(self, path, fh=None):
+            a = self._wrap(wfs.getattr, path)
+            mode = a["mode"]
+            if stat.S_IFMT(mode) == 0:
+                mode |= stat.S_IFREG
+            return {"st_mode": mode, "st_size": a["size"],
+                    "st_mtime": a["mtime"], "st_uid": a["uid"],
+                    "st_gid": a["gid"], "st_nlink": 1}
+
+        def readdir(self, path, fh):
+            return [".", ".."] + self._wrap(wfs.readdir, path)
+
+        def create(self, path, mode, fi=None):
+            return self._wrap(wfs.create, path, mode)
+
+        def open(self, path, flags):
+            import os
+            writable = bool(flags & (os.O_WRONLY | os.O_RDWR))
+            return self._wrap(wfs.open, path, writable)
+
+        def read(self, path, size, offset, fh):
+            return self._wrap(wfs.read, fh, size, offset)
+
+        def write(self, path, data, offset, fh):
+            return self._wrap(wfs.write, fh, data, offset)
+
+        def flush(self, path, fh):
+            return self._wrap(wfs.flush, fh)
+
+        def release(self, path, fh):
+            return self._wrap(wfs.release, fh)
+
+        def mkdir(self, path, mode):
+            return self._wrap(wfs.mkdir, path, mode)
+
+        def unlink(self, path):
+            return self._wrap(wfs.unlink, path)
+
+        def rmdir(self, path):
+            return self._wrap(wfs.rmdir, path)
+
+        def rename(self, old, new):
+            return self._wrap(wfs.rename, old, new)
+
+        def truncate(self, path, length, fh=None):
+            return self._wrap(wfs.truncate, path, length)
+
+        def statfs(self, path):
+            s = wfs.statfs()
+            return {"f_bsize": s["bsize"], "f_blocks": s["blocks"],
+                    "f_bavail": s["bfree"], "f_bfree": s["bfree"]}
+
+        def destroy(self, path):
+            wfs.destroy()
+
+    FUSE(WeedFuse(), mountpoint, foreground=foreground, nothreads=False,
+         big_writes=True)

@@ -1,0 +1,371 @@
+"""WFS: the mount's virtual filesystem over a filer.
+
+Mirrors weed/filesys/wfs.go + file.go + dir.go + filehandle.go: a
+path-based VFS with open-handle registry and write-back dirty pages.
+Kernel FUSE glue (fuse_mount.py) calls these methods 1:1; every operation
+here is also drictly testable without a kernel, which is exactly how the
+reference tests its mount internals (pure-logic tests only,
+dirty_page_interval_test.go / fscache_test.go).
+
+Write path (wfs_write.go + dirty_page.go): writes land in per-handle
+ContinuousIntervals; when buffered bytes exceed the chunk size the largest
+run flushes early; flush()/release() uploads the rest — each run becomes
+one chunk via filer-proxied assign + volume server POST — then the entry
+is saved with the merged chunk list.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Optional
+
+from .dirty_pages import ContinuousIntervals
+from .meta_cache import MetaCache
+
+
+class FuseError(OSError):
+    def __init__(self, errno_: int, msg: str = ""):
+        super().__init__(errno_, msg)
+
+
+def _norm(path: str) -> str:
+    path = "/" + path.strip("/")
+    while "//" in path:
+        path = path.replace("//", "/")
+    return path
+
+
+class FilerClient:
+    """Thin sync HTTP client for the filer's meta + data endpoints."""
+
+    def __init__(self, filer_url: str):
+        self.filer = filer_url.rstrip("/")
+
+    def _get_json(self, path_qs: str) -> Optional[dict]:
+        try:
+            with urllib.request.urlopen(
+                    f"http://{self.filer}{path_qs}", timeout=60) as r:
+                return json.load(r)
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def lookup(self, path: str) -> Optional[dict]:
+        return self._get_json("/__meta__/lookup?"
+                              + urllib.parse.urlencode({"path": path}))
+
+    def list_dir(self, path: str, limit: int = 100000) -> list[dict]:
+        out = self._get_json("/__meta__/list?" + urllib.parse.urlencode(
+            {"dir": path, "limit": str(limit)}))
+        return out.get("entries", []) if out else []
+
+    def create_entry(self, entry: dict, free_old_chunks: bool = True) -> None:
+        body = json.dumps({"entry": entry,
+                           "free_old_chunks": free_old_chunks}).encode()
+        req = urllib.request.Request(
+            f"http://{self.filer}/__meta__/create_entry", data=body,
+            method="POST", headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=60).close()
+
+    def update_entry(self, entry: dict) -> None:
+        body = json.dumps({"entry": entry}).encode()
+        req = urllib.request.Request(
+            f"http://{self.filer}/__meta__/update_entry", data=body,
+            method="POST", headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=60).close()
+
+    def delete(self, path: str, recursive: bool = False) -> None:
+        body = json.dumps({"path": path, "recursive": recursive}).encode()
+        req = urllib.request.Request(
+            f"http://{self.filer}/__meta__/delete", data=body,
+            method="POST", headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=60).close()
+
+    def rename(self, old: str, new: str) -> None:
+        req = urllib.request.Request(
+            f"http://{self.filer}" + urllib.parse.quote(old)
+            + "?" + urllib.parse.urlencode({"mv.to": new}), method="POST")
+        urllib.request.urlopen(req, timeout=60).close()
+
+    def assign(self, collection: str = "", replication: str = "",
+               ttl: str = "") -> dict:
+        qs = urllib.parse.urlencode({k: v for k, v in
+                                     [("collection", collection),
+                                      ("replication", replication),
+                                      ("ttl", ttl)] if v})
+        out = self._get_json("/__meta__/assign" + (f"?{qs}" if qs else ""))
+        if out is None or "error" in out:
+            raise IOError(f"assign failed: {out}")
+        return out
+
+    def upload_chunk(self, assign: dict, data: bytes) -> None:
+        headers = {"Content-Type": "application/octet-stream"}
+        if assign.get("auth"):
+            headers["Authorization"] = f"BEARER {assign['auth']}"
+        req = urllib.request.Request(
+            f"http://{assign['url']}/{assign['fid']}", data=data,
+            method="POST", headers=headers)
+        urllib.request.urlopen(req, timeout=300).close()
+
+    def read_range(self, path: str, offset: int, size: int) -> bytes:
+        req = urllib.request.Request(
+            f"http://{self.filer}" + urllib.parse.quote(path),
+            headers={"Range": f"bytes={offset}-{offset + size - 1}"})
+        try:
+            with urllib.request.urlopen(req, timeout=300) as r:
+                data = r.read()
+                if r.status == 200:
+                    data = data[offset:offset + size]
+                return data
+        except urllib.error.HTTPError as e:
+            if e.code in (404, 416):
+                return b""
+            raise
+
+
+class FileHandle:
+    """One open file: read-through + write-back dirty pages
+    (weed/filesys/filehandle.go + dirty_page.go)."""
+
+    def __init__(self, wfs: "WFS", path: str, entry: dict,
+                 flags_write: bool = True):
+        self.wfs = wfs
+        self.path = path
+        self.entry = entry
+        self.dirty = ContinuousIntervals()
+        self.flags_write = flags_write
+        self._lock = threading.Lock()
+        self.ref_count = 1
+
+    # --- size helpers ---
+    def _entry_size(self) -> int:
+        chunks = self.entry.get("chunks", [])
+        return max((c["offset"] + c["size"] for c in chunks), default=0)
+
+    def size(self) -> int:
+        return max(self._entry_size(), self.dirty.total_size())
+
+    # --- io ---
+    def write(self, data: bytes, offset: int) -> int:
+        if not self.flags_write:
+            raise FuseError(9, "handle not open for write")  # EBADF
+        with self._lock:
+            self.dirty.add_interval(data, offset)
+            if self.dirty.buffered_bytes() >= self.wfs.chunk_size:
+                self._flush_largest_locked()
+        return len(data)
+
+    def read(self, size: int, offset: int) -> bytes:
+        with self._lock:
+            dirty_data, mask = self.dirty.read_data_at(size, offset)
+        file_size = self.size()
+        if offset >= file_size:
+            return b""
+        size = min(size, file_size - offset)
+        if all(mask[:size]):
+            return dirty_data[:size]
+        remote = b""
+        if self._entry_size() > offset:
+            remote = self.wfs.client.read_range(self.path, offset, size)
+        buf = bytearray(size)
+        buf[:len(remote)] = remote
+        for i in range(size):
+            if mask[i]:
+                buf[i] = dirty_data[i]
+        return bytes(buf)
+
+    # --- flush ---
+    def _upload_interval(self, iv) -> dict:
+        a = self.wfs.client.assign(self.wfs.collection, self.wfs.replication)
+        self.wfs.client.upload_chunk(a, iv.data)
+        return {"fid": a["fid"], "offset": iv.start, "size": len(iv.data),
+                "mtime": time.time_ns(), "etag": ""}
+
+    def _flush_largest_locked(self) -> None:
+        iv = self.dirty.pop_largest_contiguous()
+        if iv is not None:
+            self.entry.setdefault("chunks", []).append(
+                self._upload_interval(iv))
+
+    def flush(self) -> None:
+        """Upload remaining dirty runs and save the entry
+        (FileHandle.Flush, filehandle.go)."""
+        with self._lock:
+            for iv in self.dirty.pop_all():
+                self.entry.setdefault("chunks", []).append(
+                    self._upload_interval(iv))
+            self.entry.setdefault("attr", {})["mtime"] = time.time()
+            self.wfs.client.create_entry(self.entry, free_old_chunks=False)
+            self.wfs.meta_cache.invalidate(self.path)
+
+    def release(self) -> None:
+        self.flush()
+
+
+class WFS:
+    """The filesystem: path ops + open-handle registry (wfs.go:77)."""
+
+    def __init__(self, filer_url: str, collection: str = "",
+                 replication: str = "", chunk_size: int = 8 * 1024 * 1024,
+                 cache_ttl: float = 60.0, subscribe: bool = False):
+        self.client = FilerClient(filer_url)
+        self.collection = collection
+        self.replication = replication
+        self.chunk_size = chunk_size
+        self.meta_cache = MetaCache(ttl=cache_ttl)
+        self.handles: dict[int, FileHandle] = {}
+        self._next_fh = 1
+        self._lock = threading.Lock()
+        if subscribe:
+            self.meta_cache.start_subscriber(filer_url)
+
+    # --- lookup / attr ---
+    def lookup(self, path: str) -> Optional[dict]:
+        path = _norm(path)
+        hit = self.meta_cache.get(path)
+        if hit is not None:
+            return hit[0]
+        entry = self.client.lookup(path)
+        self.meta_cache.put(path, entry)
+        return entry
+
+    def getattr(self, path: str) -> dict:
+        entry = self.lookup(path)
+        if entry is None:
+            raise FuseError(2, path)  # ENOENT
+        attr = entry.get("attr", {})
+        size = max(
+            (c["offset"] + c["size"] for c in entry.get("chunks", [])),
+            default=0)
+        # open write handles know a newer size
+        for fh in self.handles.values():
+            if fh.path == _norm(path):
+                size = max(size, fh.size())
+        return {"mode": attr.get("mode", 0o660), "size": size,
+                "mtime": attr.get("mtime", 0), "uid": attr.get("uid", 0),
+                "gid": attr.get("gid", 0)}
+
+    def readdir(self, path: str) -> list[str]:
+        path = _norm(path)
+        entry = self.lookup(path)
+        if entry is None:
+            raise FuseError(2, path)
+        listing = self.meta_cache.get_listing(path)
+        if listing is None:
+            listing = self.client.list_dir(path)
+            self.meta_cache.put_listing(path, listing)
+        return [e["path"].rsplit("/", 1)[-1] for e in listing]
+
+    # --- file lifecycle ---
+    def create(self, path: str, mode: int = 0o660) -> int:
+        path = _norm(path)
+        entry = {"path": path,
+                 "attr": {"mode": mode, "mtime": time.time(),
+                          "crtime": time.time(), "uid": 0, "gid": 0,
+                          "mime": "application/octet-stream"},
+                 "chunks": []}
+        self.client.create_entry(entry)
+        self.meta_cache.invalidate(path)
+        return self._open_handle(path, entry)
+
+    def open(self, path: str, for_write: bool = False) -> int:
+        path = _norm(path)
+        entry = self.lookup(path)
+        if entry is None:
+            raise FuseError(2, path)
+        return self._open_handle(path, dict(entry), for_write)
+
+    def _open_handle(self, path: str, entry: dict,
+                     for_write: bool = True) -> int:
+        with self._lock:
+            fh = self._next_fh
+            self._next_fh += 1
+            self.handles[fh] = FileHandle(self, path, entry, for_write)
+            return fh
+
+    def write(self, fh: int, data: bytes, offset: int) -> int:
+        return self._handle(fh).write(data, offset)
+
+    def read(self, fh: int, size: int, offset: int) -> bytes:
+        return self._handle(fh).read(size, offset)
+
+    def flush(self, fh: int) -> None:
+        self._handle(fh).flush()
+
+    def release(self, fh: int) -> None:
+        with self._lock:
+            handle = self.handles.pop(fh, None)
+        if handle is not None:
+            handle.release()
+
+    def _handle(self, fh: int) -> FileHandle:
+        h = self.handles.get(fh)
+        if h is None:
+            raise FuseError(9, f"bad handle {fh}")  # EBADF
+        return h
+
+    # --- namespace ops ---
+    def mkdir(self, path: str, mode: int = 0o770) -> None:
+        path = _norm(path)
+        entry = {"path": path,
+                 "attr": {"mode": 0o040000 | (mode & 0o777),
+                          "mtime": time.time(), "crtime": time.time()},
+                 "chunks": []}
+        self.client.create_entry(entry)
+        self.meta_cache.invalidate(path)
+
+    def unlink(self, path: str) -> None:
+        path = _norm(path)
+        if self.lookup(path) is None:
+            raise FuseError(2, path)
+        self.client.delete(path)
+        self.meta_cache.invalidate(path)
+
+    def rmdir(self, path: str) -> None:
+        path = _norm(path)
+        if self.client.list_dir(path, limit=1):
+            raise FuseError(39, path)  # ENOTEMPTY
+        self.client.delete(path, recursive=True)
+        self.meta_cache.invalidate(path)
+
+    def rename(self, old: str, new: str) -> None:
+        old, new = _norm(old), _norm(new)
+        self.client.rename(old, new)
+        self.meta_cache.invalidate(old)
+        self.meta_cache.invalidate(new)
+
+    def truncate(self, path: str, length: int) -> None:
+        """ftruncate semantics: drop/trim chunks past length
+        (file.go Setattr size change)."""
+        path = _norm(path)
+        entry = self.lookup(path)
+        if entry is None:
+            raise FuseError(2, path)
+        entry = dict(entry)
+        if length == 0:
+            entry["chunks"] = []
+        else:
+            kept = []
+            for c in entry.get("chunks", []):
+                if c["offset"] >= length:
+                    continue
+                c = dict(c)
+                c["size"] = min(c["size"], length - c["offset"])
+                kept.append(c)
+            entry["chunks"] = kept
+        self.client.create_entry(entry)
+        self.meta_cache.invalidate(path)
+
+    def statfs(self) -> dict:
+        return {"bsize": 1024 * 1024, "blocks": 1 << 30, "bfree": 1 << 30}
+
+    def destroy(self) -> None:
+        for fh in list(self.handles):
+            self.release(fh)
+        self.meta_cache.stop()

@@ -100,6 +100,8 @@ class FilerServer:
         app.router.add_get("/__meta__/events", self.meta_events)
         app.router.add_get("/__meta__/subscribe", self.meta_subscribe)
         app.router.add_get("/__meta__/info", self.meta_info)
+        app.router.add_get("/__meta__/assign", self.meta_assign)
+        app.router.add_get("/__meta__/lookup_volume", self.meta_lookup_volume)
         app.router.add_route("*", "/{path:.*}", self.dispatch)
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
@@ -192,6 +194,33 @@ class FilerServer:
         """Filer identity: the per-store signature used for sync loop
         prevention (store signature, weed/filer/meta_aggregator.go:169)."""
         return web.json_response({"signature": self.filer.signature})
+
+    async def meta_assign(self, request: web.Request) -> web.Response:
+        """Proxy a volume assignment to the master, applying the filer's
+        default collection/replication policy (AssignVolume RPC,
+        weed/server/filer_grpc_server.go) — lets mount/webdav clients talk
+        only to the filer."""
+        q = request.query
+        try:
+            a = await self._assign(
+                q.get("collection", self.default_collection),
+                q.get("replication", self.default_replication),
+                q.get("ttl", ""))
+        except web.HTTPError as e:
+            return web.json_response({"error": e.text}, status=500)
+        return web.json_response(a)
+
+    async def meta_lookup_volume(self, request: web.Request) -> web.Response:
+        """Proxy volume location lookup (LookupVolume RPC)."""
+        try:
+            vid = int(request.query["volumeId"])
+        except (KeyError, ValueError):
+            return web.json_response({"error": "bad volumeId"}, status=400)
+        urls = await self._lookup(vid)
+        if not urls:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response(
+            {"locations": [{"url": u} for u in urls]})
 
     async def meta_subscribe(self, request: web.Request) -> web.StreamResponse:
         """Streaming metadata subscription: replay persisted + in-memory

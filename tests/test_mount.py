@@ -1,0 +1,178 @@
+"""Mount layer: dirty-page interval algebra + the WFS virtual filesystem.
+
+Mirrors the reference's pure-logic mount tests
+(weed/filesys/dirty_page_interval_test.go) plus an end-to-end WFS pass
+against a live in-process cluster (kernel FUSE glue excluded, as in the
+reference's test strategy).
+"""
+
+import random
+
+import pytest
+
+from seaweedfs_tpu.mount.dirty_pages import ContinuousIntervals
+from seaweedfs_tpu.mount.wfs import WFS, FuseError
+
+
+# --- interval algebra (dirty_page_interval_test.go style) ---
+
+def test_single_interval_roundtrip():
+    ci = ContinuousIntervals()
+    ci.add_interval(b"hello", 0)
+    data, mask = ci.read_data_at(5, 0)
+    assert data == b"hello" and mask == b"\x01" * 5
+
+
+def test_overwrite_newer_wins():
+    ci = ContinuousIntervals()
+    ci.add_interval(b"aaaaaaaaaa", 0)
+    ci.add_interval(b"BBB", 3)
+    data, _ = ci.read_data_at(10, 0)
+    assert data == b"aaaBBBaaaa"
+
+
+def test_partial_overlap_left_right():
+    ci = ContinuousIntervals()
+    ci.add_interval(b"11111", 5)     # [5,10)
+    ci.add_interval(b"22222", 0)     # [0,5) adjacent
+    ci.add_interval(b"3333", 8)      # overlaps tail
+    data, mask = ci.read_data_at(12, 0)
+    assert data == b"222221113333"
+    assert mask == b"\x01" * 12
+
+
+def test_adjacent_coalesce():
+    ci = ContinuousIntervals()
+    ci.add_interval(b"ab", 0)
+    ci.add_interval(b"cd", 2)
+    ci.add_interval(b"ef", 4)
+    assert len(ci.intervals) == 1
+    assert ci.intervals[0].data == b"abcdef"
+
+
+def test_gap_not_coalesced_and_pop_largest():
+    ci = ContinuousIntervals()
+    ci.add_interval(b"xx", 0)
+    ci.add_interval(b"yyyy", 10)
+    assert len(ci.intervals) == 2
+    largest = ci.pop_largest_contiguous()
+    assert largest.data == b"yyyy" and largest.start == 10
+    assert ci.total_size() == 2
+
+
+def test_randomized_against_reference_buffer():
+    rng = random.Random(7)
+    ci = ContinuousIntervals()
+    ref = bytearray(512)
+    written = bytearray(512)
+    for _ in range(200):
+        off = rng.randrange(0, 480)
+        n = rng.randrange(1, 32)
+        payload = bytes(rng.getrandbits(8) for _ in range(n))
+        ci.add_interval(payload, off)
+        ref[off:off + n] = payload
+        for i in range(off, off + n):
+            written[i] = 1
+    data, mask = ci.read_data_at(512, 0)
+    for i in range(512):
+        assert mask[i] == written[i]
+        if written[i]:
+            assert data[i] == ref[i]
+
+
+# --- WFS over a live cluster ---
+
+@pytest.fixture(scope="module")
+def wfs():
+    from cluster_util import Cluster
+    c = Cluster(n_volume_servers=1)
+    filer = c.add_filer()
+    w = WFS(filer.url, chunk_size=8 * 1024, cache_ttl=0.0)
+    yield w
+    c.shutdown()
+
+
+def test_wfs_create_write_read(wfs):
+    fh = wfs.create("/m/file.txt")
+    assert wfs.write(fh, b"hello mount", 0) == 11
+    assert wfs.read(fh, 11, 0) == b"hello mount"  # read-your-writes
+    wfs.release(fh)
+    fh2 = wfs.open("/m/file.txt")
+    assert wfs.read(fh2, 100, 0) == b"hello mount"
+    wfs.release(fh2)
+    assert wfs.getattr("/m/file.txt")["size"] == 11
+
+
+def test_wfs_multi_chunk_flush(wfs):
+    fh = wfs.create("/m/big.bin")
+    payload = bytes(range(256)) * 128  # 32KB > 8KB chunk size
+    wfs.write(fh, payload, 0)
+    wfs.release(fh)
+    entry = wfs.lookup("/m/big.bin")
+    assert len(entry["chunks"]) >= 1
+    fh2 = wfs.open("/m/big.bin")
+    assert wfs.read(fh2, len(payload), 0) == payload
+    # random range read across chunk boundary
+    assert wfs.read(fh2, 100, 8150) == payload[8150:8250]
+    wfs.release(fh2)
+
+
+def test_wfs_overwrite_middle(wfs):
+    fh = wfs.create("/m/rw.txt")
+    wfs.write(fh, b"aaaaaaaaaa", 0)
+    wfs.release(fh)
+    fh = wfs.open("/m/rw.txt", for_write=True)
+    wfs.write(fh, b"XY", 4)
+    assert wfs.read(fh, 10, 0) == b"aaaaXYaaaa"  # merged dirty + remote
+    wfs.release(fh)
+    fh = wfs.open("/m/rw.txt")
+    assert wfs.read(fh, 10, 0) == b"aaaaXYaaaa"
+    wfs.release(fh)
+
+
+def test_wfs_dirs_and_readdir(wfs):
+    wfs.mkdir("/m/sub")
+    fh = wfs.create("/m/sub/inner.txt")
+    wfs.write(fh, b"x", 0)
+    wfs.release(fh)
+    names = wfs.readdir("/m/sub")
+    assert names == ["inner.txt"]
+    assert (wfs.getattr("/m/sub")["mode"] & 0o170000) == 0o040000
+    with pytest.raises(FuseError):
+        wfs.rmdir("/m/sub")  # not empty
+    wfs.unlink("/m/sub/inner.txt")
+    wfs.rmdir("/m/sub")
+    assert wfs.lookup("/m/sub") is None
+
+
+def test_wfs_rename(wfs):
+    fh = wfs.create("/m/old-name")
+    wfs.write(fh, b"renamed content", 0)
+    wfs.release(fh)
+    wfs.rename("/m/old-name", "/m/new-name")
+    assert wfs.lookup("/m/old-name") is None
+    fh = wfs.open("/m/new-name")
+    assert wfs.read(fh, 50, 0) == b"renamed content"
+    wfs.release(fh)
+
+
+def test_wfs_truncate(wfs):
+    fh = wfs.create("/m/trunc.bin")
+    wfs.write(fh, b"0123456789", 0)
+    wfs.release(fh)
+    wfs.truncate("/m/trunc.bin", 4)
+    assert wfs.getattr("/m/trunc.bin")["size"] == 4
+    fh = wfs.open("/m/trunc.bin")
+    assert wfs.read(fh, 10, 0) == b"0123"
+    wfs.release(fh)
+    wfs.truncate("/m/trunc.bin", 0)
+    assert wfs.getattr("/m/trunc.bin")["size"] == 0
+
+
+def test_wfs_enoent(wfs):
+    with pytest.raises(FuseError):
+        wfs.getattr("/does/not/exist")
+    with pytest.raises(FuseError):
+        wfs.open("/does/not/exist")
+    with pytest.raises(FuseError):
+        wfs.unlink("/does/not/exist")
