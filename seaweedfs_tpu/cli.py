@@ -108,7 +108,7 @@ def cmd_filer(args) -> None:
         default_collection=args.collection,
         meta_log_path=args.meta_log,
         peers=[p for p in args.peers.split(",") if p],
-        notifier=notifier))
+        notifier=notifier, guard=_load_guard()))
 
 
 def cmd_watch(args) -> None:
@@ -121,6 +121,20 @@ def cmd_watch(args) -> None:
             print(json.dumps(e.to_dict()), flush=True)
 
 
+def _offset_path(stem: str, *parts: str) -> str:
+    """Default resume-offset file: stable per-user directory (not CWD, so
+    daemon restarts with a different working dir still resume) +
+    human-readable first part + a hash of the full job identity
+    (source, sink, prefix) so distinct jobs never share an offset
+    (filer_sync.go setOffset/getOffset keys by signature)."""
+    import hashlib
+    base = os.path.join(os.path.expanduser("~"), ".seaweedfs_tpu", "offsets")
+    os.makedirs(base, exist_ok=True)
+    job_key = hashlib.sha256("|".join(parts).encode()).hexdigest()[:12]
+    human = parts[0].replace(":", "_").replace("/", "_") if parts else ""
+    return os.path.join(base, f"{stem}.{human}.{job_key}")
+
+
 def cmd_filer_replicate(args) -> None:
     """Continuously replicate one filer into a sink configured by
     replication.toml (weed filer.replicate)."""
@@ -131,7 +145,10 @@ def cmd_filer_replicate(args) -> None:
     if sink is None:
         raise SystemExit("no enabled [sink.*] in replication.toml "
                          "(run scaffold -config replication)")
-    Replicator(args.filer, sink, args.path_prefix).run()
+    offset = args.offset_file or _offset_path(
+        "replicate_offset", args.filer, sink.identity(), args.path_prefix)
+    Replicator(args.filer, sink, args.path_prefix,
+               offset_path=offset).run()
 
 
 def cmd_filer_sync(args) -> None:
@@ -152,9 +169,15 @@ def cmd_filer_sync(args) -> None:
 
     def one_direction(src: str, dst: str, dst_sig: int) -> None:
         # exclude events the destination already processed — the loop break
-        # of filer.sync (filer_sync.go signature filtering)
-        Replicator(src, FilerSink(dst),
-                   args.path_prefix).run(exclude_sig=dst_sig)
+        # of filer.sync (filer_sync.go signature filtering); per-direction
+        # offsets (keyed by src, dst AND prefix) persisted so restarts
+        # resume instead of full replay
+        offset = args.offset_file or _offset_path(
+            "sync_offset", src, dst, args.path_prefix)
+        if args.offset_file:
+            offset = f"{args.offset_file}.{src}_{dst}".replace(":", "_")
+        Replicator(src, FilerSink(dst), args.path_prefix,
+                   offset_path=offset).run(exclude_sig=dst_sig)
 
     ta = threading.Thread(target=one_direction,
                           args=(args.a, args.b, sig_b), daemon=True)
@@ -201,7 +224,7 @@ def cmd_download(args) -> None:
 
 def cmd_delete(args) -> None:
     from .client import Client
-    c = Client(args.server)
+    c = Client(args.server, guard=_load_guard())
     for fid in args.fids:
         c.delete(fid)
         print(f"deleted {fid}")
@@ -461,6 +484,8 @@ def build_parser() -> argparse.ArgumentParser:
                              "(replication.toml)")
     fr.add_argument("-filer", default="127.0.0.1:8888")
     fr.add_argument("-pathPrefix", dest="path_prefix", default="/")
+    fr.add_argument("-offsetFile", dest="offset_file", default="",
+                    help="resume-offset file (default derived from -filer)")
     fr.set_defaults(fn=cmd_filer_replicate)
 
     fsync = sub.add_parser("filer.sync",
@@ -468,6 +493,9 @@ def build_parser() -> argparse.ArgumentParser:
     fsync.add_argument("-a", required=True, help="filer A host:port")
     fsync.add_argument("-b", required=True, help="filer B host:port")
     fsync.add_argument("-pathPrefix", dest="path_prefix", default="/")
+    fsync.add_argument("-offsetFile", dest="offset_file", default="",
+                       help="resume-offset file stem (default: "
+                            "~/.seaweedfs_tpu/offsets/, keyed by job)")
     fsync.set_defaults(fn=cmd_filer_sync)
 
     mt = sub.add_parser("mount", help="FUSE-mount a filer path")

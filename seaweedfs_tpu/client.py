@@ -47,10 +47,27 @@ def _post_json(url: str, body: dict, timeout: float = 300.0) -> dict:
 
 
 class Client:
-    def __init__(self, master_url: str):
+    def __init__(self, master_url: str, guard=None):
         self.master = master_url.rstrip("/")
+        self.guard = guard  # security Guard for signing delete jwts
         self._vid_cache: dict[int, tuple[list[str], float]] = {}
         self._vid_cache_ttl = 60.0
+
+    def _write_auth_header(self, fid: str) -> dict:
+        """Write jwt signed with the shared key, for DELETEs — the
+        reference signs deletion jwts with security.toml's
+        jwt.signing.key (weed/security/jwt.go). Sign the canonical fid
+        form: the volume server verifies against str(FileId.parse(...)),
+        so extension/padding variants must normalize first."""
+        if self.guard is not None and self.guard.signing_key:
+            from .storage.file_id import FileId
+            try:
+                canonical = str(FileId.parse(fid))
+            except ValueError:
+                canonical = fid
+            return {"Authorization":
+                    f"BEARER {self.guard.sign_write(canonical)}"}
+        return {}
 
     # --- master ops ---
     def assign(self, count: int = 1, collection: str = "",
@@ -176,7 +193,8 @@ class Client:
         vid = int(fid.split(",")[0])
         for url in self.lookup(vid):
             req = urllib.request.Request(f"http://{url}/{fid}",
-                                         method="DELETE")
+                                         method="DELETE",
+                                         headers=self._write_auth_header(fid))
             try:
                 with urllib.request.urlopen(req, timeout=60):
                     return

@@ -45,6 +45,11 @@ class FilerClient:
 
     def __init__(self, filer_url: str):
         self.filer = filer_url.rstrip("/")
+        self._vid_cache: dict[int, tuple[list[str], float]] = {}
+        # set after the first 401: subsequent chunk reads fetch the read
+        # token up front instead of paying a guaranteed-401 round trip
+        self._read_auth_needed = False
+        self._fid_auth: dict[str, tuple[str, float]] = {}
 
     def _get_json(self, path_qs: str) -> Optional[dict]:
         try:
@@ -128,6 +133,73 @@ class FilerClient:
                 return b""
             raise
 
+    def lookup_volume(self, vid: int) -> list[str]:
+        cached = self._vid_cache.get(vid)
+        if cached and time.time() - cached[1] < 60.0:
+            return cached[0]
+        out = self._get_json(f"/__meta__/lookup_volume?volumeId={vid}")
+        urls = [loc["url"] for loc in (out or {}).get("locations", [])]
+        if urls:
+            self._vid_cache[vid] = (urls, time.time())
+        return urls
+
+    def lookup_fid_with_auth(self, fid: str) -> tuple[list[str], str]:
+        """Per-fid lookup via the filer — returns (urls, read_jwt); the
+        filer passes through the master's read token when a read key is
+        configured."""
+        out = self._get_json("/__meta__/lookup_volume?"
+                             + urllib.parse.urlencode({"fileId": fid}))
+        urls = [loc["url"] for loc in (out or {}).get("locations", [])]
+        return urls, (out or {}).get("auth", "")
+
+    def read_chunk(self, fid: str, offset_in_chunk: int, size: int) -> bytes:
+        """Fetch a sub-range of one chunk straight from a volume server —
+        used for handle-local chunks the filer doesn't know about yet.
+        Falls back to a per-fid read-jwt lookup on 401."""
+        vid = int(fid.split(",")[0])
+        last: Optional[Exception] = None
+        urls, auth = self.lookup_volume(vid), ""
+        if self._read_auth_needed:
+            cached = self._fid_auth.get(fid)
+            if cached and time.time() - cached[1] < 30.0:
+                auth = cached[0]
+            else:
+                fid_urls, auth = self.lookup_fid_with_auth(fid)
+                urls = fid_urls or urls
+                if auth:
+                    self._fid_auth[fid] = (auth, time.time())
+        for attempt in range(2):
+            for url in urls:
+                headers = {"Range": f"bytes={offset_in_chunk}-"
+                                    f"{offset_in_chunk + size - 1}"}
+                if auth:
+                    headers["Authorization"] = f"BEARER {auth}"
+                req = urllib.request.Request(f"http://{url}/{fid}",
+                                             headers=headers)
+                try:
+                    with urllib.request.urlopen(req, timeout=300) as r:
+                        data = r.read()
+                        if r.status == 200:
+                            data = data[offset_in_chunk:
+                                        offset_in_chunk + size]
+                        return data
+                except urllib.error.HTTPError as e:
+                    last = e
+                    if e.code == 401 and attempt == 0:
+                        break  # acquire a read token and retry
+                except Exception as e:
+                    last = e
+                    self._vid_cache.pop(vid, None)
+            if (attempt == 0 and isinstance(last, urllib.error.HTTPError)
+                    and last.code == 401):
+                self._read_auth_needed = True
+                urls, auth = self.lookup_fid_with_auth(fid)
+                if auth:
+                    self._fid_auth[fid] = (auth, time.time())
+                continue
+            break
+        raise IOError(f"read chunk {fid}: {last}")
+
 
 class FileHandle:
     """One open file: read-through + write-back dirty pages
@@ -142,6 +214,9 @@ class FileHandle:
         self.flags_write = flags_write
         self._lock = threading.Lock()
         self.ref_count = 1
+        # True while the handle holds early-flushed chunks the filer
+        # doesn't know about yet; reads then use the handle's chunk view
+        self._has_local_chunks = False
 
     # --- size helpers ---
     def _entry_size(self) -> int:
@@ -170,11 +245,27 @@ class FileHandle:
         size = min(size, file_size - offset)
         if all(mask[:size]):
             return dirty_data[:size]
-        remote = b""
-        if self._entry_size() > offset:
-            remote = self.wfs.client.read_range(self.path, offset, size)
+        # Mid-write (handle holds early-flushed chunks the filer doesn't
+        # know about yet): serve non-dirty ranges from the handle's own
+        # chunk list so read-your-writes holds between an auto-flush and
+        # flush() without persisting intermediate entries cluster-wide
+        # (the reference likewise reads via the handle's chunk view,
+        # weed/filesys/filehandle.go). Otherwise read through the filer
+        # path, which stays fresh w.r.t. writes by other clients.
         buf = bytearray(size)
-        buf[:len(remote)] = remote
+        if self._entry_size() > offset:
+            if self._has_local_chunks:
+                from ..filer.chunks import FileChunk as FC, read_plan
+                chunks = [FC.from_dict(c)
+                          for c in self.entry.get("chunks", [])]
+                for view in read_plan(chunks, offset, size):
+                    data = self.wfs.client.read_chunk(
+                        view.fid, view.offset_in_chunk, view.size)
+                    pos = view.logic_offset - offset
+                    buf[pos:pos + len(data)] = data
+            else:
+                remote = self.wfs.client.read_range(self.path, offset, size)
+                buf[:len(remote)] = remote
         for i in range(size):
             if mask[i]:
                 buf[i] = dirty_data[i]
@@ -188,10 +279,14 @@ class FileHandle:
                 "mtime": time.time_ns(), "etag": ""}
 
     def _flush_largest_locked(self) -> None:
+        # early-flushed chunks stay handle-local until flush(); read()
+        # serves them from the handle's chunk list, so mid-write state
+        # is never visible cluster-wide
         iv = self.dirty.pop_largest_contiguous()
         if iv is not None:
             self.entry.setdefault("chunks", []).append(
                 self._upload_interval(iv))
+            self._has_local_chunks = True
 
     def flush(self) -> None:
         """Upload remaining dirty runs and save the entry
@@ -202,6 +297,7 @@ class FileHandle:
                     self._upload_interval(iv))
             self.entry.setdefault("attr", {})["mtime"] = time.time()
             self.wfs.client.create_entry(self.entry, free_old_chunks=False)
+            self._has_local_chunks = False
             self.wfs.meta_cache.invalidate(self.path)
 
     def release(self) -> None:

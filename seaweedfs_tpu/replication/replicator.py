@@ -9,6 +9,7 @@ signature-based loop prevention, weed/command/filer_sync.go:81-330).
 from __future__ import annotations
 
 import json
+import os
 import time
 import urllib.parse
 import urllib.request
@@ -21,11 +22,49 @@ from .sink import ReplicationSink
 
 
 class Replicator:
+    # transient sink failures are retried from the last good offset; after
+    # this many consecutive failures of the SAME event it is treated as
+    # poisoned (e.g. create of a path already deleted at the source) and
+    # skipped with a loud error, so head-of-line livelock is bounded
+    MAX_EVENT_RETRIES = 3
+
     def __init__(self, source_filer: str, sink: ReplicationSink,
-                 source_path_prefix: str = "/"):
+                 source_path_prefix: str = "/",
+                 offset_path: str = ""):
         self.source = source_filer.rstrip("/")
         self.sink = sink
         self.prefix = source_path_prefix
+        # persisted resume offset so restarts don't replay the whole
+        # meta log (reference persists per-source sync offsets,
+        # weed/command/filer_sync.go setOffset/getOffset)
+        self.offset_path = offset_path
+
+    def load_offset(self) -> int:
+        if self.offset_path and os.path.exists(self.offset_path):
+            try:
+                with open(self.offset_path, encoding="utf-8") as f:
+                    return int(json.load(f)["since"])
+            except Exception:
+                return 0
+        return 0
+
+    def save_offset(self, tsns: int) -> None:
+        if not self.offset_path:
+            return
+        tmp = self.offset_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"since": tsns}, f)
+        os.replace(tmp, self.offset_path)
+        self._last_save = time.monotonic()
+
+    def _maybe_save_offset(self, tsns: int) -> None:
+        """Throttled persist: at most ~1/s on the hot path (the reference
+        persists offsets periodically too, filer_sync.go setOffset)."""
+        if not self.offset_path:
+            return
+        now = time.monotonic()
+        if now - getattr(self, "_last_save", 0.0) >= 1.0:
+            self.save_offset(tsns)
 
     def _fetch_entry_data(self, entry: Entry) -> bytes:
         """Read the file body from the source filer (repl_util chunk fetch
@@ -83,19 +122,59 @@ class Replicator:
     def run(self, since: int = 0, max_events: Optional[int] = None,
             stop_check=None, exclude_sig: int = 0) -> int:
         """Consume the live stream and apply each event. Returns the count
-        applied (bounded runs are for tests)."""
+        applied (bounded runs are for tests). Resumes from the persisted
+        offset when one exists and no explicit `since` is given.
+
+        The offset only advances past events that applied successfully;
+        on a sink failure the subscription is torn down and re-established
+        from the last good offset after a backoff, so a transiently
+        unreachable sink never loses events (the reference likewise only
+        advances after the event fn succeeds, filer_sync.go
+        processEventFnWithOffset)."""
         applied = 0
-        for e in self.subscribe_events(since, reconnect=max_events is None,
-                                       exclude_sig=exclude_sig):
-            try:
-                self.apply(e)
+        if since == 0:
+            since = self.load_offset()
+        reconnect = max_events is None
+        fail_tsns, fail_count = 0, 0
+        while True:
+            resubscribe = False
+            for e in self.subscribe_events(since, reconnect=reconnect,
+                                           exclude_sig=exclude_sig):
+                if stop_check is not None and stop_check():
+                    break
+                try:
+                    self.apply(e)
+                except Exception as ex:
+                    fail_count = fail_count + 1 if e.tsns == fail_tsns else 1
+                    fail_tsns = e.tsns
+                    if fail_count >= self.MAX_EVENT_RETRIES:
+                        # poison event: a transient sink outage would have
+                        # recovered by now — skip it (loudly) rather than
+                        # livelock every event behind it
+                        glog.error(
+                            "replicate event at %d failed %d times: %s — "
+                            "SKIPPING (entry may be missing at sink)",
+                            e.tsns, fail_count, ex)
+                        since = e.tsns
+                        self._maybe_save_offset(e.tsns)
+                        continue
+                    glog.error("replicate event at %d failed: %s "
+                               "(retry %d/%d from last good offset)",
+                               e.tsns, ex, fail_count,
+                               self.MAX_EVENT_RETRIES)
+                    resubscribe = True
+                    break
                 applied += 1
-            except Exception as ex:
-                glog.error("replicate event at %d failed: %s", e.tsns, ex)
-            if max_events is not None and applied >= max_events:
+                since = e.tsns
+                self._maybe_save_offset(e.tsns)
+                if max_events is not None and applied >= max_events:
+                    break
+            self.save_offset(since)
+            if not resubscribe or not reconnect:
                 break
             if stop_check is not None and stop_check():
                 break
+            time.sleep(1.0)
         return applied
 
 

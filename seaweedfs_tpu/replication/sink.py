@@ -43,6 +43,11 @@ class ReplicationSink:
     def close(self) -> None:
         pass
 
+    def identity(self) -> str:
+        """Stable string identifying the sink *target* — used to key
+        per-job replication resume offsets."""
+        return type(self).__name__
+
 
 class LocalSink(ReplicationSink):
     """Materialize the replicated tree under a local directory."""
@@ -50,6 +55,9 @@ class LocalSink(ReplicationSink):
     def __init__(self, directory: str):
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
+
+    def identity(self) -> str:
+        return f"LocalSink:{os.path.abspath(self.directory)}"
 
     def _path(self, entry_path: str) -> str:
         return os.path.join(self.directory, entry_path.lstrip("/"))
@@ -85,6 +93,9 @@ class FilerSink(ReplicationSink):
     def __init__(self, filer_url: str, directory: str = "/"):
         self.filer = filer_url.rstrip("/")
         self.directory = directory.rstrip("/")
+
+    def identity(self) -> str:
+        return f"FilerSink:{self.filer}{self.directory}"
 
     def _url(self, entry_path: str, **params) -> str:
         qs = urllib.parse.urlencode(

@@ -63,7 +63,8 @@ class FilerServer:
                  default_collection: str = "",
                  meta_log_path: str = "",
                  peers: Optional[list[str]] = None,
-                 notifier=None):
+                 notifier=None,
+                 guard=None):
         self.master_url = master_url
         self.chunk_size = chunk_size
         self.default_replication = default_replication
@@ -72,6 +73,7 @@ class FilerServer:
                            on_delete_chunks=self._queue_chunk_deletes,
                            meta_log_path=meta_log_path)
         self.peers = [p for p in (peers or []) if p]
+        self.guard = guard
         self.notifier = notifier
         if notifier is not None:
             self.filer.meta_log.subscribe(notifier.notify)
@@ -211,7 +213,20 @@ class FilerServer:
         return web.json_response(a)
 
     async def meta_lookup_volume(self, request: web.Request) -> web.Response:
-        """Proxy volume location lookup (LookupVolume RPC)."""
+        """Proxy volume location lookup (LookupVolume RPC). With
+        ?fileId=<fid> the master's per-fid read token (when a read key is
+        configured) is passed through so mount clients can fetch chunks
+        straight from volume servers (filer LookupVolume returns read
+        jwts in the reference, weed/security/jwt.go GenReadJwt)."""
+        fid = request.query.get("fileId", "")
+        if fid:
+            async with self._session.get(
+                    f"http://{self.master_url}/dir/lookup",
+                    params={"fileId": fid}) as r:
+                body = await r.json()
+            if "error" in body and not body.get("locations"):
+                return web.json_response(body, status=404)
+            return web.json_response(body)
         try:
             vid = int(request.query["volumeId"])
         except (KeyError, ValueError):
@@ -339,14 +354,29 @@ class FilerServer:
             chunk: FileChunk = await self._delete_queue.get()
             try:
                 vid = int(chunk.fid.split(",")[0])
+                headers = {}
+                # sign a write jwt with the shared signing key so volume
+                # servers with jwt.signing.key configured accept the
+                # delete (reference filer signs deletion jwts the same way)
+                if self.guard is not None and self.guard.signing_key:
+                    headers["Authorization"] = (
+                        f"BEARER {self.guard.sign_write(chunk.fid)}")
+                freed = False
                 for url in await self._lookup(vid):
                     try:
                         async with self._session.delete(
-                                f"http://{url}/{chunk.fid}") as r:
+                                f"http://{url}/{chunk.fid}",
+                                headers=headers) as r:
                             if r.status in (200, 202, 404):
+                                freed = True
                                 break
+                            log.warning("chunk delete %s on %s: HTTP %d",
+                                        chunk.fid, url, r.status)
                     except aiohttp.ClientError:
                         continue
+                if not freed:
+                    log.warning("chunk %s not freed on any replica",
+                                chunk.fid)
             except Exception as e:
                 log.warning("chunk delete %s failed: %s", chunk.fid, e)
 

@@ -57,7 +57,23 @@ class MasterServer:
         self.app = self._build_app()
 
     def _build_app(self) -> web.Application:
-        app = web.Application(client_max_size=64 * 1024 * 1024)
+        @web.middleware
+        async def guard_mw(request: web.Request, handler):
+            # IP whitelist wraps every master route except liveness and
+            # the heartbeat intake (guard.WhiteList around the master's
+            # public HTTP handlers, weed/server/master_server.go:115-126;
+            # heartbeats arrive over unguarded gRPC in the reference, so
+            # a client whitelist must never sever volume-server
+            # registration) — without this a non-whitelisted client could
+            # mint write/read JWTs via /dir/assign and /dir/lookup.
+            if request.path not in ("/healthz", "/heartbeat"):
+                if not self.guard.check_whitelist(request.remote or ""):
+                    return web.json_response({"error": "ip not allowed"},
+                                             status=403)
+            return await handler(request)
+
+        app = web.Application(client_max_size=64 * 1024 * 1024,
+                              middlewares=[guard_mw])
         app.router.add_get("/dir/assign", self.dir_assign)
         app.router.add_get("/dir/lookup", self.dir_lookup)
         app.router.add_get("/dir/status", self.dir_status)
@@ -131,7 +147,11 @@ class MasterServer:
         q = request.query
         vid_str = q.get("volumeId", q.get("fileId", ""))
         if "," in vid_str:
-            vid = FileId.parse(vid_str).volume_id
+            try:
+                vid = FileId.parse(vid_str).volume_id
+            except ValueError:
+                return web.json_response({"error": "invalid fileId"},
+                                         status=400)
         else:
             try:
                 vid = int(vid_str)

@@ -176,3 +176,21 @@ def test_wfs_enoent(wfs):
         wfs.open("/does/not/exist")
     with pytest.raises(FuseError):
         wfs.unlink("/does/not/exist")
+
+
+def test_wfs_read_your_writes_after_auto_flush(wfs):
+    """Non-dirty ranges must read back correctly between an early
+    auto-flush (buffer > chunk_size) and the final flush() — the
+    early-flushed chunk is persisted to the filer immediately."""
+    fh = wfs.create("/m/autoflush.bin")
+    payload = bytes((i * 7 + 3) % 256 for i in range(20 * 1024))  # > 2 chunks
+    wfs.write(fh, payload, 0)  # triggers _flush_largest_locked
+    # handle still open, final flush not yet called: every byte must match
+    assert wfs.read(fh, len(payload), 0) == payload
+    # a range that is entirely inside the auto-flushed (non-dirty) region
+    h = wfs.handles[fh]
+    assert h.dirty.buffered_bytes() < len(payload)
+    wfs.release(fh)
+    fh2 = wfs.open("/m/autoflush.bin")
+    assert wfs.read(fh2, len(payload), 0) == payload
+    wfs.release(fh2)
