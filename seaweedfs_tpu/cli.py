@@ -172,10 +172,12 @@ def cmd_filer_sync(args) -> None:
         # of filer.sync (filer_sync.go signature filtering); per-direction
         # offsets (keyed by src, dst AND prefix) persisted so restarts
         # resume instead of full replay
-        offset = args.offset_file or _offset_path(
-            "sync_offset", src, dst, args.path_prefix)
         if args.offset_file:
-            offset = f"{args.offset_file}.{src}_{dst}".replace(":", "_")
+            # sanitize only the per-direction suffix, never the user path
+            suffix = f"{src}_{dst}".replace(":", "_").replace("/", "_")
+            offset = f"{args.offset_file}.{suffix}"
+        else:
+            offset = _offset_path("sync_offset", src, dst, args.path_prefix)
         Replicator(src, FilerSink(dst), args.path_prefix,
                    offset_path=offset).run(exclude_sig=dst_sig)
 

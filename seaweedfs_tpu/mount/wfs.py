@@ -143,6 +143,18 @@ class FilerClient:
             self._vid_cache[vid] = (urls, time.time())
         return urls
 
+    def _cache_fid_auth(self, fid: str, auth: str) -> None:
+        """Bounded short-TTL token cache: fids are unbounded on a
+        long-lived mount, so stale entries are swept when the cache
+        grows instead of leaking forever."""
+        now = time.time()
+        if len(self._fid_auth) >= 4096:
+            self._fid_auth = {f: (a, ts) for f, (a, ts)
+                              in self._fid_auth.items() if now - ts < 30.0}
+            if len(self._fid_auth) >= 4096:
+                self._fid_auth.clear()
+        self._fid_auth[fid] = (auth, now)
+
     def lookup_fid_with_auth(self, fid: str) -> tuple[list[str], str]:
         """Per-fid lookup via the filer — returns (urls, read_jwt); the
         filer passes through the master's read token when a read key is
@@ -167,7 +179,7 @@ class FilerClient:
                 fid_urls, auth = self.lookup_fid_with_auth(fid)
                 urls = fid_urls or urls
                 if auth:
-                    self._fid_auth[fid] = (auth, time.time())
+                    self._cache_fid_auth(fid, auth)
         for attempt in range(2):
             for url in urls:
                 headers = {"Range": f"bytes={offset_in_chunk}-"
@@ -193,9 +205,10 @@ class FilerClient:
             if (attempt == 0 and isinstance(last, urllib.error.HTTPError)
                     and last.code == 401):
                 self._read_auth_needed = True
-                urls, auth = self.lookup_fid_with_auth(fid)
+                fid_urls, auth = self.lookup_fid_with_auth(fid)
+                urls = fid_urls or urls
                 if auth:
-                    self._fid_auth[fid] = (auth, time.time())
+                    self._cache_fid_auth(fid, auth)
                 continue
             break
         raise IOError(f"read chunk {fid}: {last}")

@@ -59,14 +59,16 @@ class MasterServer:
     def _build_app(self) -> web.Application:
         @web.middleware
         async def guard_mw(request: web.Request, handler):
-            # IP whitelist wraps every master route except liveness and
-            # the heartbeat intake (guard.WhiteList around the master's
-            # public HTTP handlers, weed/server/master_server.go:115-126;
-            # heartbeats arrive over unguarded gRPC in the reference, so
-            # a client whitelist must never sever volume-server
-            # registration) — without this a non-whitelisted client could
-            # mint write/read JWTs via /dir/assign and /dir/lookup.
-            if request.path not in ("/healthz", "/heartbeat"):
+            # IP whitelist wraps every master route except liveness
+            # (guard.WhiteList around the master's HTTP handlers,
+            # weed/server/master_server.go:115-126) — without this a
+            # non-whitelisted client could mint write/read JWTs via
+            # /dir/assign and /dir/lookup. /heartbeat is guarded too:
+            # exempting it would let any host register itself as a
+            # volume server and receive client traffic. A configured
+            # white_list must therefore include the volume servers
+            # (documented in the security.toml scaffold).
+            if request.path != "/healthz":
                 if not self.guard.check_whitelist(request.remote or ""):
                     return web.json_response({"error": "ip not allowed"},
                                              status=403)

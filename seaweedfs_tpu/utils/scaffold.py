@@ -18,7 +18,9 @@ key = ""
 expires_after_seconds = 60
 
 [guard]
-# comma-separated IPs / CIDRs allowed to talk to servers; empty = open
+# comma-separated IPs / CIDRs allowed to talk to servers; empty = open.
+# NOTE: the whitelist guards every master route including /heartbeat, so
+# it MUST include the volume servers' IPs or they cannot register.
 white_list = ""
 """
 
