@@ -1,19 +1,32 @@
 #!/usr/bin/env python3
-"""Headline benchmark: RS(10,4) ec.encode throughput on one chip.
+"""Headline benchmark: the RS(10,4) ec.encode PIPELINE on one chip.
 
-Mirrors BASELINE config 2 (batched volumes, 1MB-block stripes -> TPU): feeds
-the fused Pallas GF(2^8) kernel 640MB data batches ([10 x 64MiB] stripes,
-i.e. the coder-visible shape of the reference encode loop
-weed/storage/erasure_coding/ec_encoder.go:162-192) and reports steady-state
-data throughput. Baseline for vs_baseline is the BASELINE.json north-star
-target of 20 GB/s/chip.
+Round-1 benched only the kernel on pre-staged HBM arrays; the north star
+(BASELINE config 1/2) is the full `.dat` -> `.ec00-13` encode path the
+servers actually run. This bench measures, in order:
+
+  pipeline   stream_encode of a >=1GB synthetic volume at the reference
+             geometry (1MB small-block stripes for a 1GB volume — the exact
+             layout ec_encoder.go:194-231 produces), overlapped disk read /
+             host->HBM / Pallas kernel / 14-way shard write-back
+             (seaweedfs_tpu/ec/pipeline.py). This is the headline metric.
+  kernel     the fused Pallas GF(2^8) kernel on resident data (the on-TPU
+             portion; BASELINE target >=20 GB/s/chip)
+  rebuild    stream_rebuild of 4 missing shards from 10 survivors, p50 over
+             repetitions (BASELINE config 3)
+  sweep      kernel encode GB/s at RS(6,3)/(12,4)/(20,4) (BASELINE config 4)
 
 Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N, "extra": {...}}
+vs_baseline is pipeline GB/s over the 20 GB/s/chip north-star target.
 """
 
 import json
+import os
+import shutil
+import statistics
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -21,43 +34,167 @@ import numpy as np
 BASELINE_GBPS = 20.0  # BASELINE.json: ec.encode >= 20 GB/s/chip on v5e
 
 
-def main() -> None:
+def _make_volume(path: str, size: int) -> None:
+    rng = np.random.default_rng(7)
+    with open(path, "wb") as f:
+        left = size
+        while left > 0:
+            n = min(left, 64 * 1024 * 1024)
+            f.write(rng.integers(0, 256, n, dtype=np.uint8).tobytes())
+            left -= n
+
+
+def measure_link() -> tuple[float, float]:
+    """Host<->device link bandwidth (GB/s). On tunneled single-chip dev
+    environments (axon) the device->host direction can be orders of
+    magnitude slower than HBM — it caps any pipeline that must land parity
+    bytes on host disk, so it is measured and reported explicitly."""
+    import jax
+    x = np.zeros(64 * 1024 * 1024, dtype=np.uint8)
+    d = jax.device_put(x)
+    d.block_until_ready()
+    t0 = time.perf_counter()
+    d = jax.device_put(x)
+    d.block_until_ready()
+    h2d = x.nbytes / (time.perf_counter() - t0) / 1e9
+    np.asarray(d)  # first fetch may include warmup
+    e = jax.device_put(np.ones_like(x))
+    e.block_until_ready()
+    t0 = time.perf_counter()
+    np.asarray(e)
+    d2h = x.nbytes / (time.perf_counter() - t0) / 1e9
+    return h2d, d2h
+
+
+def bench_kernel(k: int, m: int, n: int, reps: int):
     import jax
     import jax.numpy as jnp
+    from seaweedfs_tpu.ops import gf256, rs_jax, rs_pallas
 
-    from seaweedfs_tpu.ops import gf256, rs_pallas
-
-    backend = jax.default_backend()
-    n = 64 * 1024 * 1024 if backend == "tpu" else 1024 * 1024
     data = jnp.asarray(
-        np.random.default_rng(0).integers(0, 256, (10, n), dtype=np.uint8))
-
-    fn = rs_pallas.gf_apply_pallas(gf256.parity_matrix(10, 4))
+        np.random.default_rng(0).integers(0, 256, (k, n), dtype=np.uint8))
+    if jax.default_backend() == "tpu":
+        fn = rs_pallas.gf_apply_pallas(gf256.parity_matrix(k, m))
+    else:
+        # pallas interpret mode is a pure-python emulator — useless for
+        # timing; the XLA bitplane path is the honest CPU kernel
+        fn = jax.jit(rs_jax.gf_apply_bitplane(gf256.parity_matrix(k, m)))
     out = fn(data)
     out.block_until_ready()  # compile + warm
 
     # correctness gate: never report speed for wrong parity
     check = np.asarray(out[:, :65536])
-    want = gf256.encode_parity(np.asarray(data[:, :65536]), 4)
+    want = gf256.encode_parity(np.asarray(data[:, :65536]), m)
     if not np.array_equal(check, want):
-        print(json.dumps({"metric": "ec.encode GB/s/chip", "value": 0.0,
-                          "unit": "GB/s", "vs_baseline": 0.0,
-                          "error": "parity mismatch"}))
-        sys.exit(1)
+        raise AssertionError(f"parity mismatch at RS({k},{m})")
 
-    reps = 10 if backend == "tpu" else 3
     t0 = time.perf_counter()
     for _ in range(reps):
         out = fn(data)
     out.block_until_ready()
     dt = (time.perf_counter() - t0) / reps
+    return (k * n) / dt / 1e9
 
-    gbps = (10 * n) / dt / 1e9
+
+def main() -> None:
+    import jax
+
+    from seaweedfs_tpu import ec
+    from seaweedfs_tpu.ec import pipeline
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    # CPU fallback keeps the bench runnable in dev; the recorded numbers
+    # come from the driver's TPU run.
+    vol_size = (1024 * 1024 * 1024) if on_tpu else (16 * 1024 * 1024)
+    kernel_n = (64 * 1024 * 1024) if on_tpu else (1024 * 1024)
+    kernel_reps = 10 if on_tpu else 3
+    rebuild_reps = 3 if on_tpu else 1
+    batch = 16 * 1024 * 1024 if on_tpu else 1024 * 1024
+
+    h2d_gbps, d2h_gbps = measure_link()
+    if on_tpu:
+        coder = ec.get_coder("pallas", 10, 4)
+    else:
+        try:
+            coder = ec.get_coder("cpp", 10, 4)
+        except Exception:
+            coder = ec.get_coder("jax", 10, 4)
+    work = tempfile.mkdtemp(prefix="swfs_bench_")
+    try:
+        _run_configs(work, coder, vol_size, kernel_n, kernel_reps,
+                     rebuild_reps, batch, backend, h2d_gbps, d2h_gbps)
+    except AssertionError as e:
+        # keep the one-JSON-line contract even for correctness failures
+        print(json.dumps({
+            "metric": "ec.encode pipeline GB/s/chip (.dat -> .ec00-13)",
+            "value": 0.0, "unit": "GB/s", "vs_baseline": 0.0,
+            "error": str(e)}))
+        sys.exit(1)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+def _run_configs(work, coder, vol_size, kernel_n, kernel_reps, rebuild_reps,
+                 batch, backend, h2d_gbps, d2h_gbps) -> None:
+    from seaweedfs_tpu import ec
+    from seaweedfs_tpu.ec import pipeline
+
+    base = os.path.join(work, "1")
+    _make_volume(base + ".dat", vol_size)
+
+    # run 1 warms every kernel shape (batch + tail widths); run 2 is
+    # the steady-state measurement
+    pipeline.stream_encode(base, coder, batch_size=batch)
+    for i in range(14):
+        os.remove(base + ec.to_ext(i))
+    t0 = time.perf_counter()
+    pipeline.stream_encode(base, coder, batch_size=batch)
+    pipeline_dt = time.perf_counter() - t0
+    pipeline_gbps = vol_size / pipeline_dt / 1e9
+
+    # rebuild p50 (config 3): 4 missing shards from 10 survivors;
+    # one untimed warm pass compiles the reconstruction kernel
+    victims = [0, 3, 7, 12]
+    times = []
+    for rep in range(rebuild_reps + 1):
+        for v in victims:
+            os.remove(base + ec.to_ext(v))
+        t0 = time.perf_counter()
+        pipeline.stream_rebuild(base, coder, batch_size=batch)
+        if rep > 0:
+            times.append(time.perf_counter() - t0)
+    rebuild_p50 = statistics.median(times)
+    shard_size = os.path.getsize(base + ec.to_ext(0))
+
+    kernel_gbps = bench_kernel(10, 4, kernel_n, kernel_reps)
+    sweep = {}
+    for (k, m) in ((6, 3), (12, 4), (20, 4)):
+        n = kernel_n - kernel_n % (16384 * 8)
+        sweep[f"{k},{m}"] = round(bench_kernel(k, m, n, kernel_reps), 2)
+
     print(json.dumps({
-        "metric": "ec.encode GB/s/chip",
-        "value": round(gbps, 2),
+        "metric": "ec.encode pipeline GB/s/chip (.dat -> .ec00-13)",
+        "value": round(pipeline_gbps, 2),
         "unit": "GB/s",
-        "vs_baseline": round(gbps / BASELINE_GBPS, 3),
+        "vs_baseline": round(pipeline_gbps / BASELINE_GBPS, 3),
+        "extra": {
+            "backend": backend,
+            "volume_bytes": vol_size,
+            "kernel_gbps": round(kernel_gbps, 2),
+            "kernel_vs_target": round(kernel_gbps / BASELINE_GBPS, 3),
+            "rebuild_p50_s": round(rebuild_p50, 3),
+            "rebuild_gbps": round(
+                10 * shard_size / rebuild_p50 / 1e9, 2),
+            "sweep_kernel_gbps": sweep,
+            "link_h2d_gbps": round(h2d_gbps, 3),
+            "link_d2h_gbps": round(d2h_gbps, 3),
+            "note": ("pipeline includes disk read, host<->device transfer "
+                     "and 14-way shard write-back; on a tunneled dev chip "
+                     "the device->host link (link_d2h_gbps) bounds it, "
+                     "since m/k of the volume (parity) must return to "
+                     "host disk. kernel_gbps is the on-TPU portion."),
+        },
     }))
 
 

@@ -47,11 +47,16 @@ def _load_guard():
 
 def cmd_master(args) -> None:
     from .server.master import run_master
+    url = f"{args.ip}:{args.port}"
+    peers = [p.strip() for p in args.peers.split(",") if p.strip()]
     _run_forever(run_master(
         args.ip, args.port,
         volume_size_limit_mb=args.volume_size_limit_mb,
         default_replication=args.default_replication,
-        guard=_load_guard()))
+        guard=_load_guard(),
+        url=url,
+        peers=peers or None,
+        raft_state_dir=args.mdir or None))
 
 
 def cmd_volume(args) -> None:
@@ -431,6 +436,11 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("-port", type=int, default=9333)
     m.add_argument("-volume_size_limit_mb", type=int, default=30 * 1024)
     m.add_argument("-default_replication", default="000")
+    m.add_argument("-peers", default="",
+                   help="comma-separated ip:port of ALL masters (incl. self)"
+                        " for raft HA (weed master -peers)")
+    m.add_argument("-mdir", default="",
+                   help="directory for persisted raft state")
     m.set_defaults(fn=cmd_master)
 
     v = sub.add_parser("volume", help="run a volume server")

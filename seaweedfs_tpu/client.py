@@ -48,10 +48,50 @@ def _post_json(url: str, body: dict, timeout: float = 300.0) -> dict:
 
 class Client:
     def __init__(self, master_url: str, guard=None):
-        self.master = master_url.rstrip("/")
+        # comma-separated HA master list; requests fail over to the next
+        # master when one is unreachable or leaderless (the reference
+        # client follows KeepConnected leader hints, wdclient/masterclient.go)
+        self.masters = [m.strip().rstrip("/")
+                        for m in master_url.split(",") if m.strip()]
+        self._master_i = 0
         self.guard = guard  # security Guard for signing delete jwts
         self._vid_cache: dict[int, tuple[list[str], float]] = {}
         self._vid_cache_ttl = 60.0
+
+    @property
+    def master(self) -> str:
+        return self.masters[self._master_i]
+
+    def _master_get(self, path_qs: str, timeout: float = 30.0) -> dict:
+        """GET against the current master, rotating through the HA list on
+        connection failure, 502/503/504, or leaderless/proxy-failed
+        replies (covering the follower whose leader just died)."""
+        last: Optional[Exception] = None
+        for _ in range(max(2 * len(self.masters), 2)):
+            try:
+                url = f"http://{self.master}{path_qs}"
+                try:
+                    with urllib.request.urlopen(url, timeout=timeout) as r:
+                        return json.load(r)
+                except urllib.error.HTTPError as e:
+                    if e.code in (502, 503, 504):
+                        raise ClientError(
+                            f"master {self.master}: HTTP {e.code}") from e
+                    try:
+                        return json.load(e)
+                    except ClientError:
+                        raise
+                    except Exception:
+                        raise ClientError(
+                            f"GET {url}: HTTP {e.code}") from e
+            except (ClientError, urllib.error.URLError, OSError) as e:
+                last = e
+                if len(self.masters) > 1:
+                    self._master_i = (self._master_i + 1) % len(self.masters)
+                    time.sleep(0.05)
+                else:
+                    raise
+        raise ClientError(f"all masters failed: {last}")
 
     def _write_auth_header(self, fid: str) -> dict:
         """Write jwt signed with the shared key, for DELETEs — the
@@ -79,8 +119,7 @@ class Client:
             params["replication"] = replication
         if ttl:
             params["ttl"] = ttl
-        out = _get_json(f"http://{self.master}/dir/assign?"
-                        + urllib.parse.urlencode(params))
+        out = self._master_get("/dir/assign?" + urllib.parse.urlencode(params))
         if "error" in out:
             raise ClientError(out["error"])
         return out
@@ -89,7 +128,7 @@ class Client:
         cached = self._vid_cache.get(vid)
         if cached and time.time() - cached[1] < self._vid_cache_ttl:
             return cached[0]
-        out = _get_json(f"http://{self.master}/dir/lookup?volumeId={vid}")
+        out = self._master_get(f"/dir/lookup?volumeId={vid}")
         urls = [loc["url"] for loc in out.get("locations", [])]
         if not urls:
             raise ClientError(out.get("error", f"volume {vid} not found"))
@@ -100,11 +139,10 @@ class Client:
              replication: str = "", ttl: str = "") -> dict:
         params = {"count": str(count), "collection": collection,
                   "replication": replication, "ttl": ttl}
-        return _get_json(f"http://{self.master}/vol/grow?"
-                         + urllib.parse.urlencode(params))
+        return self._master_get("/vol/grow?" + urllib.parse.urlencode(params))
 
     def cluster_status(self) -> dict:
-        return _get_json(f"http://{self.master}/cluster/status")
+        return self._master_get("/cluster/status")
 
     # --- blob ops ---
     def upload_blob(self, url: str, fid: str, data: bytes,
@@ -153,8 +191,8 @@ class Client:
         """Per-fid lookup; returns (urls, read_jwt) — the master signs a
         read token when a read key is configured (weed/security/jwt.go
         GenReadJwt)."""
-        out = _get_json(f"http://{self.master}/dir/lookup?"
-                        + urllib.parse.urlencode({"fileId": fid}))
+        out = self._master_get("/dir/lookup?"
+                               + urllib.parse.urlencode({"fileId": fid}))
         urls = [loc["url"] for loc in out.get("locations", [])]
         if not urls:
             raise ClientError(out.get("error", f"{fid} not found"))
@@ -209,10 +247,10 @@ class Client:
         return _post_json(f"http://{server}/admin/{op}", body)
 
     def ec_lookup(self, vid: int) -> dict:
-        return _get_json(f"http://{self.master}/col/lookup/ec?volumeId={vid}")
+        return self._master_get(f"/col/lookup/ec?volumeId={vid}")
 
     def dir_status(self) -> dict:
-        return _get_json(f"http://{self.master}/dir/status")
+        return self._master_get("/dir/status")
 
     def batch_delete(self, fids: list[str]) -> list[dict]:
         """Delete many fids grouped per volume server in one RPC each

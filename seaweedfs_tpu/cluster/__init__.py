@@ -1,0 +1,1 @@
+from .raft import RaftNode  # noqa: F401

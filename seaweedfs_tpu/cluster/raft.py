@@ -1,0 +1,344 @@
+"""Raft consensus for the master control plane.
+
+The reference elects a leader among <=5 masters with a goraft-lineage library
+and replicates exactly one piece of state — MaxVolumeId — through the log
+(weed/server/raft_server.go:34-151, weed/topology/cluster_commands.go:8-31);
+the rest of the topology is rebuilt from volume-server heartbeats. This is
+the same design, asyncio-native over the existing HTTP/JSON substrate instead
+of a vendored consensus library:
+
+- full Raft election (terms, randomized timeouts, vote persistence) and log
+  replication with the standard commit rule (leader commits entries of its
+  own term once a majority matches)
+- the log carries tiny JSON commands ({"max_volume_id": N}), applied in
+  order to the topology
+- persistent state (term / voted_for / log) goes to one JSON file per node
+  when a state_dir is given — the analog of goraft's snapshot+log dir
+
+RPCs ride two POST routes the master app mounts:
+  /cluster/raft/vote    RequestVote
+  /cluster/raft/append  AppendEntries (also the leader heartbeat)
+
+A single-node cluster (peers == [self]) elects itself immediately, so the
+single-master deployment keeps working with zero configuration.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import random
+from typing import Awaitable, Callable, Optional
+
+log = logging.getLogger("raft")
+
+FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+
+class RaftNode:
+    def __init__(self, node_id: str, peers: list[str],
+                 apply_fn: Callable[[dict], None],
+                 election_timeout: tuple[float, float] = (0.3, 0.6),
+                 heartbeat_interval: float = 0.1,
+                 state_dir: Optional[str] = None):
+        self.id = node_id
+        self.peers = [p for p in peers if p != node_id]
+        self.apply_fn = apply_fn
+        self.election_timeout = election_timeout
+        self.heartbeat_interval = heartbeat_interval
+        self.state_path = (os.path.join(state_dir, "raft_state.json")
+                           if state_dir else None)
+
+        # persistent
+        self.term = 0
+        self.voted_for: Optional[str] = None
+        self.log: list[dict] = []  # {"term": int, "cmd": dict}
+        self._load_state()
+
+        # volatile
+        self.role = FOLLOWER
+        self.leader_id: Optional[str] = None
+        self.commit_index = 0   # 1-based; 0 = nothing committed
+        self.last_applied = 0
+        self.next_index: dict[str, int] = {}
+        self.match_index: dict[str, int] = {}
+
+        self._session = None
+        self._tasks: list[asyncio.Task] = []
+        self._timer_reset = asyncio.Event()
+        self._commit_waiters: list[tuple[int, int, asyncio.Future]] = []
+        self._stopped = False
+        self._ready_term = -1
+
+    # --- lifecycle ---
+    async def start(self) -> None:
+        import aiohttp
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=2.0))
+        if not self.peers:
+            self._become_leader()
+        else:
+            self._tasks.append(asyncio.create_task(self._election_timer()))
+
+    async def stop(self) -> None:
+        self._stopped = True
+        # demote: a stopped node must not look like a leader to anything
+        # still holding a reference (tests, status pages)
+        self.role = FOLLOWER
+        self._fail_waiters()
+        for t in self._tasks:
+            t.cancel()
+        if self._session:
+            await self._session.close()
+
+    def _load_state(self) -> None:
+        if self.state_path and os.path.exists(self.state_path):
+            with open(self.state_path) as f:
+                st = json.load(f)
+            self.term = st["term"]
+            self.voted_for = st.get("voted_for")
+            self.log = st.get("log", [])
+
+    def _save_state(self) -> None:
+        if not self.state_path:
+            return
+        tmp = self.state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"term": self.term, "voted_for": self.voted_for,
+                       "log": self.log}, f)
+        os.replace(tmp, self.state_path)
+
+    # --- log helpers (1-based indices) ---
+    def _last_index(self) -> int:
+        return len(self.log)
+
+    def _term_at(self, index: int) -> int:
+        return self.log[index - 1]["term"] if 1 <= index <= len(self.log) \
+            else 0
+
+    @property
+    def is_leader(self) -> bool:
+        return self.role == LEADER
+
+    @property
+    def quorum(self) -> int:
+        return (len(self.peers) + 1) // 2 + 1
+
+    # --- election ---
+    async def _election_timer(self) -> None:
+        while not self._stopped:
+            timeout = random.uniform(*self.election_timeout)
+            try:
+                await asyncio.wait_for(self._timer_reset.wait(), timeout)
+                self._timer_reset.clear()
+                continue
+            except asyncio.TimeoutError:
+                pass
+            if self.role != LEADER:
+                await self._run_election()
+
+    async def _run_election(self) -> None:
+        self.role = CANDIDATE
+        self.term += 1
+        self.voted_for = self.id
+        self._save_state()
+        term = self.term
+        log.info("%s: starting election for term %d", self.id, term)
+        votes = 1
+        req = {"term": term, "candidate_id": self.id,
+               "last_log_index": self._last_index(),
+               "last_log_term": self._term_at(self._last_index())}
+        replies = await asyncio.gather(
+            *[self._post(p, "/cluster/raft/vote", req) for p in self.peers],
+            return_exceptions=True)
+        if self.term != term or self.role != CANDIDATE:
+            return
+        for r in replies:
+            if isinstance(r, dict):
+                if r.get("term", 0) > self.term:
+                    self._step_down(r["term"])
+                    return
+                if r.get("granted"):
+                    votes += 1
+        if votes >= self.quorum:
+            self._become_leader()
+
+    def _become_leader(self) -> None:
+        log.info("%s: leader for term %d", self.id, self.term)
+        self.role = LEADER
+        self.leader_id = self.id
+        nxt = self._last_index() + 1
+        self.next_index = {p: nxt for p in self.peers}
+        self.match_index = {p: 0 for p in self.peers}
+        if not self.peers:
+            self.commit_index = self._last_index()
+            self._apply_committed()
+            return
+        self._tasks.append(asyncio.create_task(self._leader_loop()))
+
+    def _step_down(self, term: int) -> None:
+        if term > self.term:
+            self.term = term
+            self.voted_for = None
+            self._save_state()
+        if self.role != FOLLOWER:
+            log.info("%s: stepping down at term %d", self.id, term)
+        self.role = FOLLOWER
+        self._fail_waiters()
+
+    async def _leader_loop(self) -> None:
+        term = self.term
+        while not self._stopped and self.role == LEADER and self.term == term:
+            await self._replicate_round()
+            await asyncio.sleep(self.heartbeat_interval)
+
+    async def _replicate_round(self) -> None:
+        await asyncio.gather(
+            *[self._replicate_to(p) for p in self.peers],
+            return_exceptions=True)
+        self._advance_commit()
+
+    async def _replicate_to(self, peer: str) -> None:
+        nxt = self.next_index.get(peer, self._last_index() + 1)
+        prev = nxt - 1
+        entries = self.log[nxt - 1:]
+        req = {"term": self.term, "leader_id": self.id,
+               "prev_log_index": prev, "prev_log_term": self._term_at(prev),
+               "entries": entries, "leader_commit": self.commit_index}
+        r = await self._post(peer, "/cluster/raft/append", req)
+        if not isinstance(r, dict) or self.role != LEADER:
+            return
+        if r.get("term", 0) > self.term:
+            self._step_down(r["term"])
+            return
+        if r.get("success"):
+            self.match_index[peer] = prev + len(entries)
+            self.next_index[peer] = self.match_index[peer] + 1
+        else:
+            self.next_index[peer] = max(1, nxt - 1)
+
+    def _advance_commit(self) -> None:
+        if self.role != LEADER:
+            return
+        for n in range(self._last_index(), self.commit_index, -1):
+            if self._term_at(n) != self.term:
+                break
+            count = 1 + sum(1 for p in self.peers
+                            if self.match_index.get(p, 0) >= n)
+            if count >= self.quorum:
+                self.commit_index = n
+                break
+        self._apply_committed()
+
+    def _apply_committed(self) -> None:
+        while self.last_applied < self.commit_index:
+            self.last_applied += 1
+            try:
+                self.apply_fn(self.log[self.last_applied - 1]["cmd"])
+            except Exception as e:
+                log.error("apply failed at %d: %s", self.last_applied, e)
+        done, self._commit_waiters = self._commit_waiters, []
+        for index, term, fut in done:
+            if fut.done():
+                continue
+            if index <= self.commit_index:
+                fut.set_result(self._term_at(index) == term)
+            else:
+                self._commit_waiters.append((index, term, fut))
+
+    def _fail_waiters(self) -> None:
+        done, self._commit_waiters = self._commit_waiters, []
+        for _, _, fut in done:
+            if not fut.done():
+                fut.set_result(False)
+
+    # --- client API ---
+    async def ensure_ready(self, timeout: float = 5.0) -> bool:
+        """Leader-readiness barrier: commit one entry of the current term
+        (a no-op) before serving state-dependent requests, so every entry
+        from previous terms is committed AND applied locally first. The
+        standard Raft guard against a fresh leader acting on stale state."""
+        if self.role != LEADER:
+            return False
+        if self._ready_term == self.term:
+            return True
+        ok = await self.propose({"noop": True}, timeout)
+        if ok:
+            self._ready_term = self.term
+        return ok
+
+    async def propose(self, cmd: dict, timeout: float = 5.0) -> bool:
+        """Append cmd to the replicated log; resolves True once committed
+        at this node's term (False if leadership was lost)."""
+        if self.role != LEADER:
+            return False
+        self.log.append({"term": self.term, "cmd": cmd})
+        self._save_state()
+        index = self._last_index()
+        if not self.peers:
+            self.commit_index = index
+            self._apply_committed()
+            return True
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._commit_waiters.append((index, self.term, fut))
+        await self._replicate_round()
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            return False
+
+    # --- RPC handlers (wired into the master app) ---
+    def handle_vote(self, req: dict) -> dict:
+        if req["term"] > self.term:
+            self._step_down(req["term"])
+        granted = False
+        if req["term"] == self.term and \
+                self.voted_for in (None, req["candidate_id"]):
+            up_to_date = (
+                req["last_log_term"], req["last_log_index"]
+            ) >= (self._term_at(self._last_index()), self._last_index())
+            if up_to_date:
+                granted = True
+                self.voted_for = req["candidate_id"]
+                self._save_state()
+                self._timer_reset.set()
+        return {"term": self.term, "granted": granted}
+
+    def handle_append(self, req: dict) -> dict:
+        if req["term"] < self.term:
+            return {"term": self.term, "success": False}
+        if req["term"] > self.term or self.role != FOLLOWER:
+            self._step_down(req["term"])
+        self.leader_id = req["leader_id"]
+        self._timer_reset.set()
+
+        prev = req["prev_log_index"]
+        if prev > 0 and (prev > self._last_index()
+                         or self._term_at(prev) != req["prev_log_term"]):
+            return {"term": self.term, "success": False}
+        # append, truncating conflicts
+        idx = prev
+        for entry in req["entries"]:
+            idx += 1
+            if idx <= self._last_index():
+                if self._term_at(idx) != entry["term"]:
+                    del self.log[idx - 1:]
+                    self.log.append(entry)
+            else:
+                self.log.append(entry)
+        if req["entries"]:
+            self._save_state()
+        if req["leader_commit"] > self.commit_index:
+            self.commit_index = min(req["leader_commit"], self._last_index())
+            self._apply_committed()
+        return {"term": self.term, "success": True}
+
+    async def _post(self, peer: str, path: str, body: dict):
+        try:
+            async with self._session.post(f"http://{peer}{path}",
+                                          json=body) as r:
+                return await r.json()
+        except Exception:
+            return None

@@ -42,6 +42,23 @@ class ErasureCoder:
         """Backend hook: build the survivors->missing transform."""
         raise NotImplementedError
 
+    # --- async pipeline hooks (ec/pipeline.py) ---
+    # CPU backends compute synchronously — the streaming pipeline still
+    # overlaps their compute with disk read/write via its worker threads.
+    # JAX backends override these to return in-flight device computations.
+
+    def encode_async(self, data: np.ndarray):
+        """Dispatch an encode; returns a handle for materialize()."""
+        return self.encode(data)
+
+    def rec_apply_async(self, present: tuple, missing: tuple) -> ApplyFn:
+        """Like _rec_apply but the returned fn may defer computation."""
+        return self._rec_apply(present, missing)
+
+    def materialize(self, handle) -> np.ndarray:
+        """Block until a handle from encode_async/rec_apply_async is real."""
+        return np.asarray(handle)
+
     def reconstruct(self, shards: Sequence[Optional[np.ndarray]],
                     data_only: bool = False,
                     targets: Optional[Sequence[int]] = None
@@ -114,6 +131,18 @@ class JaxCoder(ErasureCoder):
         return rs_jax._reconstruct_fn(self.k, self.m, present, missing,
                                       self.method)
 
+    def encode_async(self, data: np.ndarray):
+        import jax
+        return rs_jax.encode_parity(
+            jax.device_put(np.asarray(data, dtype=np.uint8)), self.m,
+            method=self.method)
+
+    def rec_apply_async(self, present, missing):
+        import jax
+        fn = self._rec_apply(present, missing)
+        return lambda survivors: fn(
+            jax.device_put(np.asarray(survivors, dtype=np.uint8)))
+
 
 class PallasCoder(ErasureCoder):
     """Fused TPU kernel path (rs_pallas.py); interpret-mode on CPU."""
@@ -140,6 +169,16 @@ class PallasCoder(ErasureCoder):
             fn = self._mod.gf_apply_pallas(rec, tile=self._tile)
             self._rec_cache[key] = fn
         return fn
+
+    def encode_async(self, data: np.ndarray):
+        import jax
+        return self._encode(jax.device_put(np.asarray(data, dtype=np.uint8)))
+
+    def rec_apply_async(self, present, missing):
+        import jax
+        fn = self._rec_apply(present, missing)
+        return lambda survivors: fn(
+            jax.device_put(np.asarray(survivors, dtype=np.uint8)))
 
 
 class CppCoder(ErasureCoder):

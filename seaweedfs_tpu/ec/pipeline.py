@@ -1,0 +1,310 @@
+"""Streaming EC pipeline: disk -> host buffer -> HBM -> kernel -> shard files.
+
+The naive encode loop (striping.write_ec_files) is the reference shape —
+synchronous 256KB batches (weed/storage/erasure_coding/ec_encoder.go:162-231).
+It leaves the chip idle while the host reads and writes. This module is the
+production path: multi-MB batches with disk read, host->HBM transfer, kernel,
+and shard write-back all overlapped.
+
+Stages (bounded queues between them; every file gets its own writer thread so
+shard write-back parallelizes across the 14 files):
+
+  reader thread   -- os.pread the .dat at the stripe offsets into [k, B]
+                     uint8 batches (k preads fanned over a thread pool; pread
+                     releases the GIL so page-cache copies run in parallel),
+                     push to a depth-bounded queue
+  main thread     -- pop a batch, dispatch coder.encode_async (device_put +
+                     jitted kernel; JAX dispatch is asynchronous so this
+                     returns immediately with computation in flight)
+  materializer    -- block on the parity handle (only this thread waits on
+                     the device), then fan rows out to the per-file queues;
+                     data rows go straight from the host buffer — data shards
+                     never round-trip through the device
+  k+m writers     -- one thread per shard file, appending rows in order
+
+Only parity bytes (m/k of the input) cross device->host. Layout semantics are
+identical to striping.write_ec_files: row-major two-tier striping, final batch
+zero-padded and written full-length (tests assert byte-identical output
+between the two paths).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from .coder import ErasureCoder
+from .geometry import DEFAULT, Geometry, to_ext
+
+# 8MB per shard-row batch: 80MB host buffer per in-flight batch at RS(10,4),
+# large enough to amortize dispatch, small enough for depth-4 on any host.
+DEFAULT_BATCH_SIZE = 8 * 1024 * 1024
+DEFAULT_DEPTH = 4
+_READ_POOL_WORKERS = 8
+
+_SENTINEL = None
+
+
+def _clamp_batch(batch_size: int, block_size: int) -> int:
+    """Largest usable buffer: divides block_size, <= batch_size."""
+    b = min(batch_size, block_size)
+    while block_size % b:
+        b -= 1
+    return b
+
+
+class _FanOut:
+    """One writer thread per output file, each with a bounded row queue."""
+
+    def __init__(self, paths: Sequence[str], depth: int):
+        self.queues = [queue.Queue(maxsize=depth) for _ in paths]
+        self.errors: list[BaseException] = []
+        self.threads = []
+        for q, path in zip(self.queues, paths):
+            th = threading.Thread(target=self._writer, args=(q, path),
+                                  daemon=True)
+            th.start()
+            self.threads.append(th)
+
+    def _writer(self, q: queue.Queue, path: str) -> None:
+        try:
+            with open(path, "wb", buffering=1 << 20) as f:
+                while True:
+                    row = q.get()
+                    if row is _SENTINEL:
+                        return
+                    f.write(row)
+        except BaseException as e:
+            self.errors.append(e)
+            while q.get() is not _SENTINEL:  # drain; never deadlock producer
+                pass
+
+    def put_rows(self, rows: Iterator[np.ndarray]) -> None:
+        for q, row in zip(self.queues, rows):
+            q.put(np.ascontiguousarray(row))
+
+    def close(self) -> None:
+        for q in self.queues:
+            q.put(_SENTINEL)
+        for th in self.threads:
+            th.join()
+
+
+def _sub_batches(dat_size: int, g: Geometry,
+                 batch_size: int) -> Iterator[tuple[list[int], int]]:
+    """(k strided offsets, width) per stripe batch, in shard-file append
+    order (row-major two-tier striping, ec_encoder.go:194-231)."""
+    def rows(start: int, block_size: int) -> Iterator[tuple[list[int], int]]:
+        b = _clamp_batch(batch_size, block_size)
+        for batch_start in range(0, block_size, b):
+            yield ([start + block_size * i + batch_start
+                    for i in range(g.data_shards)], b)
+
+    remaining = dat_size
+    processed = 0
+    while remaining > g.large_row_size:
+        yield from rows(processed, g.large_block_size)
+        remaining -= g.large_row_size
+        processed += g.large_row_size
+    while remaining > 0:
+        yield from rows(processed, g.small_block_size)
+        remaining -= g.small_row_size
+        processed += g.small_row_size
+
+
+def _encode_batches(pool: ThreadPoolExecutor, dat_fd: int, dat_size: int,
+                    g: Geometry, batch_size: int) -> Iterator[np.ndarray]:
+    """Yield [k, <=batch_size] aggregated batches.
+
+    Every stripe batch appends its row i to shard file i, so consecutive
+    batches concatenate along the width axis without changing the on-disk
+    layout — this is what lets small-block rows (1MB in the reference
+    geometry) still feed the chip in multi-MB dispatches.
+    """
+    agg: np.ndarray | None = None
+    col = 0
+    jobs: list[tuple[int, int, int, int]] = []  # (row, col, width, offset)
+
+    def flush_reads() -> None:
+        def one(job: tuple[int, int, int, int]) -> None:
+            i, c, w, off = job
+            chunk = os.pread(dat_fd, w, off)
+            if chunk:
+                agg[i, c:c + len(chunk)] = np.frombuffer(chunk,
+                                                         dtype=np.uint8)
+        list(pool.map(one, jobs))
+        jobs.clear()
+
+    for offsets, w in _sub_batches(dat_size, g, batch_size):
+        if agg is None:
+            agg = np.zeros((g.data_shards, max(batch_size, w)),
+                           dtype=np.uint8)
+        if col + w > agg.shape[1]:
+            flush_reads()
+            yield agg[:, :col]
+            agg = np.zeros((g.data_shards, max(batch_size, w)),
+                           dtype=np.uint8)
+            col = 0
+        jobs.extend((i, col, w, off) for i, off in enumerate(offsets)
+                    if off < dat_size)
+        col += w
+    if agg is not None and col:
+        flush_reads()
+        yield agg[:, :col]
+
+
+def _run_pipeline(batches: Iterator[np.ndarray], dispatch, consume,
+                  depth: int) -> None:
+    """reader thread -> main dispatch -> materializer thread."""
+    read_q: queue.Queue = queue.Queue(maxsize=depth)
+    mat_q: queue.Queue = queue.Queue(maxsize=depth)
+    errors: list[BaseException] = []
+
+    def reader_main() -> None:
+        try:
+            for item in batches:
+                read_q.put(item)
+        except BaseException as e:
+            errors.append(e)
+        finally:
+            read_q.put(_SENTINEL)
+
+    def mat_main() -> None:
+        try:
+            while True:
+                item = mat_q.get()
+                if item is _SENTINEL:
+                    return
+                consume(*item)
+        except BaseException as e:
+            errors.append(e)
+            while mat_q.get() is not _SENTINEL:
+                pass
+
+    reader = threading.Thread(target=reader_main, daemon=True)
+    mat = threading.Thread(target=mat_main, daemon=True)
+    reader.start()
+    mat.start()
+    drained = False
+    try:
+        while True:
+            batch = read_q.get()
+            if batch is _SENTINEL:
+                drained = True
+                break
+            handle = dispatch(batch)
+            # kick the device->host copy off immediately so it overlaps the
+            # next batch's H2D + kernel instead of starting at materialize
+            # time (matters most when the transfer link is the bottleneck)
+            start_async = getattr(handle, "copy_to_host_async", None)
+            if start_async is not None:
+                try:
+                    start_async()
+                except Exception:
+                    pass
+            mat_q.put((batch, handle))
+    finally:
+        mat_q.put(_SENTINEL)
+        # drain read_q so a reader blocked on a full queue can finish
+        # (otherwise a dispatch() exception would deadlock reader.join())
+        while not drained and read_q.get() is not _SENTINEL:
+            pass
+        reader.join()
+        mat.join()
+    if errors:
+        raise errors[0]
+
+
+def stream_encode(base_file_name: str, coder: ErasureCoder,
+                  geometry: Geometry = DEFAULT,
+                  batch_size: int = DEFAULT_BATCH_SIZE,
+                  depth: int = DEFAULT_DEPTH) -> None:
+    """Encode <base>.dat into shard files with the overlapped pipeline.
+
+    Byte-identical output to striping.write_ec_files (WriteEcFiles,
+    ec_encoder.go:57) — only the schedule differs.
+    """
+    g = geometry
+    assert coder.k == g.data_shards and coder.m == g.parity_shards
+    dat_size = os.path.getsize(base_file_name + ".dat")
+    dat_fd = os.open(base_file_name + ".dat", os.O_RDONLY)
+    fan = _FanOut([base_file_name + to_ext(i) for i in range(g.total_shards)],
+                  depth)
+
+    def consume(data: np.ndarray, handle) -> None:
+        parity = coder.materialize(handle)
+        fan.put_rows(iter([*data, *parity]))
+
+    try:
+        with ThreadPoolExecutor(max_workers=_READ_POOL_WORKERS) as pool:
+            _run_pipeline(
+                _encode_batches(pool, dat_fd, dat_size, g, batch_size),
+                coder.encode_async, consume, depth)
+    finally:
+        fan.close()
+        os.close(dat_fd)
+    if fan.errors:
+        raise fan.errors[0]
+
+
+def stream_rebuild(base_file_name: str, coder: ErasureCoder,
+                   geometry: Geometry = DEFAULT,
+                   batch_size: int = DEFAULT_BATCH_SIZE,
+                   depth: int = DEFAULT_DEPTH) -> list[int]:
+    """Regenerate missing shard files from k survivors, overlapped
+    (RebuildEcFiles, ec_encoder.go:233-287 — but with multi-MB strides and
+    read/compute/write overlap instead of synchronous 1MB loops).
+    Returns the rebuilt shard ids.
+    """
+    g = geometry
+    present = [i for i in range(g.total_shards)
+               if os.path.exists(base_file_name + to_ext(i))]
+    missing = [i for i in range(g.total_shards) if i not in present]
+    if not missing:
+        return []
+    if len(present) < g.data_shards:
+        raise ValueError(
+            f"need {g.data_shards} shards to rebuild, have {len(present)}")
+    survivors_ids = tuple(present[:g.data_shards])
+    fn = coder.rec_apply_async(survivors_ids, tuple(missing))
+
+    fds = {i: os.open(base_file_name + to_ext(i), os.O_RDONLY)
+           for i in survivors_ids}
+    shard_size = os.path.getsize(base_file_name + to_ext(survivors_ids[0]))
+    fan = _FanOut([base_file_name + to_ext(i) for i in missing], depth)
+
+    def batches(pool: ThreadPoolExecutor) -> Iterator[np.ndarray]:
+        offset = 0
+        while offset < shard_size:
+            n = min(batch_size, shard_size - offset)
+
+            def one(i: int, off: int = offset, ln: int = n) -> np.ndarray:
+                chunk = os.pread(fds[i], ln, off)
+                if len(chunk) != ln:
+                    raise IOError(
+                        f"shard {i} short read {len(chunk)} != {ln}")
+                return np.frombuffer(chunk, dtype=np.uint8)
+
+            rows = list(pool.map(one, survivors_ids))
+            yield np.stack(rows)
+            offset += n
+
+    def consume(survivors: np.ndarray, handle) -> None:
+        rebuilt = coder.materialize(handle)
+        fan.put_rows(iter(rebuilt))
+
+    try:
+        with ThreadPoolExecutor(max_workers=_READ_POOL_WORKERS) as pool:
+            _run_pipeline(batches(pool), fn, consume, depth)
+    finally:
+        fan.close()
+        for fd in fds.values():
+            os.close(fd)
+    if fan.errors:
+        raise fan.errors[0]
+    return missing

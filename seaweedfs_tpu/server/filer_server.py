@@ -65,7 +65,11 @@ class FilerServer:
                  peers: Optional[list[str]] = None,
                  notifier=None,
                  guard=None):
-        self.master_url = master_url
+        # comma-separated HA master list; rotates on failure like the
+        # Client/VolumeServer (wdclient/masterclient.go)
+        self.masters = [m.strip() for m in master_url.split(",")
+                        if m.strip()]
+        self._master_i = 0
         self.chunk_size = chunk_size
         self.default_replication = default_replication
         self.default_collection = default_collection
@@ -220,10 +224,7 @@ class FilerServer:
         jwts in the reference, weed/security/jwt.go GenReadJwt)."""
         fid = request.query.get("fileId", "")
         if fid:
-            async with self._session.get(
-                    f"http://{self.master_url}/dir/lookup",
-                    params={"fileId": fid}) as r:
-                body = await r.json()
+            body = await self._master_get("/dir/lookup", {"fileId": fid})
             if "error" in body and not body.get("locations"):
                 return web.json_response(body, status=404)
             return web.json_response(body)
@@ -381,14 +382,38 @@ class FilerServer:
                 log.warning("chunk delete %s failed: %s", chunk.fid, e)
 
     # --- master/volume plumbing ---
+    @property
+    def master_url(self) -> str:
+        return self.masters[self._master_i]
+
+    async def _master_get(self, path: str, params: dict) -> dict:
+        """GET against the current master, rotating through the HA list on
+        connection failure or 502/503/504 (leaderless follower)."""
+        last: Optional[Exception] = None
+        for _ in range(max(2 * len(self.masters), 2)):
+            try:
+                async with self._session.get(
+                        f"http://{self.master_url}{path}",
+                        params=params) as r:
+                    if r.status in (502, 503, 504):
+                        raise aiohttp.ClientError(
+                            f"master {self.master_url}: HTTP {r.status}")
+                    return await r.json()
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+                last = e
+                if len(self.masters) > 1:
+                    self._master_i = (self._master_i + 1) % len(self.masters)
+                    await asyncio.sleep(0.05)
+                else:
+                    raise
+        raise aiohttp.ClientError(f"all masters failed: {last}")
+
     async def _lookup(self, vid: int) -> list[str]:
         cached = self._vid_cache.get(vid)
         if cached and time.time() - cached[1] < 60:
             return cached[0]
-        async with self._session.get(
-                f"http://{self.master_url}/dir/lookup",
-                params={"volumeId": str(vid)}) as r:
-            body = await r.json()
+        body = await self._master_get("/dir/lookup",
+                                      {"volumeId": str(vid)})
         urls = [loc["url"] for loc in body.get("locations", [])]
         if urls:
             self._vid_cache[vid] = (urls, time.time())
@@ -398,10 +423,8 @@ class FilerServer:
                       ttl: str) -> dict:
         params = {"collection": collection, "replication": replication,
                   "ttl": ttl}
-        async with self._session.get(
-                f"http://{self.master_url}/dir/assign",
-                params={k: v for k, v in params.items() if v}) as r:
-            body = await r.json()
+        body = await self._master_get(
+            "/dir/assign", {k: v for k, v in params.items() if v})
         if "error" in body:
             raise web.HTTPInternalServerError(text=body["error"])
         return body
@@ -460,10 +483,8 @@ class FilerServer:
                     last = e
             if needs_auth:
                 # volume server wants a read token: per-fid lookup signs one
-                async with self._session.get(
-                        f"http://{self.master_url}/dir/lookup",
-                        params={"fileId": fid}) as r:
-                    body = await r.json()
+                body = await self._master_get("/dir/lookup",
+                                              {"fileId": fid})
                 read_auth = body.get("auth", "")
                 if read_auth:
                     continue

@@ -22,6 +22,7 @@ from typing import Optional
 
 from aiohttp import web
 
+from ..cluster.raft import RaftNode
 from ..security.guard import Guard
 from ..storage.file_id import FileId, new_cookie
 from ..topology.sequence import MemorySequencer
@@ -29,6 +30,11 @@ from ..topology.topology import Topology
 from ..utils import metrics as metrics_mod
 
 log = logging.getLogger("master")
+
+# routes every master answers itself; everything else is proxied to the
+# Raft leader by followers (proxyToLeader, weed/server/master_server.go:156)
+_LOCAL_PATHS = ("/healthz", "/metrics", "/cluster/status",
+                "/cluster/raft/vote", "/cluster/raft/append")
 
 
 async def _healthz(request: "web.Request") -> "web.Response":
@@ -41,7 +47,12 @@ class MasterServer:
                  pulse_seconds: float = 5.0,
                  garbage_threshold: float = 0.3,
                  vacuum_interval_seconds: float = 900.0,
-                 guard: Optional[Guard] = None):
+                 guard: Optional[Guard] = None,
+                 url: str = "",
+                 peers: Optional[list[str]] = None,
+                 raft_state_dir: Optional[str] = None,
+                 election_timeout: tuple[float, float] = (0.3, 0.6),
+                 raft_heartbeat: float = 0.1):
         self.topology = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds)
@@ -50,11 +61,40 @@ class MasterServer:
         self.garbage_threshold = garbage_threshold
         self.vacuum_interval_seconds = vacuum_interval_seconds
         self.guard = guard or Guard()
+        self.url = url
+        self.raft = RaftNode(url or "self", peers or [],
+                             self._raft_apply,
+                             election_timeout=election_timeout,
+                             heartbeat_interval=raft_heartbeat,
+                             state_dir=raft_state_dir)
         self._grow_lock = asyncio.Lock()
         self._vacuum_lock = asyncio.Lock()
         self._vacuum_task: Optional[asyncio.Task] = None
+        self._key_bound = 0          # replicated sequencer high-water mark
+        self._key_bound_step = 10000  # one raft round per this many keys
+        self._seq_synced_term = -1   # term whose ceiling was folded in
+        # peer masters are implicitly trusted: raft RPCs and proxied
+        # follower->leader traffic must pass any configured IP whitelist
+        self._peer_ips = {p.split(":")[0] for p in (peers or [])}
+        self._proxy_session = None
         self.metrics = metrics_mod.Registry("master")
         self.app = self._build_app()
+
+    def _raft_apply(self, cmd: dict) -> None:
+        """State machine: replicated MaxVolumeId
+        (weed/topology/cluster_commands.go:8-31) plus a needle-key
+        high-water mark so a new leader never re-mints file keys (the
+        reference recovers max_file_key from heartbeats; here followers
+        proxy heartbeats to the leader, so the bound rides the log).
+
+        The bound is a CEILING only — it reaches the sequencer exclusively
+        through the post-ensure_ready sync in dir_assign, never here, so a
+        leader applying its own proposal does not leapfrog its sequencer."""
+        if "max_volume_id" in cmd:
+            self.topology.max_volume_id = max(self.topology.max_volume_id,
+                                              cmd["max_volume_id"])
+        if "max_file_key" in cmd:
+            self._key_bound = max(self._key_bound, cmd["max_file_key"])
 
     def _build_app(self) -> web.Application:
         @web.middleware
@@ -69,13 +109,27 @@ class MasterServer:
             # white_list must therefore include the volume servers
             # (documented in the security.toml scaffold).
             if request.path != "/healthz":
-                if not self.guard.check_whitelist(request.remote or ""):
+                remote = request.remote or ""
+                if remote not in self._peer_ips and \
+                        not self.guard.check_whitelist(remote):
                     return web.json_response({"error": "ip not allowed"},
                                              status=403)
             return await handler(request)
 
+        @web.middleware
+        async def leader_proxy_mw(request: web.Request, handler):
+            # followers proxy API traffic to the Raft leader
+            # (proxyToLeader, weed/server/master_server.go:156-180)
+            if self.raft.is_leader or request.path in _LOCAL_PATHS:
+                return await handler(request)
+            leader = self.raft.leader_id
+            if not leader or leader == self.raft.id:
+                return web.json_response(
+                    {"error": "no leader elected"}, status=503)
+            return await self._proxy_to(leader, request)
+
         app = web.Application(client_max_size=64 * 1024 * 1024,
-                              middlewares=[guard_mw])
+                              middlewares=[guard_mw, leader_proxy_mw])
         app.router.add_get("/dir/assign", self.dir_assign)
         app.router.add_get("/dir/lookup", self.dir_lookup)
         app.router.add_get("/dir/status", self.dir_status)
@@ -84,6 +138,8 @@ class MasterServer:
         app.router.add_get("/col/lookup/ec", self.ec_lookup)
         app.router.add_post("/heartbeat", self.heartbeat)
         app.router.add_get("/cluster/status", self.cluster_status)
+        app.router.add_post("/cluster/raft/vote", self.raft_vote)
+        app.router.add_post("/cluster/raft/append", self.raft_append)
         app.router.add_get("/metrics", self.metrics_handler)
         app.router.add_get("/healthz", _healthz)
         app.on_startup.append(self._on_startup)
@@ -91,18 +147,81 @@ class MasterServer:
         return app
 
     async def _on_startup(self, app) -> None:
+        await self.raft.start()
         if self.vacuum_interval_seconds > 0:
             self._vacuum_task = asyncio.create_task(self._vacuum_loop())
 
     async def _on_cleanup(self, app) -> None:
         if self._vacuum_task:
             self._vacuum_task.cancel()
+        if self._proxy_session is not None:
+            await self._proxy_session.close()
+        await self.raft.stop()
+
+    # --- raft plumbing ---
+    def _raft_peer_check(self, request: web.Request):
+        """Raft RPCs are master-to-master only: accept them solely from
+        configured peers (single-master deployments reject them outright).
+        Without this, any API-whitelisted client could forge AppendEntries
+        and depose leaders / inject state."""
+        if (request.remote or "") not in self._peer_ips:
+            return web.json_response({"error": "not a raft peer"},
+                                     status=403)
+        return None
+
+    async def raft_vote(self, request: web.Request) -> web.Response:
+        denied = self._raft_peer_check(request)
+        if denied:
+            return denied
+        return web.json_response(self.raft.handle_vote(await request.json()))
+
+    async def raft_append(self, request: web.Request) -> web.Response:
+        denied = self._raft_peer_check(request)
+        if denied:
+            return denied
+        return web.json_response(
+            self.raft.handle_append(await request.json()))
+
+    async def _proxy_to(self, leader: str, request: web.Request):
+        import aiohttp
+        body = await request.read()
+        url = f"http://{leader}{request.path_qs}"
+        if self._proxy_session is None or self._proxy_session.closed:
+            # one keep-alive pool for the follower->leader hop
+            self._proxy_session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=60))
+        try:
+            async with self._proxy_session.request(
+                    request.method, url, data=body or None,
+                    headers={k: v for k, v in request.headers.items()
+                             if k.lower() not in ("host",
+                                                  "content-length")}) as r:
+                payload = await r.read()
+                return web.Response(
+                    body=payload, status=r.status,
+                    content_type=r.content_type or "application/json")
+        except Exception as e:
+            return web.json_response(
+                {"error": f"leader proxy to {leader} failed: {e}"},
+                status=503)
 
     # --- handlers ---
     async def dir_assign(self, request: web.Request) -> web.Response:
         """Assign a write target (dirAssignHandler,
         weed/server/master_server_handlers.go:96-150)."""
         self.metrics.count("assign")
+        # leader-readiness barrier: all prior-term entries (key bounds,
+        # volume ids) must be applied before minting anything
+        if not await self.raft.ensure_ready():
+            return web.json_response(
+                {"error": "not the leader / not ready"}, status=503)
+        # a freshly elected leader starts its sequencer above the last
+        # committed ceiling — keys handed out by dead leaders are <= it.
+        # Once per term: set_max jumps the counter past the ceiling, so
+        # doing it per-request would burn the whole bound window each time.
+        if self._seq_synced_term != self.raft.term:
+            self.sequencer.set_max(self._key_bound)
+            self._seq_synced_term = self.raft.term
         q = request.query
         count = int(q.get("count", 1))
         collection = q.get("collection", "")
@@ -118,6 +237,10 @@ class MasterServer:
                 if picked is None:
                     grown = await self._grow(1, collection, replication, ttl,
                                              data_center)
+                    if grown is None:
+                        return web.json_response(
+                            {"error": "lost leadership during grow"},
+                            status=503)
                     if not grown:
                         return web.json_response(
                             {"error": "no writable volumes and cannot grow"},
@@ -129,6 +252,13 @@ class MasterServer:
                                      status=500)
         vid, nodes = picked
         key = self.sequencer.next_file_id(count)
+        # never hand out keys beyond the raft-committed ceiling: a failover
+        # before the bound advances could otherwise re-mint the same keys
+        if key + count > self._key_bound:
+            bound = key + count + self._key_bound_step
+            if not await self.raft.propose({"max_file_key": bound}):
+                return web.json_response(
+                    {"error": "lost leadership during assign"}, status=503)
         fid = FileId(vid, key, new_cookie())
         node = nodes[0]
         resp = {
@@ -209,22 +339,36 @@ class MasterServer:
                 count, q.get("collection", ""),
                 q.get("replication", self.default_replication),
                 q.get("ttl", ""), q.get("dataCenter", ""))
+        if grown is None:
+            return web.json_response({"error": "lost leadership during grow"},
+                                     status=503)
         if not grown:
             return web.json_response({"error": "growth failed"}, status=500)
         return web.json_response({"count": len(grown),
                                   "volume_ids": grown})
 
     async def _grow(self, count: int, collection: str, replication: str,
-                    ttl: str, data_center: str = "") -> list[int]:
+                    ttl: str, data_center: str = "") -> Optional[list[int]]:
         """AutomaticGrowByType (weed/topology/volume_growth.go:70-208):
-        pick placement-satisfying nodes, allocate on each."""
+        pick placement-satisfying nodes, allocate on each. Returns None if
+        leadership was lost (callers answer 503 so HA clients fail over)."""
         import aiohttp
         grown: list[int] = []
+        # barrier: apply any replicated max_volume_id from prior terms
+        # before computing the next id (avoids duplicate volume ids after
+        # failover)
+        if not await self.raft.ensure_ready():
+            return None
         for _ in range(count):
             nodes = self.topology.find_empty_slots(replication, data_center)
             if not nodes:
                 break
-            vid = self.topology.next_volume_id()
+            # replicate the new MaxVolumeId through raft before allocating
+            # (MaxVolumeIdCommand, weed/topology/cluster_commands.go:8-31)
+            vid = self.topology.max_volume_id + 1
+            if not await self.raft.propose({"max_volume_id": vid}):
+                log.warning("lost leadership while growing volume %d", vid)
+                return None
             ok = True
             async with aiohttp.ClientSession() as session:
                 for node in nodes:
@@ -369,12 +513,15 @@ class MasterServer:
         self.topology.prune_dead_nodes()
         return web.json_response({
             "volume_size_limit": self.topology.volume_size_limit,
+            "leader": self.raft.leader_id or "",
         })
 
     async def cluster_status(self, request: web.Request) -> web.Response:
         return web.json_response({
-            "is_leader": True,
-            "leader": f"{request.host}",
+            "is_leader": self.raft.is_leader,
+            "leader": self.raft.leader_id or "",
+            "peers": self.raft.peers,
+            "raft_term": self.raft.term,
             "topology": self.topology.to_dict(),
         })
 

@@ -57,7 +57,11 @@ class VolumeServer:
                  pulse_seconds: float = 5.0, read_redirect: bool = False,
                  guard: Optional[Guard] = None):
         self.store = store
-        self.master_url = master_url
+        # master_url may be a comma-separated HA list; heartbeats follow the
+        # raft leader hint and rotate on failure
+        # (weed/server/volume_grpc_client_to_master.go:50-86)
+        self.masters = [m.strip() for m in master_url.split(",") if m.strip()]
+        self.master_url = self.masters[0]
         self.url = url
         self.public_url = public_url or url
         self.data_center = data_center
@@ -142,7 +146,14 @@ class VolumeServer:
                 await self.send_heartbeat()
             except Exception as e:
                 log.warning("heartbeat to %s failed: %s", self.master_url, e)
+                self._rotate_master()
             await asyncio.sleep(self.pulse_seconds)
+
+    def _rotate_master(self) -> None:
+        if len(self.masters) > 1:
+            i = self.masters.index(self.master_url) \
+                if self.master_url in self.masters else 0
+            self.master_url = self.masters[(i + 1) % len(self.masters)]
 
     async def send_heartbeat(self) -> None:
         payload = self.store.heartbeat()
@@ -159,6 +170,12 @@ class VolumeServer:
             body = await r.json()
             self.volume_size_limit = body.get("volume_size_limit",
                                               self.volume_size_limit)
+            # follow the raft leader so deltas land on the node that owns
+            # the topology (volume_grpc_client_to_master.go:60-86)
+            leader = body.get("leader", "")
+            if leader and leader != self.master_url and leader != "self":
+                log.info("heartbeat: following master leader %s", leader)
+                self.master_url = leader
 
     # --- data path ---
     async def data_handler(self, request: web.Request) -> web.Response:

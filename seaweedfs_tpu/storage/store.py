@@ -14,6 +14,7 @@ import threading
 from typing import Optional
 
 from .. import ec as ec_mod
+from ..ec import pipeline as ec_pipeline
 from ..ec.coder import ErasureCoder
 from ..ec.ec_volume import EcVolume
 from . import types as t
@@ -230,7 +231,10 @@ class Store:
         v.read_only = True
         v.sync()
         base = v.base_file_name()
-        ec_mod.write_ec_files(base, self.coder(), self.geometry)
+        # streaming pipeline: overlapped disk read / H2D / kernel / shard
+        # write-back (ec/pipeline.py) — byte-identical to the synchronous
+        # write_ec_files layout
+        ec_pipeline.stream_encode(base, self.coder(), self.geometry)
         ec_mod.write_sorted_ecx_from_idx(base)
         return list(range(self.geometry.total_shards))
 
@@ -280,7 +284,8 @@ class Store:
         loc = self._location_with_ec_files(vid, collection)
         prefix = f"{collection}_" if collection else ""
         base = os.path.join(loc.directory, f"{prefix}{vid}")
-        rebuilt = ec_mod.rebuild_ec_files(base, self.coder(), self.geometry)
+        rebuilt = ec_pipeline.stream_rebuild(base, self.coder(),
+                                             self.geometry)
         ec_mod.rebuild_ecx_file(base)
         return rebuilt
 

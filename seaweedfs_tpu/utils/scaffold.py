@@ -21,6 +21,7 @@ expires_after_seconds = 60
 # comma-separated IPs / CIDRs allowed to talk to servers; empty = open.
 # NOTE: the whitelist guards every master route including /heartbeat, so
 # it MUST include the volume servers' IPs or they cannot register.
+# Peer masters listed in -peers are trusted implicitly (raft + proxying).
 white_list = ""
 """
 

@@ -35,13 +35,15 @@ class Cluster:
                  coder_name: str = "numpy",
                  default_replication: str = "000",
                  max_volumes: int = 16,
-                 pulse: float = 0.15):
+                 pulse: float = 0.15,
+                 n_masters: int = 1):
         self.geometry = geometry
         self.coder_name = coder_name
         self.default_replication = default_replication
         self.max_volumes = max_volumes
         self.pulse = pulse
         self.n = n_volume_servers
+        self.n_masters = n_masters
 
         self.loop = asyncio.new_event_loop()
         self.thread = threading.Thread(target=self._loop_main, daemon=True)
@@ -79,19 +81,52 @@ class Cluster:
     def _start(self) -> None:
         from aiohttp import web
 
-        self.master_port = free_port()
-        self.master_url = f"127.0.0.1:{self.master_port}"
-        self.master = MasterServer(
-            volume_size_limit_mb=1,  # tiny: volumes seal quickly
-            default_replication=self.default_replication,
-            pulse_seconds=self.pulse)
-
-        self.runners.append(self.serve(self.master.app, self.master_port))
+        master_ports = [free_port() for _ in range(self.n_masters)]
+        master_urls = [f"127.0.0.1:{p}" for p in master_ports]
+        self.masters: list[MasterServer] = []
+        self._master_runners: list = []
+        for port, url in zip(master_ports, master_urls):
+            m = MasterServer(
+                volume_size_limit_mb=1,  # tiny: volumes seal quickly
+                default_replication=self.default_replication,
+                pulse_seconds=self.pulse,
+                url=url,
+                peers=master_urls if self.n_masters > 1 else None,
+                election_timeout=(0.15, 0.3),
+                raft_heartbeat=0.05)
+            runner = self.serve(m.app, port)
+            self.masters.append(m)
+            self._master_runners.append(runner)
+            self.runners.append(runner)
+        self.master = self.masters[0]
+        self.master_port = master_ports[0]
+        self.master_url = ",".join(master_urls)
+        if self.n_masters > 1:
+            self.wait_for_leader()
 
         for i in range(self.n):
             self.add_volume_server()
         self.wait_for_nodes(self.n)
         self.client = Client(self.master_url)
+
+    def wait_for_leader(self, timeout: float = 10.0) -> "MasterServer":
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            for m in self.masters:
+                if m.raft.is_leader:
+                    return m
+            time.sleep(0.05)
+        raise TimeoutError("no master elected leader")
+
+    def stop_master(self, index: int) -> None:
+        runner = self._master_runners[index]
+        m = self.masters[index]
+
+        async def halt():
+            await m.raft.stop()
+            await runner.cleanup()
+
+        self.call(halt())
 
     def add_volume_server(self, data_center: str = "dc1",
                           rack: str = "") -> VolumeServer:
@@ -141,16 +176,17 @@ class Cluster:
     def wait_for_nodes(self, n: int, timeout: float = 10.0) -> None:
         import json
         import urllib.request
+        urls = self.master_url.split(",")
         deadline = time.time() + timeout
         while time.time() < deadline:
-            try:
-                with urllib.request.urlopen(
-                        f"http://{self.master_url}/dir/status",
-                        timeout=2) as r:
-                    if len(json.load(r).get("nodes", [])) >= n:
-                        return
-            except Exception:
-                pass
+            for u in urls:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://{u}/dir/status", timeout=2) as r:
+                        if len(json.load(r).get("nodes", [])) >= n:
+                            return
+                except Exception:
+                    pass
             time.sleep(0.05)
         raise TimeoutError(f"cluster did not reach {n} nodes")
 

@@ -1,0 +1,89 @@
+"""Master HA: Raft leader election, follower proxy, leader-kill failover.
+
+Mirrors the reference's HA story: <=5 raft masters elect a leader
+(weed/server/raft_server.go:34-151), MaxVolumeId is the replicated state
+(weed/topology/cluster_commands.go:8-31), followers proxy HTTP to the leader
+(weed/server/master_server.go:156-180), and volume servers re-home their
+heartbeat stream on leader change
+(weed/server/volume_grpc_client_to_master.go:50-86).
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from cluster_util import Cluster
+
+
+@pytest.fixture(scope="module")
+def ha_cluster():
+    c = Cluster(n_volume_servers=2, n_masters=3)
+    yield c
+    c.shutdown()
+
+
+def _status(url):
+    with urllib.request.urlopen(f"http://{url}/cluster/status",
+                                timeout=2) as r:
+        return json.load(r)
+
+
+def test_single_leader_elected(ha_cluster):
+    leaders = [m for m in ha_cluster.masters if m.raft.is_leader]
+    assert len(leaders) == 1
+    # every node agrees on who the leader is
+    leader_id = leaders[0].raft.id
+    for m in ha_cluster.masters:
+        assert m.raft.leader_id == leader_id
+
+
+def test_follower_proxies_assign(ha_cluster):
+    leader = ha_cluster.wait_for_leader()
+    follower = next(m for m in ha_cluster.masters if not m.raft.is_leader)
+    with urllib.request.urlopen(
+            f"http://{follower.url}/dir/assign?count=1", timeout=5) as r:
+        out = json.load(r)
+    assert "fid" in out and "url" in out
+
+
+def test_max_volume_id_replicated(ha_cluster):
+    leader = ha_cluster.wait_for_leader()
+    ha_cluster.client.assign()  # forces at least one volume growth
+    time.sleep(0.3)  # let the commit land on followers
+    for m in ha_cluster.masters:
+        assert m.topology.max_volume_id >= 1, m.raft.id
+
+
+def test_leader_kill_failover_keeps_assigning(ha_cluster):
+    c = ha_cluster
+    before = c.client.assign()
+    assert "fid" in before
+
+    leader = c.wait_for_leader()
+    idx = c.masters.index(leader)
+    c.stop_master(idx)
+    survivors = [m for i, m in enumerate(c.masters) if i != idx]
+
+    # a new leader emerges among the survivors
+    deadline = time.time() + 10
+    new_leader = None
+    while time.time() < deadline and new_leader is None:
+        new_leader = next((m for m in survivors if m.raft.is_leader), None)
+        time.sleep(0.05)
+    assert new_leader is not None, "no new leader elected after kill"
+    assert new_leader.raft.term > leader.raft.term
+
+    # volume servers re-home their heartbeats to a surviving master
+    c.wait_heartbeats()
+    time.sleep(c.pulse * 3)
+
+    # assignment keeps working through the client's HA master list
+    after = c.client.assign()
+    assert "fid" in after
+
+    # the replicated MaxVolumeId survived the failover: new volume ids
+    # never collide with pre-failover ones
+    vid_before = int(before["fid"].split(",")[0])
+    assert new_leader.topology.max_volume_id >= vid_before
