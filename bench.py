@@ -66,6 +66,34 @@ def measure_link() -> tuple[float, float]:
     return h2d, d2h
 
 
+def bench_fused(work: str, coder, vol_size: int) -> dict:
+    """BASELINE config 5: compaction + gzip + RS(10,4) in one pass over a
+    needle volume that is ~50% garbage."""
+    from seaweedfs_tpu.ec.fused import fused_vacuum_gzip_encode
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    vdir = os.path.join(work, "fusedvol")
+    os.makedirs(vdir, exist_ok=True)
+    v = Volume(vdir, "", 7, create=True)
+    needle_data = (b"fused bench payload: compressible text block. " * 450)
+    target = min(vol_size // 4, 256 * 1024 * 1024)
+    count = max(target // len(needle_data), 10)
+    for i in range(1, count + 1):
+        v.write_needle(Needle(cookie=i, id=i, data=needle_data))
+    for i in range(1, count + 1, 2):
+        v.delete_needle(Needle(cookie=i, id=i))
+    src_bytes = v.data_file_size()
+    dst = os.path.join(vdir, "out_7")
+    t0 = time.perf_counter()
+    out = fused_vacuum_gzip_encode(v, dst, coder)
+    dt = time.perf_counter() - t0
+    v.close()
+    return {"src_bytes": src_bytes,
+            "compacted_bytes": out["compacted_bytes"],
+            "gbps": round(src_bytes / dt / 1e9, 3)}
+
+
 def bench_kernel(k: int, m: int, n: int, reps: int):
     import jax
     import jax.numpy as jnp
@@ -173,6 +201,8 @@ def _run_configs(work, coder, vol_size, kernel_n, kernel_reps, rebuild_reps,
         n = kernel_n - kernel_n % (16384 * 8)
         sweep[f"{k},{m}"] = round(bench_kernel(k, m, n, kernel_reps), 2)
 
+    fused = bench_fused(work, coder, vol_size)
+
     print(json.dumps({
         "metric": "ec.encode pipeline GB/s/chip (.dat -> .ec00-13)",
         "value": round(pipeline_gbps, 2),
@@ -187,6 +217,7 @@ def _run_configs(work, coder, vol_size, kernel_n, kernel_reps, rebuild_reps,
             "rebuild_gbps": round(
                 10 * shard_size / rebuild_p50 / 1e9, 2),
             "sweep_kernel_gbps": sweep,
+            "fused_compact_gzip_rs": fused,
             "link_h2d_gbps": round(h2d_gbps, 3),
             "link_d2h_gbps": round(d2h_gbps, 3),
             "note": ("pipeline includes disk read, host<->device transfer "

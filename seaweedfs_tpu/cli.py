@@ -113,7 +113,8 @@ def cmd_filer(args) -> None:
         default_collection=args.collection,
         meta_log_path=args.meta_log,
         peers=[p for p in args.peers.split(",") if p],
-        notifier=notifier, guard=_load_guard()))
+        notifier=notifier, guard=_load_guard(),
+        cipher=args.encrypt_volume_data))
 
 
 def cmd_watch(args) -> None:
@@ -238,32 +239,88 @@ def cmd_delete(args) -> None:
 
 
 def cmd_shell(args) -> None:
+    """Admin shell: one-shot `weed shell <cmd> [args]` or interactive REPL
+    (weed/shell/shell_liner.go)."""
     from .client import Client
     from .ec.geometry import Geometry
-    from .shell.ec_commands import EcCommands
+    from .shell import commands as shell_commands
+    from .shell.commands import CommandEnv, COMMANDS, run_command
+    shell_commands._register_all()
     c = Client(args.server)
-    geometry = Geometry(large_block_size=args.ec_large_block,
-                        small_block_size=args.ec_small_block)
-    ec = EcCommands(c, geometry)
-    op = args.op
-    if op == "ec.encode":
-        print(json.dumps(ec.encode(args.volume, args.collection,
-                                   apply=not args.dry_run)))
-    elif op == "ec.rebuild":
-        print(json.dumps(ec.rebuild(args.volume, args.collection,
-                                    apply=not args.dry_run)))
-    elif op == "ec.balance":
-        print(json.dumps(ec.balance(args.collection,
-                                    apply=not args.dry_run)))
-    elif op == "ec.decode":
-        print(json.dumps(ec.decode(args.volume, args.collection,
-                                   apply=not args.dry_run)))
-    elif op == "volume.vacuum":
-        for url in c.lookup(args.volume):
-            print(json.dumps(c.volume_admin(url, "vacuum",
-                                            {"volume_id": args.volume})))
-    else:
-        raise SystemExit(f"unknown shell op {op}")
+
+    # back-compat with the round-1 flag style (`shell ec.encode -volume N
+    # -ec_large_block B`): argparse REMAINDER swallows those flags, so
+    # fold them back into the geometry / new-style argv here
+    large, small = args.ec_large_block, args.ec_small_block
+    argv: list[str] = []
+    raw = list(args.cmd or [])
+    i = 0
+    while i < len(raw):
+        tok = raw[i]
+        needs_value = tok in ("-volume", "-ec_large_block",
+                              "-ec_small_block")
+        if needs_value and i + 1 >= len(raw):
+            raise SystemExit(f"shell: flag {tok} needs a value")
+        try:
+            if tok == "-volume":
+                argv += ["-volumeId", raw[i + 1]]
+                i += 2
+            elif tok == "-dry_run":
+                argv.append("-dryRun")
+                i += 1
+            elif tok == "-ec_large_block":
+                large = int(raw[i + 1])
+                i += 2
+            elif tok == "-ec_small_block":
+                small = int(raw[i + 1])
+                i += 2
+            else:
+                argv.append(tok)
+                i += 1
+        except ValueError:
+            raise SystemExit(f"shell: bad value for {tok}: {raw[i + 1]!r}")
+    if argv and args.volume:
+        argv += ["-volumeId", str(args.volume)]
+    if argv and args.collection:
+        argv += ["-collection", args.collection]
+    if argv and args.dry_run:
+        argv.append("-dryRun")
+
+    geometry = Geometry(large_block_size=large, small_block_size=small)
+    env = CommandEnv(c, geometry, filer=args.filer)
+
+    def show(result) -> None:
+        if isinstance(result, bytes):
+            import sys as sys_mod
+            sys_mod.stdout.buffer.write(result)
+            sys_mod.stdout.buffer.flush()
+        else:
+            print(json.dumps(result, indent=None, default=str))
+
+    if argv:
+        show(run_command(env, argv))
+        return
+
+    # REPL
+    import sys as sys_mod
+    print(f"seaweedfs-tpu shell: {len(COMMANDS)} commands; "
+          "'help' lists them, ctrl-d exits", file=sys_mod.stderr)
+    while True:
+        try:
+            line = input("> ")
+        except EOFError:
+            break
+        line = line.strip()
+        if not line:
+            continue
+        if line in ("exit", "quit"):
+            break
+        try:
+            show(run_command(env, line))
+        except Exception as e:
+            print(json.dumps({"error": str(e)}))
+    if env.locked:
+        env.release_lock()
 
 
 def cmd_backup(args) -> None:
@@ -480,6 +537,10 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("-collection", default="")
     f.add_argument("-meta_log", default="",
                    help="path for the persisted metadata event log")
+    f.add_argument("-encryptVolumeData", dest="encrypt_volume_data",
+                   action="store_true",
+                   help="AES-256-GCM encrypt chunk data on volume servers"
+                        " (weed filer -encryptVolumeData)")
     f.add_argument("-peers", default="",
                    help="comma-separated peer filer host:port for "
                         "active-active metadata sync")
@@ -559,15 +620,17 @@ def build_parser() -> argparse.ArgumentParser:
     rm.add_argument("fids", nargs="+")
     rm.set_defaults(fn=cmd_delete)
 
-    sh = sub.add_parser("shell", help="admin ops")
+    sh = sub.add_parser("shell", help="admin shell (REPL or one-shot)")
     sh.add_argument("-server", default="127.0.0.1:9333")
-    sh.add_argument("op", choices=["ec.encode", "ec.rebuild", "ec.balance",
-                                   "ec.decode", "volume.vacuum"])
+    sh.add_argument("-filer", default="",
+                    help="filer host:port for fs.*/bucket.*/fsck commands")
     sh.add_argument("-volume", type=int, default=0)
     sh.add_argument("-collection", default="")
     sh.add_argument("-dry_run", action="store_true")
     sh.add_argument("-ec_large_block", type=int, default=1024 * 1024 * 1024)
     sh.add_argument("-ec_small_block", type=int, default=1024 * 1024)
+    sh.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="command + args (empty for interactive REPL)")
     sh.set_defaults(fn=cmd_shell)
 
     bk = sub.add_parser("backup", help="incrementally pull a volume locally")

@@ -20,6 +20,11 @@ class ClientError(RuntimeError):
     pass
 
 
+# sentinel timestamp for vid-cache entries fed by the KeepConnected push
+# stream: they are authoritative until the stream says otherwise
+_PUSHED = -1.0
+
+
 def _get_json(url: str, timeout: float = 30.0) -> dict:
     try:
         with urllib.request.urlopen(url, timeout=timeout) as r:
@@ -57,6 +62,8 @@ class Client:
         self.guard = guard  # security Guard for signing delete jwts
         self._vid_cache: dict[int, tuple[list[str], float]] = {}
         self._vid_cache_ttl = 60.0
+        self._watch_thread = None
+        self._watch_stop = False
 
     @property
     def master(self) -> str:
@@ -126,7 +133,8 @@ class Client:
 
     def lookup(self, vid: int) -> list[str]:
         cached = self._vid_cache.get(vid)
-        if cached and time.time() - cached[1] < self._vid_cache_ttl:
+        if cached and (cached[1] == _PUSHED
+                       or time.time() - cached[1] < self._vid_cache_ttl):
             return cached[0]
         out = self._master_get(f"/dir/lookup?volumeId={vid}")
         urls = [loc["url"] for loc in out.get("locations", [])]
@@ -134,6 +142,60 @@ class Client:
             raise ClientError(out.get("error", f"volume {vid} not found"))
         self._vid_cache[vid] = (urls, time.time())
         return urls
+
+    # --- KeepConnected vid-location subscription ---
+    # (wdclient/masterclient.go:95-151 + vid_map.go: the master pushes
+    # location deltas over /cluster/watch; pushed entries never expire and
+    # per-read /dir/lookup polling stops)
+    def watch_start(self) -> None:
+        """Start the background KeepConnected subscription."""
+        import threading
+        if self._watch_thread is not None:
+            return
+        self._watch_stop = False
+        self._watch_thread = threading.Thread(target=self._watch_main,
+                                              daemon=True)
+        self._watch_thread.start()
+
+    def watch_stop(self) -> None:
+        self._watch_stop = True
+        self._watch_thread = None
+
+    def _watch_main(self) -> None:
+        while not self._watch_stop:
+            try:
+                url = f"http://{self.master}/cluster/watch"
+                with urllib.request.urlopen(url, timeout=3600) as r:
+                    for line in r:
+                        if self._watch_stop:
+                            return
+                        self._watch_apply(json.loads(line))
+            except Exception:
+                # stream loss (leader death, network): rotate and redial,
+                # picking up a fresh snapshot from the new leader
+                self._master_i = (self._master_i + 1) % len(self.masters)
+                time.sleep(0.2)
+
+    def _watch_apply(self, msg: dict) -> None:
+        if msg.get("type") == "snapshot":
+            fresh = {int(vid): ([loc["url"] for loc in locs], _PUSHED)
+                     for vid, locs in msg.get("volumes", {}).items()}
+            self._vid_cache.clear()
+            self._vid_cache.update(fresh)
+        elif msg.get("type") == "update":
+            url = msg["url"]
+            for vid in msg.get("new_vids", []):
+                urls, _ = self._vid_cache.get(vid, ([], _PUSHED))
+                if url not in urls:
+                    urls = urls + [url]
+                self._vid_cache[vid] = (urls, _PUSHED)
+            for vid in msg.get("deleted_vids", []):
+                urls, _ = self._vid_cache.get(vid, ([], _PUSHED))
+                urls = [u for u in urls if u != url]
+                if urls:
+                    self._vid_cache[vid] = (urls, _PUSHED)
+                else:
+                    self._vid_cache.pop(vid, None)
 
     def grow(self, count: int = 1, collection: str = "",
              replication: str = "", ttl: str = "") -> dict:

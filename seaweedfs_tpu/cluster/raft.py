@@ -42,10 +42,19 @@ class RaftNode:
                  apply_fn: Callable[[dict], None],
                  election_timeout: tuple[float, float] = (0.3, 0.6),
                  heartbeat_interval: float = 0.1,
-                 state_dir: Optional[str] = None):
+                 state_dir: Optional[str] = None,
+                 capture_fn: Optional[Callable[[], dict]] = None,
+                 restore_fn: Optional[Callable[[dict], None]] = None,
+                 max_log_entries: int = 256):
         self.id = node_id
         self.peers = [p for p in peers if p != node_id]
         self.apply_fn = apply_fn
+        # snapshotting (goraft persisted MaxVolumeId the same way,
+        # raft_server.go:34-51): capture_fn serializes the applied state
+        # machine, restore_fn reinstates it on a lagging follower
+        self.capture_fn = capture_fn
+        self.restore_fn = restore_fn
+        self.max_log_entries = max_log_entries
         self.election_timeout = election_timeout
         self.heartbeat_interval = heartbeat_interval
         self.state_path = (os.path.join(state_dir, "raft_state.json")
@@ -55,6 +64,9 @@ class RaftNode:
         self.term = 0
         self.voted_for: Optional[str] = None
         self.log: list[dict] = []  # {"term": int, "cmd": dict}
+        self.snap_index = 0        # last log index folded into the snapshot
+        self.snap_term = 0
+        self.snap_state: dict = {}
         self._load_state()
 
         # volatile
@@ -100,6 +112,11 @@ class RaftNode:
             self.term = st["term"]
             self.voted_for = st.get("voted_for")
             self.log = st.get("log", [])
+            self.snap_index = st.get("snap_index", 0)
+            self.snap_term = st.get("snap_term", 0)
+            self.snap_state = st.get("snap_state", {})
+            if self.snap_state and self.restore_fn:
+                self.restore_fn(self.snap_state)
 
     def _save_state(self) -> None:
         if not self.state_path:
@@ -107,16 +124,39 @@ class RaftNode:
         tmp = self.state_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"term": self.term, "voted_for": self.voted_for,
-                       "log": self.log}, f)
+                       "log": self.log, "snap_index": self.snap_index,
+                       "snap_term": self.snap_term,
+                       "snap_state": self.snap_state}, f)
         os.replace(tmp, self.state_path)
 
-    # --- log helpers (1-based indices) ---
+    # --- log helpers (1-based global indices; the in-memory list holds
+    #     entries (snap_index, snap_index + len(log)]) ---
     def _last_index(self) -> int:
-        return len(self.log)
+        return self.snap_index + len(self.log)
+
+    def _entry(self, index: int) -> dict:
+        return self.log[index - self.snap_index - 1]
 
     def _term_at(self, index: int) -> int:
-        return self.log[index - 1]["term"] if 1 <= index <= len(self.log) \
-            else 0
+        if index == self.snap_index:
+            return self.snap_term
+        if self.snap_index < index <= self._last_index():
+            return self._entry(index)["term"]
+        return 0
+
+    def _maybe_compact(self) -> None:
+        """Fold applied entries into the snapshot once the log grows past
+        max_log_entries, bounding both memory and _save_state cost."""
+        if len(self.log) <= self.max_log_entries:
+            return
+        cut = self.last_applied - self.snap_index
+        if cut <= 0:
+            return
+        self.snap_term = self._term_at(self.last_applied)
+        del self.log[:cut]
+        self.snap_index = self.last_applied
+        self.snap_state = self.capture_fn() if self.capture_fn else {}
+        self._save_state()
 
     @property
     def is_leader(self) -> bool:
@@ -176,6 +216,7 @@ class RaftNode:
             self.commit_index = self._last_index()
             self._apply_committed()
             return
+        self._prune_tasks()
         self._tasks.append(asyncio.create_task(self._leader_loop()))
 
     def _step_down(self, term: int) -> None:
@@ -202,11 +243,19 @@ class RaftNode:
 
     async def _replicate_to(self, peer: str) -> None:
         nxt = self.next_index.get(peer, self._last_index() + 1)
+        if nxt <= self.snap_index:
+            # follower is behind the compacted log: install the snapshot
+            # first (InstallSnapshot folded into AppendEntries)
+            nxt = self.snap_index + 1
         prev = nxt - 1
-        entries = self.log[nxt - 1:]
+        entries = self.log[nxt - self.snap_index - 1:]
         req = {"term": self.term, "leader_id": self.id,
                "prev_log_index": prev, "prev_log_term": self._term_at(prev),
                "entries": entries, "leader_commit": self.commit_index}
+        if prev == self.snap_index and self.snap_index > 0:
+            req["snapshot"] = {"state": self.snap_state,
+                               "index": self.snap_index,
+                               "term": self.snap_term}
         r = await self._post(peer, "/cluster/raft/append", req)
         if not isinstance(r, dict) or self.role != LEADER:
             return
@@ -218,6 +267,9 @@ class RaftNode:
             self.next_index[peer] = self.match_index[peer] + 1
         else:
             self.next_index[peer] = max(1, nxt - 1)
+
+    def _prune_tasks(self) -> None:
+        self._tasks = [t for t in self._tasks if not t.done()]
 
     def _advance_commit(self) -> None:
         if self.role != LEADER:
@@ -236,9 +288,10 @@ class RaftNode:
         while self.last_applied < self.commit_index:
             self.last_applied += 1
             try:
-                self.apply_fn(self.log[self.last_applied - 1]["cmd"])
+                self.apply_fn(self._entry(self.last_applied)["cmd"])
             except Exception as e:
                 log.error("apply failed at %d: %s", self.last_applied, e)
+        self._maybe_compact()
         done, self._commit_waiters = self._commit_waiters, []
         for index, term, fut in done:
             if fut.done():
@@ -314,7 +367,24 @@ class RaftNode:
         self.leader_id = req["leader_id"]
         self._timer_reset.set()
 
+        snap = req.get("snapshot")
+        if snap and snap["index"] > self.snap_index:
+            # install the leader's snapshot: reinstate state, reset log
+            if self.restore_fn:
+                self.restore_fn(snap["state"])
+            self.log = []
+            self.snap_index = snap["index"]
+            self.snap_term = snap["term"]
+            self.snap_state = snap["state"]
+            self.commit_index = max(self.commit_index, snap["index"])
+            self.last_applied = max(self.last_applied, snap["index"])
+            self._save_state()
+
         prev = req["prev_log_index"]
+        if prev < self.snap_index:
+            # stale append below our snapshot floor: everything up to
+            # snap_index is already committed here
+            return {"term": self.term, "success": False}
         if prev > 0 and (prev > self._last_index()
                          or self._term_at(prev) != req["prev_log_term"]):
             return {"term": self.term, "success": False}
@@ -324,7 +394,7 @@ class RaftNode:
             idx += 1
             if idx <= self._last_index():
                 if self._term_at(idx) != entry["term"]:
-                    del self.log[idx - 1:]
+                    del self.log[idx - self.snap_index - 1:]
                     self.log.append(entry)
             else:
                 self.log.append(entry)

@@ -108,6 +108,21 @@ class EcVolume:
             return s.size
         return self.remote_shard_size
 
+    def live_entries(self) -> list[tuple[int, int]]:
+        """Live (needle_id, size) pairs from the sorted .ecx, skipping
+        tombstones (the fsck inventory for EC volumes)."""
+        out = []
+        with self._lock:
+            n = self.ecx_size // t.NEEDLE_MAP_ENTRY_SIZE
+            for i in range(n):
+                entry = os.pread(self._ecx.fileno(),
+                                 t.NEEDLE_MAP_ENTRY_SIZE,
+                                 i * t.NEEDLE_MAP_ENTRY_SIZE)
+                key, offset, size = idx_mod.unpack_entry(entry)
+                if not t.size_is_deleted(size):
+                    out.append((key, size))
+        return out
+
     # --- index lookup ---
     def find_needle(self, needle_id: int) -> tuple[int, int]:
         """(stored_offset, size) via on-disk binary search

@@ -1,0 +1,85 @@
+"""Fused maintenance pipeline: compaction + gzip + RS encode (BASELINE
+config 5).
+
+One call takes a live volume with deleted space straight to erasure-coded
+shards: live needles are copied out (compaction — the Compact2 snapshot
+walk, weed/storage/volume_vacuum.go:66-89), payloads gzipped where it pays
+(weed/util/compression.go), and the compacted `.dat` stream feeds the
+overlapped TPU encode pipeline (ec/pipeline.py) — so the chip starts
+encoding while the host is still compacting the tail.
+
+The output is a fresh volume (`<dst>.dat/.idx`) plus its `.ec00-13`/`.ecx`
+shard set; the source volume is untouched.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from ..storage import idx as idx_mod
+from ..storage import types as t
+from ..storage.needle import FLAG_IS_COMPRESSED
+from ..storage.superblock import SuperBlock
+from ..utils import compression
+from . import striping
+from .coder import ErasureCoder
+from .geometry import DEFAULT, Geometry
+from .pipeline import DEFAULT_BATCH_SIZE, stream_encode
+
+
+def fused_vacuum_gzip_encode(volume, dst_base: str, coder: ErasureCoder,
+                             geometry: Geometry = DEFAULT,
+                             batch_size: int = DEFAULT_BATCH_SIZE,
+                             gzip_level: int = 1) -> dict:
+    """Compact `volume` into <dst_base>.dat (gzipping payloads), then
+    erasure-code the result through the overlapped pipeline. The two-tier
+    stripe layout needs the final compacted size before shard rows can be
+    assigned, so the phases chain (the encode itself overlaps disk/H2D/
+    kernel/write-back internally).
+
+    Returns {live_needles, src_bytes, compacted_bytes, shard_files}.
+    """
+    src_size = volume.data_file_size()
+    with volume._lock:
+        snapshot = [nv for nv in volume.nm._map.values()
+                    if t.size_is_valid(nv.size)]
+        sb = SuperBlock(
+            version=volume.super_block.version,
+            replica_placement=volume.super_block.replica_placement,
+            ttl=volume.super_block.ttl,
+            compaction_revision=volume.super_block.compaction_revision + 1,
+            extra=volume.super_block.extra)
+    snapshot.sort(key=lambda nv: nv.offset)
+
+    with open(dst_base + ".dat", "wb", buffering=1 << 20) as dat, \
+            open(dst_base + ".idx", "wb") as idx:
+        dat.write(sb.to_bytes())
+        offset = len(sb.to_bytes())
+        for nv in snapshot:
+            n = volume.read_needle_at(t.stored_to_offset(nv.offset),
+                                      nv.size)
+            if n.data and not n.is_compressed:
+                comp = compression.compress(n.data, level=gzip_level)
+                if len(comp) * 10 < len(n.data) * 9:
+                    n.data = comp
+                    n.set_flag(FLAG_IS_COMPRESSED)
+            record = n.to_bytes(volume.version)
+            if offset % t.NEEDLE_PADDING_SIZE:
+                pad = (-offset) % t.NEEDLE_PADDING_SIZE
+                dat.write(bytes(pad))
+                offset += pad
+            dat.write(record)
+            idx.write(idx_mod.pack_entry(nv.key, t.offset_to_stored(offset),
+                                         n.size))
+            offset += len(record)
+
+    stream_encode(dst_base, coder, geometry, batch_size=batch_size)
+    striping.write_sorted_ecx_from_idx(dst_base)
+    return {
+        "live_needles": len(snapshot),
+        "src_bytes": src_size,
+        "compacted_bytes": os.path.getsize(dst_base + ".dat"),
+        "shard_files": geometry.total_shards,
+    }

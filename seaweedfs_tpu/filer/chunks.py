@@ -22,19 +22,23 @@ class FileChunk:
     mtime: int = 0       # nanoseconds; later wins
     etag: str = ""
     is_chunk_manifest: bool = False
+    cipher_key: str = ""  # base64 AES-256 key when server-side encrypted
 
     def to_dict(self) -> dict:
         d = {"fid": self.fid, "offset": self.offset, "size": self.size,
              "mtime": self.mtime, "etag": self.etag}
         if self.is_chunk_manifest:
             d["is_chunk_manifest"] = True
+        if self.cipher_key:
+            d["cipher_key"] = self.cipher_key
         return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "FileChunk":
         return cls(fid=d["fid"], offset=d["offset"], size=d["size"],
                    mtime=d.get("mtime", 0), etag=d.get("etag", ""),
-                   is_chunk_manifest=d.get("is_chunk_manifest", False))
+                   is_chunk_manifest=d.get("is_chunk_manifest", False),
+                   cipher_key=d.get("cipher_key", ""))
 
 
 @dataclass

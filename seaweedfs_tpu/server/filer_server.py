@@ -27,6 +27,7 @@ from typing import Optional
 import aiohttp
 from aiohttp import web
 
+from ..client import _PUSHED
 from ..filer.chunks import FileChunk, etag as chunks_etag, read_plan, total_size
 from ..filer.entry import Entry, new_directory, new_file
 from ..filer.filer import Filer, _norm
@@ -64,7 +65,8 @@ class FilerServer:
                  meta_log_path: str = "",
                  peers: Optional[list[str]] = None,
                  notifier=None,
-                 guard=None):
+                 guard=None,
+                 cipher: bool = False):
         # comma-separated HA master list; rotates on failure like the
         # Client/VolumeServer (wdclient/masterclient.go)
         self.masters = [m.strip() for m in master_url.split(",")
@@ -78,6 +80,9 @@ class FilerServer:
                            meta_log_path=meta_log_path)
         self.peers = [p for p in (peers or []) if p]
         self.guard = guard
+        # server-side AES-256-GCM chunk encryption
+        # (filer_server_handlers_write_cipher.go:17, util/cipher.go)
+        self.cipher = cipher
         self.notifier = notifier
         if notifier is not None:
             self.filer.meta_log.subscribe(notifier.notify)
@@ -86,6 +91,7 @@ class FilerServer:
         self._delete_queue: asyncio.Queue = asyncio.Queue()
         self._delete_task: Optional[asyncio.Task] = None
         self._aggregator_tasks: list[asyncio.Task] = []
+        self._watch_task: Optional[asyncio.Task] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._vid_cache: dict[int, tuple[list[str], float]] = {}
         self.app = self._build_app()
@@ -330,6 +336,7 @@ class FilerServer:
         self._loop = asyncio.get_event_loop()
         self._session = aiohttp.ClientSession()
         self._delete_task = asyncio.create_task(self._deletion_worker())
+        self._watch_task = asyncio.create_task(self._watch_master())
         for peer in self.peers:
             self._aggregator_tasks.append(
                 asyncio.create_task(self._aggregate_from_peer(peer)))
@@ -337,11 +344,56 @@ class FilerServer:
     async def _on_cleanup(self, app) -> None:
         if self._delete_task:
             self._delete_task.cancel()
+        if self._watch_task:
+            self._watch_task.cancel()
         for t in self._aggregator_tasks:
             t.cancel()
         if self._session:
             await self._session.close()
         self.filer.close()
+
+    async def _watch_master(self) -> None:
+        """KeepConnected vid-location subscription: the master pushes
+        location deltas, so chunk reads stop polling /dir/lookup
+        (wdclient/masterclient.go:95-151). Stream loss redials the next
+        master and picks up a fresh snapshot."""
+        import json as json_mod
+        while True:
+            try:
+                async with self._session.get(
+                        f"http://{self.master_url}/cluster/watch",
+                        timeout=aiohttp.ClientTimeout(total=None,
+                                                      sock_read=3600)) as r:
+                    async for line in r.content:
+                        msg = json_mod.loads(line)
+                        if msg.get("type") == "snapshot":
+                            self._vid_cache = {
+                                int(vid): ([x["url"] for x in locs],
+                                           _PUSHED)
+                                for vid, locs in
+                                msg.get("volumes", {}).items()}
+                        elif msg.get("type") == "update":
+                            self._apply_location_update(msg)
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                self._master_i = (self._master_i + 1) % len(self.masters)
+                await asyncio.sleep(0.2)
+
+    def _apply_location_update(self, msg: dict) -> None:
+        url = msg["url"]
+        for vid in msg.get("new_vids", []):
+            urls, _ = self._vid_cache.get(vid, ([], _PUSHED))
+            if url not in urls:
+                urls = urls + [url]
+            self._vid_cache[vid] = (urls, _PUSHED)
+        for vid in msg.get("deleted_vids", []):
+            urls, _ = self._vid_cache.get(vid, ([], _PUSHED))
+            urls = [u for u in urls if u != url]
+            if urls:
+                self._vid_cache[vid] = (urls, _PUSHED)
+            else:
+                self._vid_cache.pop(vid, None)
 
     # --- chunk-freeing queue (filer_deletion.go) ---
     def _queue_chunk_deletes(self, chunks: list[FileChunk]) -> None:
@@ -410,7 +462,8 @@ class FilerServer:
 
     async def _lookup(self, vid: int) -> list[str]:
         cached = self._vid_cache.get(vid)
-        if cached and time.time() - cached[1] < 60:
+        if cached and (cached[1] == _PUSHED
+                       or time.time() - cached[1] < 60):
             return cached[0]
         body = await self._master_get("/dir/lookup",
                                       {"volumeId": str(vid)})
@@ -431,14 +484,35 @@ class FilerServer:
 
     async def _upload_chunk(self, data: bytes, collection: str,
                             replication: str, ttl: str,
-                            offset: int) -> FileChunk:
+                            offset: int, name_hint: str = "",
+                            mime_hint: str = "") -> FileChunk:
         a = await self._assign(collection, replication, ttl)
+        cipher_key = ""
+        payload = data
+        if self.cipher:
+            # per-chunk AES-256-GCM: the volume server stores ciphertext,
+            # the key lives only in the filer's chunk metadata
+            # (filer_server_handlers_write_cipher.go:17)
+            from ..utils import cipher as cipher_mod
+            payload, key = await asyncio.get_event_loop().run_in_executor(
+                None, cipher_mod.encrypt, data)
+            cipher_key = cipher_mod.key_to_str(key)
         form = aiohttp.FormData()
-        form.add_field("file", data, filename="chunk",
-                       content_type="application/octet-stream")
+        # name/mime hints let the volume server's compression decision
+        # table see the real content type (chunks themselves are opaque)
+        form.add_field("file", payload,
+                       filename=name_hint or "chunk",
+                       content_type=(mime_hint if not cipher_key else "")
+                       or "application/octet-stream")
         url = f"http://{a['url']}/{a['fid']}"
+        params = []
+        if cipher_key:
+            # ciphertext is incompressible and must round-trip bit-exact
+            params.append("compress=false")
         if ttl:
-            url += f"?ttl={ttl}"
+            params.append(f"ttl={ttl}")
+        if params:
+            url += "?" + "&".join(params)
         headers = {}
         if a.get("auth"):
             # carry the master-signed per-fid write token to the volume
@@ -450,10 +524,24 @@ class FilerServer:
                     text=f"chunk upload to {a['url']}: {r.status}")
             body = await r.json()
         return FileChunk(fid=a["fid"], offset=offset, size=len(data),
-                         mtime=time.time_ns(), etag=body.get("eTag", ""))
+                         mtime=time.time_ns(), etag=body.get("eTag", ""),
+                         cipher_key=cipher_key)
 
     async def _fetch_view(self, fid: str, offset_in_chunk: int,
-                          size: int) -> bytes:
+                          size: int, cipher_key: str = "") -> bytes:
+        if cipher_key:
+            # encrypted chunks cannot be range-read: fetch whole, decrypt,
+            # slice (reader side of filer_server_handlers_write_cipher.go)
+            from ..utils import cipher as cipher_mod
+            whole = await self._fetch_raw(fid)
+            plain = await asyncio.get_event_loop().run_in_executor(
+                None, cipher_mod.decrypt, whole,
+                cipher_mod.key_from_str(cipher_key))
+            return plain[offset_in_chunk:offset_in_chunk + size]
+        return await self._fetch_raw(fid, offset_in_chunk, size)
+
+    async def _fetch_raw(self, fid: str, offset_in_chunk: int = 0,
+                         size: int = -1) -> bytes:
         vid = int(fid.split(",")[0])
         last: Optional[Exception] = None
         read_auth = ""
@@ -461,9 +549,10 @@ class FilerServer:
         for attempt in range(2):
             needs_auth = False
             for url in urls:
-                headers = {"Range":
-                           f"bytes={offset_in_chunk}-"
-                           f"{offset_in_chunk + size - 1}"}
+                headers = {}
+                if size >= 0:
+                    headers["Range"] = (f"bytes={offset_in_chunk}-"
+                                        f"{offset_in_chunk + size - 1}")
                 if read_auth:
                     headers["Authorization"] = f"BEARER {read_auth}"
                 try:
@@ -471,7 +560,7 @@ class FilerServer:
                                                  headers=headers) as r:
                         if r.status in (200, 206):
                             data = await r.read()
-                            if r.status == 200:
+                            if r.status == 200 and size >= 0:
                                 data = data[offset_in_chunk:
                                             offset_in_chunk + size]
                             return data
@@ -548,6 +637,7 @@ class FilerServer:
             await resp.write_eof()
             return resp
         plan = read_plan(entry.chunks, start, length)
+        keys = {c.fid: c.cipher_key for c in entry.chunks if c.cipher_key}
         written = start
         for view in plan:
             if view.logic_offset > written:
@@ -555,7 +645,8 @@ class FilerServer:
                 await resp.write(bytes(view.logic_offset - written))
                 written = view.logic_offset
             data = await self._fetch_view(view.fid, view.offset_in_chunk,
-                                          view.size)
+                                          view.size,
+                                          cipher_key=keys.get(view.fid, ""))
             await resp.write(data)
             written += len(data)
         if written < start + length:
@@ -629,7 +720,8 @@ class FilerServer:
                 if not data:
                     break
                 chunks.append(await self._upload_chunk(
-                    bytes(data), collection, replication, ttl, offset))
+                    bytes(data), collection, replication, ttl, offset,
+                    name_hint=path.rsplit("/", 1)[-1], mime_hint=mime))
                 offset += len(data)
         except web.HTTPError:
             # clean up whatever we uploaded

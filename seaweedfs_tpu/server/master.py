@@ -33,7 +33,9 @@ log = logging.getLogger("master")
 
 # routes every master answers itself; everything else is proxied to the
 # Raft leader by followers (proxyToLeader, weed/server/master_server.go:156)
-_LOCAL_PATHS = ("/healthz", "/metrics", "/cluster/status",
+# (/cluster/watch is local because it streams: followers 307-redirect to the
+# leader instead of buffering the stream through the proxy)
+_LOCAL_PATHS = ("/healthz", "/metrics", "/cluster/status", "/cluster/watch",
                 "/cluster/raft/vote", "/cluster/raft/append")
 
 
@@ -66,13 +68,20 @@ class MasterServer:
                              self._raft_apply,
                              election_timeout=election_timeout,
                              heartbeat_interval=raft_heartbeat,
-                             state_dir=raft_state_dir)
+                             state_dir=raft_state_dir,
+                             capture_fn=self._raft_capture,
+                             restore_fn=self._raft_restore)
         self._grow_lock = asyncio.Lock()
         self._vacuum_lock = asyncio.Lock()
         self._vacuum_task: Optional[asyncio.Task] = None
         self._key_bound = 0          # replicated sequencer high-water mark
         self._key_bound_step = 10000  # one raft round per this many keys
         self._seq_synced_term = -1   # term whose ceiling was folded in
+        self._watchers: set = set()  # KeepConnected subscriber queues
+        # admin exclusive locks: name -> (token, client_name, expires_at)
+        # (LeaseAdminToken, weed/server/master_grpc_server_admin.go:21-138)
+        self._admin_locks: dict[str, tuple[int, str, float]] = {}
+        self.admin_lease_seconds = 10.0
         # peer masters are implicitly trusted: raft RPCs and proxied
         # follower->leader traffic must pass any configured IP whitelist
         self._peer_ips = {p.split(":")[0] for p in (peers or [])}
@@ -95,6 +104,17 @@ class MasterServer:
                                               cmd["max_volume_id"])
         if "max_file_key" in cmd:
             self._key_bound = max(self._key_bound, cmd["max_file_key"])
+
+    def _raft_capture(self) -> dict:
+        """Snapshot the applied state machine for raft log compaction."""
+        return {"max_volume_id": self.topology.max_volume_id,
+                "max_file_key": self._key_bound}
+
+    def _raft_restore(self, state: dict) -> None:
+        self.topology.max_volume_id = max(self.topology.max_volume_id,
+                                          state.get("max_volume_id", 0))
+        self._key_bound = max(self._key_bound,
+                              state.get("max_file_key", 0))
 
     def _build_app(self) -> web.Application:
         @web.middleware
@@ -138,6 +158,9 @@ class MasterServer:
         app.router.add_get("/col/lookup/ec", self.ec_lookup)
         app.router.add_post("/heartbeat", self.heartbeat)
         app.router.add_get("/cluster/status", self.cluster_status)
+        app.router.add_get("/cluster/watch", self.cluster_watch)
+        app.router.add_post("/cluster/lock", self.cluster_lock)
+        app.router.add_post("/cluster/unlock", self.cluster_unlock)
         app.router.add_post("/cluster/raft/vote", self.raft_vote)
         app.router.add_post("/cluster/raft/append", self.raft_append)
         app.router.add_get("/metrics", self.metrics_handler)
@@ -500,7 +523,7 @@ class MasterServer:
                ec_shards: [...]}."""
         self.metrics.count("heartbeat")
         body = await request.json()
-        self.topology.register_heartbeat(
+        event = self.topology.register_heartbeat(
             node_id=body["node_id"],
             url=body["url"],
             public_url=body.get("public_url", body["url"]),
@@ -510,11 +533,100 @@ class MasterServer:
             payload=body,
         )
         self.sequencer.set_max(body.get("max_file_key", 0))
-        self.topology.prune_dead_nodes()
+        self._broadcast_location(event)
+        for ev in self.topology.prune_dead_nodes():
+            self._broadcast_location(ev)
         return web.json_response({
             "volume_size_limit": self.topology.volume_size_limit,
             "leader": self.raft.leader_id or "",
         })
+
+    # --- KeepConnected push (weed/server/master_grpc_server.go:178-233,
+    #     wdclient/masterclient.go) ---
+    def _broadcast_location(self, event: Optional[dict]) -> None:
+        """Push a vid-location delta to every subscriber; drops nothing —
+        queues are unbounded and subscriber death is handled by the
+        streaming handler."""
+        if not event or (not event["new_vids"] and not event["deleted_vids"]):
+            return
+        msg = dict(event)
+        msg["type"] = "update"
+        for q in list(self._watchers):
+            q.put_nowait(msg)
+
+    def _location_snapshot(self) -> dict:
+        """Current vid -> location urls map, sent on watch connect (the
+        stream-open full sync in the reference)."""
+        vols: dict[str, list] = {}
+        for node in self.topology.nodes.values():
+            for vid in node.volumes:
+                vols.setdefault(str(vid), []).append(
+                    {"url": node.url, "publicUrl": node.public_url})
+            for vid in node.ec_shards:
+                entry = {"url": node.url, "publicUrl": node.public_url}
+                if entry not in vols.setdefault(str(vid), []):
+                    vols[str(vid)].append(entry)
+        return {"type": "snapshot", "volumes": vols,
+                "leader": self.raft.leader_id or ""}
+
+    async def cluster_watch(self, request: web.Request) -> web.StreamResponse:
+        """Long-lived JSON-lines stream of vid-location deltas. Followers
+        redirect to the leader (they receive no heartbeats); clients keep
+        a vid cache fed by this stream instead of polling /dir/lookup."""
+        import json as json_mod
+        if not self.raft.is_leader:
+            leader = self.raft.leader_id
+            if not leader or leader == self.raft.id:
+                return web.json_response({"error": "no leader elected"},
+                                         status=503)
+            raise web.HTTPTemporaryRedirect(
+                location=f"http://{leader}/cluster/watch")
+        resp = web.StreamResponse(
+            headers={"Content-Type": "application/x-ndjson"})
+        await resp.prepare(request)
+        q: asyncio.Queue = asyncio.Queue()
+        self._watchers.add(q)
+        try:
+            await resp.write(
+                json_mod.dumps(self._location_snapshot()).encode() + b"\n")
+            while True:
+                msg = await q.get()
+                await resp.write(json_mod.dumps(msg).encode() + b"\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            self._watchers.discard(q)
+        return resp
+
+    async def cluster_lock(self, request: web.Request) -> web.Response:
+        """Lease the cluster-exclusive admin lock. Renew by presenting the
+        previous token; a stale holder's lease expires after
+        admin_lease_seconds (LeaseAdminToken semantics)."""
+        import time as time_mod
+        body = await request.json()
+        name = body.get("name", "admin")
+        client = body.get("client", "")
+        prev = body.get("previous_token", 0)
+        now = time_mod.time()
+        held = self._admin_locks.get(name)
+        if held and held[2] > now and held[0] != prev:
+            return web.json_response(
+                {"error": f"lock {name} held by {held[1]}",
+                 "holder": held[1]}, status=409)
+        token = (held[0] if held and held[0] == prev
+                 else int(now * 1e9) ^ id(body) & 0xFFFF)
+        expires = now + self.admin_lease_seconds
+        self._admin_locks[name] = (token, client, expires)
+        return web.json_response({"token": token, "expires_at": expires})
+
+    async def cluster_unlock(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        name = body.get("name", "admin")
+        held = self._admin_locks.get(name)
+        if held and held[0] == body.get("token", 0):
+            del self._admin_locks[name]
+            return web.json_response({"ok": True})
+        return web.json_response({"error": "not the holder"}, status=409)
 
     async def cluster_status(self, request: web.Request) -> web.Response:
         return web.json_response({

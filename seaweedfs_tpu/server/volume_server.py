@@ -36,7 +36,9 @@ import aiohttp
 from aiohttp import web
 
 from ..storage.file_id import FileId
-from ..storage.needle import (FLAG_HAS_LAST_MODIFIED, FLAG_HAS_MIME,
+from ..utils import compression
+from ..storage.needle import (FLAG_IS_COMPRESSED,
+                              FLAG_HAS_LAST_MODIFIED, FLAG_HAS_MIME,
                               FLAG_HAS_NAME, FLAG_HAS_TTL, Needle)
 from ..storage import types as t
 from ..storage.store import Store
@@ -101,6 +103,14 @@ class VolumeServer:
                             self.admin_vacuum_cleanup)
         app.router.add_post("/admin/volume/delete", self.admin_volume_delete)
         app.router.add_post("/admin/volume/readonly", self.admin_readonly)
+        app.router.add_post("/admin/volume/mount", self.admin_volume_mount)
+        app.router.add_post("/admin/volume/unmount",
+                            self.admin_volume_unmount)
+        app.router.add_post("/admin/volume/configure_replication",
+                            self.admin_volume_configure)
+        app.router.add_get("/admin/volume/needle_ids", self.admin_needle_ids)
+        app.router.add_post("/admin/tier/upload", self.admin_tier_upload)
+        app.router.add_post("/admin/tier/download", self.admin_tier_download)
         app.router.add_post("/admin/ec/generate", self.admin_ec_generate)
         app.router.add_post("/admin/ec/mount", self.admin_ec_mount)
         app.router.add_post("/admin/ec/unmount", self.admin_ec_unmount)
@@ -237,10 +247,16 @@ class VolumeServer:
                 f'inline; filename="{n.name.decode("utf-8", "replace")}"')
         body = n.data
         if n.is_compressed:
-            headers["Content-Encoding"] = "gzip"
+            # serve gzip verbatim only to clients that accept it; otherwise
+            # decompress server-side (volume_server_handlers_read.go:170-200)
+            if "gzip" in request.headers.get("Accept-Encoding", ""):
+                headers["Content-Encoding"] = "gzip"
+            else:
+                body = compression.decompress(body)
         # range support
         rng = request.headers.get("Range")
-        if rng and rng.startswith("bytes=") and not n.is_compressed:
+        if rng and rng.startswith("bytes=") and \
+                "Content-Encoding" not in headers:
             try:
                 start_s, _, end_s = rng[6:].partition("-")
                 if not start_s:
@@ -291,6 +307,8 @@ class VolumeServer:
         n = Needle(cookie=fid.cookie, id=fid.key)
         reader = await request.multipart() if request.content_type.startswith(
             "multipart/") else None
+        filename, ctype = "", ""
+        already_gzipped = False
         if reader is not None:
             part = await reader.next()
             if part is None:
@@ -305,8 +323,29 @@ class VolumeServer:
             if ctype and ctype != "application/octet-stream":
                 n.set_flag(FLAG_HAS_MIME)
                 n.mime = ctype.encode()[:255]
+            already_gzipped = part.headers.get(
+                "Content-Encoding", "") == "gzip"
         else:
             n.data = await request.read()
+            already_gzipped = request.headers.get(
+                "Content-Encoding", "") == "gzip"
+        # write-path compression (needle_parse_upload.go via
+        # util/compression.go): client-gzipped payloads keep the flag;
+        # compressable content gets gzipped when it actually shrinks.
+        # ?compress=false opts out (e.g. filer-ciphered chunks).
+        # The Content-Encoding header alone is NOT trusted: aiohttp
+        # auto-inflates gzip request bodies on the raw path, so the flag is
+        # only set when the bytes really are a gzip stream.
+        if already_gzipped and compression.is_gzipped(n.data):
+            n.set_flag(FLAG_IS_COMPRESSED)
+        elif request.query.get("compress") != "false":
+            import os as _os
+            ext = _os.path.splitext(filename)[1] if filename else ""
+            payload, compressed = compression.maybe_compress(
+                n.data, ext, ctype)
+            if compressed:
+                n.data = payload
+                n.set_flag(FLAG_IS_COMPRESSED)
         if len(n.data) > 32 * 1024 * 1024:
             return web.json_response({"error": "entry too large"}, status=413)
         ttl_s = request.query.get("ttl", "")
@@ -347,18 +386,27 @@ class VolumeServer:
         if not replicas:
             return True
 
-        def body_for_replica() -> aiohttp.FormData:
-            # re-wrap as multipart so name/mime survive on the replica and
-            # its needle bytes match the primary's
-            form = aiohttp.FormData()
-            form.add_field(
-                "file", n.data,
-                filename=(n.name.decode("utf-8", "replace")
-                          if n.has(FLAG_HAS_NAME) else "file"),
-                content_type=(n.mime.decode("utf-8", "replace")
-                              if n.has(FLAG_HAS_MIME)
-                              else "application/octet-stream"))
-            return form
+        import uuid as uuid_mod
+
+        def body_for_replica() -> tuple[bytes, str]:
+            # raw multipart so name/mime survive on the replica and its
+            # needle bytes match the primary's; already-compressed payloads
+            # carry Content-Encoding so the replica sets the compressed
+            # flag instead of re-compressing/mis-flagging
+            boundary = uuid_mod.uuid4().hex
+            name = (n.name.decode("utf-8", "replace")
+                    if n.has(FLAG_HAS_NAME) else "file")
+            ctype = (n.mime.decode("utf-8", "replace")
+                     if n.has(FLAG_HAS_MIME) else "application/octet-stream")
+            head = (f"--{boundary}\r\n"
+                    f'Content-Disposition: form-data; name="file"; '
+                    f'filename="{name}"\r\n'
+                    f"Content-Type: {ctype}\r\n")
+            if n.is_compressed:
+                head += "Content-Encoding: gzip\r\n"
+            body = head.encode() + b"\r\n" + n.data + \
+                f"\r\n--{boundary}--\r\n".encode()
+            return body, boundary
 
         # forward the caller's write jwt (header or query form) so the peer's
         # guard admits the replicated write (weed/topology/store_replicate.go
@@ -367,11 +415,14 @@ class VolumeServer:
         token = token_from_request(request.headers, request.query)
         if token:
             fwd["jwt"] = token
+        payload, boundary = body_for_replica()
         results = await asyncio.gather(
             *[self._session.post(
                 f"http://{url}/{fid}",
                 params={"type": "replicate", **fwd},
-                data=body_for_replica())
+                data=payload,
+                headers={"Content-Type":
+                         f"multipart/form-data; boundary={boundary}"})
               for url in replicas], return_exceptions=True)
         ok = True
         for url, res in zip(replicas, results):
@@ -541,6 +592,82 @@ class VolumeServer:
         ok = self.store.mark_readonly(int(body["volume_id"]),
                                       body.get("read_only", True))
         return web.json_response({"ok": ok})
+
+    async def admin_volume_mount(self, request: web.Request) -> web.Response:
+        """VolumeMount (weed/server/volume_grpc_admin.go)."""
+        body = await request.json()
+        try:
+            v = await asyncio.get_event_loop().run_in_executor(
+                None, lambda: self.store.mount_volume(
+                    int(body["volume_id"]), body.get("collection", "")))
+        except (KeyError, ValueError) as e:
+            return web.json_response({"error": str(e)}, status=409)
+        await self.send_heartbeat()
+        return web.json_response({"ok": True,
+                                  "file_count": v.file_count()})
+
+    async def admin_volume_unmount(self,
+                                   request: web.Request) -> web.Response:
+        """VolumeUnmount: stop serving, keep files."""
+        body = await request.json()
+        ok = self.store.unmount_volume(int(body["volume_id"]))
+        await self.send_heartbeat()
+        return web.json_response({"ok": ok})
+
+    async def admin_volume_configure(self,
+                                     request: web.Request) -> web.Response:
+        """VolumeConfigure: rewrite superblock replication in place."""
+        body = await request.json()
+        try:
+            self.store.configure_replication(int(body["volume_id"]),
+                                             body["replication"])
+        except (KeyError, ValueError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        await self.send_heartbeat()
+        return web.json_response({"ok": True})
+
+    async def admin_needle_ids(self, request: web.Request) -> web.Response:
+        """Live needle inventory for fsck (command_volume_fsck.go collects
+        the same per-volume id set)."""
+        try:
+            vid = int(request.query["volume_id"])
+            entries = await asyncio.get_event_loop().run_in_executor(
+                None, lambda: self.store.needle_ids(vid))
+        except KeyError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        return web.json_response({"volume_id": vid,
+                                  "needles": [[k, s] for k, s in entries]})
+
+    async def admin_tier_upload(self, request: web.Request) -> web.Response:
+        """Move a sealed volume's .dat to an object-store tier
+        (VolumeTierMoveDatToRemote, volume_grpc_tier_upload.go:14)."""
+        body = await request.json()
+        try:
+            info = await asyncio.get_event_loop().run_in_executor(
+                None, lambda: self.store.tier_upload(
+                    int(body["volume_id"]), body["backend"],
+                    keep_local=body.get("keep_local", False)))
+        except (KeyError, ValueError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        except Exception as e:
+            # upload failure (unreachable store etc.): volume already
+            # un-sealed by the store's rollback
+            return web.json_response({"error": str(e)}, status=502)
+        await self.send_heartbeat()
+        return web.json_response({"ok": True, "info": info})
+
+    async def admin_tier_download(self,
+                                  request: web.Request) -> web.Response:
+        """Bring a tiered .dat back local (VolumeTierMoveDatFromRemote)."""
+        body = await request.json()
+        try:
+            out = await asyncio.get_event_loop().run_in_executor(
+                None, lambda: self.store.tier_download(
+                    int(body["volume_id"])))
+        except (KeyError, ValueError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        await self.send_heartbeat()
+        return web.json_response({"ok": True, **out})
 
     async def admin_ec_generate(self, request: web.Request) -> web.Response:
         body = await request.json()

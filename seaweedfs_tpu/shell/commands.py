@@ -1,0 +1,142 @@
+"""Admin shell framework: command registry + CommandEnv.
+
+Mirrors the reference shell's design (weed/shell/commands.go:28-72): every
+command is a named callable over a shared CommandEnv holding the master
+client, the current filer working directory, and the cluster-exclusive admin
+lock. Commands are pure planners where possible (dry-run testable like
+command_ec_test.go); executors drive the master/volume/filer HTTP APIs.
+
+Registration is by decorator; `weed shell <name> [args...]` and the REPL
+both dispatch through COMMANDS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shlex
+import urllib.request
+from typing import Callable, Optional
+
+from ..client import Client, ClientError, _post_json
+
+COMMANDS: dict[str, "ShellCommand"] = {}
+
+
+class ShellCommand:
+    def __init__(self, name: str, help_text: str, fn: Callable,
+                 destructive: bool = False):
+        self.name = name
+        self.help = help_text
+        self.fn = fn
+        self.destructive = destructive
+
+    def __call__(self, env: "CommandEnv", argv: list[str]):
+        if self.destructive and not env.locked and env.require_lock:
+            raise ClientError(
+                f"{self.name} needs the exclusive lock: run 'lock' first "
+                "(weed/shell/command_fs_lock_unlock.go)")
+        return self.fn(env, argv)
+
+
+def command(name: str, help_text: str, destructive: bool = False):
+    def deco(fn):
+        COMMANDS[name] = ShellCommand(name, help_text, fn, destructive)
+        return fn
+    return deco
+
+
+class CommandEnv:
+    """Shared state across shell commands (weed/shell/commands.go:28-33:
+    CommandEnv{MasterClient, option, locker})."""
+
+    def __init__(self, client: Client, geometry=None, filer: str = "",
+                 require_lock: bool = False):
+        from ..ec.geometry import DEFAULT
+        self.client = client
+        self.geometry = geometry or DEFAULT
+        self.filer = filer.rstrip("/")
+        self.cwd = "/"
+        self.require_lock = require_lock
+        self.lock_token = 0
+        self.lock_name = "admin"
+
+    # --- exclusive lock (wdclient/exclusive_locks/exclusive_locker.go) ---
+    @property
+    def locked(self) -> bool:
+        return self.lock_token != 0
+
+    def acquire_lock(self, client_name: str = "shell") -> dict:
+        out = _post_json(f"http://{self.client.master}/cluster/lock",
+                         {"name": self.lock_name, "client": client_name,
+                          "previous_token": self.lock_token})
+        self.lock_token = out["token"]
+        return out
+
+    def release_lock(self) -> dict:
+        if not self.lock_token:
+            return {"ok": True}
+        out = _post_json(f"http://{self.client.master}/cluster/unlock",
+                         {"name": self.lock_name,
+                          "token": self.lock_token})
+        self.lock_token = 0
+        return out
+
+    # --- filer plumbing for fs.* commands ---
+    def filer_get(self, path: str, params: dict) -> dict:
+        import urllib.parse
+        qs = urllib.parse.urlencode(params)
+        with urllib.request.urlopen(
+                f"http://{self.filer}{path}?{qs}", timeout=60) as r:
+            return json.load(r)
+
+    def filer_post(self, path: str, body: dict) -> dict:
+        return _post_json(f"http://{self.filer}{path}", body)
+
+    def resolve(self, path: str) -> str:
+        """Resolve a possibly-relative filer path against the shell cwd."""
+        if not path or path == ".":
+            return self.cwd
+        if not path.startswith("/"):
+            base = self.cwd.rstrip("/")
+            path = f"{base}/{path}"
+        # normalize . / ..
+        parts: list[str] = []
+        for seg in path.split("/"):
+            if seg in ("", "."):
+                continue
+            if seg == "..":
+                if parts:
+                    parts.pop()
+                continue
+            parts.append(seg)
+        return "/" + "/".join(parts)
+
+
+def parser(prog: str) -> argparse.ArgumentParser:
+    return argparse.ArgumentParser(prog=prog, add_help=False)
+
+
+def run_command(env: CommandEnv, line_or_argv) -> object:
+    """Dispatch one command line (string or argv list)."""
+    argv = (shlex.split(line_or_argv) if isinstance(line_or_argv, str)
+            else list(line_or_argv))
+    if not argv:
+        return None
+    name, rest = argv[0], argv[1:]
+    if name in ("help", "?"):
+        return {n: c.help for n, c in sorted(COMMANDS.items())}
+    cmd = COMMANDS.get(name)
+    if cmd is None:
+        raise ClientError(f"unknown command {name!r}; try 'help'")
+    return cmd(env, rest)
+
+
+def _register_all() -> None:
+    """Import every command module for its registration side effects
+    (the reference does the same via init() imports, shell/commands.go:42)."""
+    from . import bucket_commands  # noqa: F401
+    from . import fs_commands  # noqa: F401
+    from . import lock_commands  # noqa: F401
+    from . import volume_commands  # noqa: F401
+    from . import ec_shell  # noqa: F401

@@ -1,0 +1,62 @@
+"""Registry adapters exposing the EC lifecycle workflows as shell commands
+(ec.encode / ec.rebuild / ec.balance / ec.decode, weed/shell/command_ec_*)."""
+
+from __future__ import annotations
+
+from .commands import CommandEnv, command, parser
+from .ec_commands import EcCommands
+
+
+def _ec(env: CommandEnv) -> EcCommands:
+    return EcCommands(env.client, env.geometry)
+
+
+@command("ec.encode",
+         "erasure-code a volume (ec.encode -volumeId N [-collection c] "
+         "[-dryRun])", destructive=True)
+def ec_encode(env: CommandEnv, argv: list[str]):
+    p = parser("ec.encode")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-dryRun", action="store_true")
+    args = p.parse_args(argv)
+    return _ec(env).encode(args.volumeId, args.collection,
+                           apply=not args.dryRun)
+
+
+@command("ec.rebuild",
+         "rebuild missing EC shards (ec.rebuild -volumeId N "
+         "[-collection c] [-dryRun])", destructive=True)
+def ec_rebuild(env: CommandEnv, argv: list[str]):
+    p = parser("ec.rebuild")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-dryRun", action="store_true")
+    args = p.parse_args(argv)
+    return _ec(env).rebuild(args.volumeId, args.collection,
+                            apply=not args.dryRun)
+
+
+@command("ec.balance",
+         "spread EC shards evenly (ec.balance [-collection c] [-dryRun])",
+         destructive=True)
+def ec_balance(env: CommandEnv, argv: list[str]):
+    p = parser("ec.balance")
+    p.add_argument("-collection", default="")
+    p.add_argument("-dryRun", action="store_true")
+    args = p.parse_args(argv)
+    return _ec(env).balance(args.collection, apply=not args.dryRun)
+
+
+@command("ec.decode",
+         "decode an EC volume back to a normal volume "
+         "(ec.decode -volumeId N [-collection c] [-dryRun])",
+         destructive=True)
+def ec_decode(env: CommandEnv, argv: list[str]):
+    p = parser("ec.decode")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-dryRun", action="store_true")
+    args = p.parse_args(argv)
+    return _ec(env).decode(args.volumeId, args.collection,
+                           apply=not args.dryRun)

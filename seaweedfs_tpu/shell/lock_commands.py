@@ -1,0 +1,19 @@
+"""lock / unlock: the cluster-exclusive admin lease.
+
+Counterpart of weed/shell/command_lock_unlock.go over the master's
+/cluster/lock lease API (master_grpc_server_admin.go:21-138).
+"""
+
+from __future__ import annotations
+
+from .commands import CommandEnv, command
+
+
+@command("lock", "acquire the cluster-exclusive admin lock")
+def lock(env: CommandEnv, argv: list[str]):
+    return env.acquire_lock()
+
+
+@command("unlock", "release the cluster-exclusive admin lock")
+def unlock(env: CommandEnv, argv: list[str]):
+    return env.release_lock()

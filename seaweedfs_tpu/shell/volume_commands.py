@@ -1,0 +1,572 @@
+"""volume.* and volumeServer.* admin commands.
+
+Planner/executor pairs mirroring the reference shell's volume ops:
+- volume.balance       weed/shell/command_volume_balance.go
+- volume.fix.replication  command_volume_fix_replication.go:1-386
+- volume.fsck          command_volume_fsck.go:1-367
+- volume.move/copy/delete/mount/unmount  command_volume_move.go etc.
+- volume.configure.replication  command_volume_configure_replication.go
+- volume.mark          command_volume_mark.go (readonly/writable)
+- volumeServer.evacuate  command_volume_server_evacuate.go
+
+Planners are pure functions over the topology dict (dry-run testable, like
+the reference's command_ec_test.go pattern); executors drive the volume
+servers' admin HTTP API through the Client.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Optional
+
+from ..client import ClientError
+from ..storage.superblock import ReplicaPlacement
+from .commands import CommandEnv, command, parser
+
+
+# --- shared topology helpers ---
+
+def _nodes(env: CommandEnv) -> list[dict]:
+    return env.client.dir_status().get("nodes", [])
+
+
+def _volume_locations(nodes: list[dict]) -> dict[int, list[dict]]:
+    """vid -> [node dicts] over normal volumes."""
+    locs: dict[int, list[dict]] = defaultdict(list)
+    for nd in nodes:
+        for v in nd.get("volumes", []):
+            locs[v["id"]].append(nd)
+    return locs
+
+
+def _volume_info(nodes: list[dict]) -> dict[int, dict]:
+    info: dict[int, dict] = {}
+    for nd in nodes:
+        for v in nd.get("volumes", []):
+            info.setdefault(v["id"], v)
+    return info
+
+
+# --- volume.list ---
+
+@command("volume.list", "print the cluster topology (volume.list)")
+def volume_list(env: CommandEnv, argv: list[str]):
+    return env.client.dir_status()
+
+
+# --- volume.balance ---
+
+def plan_volume_balance(nodes: list[dict],
+                        collection: Optional[str] = None
+                        ) -> list[dict]:
+    """Even out volume counts by capacity ratio (balanceVolumeServers,
+    command_volume_balance.go): move volumes off the node with the highest
+    count/capacity ratio onto the lowest, skipping nodes that already hold
+    the volume (or a replica of it)."""
+    counts = {nd["url"]: len([v for v in nd.get("volumes", [])
+                              if collection in (None, v.get("collection"))])
+              for nd in nodes}
+    caps = {nd["url"]: max(nd.get("max_volume_count", 8), 1)
+            for nd in nodes}
+    holdings = {nd["url"]: {v["id"] for v in nd.get("volumes", [])}
+                for nd in nodes}
+    by_url = {nd["url"]: nd for nd in nodes}
+    moves: list[dict] = []
+    if len(nodes) < 2:
+        return moves
+
+    def ratio(u: str) -> float:
+        return counts[u] / caps[u]
+
+    for _ in range(256):  # bounded; each move strictly reduces spread
+        hi = max(counts, key=ratio)
+        lo = min(counts, key=ratio)
+        if ratio(hi) - ratio(lo) <= 1.0 / caps[lo]:
+            break
+        if counts[lo] >= caps[lo]:
+            break
+        # pick a volume on hi that lo does not hold
+        movable = [vid for vid in holdings[hi] - holdings[lo]
+                   if collection is None or
+                   _vol_collection(by_url[hi], vid) == collection]
+        if not movable:
+            break
+        vid = sorted(movable)[0]
+        moves.append({"volume_id": vid, "from": hi, "to": lo,
+                      "collection": _vol_collection(by_url[hi], vid)})
+        holdings[hi].discard(vid)
+        holdings[lo].add(vid)
+        counts[hi] -= 1
+        counts[lo] += 1
+    return moves
+
+
+def _vol_collection(node: dict, vid: int) -> str:
+    for v in node.get("volumes", []):
+        if v["id"] == vid:
+            return v.get("collection", "")
+    return ""
+
+
+@command("volume.balance",
+         "even out volume counts across servers "
+         "(volume.balance [-collection c] [-force])", destructive=True)
+def volume_balance(env: CommandEnv, argv: list[str]):
+    p = parser("volume.balance")
+    p.add_argument("-collection", default=None)
+    p.add_argument("-force", action="store_true")
+    args = p.parse_args(argv)
+    nodes = _nodes(env)
+    moves = plan_volume_balance(nodes, args.collection)
+    if not args.force:
+        return {"plan": moves, "applied": False}
+    done = []
+    for mv in moves:
+        _move_volume(env, mv["volume_id"], mv["collection"],
+                     mv["from"], mv["to"])
+        done.append(mv)
+    return {"plan": moves, "applied": True, "moved": len(done)}
+
+
+def _move_volume(env: CommandEnv, vid: int, collection: str,
+                 src: str, dst: str) -> None:
+    """Copy to dst (pull model), then delete from src (volume.move)."""
+    env.client.volume_admin(src, "volume/readonly",
+                            {"volume_id": vid, "read_only": True})
+    try:
+        env.client.volume_admin(dst, "volume/copy",
+                                {"volume_id": vid, "collection": collection,
+                                 "source": src})
+    except Exception:
+        env.client.volume_admin(src, "volume/readonly",
+                                {"volume_id": vid, "read_only": False})
+        raise
+    env.client.volume_admin(src, "volume/delete", {"volume_id": vid})
+    env.client.volume_admin(dst, "volume/readonly",
+                            {"volume_id": vid, "read_only": False})
+    env.client._vid_cache.pop(vid, None)
+
+
+# --- volume.fix.replication ---
+
+def plan_fix_replication(nodes: list[dict]) -> list[dict]:
+    """Under-replicated volumes gain a copy on the emptiest non-holding
+    node (DC/rack-spread preferred); over-replicated volumes lose the copy
+    on the fullest holder (command_volume_fix_replication.go:1-386)."""
+    locs = _volume_locations(nodes)
+    info = _volume_info(nodes)
+    actions: list[dict] = []
+    holdings = {nd["url"]: {v["id"] for v in nd.get("volumes", [])}
+                for nd in nodes}
+    load = {nd["url"]: len(nd.get("volumes", [])) for nd in nodes}
+    caps = {nd["url"]: nd.get("max_volume_count", 8) for nd in nodes}
+    by_url = {nd["url"]: nd for nd in nodes}
+
+    for vid, holders in sorted(locs.items()):
+        rp = ReplicaPlacement.parse(info[vid].get("replica_placement",
+                                                  "000"))
+        want = rp.copy_count()
+        have = len(holders)
+        if have < want:
+            held_urls = {nd["url"] for nd in holders}
+            held_racks = {(nd.get("data_center", ""), nd.get("rack", ""))
+                          for nd in holders}
+            candidates = [u for u in holdings if u not in held_urls
+                          and load[u] < caps[u]]
+            if not candidates:
+                actions.append({"volume_id": vid, "action": "impossible",
+                                "have": have, "want": want})
+                continue
+            # prefer a different rack (placement spirit), then emptiest
+            def rack_key(u: str):
+                nd = by_url[u]
+                other_rack = (nd.get("data_center", ""),
+                              nd.get("rack", "")) not in held_racks
+                return (not other_rack, load[u])
+            dst = sorted(candidates, key=rack_key)[0]
+            actions.append({"volume_id": vid, "action": "add",
+                            "from": holders[0]["url"], "to": dst,
+                            "collection": info[vid].get("collection", ""),
+                            "have": have, "want": want})
+            holdings[dst].add(vid)
+            load[dst] += 1
+        elif have > want:
+            victim = max(holders, key=lambda nd: load[nd["url"]])
+            actions.append({"volume_id": vid, "action": "remove",
+                            "from": victim["url"],
+                            "have": have, "want": want})
+            holdings[victim["url"]].discard(vid)
+            load[victim["url"]] -= 1
+    return actions
+
+
+@command("volume.fix.replication",
+         "re-replicate under/over-replicated volumes "
+         "(volume.fix.replication [-force])", destructive=True)
+def volume_fix_replication(env: CommandEnv, argv: list[str]):
+    p = parser("volume.fix.replication")
+    p.add_argument("-force", action="store_true")
+    args = p.parse_args(argv)
+    actions = plan_fix_replication(_nodes(env))
+    if not args.force:
+        return {"plan": actions, "applied": False}
+    applied = 0
+    for act in actions:
+        if act["action"] == "add":
+            env.client.volume_admin(
+                act["to"], "volume/copy",
+                {"volume_id": act["volume_id"],
+                 "collection": act.get("collection", ""),
+                 "source": act["from"]})
+            applied += 1
+        elif act["action"] == "remove":
+            env.client.volume_admin(act["from"], "volume/delete",
+                                    {"volume_id": act["volume_id"]})
+            applied += 1
+    return {"plan": actions, "applied": True, "count": applied}
+
+
+# --- volume.fsck ---
+
+@command("volume.fsck",
+         "cross-check filer chunk references against volume needles "
+         "(volume.fsck [-purgeOrphans])", destructive=False)
+def volume_fsck(env: CommandEnv, argv: list[str]):
+    import stat as stat_mod
+    p = parser("volume.fsck")
+    p.add_argument("-purgeOrphans", action="store_true")
+    args = p.parse_args(argv)
+    if not env.filer:
+        raise ClientError("volume.fsck needs -filer")
+
+    # 1. referenced fids per volume from the filer tree
+    referenced: dict[int, set[int]] = defaultdict(set)
+    from ..storage.file_id import FileId
+    def walk(directory: str) -> None:
+        start = ""
+        while True:
+            out = env.filer_get("/__meta__/list",
+                                {"dir": directory, "start": start,
+                                 "limit": 256})
+            entries = out.get("entries", [])
+            if not entries:
+                return
+            for e in entries:
+                mode = e.get("attr", {}).get("mode", 0)
+                if stat_mod.S_ISDIR(mode):
+                    walk(e["path"])
+                for c in e.get("chunks", []):
+                    try:
+                        fid = FileId.parse(c["fid"])
+                        referenced[fid.volume_id].add(fid.key)
+                    except ValueError:
+                        pass
+            import os.path as osp
+            start = osp.basename(entries[-1]["path"])
+            if len(entries) < 256:
+                return
+    walk("/")
+
+    # 2. live needles per volume from one replica each
+    nodes = _nodes(env)
+    locs = _volume_locations(nodes)
+    ec_vols: dict[int, str] = {}
+    for nd in nodes:
+        for s in nd.get("ec_shards", []):
+            ec_vols.setdefault(s["id"], nd["url"])
+    report = {"volumes": {}, "orphan_count": 0, "missing_count": 0}
+    orphans_by_server: dict[str, list[str]] = defaultdict(list)
+    seen_vids = set()
+    for vid, holders in sorted(locs.items()):
+        _fsck_one(env, vid, holders[0]["url"], referenced, report,
+                  orphans_by_server)
+        seen_vids.add(vid)
+    for vid, url in sorted(ec_vols.items()):
+        if vid not in seen_vids:
+            _fsck_one(env, vid, url, referenced, report, orphans_by_server)
+            seen_vids.add(vid)
+    # chunks referencing volumes that do not exist at all
+    for vid, keys in referenced.items():
+        if vid not in seen_vids:
+            report["volumes"][str(vid)] = {
+                "error": "volume missing entirely",
+                "missing": len(keys)}
+            report["missing_count"] += len(keys)
+
+    if args.purgeOrphans:
+        purged = 0
+        for server, fids in orphans_by_server.items():
+            for r in env.client.volume_admin(server, "batch_delete",
+                                             {"fids": fids})["results"]:
+                if "error" not in r:
+                    purged += 1
+        report["purged"] = purged
+    return report
+
+
+def _fsck_one(env: CommandEnv, vid: int, url: str, referenced, report,
+              orphans_by_server) -> None:
+    import json as json_mod
+    import urllib.request
+    with urllib.request.urlopen(
+            f"http://{url}/admin/volume/needle_ids?volume_id={vid}",
+            timeout=60) as r:
+        present = {k for k, _ in json_mod.load(r)["needles"]}
+    refs = referenced.get(vid, set())
+    orphans = present - refs
+    missing = refs - present
+    report["volumes"][str(vid)] = {"needles": len(present),
+                                   "referenced": len(refs),
+                                   "orphans": len(orphans),
+                                   "missing": len(missing)}
+    report["orphan_count"] += len(orphans)
+    report["missing_count"] += len(missing)
+    # fsck cannot know cookies; that is fine — the tombstone path deletes
+    # by needle id without a cookie comparison (volume.delete_needle)
+    orphans_by_server[url].extend(
+        f"{vid},{k:x}00000000" for k in orphans)
+
+
+# --- explicit volume ops ---
+
+@command("volume.move",
+         "move a volume between servers "
+         "(volume.move -volumeId N -from src -to dst)", destructive=True)
+def volume_move(env: CommandEnv, argv: list[str]):
+    p = parser("volume.move")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-from", dest="src", required=True)
+    p.add_argument("-to", dest="dst", required=True)
+    p.add_argument("-collection", default="")
+    args = p.parse_args(argv)
+    _move_volume(env, args.volumeId, args.collection, args.src, args.dst)
+    return {"ok": True, "volume_id": args.volumeId,
+            "from": args.src, "to": args.dst}
+
+
+@command("volume.copy",
+         "copy a volume to another server "
+         "(volume.copy -volumeId N -from src -to dst)", destructive=True)
+def volume_copy(env: CommandEnv, argv: list[str]):
+    p = parser("volume.copy")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-from", dest="src", required=True)
+    p.add_argument("-to", dest="dst", required=True)
+    p.add_argument("-collection", default="")
+    args = p.parse_args(argv)
+    out = env.client.volume_admin(
+        args.dst, "volume/copy",
+        {"volume_id": args.volumeId, "collection": args.collection,
+         "source": args.src})
+    return {"ok": True, **out}
+
+
+@command("volume.delete",
+         "delete a volume from a server "
+         "(volume.delete -volumeId N -node url)", destructive=True)
+def volume_delete(env: CommandEnv, argv: list[str]):
+    p = parser("volume.delete")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-node", required=True)
+    args = p.parse_args(argv)
+    return env.client.volume_admin(args.node, "volume/delete",
+                                   {"volume_id": args.volumeId})
+
+
+@command("volume.mount",
+         "mount an on-disk volume (volume.mount -volumeId N -node url)",
+         destructive=True)
+def volume_mount(env: CommandEnv, argv: list[str]):
+    p = parser("volume.mount")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-node", required=True)
+    p.add_argument("-collection", default="")
+    args = p.parse_args(argv)
+    return env.client.volume_admin(
+        args.node, "volume/mount",
+        {"volume_id": args.volumeId, "collection": args.collection})
+
+
+@command("volume.unmount",
+         "unmount a volume, keeping its files "
+         "(volume.unmount -volumeId N -node url)", destructive=True)
+def volume_unmount(env: CommandEnv, argv: list[str]):
+    p = parser("volume.unmount")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-node", required=True)
+    args = p.parse_args(argv)
+    return env.client.volume_admin(args.node, "volume/unmount",
+                                   {"volume_id": args.volumeId})
+
+
+@command("volume.mark",
+         "mark a volume readonly/writable "
+         "(volume.mark -volumeId N -node url -readonly|-writable)",
+         destructive=True)
+def volume_mark(env: CommandEnv, argv: list[str]):
+    p = parser("volume.mark")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-node", required=True)
+    p.add_argument("-readonly", action="store_true")
+    p.add_argument("-writable", action="store_true")
+    args = p.parse_args(argv)
+    return env.client.volume_admin(
+        args.node, "volume/readonly",
+        {"volume_id": args.volumeId, "read_only": not args.writable})
+
+
+@command("volume.configure.replication",
+         "rewrite a volume's replication setting "
+         "(volume.configure.replication -volumeId N -replication XYZ)",
+         destructive=True)
+def volume_configure_replication(env: CommandEnv, argv: list[str]):
+    p = parser("volume.configure.replication")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-replication", required=True)
+    args = p.parse_args(argv)
+    ReplicaPlacement.parse(args.replication)  # validate early
+    done = []
+    for nd in _volume_locations(_nodes(env)).get(args.volumeId, []):
+        env.client.volume_admin(
+            nd["url"], "volume/configure_replication",
+            {"volume_id": args.volumeId, "replication": args.replication})
+        done.append(nd["url"])
+    if not done:
+        raise ClientError(f"volume {args.volumeId} not found")
+    return {"ok": True, "configured": done}
+
+
+@command("volume.vacuum",
+         "compact volumes above a garbage threshold "
+         "(volume.vacuum [-garbageThreshold 0.3] [-volumeId N])",
+         destructive=True)
+def volume_vacuum(env: CommandEnv, argv: list[str]):
+    p = parser("volume.vacuum")
+    p.add_argument("-garbageThreshold", type=float, default=0.3)
+    p.add_argument("-volumeId", type=int, default=0)
+    args = p.parse_args(argv)
+    if args.volumeId:
+        return [env.client.volume_admin(url, "vacuum",
+                                        {"volume_id": args.volumeId})
+                for url in env.client.lookup(args.volumeId)]
+    return env.client._master_get(
+        f"/vol/vacuum?garbageThreshold={args.garbageThreshold}")
+
+
+# --- volume.tier.* (command_volume_tier_upload/download.go) ---
+
+@command("volume.tier.upload",
+         "move a volume's .dat to an object-store tier "
+         "(volume.tier.upload -volumeId N -dest local_store:/dir | "
+         "s3:endpoint/bucket [-keepLocal])", destructive=True)
+def volume_tier_upload(env: CommandEnv, argv: list[str]):
+    p = parser("volume.tier.upload")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-dest", required=True)
+    p.add_argument("-keepLocal", action="store_true")
+    args = p.parse_args(argv)
+    spec = _parse_backend_dest(args.dest)
+    results = []
+    for url in env.client.lookup(args.volumeId):
+        results.append(env.client.volume_admin(
+            url, "tier/upload",
+            {"volume_id": args.volumeId, "backend": spec,
+             "keep_local": args.keepLocal}))
+    return {"ok": True, "results": results}
+
+
+@command("volume.tier.download",
+         "bring a tiered volume's .dat back to local disk "
+         "(volume.tier.download -volumeId N)", destructive=True)
+def volume_tier_download(env: CommandEnv, argv: list[str]):
+    p = parser("volume.tier.download")
+    p.add_argument("-volumeId", type=int, required=True)
+    args = p.parse_args(argv)
+    results = [env.client.volume_admin(url, "tier/download",
+                                       {"volume_id": args.volumeId})
+               for url in env.client.lookup(args.volumeId)]
+    return {"ok": True, "results": results}
+
+
+def _parse_backend_dest(dest: str) -> dict:
+    """'local_store:/path' or 's3:http://endpoint/bucket'."""
+    kind, _, rest = dest.partition(":")
+    if kind == "local_store":
+        return {"type": "local_store", "directory": rest}
+    if kind == "s3":
+        endpoint, _, bucket = rest.rpartition("/")
+        from ..utils.config import load_configuration
+        cfg = load_configuration("security")
+        return {"type": "s3", "endpoint": endpoint, "bucket": bucket,
+                "access_key": cfg.get_string("s3.access_key", ""),
+                "secret_key": cfg.get_string("s3.secret_key", "")}
+    raise ClientError(f"unknown tier destination {dest!r}")
+
+
+# --- volumeServer.evacuate ---
+
+def plan_evacuate(nodes: list[dict], victim: str) -> list[dict]:
+    """Every volume and EC shard on the victim moves to the emptiest other
+    node not already holding it (command_volume_server_evacuate.go)."""
+    vnode = next((nd for nd in nodes if nd["url"] == victim), None)
+    if vnode is None:
+        raise ClientError(f"unknown volume server {victim}")
+    others = [nd for nd in nodes if nd["url"] != victim]
+    if not others:
+        raise ClientError("no other servers to evacuate to")
+    load = {nd["url"]: len(nd.get("volumes", [])) for nd in others}
+    holdings = {nd["url"]: {v["id"] for v in nd.get("volumes", [])}
+                for nd in others}
+    moves: list[dict] = []
+    for v in vnode.get("volumes", []):
+        cands = [u for u in load if v["id"] not in holdings[u]]
+        if not cands:
+            moves.append({"volume_id": v["id"], "action": "impossible"})
+            continue
+        dst = min(cands, key=lambda u: load[u])
+        moves.append({"volume_id": v["id"], "action": "move", "to": dst,
+                      "collection": v.get("collection", "")})
+        load[dst] += 1
+        holdings[dst].add(v["id"])
+    for s in vnode.get("ec_shards", []):
+        for sid in s.get("shard_ids", []):
+            dst = min(load, key=lambda u: load[u])
+            moves.append({"volume_id": s["id"], "action": "move_shard",
+                          "shard_id": sid, "to": dst,
+                          "collection": s.get("collection", "")})
+    return moves
+
+
+@command("volumeServer.evacuate",
+         "move everything off a server "
+         "(volumeServer.evacuate -node url [-force])", destructive=True)
+def volume_server_evacuate(env: CommandEnv, argv: list[str]):
+    p = parser("volumeServer.evacuate")
+    p.add_argument("-node", required=True)
+    p.add_argument("-force", action="store_true")
+    args = p.parse_args(argv)
+    moves = plan_evacuate(_nodes(env), args.node)
+    if not args.force:
+        return {"plan": moves, "applied": False}
+    for mv in moves:
+        if mv["action"] == "move":
+            _move_volume(env, mv["volume_id"], mv["collection"],
+                         args.node, mv["to"])
+        elif mv["action"] == "move_shard":
+            env.client.volume_admin(
+                mv["to"], "ec/copy",
+                {"volume_id": mv["volume_id"],
+                 "collection": mv["collection"],
+                 "shard_ids": [mv["shard_id"]], "source": args.node,
+                 "copy_ecx_file": True})
+            env.client.volume_admin(
+                mv["to"], "ec/mount",
+                {"volume_id": mv["volume_id"],
+                 "collection": mv["collection"],
+                 "shard_ids": [mv["shard_id"]]})
+            env.client.volume_admin(
+                args.node, "ec/delete_shards",
+                {"volume_id": mv["volume_id"],
+                 "collection": mv["collection"],
+                 "shard_ids": [mv["shard_id"]]})
+    return {"plan": moves, "applied": True}

@@ -119,6 +119,12 @@ class NeedleMap:
     def content_size(self) -> int:
         return self.file_byte_count
 
+    def live_entries(self) -> list[tuple[int, int]]:
+        """Live (needle_id, size) pairs — the fsck/needle-status
+        inventory."""
+        return [(key, nv.size) for key, nv in sorted(self._map.items())
+                if nv.size > 0]
+
     def close(self) -> None:
         if self._index_file is not None:
             self._index_file.close()

@@ -36,8 +36,12 @@ class DiskLocation:
 
     def load_existing(self, coder_factory,
                       geometry: ec_mod.Geometry) -> None:
-        for dat in glob.glob(os.path.join(self.directory, "*.dat")):
-            name = os.path.basename(dat)[:-4]
+        # tiered volumes have no local .dat — discover via .vif sidecars too
+        names = {os.path.basename(p)[:-4]
+                 for p in glob.glob(os.path.join(self.directory, "*.dat"))}
+        names |= {os.path.basename(p)[:-4]
+                  for p in glob.glob(os.path.join(self.directory, "*.vif"))}
+        for name in sorted(names):
             collection, vid = _parse_volume_file_name(name)
             if vid is None:
                 continue
@@ -155,6 +159,123 @@ class Store:
             return False
         v.read_only = read_only
         return True
+
+    def unmount_volume(self, vid: int) -> bool:
+        """Close a volume and drop it from serving; files stay on disk
+        (VolumeUnmount, weed/server/volume_grpc_admin.go)."""
+        with self._lock:
+            for loc in self.locations:
+                v = loc.volumes.pop(vid, None)
+                if v is not None:
+                    v.close()
+                    return True
+        return False
+
+    def mount_volume(self, vid: int, collection: str = "") -> Volume:
+        """Load an on-disk volume back into serving (VolumeMount).
+        Tiered volumes (no local .dat, a .vif sidecar) mount too."""
+        with self._lock:
+            if self.find_volume(vid) is not None:
+                raise ValueError(f"volume {vid} already mounted")
+            prefix = f"{collection}_" if collection else ""
+            for loc in self.locations:
+                base = os.path.join(loc.directory, f"{prefix}{vid}")
+                if os.path.exists(base + ".dat") or \
+                        os.path.exists(base + ".vif"):
+                    v = Volume(loc.directory, collection, vid)
+                    loc.volumes[vid] = v
+                    return v
+        raise KeyError(f"volume {vid} not found on disk")
+
+    def configure_replication(self, vid: int, replication: str) -> None:
+        """Rewrite the superblock replica placement in place
+        (VolumeConfigure, weed/server/volume_grpc_admin.go; superblock
+        byte 1, super_block.go:12-31)."""
+        v = self.find_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        v.configure_replication(ReplicaPlacement.parse(replication))
+
+    # --- cloud tier (volume_tier.go:15-50,
+    #     volume_grpc_tier_upload/download.go) ---
+    def tier_upload(self, vid: int, backend_spec: dict,
+                    keep_local: bool = False) -> dict:
+        """Move a sealed volume's .dat to an object store; the .idx stays
+        local and reads proxy through the remote backend. Writes a `.vif`
+        sidecar so the volume reloads tiered after restart."""
+        from . import backend as backend_mod
+        v = self.find_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        if v.is_remote:
+            raise ValueError(f"volume {vid} is already tiered")
+        was_read_only = v.read_only
+        v.read_only = True
+        try:
+            v.sync()
+            base = v.base_file_name()
+            store = backend_mod.open_store(backend_spec)
+            key = f"{os.path.basename(base)}.dat"
+            store.put(key, base + ".dat")
+            size = os.path.getsize(base + ".dat")
+            info = {"volume_id": vid, "version": v.version,
+                    "files": [{"backend": store.spec(), "key": key,
+                               "file_size": size,
+                               "modified_at": int(os.path.getmtime(
+                                   base + ".dat"))}]}
+            backend_mod.save_volume_info(base, info)
+        except Exception:
+            # roll back the seal so the volume keeps taking writes
+            v.read_only = was_read_only
+            raise
+        with v._lock:
+            # swap the read handle; the OLD local file stays open (not
+            # closed) so lock-free in-flight positioned reads that grabbed
+            # the previous handle never hit a closed fd — the open fd also
+            # keeps the unlinked file readable until volume close
+            v._retired_dat = v._dat
+            v._dat = backend_mod.RemoteFile(store, key, size)
+        if not keep_local:
+            os.remove(base + ".dat")
+        return info
+
+    def tier_download(self, vid: int) -> dict:
+        """Bring a tiered volume's .dat back to local disk and drop the
+        `.vif` (VolumeTierMoveDatFromRemote)."""
+        from . import backend as backend_mod
+        from .volume import Volume
+        v = self.find_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        if not v.is_remote:
+            raise ValueError(f"volume {vid} is not tiered")
+        base = v.base_file_name()
+        info = backend_mod.load_volume_info(base)
+        spec = info["files"][0]
+        store = backend_mod.open_store(spec["backend"])
+        store.get_to_file(spec["key"], base + ".dat")
+        with self._lock:
+            for loc in self.locations:
+                if loc.volumes.get(vid) is v:
+                    v.close()
+                    os.remove(backend_mod.vif_path(base))
+                    loc.volumes[vid] = Volume(loc.directory, v.collection,
+                                              vid)
+                    loc.volumes[vid].read_only = True
+                    break
+        return {"volume_id": vid, "bytes": spec["file_size"]}
+
+    def needle_ids(self, vid: int) -> list[tuple[int, int]]:
+        """Live (needle_id, size) pairs — the fsck inventory
+        (weed/shell/command_volume_fsck.go collects the same via
+        VolumeNeedleStatus/export)."""
+        v = self.find_volume(vid)
+        if v is not None:
+            return v.nm.live_entries()
+        ev = self.find_ec_volume(vid)
+        if ev is not None:
+            return ev.live_entries()
+        raise KeyError(f"volume {vid} not found")
 
     # --- vacuum (VacuumVolume{Check,Compact,Commit,Cleanup},
     #     weed/server/volume_grpc_vacuum.go) ---

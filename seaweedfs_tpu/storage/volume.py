@@ -16,11 +16,13 @@ import threading
 import time
 from typing import Optional
 
+from . import backend as backend_mod
 from . import idx as idx_mod
 from . import types as t
+from .backend import DiskFile
 from .needle import (FLAG_HAS_LAST_MODIFIED, FLAG_HAS_TTL, Needle)
 from .needle_map import NeedleMap, NeedleValue
-from .superblock import SuperBlock
+from .superblock import SUPER_BLOCK_SIZE, SuperBlock
 
 
 class NeedleNotFound(KeyError):
@@ -46,32 +48,55 @@ class Volume:
         self.last_append_at_ns = 0
         self.last_modified_ts = 0
         self._lock = threading.RLock()
+        self._retired_dat = None  # pre-tiering local handle kept open for
+        #                           in-flight lock-free readers
         self._compacting = False
         self._compact_sb: Optional[SuperBlock] = None
         self._compact_idx_entries = 0
 
         base = self.base_file_name()
         dat_path = base + ".dat"
-        if create or not os.path.exists(dat_path):
+        has_local = os.path.exists(dat_path)
+        has_vif = backend_mod.load_volume_info(base) is not None
+        if create or (not has_local and not has_vif):
             self.super_block = superblock or SuperBlock()
-            self._dat = open(dat_path, "w+b")
-            self._dat.write(self.super_block.to_bytes())
+            self._dat = DiskFile(dat_path, create=True)
+            self._dat.write_at(self.super_block.to_bytes(), 0)
             self._dat.flush()
             # fresh .dat invalidates any stale journal from a prior volume
             if os.path.exists(base + ".idx"):
                 os.remove(base + ".idx")
             self.nm = NeedleMap(base + ".idx")
+        elif not has_local:
+            # tiered volume: the .dat lives in an object store, the .idx
+            # stays local (volume_tier.go:15-50); reads proxy to the remote
+            # backend, writes are rejected
+            self._dat = backend_mod.open_remote_dat(base)
+            self.read_only = True
+            self.super_block = self._read_superblock()
+            self.nm = NeedleMap(base + ".idx")
         else:
-            self._dat = open(dat_path, "r+b")
-            self.super_block = SuperBlock.read_from(self._dat)
+            self._dat = DiskFile(dat_path)
+            self.super_block = self._read_superblock()
             self.nm = NeedleMap(base + ".idx")
             # conservative freshness floor for TTL expiry across restarts:
             # the .dat mtime bounds the last write even when the index tail
             # is a tombstone and carries no usable timestamp
             self.last_modified_ts = int(os.path.getmtime(dat_path))
             self.check_integrity()
-        self._dat.seek(0, os.SEEK_END)
-        self._append_offset = self._dat.tell()
+        self._append_offset = self._dat.size()
+
+    def _read_superblock(self) -> SuperBlock:
+        head = self._dat.read_at(SUPER_BLOCK_SIZE, 0)
+        sb = SuperBlock.from_bytes(head)
+        extra_size = t.get_u16(head, 6)
+        if extra_size:
+            sb.extra = self._dat.read_at(extra_size, SUPER_BLOCK_SIZE)
+        return sb
+
+    @property
+    def is_remote(self) -> bool:
+        return not self._dat.writable
 
     # --- naming ---
     def base_file_name(self) -> str:
@@ -150,10 +175,8 @@ class Volume:
         offset = self._append_offset
         if offset % t.NEEDLE_PADDING_SIZE != 0:
             offset += (-offset) % t.NEEDLE_PADDING_SIZE
-            self._dat.seek(offset)
         record = n.to_bytes(self.version)
-        self._dat.seek(offset)
-        self._dat.write(record)
+        self._dat.write_at(record, offset)
         self._dat.flush()
         self._append_offset = offset + len(record)
         return offset
@@ -189,13 +212,11 @@ class Volume:
         # positioned read: does not disturb the append position and is safe
         # against concurrent readers (no shared seek state)
         length = t.get_actual_size(size, self.version)
-        self._dat.flush()
-        record = os.pread(self._dat.fileno(), length, byte_offset)
+        record = self._dat.read_at(length, byte_offset)
         return Needle.from_bytes(record, self.version)
 
     def _read_header_at(self, byte_offset: int) -> Optional[Needle]:
-        self._dat.flush()
-        head = os.pread(self._dat.fileno(), t.NEEDLE_HEADER_SIZE, byte_offset)
+        head = self._dat.read_at(t.NEEDLE_HEADER_SIZE, byte_offset)
         if len(head) < t.NEEDLE_HEADER_SIZE:
             return None
         return Needle.parse_header(head)
@@ -219,6 +240,14 @@ class Volume:
         if self._append_offset == 0:
             return 0.0
         return self.nm.deleted_byte_count / self._append_offset
+
+    def configure_replication(self, rp) -> None:
+        """Rewrite the superblock replica-placement byte in place
+        (VolumeConfigure; superblock byte 1, super_block.go:12-31)."""
+        with self._lock:
+            self.super_block.replica_placement = rp
+            self._dat.write_at(bytes([rp.to_byte()]), 1)
+            self._dat.flush()
 
     def check_integrity(self) -> None:
         """Verify the last .idx entry points at a valid needle at the .dat
@@ -278,6 +307,9 @@ class Volume:
         append-only .dat, so racing appends are safe."""
         base = self.base_file_name()
         with self._lock:
+            if self.is_remote:
+                raise VolumeReadOnly(
+                    f"volume {self.vid} is tiered remote; download first")
             if self._compacting:
                 raise RuntimeError(f"volume {self.vid} already compacting")
             self._compacting = True
@@ -364,11 +396,10 @@ class Volume:
             self.nm.close()
             os.replace(base + ".cpd", base + ".dat")
             os.replace(base + ".cpx", base + ".idx")
-            self._dat = open(base + ".dat", "r+b")
+            self._dat = DiskFile(base + ".dat")
             self.super_block = new_sb
             self.nm = NeedleMap(base + ".idx")
-            self._dat.seek(0, os.SEEK_END)
-            self._append_offset = self._dat.tell()
+            self._append_offset = self._dat.size()
             self._compacting = False
 
     def cleanup_compact(self) -> None:
@@ -414,11 +445,13 @@ class Volume:
     def close(self) -> None:
         with self._lock:
             self.nm.close()
+            if self._retired_dat is not None:
+                self._retired_dat.close()
+                self._retired_dat = None
             if not self._dat.closed:
                 self._dat.flush()
                 self._dat.close()
 
     def sync(self) -> None:
         with self._lock:
-            self._dat.flush()
-            os.fsync(self._dat.fileno())
+            self._dat.sync()

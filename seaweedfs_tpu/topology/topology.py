@@ -142,7 +142,11 @@ class Topology:
     #     weed/server/master_grpc_server.go:20-176) ---
     def register_heartbeat(self, node_id: str, url: str, public_url: str,
                            data_center: str, rack: str,
-                           max_volume_count: int, payload: dict) -> None:
+                           max_volume_count: int, payload: dict) -> dict:
+        """Apply one heartbeat; returns the location delta event
+        ({url, public_url, new_vids, deleted_vids}) that KeepConnected
+        subscribers should receive (master_grpc_server.go:60-140 builds the
+        same VolumeLocation message from the incremental heartbeat)."""
         node = self.nodes.get(node_id)
         if node is None:
             node = DataNode(node_id, url, public_url, data_center or "DefaultDataCenter",
@@ -150,6 +154,7 @@ class Topology:
             self.nodes[node_id] = node
         node.last_seen = time.time()
         node.max_volume_count = max_volume_count
+        before = set(node.volumes) | set(node.ec_shards)
 
         new_volumes = {}
         for vd in payload.get("volumes", []):
@@ -173,22 +178,37 @@ class Topology:
             node.ec_shards[si.id] = si
             self.max_volume_id = max(self.max_volume_id, si.id)
 
-    def unregister_node(self, node_id: str) -> None:
+        after = set(node.volumes) | set(node.ec_shards)
+        return {"url": node.url, "public_url": node.public_url,
+                "new_vids": sorted(after - before),
+                "deleted_vids": sorted(before - after)}
+
+    def unregister_node(self, node_id: str) -> Optional[dict]:
+        """Remove a node; returns the deleted-locations delta event
+        (the DeletedVids broadcast on stream loss,
+        master_grpc_server.go:22-49)."""
         node = self.nodes.pop(node_id, None)
         if node is None:
-            return
+            return None
         for vid, vi in node.volumes.items():
             self._layout_for(vi.collection, vi.replica_placement,
                              vi.ttl).unregister(vid, node)
+        gone = sorted(set(node.volumes) | set(node.ec_shards))
+        return {"url": node.url, "public_url": node.public_url,
+                "new_vids": [], "deleted_vids": gone}
 
-    def prune_dead_nodes(self, timeout: Optional[float] = None) -> list[str]:
+    def prune_dead_nodes(self, timeout: Optional[float] = None
+                         ) -> list[dict]:
         timeout = timeout or self.pulse_seconds * 5
         now = time.time()
         dead = [nid for nid, n in self.nodes.items()
                 if now - n.last_seen > timeout]
+        events = []
         for nid in dead:
-            self.unregister_node(nid)
-        return dead
+            ev = self.unregister_node(nid)
+            if ev:
+                events.append(ev)
+        return events
 
     def _layout_for(self, collection: str, replication: str,
                     ttl: str) -> VolumeLayout:

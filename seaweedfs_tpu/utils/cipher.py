@@ -1,0 +1,40 @@
+"""AES-256-GCM chunk encryption (weed/util/cipher.go).
+
+Same scheme as the reference: a fresh random 256-bit key per chunk, the
+12-byte nonce prepended to the ciphertext, key stored (not the data) in the
+filer's chunk metadata. Backed by the `cryptography` package's AESGCM
+(OpenSSL EVP under the hood — the native path SURVEY §2.12 calls for).
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+KEY_SIZE = 32
+NONCE_SIZE = 12
+
+
+def encrypt(data: bytes) -> tuple[bytes, bytes]:
+    """Encrypt with a fresh key; returns (nonce||ciphertext||tag, key)."""
+    key = os.urandom(KEY_SIZE)
+    nonce = os.urandom(NONCE_SIZE)
+    ct = AESGCM(key).encrypt(nonce, data, None)
+    return nonce + ct, key
+
+
+def decrypt(payload: bytes, key: bytes) -> bytes:
+    if len(payload) < NONCE_SIZE:
+        raise ValueError("cipher payload too short")
+    nonce, ct = payload[:NONCE_SIZE], payload[NONCE_SIZE:]
+    return AESGCM(key).decrypt(nonce, ct, None)
+
+
+def key_to_str(key: bytes) -> str:
+    return base64.b64encode(key).decode()
+
+
+def key_from_str(s: str) -> bytes:
+    return base64.b64decode(s)

@@ -1,0 +1,97 @@
+"""KeepConnected push protocol: /cluster/watch streams vid-location deltas.
+
+Mirrors the reference's push-based cluster client design: the master pushes
+VolumeLocation updates to subscribed clients (master_grpc_server.go:178-233),
+which maintain a vid cache and stop polling /dir/lookup per miss
+(wdclient/masterclient.go:95-151, vid_map.go:37-47).
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from cluster_util import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(n_volume_servers=2)
+    yield c
+    c.shutdown()
+
+
+def test_watch_snapshot_and_grow_delta(cluster):
+    c = cluster
+    fid = c.client.upload(b"push-proto-1")  # ensures >=1 volume exists
+    vid = int(fid.split(",")[0])
+
+    req = urllib.request.urlopen(
+        f"http://{c.master_url.split(',')[0]}/cluster/watch", timeout=10)
+    snapshot = json.loads(req.readline())
+    assert snapshot["type"] == "snapshot"
+    assert str(vid) in snapshot["volumes"]
+
+    # growing a volume pushes an update with its new vids — no polling
+    grown = c.client.grow(1)
+    deadline = time.time() + 5
+    seen_new = set()
+    while time.time() < deadline and not \
+            set(grown["volume_ids"]) & seen_new:
+        line = req.readline()
+        msg = json.loads(line)
+        if msg.get("type") == "update":
+            seen_new.update(msg.get("new_vids", []))
+    req.close()
+    assert set(grown["volume_ids"]) & seen_new
+
+
+def test_client_vid_cache_fed_by_push(cluster):
+    c = cluster
+    fid = c.client.upload(b"push-proto-2")
+    vid = int(fid.split(",")[0])
+
+    from seaweedfs_tpu.client import Client, _PUSHED
+    cl = Client(c.master_url)
+    cl.watch_start()
+    deadline = time.time() + 5
+    while time.time() < deadline and vid not in cl._vid_cache:
+        time.sleep(0.05)
+    assert vid in cl._vid_cache
+    assert cl._vid_cache[vid][1] == _PUSHED
+
+    # reads are served from the pushed cache without any /dir/lookup —
+    # make master GETs explode to prove it
+    def boom(path_qs, timeout=30.0):
+        raise AssertionError(f"unexpected master poll: {path_qs}")
+    cl._master_get = boom
+    urls = cl.lookup(vid)
+    assert urls
+    assert cl.download(fid) == b"push-proto-2"
+    cl.watch_stop()
+
+
+def test_dead_node_pushes_deletions(cluster):
+    c = cluster
+    fid = c.client.upload(b"push-proto-3")
+    vid = int(fid.split(",")[0])
+
+    from seaweedfs_tpu.client import Client
+    cl = Client(c.master_url)
+    cl.watch_start()
+    deadline = time.time() + 5
+    while time.time() < deadline and vid not in cl._vid_cache:
+        time.sleep(0.05)
+    holder = cl._vid_cache[vid][0][0]
+
+    idx = next(i for i, vs in enumerate(c.volume_servers)
+               if vs.url == holder)
+    c.stop_volume_server(idx)
+    # the master prunes the dead node after ~5 pulses and pushes DeletedVids
+    deadline = time.time() + 10
+    while time.time() < deadline and \
+            holder in cl._vid_cache.get(vid, ([], 0))[0]:
+        time.sleep(0.1)
+    assert holder not in cl._vid_cache.get(vid, ([], 0))[0]
+    cl.watch_stop()

@@ -1,0 +1,236 @@
+"""Admin shell: planner unit tests + cluster e2e.
+
+Planner tests follow the reference's dry-run pattern
+(weed/shell/command_ec_test.go, command_volume_balance_test.go): pure
+functions over fake topology dicts. The e2e repairs a real
+under-replicated volume (command_volume_fix_replication.go) and drives
+fs.* / bucket.* / lock against live servers.
+"""
+
+import time
+
+import pytest
+
+from cluster_util import Cluster
+from seaweedfs_tpu.client import ClientError
+from seaweedfs_tpu.shell import commands as shell_commands
+from seaweedfs_tpu.shell.commands import COMMANDS, CommandEnv, run_command
+from seaweedfs_tpu.shell.volume_commands import (plan_evacuate,
+                                                 plan_fix_replication,
+                                                 plan_volume_balance)
+
+shell_commands._register_all()
+
+
+def _node(url, volumes=(), cap=8, dc="dc1", rack="r1", ec=()):
+    return {"url": url, "max_volume_count": cap, "data_center": dc,
+            "rack": rack,
+            "volumes": [{"id": v, "collection": "",
+                         "replica_placement": rp}
+                        for v, rp in volumes],
+            "ec_shards": [{"id": vid, "collection": "",
+                           "shard_ids": list(sids)} for vid, sids in ec]}
+
+
+# --- planners (pure, no sockets) ---
+
+def test_plan_balance_moves_from_loaded_to_empty():
+    nodes = [_node("a", [(1, "000"), (2, "000"), (3, "000"), (4, "000")]),
+             _node("b", [(5, "000")]),
+             _node("c", [])]
+    moves = plan_volume_balance(nodes)
+    assert moves, "expected at least one move"
+    assert all(m["from"] == "a" for m in moves[:1])
+    # never move to a node already holding the volume
+    for m in moves:
+        assert m["from"] != m["to"]
+
+
+def test_plan_balance_noop_when_even():
+    nodes = [_node("a", [(1, "000")]), _node("b", [(2, "000")])]
+    assert plan_volume_balance(nodes) == []
+
+
+def test_plan_fix_replication_adds_missing_replica():
+    nodes = [_node("a", [(1, "001")], rack="r1"),
+             _node("b", [], rack="r2"),
+             _node("c", [], rack="r1")]
+    actions = plan_fix_replication(nodes)
+    add = [a for a in actions if a["action"] == "add"]
+    assert len(add) == 1
+    assert add[0]["volume_id"] == 1
+    assert add[0]["from"] == "a"
+    assert add[0]["to"] == "b"  # other rack preferred for 001
+
+
+def test_plan_fix_replication_removes_extra_replica():
+    nodes = [_node("a", [(1, "000"), (2, "000")]),
+             _node("b", [(1, "000")])]
+    actions = plan_fix_replication(nodes)
+    rm = [a for a in actions if a["action"] == "remove"]
+    assert len(rm) == 1 and rm[0]["volume_id"] == 1
+    assert rm[0]["from"] == "a"  # fullest holder loses the copy
+
+
+def test_plan_fix_replication_impossible_when_no_slots():
+    nodes = [_node("a", [(1, "001")], cap=1)]
+    actions = plan_fix_replication(nodes)
+    assert actions[0]["action"] == "impossible"
+
+
+def test_plan_evacuate_spreads_everything():
+    nodes = [_node("a", [(1, "000"), (2, "000")], ec=[(9, [0, 1])]),
+             _node("b", [(1, "000")]),
+             _node("c", [])]
+    moves = plan_evacuate(nodes, "a")
+    vol_moves = [m for m in moves if m["action"] == "move"]
+    # volume 1 cannot go to b (already holds it)
+    assert {m["volume_id"]: m["to"] for m in vol_moves}[1] == "c"
+    shard_moves = [m for m in moves if m["action"] == "move_shard"]
+    assert len(shard_moves) == 2
+
+
+def test_help_lists_commands():
+    env = CommandEnv.__new__(CommandEnv)  # no client needed for help
+    out = run_command(env, "help")
+    for name in ("volume.balance", "volume.fix.replication", "volume.fsck",
+                 "fs.ls", "bucket.create", "collection.list", "lock",
+                 "ec.encode", "volumeServer.evacuate"):
+        assert name in out, name
+    assert len(COMMANDS) >= 25
+
+
+# --- e2e against a live cluster ---
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(n_volume_servers=3)
+    yield c
+    c.shutdown()
+
+
+def _env(c, filer=""):
+    return CommandEnv(c.client, c.geometry, filer=filer)
+
+
+def test_e2e_fix_under_replicated_volume(cluster):
+    c = cluster
+    fid = c.client.upload(b"fix-me" * 100, replication="001")
+    vid = int(fid.split(",")[0])
+    c.wait_heartbeats()
+
+    # break one replica: delete the volume from one of its two holders
+    holders = c.client.lookup(vid)
+    assert len(holders) == 2
+    c.client.volume_admin(holders[0], "volume/delete", {"volume_id": vid})
+    c.wait_heartbeats()
+    c.client._vid_cache.clear()
+    assert len(c.client.lookup(vid)) == 1
+
+    env = _env(c)
+    plan = run_command(env, ["volume.fix.replication"])
+    wanted = [a for a in plan["plan"]
+              if a["volume_id"] == vid and a["action"] == "add"]
+    assert wanted, plan
+
+    out = run_command(env, ["volume.fix.replication", "-force"])
+    assert out["applied"]
+    c.wait_heartbeats()
+    c.client._vid_cache.clear()
+    assert len(c.client.lookup(vid)) == 2
+    assert c.client.download(fid) == b"fix-me" * 100
+
+
+def test_e2e_volume_move(cluster):
+    c = cluster
+    fid = c.client.upload(b"move-me" * 50)
+    vid = int(fid.split(",")[0])
+    c.wait_heartbeats()
+    src = c.client.lookup(vid)[0]
+    dst = next(vs.url for vs in c.volume_servers if vs.url != src)
+    env = _env(c)
+    out = run_command(env, ["volume.move", "-volumeId", str(vid),
+                            "-from", src, "-to", dst])
+    assert out["ok"]
+    c.wait_heartbeats()
+    c.client._vid_cache.clear()
+    locs = c.client.lookup(vid)
+    assert dst in locs and src not in locs
+    assert c.client.download(fid) == b"move-me" * 50
+
+
+def test_e2e_balance_dry_run_and_collections(cluster):
+    env = _env(cluster)
+    out = run_command(env, ["volume.balance"])
+    assert out["applied"] is False
+    cols = run_command(env, ["collection.list"])
+    assert any(col["name"] == "(default)"
+               for col in cols["collections"])
+
+
+def test_e2e_fs_and_bucket_commands(cluster):
+    c = cluster
+    fs = c.add_filer()
+    time.sleep(0.3)
+    import urllib.request
+    urllib.request.urlopen(
+        urllib.request.Request(f"http://{fs.url}/shelltest/hello.txt",
+                               data=b"shell fs data", method="PUT"),
+        timeout=10).read()
+
+    env = _env(c, filer=fs.url)
+    ls = run_command(env, ["fs.ls", "/shelltest"])
+    assert "hello.txt" in ls["entries"]
+    du = run_command(env, ["fs.du", "/shelltest"])
+    assert du["bytes"] == len(b"shell fs data")
+    assert run_command(env, ["fs.cat", "/shelltest/hello.txt"]) == \
+        b"shell fs data"
+    run_command(env, ["fs.mv", "/shelltest/hello.txt",
+                      "/shelltest/renamed.txt"])
+    ls = run_command(env, ["fs.ls", "/shelltest"])
+    assert "renamed.txt" in ls["entries"]
+    run_command(env, ["fs.cd", "/shelltest"])
+    assert run_command(env, ["fs.pwd"])["cwd"] == "/shelltest"
+    assert run_command(env, ["fs.ls"])["entries"] == ["renamed.txt"]
+
+    run_command(env, ["bucket.create", "-name", "shellbucket"])
+    assert "shellbucket" in run_command(env, ["bucket.list"])["buckets"]
+    run_command(env, ["bucket.delete", "-name", "shellbucket"])
+    assert "shellbucket" not in run_command(env, ["bucket.list"])["buckets"]
+
+    run_command(env, ["fs.rm", "-r", "/shelltest"])
+    assert run_command(env, ["fs.ls", "/shelltest"])["entries"] == []
+
+
+def test_e2e_fsck_clean_and_orphan(cluster):
+    c = cluster
+    fs = c.add_filer()
+    time.sleep(0.3)
+    import urllib.request
+    urllib.request.urlopen(
+        urllib.request.Request(f"http://{fs.url}/fsck/a.bin",
+                               data=b"x" * 2048, method="PUT"),
+        timeout=10).read()
+    c.wait_heartbeats()
+    env = _env(c, filer=fs.url)
+    report = run_command(env, ["volume.fsck"])
+    assert report["missing_count"] == 0
+
+    # orphan: a blob uploaded directly, never referenced by the filer
+    c.client.upload(b"orphan-blob" * 10)
+    c.wait_heartbeats()
+    report = run_command(env, ["volume.fsck"])
+    assert report["orphan_count"] >= 1
+
+
+def test_e2e_exclusive_lock(cluster):
+    env1 = _env(cluster)
+    env2 = _env(cluster)
+    out = run_command(env1, ["lock"])
+    assert out["token"]
+    with pytest.raises(ClientError):
+        run_command(env2, ["lock"])
+    run_command(env1, ["unlock"])
+    out2 = run_command(env2, ["lock"])
+    assert out2["token"]
+    run_command(env2, ["unlock"])
