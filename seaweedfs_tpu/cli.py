@@ -68,7 +68,9 @@ def cmd_volume(args) -> None:
         small_block_size=args.ec_small_block)
     store = Store(args.dir.split(","),
                   max_volume_counts=[args.max] * len(args.dir.split(",")),
-                  coder_name=args.coder, geometry=geometry)
+                  coder_name=args.coder, geometry=geometry,
+                  needle_map_kind=args.index,
+                  min_free_space_percent=args.min_free_space_percent)
     _run_forever(run_volume_server(
         args.ip, args.port, store, args.mserver,
         data_center=args.data_center, rack=args.rack,
@@ -103,7 +105,7 @@ def cmd_filer(args) -> None:
     from .server.filer_server import run_filer
     from .utils.config import load_configuration
     store_kwargs = {}
-    if args.store == "sqlite":
+    if args.store in ("sqlite", "leveldb"):
         store_kwargs["path"] = args.store_path
     notifier = load_notifier(load_configuration("notification"))
     _run_forever(run_filer(
@@ -199,9 +201,14 @@ def cmd_s3(args) -> None:
         raise SystemExit(
             "-access_key and -secret_key must be provided together "
             "(omit both for anonymous mode)")
+    iam = None
+    if args.config:
+        from .s3.auth import Iam
+        iam = Iam.from_file(args.config)
     _run_forever(run_s3(args.ip, args.port, args.filer,
                         access_key=args.access_key,
-                        secret_key=args.secret_key))
+                        secret_key=args.secret_key,
+                        iam=iam))
 
 
 def cmd_upload(args) -> None:
@@ -402,45 +409,114 @@ def cmd_status(args) -> None:
 
 
 def cmd_benchmark(args) -> None:
-    """Self-validating write/read benchmark (weed/command/benchmark.go)."""
-    import concurrent.futures
+    """Self-validating write/read benchmark (weed/command/benchmark.go):
+    seeded unique payloads, hash-checked on read-back, latency
+    percentiles. Async client with pooled keep-alive connections so the
+    harness itself is not the bottleneck."""
+    import asyncio
     import hashlib
     import random
     import time
 
-    from .client import Client
-    c = Client(args.server)
+    import aiohttp
+
     rng = random.Random(42)
-    payloads = {}
+    payloads: dict[str, str] = {}
+    master = args.server.split(",")[0]
 
-    def one_write(i: int) -> float:
-        data = bytes(rng.getrandbits(8) for _ in range(args.size))
-        t0 = time.perf_counter()
-        fid = c.upload(data, filename=f"bench{i}")
-        payloads[fid] = hashlib.sha256(data).hexdigest()
-        return time.perf_counter() - t0
+    async def run() -> None:
+        conn = aiohttp.TCPConnector(limit=args.concurrency * 2)
+        sem = asyncio.Semaphore(args.concurrency)
+        async with aiohttp.ClientSession(connector=conn) as s:
 
-    t0 = time.perf_counter()
-    with concurrent.futures.ThreadPoolExecutor(args.concurrency) as pool:
-        lat = list(pool.map(one_write, range(args.n)))
-    wall = time.perf_counter() - t0
-    lat.sort()
-    print(f"writes: {args.n} in {wall:.2f}s -> {args.n/wall:.1f} req/s, "
-          f"p50={lat[len(lat)//2]*1e3:.1f}ms "
-          f"p99={lat[int(len(lat)*0.99)]*1e3:.1f}ms")
+            async def one_write(i: int, data: bytes,
+                                pre: "tuple[str, str] | None") -> float:
+                async with sem:
+                    t0 = time.perf_counter()
+                    if pre is None:
+                        async with s.get(
+                                f"http://{master}/dir/assign") as r:
+                            a = await r.json()
+                        fid, url = a["fid"], a["url"]
+                        auth = a.get("auth", "")
+                    else:
+                        fid, url = pre
+                        auth = ""
+                    form = aiohttp.FormData()
+                    form.add_field("file", data, filename=f"bench{i}")
+                    headers = {}
+                    if auth:
+                        headers["Authorization"] = f"BEARER {auth}"
+                    async with s.post(f"http://{url}/{fid}",
+                                      data=form, headers=headers) as r:
+                        assert r.status == 201, r.status
+                    dt = time.perf_counter() - t0
+                payloads[fid] = hashlib.sha256(data).hexdigest()
+                return dt
 
-    def one_read(fid: str) -> bool:
-        return hashlib.sha256(c.download(fid)).hexdigest() == payloads[fid]
+            pres: list = [None] * args.n
+            if args.assign_batch > 1:
+                # assign?count=N reserves N sequential keys in one master
+                # round trip (the reference's batched assignment API);
+                # derived fids share the volume and cookie
+                from seaweedfs_tpu.storage.file_id import FileId
+                got = 0
+                while got < args.n:
+                    want = min(args.assign_batch, args.n - got)
+                    async with s.get(f"http://{master}/dir/assign",
+                                     params={"count": str(want)}) as r:
+                        a = await r.json()
+                    base = FileId.parse(a["fid"])
+                    for j in range(want):
+                        pres[got + j] = (str(FileId(
+                            base.volume_id, base.key + j, base.cookie)),
+                            a["url"])
+                    got += want
 
-    t0 = time.perf_counter()
-    with concurrent.futures.ThreadPoolExecutor(args.concurrency) as pool:
-        results = list(pool.map(one_read, payloads))
-    wall = time.perf_counter() - t0
-    bad = results.count(False)
-    print(f"reads: {len(results)} in {wall:.2f}s -> "
-          f"{len(results)/wall:.1f} req/s, {bad} corrupt")
-    if bad:
-        raise SystemExit(1)
+            blobs = [(i.to_bytes(8, "big")
+                      + rng.randbytes(max(args.size - 8, 0)))
+                     for i in range(args.n)]
+            t0 = time.perf_counter()
+            lat = await asyncio.gather(
+                *[one_write(i, b, pres[i]) for i, b in enumerate(blobs)])
+            wall = time.perf_counter() - t0
+            lat = sorted(lat)
+            print(f"writes: {args.n} in {wall:.2f}s -> "
+                  f"{args.n/wall:.1f} req/s, "
+                  f"p50={lat[len(lat)//2]*1e3:.1f}ms "
+                  f"p95={lat[int(len(lat)*0.95)]*1e3:.1f}ms "
+                  f"p99={lat[int(len(lat)*0.99)]*1e3:.1f}ms")
+
+            lookup_cache: dict[str, list] = {}
+
+            async def one_read(fid: str) -> bool:
+                async with sem:
+                    vid = fid.split(",")[0]
+                    urls = lookup_cache.get(vid)
+                    if urls is None:
+                        async with s.get(f"http://{master}/dir/lookup",
+                                         params={"volumeId": vid}) as r:
+                            body = await r.json()
+                        urls = [x["url"] for x in body.get("locations", [])]
+                        lookup_cache[vid] = urls
+                    if not urls:
+                        return False  # counted as corrupt, not a crash
+                    async with s.get(f"http://{urls[0]}/{fid}") as r:
+                        if r.status != 200:
+                            return False
+                        data = await r.read()
+                return hashlib.sha256(data).hexdigest() == payloads[fid]
+
+            t0 = time.perf_counter()
+            results = await asyncio.gather(*[one_read(f) for f in payloads])
+            wall = time.perf_counter() - t0
+            bad = results.count(False)
+            print(f"reads: {len(results)} in {wall:.2f}s -> "
+                  f"{len(results)/wall:.1f} req/s, {bad} corrupt")
+            if bad:
+                raise SystemExit(1)
+
+    asyncio.run(run())
 
 
 def cmd_mount(args) -> None:
@@ -510,6 +586,10 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("-rack", default="")
     v.add_argument("-pulse", type=float, default=5.0)
     v.add_argument("-coder", default="auto")
+    v.add_argument("-index", default="memory", choices=["memory", "compact"],
+                   help="needle map kind (weed volume -index)")
+    v.add_argument("-minFreeSpacePercent", dest="min_free_space_percent",
+                   type=float, default=1.0)
     v.add_argument("-ec_large_block", type=int, default=1024 * 1024 * 1024)
     v.add_argument("-ec_small_block", type=int, default=1024 * 1024)
     v.set_defaults(fn=cmd_volume)
@@ -599,6 +679,9 @@ def build_parser() -> argparse.ArgumentParser:
     s3p.add_argument("-filer", default="127.0.0.1:8888")
     s3p.add_argument("-access_key", default="")
     s3p.add_argument("-secret_key", default="")
+    s3p.add_argument("-config", default="",
+                     help="JSON identities file with per-action ACLs "
+                          "(weed s3 -config)")
     s3p.set_defaults(fn=cmd_s3)
 
     u = sub.add_parser("upload", help="upload files")
@@ -668,6 +751,9 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("-n", type=int, default=1000)
     b.add_argument("-size", type=int, default=1024)
     b.add_argument("-concurrency", type=int, default=16)
+    b.add_argument("-assign_batch", type=int, default=1,
+                   help="keys reserved per /dir/assign round trip "
+                        "(1 = a master assign per write)")
     b.set_defaults(fn=cmd_benchmark)
 
     sc = sub.add_parser("scaffold", help="emit default TOML config templates")

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import os
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional
 
 import numpy as np
@@ -26,6 +27,11 @@ from ..storage import types as t
 from ..storage.needle import Needle
 from ..storage.superblock import SuperBlock
 from .coder import ErasureCoder
+
+# shared fan-out pool for parallel remote-survivor fetches; sized for one
+# reconstruction's worth of peers, shared across volumes
+_SURVIVOR_POOL = ThreadPoolExecutor(max_workers=14,
+                                    thread_name_prefix="ec-survivor")
 from .geometry import DEFAULT, Geometry, to_ext
 from .locate import Interval, locate_data
 
@@ -196,29 +202,43 @@ class EcVolume:
     def _reconstruct_interval(self, missing_shard: int, offset: int,
                               size: int,
                               shard_reader: Optional[ShardReader]) -> bytes:
-        """Online reconstruction of one interval from any k other shards
+        """Online reconstruction of one interval from any k other shards.
+        Local shards are read inline; remote survivors are fetched in
+        parallel, matching the reference's goroutine fan-out
         (recoverOneRemoteEcShardInterval, store_ec.go:322-376)."""
         if self.coder is None:
             raise IOError(
                 f"shard {missing_shard} missing and no coder to reconstruct")
         shards: list[Optional[np.ndarray]] = [None] * self.g.total_shards
         have = 0
+        remote_candidates: list[int] = []
         for sid in range(self.g.total_shards):
-            if sid == missing_shard or have >= self.g.data_shards:
+            if sid == missing_shard:
                 continue
-            buf = None
             local = self.shards.get(sid)
-            if local is not None:
+            if local is not None and have < self.g.data_shards:
                 b = local.read_at(offset, size)
                 if len(b) == size:
-                    buf = b
-            if buf is None and shard_reader is not None:
-                b = shard_reader(sid, offset, size)
+                    shards[sid] = np.frombuffer(b, dtype=np.uint8)
+                    have += 1
+                    continue
+            remote_candidates.append(sid)
+        need = self.g.data_shards - have
+        if need > 0 and shard_reader is not None and remote_candidates:
+            futs = {sid: _SURVIVOR_POOL.submit(shard_reader, sid, offset,
+                                               size)
+                    for sid in remote_candidates}
+            for sid, fut in futs.items():
+                if have >= self.g.data_shards:
+                    fut.cancel()
+                    continue
+                try:
+                    b = fut.result()
+                except Exception:
+                    continue
                 if b is not None and len(b) == size:
-                    buf = b
-            if buf is not None:
-                shards[sid] = np.frombuffer(buf, dtype=np.uint8)
-                have += 1
+                    shards[sid] = np.frombuffer(b, dtype=np.uint8)
+                    have += 1
         if have < self.g.data_shards:
             raise IOError(
                 f"cannot reconstruct shard {missing_shard}: "

@@ -43,7 +43,7 @@ def fused_vacuum_gzip_encode(volume, dst_base: str, coder: ErasureCoder,
     """
     src_size = volume.data_file_size()
     with volume._lock:
-        snapshot = [nv for nv in volume.nm._map.values()
+        snapshot = [nv for nv in volume.nm.values()
                     if t.size_is_valid(nv.size)]
         sb = SuperBlock(
             version=volume.super_block.version,

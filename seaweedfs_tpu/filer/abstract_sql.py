@@ -1,0 +1,270 @@
+"""Abstract-SQL filer store: one SQL implementation, many engines.
+
+Counterpart of the reference's shared SQL layer
+(weed/filer/abstract_sql/abstract_sql_store.go) used by its mysql and
+postgres plugins: all CRUD/listing SQL lives here, parameterized by
+dialect (placeholder style + upsert form), and each engine contributes
+only a connection factory.
+
+Engines: sqlite (stdlib, the embedded default) plus mysql / postgres
+shells that bind to their DB-API drivers when installed (this image ships
+neither, so constructing them raises a clear error — the SQL they would
+run is the tested code path shared with sqlite).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .entry import Entry
+from .stores import FilerStore, _split
+
+
+class Dialect:
+    """SQL variation points (abstract_sql_store.go's GenSql* hooks)."""
+
+    placeholder = "?"
+    # MySQL treats backslash specially inside string literals, so its
+    # ESCAPE clause needs a doubled backslash
+    like_escape = r"ESCAPE '\'"
+
+    def upsert_entry(self) -> str:
+        return ("INSERT OR REPLACE INTO entries (dir, name, meta) "
+                f"VALUES ({self.placeholder},{self.placeholder},"
+                f"{self.placeholder})")
+
+    def upsert_kv(self) -> str:
+        return ("INSERT OR REPLACE INTO kv (k, v) "
+                f"VALUES ({self.placeholder},{self.placeholder})")
+
+    def create_tables(self) -> list[str]:
+        return [
+            """CREATE TABLE IF NOT EXISTS entries (
+                   dir TEXT NOT NULL,
+                   name TEXT NOT NULL,
+                   meta TEXT NOT NULL,
+                   PRIMARY KEY (dir, name)
+               )""",
+            """CREATE TABLE IF NOT EXISTS kv (
+                   k TEXT PRIMARY KEY,
+                   v BLOB NOT NULL
+               )""",
+        ]
+
+
+class MysqlDialect(Dialect):
+    placeholder = "%s"
+    like_escape = r"ESCAPE '\\'"
+
+    def upsert_entry(self) -> str:
+        return ("INSERT INTO entries (dir, name, meta) VALUES (%s,%s,%s) "
+                "ON DUPLICATE KEY UPDATE meta=VALUES(meta)")
+
+    def upsert_kv(self) -> str:
+        return ("INSERT INTO kv (k, v) VALUES (%s,%s) "
+                "ON DUPLICATE KEY UPDATE v=VALUES(v)")
+
+
+class PostgresDialect(Dialect):
+    placeholder = "%s"
+
+    def upsert_entry(self) -> str:
+        return ("INSERT INTO entries (dir, name, meta) VALUES (%s,%s,%s) "
+                "ON CONFLICT (dir, name) DO UPDATE SET meta=EXCLUDED.meta")
+
+    def upsert_kv(self) -> str:
+        return ("INSERT INTO kv (k, v) VALUES (%s,%s) "
+                "ON CONFLICT (k) DO UPDATE SET v=EXCLUDED.v")
+
+
+class AbstractSqlStore(FilerStore):
+    """All filer-store SQL, engine-independent."""
+
+    name = "abstract_sql"
+    dialect = Dialect()
+
+    def _connect(self):
+        raise NotImplementedError
+
+    def __init__(self):
+        self._local = threading.local()
+        self._init_schema()
+
+    def _conn(self):
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = self._connect()
+            self._local.conn = conn
+        return conn
+
+    def _ph(self, n: int) -> list[str]:
+        return [self.dialect.placeholder] * n
+
+    def _in_txn(self) -> bool:
+        return getattr(self._local, "in_txn", False)
+
+    def _commit(self, conn) -> None:
+        if not self._in_txn():
+            conn.commit()
+
+    def begin(self) -> None:
+        self._local.in_txn = True
+
+    def commit(self) -> None:
+        self._local.in_txn = False
+        self._conn().commit()
+
+    def rollback(self) -> None:
+        self._local.in_txn = False
+        self._conn().rollback()
+
+    def _init_schema(self) -> None:
+        conn = self._conn()
+        cur = conn.cursor()
+        for stmt in self.dialect.create_tables():
+            cur.execute(stmt)
+        conn.commit()
+
+    def insert_entry(self, entry: Entry) -> None:
+        d, name = _split(entry.full_path)
+        conn = self._conn()
+        conn.cursor().execute(self.dialect.upsert_entry(),
+                              (d, name, entry.to_json()))
+        self._commit(conn)
+
+    update_entry = insert_entry
+
+    def find_entry(self, path: str) -> Optional[Entry]:
+        d, name = _split(path)
+        if name == "/":
+            return None
+        ph = self.dialect.placeholder
+        cur = self._conn().cursor()
+        cur.execute(f"SELECT meta FROM entries WHERE dir={ph} AND name={ph}",
+                    (d, name))
+        row = cur.fetchone()
+        return Entry.from_json(row[0]) if row else None
+
+    def delete_entry(self, path: str) -> None:
+        d, name = _split(path)
+        ph = self.dialect.placeholder
+        conn = self._conn()
+        conn.cursor().execute(
+            f"DELETE FROM entries WHERE dir={ph} AND name={ph}", (d, name))
+        self._commit(conn)
+
+    def delete_folder_children(self, path: str) -> None:
+        path = path.rstrip("/") or "/"
+        ph = self.dialect.placeholder
+        conn = self._conn()
+        cur = conn.cursor()
+        if path == "/":
+            cur.execute("DELETE FROM entries WHERE dir != ''")
+        else:
+            cur.execute(
+                f"DELETE FROM entries WHERE dir = {ph} OR dir LIKE {ph}",
+                (path, path + "/%"))
+        self._commit(conn)
+
+    def list_directory_entries(self, dir_path: str, start_file_name: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        dir_path = dir_path.rstrip("/") or "/"
+        ph = self.dialect.placeholder
+        op = ">=" if include_start else ">"
+        sql = f"SELECT meta FROM entries WHERE dir={ph} AND name {op} {ph}"
+        args: list = [dir_path, start_file_name]
+        if prefix:
+            sql += f" AND name LIKE {ph} {self.dialect.like_escape}"
+            escaped = (prefix.replace("\\", r"\\")
+                       .replace("%", r"\%").replace("_", r"\_"))
+            args.append(escaped + "%")
+        sql += f" ORDER BY name LIMIT {ph}"
+        args.append(limit)
+        cur = self._conn().cursor()
+        cur.execute(sql, args)
+        return [Entry.from_json(r[0]) for r in cur.fetchall()]
+
+    def kv_put(self, key: str, value: bytes) -> None:
+        conn = self._conn()
+        conn.cursor().execute(self.dialect.upsert_kv(), (key, value))
+        conn.commit()
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        ph = self.dialect.placeholder
+        cur = self._conn().cursor()
+        cur.execute(f"SELECT v FROM kv WHERE k={ph}", (key,))
+        row = cur.fetchone()
+        return bytes(row[0]) if row else None
+
+    def close(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+
+
+class SqliteStore(AbstractSqlStore):
+    """Embedded sqlite engine — the default persistent store, and the
+    reference implementation exercising the shared SQL."""
+
+    name = "sqlite"
+    dialect = Dialect()
+
+    def __init__(self, path: str = "filer.db", **_):
+        self._path = path
+        super().__init__()
+
+    def _connect(self):
+        import sqlite3
+        conn = sqlite3.connect(self._path, timeout=30)
+        conn.execute("PRAGMA journal_mode=WAL")
+        return conn
+
+
+class MysqlStore(AbstractSqlStore):
+    """MySQL engine over the abstract-SQL layer (filer store 'mysql')."""
+
+    name = "mysql"
+    dialect = MysqlDialect()
+
+    def __init__(self, host: str = "localhost", port: int = 3306,
+                 user: str = "root", password: str = "",
+                 database: str = "seaweedfs", **_):
+        self._params = dict(host=host, port=port, user=user,
+                            password=password, database=database)
+        super().__init__()
+
+    def _connect(self):
+        try:
+            import pymysql  # type: ignore[import-not-found]
+        except ImportError as e:
+            raise RuntimeError(
+                "filer store 'mysql' needs the pymysql driver "
+                "(not installed in this image)") from e
+        return pymysql.connect(**self._params)
+
+
+class PostgresStore(AbstractSqlStore):
+    """PostgreSQL engine over the abstract-SQL layer (store 'postgres')."""
+
+    name = "postgres"
+    dialect = PostgresDialect()
+
+    def __init__(self, host: str = "localhost", port: int = 5432,
+                 user: str = "postgres", password: str = "",
+                 database: str = "seaweedfs", **_):
+        self._params = dict(host=host, port=port, user=user,
+                            password=password, dbname=database)
+        super().__init__()
+
+    def _connect(self):
+        try:
+            import psycopg2  # type: ignore[import-not-found]
+        except ImportError as e:
+            raise RuntimeError(
+                "filer store 'postgres' needs the psycopg2 driver "
+                "(not installed in this image)") from e
+        return psycopg2.connect(**self._params)

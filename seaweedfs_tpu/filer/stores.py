@@ -17,7 +17,6 @@ used for system metadata (offsets etc., filer.proto KvGet/KvPut).
 from __future__ import annotations
 
 import os
-import sqlite3
 import threading
 from typing import Callable, Iterator, Optional
 
@@ -167,127 +166,30 @@ class MemoryStore(FilerStore):
         return self._kv.get(key)
 
 
-class SqliteStore(FilerStore):
-    name = "sqlite"
+# SQL family (abstract-SQL layer, filer/abstract_sql.py) and the embedded
+# log-structured store register lazily to avoid import cycles
+def _sqlite(**kw):
+    from .abstract_sql import SqliteStore
+    return SqliteStore(**kw)
 
-    def __init__(self, path: str = "filer.db", **_):
-        self._path = path
-        self._local = threading.local()
-        self._init_schema()
 
-    def _conn(self) -> sqlite3.Connection:
-        conn = getattr(self._local, "conn", None)
-        if conn is None:
-            conn = sqlite3.connect(self._path, timeout=30)
-            conn.execute("PRAGMA journal_mode=WAL")
-            self._local.conn = conn
-        return conn
+def _mysql(**kw):
+    from .abstract_sql import MysqlStore
+    return MysqlStore(**kw)
 
-    def _in_txn(self) -> bool:
-        return getattr(self._local, "in_txn", False)
 
-    def _commit(self, conn: sqlite3.Connection) -> None:
-        if not self._in_txn():
-            conn.commit()
+def _postgres(**kw):
+    from .abstract_sql import PostgresStore
+    return PostgresStore(**kw)
 
-    def begin(self) -> None:
-        self._conn().execute("BEGIN")
-        self._local.in_txn = True
 
-    def commit(self) -> None:
-        self._local.in_txn = False
-        self._conn().commit()
-
-    def rollback(self) -> None:
-        self._local.in_txn = False
-        self._conn().rollback()
-
-    def _init_schema(self) -> None:
-        conn = self._conn()
-        conn.execute("""
-            CREATE TABLE IF NOT EXISTS entries (
-                dir TEXT NOT NULL,
-                name TEXT NOT NULL,
-                meta TEXT NOT NULL,
-                PRIMARY KEY (dir, name)
-            )""")
-        conn.execute("""
-            CREATE TABLE IF NOT EXISTS kv (
-                k TEXT PRIMARY KEY,
-                v BLOB NOT NULL
-            )""")
-        conn.commit()
-
-    def insert_entry(self, entry: Entry) -> None:
-        d, name = _split(entry.full_path)
-        conn = self._conn()
-        conn.execute(
-            "INSERT OR REPLACE INTO entries (dir, name, meta) VALUES (?,?,?)",
-            (d, name, entry.to_json()))
-        self._commit(conn)
-
-    update_entry = insert_entry
-
-    def find_entry(self, path: str) -> Optional[Entry]:
-        d, name = _split(path)
-        if name == "/":
-            return None
-        row = self._conn().execute(
-            "SELECT meta FROM entries WHERE dir=? AND name=?",
-            (d, name)).fetchone()
-        return Entry.from_json(row[0]) if row else None
-
-    def delete_entry(self, path: str) -> None:
-        d, name = _split(path)
-        conn = self._conn()
-        conn.execute("DELETE FROM entries WHERE dir=? AND name=?", (d, name))
-        self._commit(conn)
-
-    def delete_folder_children(self, path: str) -> None:
-        path = path.rstrip("/") or "/"
-        conn = self._conn()
-        if path == "/":
-            conn.execute("DELETE FROM entries WHERE dir != ''")
-        else:
-            conn.execute("DELETE FROM entries WHERE dir = ? OR dir LIKE ?",
-                         (path, path + "/%"))
-        self._commit(conn)
-
-    def list_directory_entries(self, dir_path: str, start_file_name: str = "",
-                               include_start: bool = False,
-                               limit: int = 1024,
-                               prefix: str = "") -> list[Entry]:
-        dir_path = dir_path.rstrip("/") or "/"
-        op = ">=" if include_start else ">"
-        sql = f"SELECT meta FROM entries WHERE dir=? AND name {op} ?"
-        args: list = [dir_path, start_file_name]
-        if prefix:
-            sql += r" AND name LIKE ? ESCAPE '\'"
-            escaped = (prefix.replace("\\", r"\\")
-                       .replace("%", r"\%").replace("_", r"\_"))
-            args.append(escaped + "%")
-        sql += " ORDER BY name LIMIT ?"
-        args.append(limit)
-        rows = self._conn().execute(sql, args).fetchall()
-        return [Entry.from_json(r[0]) for r in rows]
-
-    def kv_put(self, key: str, value: bytes) -> None:
-        conn = self._conn()
-        conn.execute("INSERT OR REPLACE INTO kv (k, v) VALUES (?,?)",
-                     (key, value))
-        conn.commit()
-
-    def kv_get(self, key: str) -> Optional[bytes]:
-        row = self._conn().execute("SELECT v FROM kv WHERE k=?",
-                                   (key,)).fetchone()
-        return bytes(row[0]) if row else None
-
-    def close(self) -> None:
-        conn = getattr(self._local, "conn", None)
-        if conn is not None:
-            conn.close()
-            self._local.conn = None
+def _leveldb(**kw):
+    from .leveldb_store import LevelDbStore
+    return LevelDbStore(**kw)
 
 
 register_store("memory", MemoryStore)
-register_store("sqlite", SqliteStore)
+register_store("sqlite", _sqlite)
+register_store("mysql", _mysql)
+register_store("postgres", _postgres)
+register_store("leveldb", _leveldb)

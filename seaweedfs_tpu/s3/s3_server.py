@@ -29,6 +29,7 @@ from typing import Optional
 import aiohttp
 from aiohttp import web
 
+from . import auth as auth_mod
 from ..utils import metrics as metrics_mod
 
 log = logging.getLogger("s3")
@@ -54,10 +55,24 @@ def _error(code: str, message: str, status: int) -> web.Response:
 
 class S3Server:
     def __init__(self, filer_url: str,
-                 access_key: str = "", secret_key: str = ""):
+                 access_key: str = "", secret_key: str = "",
+                 iam: Optional["auth_mod.Iam"] = None):
         self.filer_url = filer_url
         self.access_key = access_key
         self.secret_key = secret_key
+        # identity registry with per-action ACLs
+        # (auth_credentials.go:25-150); the legacy access/secret pair
+        # becomes a single Admin identity
+        if iam is not None:
+            self.iam = iam
+        elif access_key:
+            self.iam = auth_mod.Iam([{
+                "name": "admin",
+                "credentials": [{"accessKey": access_key,
+                                 "secretKey": secret_key}],
+                "actions": [auth_mod.ACTION_ADMIN]}])
+        else:
+            self.iam = auth_mod.Iam([])
         self.metrics = metrics_mod.Registry("s3")
         self._session: Optional[aiohttp.ClientSession] = None
         self.app = self._build_app()
@@ -78,9 +93,14 @@ class S3Server:
         if self._session:
             await self._session.close()
 
-    # --- auth (SigV4 header scheme) ---
-    def _check_auth(self, request: web.Request) -> Optional[web.Response]:
-        if not self.access_key:
+    # --- auth (SigV4 header scheme + per-action ACLs) ---
+    def _check_auth(self, request: web.Request,
+                    action: str = "", bucket: str = ""
+                    ) -> Optional[web.Response]:
+        """Verify the SigV4 signature, resolve the identity, and check the
+        requested action against its ACL. Stashes the verified signature
+        context on the request for streaming-chunked payloads."""
+        if not self.iam.enabled:
             return None  # anonymous mode
         auth = request.headers.get("Authorization", "")
         if not auth.startswith("AWS4-HMAC-SHA256 "):
@@ -90,8 +110,14 @@ class S3Server:
                          for p in auth[len("AWS4-HMAC-SHA256 "):].split(","))
             cred = parts["Credential"].split("/")
             akid, date, region, service = cred[0], cred[1], cred[2], cred[3]
-            if akid != self.access_key:
+            found = self.iam.lookup(akid)
+            if found is None:
                 return _error("InvalidAccessKeyId", "unknown key", 403)
+            identity, secret_key = found
+            if action and not identity.allows(action, bucket):
+                return _error(
+                    "AccessDenied",
+                    f"{identity.name} may not {action} on {bucket}", 403)
             signed_headers = parts["SignedHeaders"].split(";")
             # canonical request
             canonical_headers = "".join(
@@ -117,17 +143,14 @@ class S3Server:
                 "AWS4-HMAC-SHA256", amz_date, scope,
                 hashlib.sha256(canonical.encode()).hexdigest()])
 
-            def _hmac(key: bytes, msg: str) -> bytes:
-                return hmac.new(key, msg.encode(), hashlib.sha256).digest()
-
-            k = _hmac(f"AWS4{self.secret_key}".encode(), date)
-            k = _hmac(k, region)
-            k = _hmac(k, service)
-            k = _hmac(k, "aws4_request")
+            k = auth_mod.signing_key(secret_key, date, region, service)
             want = hmac.new(k, string_to_sign.encode(),
                             hashlib.sha256).hexdigest()
             if not hmac.compare_digest(want, parts["Signature"]):
                 return _error("SignatureDoesNotMatch", "bad signature", 403)
+            # context for STREAMING-AWS4-HMAC-SHA256-PAYLOAD bodies
+            request["sigv4"] = {"seed": parts["Signature"], "key": k,
+                                "amz_date": amz_date, "scope": scope}
         except (KeyError, IndexError, ValueError) as e:
             return _error("AuthorizationHeaderMalformed", str(e), 400)
         return None
@@ -157,10 +180,19 @@ class S3Server:
         return _error("MethodNotAllowed", request.method, 405)
 
     async def dispatch_bucket(self, request: web.Request) -> web.Response:
-        denied = self._check_auth(request)
+        bucket = request.match_info["bucket"]
+        if request.method == "POST" and "delete" not in request.query:
+            # browser post-policy upload: authenticated by the signed
+            # policy document, not the Authorization header
+            return await self.post_policy_upload(request, bucket)
+        action = {"PUT": auth_mod.ACTION_ADMIN,
+                  "DELETE": auth_mod.ACTION_ADMIN,
+                  "HEAD": auth_mod.ACTION_LIST,
+                  "GET": auth_mod.ACTION_LIST,
+                  "POST": auth_mod.ACTION_WRITE}.get(request.method, "")
+        denied = self._check_auth(request, action, bucket)
         if denied:
             return denied
-        bucket = request.match_info["bucket"]
         if request.method == "PUT":
             return await self.put_bucket(bucket)
         if request.method == "DELETE":
@@ -174,12 +206,27 @@ class S3Server:
         return _error("MethodNotAllowed", request.method, 405)
 
     async def dispatch_object(self, request: web.Request) -> web.Response:
-        denied = self._check_auth(request)
-        if denied:
-            return denied
         bucket = request.match_info["bucket"]
         key = request.match_info["key"]
         q = request.query
+        tagging = "tagging" in q
+        if tagging:
+            action = (auth_mod.ACTION_READ if request.method == "GET"
+                      else auth_mod.ACTION_TAGGING)
+        elif request.method in ("GET", "HEAD"):
+            action = auth_mod.ACTION_READ
+        else:
+            action = auth_mod.ACTION_WRITE
+        denied = self._check_auth(request, action, bucket)
+        if denied:
+            return denied
+        if tagging:
+            if request.method == "GET":
+                return await self.get_tagging(bucket, key)
+            if request.method == "PUT":
+                return await self.put_tagging(request, bucket, key)
+            if request.method == "DELETE":
+                return await self.delete_tagging(bucket, key)
         if request.method == "POST" and "uploads" in q:
             return await self.initiate_multipart(bucket, key)
         if request.method == "PUT" and "partNumber" in q:
@@ -199,6 +246,21 @@ class S3Server:
         if request.method == "DELETE":
             return await self.delete_object(bucket, key)
         return _error("MethodNotAllowed", request.method, 405)
+
+    # --- request payloads (streaming chunked SigV4) ---
+    async def _request_payload(self, request: web.Request):
+        """The request body, transparently de-framing (and verifying)
+        STREAMING-AWS4-HMAC-SHA256-PAYLOAD bodies
+        (chunked_reader_v4.go)."""
+        if request.headers.get("x-amz-content-sha256", "") == \
+                "STREAMING-AWS4-HMAC-SHA256-PAYLOAD":
+            ctx = request.get("sigv4")
+            if ctx:
+                return await auth_mod.read_chunked_sigv4(
+                    request.content, ctx["seed"], ctx["key"],
+                    ctx["amz_date"], ctx["scope"])
+            return await auth_mod.read_chunked_sigv4(request.content)
+        return request.content
 
     # --- buckets ---
     async def list_buckets(self, request: web.Request) -> web.Response:
@@ -258,11 +320,19 @@ class S3Server:
         path = self._obj_path(bucket, key)
         headers = {"Content-Type": request.content_type
                    or "application/octet-stream"}
+        try:
+            payload = await self._request_payload(request)
+        except auth_mod.ChunkedSigV4Error as e:
+            return _error("SignatureDoesNotMatch", str(e), 403)
         async with self._session.put(
                 f"http://{self.filer_url}{urllib.parse.quote(path)}",
-                data=request.content, headers=headers) as r:
+                data=payload, headers=headers) as r:
             if r.status >= 300:
                 return _error("InternalError", f"filer: {r.status}", 500)
+        if request.headers.get("x-amz-tagging"):
+            tags = dict(urllib.parse.parse_qsl(
+                request.headers["x-amz-tagging"]))
+            await self._save_tags(path, tags)
         status, entry = await self._meta_get("lookup", {"path": path})
         et = _entry_etag(entry) if status == 200 else ""
         return web.Response(status=200, headers={"ETag": f'"{et}"'})
@@ -452,6 +522,110 @@ class S3Server:
             contents.append((key, e))
         return contents, common, truncated, next_marker
 
+    # --- tagging (s3api_object_tagging_handlers.go; tags live in the
+    #     entry's extended attributes) ---
+    async def _save_tags(self, path: str, tags: dict) -> web.Response | None:
+        status, entry = await self._meta_get("lookup", {"path": path})
+        if status != 200:
+            return _error("NoSuchKey", path, 404)
+        extended = entry.get("extended") or {}
+        if tags:
+            extended["x-amz-tagging"] = urllib.parse.urlencode(tags)
+        else:
+            extended.pop("x-amz-tagging", None)
+        entry["extended"] = extended
+        await self._meta("update_entry", {"entry": entry})
+        return None
+
+    async def get_tagging(self, bucket: str, key: str) -> web.Response:
+        path = self._obj_path(bucket, key)
+        status, entry = await self._meta_get("lookup", {"path": path})
+        if status != 200:
+            return _error("NoSuchKey", key, 404)
+        raw = (entry.get("extended") or {}).get("x-amz-tagging", "")
+        root = ET.Element("Tagging", xmlns=XMLNS)
+        tagset = ET.SubElement(root, "TagSet")
+        for k, v in urllib.parse.parse_qsl(raw):
+            tag = ET.SubElement(tagset, "Tag")
+            ET.SubElement(tag, "Key").text = k
+            ET.SubElement(tag, "Value").text = v
+        return _xml(root)
+
+    async def put_tagging(self, request: web.Request, bucket: str,
+                          key: str) -> web.Response:
+        body = await request.read()
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError as e:
+            return _error("MalformedXML", str(e), 400)
+        ns = root.tag.split("}")[0] + "}" if root.tag.startswith("{") else ""
+        tags = {}
+        for tag in root.iter(f"{ns}Tag"):
+            k = tag.find(f"{ns}Key")
+            v = tag.find(f"{ns}Value")
+            if k is not None and k.text:
+                tags[k.text] = v.text or "" if v is not None else ""
+        if len(tags) > 10:
+            return _error("BadRequest", "too many tags", 400)
+        err = await self._save_tags(self._obj_path(bucket, key), tags)
+        return err or web.Response(status=200)
+
+    async def delete_tagging(self, bucket: str, key: str) -> web.Response:
+        err = await self._save_tags(self._obj_path(bucket, key), {})
+        return err or web.Response(status=204)
+
+    # --- browser post-policy upload (weed/s3api/policy) ---
+    async def post_policy_upload(self, request: web.Request,
+                                 bucket: str) -> web.Response:
+        if not request.content_type.startswith("multipart/"):
+            return _error("BadRequest", "expected multipart form", 400)
+        fields: dict[str, str] = {"bucket": bucket}
+        file_data: Optional[bytes] = None
+        file_name = ""
+        reader = await request.multipart()
+        while True:
+            part = await reader.next()
+            if part is None:
+                break
+            name = (part.name or "").lower()
+            if name == "file":
+                file_data = bytes(await part.read(decode=False))
+                file_name = part.filename or ""
+                break  # per the S3 spec, fields after `file` are ignored
+            fields[name] = (await part.read(decode=False)).decode(
+                "utf-8", "replace")
+        if file_data is None:
+            return _error("BadRequest", "missing file field", 400)
+        if self.iam.enabled:
+            ok, why = auth_mod.verify_post_policy(fields, self.iam)
+            if not ok:
+                return _error("AccessDenied", why, 403)
+            # the signing identity still needs Write on this bucket — a
+            # policy signature must not bypass the per-action ACL
+            akid = fields.get("x-amz-credential", "").split("/")[0]
+            found = self.iam.lookup(akid)
+            if found is None or not found[0].allows(auth_mod.ACTION_WRITE,
+                                                    bucket):
+                return _error("AccessDenied",
+                              f"identity may not Write on {bucket}", 403)
+        key = fields.get("key", "")
+        if not key:
+            return _error("BadRequest", "missing key", 400)
+        key = key.replace("${filename}", file_name)
+        if (await self.head_bucket(bucket)).status != 200:
+            return _error("NoSuchBucket", bucket, 404)
+        path = self._obj_path(bucket, key)
+        headers = {"Content-Type": fields.get("content-type",
+                                              "application/octet-stream")}
+        async with self._session.put(
+                f"http://{self.filer_url}{urllib.parse.quote(path)}",
+                data=file_data, headers=headers) as r:
+            if r.status >= 300:
+                return _error("InternalError", f"filer: {r.status}", 500)
+        status = int(fields.get("success_action_status", "204"))
+        return web.Response(status=status if status in (200, 201, 204)
+                            else 204)
+
     # --- multipart ---
     async def initiate_multipart(self, bucket: str,
                                  key: str) -> web.Response:
@@ -480,9 +654,13 @@ class S3Server:
         if not 1 <= part <= 10000:
             return _error("InvalidPartNumber", str(part), 400)
         path = f"{UPLOADS_DIR}/{upload_id}/{part:05d}.part"
+        try:
+            payload = await self._request_payload(request)
+        except auth_mod.ChunkedSigV4Error as e:
+            return _error("SignatureDoesNotMatch", str(e), 403)
         async with self._session.put(
                 f"http://{self.filer_url}{path}",
-                data=request.content) as r:
+                data=payload) as r:
             if r.status >= 300:
                 return _error("InternalError", f"filer: {r.status}", 500)
         status, entry = await self._meta_get("lookup", {"path": path})

@@ -28,6 +28,7 @@ import aiohttp
 from aiohttp import web
 
 from ..client import _PUSHED
+from ..filer import manifest as manifest_mod
 from ..filer.chunks import FileChunk, etag as chunks_etag, read_plan, total_size
 from ..filer.entry import Entry, new_directory, new_file
 from ..filer.filer import Filer, _norm
@@ -83,6 +84,9 @@ class FilerServer:
         # server-side AES-256-GCM chunk encryption
         # (filer_server_handlers_write_cipher.go:17, util/cipher.go)
         self.cipher = cipher
+        # entries fold chunk lists into manifest blobs past this many
+        # chunks (filechunk_manifest.go ManifestBatch)
+        self.manifest_batch = manifest_mod.MANIFEST_BATCH
         self.notifier = notifier
         if notifier is not None:
             self.filer.meta_log.subscribe(notifier.notify)
@@ -402,10 +406,31 @@ class FilerServer:
         for c in chunks:
             self._loop.call_soon_threadsafe(self._delete_queue.put_nowait, c)
 
+    async def _fetch_manifest_blob(self, chunk: FileChunk) -> bytes:
+        """Fetch (and decrypt) a manifest chunk's blob."""
+        data = await self._fetch_raw(chunk.fid)
+        if chunk.cipher_key:
+            from ..utils import cipher as cipher_mod
+            data = cipher_mod.decrypt(
+                data, cipher_mod.key_from_str(chunk.cipher_key))
+        return data
+
     async def _deletion_worker(self) -> None:
         while True:
             chunk: FileChunk = await self._delete_queue.get()
             try:
+                if chunk.is_chunk_manifest:
+                    # free the data chunks the manifest references before
+                    # the manifest blob itself (filer_deletion.go resolves
+                    # manifests the same way)
+                    try:
+                        nested = manifest_mod.unpack_manifest(
+                            await self._fetch_manifest_blob(chunk))
+                        for c in nested:
+                            self._delete_queue.put_nowait(c)
+                    except Exception as e:
+                        log.warning("manifest %s resolution for delete "
+                                    "failed: %s", chunk.fid, e)
                 vid = int(chunk.fid.split(",")[0])
                 headers = {}
                 # sign a write jwt with the shared signing key so volume
@@ -636,8 +661,12 @@ class FilerServer:
         if request.method == "HEAD" or length == 0:
             await resp.write_eof()
             return resp
-        plan = read_plan(entry.chunks, start, length)
-        keys = {c.fid: c.cipher_key for c in entry.chunks if c.cipher_key}
+        chunks = entry.chunks
+        if any(c.is_chunk_manifest for c in chunks):
+            chunks = await manifest_mod.resolve_manifests(
+                chunks, self._fetch_manifest_blob)
+        plan = read_plan(chunks, start, length)
+        keys = {c.fid: c.cipher_key for c in chunks if c.cipher_key}
         written = start
         for view in plan:
             if view.logic_offset > written:
@@ -727,6 +756,14 @@ class FilerServer:
             # clean up whatever we uploaded
             self._queue_chunk_deletes(chunks)
             raise
+        if len(chunks) > self.manifest_batch:
+            # super-large file: fold chunk groups into manifest blobs
+            # (filechunk_manifest.go:41-120)
+            async def save_manifest(blob: bytes, at: int) -> FileChunk:
+                return await self._upload_chunk(blob, collection,
+                                                replication, ttl, at)
+            chunks = await manifest_mod.maybe_manifestize(
+                chunks, save_manifest, self.manifest_batch)
         entry = new_file(_norm(path), chunks, mime=mime,
                          collection=collection, replication=replication)
         if request.query.get("ttl"):

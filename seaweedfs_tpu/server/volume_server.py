@@ -53,6 +53,67 @@ async def _healthz(request: "web.Request") -> "web.Response":
     return web.json_response({"ok": True})
 
 
+class WriteBatcher:
+    """Per-volume async write coalescing — the server half of the
+    reference's batching worker (volume_read_write.go:297-327): up to 128
+    requests or 4MB land in one executor call and one engine flush, so
+    concurrent small writes stop paying a thread-pool hop each.
+    """
+
+    MAX_BATCH = 128
+    MAX_BYTES = 4 * 1024 * 1024
+
+    def __init__(self, store: Store):
+        self.store = store
+        self._queues: dict[int, asyncio.Queue] = {}
+        self._workers: dict[int, asyncio.Task] = {}
+
+    async def write(self, vid: int, needle) -> tuple[int, int, bool]:
+        q = self._queues.get(vid)
+        if q is None:
+            q = self._queues[vid] = asyncio.Queue()
+            self._workers[vid] = asyncio.create_task(self._worker(vid, q))
+        fut = asyncio.get_event_loop().create_future()
+        q.put_nowait((needle, fut))
+        result = await fut
+        if isinstance(result, Exception):
+            raise result
+        return result
+
+    async def _worker(self, vid: int, q: asyncio.Queue) -> None:
+        loop = asyncio.get_event_loop()
+        while True:
+            needle, fut = await q.get()
+            batch = [(needle, fut)]
+            size = len(needle.data)
+            while (len(batch) < self.MAX_BATCH and size < self.MAX_BYTES
+                   and not q.empty()):
+                n2, f2 = q.get_nowait()
+                batch.append((n2, f2))
+                size += len(n2.data)
+            v = self.store.find_volume(vid)
+            if v is None:
+                err = KeyError(f"volume {vid} not found")
+                for _, f in batch:
+                    if not f.done():
+                        f.set_exception(err)
+                continue
+            try:
+                results = await loop.run_in_executor(
+                    None, v.write_needles_batch, [n for n, _ in batch])
+            except Exception as e:
+                results = [e] * len(batch)
+            for (_, f), res in zip(batch, results):
+                if f.done():
+                    continue
+                # engine errors come back in-place; surface per-request
+                f.set_result(res)
+
+    def stop(self) -> None:
+        for t in self._workers.values():
+            t.cancel()
+
+
 class VolumeServer:
     def __init__(self, store: Store, master_url: str, url: str,
                  public_url: str = "", data_center: str = "", rack: str = "",
@@ -75,6 +136,9 @@ class VolumeServer:
         self.metrics = metrics_mod.Registry("volume")
         self._hb_task: Optional[asyncio.Task] = None
         self._session: Optional[aiohttp.ClientSession] = None
+        self._batcher: Optional[WriteBatcher] = None
+        self._replica_cache: dict[int, tuple[list[str], float]] = {}
+        self._shard_loc_cache: dict[int, tuple[dict, float]] = {}
         self.app = self._build_app()
         # the EC read path fetches missing shards from peers through this
         store._remote_shard_reader = self._make_shard_reader
@@ -136,11 +200,14 @@ class VolumeServer:
 
     async def _on_startup(self, app) -> None:
         self._session = aiohttp.ClientSession()
+        self._batcher = WriteBatcher(self.store)
         self._hb_task = asyncio.create_task(self._heartbeat_loop())
 
     async def _on_cleanup(self, app) -> None:
         if self._hb_task:
             self._hb_task.cancel()
+        if self._batcher is not None:
+            self._batcher.stop()
         if self._session:
             await self._session.close()
         self.store.close()
@@ -153,6 +220,14 @@ class VolumeServer:
                     None, self.store.delete_expired_volumes)
                 if expired:
                     log.info("deleted expired TTL volumes %s", expired)
+                # min-free-space watchdog: volumes on a filling disk seal
+                # themselves readonly before the disk is full
+                # (disk_location.go:304)
+                was_low = self.store.low_disk_space
+                low = await asyncio.get_event_loop().run_in_executor(
+                    None, self.store.check_free_space)
+                if low != was_low:
+                    log.warning("low disk space: %s", low)
                 await self.send_heartbeat()
             except Exception as e:
                 log.warning("heartbeat to %s failed: %s", self.master_url, e)
@@ -358,9 +433,10 @@ class VolumeServer:
 
         with self.metrics.timed("write"):
             try:
-                _, size, unchanged = await asyncio.get_event_loop() \
-                    .run_in_executor(None, lambda: self.store.write_needle(
-                        fid.volume_id, n))
+                result = await self._batcher.write(fid.volume_id, n)
+                if isinstance(result, Exception):
+                    raise result
+                _, size, unchanged = result
             except KeyError:
                 return web.json_response({"error": "volume not found"},
                                          status=404)
@@ -436,6 +512,13 @@ class VolumeServer:
         return ok
 
     async def _replica_urls(self, vid: int) -> list[str]:
+        # short-TTL cache: the replicated-write fan-out otherwise pays a
+        # master lookup per request (getWritableRemoteReplications caches
+        # the same way, weed/topology/store_replicate.go:163)
+        import time as time_mod
+        cached = self._replica_cache.get(vid)
+        if cached and time_mod.monotonic() - cached[1] < 10.0:
+            return cached[0]
         try:
             async with self._session.get(
                     f"http://{self.master_url}/dir/lookup",
@@ -443,8 +526,10 @@ class VolumeServer:
                 if r.status != 200:
                     return []
                 body = await r.json()
-                return [loc["url"] for loc in body.get("locations", [])
+                urls = [loc["url"] for loc in body.get("locations", [])
                         if loc["url"] != self.url]
+                self._replica_cache[vid] = (urls, time_mod.monotonic())
+                return urls
         except Exception:
             return []
 
@@ -784,34 +869,65 @@ class VolumeServer:
         return web.Response(body=data,
                             content_type="application/octet-stream")
 
+    # shard-location freshness tiers (store_ec.go:221-262): a missing
+    # shard re-polls the master after 11s, a known one after 7m; a total
+    # read miss forces an immediate refresh (see _make_shard_reader)
+    _SHARD_LOC_MISSING_TTL = 11.0
+    _SHARD_LOC_KNOWN_TTL = 7 * 60.0
+
+    def _shard_locations(self, vid: int, shard_id: int,
+                         force: bool = False) -> list[str]:
+        """Tiered-TTL cache of vid -> shard -> holder urls."""
+        import json as _json
+        import time as time_mod
+        import urllib.request
+        now = time_mod.monotonic()
+        cached = self._shard_loc_cache.get(vid)
+        if cached is not None and not force:
+            shards, fetched = cached
+            age = now - fetched
+            have = str(shard_id) in shards
+            if age < self._SHARD_LOC_MISSING_TTL or \
+                    (have and age < self._SHARD_LOC_KNOWN_TTL):
+                return [u for u in shards.get(str(shard_id), [])
+                        if u != self.url]
+        try:
+            with urllib.request.urlopen(
+                    f"http://{self.master_url}/col/lookup/ec?volumeId={vid}",
+                    timeout=5) as r:
+                shards = _json.load(r).get("shards", {})
+            self._shard_loc_cache[vid] = (shards, now)
+        except Exception as e:
+            log.warning("ec shard lookup for %d failed: %s", vid, e)
+            shards = cached[0] if cached else {}
+        return [u for u in shards.get(str(shard_id), []) if u != self.url]
+
     def _make_shard_reader(self, ev):
         """Shard reader hitting peers' /admin/ec/shard_read — used by the EC
         read path for non-local shards (store_ec.go:282-320). Synchronous
-        (runs in executor threads)."""
+        (runs in executor threads); a total miss forces one location-cache
+        refresh so reads survive shard moves."""
         import urllib.request
 
-        def read(shard_id: int, offset: int, size: int) -> Optional[bytes]:
+        def fetch(url: str, shard_id: int, offset: int,
+                  size: int) -> Optional[bytes]:
             try:
-                import json as _json
                 with urllib.request.urlopen(
-                        f"http://{self.master_url}/col/lookup/ec?volumeId="
-                        f"{ev.vid}", timeout=5) as r:
-                    shards = _json.load(r).get("shards", {})
-                urls = [u for u in shards.get(str(shard_id), [])
-                        if u != self.url]
-                for url in urls:
-                    try:
-                        with urllib.request.urlopen(
-                                f"http://{url}/admin/ec/shard_read?volume="
-                                f"{ev.vid}&shard={shard_id}&offset={offset}"
-                                f"&size={size}", timeout=10) as r:
-                            data = r.read()
-                            if len(data) == size:
-                                return data
-                    except Exception:
-                        continue
+                        f"http://{url}/admin/ec/shard_read?volume="
+                        f"{ev.vid}&shard={shard_id}&offset={offset}"
+                        f"&size={size}", timeout=10) as r:
+                    data = r.read()
+                    return data if len(data) == size else None
             except Exception:
                 return None
+
+        def read(shard_id: int, offset: int, size: int) -> Optional[bytes]:
+            for force in (False, True):
+                for url in self._shard_locations(ev.vid, shard_id,
+                                                 force=force):
+                    data = fetch(url, shard_id, offset, size)
+                    if data is not None:
+                        return data
             return None
 
         return read
@@ -908,7 +1024,8 @@ class VolumeServer:
                             f.write(chunk)
             from ..storage.volume import Volume
             v = await asyncio.get_event_loop().run_in_executor(
-                None, lambda: Volume(loc.directory, collection, vid))
+                None, lambda: Volume(loc.directory, collection, vid,
+                     needle_map_kind=self.store.needle_map_kind))
             loc.volumes[vid] = v
         except Exception as e:
             for ext in (".dat", ".idx"):

@@ -239,9 +239,34 @@ def volume_fsck(env: CommandEnv, argv: list[str]):
     if not env.filer:
         raise ClientError("volume.fsck needs -filer")
 
-    # 1. referenced fids per volume from the filer tree
+    # 1. referenced fids per volume from the filer tree; manifest chunks
+    #    are resolved recursively so their data chunks count as referenced
+    #    (command_volume_fsck.go walks the same closure)
     referenced: dict[int, set[int]] = defaultdict(set)
     from ..storage.file_id import FileId
+
+    def add_chunks(chunk_dicts: list, depth: int = 0) -> None:
+        if depth > 16:
+            return
+        for c in chunk_dicts:
+            try:
+                fid = FileId.parse(c["fid"])
+                referenced[fid.volume_id].add(fid.key)
+            except ValueError:
+                continue
+            if c.get("is_chunk_manifest"):
+                import json as json_mod
+                try:
+                    blob = env.client.download(c["fid"])
+                    if c.get("cipher_key"):
+                        from ..utils import cipher as cipher_mod
+                        blob = cipher_mod.decrypt(
+                            blob,
+                            cipher_mod.key_from_str(c["cipher_key"]))
+                    add_chunks(json_mod.loads(blob)["chunks"], depth + 1)
+                except Exception:
+                    pass  # unreadable manifest: its refs count as missing
+
     def walk(directory: str) -> None:
         start = ""
         while True:
@@ -255,12 +280,7 @@ def volume_fsck(env: CommandEnv, argv: list[str]):
                 mode = e.get("attr", {}).get("mode", 0)
                 if stat_mod.S_ISDIR(mode):
                     walk(e["path"])
-                for c in e.get("chunks", []):
-                    try:
-                        fid = FileId.parse(c["fid"])
-                        referenced[fid.volume_id].add(fid.key)
-                    except ValueError:
-                        pass
+                add_chunks(e.get("chunks", []))
             import os.path as osp
             start = osp.basename(entries[-1]["path"])
             if len(entries) < 256:

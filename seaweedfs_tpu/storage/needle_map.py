@@ -125,10 +125,188 @@ class NeedleMap:
         return [(key, nv.size) for key, nv in sorted(self._map.items())
                 if nv.size > 0]
 
+    def values(self):
+        """All current entries (live + tombstoned), unordered."""
+        return list(self._map.values())
+
     def close(self) -> None:
         if self._index_file is not None:
             self._index_file.close()
             self._index_file = None
+
+
+class CompactNeedleMap(NeedleMap):
+    """Sectioned numpy needle map — 16 bytes/entry at any scale.
+
+    The reference's CompactMap keeps 100k-entry sorted sections plus an
+    overflow area (weed/storage/needle_map/compact_map.go:10-40) precisely
+    to hold 100M+ needles at 16B each; a Python dict of NeedleValues costs
+    ~10x that. Here the settled entries live in parallel numpy arrays
+    (u64 key / u32 offset / i32 size = 16B), binary-searched per lookup,
+    with a small dict overflow for recent writes that merges in batches.
+
+    Same public surface and .idx journaling as NeedleMap (selected with
+    needle_map_kind="compact").
+    """
+
+    MERGE_THRESHOLD = 100_000
+
+    def __init__(self, index_path: Optional[str] = None):
+        import numpy as np
+        self._np = np
+        self._keys = np.empty(0, dtype=np.uint64)
+        self._offsets = np.empty(0, dtype=np.uint32)
+        self._sizes = np.empty(0, dtype=np.int32)
+        super().__init__(index_path)
+        self._merge()
+
+    def _load(self, index_path: str) -> None:
+        """Replay the .idx journal folding into the arrays in
+        MERGE_THRESHOLD batches — peak memory stays at the 16B/entry
+        budget even for 100M-entry volumes (the dict-based parent _load
+        would momentarily cost ~10x that)."""
+        if not os.path.exists(index_path):
+            open(index_path, "wb").close()
+            return
+        for key, offset, size in idx_mod.iter_index_file(index_path):
+            self.maximum_key = max(self.maximum_key, key)
+            if offset > 0 and size != t.TOMBSTONE_FILE_SIZE:
+                existing = self._store_get(key)
+                if existing is not None:
+                    self.deleted_count += 1
+                    self.deleted_byte_count += max(existing.size, 0)
+                self._store_set(NeedleValue(key, offset, size))
+                self.file_count += 1
+                self.file_byte_count += max(size, 0)
+            else:
+                existing = self._store_get(key)
+                if existing is not None and existing.size > 0:
+                    self._store_set(NeedleValue(key, existing.offset,
+                                                -existing.size))
+                    self.deleted_count += 1
+                    self.deleted_byte_count += max(existing.size, 0)
+
+    # storage primitives -------------------------------------------------
+    def _array_index(self, key: int) -> int:
+        i = int(self._np.searchsorted(self._keys, self._np.uint64(key)))
+        if i < len(self._keys) and int(self._keys[i]) == key:
+            return i
+        return -1
+
+    def _store_get(self, key: int) -> Optional[NeedleValue]:
+        nv = self._map.get(key)
+        if nv is not None:
+            return nv
+        i = self._array_index(key)
+        if i < 0:
+            return None
+        return NeedleValue(key, int(self._offsets[i]), int(self._sizes[i]))
+
+    def _store_set(self, nv: NeedleValue) -> None:
+        if nv.key not in self._map:
+            i = self._array_index(nv.key)
+            if i >= 0:
+                # in-place update keeps the arrays sorted and allocation-free
+                self._offsets[i] = nv.offset
+                self._sizes[i] = nv.size
+                return
+        self._map[nv.key] = nv
+        if len(self._map) >= self.MERGE_THRESHOLD:
+            self._merge()
+
+    def _merge(self) -> None:
+        if not self._map:
+            return
+        np = self._np
+        new_keys = np.fromiter(self._map.keys(), dtype=np.uint64,
+                               count=len(self._map))
+        order = np.argsort(new_keys, kind="stable")
+        new_keys = new_keys[order]
+        vals = list(self._map.values())
+        new_offsets = np.fromiter((vals[i].offset for i in order),
+                                  dtype=np.uint32, count=len(vals))
+        new_sizes = np.fromiter((vals[i].size for i in order),
+                                dtype=np.int32, count=len(vals))
+        # drop array entries shadowed by the overflow, then merge-sort
+        keep = ~np.isin(self._keys, new_keys)
+        keys = np.concatenate([self._keys[keep], new_keys])
+        offsets = np.concatenate([self._offsets[keep], new_offsets])
+        sizes = np.concatenate([self._sizes[keep], new_sizes])
+        order = np.argsort(keys, kind="stable")
+        self._keys = keys[order]
+        self._offsets = offsets[order]
+        self._sizes = sizes[order]
+        self._map.clear()
+
+    # public surface (counters ride the NeedleMap implementations) -------
+    def put(self, key: int, stored_offset: int, size: int) -> None:
+        existing = self._store_get(key)
+        if existing is not None and existing.size > 0:
+            self.deleted_count += 1
+            self.deleted_byte_count += existing.size
+        self._store_set(NeedleValue(key, stored_offset, size))
+        self.file_count += 1
+        self.file_byte_count += max(size, 0)
+        self.maximum_key = max(self.maximum_key, key)
+        if self._index_file is not None:
+            self._index_file.write(
+                idx_mod.pack_entry(key, stored_offset, size))
+            self._index_file.flush()
+
+    def delete(self, key: int, tombstone_offset: int = 0) -> bool:
+        existing = self._store_get(key)
+        if existing is None or existing.size < 0:
+            return False
+        self._store_set(NeedleValue(key, existing.offset, -existing.size))
+        self.deleted_count += 1
+        self.deleted_byte_count += max(existing.size, 0)
+        if self._index_file is not None:
+            self._index_file.write(idx_mod.pack_entry(
+                key, tombstone_offset, t.TOMBSTONE_FILE_SIZE))
+            self._index_file.flush()
+        return True
+
+    def get(self, key: int) -> Optional[NeedleValue]:
+        return self._store_get(key)
+
+    def __len__(self) -> int:
+        # overflow and arrays are disjoint (in-place array updates), so
+        # no merge is needed — heartbeats stay O(overflow)
+        return int((self._sizes > 0).sum()) + \
+            sum(1 for nv in self._map.values() if nv.size > 0)
+
+    def __contains__(self, key: int) -> bool:
+        nv = self._store_get(key)
+        return nv is not None and nv.size > 0
+
+    def ascending_visit(self, fn: Callable[[NeedleValue], None]) -> None:
+        self._merge()
+        for i in range(len(self._keys)):
+            if self._sizes[i] > 0:
+                fn(NeedleValue(int(self._keys[i]), int(self._offsets[i]),
+                               int(self._sizes[i])))
+
+    def live_entries(self) -> list[tuple[int, int]]:
+        self._merge()
+        live = self._sizes > 0
+        return list(zip((int(k) for k in self._keys[live]),
+                        (int(s) for s in self._sizes[live])))
+
+    def values(self):
+        self._merge()
+        return [NeedleValue(int(self._keys[i]), int(self._offsets[i]),
+                            int(self._sizes[i]))
+                for i in range(len(self._keys))]
+
+
+def create_needle_map(kind: str, index_path: Optional[str] = None):
+    """Needle map factory (NeedleMapType selection,
+    weed/storage/needle_map.go:14-19)."""
+    if kind in ("memory", ""):
+        return NeedleMap(index_path)
+    if kind == "compact":
+        return CompactNeedleMap(index_path)
+    raise KeyError(f"unknown needle map kind {kind!r}")
 
 
 class SortedNeedleMap:

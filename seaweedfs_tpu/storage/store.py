@@ -27,12 +27,15 @@ class DiskLocation:
     """One storage directory holding volumes and EC shards
     (weed/storage/disk_location.go)."""
 
-    def __init__(self, directory: str, max_volume_count: int = 8):
+    def __init__(self, directory: str, max_volume_count: int = 8,
+                 needle_map_kind: str = "memory"):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.max_volume_count = max_volume_count
+        self.needle_map_kind = needle_map_kind
         self.volumes: dict[int, Volume] = {}
         self.ec_volumes: dict[int, EcVolume] = {}
+        self.low_space = False
 
     def load_existing(self, coder_factory,
                       geometry: ec_mod.Geometry) -> None:
@@ -41,14 +44,24 @@ class DiskLocation:
                  for p in glob.glob(os.path.join(self.directory, "*.dat"))}
         names |= {os.path.basename(p)[:-4]
                   for p in glob.glob(os.path.join(self.directory, "*.vif"))}
-        for name in sorted(names):
+
+        def load_one(name: str):
             collection, vid = _parse_volume_file_name(name)
             if vid is None:
-                continue
+                return None
             try:
-                self.volumes[vid] = Volume(self.directory, collection, vid)
+                return vid, Volume(self.directory, collection, vid,
+                                   needle_map_kind=self.needle_map_kind)
             except Exception:
-                continue
+                return None
+
+        # 8-way concurrent load (disk_location.go:94-118): .idx replay is
+        # the startup cost and parallelizes across volumes
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            for res in pool.map(load_one, sorted(names)):
+                if res is not None:
+                    self.volumes[res[0]] = res[1]
         for ecx in glob.glob(os.path.join(self.directory, "*.ecx")):
             name = os.path.basename(ecx)[:-4]
             collection, vid = _parse_volume_file_name(name)
@@ -83,16 +96,50 @@ class Store:
     def __init__(self, directories: list[str],
                  max_volume_counts: Optional[list[int]] = None,
                  coder_name: str = "auto",
-                 geometry: ec_mod.Geometry = ec_mod.DEFAULT):
+                 geometry: ec_mod.Geometry = ec_mod.DEFAULT,
+                 needle_map_kind: str = "memory",
+                 min_free_space_percent: float = 1.0):
         self.geometry = geometry
         self.coder_name = coder_name
+        self.needle_map_kind = needle_map_kind
+        self.min_free_space_percent = min_free_space_percent
+        self.low_disk_space = False
         self._coder: Optional[ErasureCoder] = None
         counts = max_volume_counts or [8] * len(directories)
-        self.locations = [DiskLocation(d, c)
+        self.locations = [DiskLocation(d, c, needle_map_kind)
                           for d, c in zip(directories, counts)]
         self._lock = threading.RLock()
         for loc in self.locations:
             loc.load_existing(self.coder, self.geometry)
+
+    def check_free_space(self) -> bool:
+        """Min-free-space watchdog (disk_location.go:304 + statfs,
+        weed/stats/disk_supported.go): when any location's disk drops
+        below the threshold, every volume there goes readonly; space
+        coming back lifts the seal for volumes we sealed ourselves."""
+        low_any = False
+        for loc in self.locations:
+            st = os.statvfs(loc.directory)
+            free_pct = st.f_bavail / max(st.f_blocks, 1) * 100.0
+            low = free_pct < self.min_free_space_percent
+            low_any = low_any or low
+            if low and not loc.low_space:
+                loc.low_space = True
+                for v in loc.volumes.values():
+                    if not v.read_only:
+                        v.read_only = True
+                        v.watchdog_sealed = True
+            elif not low and loc.low_space:
+                loc.low_space = False
+                for v in loc.volumes.values():
+                    # only lift seals the watchdog itself applied; an
+                    # operator/readonly mark set in the interim clears
+                    # watchdog_sealed and wins
+                    if v.watchdog_sealed and not v.is_remote:
+                        v.read_only = False
+                    v.watchdog_sealed = False
+        self.low_disk_space = low_any
+        return low_any
 
     def coder(self) -> ErasureCoder:
         if self._coder is None:
@@ -136,7 +183,8 @@ class Store:
                 replica_placement=ReplicaPlacement.parse(replica_placement),
                 ttl=t.TTL.parse(ttl))
             v = Volume(loc.directory, collection, vid, superblock=sb,
-                       create=True)
+                       create=True,
+                       needle_map_kind=self.needle_map_kind)
             loc.volumes[vid] = v
             return v
 
@@ -158,6 +206,8 @@ class Store:
         if v is None:
             return False
         v.read_only = read_only
+        # an explicit admin decision supersedes any watchdog seal
+        v.watchdog_sealed = False
         return True
 
     def unmount_volume(self, vid: int) -> bool:
@@ -182,7 +232,8 @@ class Store:
                 base = os.path.join(loc.directory, f"{prefix}{vid}")
                 if os.path.exists(base + ".dat") or \
                         os.path.exists(base + ".vif"):
-                    v = Volume(loc.directory, collection, vid)
+                    v = Volume(loc.directory, collection, vid,
+                               needle_map_kind=self.needle_map_kind)
                     loc.volumes[vid] = v
                     return v
         raise KeyError(f"volume {vid} not found on disk")
@@ -259,8 +310,9 @@ class Store:
                 if loc.volumes.get(vid) is v:
                     v.close()
                     os.remove(backend_mod.vif_path(base))
-                    loc.volumes[vid] = Volume(loc.directory, v.collection,
-                                              vid)
+                    loc.volumes[vid] = Volume(
+                        loc.directory, v.collection, vid,
+                        needle_map_kind=self.needle_map_kind)
                     loc.volumes[vid].read_only = True
                     break
         return {"volume_id": vid, "bytes": spec["file_size"]}
@@ -440,7 +492,9 @@ class Store:
             ev = loc.ec_volumes.pop(vid, None)
             if ev is not None:
                 ev.close()
-            loc.volumes[vid] = Volume(loc.directory, collection, vid)
+            loc.volumes[vid] = Volume(
+                loc.directory, collection, vid,
+                needle_map_kind=self.needle_map_kind)
 
     # --- heartbeat ---
     def heartbeat(self) -> dict:

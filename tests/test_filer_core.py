@@ -11,7 +11,8 @@ from seaweedfs_tpu.filer.chunks import (FileChunk, compact_chunks, etag,
                                         read_plan, total_size)
 from seaweedfs_tpu.filer.entry import new_directory, new_file
 from seaweedfs_tpu.filer.filer import Filer
-from seaweedfs_tpu.filer.stores import MemoryStore, SqliteStore, create_store
+from seaweedfs_tpu.filer.abstract_sql import SqliteStore
+from seaweedfs_tpu.filer.stores import MemoryStore, create_store
 
 
 # ---------- chunk algebra ----------

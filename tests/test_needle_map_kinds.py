@@ -1,0 +1,134 @@
+"""Needle map kinds: the numpy CompactNeedleMap (16B/entry, sectioned like
+the reference CompactMap, weed/storage/needle_map/compact_map.go) must be
+behavior-identical to the dict NeedleMap; plus the min-free-space watchdog.
+"""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.needle_map import (CompactNeedleMap, NeedleMap,
+                                              create_needle_map)
+from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.storage.volume import Volume
+
+
+def _apply_ops(nm, ops):
+    for op, key, offset, size in ops:
+        if op == "put":
+            nm.put(key, offset, size)
+        else:
+            nm.delete(key)
+
+
+def _random_ops(n=5000, key_space=800, seed=9):
+    rng = random.Random(seed)
+    ops = []
+    for i in range(n):
+        key = rng.randrange(1, key_space)
+        if rng.random() < 0.25:
+            ops.append(("delete", key, 0, 0))
+        else:
+            ops.append(("put", key, i + 1, rng.randrange(1, 5000)))
+    return ops
+
+
+def test_compact_map_differential_vs_dict_map():
+    a, b = NeedleMap(), CompactNeedleMap()
+    # small merge threshold: exercise array/overflow interplay constantly
+    b.MERGE_THRESHOLD = 64
+    ops = _random_ops()
+    _apply_ops(a, ops)
+    _apply_ops(b, ops)
+
+    assert len(a) == len(b)
+    assert a.file_count == b.file_count
+    assert a.deleted_count == b.deleted_count
+    assert a.file_byte_count == b.file_byte_count
+    assert a.deleted_byte_count == b.deleted_byte_count
+    assert a.maximum_key == b.maximum_key
+    for key in range(1, 800):
+        va, vb = a.get(key), b.get(key)
+        assert (va is None) == (vb is None), key
+        if va is not None:
+            assert (va.offset, va.size) == (vb.offset, vb.size), key
+        assert (key in a) == (key in b)
+    assert a.live_entries() == b.live_entries()
+
+    visits_a, visits_b = [], []
+    a.ascending_visit(lambda nv: visits_a.append(nv))
+    b.ascending_visit(lambda nv: visits_b.append(nv))
+    assert visits_a == visits_b
+
+
+def test_compact_map_idx_journal_roundtrip(tmp_path):
+    path = str(tmp_path / "m.idx")
+    nm = CompactNeedleMap(path)
+    nm.MERGE_THRESHOLD = 32
+    ops = _random_ops(n=1000, key_space=200, seed=4)
+    _apply_ops(nm, ops)
+    live = nm.live_entries()
+    nm.close()
+
+    # replay the journal into both kinds: identical state
+    nm2 = create_needle_map("compact", path)
+    nm3 = create_needle_map("memory", path)
+    assert nm2.live_entries() == live
+    assert nm3.live_entries() == live
+    assert len(nm2) == len(nm3)
+
+
+def test_compact_map_memory_is_16_bytes_per_entry():
+    nm = CompactNeedleMap()
+    for i in range(1, 200_001):
+        nm.put(i, i, 100)
+    nm._merge()
+    array_bytes = (nm._keys.nbytes + nm._offsets.nbytes + nm._sizes.nbytes)
+    assert array_bytes == 200_000 * 16
+    assert len(nm._map) == 0  # everything settled into the arrays
+
+
+def test_volume_runs_on_compact_map(tmp_path):
+    v = Volume(str(tmp_path), "", 1, create=True,
+               needle_map_kind="compact")
+    assert isinstance(v.nm, CompactNeedleMap)
+    for i in range(1, 50):
+        v.write_needle(Needle(cookie=i, id=i, data=b"x" * i))
+    v.delete_needle(Needle(cookie=7, id=7))
+    assert v.read_needle(8).data == b"x" * 8
+    with pytest.raises(KeyError):
+        v.read_needle(7)
+    v.close()
+    # reload replays the journal into a compact map again
+    v2 = Volume(str(tmp_path), "", 1, needle_map_kind="compact")
+    assert isinstance(v2.nm, CompactNeedleMap)
+    assert v2.read_needle(8).data == b"x" * 8
+    with pytest.raises(KeyError):
+        v2.read_needle(7)
+    v2.close()
+
+
+def test_min_free_space_watchdog(tmp_path):
+    st = Store([str(tmp_path)], coder_name="numpy")
+    v = st.add_volume(1)
+    v.write_needle(Needle(cookie=1, id=1, data=b"data"))
+    # plenty of space: nothing sealed
+    st.min_free_space_percent = 0.0
+    assert st.check_free_space() is False
+    assert not v.read_only
+    # impossible threshold simulates a filling disk: volume seals
+    st.min_free_space_percent = 101.0
+    assert st.check_free_space() is True
+    assert v.read_only
+    from seaweedfs_tpu.storage.volume import VolumeReadOnly
+    with pytest.raises(VolumeReadOnly):
+        v.write_needle(Needle(cookie=2, id=2, data=b"no"))
+    # space recovers: the watchdog unseals what it sealed
+    st.min_free_space_percent = 0.0
+    assert st.check_free_space() is False
+    assert not v.read_only
+    v.write_needle(Needle(cookie=2, id=2, data=b"yes"))
+    st.close()

@@ -219,12 +219,14 @@ def test_sigv4_auth_required():
     k = _h(k, "aws4_request")
     sig = hmac_mod.new(k, sts.encode(), hashlib.sha256).hexdigest()
 
-    class FakeRequest:
+    class FakeRequest(dict):
+        # dict base: _check_auth stashes the sigv4 context on the request
         method = "GET"
         path = "/"
         query = FakeQuery()
 
         def __init__(self, hdrs):
+            super().__init__()
             self.headers = hdrs
 
     good = FakeRequest({**{k.title(): v for k, v in headers.items()},
