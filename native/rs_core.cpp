@@ -16,6 +16,7 @@
 #include <cstddef>
 #include <cstdint>
 #include <cstring>
+#include <mutex>
 
 #if defined(__AVX2__)
 #include <immintrin.h>
@@ -120,10 +121,9 @@ void gf_matrix_apply(const uint8_t* matrix, int rows, int cols,
 // ---- CRC32C (Castagnoli), slice-by-8, matching Go crc32.Update semantics ----
 
 static uint32_t crc32c_table[8][256];
-static bool crc32c_init_done = false;
+static std::once_flag crc32c_once;
 
-static void crc32c_init() {
-    if (crc32c_init_done) return;
+static void crc32c_fill() {
     const uint32_t poly = 0x82F63B78u;  // reflected Castagnoli
     for (uint32_t i = 0; i < 256; i++) {
         uint32_t crc = i;
@@ -139,8 +139,11 @@ static void crc32c_init() {
             crc32c_table[k][i] = crc;
         }
     }
-    crc32c_init_done = true;
 }
+
+// concurrent first use must not race the table fill (TSAN-checked by
+// native/tsan_check.cpp)
+static void crc32c_init() { std::call_once(crc32c_once, crc32c_fill); }
 
 uint32_t crc32c_update(uint32_t crc, const uint8_t* data, size_t n) {
     crc32c_init();

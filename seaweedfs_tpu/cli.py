@@ -56,7 +56,9 @@ def cmd_master(args) -> None:
         guard=_load_guard(),
         url=url,
         peers=peers or None,
-        raft_state_dir=args.mdir or None))
+        raft_state_dir=args.mdir or None,
+        grpc_port=(args.port + 10000 if args.grpc_port < 0
+                   else args.grpc_port)))
 
 
 def cmd_volume(args) -> None:
@@ -74,7 +76,8 @@ def cmd_volume(args) -> None:
     _run_forever(run_volume_server(
         args.ip, args.port, store, args.mserver,
         data_center=args.data_center, rack=args.rack,
-        pulse_seconds=args.pulse, guard=_load_guard()))
+        pulse_seconds=args.pulse, guard=_load_guard(),
+        use_grpc_heartbeat=args.grpc_heartbeat))
 
 
 def cmd_server(args) -> None:
@@ -458,7 +461,9 @@ def cmd_benchmark(args) -> None:
             if args.assign_batch > 1:
                 # assign?count=N reserves N sequential keys in one master
                 # round trip (the reference's batched assignment API);
-                # derived fids share the volume and cookie
+                # derived fids share the volume and cookie. Per-fid write
+                # JWTs cannot be derived client-side, so a guarded cluster
+                # falls back to per-file assigns.
                 from seaweedfs_tpu.storage.file_id import FileId
                 got = 0
                 while got < args.n:
@@ -466,6 +471,11 @@ def cmd_benchmark(args) -> None:
                     async with s.get(f"http://{master}/dir/assign",
                                      params={"count": str(want)}) as r:
                         a = await r.json()
+                    if a.get("auth"):
+                        print("jwt-guarded cluster: falling back to "
+                              "per-file assigns")
+                        pres = [None] * args.n
+                        break
                     base = FileId.parse(a["fid"])
                     for j in range(want):
                         pres[got + j] = (str(FileId(
@@ -562,6 +572,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-vmodule", default="",
                    help="per-file verbosity, e.g. volume=2,store=4")
     p.add_argument("-logFile", default="", dest="log_file")
+    p.add_argument("-cpuprofile", default="",
+                   help="write a cProfile dump here at exit "
+                        "(grace.SetupProfiling analog)")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     m = sub.add_parser("master", help="run a master server")
@@ -574,6 +587,9 @@ def build_parser() -> argparse.ArgumentParser:
                         " for raft HA (weed master -peers)")
     m.add_argument("-mdir", default="",
                    help="directory for persisted raft state")
+    m.add_argument("-grpc_port", type=int, default=-1,
+                   help="gRPC control-plane port (default HTTP+10000; "
+                        "0 disables)")
     m.set_defaults(fn=cmd_master)
 
     v = sub.add_parser("volume", help="run a volume server")
@@ -590,6 +606,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="needle map kind (weed volume -index)")
     v.add_argument("-minFreeSpacePercent", dest="min_free_space_percent",
                    type=float, default=1.0)
+    v.add_argument("-grpc_heartbeat", action="store_true",
+                   help="stream heartbeats over gRPC instead of HTTP "
+                        "polling")
     v.add_argument("-ec_large_block", type=int, default=1024 * 1024 * 1024)
     v.add_argument("-ec_small_block", type=int, default=1024 * 1024)
     v.set_defaults(fn=cmd_volume)
@@ -774,6 +793,9 @@ def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
     from .utils import glog
     glog.setup(args.verbosity, args.vmodule, args.log_file)
+    if args.cpuprofile:
+        from .utils.profiling import setup_cpu_profile
+        setup_cpu_profile(args.cpuprofile)
     args.fn(args)
 
 

@@ -197,7 +197,9 @@ def _run_pipeline(batches: Iterator[np.ndarray], dispatch, consume,
             if batch is _SENTINEL:
                 drained = True
                 break
-            handle = dispatch(batch)
+            from ..utils.profiling import trace_annotation
+            with trace_annotation("ec_pipeline_dispatch"):
+                handle = dispatch(batch)
             # kick the device->host copy off immediately so it overlaps the
             # next batch's H2D + kernel instead of starting at materialize
             # time (matters most when the transfer link is the bottleneck)

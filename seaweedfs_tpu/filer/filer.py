@@ -175,8 +175,29 @@ class Filer:
                 if old.is_directory and not entry.is_directory:
                     raise IsADirectoryError(entry.full_path)
             self.store.insert_entry(entry)
+            # hard-link bookkeeping (filerstore_hardlink.go): a KV counter
+            # per link id decides when shared chunks may be freed; an
+            # overwrite that changes/clears the link id drops the old
+            # group's reference
+            if old is not None and old.hard_link_id and \
+                    old.hard_link_id != entry.hard_link_id:
+                self._bump_hardlink(old.hard_link_id, -1)
+            if entry.hard_link_id and \
+                    (old is None or old.hard_link_id != entry.hard_link_id):
+                self._bump_hardlink(entry.hard_link_id, +1)
         self._notify(entry.parent, old, entry, signatures=signatures)
         return entry
+
+    def _bump_hardlink(self, hlid: str, delta: int) -> int:
+        key = f"hardlink/{hlid}"
+        raw = self.store.kv_get(key)
+        n = (int(raw) if raw else 0) + delta
+        if n <= 0:
+            # kv face has no delete; zero means gone
+            self.store.kv_put(key, b"0")
+            return 0
+        self.store.kv_put(key, str(n).encode())
+        return n
 
     def _ensure_parents(self, dir_path: str) -> None:
         if dir_path in ("", "/"):
@@ -199,6 +220,11 @@ class Filer:
             if old is None:
                 raise FileNotFoundError(entry.full_path)
             self.store.update_entry(entry)
+            if old.hard_link_id and old.hard_link_id != entry.hard_link_id:
+                self._bump_hardlink(old.hard_link_id, -1)
+            if entry.hard_link_id and \
+                    old.hard_link_id != entry.hard_link_id:
+                self._bump_hardlink(entry.hard_link_id, +1)
         self._notify(entry.parent, old, entry, signatures=signatures)
         return entry
 
@@ -235,7 +261,12 @@ class Filer:
                     self._collect_chunks_recursive(path, freed)
                 self.store.delete_folder_children(path)
             elif free_chunks:
-                freed.extend(entry.chunks)
+                if entry.hard_link_id:
+                    # shared chunks are freed only with the last link
+                    if self._bump_hardlink(entry.hard_link_id, -1) == 0:
+                        freed.extend(entry.chunks)
+                else:
+                    freed.extend(entry.chunks)
             self.store.delete_entry(path)
         if freed:
             self.on_delete_chunks(freed)
@@ -253,6 +284,9 @@ class Filer:
             for e in batch:
                 if e.is_directory:
                     self._collect_chunks_recursive(e.full_path, out)
+                elif e.hard_link_id:
+                    if self._bump_hardlink(e.hard_link_id, -1) == 0:
+                        out.extend(e.chunks)
                 else:
                     out.extend(e.chunks)
             if len(batch) < 1024:

@@ -471,6 +471,73 @@ class WFS:
         self.client.create_entry(entry)
         self.meta_cache.invalidate(path)
 
+    # --- extended attributes (weed/filesys/xattr.go; stored in the
+    #     entry's extended map) ---
+    def setxattr(self, path: str, name: str, value: bytes) -> None:
+        import base64
+        path = _norm(path)
+        entry = self.lookup(path)
+        if entry is None:
+            raise FuseError(2, path)
+        entry = dict(entry)
+        extended = dict(entry.get("extended") or {})
+        extended["xattr-" + name] = base64.b64encode(value).decode()
+        entry["extended"] = extended
+        self.client.update_entry(entry)
+        self.meta_cache.invalidate(path)
+
+    def getxattr(self, path: str, name: str) -> bytes:
+        import base64
+        entry = self.lookup(_norm(path))
+        if entry is None:
+            raise FuseError(2, path)
+        raw = (entry.get("extended") or {}).get("xattr-" + name)
+        if raw is None:
+            raise FuseError(61, name)  # ENODATA
+        return base64.b64decode(raw)
+
+    def listxattr(self, path: str) -> list[str]:
+        entry = self.lookup(_norm(path))
+        if entry is None:
+            raise FuseError(2, path)
+        return [k[len("xattr-"):] for k in (entry.get("extended") or {})
+                if k.startswith("xattr-")]
+
+    def removexattr(self, path: str, name: str) -> None:
+        path = _norm(path)
+        entry = self.lookup(path)
+        if entry is None:
+            raise FuseError(2, path)
+        extended = dict(entry.get("extended") or {})
+        if extended.pop("xattr-" + name, None) is None:
+            raise FuseError(61, name)
+        entry = dict(entry)
+        entry["extended"] = extended
+        self.client.update_entry(entry)
+        self.meta_cache.invalidate(path)
+
+    # --- hard links (weed/filer/filerstore_hardlink.go: linked entries
+    #     share a hard_link_id and the chunk list rides it) ---
+    def link(self, target: str, link_path: str) -> None:
+        target, link_path = _norm(target), _norm(link_path)
+        entry = self.lookup(target)
+        if entry is None:
+            raise FuseError(2, target)
+        if entry.get("attr", {}).get("mode", 0) & 0o040000:
+            raise FuseError(1, "cannot hardlink directories")  # EPERM
+        entry = dict(entry)
+        hlid = entry.get("hard_link_id")
+        if not hlid:
+            import uuid as uuid_mod
+            hlid = uuid_mod.uuid4().hex
+            entry["hard_link_id"] = hlid
+            self.client.update_entry(entry)
+        link_entry = dict(entry)
+        link_entry["path"] = link_path
+        self.client.create_entry(link_entry, free_old_chunks=False)
+        self.meta_cache.invalidate(link_path)
+        self.meta_cache.invalidate(target)
+
     def statfs(self) -> dict:
         return {"bsize": 1024 * 1024, "blocks": 1 << 30, "bfree": 1 << 30}
 

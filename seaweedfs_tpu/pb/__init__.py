@@ -1,0 +1,11 @@
+"""Protobuf wire layer (proto/master.proto compiled by protoc).
+
+The generated module references itself by its bare name, so the package
+path is extended for the import to resolve.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+from . import master_pb2  # noqa: E402,F401

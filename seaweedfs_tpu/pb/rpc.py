@@ -1,0 +1,104 @@
+"""Hand-rolled gRPC service plumbing for the Master service.
+
+The image ships grpcio + protoc but not grpc_tools, so instead of
+generated *_pb2_grpc stubs the service is registered through gRPC's
+generic-handler API and the client uses multicallables with explicit
+serializers — byte-identical on the wire to what generated stubs produce.
+
+Port convention: gRPC listens on HTTP port + 10000
+(weed/pb/grpc_client_server.go).
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from . import master_pb2 as pb
+
+SERVICE = "seaweedfs_tpu.master.Master"
+GRPC_PORT_OFFSET = 10000
+
+
+def grpc_address(http_url: str) -> str:
+    """host:port -> host:(port+10000)."""
+    host, _, port = http_url.rpartition(":")
+    return f"{host}:{int(port) + GRPC_PORT_OFFSET}"
+
+
+def master_service_handler(servicer) -> grpc.GenericRpcHandler:
+    """Bind a servicer object (async methods named like the RPCs) into a
+    generic handler grpc.aio can serve."""
+    def uu(method, req, resp):
+        return grpc.unary_unary_rpc_method_handler(
+            method, request_deserializer=req.FromString,
+            response_serializer=resp.SerializeToString)
+
+    def us(method, req, resp):
+        return grpc.unary_stream_rpc_method_handler(
+            method, request_deserializer=req.FromString,
+            response_serializer=resp.SerializeToString)
+
+    def ss(method, req, resp):
+        return grpc.stream_stream_rpc_method_handler(
+            method, request_deserializer=req.FromString,
+            response_serializer=resp.SerializeToString)
+
+    handlers = {
+        "Assign": uu(servicer.Assign, pb.AssignRequest, pb.AssignResponse),
+        "Lookup": uu(servicer.Lookup, pb.LookupRequest, pb.LookupResponse),
+        "LookupEc": uu(servicer.LookupEc, pb.LookupEcRequest,
+                       pb.LookupEcResponse),
+        "Heartbeat": ss(servicer.Heartbeat, pb.HeartbeatRequest,
+                        pb.HeartbeatResponse),
+        "KeepConnected": us(servicer.KeepConnected, pb.KeepConnectedRequest,
+                            pb.VolumeLocationMessage),
+        "ClusterStatus": uu(servicer.ClusterStatus, pb.ClusterStatusRequest,
+                            pb.ClusterStatusResponse),
+        "LeaseAdminToken": uu(servicer.LeaseAdminToken,
+                              pb.LeaseAdminTokenRequest,
+                              pb.LeaseAdminTokenResponse),
+        "ReleaseAdminToken": uu(servicer.ReleaseAdminToken,
+                                pb.ReleaseAdminTokenRequest,
+                                pb.ReleaseAdminTokenResponse),
+    }
+    return grpc.method_handlers_generic_handler(SERVICE, handlers)
+
+
+class MasterStub:
+    """Client multicallables (what a generated stub would contain)."""
+
+    def __init__(self, channel):
+        def uu(name, req, resp):
+            return channel.unary_unary(
+                f"/{SERVICE}/{name}",
+                request_serializer=req.SerializeToString,
+                response_deserializer=resp.FromString)
+
+        def us(name, req, resp):
+            return channel.unary_stream(
+                f"/{SERVICE}/{name}",
+                request_serializer=req.SerializeToString,
+                response_deserializer=resp.FromString)
+
+        def ss(name, req, resp):
+            return channel.stream_stream(
+                f"/{SERVICE}/{name}",
+                request_serializer=req.SerializeToString,
+                response_deserializer=resp.FromString)
+
+        self.Assign = uu("Assign", pb.AssignRequest, pb.AssignResponse)
+        self.Lookup = uu("Lookup", pb.LookupRequest, pb.LookupResponse)
+        self.LookupEc = uu("LookupEc", pb.LookupEcRequest,
+                           pb.LookupEcResponse)
+        self.Heartbeat = ss("Heartbeat", pb.HeartbeatRequest,
+                            pb.HeartbeatResponse)
+        self.KeepConnected = us("KeepConnected", pb.KeepConnectedRequest,
+                                pb.VolumeLocationMessage)
+        self.ClusterStatus = uu("ClusterStatus", pb.ClusterStatusRequest,
+                                pb.ClusterStatusResponse)
+        self.LeaseAdminToken = uu("LeaseAdminToken",
+                                  pb.LeaseAdminTokenRequest,
+                                  pb.LeaseAdminTokenResponse)
+        self.ReleaseAdminToken = uu("ReleaseAdminToken",
+                                    pb.ReleaseAdminTokenRequest,
+                                    pb.ReleaseAdminTokenResponse)

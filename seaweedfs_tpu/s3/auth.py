@@ -175,12 +175,12 @@ def verify_post_policy(fields: dict, iam: Iam) -> tuple[bool, str]:
         return False, "unreadable policy"
     exp = policy.get("expiration", "")
     try:
-        deadline = time.mktime(time.strptime(
+        import calendar
+        deadline = calendar.timegm(time.strptime(
             exp.split(".")[0].rstrip("Z"), "%Y-%m-%dT%H:%M:%S"))
     except ValueError:
         return False, "bad expiration"
-    # expiration is UTC
-    if time.time() > deadline - time.timezone:
+    if time.time() > deadline:
         return False, "policy expired"
     for cond in policy.get("conditions", []):
         if isinstance(cond, dict):

@@ -684,8 +684,21 @@ class S3Server:
         all_chunks = []
         offset = 0
         for p in parts:
-            for c in p.get("chunks", []):
-                all_chunks.append({**c, "offset": offset + c["offset"]})
+            chunks = p.get("chunks", [])
+            if any(c.get("is_chunk_manifest") for c in chunks):
+                # super-chunked part: flatten through the filer so nested
+                # offsets shift correctly and the manifest blobs are freed
+                status, body = await self._meta_get(
+                    "resolve_chunks", {"path": p["path"],
+                                       "shift": str(offset),
+                                       "free_manifests": "true"})
+                if status != 200:
+                    return _error("InternalError",
+                                  "part manifest resolution failed", 500)
+                all_chunks.extend(body["chunks"])
+            else:
+                for c in chunks:
+                    all_chunks.append({**c, "offset": offset + c["offset"]})
             offset += _entry_size(p)
         final_path = self._obj_path(bucket, key)
         status, _ = await self._meta("create_entry", {"entry": {

@@ -104,6 +104,9 @@ class FilerServer:
         app = web.Application(client_max_size=1024 * 1024 * 1024)
         app.router.add_get("/healthz", _healthz)
         app.router.add_get("/metrics", self.metrics_handler)
+        from ..utils.profiling import profile_handler
+        app.router.add_get("/debug/profile", profile_handler())
+        app.router.add_get("/ui", self.status_ui)
         # entry-level meta API: the JSON face of the reference's filer gRPC
         # (weed/pb/filer.proto LookupDirectoryEntry/ListEntries/CreateEntry/
         # UpdateEntry/DeleteEntry/AtomicRenameEntry) — used by gateways (S3)
@@ -118,6 +121,8 @@ class FilerServer:
         app.router.add_get("/__meta__/info", self.meta_info)
         app.router.add_get("/__meta__/assign", self.meta_assign)
         app.router.add_get("/__meta__/lookup_volume", self.meta_lookup_volume)
+        app.router.add_get("/__meta__/resolve_chunks",
+                           self.meta_resolve_chunks)
         app.router.add_route("*", "/{path:.*}", self.dispatch)
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
@@ -405,6 +410,35 @@ class FilerServer:
             return
         for c in chunks:
             self._loop.call_soon_threadsafe(self._delete_queue.put_nowait, c)
+
+    async def meta_resolve_chunks(self, request: web.Request
+                                  ) -> web.Response:
+        """Fully resolved data-chunk list of an entry, offsets shifted by
+        ?shift=N. With ?free_manifests=true the manifest blobs themselves
+        are queued for deletion (their data chunks live on — used by
+        multipart complete, which flattens part chunk lists)."""
+        entry = await asyncio.get_event_loop().run_in_executor(
+            None, self.filer.find_entry, request.query.get("path", ""))
+        if entry is None:
+            return web.json_response({"error": "not found"}, status=404)
+        shift = int(request.query.get("shift", 0))
+        resolved = entry.chunks
+        manifests = [c for c in entry.chunks if c.is_chunk_manifest]
+        if manifests:
+            resolved = await manifest_mod.resolve_manifests(
+                entry.chunks, self._fetch_manifest_blob)
+            if request.query.get("free_manifests") == "true":
+                # delete only the blobs: strip the manifest flag so the
+                # deletion worker doesn't cascade into the data chunks
+                self._queue_chunk_deletes([
+                    FileChunk(fid=m.fid, offset=0, size=m.size)
+                    for m in manifests])
+        out = []
+        for c in resolved:
+            d = c.to_dict()
+            d["offset"] += shift
+            out.append(d)
+        return web.json_response({"chunks": out})
 
     async def _fetch_manifest_blob(self, chunk: FileChunk) -> bytes:
         """Fetch (and decrypt) a manifest chunk's blob."""
@@ -815,6 +849,17 @@ class FilerServer:
     async def metrics_handler(self, request: web.Request) -> web.Response:
         return web.Response(text=self.metrics.render(),
                             content_type="text/plain")
+
+    async def status_ui(self, request: web.Request) -> web.Response:
+        """Status page (weed/server/filer_ui/)."""
+        from ..utils.status_ui import render_status
+        return web.Response(
+            text=render_status("seaweedfs-tpu filer", {
+                "store": self.filer.store.name,
+                "masters": self.masters,
+                "cipher": self.cipher,
+                "metrics": self.metrics.render(),
+            }), content_type="text/html")
 
 
 async def run_filer(host: str, port: int, master_url: str,

@@ -36,7 +36,8 @@ log = logging.getLogger("master")
 # (/cluster/watch is local because it streams: followers 307-redirect to the
 # leader instead of buffering the stream through the proxy)
 _LOCAL_PATHS = ("/healthz", "/metrics", "/cluster/status", "/cluster/watch",
-                "/cluster/raft/vote", "/cluster/raft/append")
+                "/cluster/raft/vote", "/cluster/raft/append",
+                "/ui", "/debug/profile")
 
 
 async def _healthz(request: "web.Request") -> "web.Response":
@@ -54,7 +55,8 @@ class MasterServer:
                  peers: Optional[list[str]] = None,
                  raft_state_dir: Optional[str] = None,
                  election_timeout: tuple[float, float] = (0.3, 0.6),
-                 raft_heartbeat: float = 0.1):
+                 raft_heartbeat: float = 0.1,
+                 grpc_port: int = 0):
         self.topology = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds)
@@ -86,6 +88,8 @@ class MasterServer:
         # follower->leader traffic must pass any configured IP whitelist
         self._peer_ips = {p.split(":")[0] for p in (peers or [])}
         self._proxy_session = None
+        self.grpc_port = grpc_port
+        self._grpc_server = None
         self.metrics = metrics_mod.Registry("master")
         self.app = self._build_app()
 
@@ -165,6 +169,9 @@ class MasterServer:
         app.router.add_post("/cluster/raft/append", self.raft_append)
         app.router.add_get("/metrics", self.metrics_handler)
         app.router.add_get("/healthz", _healthz)
+        from ..utils.profiling import profile_handler
+        app.router.add_get("/debug/profile", profile_handler())
+        app.router.add_get("/ui", self.status_ui)
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         return app
@@ -173,10 +180,18 @@ class MasterServer:
         await self.raft.start()
         if self.vacuum_interval_seconds > 0:
             self._vacuum_task = asyncio.create_task(self._vacuum_loop())
+        if self.grpc_port:
+            from .master_grpc import serve_master_grpc
+            host = (self.url.rsplit(":", 1)[0] if ":" in self.url
+                    else "0.0.0.0")
+            self._grpc_server = await serve_master_grpc(
+                self, host or "0.0.0.0", self.grpc_port)
 
     async def _on_cleanup(self, app) -> None:
         if self._vacuum_task:
             self._vacuum_task.cancel()
+        if self._grpc_server is not None:
+            await self._grpc_server.stop(grace=0.5)
         if self._proxy_session is not None:
             await self._proxy_session.close()
         await self.raft.stop()
@@ -246,12 +261,19 @@ class MasterServer:
             self.sequencer.set_max(self._key_bound)
             self._seq_synced_term = self.raft.term
         q = request.query
-        count = int(q.get("count", 1))
-        collection = q.get("collection", "")
-        replication = q.get("replication", self.default_replication)
-        ttl = q.get("ttl", "")
-        data_center = q.get("dataCenter", "")
+        resp, status = await self.assign_api(
+            count=int(q.get("count", 1)),
+            collection=q.get("collection", ""),
+            replication=q.get("replication", self.default_replication),
+            ttl=q.get("ttl", ""),
+            data_center=q.get("dataCenter", ""))
+        return web.json_response(resp, status=status)
 
+    async def assign_api(self, count: int = 1, collection: str = "",
+                         replication: str = "", ttl: str = "",
+                         data_center: str = "") -> tuple[dict, int]:
+        """Core assignment, shared by the HTTP and gRPC surfaces."""
+        replication = replication or self.default_replication
         picked = self.topology.pick_for_write(collection, replication, ttl)
         if picked is None:
             async with self._grow_lock:
@@ -261,18 +283,16 @@ class MasterServer:
                     grown = await self._grow(1, collection, replication, ttl,
                                              data_center)
                     if grown is None:
-                        return web.json_response(
-                            {"error": "lost leadership during grow"},
-                            status=503)
+                        return ({"error": "lost leadership during grow"},
+                                503)
                     if not grown:
-                        return web.json_response(
-                            {"error": "no writable volumes and cannot grow"},
-                            status=500)
+                        return ({"error":
+                                 "no writable volumes and cannot grow"},
+                                500)
                     picked = self.topology.pick_for_write(
                         collection, replication, ttl)
         if picked is None:
-            return web.json_response({"error": "no writable volumes"},
-                                     status=500)
+            return {"error": "no writable volumes"}, 500
         vid, nodes = picked
         key = self.sequencer.next_file_id(count)
         # never hand out keys beyond the raft-committed ceiling: a failover
@@ -280,8 +300,7 @@ class MasterServer:
         if key + count > self._key_bound:
             bound = key + count + self._key_bound_step
             if not await self.raft.propose({"max_file_key": bound}):
-                return web.json_response(
-                    {"error": "lost leadership during assign"}, status=503)
+                return {"error": "lost leadership during assign"}, 503
         fid = FileId(vid, key, new_cookie())
         node = nodes[0]
         resp = {
@@ -296,7 +315,7 @@ class MasterServer:
         auth = self.guard.sign_write(str(fid))
         if auth:
             resp["auth"] = auth
-        return web.json_response(resp)
+        return resp, 200
 
     async def dir_lookup(self, request: web.Request) -> web.Response:
         q = request.query
@@ -523,6 +542,11 @@ class MasterServer:
                ec_shards: [...]}."""
         self.metrics.count("heartbeat")
         body = await request.json()
+        return web.json_response(self.apply_heartbeat(body))
+
+    def apply_heartbeat(self, body: dict) -> dict:
+        """Fold one heartbeat into the topology and push location deltas —
+        shared by the HTTP poll handler and the gRPC bidi stream."""
         event = self.topology.register_heartbeat(
             node_id=body["node_id"],
             url=body["url"],
@@ -536,10 +560,10 @@ class MasterServer:
         self._broadcast_location(event)
         for ev in self.topology.prune_dead_nodes():
             self._broadcast_location(ev)
-        return web.json_response({
+        return {
             "volume_size_limit": self.topology.volume_size_limit,
             "leader": self.raft.leader_id or "",
-        })
+        }
 
     # --- KeepConnected push (weed/server/master_grpc_server.go:178-233,
     #     wdclient/masterclient.go) ---
@@ -640,6 +664,19 @@ class MasterServer:
     async def metrics_handler(self, request: web.Request) -> web.Response:
         return web.Response(text=self.metrics.render(),
                             content_type="text/plain")
+
+    async def status_ui(self, request: web.Request) -> web.Response:
+        """Status page (weed/server/master_ui/)."""
+        from ..utils.status_ui import render_status
+        return web.Response(
+            text=render_status(f"seaweedfs-tpu master {self.url}", {
+                "raft": {"is_leader": self.raft.is_leader,
+                         "leader": self.raft.leader_id,
+                         "term": self.raft.term,
+                         "peers": self.raft.peers},
+                "topology": self.topology.to_dict(),
+                "metrics": self.metrics.render(),
+            }), content_type="text/html")
 
 
 async def run_master(host: str, port: int, **kwargs) -> web.AppRunner:

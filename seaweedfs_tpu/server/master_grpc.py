@@ -1,0 +1,232 @@
+"""gRPC face of the master (role of weed/server/master_grpc_server.go).
+
+Serves the Master service from proto/master.proto on HTTP port + 10000:
+assign/lookup, the bidirectional heartbeat stream (a dropped stream
+unregisters the node and broadcasts its DeletedVids immediately —
+master_grpc_server.go:22-49), KeepConnected location push, and the admin
+lease. All handlers delegate to the same MasterServer internals the
+HTTP surface uses.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+import grpc
+
+from ..ec import shard_bits
+from ..pb import master_pb2 as pb
+from ..pb.rpc import master_service_handler
+
+log = logging.getLogger("master.grpc")
+
+
+def _hb_to_dict(req: pb.HeartbeatRequest) -> dict:
+    return {
+        "node_id": req.node_id,
+        "url": req.url,
+        "public_url": req.public_url or req.url,
+        "data_center": req.data_center,
+        "rack": req.rack,
+        "max_volume_count": req.max_volume_count or 8,
+        "max_file_key": req.max_file_key,
+        "volumes": [{
+            "id": v.id, "collection": v.collection, "size": v.size,
+            "file_count": v.file_count, "delete_count": v.delete_count,
+            "deleted_bytes": v.deleted_bytes, "read_only": v.read_only,
+            "replica_placement": v.replica_placement or "000",
+            "ttl": v.ttl, "version": v.version or 3,
+        } for v in req.volumes],
+        "ec_shards": [{
+            "id": s.id, "collection": s.collection,
+            "shard_ids": shard_bits.to_ids(s.ec_index_bits),
+            "shard_size": s.shard_size,
+        } for s in req.ec_shards],
+    }
+
+
+def heartbeat_to_pb(payload: dict) -> pb.HeartbeatRequest:
+    """Store heartbeat dict -> wire message (client side)."""
+    return pb.HeartbeatRequest(
+        node_id=payload["node_id"],
+        url=payload["url"],
+        public_url=payload.get("public_url", ""),
+        data_center=payload.get("data_center", ""),
+        rack=payload.get("rack", ""),
+        max_volume_count=payload.get("max_volume_count", 8),
+        max_file_key=payload.get("max_file_key", 0),
+        volumes=[pb.VolumeInformation(
+            id=v["id"], collection=v.get("collection", ""),
+            size=v.get("size", 0), file_count=v.get("file_count", 0),
+            delete_count=v.get("delete_count", 0),
+            deleted_bytes=v.get("deleted_bytes", 0),
+            read_only=v.get("read_only", False),
+            replica_placement=str(v.get("replica_placement", "000")),
+            ttl=str(v.get("ttl", "")), version=v.get("version", 3),
+        ) for v in payload.get("volumes", [])],
+        ec_shards=[pb.EcShardInformation(
+            id=s["id"], collection=s.get("collection", ""),
+            ec_index_bits=shard_bits.from_ids(s.get("shard_ids", [])),
+            shard_size=s.get("shard_size", 0),
+        ) for s in payload.get("ec_shards", [])])
+
+
+class MasterGrpcServicer:
+    def __init__(self, master):
+        self.master = master
+
+    async def Assign(self, request: pb.AssignRequest, context):
+        if not await self.master.raft.ensure_ready():
+            return pb.AssignResponse(error="not the leader / not ready")
+        if self.master._seq_synced_term != self.master.raft.term:
+            self.master.sequencer.set_max(self.master._key_bound)
+            self.master._seq_synced_term = self.master.raft.term
+        resp, status = await self.master.assign_api(
+            count=request.count or 1,
+            collection=request.collection,
+            replication=request.replication,
+            ttl=request.ttl,
+            data_center=request.data_center)
+        if status != 200:
+            return pb.AssignResponse(error=resp.get("error", "failed"))
+        return pb.AssignResponse(
+            fid=resp["fid"], url=resp["url"],
+            public_url=resp["publicUrl"], count=resp["count"],
+            auth=resp.get("auth", ""), replicas=resp.get("replicas", []))
+
+    async def Lookup(self, request: pb.LookupRequest, context):
+        master = self.master
+        if request.file_id:
+            from ..storage.file_id import FileId
+            try:
+                fid = FileId.parse(request.file_id)
+            except ValueError:
+                return pb.LookupResponse(error="invalid fileId")
+            vid = fid.volume_id
+            auth = (master.guard.sign_read(str(fid))
+                    if master.guard.read_signing_key else "")
+        else:
+            vid = request.volume_id
+            auth = ""
+        nodes = master.topology.lookup(vid, request.collection)
+        if nodes:
+            return pb.LookupResponse(
+                volume_id=vid, auth=auth,
+                locations=[pb.Location(url=n.url, public_url=n.public_url)
+                           for n in nodes])
+        shards = master.topology.lookup_ec_shards(vid)
+        if shards:
+            seen, locs = set(), []
+            for nlist in shards.values():
+                for n in nlist:
+                    if n.url not in seen:
+                        seen.add(n.url)
+                        locs.append(pb.Location(url=n.url,
+                                                public_url=n.public_url))
+            return pb.LookupResponse(volume_id=vid, ec=True, auth=auth,
+                                     locations=locs)
+        return pb.LookupResponse(volume_id=vid, error="volume not found")
+
+    async def LookupEc(self, request: pb.LookupEcRequest, context):
+        shards = self.master.topology.lookup_ec_shards(request.volume_id)
+        if not shards:
+            return pb.LookupEcResponse(volume_id=request.volume_id,
+                                       error="ec volume not found")
+        return pb.LookupEcResponse(
+            volume_id=request.volume_id,
+            shards=[pb.EcShardLocations(
+                shard_id=sid,
+                locations=[pb.Location(url=n.url, public_url=n.public_url)
+                           for n in nodes])
+                    for sid, nodes in sorted(shards.items())])
+
+    async def Heartbeat(self, request_iterator, context):
+        """Bidi heartbeat stream: beats up, config down; a dropped stream
+        unregisters the node immediately and pushes its DeletedVids."""
+        master = self.master
+        node_id: Optional[str] = None
+        try:
+            async for req in request_iterator:
+                body = _hb_to_dict(req)
+                node_id = body["node_id"]
+                out = master.apply_heartbeat(body)
+                yield pb.HeartbeatResponse(
+                    volume_size_limit=out["volume_size_limit"],
+                    leader=out["leader"])
+        finally:
+            if node_id is not None:
+                ev = master.topology.unregister_node(node_id)
+                master._broadcast_location(ev)
+                log.info("heartbeat stream from %s closed; unregistered",
+                         node_id)
+
+    async def KeepConnected(self, request: pb.KeepConnectedRequest,
+                            context):
+        master = self.master
+        if not master.raft.is_leader:
+            yield pb.VolumeLocationMessage(
+                leader=master.raft.leader_id or "")
+            return
+        q: asyncio.Queue = asyncio.Queue()
+        master._watchers.add(q)
+        try:
+            for node in master.topology.nodes.values():
+                vids = sorted(set(node.volumes) | set(node.ec_shards))
+                yield pb.VolumeLocationMessage(
+                    url=node.url, public_url=node.public_url,
+                    new_vids=vids, is_snapshot=True,
+                    leader=master.raft.leader_id or "")
+            while True:
+                msg = await q.get()
+                yield pb.VolumeLocationMessage(
+                    url=msg.get("url", ""),
+                    public_url=msg.get("public_url", ""),
+                    new_vids=msg.get("new_vids", []),
+                    deleted_vids=msg.get("deleted_vids", []),
+                    leader=master.raft.leader_id or "")
+        finally:
+            master._watchers.discard(q)
+
+    async def ClusterStatus(self, request, context):
+        raft = self.master.raft
+        return pb.ClusterStatusResponse(
+            is_leader=raft.is_leader, leader=raft.leader_id or "",
+            peers=raft.peers, raft_term=raft.term)
+
+    async def LeaseAdminToken(self, request, context):
+        import time as time_mod
+        master = self.master
+        now = time_mod.time()
+        held = master._admin_locks.get(request.name or "admin")
+        if held and held[2] > now and held[0] != request.previous_token:
+            return pb.LeaseAdminTokenResponse(
+                error=f"lock held by {held[1]}")
+        token = (held[0] if held and held[0] == request.previous_token
+                 else int(now * 1e9))
+        expires = now + master.admin_lease_seconds
+        master._admin_locks[request.name or "admin"] = (
+            token, request.client, expires)
+        return pb.LeaseAdminTokenResponse(token=token, expires_at=expires)
+
+    async def ReleaseAdminToken(self, request, context):
+        master = self.master
+        name = request.name or "admin"
+        held = master._admin_locks.get(name)
+        if held and held[0] == request.token:
+            del master._admin_locks[name]
+            return pb.ReleaseAdminTokenResponse(ok=True)
+        return pb.ReleaseAdminTokenResponse(ok=False)
+
+
+async def serve_master_grpc(master, host: str, port: int):
+    """Start the grpc.aio server; returns it (caller stops with
+    .stop())."""
+    server = grpc.aio.server()
+    server.add_generic_rpc_handlers(
+        (master_service_handler(MasterGrpcServicer(master)),))
+    server.add_insecure_port(f"{host}:{port}")
+    await server.start()
+    log.info("master gRPC on %s:%d", host, port)
+    return server

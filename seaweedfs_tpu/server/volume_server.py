@@ -53,6 +53,28 @@ async def _healthz(request: "web.Request") -> "web.Response":
     return web.json_response({"ok": True})
 
 
+def _resize_image(data: bytes, mime: str, width: int, height: int,
+                  mode: str) -> bytes:
+    """Resize an image payload (weed/images/resizing.go): 'fit' keeps the
+    aspect ratio inside the box, 'fill' crops to exactly fill it."""
+    import io
+
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(data))
+    fmt = img.format or mime.split("/")[-1].upper()
+    w = width or img.width
+    h = height or img.height
+    if mode == "fill":
+        from PIL import ImageOps
+        img = ImageOps.fit(img, (w, h))
+    else:
+        img.thumbnail((w, h))
+    out = io.BytesIO()
+    img.save(out, format=fmt)
+    return out.getvalue()
+
+
 class WriteBatcher:
     """Per-volume async write coalescing — the server half of the
     reference's batching worker (volume_read_write.go:297-327): up to 128
@@ -118,7 +140,13 @@ class VolumeServer:
     def __init__(self, store: Store, master_url: str, url: str,
                  public_url: str = "", data_center: str = "", rack: str = "",
                  pulse_seconds: float = 5.0, read_redirect: bool = False,
-                 guard: Optional[Guard] = None):
+                 guard: Optional[Guard] = None,
+                 use_grpc_heartbeat: bool = False,
+                 master_grpc_target: str = ""):
+        self.use_grpc_heartbeat = use_grpc_heartbeat
+        # explicit gRPC endpoint override; default follows the
+        # HTTP-port+10000 convention (grpc_client_server.go)
+        self.master_grpc_target = master_grpc_target
         self.store = store
         # master_url may be a comma-separated HA list; heartbeats follow the
         # raft leader hint and rotate on failure
@@ -193,6 +221,9 @@ class VolumeServer:
         app.router.add_get("/status", self.status)
         app.router.add_get("/metrics", self.metrics_handler)
         app.router.add_get("/healthz", _healthz)
+        from ..utils.profiling import profile_handler
+        app.router.add_get("/debug/profile", profile_handler())
+        app.router.add_get("/ui", self.status_ui)
         app.router.add_route("*", "/{fid:[^{}]*}", self.data_handler)
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
@@ -216,31 +247,31 @@ class VolumeServer:
     async def _heartbeat_loop(self) -> None:
         while True:
             try:
-                expired = await asyncio.get_event_loop().run_in_executor(
-                    None, self.store.delete_expired_volumes)
-                if expired:
-                    log.info("deleted expired TTL volumes %s", expired)
-                # min-free-space watchdog: volumes on a filling disk seal
-                # themselves readonly before the disk is full
-                # (disk_location.go:304)
-                was_low = self.store.low_disk_space
-                low = await asyncio.get_event_loop().run_in_executor(
-                    None, self.store.check_free_space)
-                if low != was_low:
-                    log.warning("low disk space: %s", low)
+                await self._periodic_maintenance()
+                if self.use_grpc_heartbeat:
+                    # the bidi stream carries beats until it breaks; the
+                    # HTTP beat below is the fallback for that round
+                    await self._grpc_heartbeat_stream()
                 await self.send_heartbeat()
             except Exception as e:
                 log.warning("heartbeat to %s failed: %s", self.master_url, e)
                 self._rotate_master()
             await asyncio.sleep(self.pulse_seconds)
 
-    def _rotate_master(self) -> None:
-        if len(self.masters) > 1:
-            i = self.masters.index(self.master_url) \
-                if self.master_url in self.masters else 0
-            self.master_url = self.masters[(i + 1) % len(self.masters)]
+    async def _periodic_maintenance(self) -> None:
+        expired = await asyncio.get_event_loop().run_in_executor(
+            None, self.store.delete_expired_volumes)
+        if expired:
+            log.info("deleted expired TTL volumes %s", expired)
+        # min-free-space watchdog: volumes on a filling disk seal
+        # themselves readonly before the disk is full (disk_location.go:304)
+        was_low = self.store.low_disk_space
+        low = await asyncio.get_event_loop().run_in_executor(
+            None, self.store.check_free_space)
+        if low != was_low:
+            log.warning("low disk space: %s", low)
 
-    async def send_heartbeat(self) -> None:
+    def _hb_payload(self) -> dict:
         payload = self.store.heartbeat()
         payload.update({
             "node_id": self.url,
@@ -249,6 +280,74 @@ class VolumeServer:
             "data_center": self.data_center,
             "rack": self.rack,
         })
+        return payload
+
+    async def _grpc_heartbeat_stream(self) -> None:
+        """Hold the bidi gRPC heartbeat stream
+        (volume_grpc_client_to_master.go:50-222): full-state beats up
+        every pulse, volume-size-limit + leader hints down. Returns when
+        the stream breaks; the caller falls back to HTTP and retries."""
+        import grpc
+
+        from ..pb.rpc import MasterStub, grpc_address
+        from .master_grpc import heartbeat_to_pb
+
+        target = self.master_grpc_target or grpc_address(self.master_url)
+        stop = asyncio.Event()
+
+        async def beats():
+            while not stop.is_set():
+                await self._periodic_maintenance()
+                yield heartbeat_to_pb(self._hb_payload())
+                try:
+                    await asyncio.wait_for(stop.wait(), self.pulse_seconds)
+                except asyncio.TimeoutError:
+                    pass
+
+        async with grpc.aio.insecure_channel(target) as channel:
+            call = MasterStub(channel).Heartbeat(beats())
+            try:
+                async for resp in call:
+                    self.volume_size_limit = (resp.volume_size_limit
+                                              or self.volume_size_limit)
+                    leader = resp.leader
+                    if leader and leader not in ("self", self.master_url):
+                        log.info("grpc heartbeat: following leader %s",
+                                 leader)
+                        self.master_url = leader
+                        return  # redial the leader's gRPC port
+            finally:
+                stop.set()
+
+    def _rotate_master(self) -> None:
+        if len(self.masters) > 1:
+            i = self.masters.index(self.master_url) \
+                if self.master_url in self.masters else 0
+            self.master_url = self.masters[(i + 1) % len(self.masters)]
+
+    def _update_volume_gauges(self, payload: dict) -> None:
+        """Per-collection volume gauges (the reference's labeled
+        volumeServer gauges, weed/stats/metrics.go + store.go:40)."""
+        by_col: dict[str, list[int]] = {}
+        for v in payload.get("volumes", []):
+            agg = by_col.setdefault(v.get("collection", "") or "default",
+                                    [0, 0])
+            agg[0] += 1
+            agg[1] += v.get("size", 0)
+        for col, (n, size) in by_col.items():
+            self.metrics.gauge("volumes", n, labels={"collection": col,
+                                                     "type": "normal"})
+            self.metrics.gauge("volume_bytes", size,
+                               labels={"collection": col})
+        for s in payload.get("ec_shards", []):
+            self.metrics.gauge(
+                "ec_shards", len(s.get("shard_ids", [])),
+                labels={"collection": s.get("collection", "") or "default",
+                        "volume": str(s.get("id"))})
+
+    async def send_heartbeat(self) -> None:
+        payload = self._hb_payload()
+        self._update_volume_gauges(payload)
         async with self._session.post(
                 f"http://{self.master_url}/heartbeat", json=payload,
                 timeout=aiohttp.ClientTimeout(total=10)) as r:
@@ -328,6 +427,26 @@ class VolumeServer:
                 headers["Content-Encoding"] = "gzip"
             else:
                 body = compression.decompress(body)
+        # image resize on read (?width=&height=&mode=fit|fill,
+        # volume_server_handlers_read.go:240-272 via images.Resized);
+        # skipped when the body is being served gzip-encoded. Detection by
+        # mime or stored filename extension (the reference keys on ext).
+        is_image = mime.startswith("image/") or (
+            n.has(FLAG_HAS_NAME) and n.name
+            and n.name.lower().endswith((b".jpg", b".jpeg", b".png",
+                                         b".gif", b".webp")))
+        if (is_image
+                and "Content-Encoding" not in headers
+                and (request.query.get("width")
+                     or request.query.get("height"))):
+            try:
+                body = _resize_image(
+                    body, mime,
+                    int(request.query.get("width", 0)),
+                    int(request.query.get("height", 0)),
+                    request.query.get("mode", "fit"))
+            except Exception as e:
+                log.warning("image resize failed: %s", e)
         # range support
         rng = request.headers.get("Range")
         if rng and rng.startswith("bytes=") and \
@@ -433,10 +552,8 @@ class VolumeServer:
 
         with self.metrics.timed("write"):
             try:
-                result = await self._batcher.write(fid.volume_id, n)
-                if isinstance(result, Exception):
-                    raise result
-                _, size, unchanged = result
+                _, size, unchanged = await self._batcher.write(
+                    fid.volume_id, n)
             except KeyError:
                 return web.json_response({"error": "volume not found"},
                                          status=404)
@@ -1088,6 +1205,16 @@ class VolumeServer:
     async def metrics_handler(self, request: web.Request) -> web.Response:
         return web.Response(text=self.metrics.render(),
                             content_type="text/plain")
+
+    async def status_ui(self, request: web.Request) -> web.Response:
+        """Status page (weed/server/volume_server_ui/)."""
+        from ..utils.status_ui import render_status
+        return web.Response(
+            text=render_status(f"seaweedfs-tpu volume {self.url}", {
+                "store": self.store.status(),
+                "master": self.master_url,
+                "metrics": self.metrics.render(),
+            }), content_type="text/html")
 
 
 async def run_volume_server(host: str, port: int, store: Store,

@@ -112,8 +112,7 @@ class Volume:
 
     # --- write path ---
     def write_needle(self, n: Needle,
-                     preserve_append_at_ns: bool = False,
-                     _defer_flush: bool = False
+                     preserve_append_at_ns: bool = False
                      ) -> tuple[int, int, bool]:
         """Append a needle; returns (byte_offset, size, is_unchanged).
 
@@ -144,7 +143,7 @@ class Volume:
 
             if not (preserve_append_at_ns and n.append_at_ns):
                 n.append_at_ns = time.time_ns()
-            offset = self._append(n, flush=not _defer_flush)
+            offset = self._append(n)
             self.last_append_at_ns = n.append_at_ns
             if nv is None or t.stored_to_offset(nv.offset) < offset:
                 self.nm.put(n.id, t.offset_to_stored(offset), n.size)
@@ -175,31 +174,31 @@ class Volume:
             self.nm.delete(n.id, t.offset_to_stored(offset))
             return freed
 
-    def _append(self, n: Needle, flush: bool = True) -> int:
+    def _append(self, n: Needle) -> int:
         offset = self._append_offset
         if offset % t.NEEDLE_PADDING_SIZE != 0:
             offset += (-offset) % t.NEEDLE_PADDING_SIZE
         record = n.to_bytes(self.version)
+        # write_at is an unbuffered pwrite: the record reaches the kernel
+        # before the .idx journal entry is appended, so the index never
+        # references bytes that were not written (durability ordering)
         self._dat.write_at(record, offset)
-        if flush:
-            self._dat.flush()
         self._append_offset = offset + len(record)
         return offset
 
     def write_needles_batch(self, needles: list[Needle]
                             ) -> list[tuple[int, int, bool] | Exception]:
-        """Append many needles under one lock with a single flush — the
-        engine half of the reference's async write batching (<=128 reqs /
-        4MB per batch, weed/storage/volume_read_write.go:297-327).
-        Per-needle failures are returned in-place, not raised."""
+        """Append many needles under one lock acquisition — the engine half
+        of the reference's async write batching (<=128 reqs / 4MB per
+        batch, weed/storage/volume_read_write.go:297-327). Per-needle
+        failures are returned in-place, not raised."""
         out: list = []
         with self._lock:
             for n in needles:
                 try:
-                    out.append(self.write_needle(n, _defer_flush=True))
+                    out.append(self.write_needle(n))
                 except Exception as e:
                     out.append(e)
-            self._dat.flush()
         return out
 
     def _is_unchanged(self, n: Needle, nv: NeedleValue) -> bool:

@@ -314,4 +314,41 @@ class Topology:
             "max_volume_id": self.max_volume_id,
             "volume_size_limit": self.volume_size_limit,
             "nodes": [n.to_dict() for n in self.nodes.values()],
+            "Topology": self.tree(),
         }
+
+    def tree(self) -> dict:
+        """DC -> rack -> node aggregation with up-summed counters
+        (the reference's node hierarchy, weed/topology/node.go:16-47,
+        data_center.go, rack.go: volumeCount / maxVolumeCount /
+        ecShardCount aggregate at every level)."""
+        def node_stats(n: DataNode) -> dict:
+            return {"volume_count": len(n.volumes),
+                    "max_volume_count": n.max_volume_count,
+                    "ec_shard_count": sum(len(s.shard_ids)
+                                          for s in n.ec_shards.values()),
+                    "free_slots": n.free_slots()}
+
+        dcs: dict[str, dict] = {}
+        for n in self.nodes.values():
+            dc = dcs.setdefault(n.data_center, {"racks": {}})
+            rack = dc["racks"].setdefault(n.rack, {"nodes": {}})
+            rack["nodes"][n.id] = node_stats(n)
+
+        def aggregate(children: dict) -> dict:
+            out = {"volume_count": 0, "max_volume_count": 0,
+                   "ec_shard_count": 0, "free_slots": 0}
+            for c in children.values():
+                for k in out:
+                    out[k] += c[k]
+            return out
+
+        for dc in dcs.values():
+            for rack in dc["racks"].values():
+                rack.update(aggregate(rack["nodes"]))
+            dc.update(aggregate(dc["racks"]))
+        total = aggregate(dcs) if dcs else {
+            "volume_count": 0, "max_volume_count": 0,
+            "ec_shard_count": 0, "free_slots": 0}
+        total["data_centers"] = dcs
+        return total

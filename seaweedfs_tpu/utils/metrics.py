@@ -2,7 +2,9 @@
 
 Counterpart of the reference's central registry (weed/stats/metrics.go:19-118)
 — counters, gauges and duration histograms rendered in Prometheus exposition
-format at /metrics (scrape model; the reference also supports push).
+format at /metrics, with optional label sets
+(`count("read", labels={"collection": "c"})`) and a push-gateway loop
+(LoopPushingMetric, metrics.go:140).
 """
 
 from __future__ import annotations
@@ -12,6 +14,13 @@ import time
 from collections import defaultdict
 
 _BUCKETS = [0.0001, 0.001, 0.01, 0.1, 1.0, 10.0]
+
+
+def _key(name: str, labels: dict | None) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
 
 
 class Registry:
@@ -24,13 +33,15 @@ class Registry:
         self._hist_sum: dict[str, float] = defaultdict(float)
         self._hist_count: dict[str, int] = defaultdict(int)
 
-    def count(self, name: str, value: float = 1.0) -> None:
+    def count(self, name: str, value: float = 1.0,
+              labels: dict | None = None) -> None:
         with self._lock:
-            self._counters[name] += value
+            self._counters[_key(name, labels)] += value
 
-    def gauge(self, name: str, value: float) -> None:
+    def gauge(self, name: str, value: float,
+              labels: dict | None = None) -> None:
         with self._lock:
-            self._gauges[name] = value
+            self._gauges[_key(name, labels)] = value
 
     def observe(self, name: str, seconds: float) -> None:
         with self._lock:
@@ -43,6 +54,24 @@ class Registry:
                 buckets[-1] += 1
             self._hist_sum[name] += seconds
             self._hist_count[name] += 1
+
+    async def push_loop(self, gateway_url: str, job: str,
+                        interval_seconds: float = 15.0) -> None:
+        """Push-gateway mode (LoopPushingMetric, weed/stats/metrics.go:140):
+        POST the exposition text to <gateway>/metrics/job/<job> forever."""
+        import aiohttp
+        async with aiohttp.ClientSession() as session:
+            while True:
+                try:
+                    async with session.post(
+                            f"{gateway_url.rstrip('/')}/metrics/job/{job}",
+                            data=self.render(),
+                            headers={"Content-Type": "text/plain"}) as r:
+                        await r.read()
+                except Exception:
+                    pass  # the gateway being down must never hurt serving
+                import asyncio
+                await asyncio.sleep(interval_seconds)
 
     def timed(self, name: str):
         registry = self
@@ -57,16 +86,31 @@ class Registry:
 
         return _Timer()
 
+    @staticmethod
+    def _split(key: str) -> tuple[str, str]:
+        """'read{a="b"}' -> ('read', '{a="b"}')."""
+        if "{" in key:
+            name, _, rest = key.partition("{")
+            return name, "{" + rest
+        return key, ""
+
     def render(self) -> str:
         with self._lock:
             lines = []
             p = f"seaweedfs_tpu_{self.subsystem}"
-            for name, v in sorted(self._counters.items()):
-                lines.append(f"# TYPE {p}_{name}_total counter")
-                lines.append(f"{p}_{name}_total {v}")
-            for name, v in sorted(self._gauges.items()):
-                lines.append(f"# TYPE {p}_{name} gauge")
-                lines.append(f"{p}_{name} {v}")
+            typed: set[str] = set()
+            for key, v in sorted(self._counters.items()):
+                name, lbl = self._split(key)
+                if name not in typed:
+                    typed.add(name)
+                    lines.append(f"# TYPE {p}_{name}_total counter")
+                lines.append(f"{p}_{name}_total{lbl} {v}")
+            for key, v in sorted(self._gauges.items()):
+                name, lbl = self._split(key)
+                if ("g", name) not in typed:
+                    typed.add(("g", name))
+                    lines.append(f"# TYPE {p}_{name} gauge")
+                lines.append(f"{p}_{name}{lbl} {v}")
             for name, buckets in sorted(self._hist.items()):
                 lines.append(f"# TYPE {p}_{name}_seconds histogram")
                 acc = 0

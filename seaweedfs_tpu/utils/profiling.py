@@ -1,0 +1,72 @@
+"""Profiling hooks (role of weed/util/grace/pprof.go + net/http/pprof).
+
+- setup_cpu_profile(path): process-wide cProfile started now, dumped at
+  exit — the -cpuprofile flag every server command takes (the reference
+  routes the same flag through grace.SetupProfiling).
+- profile_handler: an aiohttp handler factory serving /debug/profile?
+  seconds=N — samples the process with cProfile for N seconds and returns
+  pstats text (the /debug/pprof/profile analog).
+- trace_annotation(name): JAX profiler annotation context for kernel
+  launches; no-op when the profiler is idle, visible in TensorBoard/
+  Perfetto traces when one is active.
+"""
+
+from __future__ import annotations
+
+import atexit
+import cProfile
+import io
+import pstats
+from typing import Optional
+
+_active: Optional[cProfile.Profile] = None
+
+
+def setup_cpu_profile(path: str) -> None:
+    """Start profiling the whole process; write pstats to `path` at exit
+    (grace.SetupProfiling, weed/util/grace/pprof.go:11)."""
+    global _active
+    if not path or _active is not None:
+        return
+    prof = cProfile.Profile()
+    prof.enable()
+    _active = prof
+
+    def dump() -> None:
+        prof.disable()
+        prof.dump_stats(path)
+
+    atexit.register(dump)
+
+
+def profile_handler():
+    """aiohttp handler: GET /debug/profile?seconds=5 returns pstats text
+    for that window (net/http/pprof's /debug/pprof/profile analog)."""
+    import asyncio
+
+    from aiohttp import web
+
+    async def handler(request: web.Request) -> web.Response:
+        seconds = min(float(request.query.get("seconds", 5)), 60.0)
+        prof = cProfile.Profile()
+        prof.enable()
+        await asyncio.sleep(seconds)
+        prof.disable()
+        out = io.StringIO()
+        stats = pstats.Stats(prof, stream=out)
+        stats.sort_stats("cumulative").print_stats(60)
+        return web.Response(text=out.getvalue(),
+                            content_type="text/plain")
+
+    return handler
+
+
+def trace_annotation(name: str):
+    """JAX trace annotation around kernel launches; inert without an
+    active profiler session."""
+    try:
+        import jax.profiler
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        import contextlib
+        return contextlib.nullcontext()

@@ -1,0 +1,26 @@
+"""Tiny status pages (role of weed/server/*_ui/ templates)."""
+
+from __future__ import annotations
+
+import html
+import json
+
+
+def render_status(title: str, sections: dict) -> str:
+    """One HTML page: a heading plus <pre> blocks per section."""
+    parts = [
+        "<!doctype html><html><head><meta charset='utf-8'>",
+        f"<title>{html.escape(title)}</title>",
+        "<style>body{font-family:monospace;margin:2em;background:#fafafa}"
+        "h1{font-size:1.3em}h2{font-size:1.05em;margin-top:1.2em}"
+        "pre{background:#fff;border:1px solid #ddd;padding:.8em;"
+        "overflow-x:auto}</style></head><body>",
+        f"<h1>{html.escape(title)}</h1>",
+    ]
+    for name, value in sections.items():
+        body = (value if isinstance(value, str)
+                else json.dumps(value, indent=1, default=str))
+        parts.append(f"<h2>{html.escape(name)}</h2>"
+                     f"<pre>{html.escape(body)}</pre>")
+    parts.append("</body></html>")
+    return "".join(parts)

@@ -36,7 +36,8 @@ class Cluster:
                  default_replication: str = "000",
                  max_volumes: int = 16,
                  pulse: float = 0.15,
-                 n_masters: int = 1):
+                 n_masters: int = 1,
+                 master_grpc_port: int = 0):
         self.geometry = geometry
         self.coder_name = coder_name
         self.default_replication = default_replication
@@ -44,6 +45,7 @@ class Cluster:
         self.pulse = pulse
         self.n = n_volume_servers
         self.n_masters = n_masters
+        self.master_grpc_port = master_grpc_port
 
         self.loop = asyncio.new_event_loop()
         self.thread = threading.Thread(target=self._loop_main, daemon=True)
@@ -85,7 +87,7 @@ class Cluster:
         master_urls = [f"127.0.0.1:{p}" for p in master_ports]
         self.masters: list[MasterServer] = []
         self._master_runners: list = []
-        for port, url in zip(master_ports, master_urls):
+        for i, (port, url) in enumerate(zip(master_ports, master_urls)):
             m = MasterServer(
                 volume_size_limit_mb=1,  # tiny: volumes seal quickly
                 default_replication=self.default_replication,
@@ -93,7 +95,8 @@ class Cluster:
                 url=url,
                 peers=master_urls if self.n_masters > 1 else None,
                 election_timeout=(0.15, 0.3),
-                raft_heartbeat=0.05)
+                raft_heartbeat=0.05,
+                grpc_port=self.master_grpc_port if i == 0 else 0)
             runner = self.serve(m.app, port)
             self.masters.append(m)
             self._master_runners.append(runner)
@@ -129,7 +132,8 @@ class Cluster:
         self.call(halt())
 
     def add_volume_server(self, data_center: str = "dc1",
-                          rack: str = "") -> VolumeServer:
+                          rack: str = "",
+                          use_grpc_heartbeat: bool = False) -> VolumeServer:
         from aiohttp import web
 
         tmp = tempfile.TemporaryDirectory(prefix="weedtpu_vs_")
@@ -140,7 +144,11 @@ class Cluster:
         vs = VolumeServer(store, self.master_url, url=f"127.0.0.1:{port}",
                           data_center=data_center,
                           rack=rack or f"rack{len(self.volume_servers) % 2}",
-                          pulse_seconds=self.pulse)
+                          pulse_seconds=self.pulse,
+                          use_grpc_heartbeat=use_grpc_heartbeat,
+                          master_grpc_target=(
+                              f"127.0.0.1:{self.master_grpc_port}"
+                              if use_grpc_heartbeat else ""))
 
         runner = self.serve(vs.app, port)
         self.runners.append(runner)

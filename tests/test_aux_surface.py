@@ -1,0 +1,135 @@
+"""Auxiliary surfaces: xattr + hardlinks on the mount, the fsspec
+adapter, labeled metrics + status UI, and profiling endpoints.
+"""
+
+import time
+import urllib.request
+
+import pytest
+
+from cluster_util import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(n_volume_servers=1)
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def filer(cluster):
+    fs = cluster.add_filer(chunk_size=8 * 1024)
+    time.sleep(0.3)
+    return fs
+
+
+def test_wfs_xattr(cluster, filer):
+    from seaweedfs_tpu.mount.wfs import WFS, FuseError
+    w = WFS(filer.url)
+    fh = w.create("/x/attrs.txt")
+    w.write(fh, b"data", 0)
+    w.flush(fh)
+    w.release(fh)
+
+    w.setxattr("/x/attrs.txt", "user.color", b"blue")
+    w.setxattr("/x/attrs.txt", "user.shape", b"round")
+    assert w.getxattr("/x/attrs.txt", "user.color") == b"blue"
+    assert sorted(w.listxattr("/x/attrs.txt")) == ["user.color",
+                                                   "user.shape"]
+    w.removexattr("/x/attrs.txt", "user.color")
+    assert w.listxattr("/x/attrs.txt") == ["user.shape"]
+    with pytest.raises(FuseError):
+        w.getxattr("/x/attrs.txt", "user.color")
+    w.destroy()
+
+
+def test_wfs_hardlink_shares_data_and_survives_unlink(cluster, filer):
+    from seaweedfs_tpu.mount.wfs import WFS
+    w = WFS(filer.url)
+    fh = w.create("/h/orig.bin")
+    w.write(fh, b"linked-bytes" * 100, 0)
+    w.flush(fh)
+    w.release(fh)
+
+    w.link("/h/orig.bin", "/h/alias.bin")
+    fh = w.open("/h/alias.bin")
+    assert w.read(fh, 12, 0) == b"linked-bytes"
+    w.release(fh)
+
+    # removing the original must not free the shared chunks
+    w.unlink("/h/orig.bin")
+    time.sleep(0.5)  # let any (wrong) chunk deletion run
+    fh = w.open("/h/alias.bin")
+    assert w.read(fh, 12 * 100, 0) == b"linked-bytes" * 100
+    w.release(fh)
+    # removing the last link frees them
+    w.unlink("/h/alias.bin")
+    w.destroy()
+
+
+def test_fsspec_adapter(cluster, filer):
+    import fsspec
+
+    from seaweedfs_tpu.integrations.fsspec_fs import register
+    register()
+    fs = fsspec.filesystem("seaweedfs", filer=filer.url)
+
+    with fs.open(f"seaweedfs://{filer.url}/fsspec/data.txt", "wb") as f:
+        f.write(b"fsspec payload")
+    assert fs.cat_file("/fsspec/data.txt") == b"fsspec payload"
+    assert fs.cat_file("/fsspec/data.txt", start=7, end=14) == b"payload"
+    info = fs.info("/fsspec/data.txt")
+    assert info["type"] == "file" and info["size"] == 14
+    names = fs.ls("/fsspec", detail=False)
+    assert "fsspec/data.txt" in names
+    assert fs.exists("/fsspec/data.txt")
+    fs.mv("/fsspec/data.txt", "/fsspec/moved.txt")
+    assert not fs.exists("/fsspec/data.txt")
+    with fsspec.open(f"seaweedfs://{filer.url}/fsspec/moved.txt") as f:
+        assert f.read() == b"fsspec payload"
+    fs.rm("/fsspec", recursive=True)
+    assert not fs.exists("/fsspec/moved.txt")
+
+
+def test_labeled_metrics_render():
+    from seaweedfs_tpu.utils.metrics import Registry
+    r = Registry("test")
+    r.count("reads", labels={"collection": "photos"})
+    r.count("reads", labels={"collection": "photos"})
+    r.count("reads", labels={"collection": "docs"})
+    r.count("reads")
+    r.gauge("volumes", 3, labels={"collection": "photos", "type": "ec"})
+    text = r.render()
+    assert 'seaweedfs_tpu_test_reads_total{collection="photos"} 2.0' in text
+    assert 'seaweedfs_tpu_test_reads_total{collection="docs"} 1.0' in text
+    assert "seaweedfs_tpu_test_reads_total 1.0" in text
+    assert ('seaweedfs_tpu_test_volumes{collection="photos",type="ec"} 3'
+            in text)
+    assert text.count("# TYPE seaweedfs_tpu_test_reads_total counter") == 1
+
+
+def test_status_ui_and_profile_endpoints(cluster, filer):
+    url = cluster.master_url.split(",")[0]
+    with urllib.request.urlopen(f"http://{url}/ui", timeout=10) as r:
+        page = r.read().decode()
+    assert "master" in page and "topology" in page
+    vs_url = cluster.volume_servers[0].url
+    with urllib.request.urlopen(f"http://{vs_url}/ui", timeout=10) as r:
+        assert "volume" in r.read().decode()
+    with urllib.request.urlopen(f"http://{filer.url}/ui", timeout=10) as r:
+        assert "filer" in r.read().decode()
+    with urllib.request.urlopen(
+            f"http://{vs_url}/debug/profile?seconds=0.2", timeout=10) as r:
+        assert "cumulative" in r.read().decode()
+
+
+def test_vs_exports_labeled_volume_gauges(cluster, filer):
+    c = cluster
+    c.client.upload(b"gauge me", collection="")
+    c.wait_heartbeats()
+    vs_url = c.volume_servers[0].url
+    with urllib.request.urlopen(f"http://{vs_url}/metrics",
+                                timeout=10) as r:
+        text = r.read().decode()
+    assert 'volumes{collection="default"' in text

@@ -1,0 +1,159 @@
+"""gRPC control plane: protobuf wire for assign/lookup, the bidi
+heartbeat stream, KeepConnected push, admin lease, and ShardBits.
+
+Counterpart of the reference's gRPC surface (weed/pb/master.proto,
+master_grpc_server.go). The service runs next to HTTP on port+10000
+(grpc_client_server.go convention).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from cluster_util import Cluster, free_port
+from seaweedfs_tpu.ec import shard_bits
+from seaweedfs_tpu.pb import master_pb2 as pb
+from seaweedfs_tpu.pb.rpc import MasterStub, grpc_address
+
+
+def test_shard_bits_algebra():
+    assert shard_bits.from_ids([0, 3, 13]) == (1 | 8 | (1 << 13))
+    assert shard_bits.to_ids(shard_bits.from_ids([5, 1, 9])) == [1, 5, 9]
+    a = shard_bits.from_ids([0, 1, 2])
+    b = shard_bits.from_ids([2, 3])
+    assert shard_bits.to_ids(shard_bits.plus(a, b)) == [0, 1, 2, 3]
+    assert shard_bits.to_ids(shard_bits.minus(a, b)) == [0, 1]
+    full = shard_bits.from_ids(range(14))
+    assert shard_bits.to_ids(
+        shard_bits.minus_parity_shards(full, 10)) == list(range(10))
+    assert shard_bits.count(full) == 14
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    grpc_port = free_port()
+    c = Cluster(n_volume_servers=0, master_grpc_port=grpc_port)
+    c.grpc_target = f"127.0.0.1:{grpc_port}"
+    # one volume server heartbeating over the gRPC bidi stream
+    c.add_volume_server(use_grpc_heartbeat=True)
+    c.wait_for_nodes(1)
+    yield c
+    c.shutdown()
+
+
+def _call(cluster, fn):
+    """Run a grpc.aio coroutine against the cluster's loop thread."""
+    return cluster.call(fn())
+
+
+def test_grpc_heartbeat_registers_node(cluster):
+    # wait_for_nodes in the fixture already proved the stream works; check
+    # the node registered with its real url
+    nodes = cluster.client.dir_status()["nodes"]
+    assert len(nodes) == 1
+    assert nodes[0]["url"] == cluster.volume_servers[0].url
+
+
+def test_grpc_assign_and_lookup(cluster):
+    import grpc
+
+    async def go():
+        async with grpc.aio.insecure_channel(cluster.grpc_target) as ch:
+            stub = MasterStub(ch)
+            a = await stub.Assign(pb.AssignRequest(count=1))
+            assert a.error == "", a.error
+            assert a.fid and a.url
+            vid = int(a.fid.split(",")[0])
+            lk = await stub.Lookup(pb.LookupRequest(volume_id=vid))
+            assert [l.url for l in lk.locations] == [a.url]
+            missing = await stub.Lookup(pb.LookupRequest(volume_id=9999))
+            assert missing.error
+            st = await stub.ClusterStatus(pb.ClusterStatusRequest())
+            assert st.is_leader
+            return a.fid
+
+    fid = _call(cluster, go)
+    assert "," in fid
+
+
+def test_grpc_keepconnected_snapshot_and_delta(cluster):
+    import grpc
+
+    fid = cluster.client.upload(b"grpc-push")
+    vid = int(fid.split(",")[0])
+    cluster.wait_heartbeats()
+
+    async def go():
+        async with grpc.aio.insecure_channel(cluster.grpc_target) as ch:
+            stub = MasterStub(ch)
+            stream = stub.KeepConnected(
+                pb.KeepConnectedRequest(client_name="test"))
+            seen_snapshot_vids = set()
+            # snapshot messages arrive first
+            msg = await asyncio.wait_for(stream.read(), 5)
+            assert msg.is_snapshot
+            seen_snapshot_vids.update(msg.new_vids)
+            # growing a volume must push a delta
+            grow_task = asyncio.get_event_loop().create_task(
+                _grow_async(cluster))
+            new_vids = set()
+            deadline = time.monotonic() + 8
+            while time.monotonic() < deadline:
+                msg = await asyncio.wait_for(stream.read(), 5)
+                if not msg.is_snapshot and msg.new_vids:
+                    new_vids.update(msg.new_vids)
+                    break
+            await grow_task
+            stream.cancel()
+            return seen_snapshot_vids, new_vids
+
+    snapshot_vids, delta_vids = _call(cluster, go)
+    assert vid in snapshot_vids
+    assert delta_vids, "no delta pushed after growth"
+
+
+async def _grow_async(cluster):
+    import aiohttp
+    url = cluster.master_url.split(",")[0]
+    async with aiohttp.ClientSession() as s:
+        async with s.get(f"http://{url}/vol/grow?count=1") as r:
+            return await r.json()
+
+
+def test_grpc_admin_lease(cluster):
+    import grpc
+
+    async def go():
+        async with grpc.aio.insecure_channel(cluster.grpc_target) as ch:
+            stub = MasterStub(ch)
+            lease = await stub.LeaseAdminToken(
+                pb.LeaseAdminTokenRequest(name="locktest", client="t1"))
+            assert lease.token and not lease.error
+            other = await stub.LeaseAdminToken(
+                pb.LeaseAdminTokenRequest(name="locktest", client="t2"))
+            assert other.error
+            renew = await stub.LeaseAdminToken(
+                pb.LeaseAdminTokenRequest(name="locktest", client="t1",
+                                          previous_token=lease.token))
+            assert renew.token == lease.token
+            rel = await stub.ReleaseAdminToken(
+                pb.ReleaseAdminTokenRequest(name="locktest",
+                                            token=lease.token))
+            assert rel.ok
+
+    _call(cluster, go)
+
+
+def test_grpc_heartbeat_disconnect_unregisters(cluster):
+    """Dropping the bidi stream unregisters the node and pushes its
+    DeletedVids immediately (master_grpc_server.go:22-49)."""
+    c = cluster
+    assert len(c.client.dir_status()["nodes"]) == 1
+    c.stop_volume_server(0)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if not c.client.dir_status()["nodes"]:
+            break
+        time.sleep(0.1)
+    assert c.client.dir_status()["nodes"] == []

@@ -46,6 +46,7 @@ def _boot_s3(cluster, **kwargs):
 
     cluster.runners.append(cluster.call(boot()))
     server.url = f"127.0.0.1:{port}"
+    server._test_filer = filer
     return server
 
 
@@ -294,3 +295,34 @@ def test_post_policy_upload(s3_iam):
             headers={"Content-Type":
                      "multipart/form-data; boundary=bnd123"})
     assert e.value.code == 403
+
+
+def test_multipart_with_manifested_part(cluster, s3):
+    """A part large enough to be chunk-manifested must assemble with
+    correct offsets (the filer flattens it at complete time)."""
+    # find the filer behind this s3 server and shrink its manifest batch
+    filer = s3._test_filer
+    old_batch = filer.manifest_batch
+    filer.manifest_batch = 3
+    try:
+        req(s3, "PUT", "/mpbucket").read()
+        with req(s3, "POST", "/mpbucket/big.bin?uploads") as r:
+            body = r.read().decode()
+        upload_id = body.split("<UploadId>")[1].split("</UploadId>")[0]
+        # part 1: spans many chunks (chunk_size is 16KB in the fixture)
+        part1 = bytes([7]) * (16 * 1024 * 5)   # 5 chunks > batch of 3
+        part2 = bytes([9]) * (16 * 1024 * 2)
+        req(s3, "PUT",
+            f"/mpbucket/big.bin?partNumber=1&uploadId={upload_id}",
+            data=part1).read()
+        req(s3, "PUT",
+            f"/mpbucket/big.bin?partNumber=2&uploadId={upload_id}",
+            data=part2).read()
+        with req(s3, "POST", f"/mpbucket/big.bin?uploadId={upload_id}",
+                 data=b"<CompleteMultipartUpload/>") as r:
+            assert b"CompleteMultipartUploadResult" in r.read()
+        with req(s3, "GET", "/mpbucket/big.bin") as r:
+            got = r.read()
+        assert got == part1 + part2
+    finally:
+        filer.manifest_batch = old_batch
