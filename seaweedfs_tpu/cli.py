@@ -53,6 +53,7 @@ def cmd_master(args) -> None:
         args.ip, args.port,
         volume_size_limit_mb=args.volume_size_limit_mb,
         default_replication=args.default_replication,
+        pulse_seconds=args.pulse,
         guard=_load_guard(),
         url=url,
         peers=peers or None,
@@ -72,7 +73,8 @@ def cmd_volume(args) -> None:
                   max_volume_counts=[args.max] * len(args.dir.split(",")),
                   coder_name=args.coder, geometry=geometry,
                   needle_map_kind=args.index,
-                  min_free_space_percent=args.min_free_space_percent)
+                  min_free_space_percent=args.min_free_space_percent,
+                  preallocate=args.preallocate * 1024 * 1024)
     _run_forever(run_volume_server(
         args.ip, args.port, store, args.mserver,
         data_center=args.data_center, rack=args.rack,
@@ -587,6 +589,9 @@ def build_parser() -> argparse.ArgumentParser:
                         " for raft HA (weed master -peers)")
     m.add_argument("-mdir", default="",
                    help="directory for persisted raft state")
+    m.add_argument("-pulse", type=float, default=5.0,
+                   help="expected heartbeat interval (drives dead-node "
+                        "pruning)")
     m.add_argument("-grpc_port", type=int, default=-1,
                    help="gRPC control-plane port (default HTTP+10000; "
                         "0 disables)")
@@ -606,6 +611,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="needle map kind (weed volume -index)")
     v.add_argument("-minFreeSpacePercent", dest="min_free_space_percent",
                    type=float, default=1.0)
+    v.add_argument("-preallocate", type=int, default=0,
+                   help="MB to fallocate per new volume "
+                        "(volume_create_linux.go)")
     v.add_argument("-grpc_heartbeat", action="store_true",
                    help="stream heartbeats over gRPC instead of HTTP "
                         "polling")
@@ -790,6 +798,12 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> None:
+    import os as _os
+    if _os.environ.get("SEAWEEDFS_FORCE_CPU"):
+        # env-var JAX_PLATFORMS is overridden by eager site hooks (axon);
+        # jax.config wins — used by multi-process tests and CPU-only ops
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     args = build_parser().parse_args(argv)
     from .utils import glog
     glog.setup(args.verbosity, args.vmodule, args.log_file)

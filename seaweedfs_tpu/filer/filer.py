@@ -188,6 +188,19 @@ class Filer:
         self._notify(entry.parent, old, entry, signatures=signatures)
         return entry
 
+    def freeable_replaced_chunks(self, old: Optional[Entry]
+                                 ) -> list[FileChunk]:
+        """Chunks of an overwritten entry that are safe to free: none
+        while other hard links still reference them (the overwrite's
+        counter decrement has already run inside create_entry)."""
+        if old is None or not old.chunks:
+            return []
+        if old.hard_link_id:
+            raw = self.store.kv_get(f"hardlink/{old.hard_link_id}")
+            if raw and int(raw) > 0:
+                return []
+        return list(old.chunks)
+
     def _bump_hardlink(self, hlid: str, delta: int) -> int:
         key = f"hardlink/{hlid}"
         raw = self.store.kv_get(key)
@@ -257,18 +270,21 @@ class Filer:
                 children = self.store.list_directory_entries(path, limit=2)
                 if children and not recursive:
                     raise OSError(f"directory {path} not empty")
-                if free_chunks:
-                    self._collect_chunks_recursive(path, freed)
+                # the walk always runs so hard-link counters stay in sync
+                # even on metadata-only deletes (sync replay passes
+                # free_chunks=False but the link still goes away)
+                self._collect_chunks_recursive(path, freed)
                 self.store.delete_folder_children(path)
-            elif free_chunks:
+            else:
                 if entry.hard_link_id:
-                    # shared chunks are freed only with the last link
+                    # shared chunks are freed only with the last link;
+                    # the decrement itself is unconditional
                     if self._bump_hardlink(entry.hard_link_id, -1) == 0:
                         freed.extend(entry.chunks)
-                else:
+                elif free_chunks:
                     freed.extend(entry.chunks)
             self.store.delete_entry(path)
-        if freed:
+        if freed and free_chunks:
             self.on_delete_chunks(freed)
         self._notify(entry.parent, entry, None, delete_chunks=bool(freed),
                      signatures=signatures)

@@ -63,11 +63,70 @@ class FileQueue:
                 self._f = None
 
 
+class WebhookQueue:
+    """POST every event as JSON to an HTTP endpoint — the broker-less
+    analog of the reference's kafka/sqs/pubsub queues: any consumer with
+    a URL can receive the filer event stream.
+
+    Delivery runs on a background worker so a slow/down endpoint never
+    blocks filer mutations. Failed posts append to an ndjson spool file
+    for out-of-band replay (nothing replays it automatically); with a
+    bounded in-memory queue, overflow events go straight to the spool.
+    """
+
+    def __init__(self, url: str, spool_path: str = "",
+                 timeout: float = 10.0, queue_size: int = 4096):
+        import queue as queue_mod
+        self.url = url
+        self.timeout = timeout
+        self.spool_path = spool_path
+        self._lock = threading.Lock()
+        self._q: "queue_mod.Queue" = queue_mod.Queue(maxsize=queue_size)
+        self._worker = threading.Thread(target=self._drain, daemon=True)
+        self._worker.start()
+
+    def notify(self, event) -> None:
+        body = json.dumps(event.to_dict()).encode()
+        try:
+            self._q.put_nowait(body)
+        except Exception:
+            self._spool(body)
+
+    def _drain(self) -> None:
+        import urllib.request
+        while True:
+            body = self._q.get()
+            req = urllib.request.Request(
+                self.url, data=body, method="POST",
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req, timeout=self.timeout).close()
+            except Exception as e:
+                glog.warning("webhook notify %s failed: %s", self.url, e)
+                self._spool(body)
+
+    def _spool(self, body: bytes) -> None:
+        if not self.spool_path:
+            return
+        with self._lock, open(self.spool_path, "a",
+                              encoding="utf-8") as f:
+            f.write(body.decode() + "\n")
+
+
 QUEUES = {
     "log": lambda cfg: LogQueue(),
     "file": lambda cfg: FileQueue(cfg.get_string("directory",
                                                  "./notifications")),
+    "webhook": lambda cfg: WebhookQueue(
+        cfg.get_string("url", ""),
+        cfg.get_string("spool", "")),
 }
+
+
+def _broker_stub(name: str):
+    raise RuntimeError(
+        f"notification queue {name!r} needs its broker SDK, which this "
+        "image does not ship; use 'webhook' or 'file' instead")
 
 
 def load_notifier(config) -> Optional[object]:
@@ -76,6 +135,10 @@ def load_notifier(config) -> Optional[object]:
     section = config.section("notification")
     for name in section.keys():
         sub = section.section(name)
-        if sub.get_bool("enabled") and name in QUEUES:
+        if not sub.get_bool("enabled"):
+            continue
+        if name in QUEUES:
             return QUEUES[name](sub)
+        if name in ("kafka", "aws_sqs", "google_pub_sub", "gocdk"):
+            _broker_stub(name)
     return None

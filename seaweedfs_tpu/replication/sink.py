@@ -139,6 +139,56 @@ class FilerSink(ReplicationSink):
                 raise
 
 
+class S3Sink(ReplicationSink):
+    """Replicate entries into an S3-compatible bucket
+    (weed/replication/sink/s3sink) via the SigV4 object-store client —
+    works against AWS-compatible endpoints and this project's own S3
+    gateway."""
+
+    def __init__(self, endpoint: str, bucket: str, directory: str = "/",
+                 access_key: str = "", secret_key: str = "",
+                 region: str = "us-east-1"):
+        from ..storage.backend import S3ObjectStore
+        self.store = S3ObjectStore(endpoint, bucket, access_key,
+                                   secret_key, region)
+        self.prefix = directory.strip("/")
+
+    def identity(self) -> str:
+        return (f"S3Sink:{self.store.endpoint}/{self.store.bucket}/"
+                f"{self.prefix}")
+
+    def _key(self, entry_path: str) -> str:
+        key = entry_path.lstrip("/")
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    def create_entry(self, entry: Entry,
+                     fetch_data: Callable[[], bytes],
+                     signatures: tuple[int, ...] = ()) -> None:
+        if entry.is_directory:
+            return  # object stores have no directories
+        import tempfile
+        with tempfile.NamedTemporaryFile() as tmp:
+            tmp.write(fetch_data())
+            tmp.flush()
+            self.store.put(self._key(entry.full_path), tmp.name)
+
+    def delete_entry(self, entry: Entry,
+                     signatures: tuple[int, ...] = ()) -> None:
+        if entry.is_directory:
+            return
+        try:
+            self.store.delete(self._key(entry.full_path))
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+
+
+def _cloud_stub(name: str) -> ReplicationSink:
+    raise RuntimeError(
+        f"replication sink {name!r} needs its cloud SDK, which this image "
+        "does not ship; the s3 sink covers any S3-compatible endpoint")
+
+
 def load_sink(config) -> Optional[ReplicationSink]:
     """First enabled [sink.<name>] in replication.toml wins
     (weed/replication/replicator.go NewReplicator)."""
@@ -152,4 +202,13 @@ def load_sink(config) -> Optional[ReplicationSink]:
         if name == "filer":
             return FilerSink(sub.get_string("grpcAddress", "localhost:8888"),
                              sub.get_string("directory", "/"))
+        if name == "s3":
+            return S3Sink(sub.get_string("endpoint", ""),
+                          sub.get_string("bucket", ""),
+                          sub.get_string("directory", "/"),
+                          sub.get_string("aws_access_key_id", ""),
+                          sub.get_string("aws_secret_access_key", ""),
+                          sub.get_string("region", "us-east-1"))
+        if name in ("gcs", "azure", "backblaze"):
+            _cloud_stub(name)
     return None

@@ -159,12 +159,12 @@ class FilerServer:
             return web.json_response({"error": "exists"}, status=409)
         except (IsADirectoryError, NotADirectoryError) as e:
             return web.json_response({"error": str(e)}, status=409)
-        if (old is not None and old.chunks
-                and body.get("free_old_chunks", True)):
-            old_fids = {c.fid for c in old.chunks}
+        if body.get("free_old_chunks", True):
+            # hard-link aware: replaced chunks stay if other links remain
             new_fids = {c.fid for c in entry.chunks}
             self._queue_chunk_deletes(
-                [c for c in old.chunks if c.fid not in new_fids])
+                [c for c in self.filer.freeable_replaced_chunks(old)
+                 if c.fid not in new_fids])
         return web.json_response({"ok": True})
 
     async def meta_update(self, request: web.Request) -> web.Response:
@@ -806,8 +806,8 @@ class FilerServer:
         sigs = _parse_signatures(request)
         await asyncio.get_event_loop().run_in_executor(
             None, lambda: self.filer.create_entry(entry, signatures=sigs))
-        if old_entry is not None and old_entry.chunks:
-            self._queue_chunk_deletes(old_entry.chunks)
+        self._queue_chunk_deletes(
+            self.filer.freeable_replaced_chunks(old_entry))
         return web.json_response(
             {"name": entry.name, "size": offset,
              "chunks": len(chunks)}, status=201)

@@ -248,18 +248,9 @@ class MasterServer:
         """Assign a write target (dirAssignHandler,
         weed/server/master_server_handlers.go:96-150)."""
         self.metrics.count("assign")
-        # leader-readiness barrier: all prior-term entries (key bounds,
-        # volume ids) must be applied before minting anything
-        if not await self.raft.ensure_ready():
+        if not await self.ensure_assign_ready():
             return web.json_response(
                 {"error": "not the leader / not ready"}, status=503)
-        # a freshly elected leader starts its sequencer above the last
-        # committed ceiling — keys handed out by dead leaders are <= it.
-        # Once per term: set_max jumps the counter past the ceiling, so
-        # doing it per-request would burn the whole bound window each time.
-        if self._seq_synced_term != self.raft.term:
-            self.sequencer.set_max(self._key_bound)
-            self._seq_synced_term = self.raft.term
         q = request.query
         resp, status = await self.assign_api(
             count=int(q.get("count", 1)),
@@ -268,6 +259,21 @@ class MasterServer:
             ttl=q.get("ttl", ""),
             data_center=q.get("dataCenter", ""))
         return web.json_response(resp, status=status)
+
+    async def ensure_assign_ready(self) -> bool:
+        """Leader-readiness barrier + once-per-term sequencer sync, shared
+        by the HTTP and gRPC assign surfaces: all prior-term entries (key
+        bounds, volume ids) must be applied before minting anything, and a
+        freshly elected leader starts its sequencer above the last
+        committed ceiling. The sync runs once per term — set_max jumps the
+        counter past the ceiling, so per-request syncs would burn the
+        whole bound window each time."""
+        if not await self.raft.ensure_ready():
+            return False
+        if self._seq_synced_term != self.raft.term:
+            self.sequencer.set_max(self._key_bound)
+            self._seq_synced_term = self.raft.term
+        return True
 
     async def assign_api(self, count: int = 1, collection: str = "",
                          replication: str = "", ttl: str = "",
@@ -622,33 +628,43 @@ class MasterServer:
             self._watchers.discard(q)
         return resp
 
-    async def cluster_lock(self, request: web.Request) -> web.Response:
-        """Lease the cluster-exclusive admin lock. Renew by presenting the
-        previous token; a stale holder's lease expires after
-        admin_lease_seconds (LeaseAdminToken semantics)."""
+    def lease_admin_token(self, name: str, client: str,
+                          previous_token: int) -> tuple[dict, int]:
+        """Lease the cluster-exclusive admin lock (shared by HTTP + gRPC).
+        Renew by presenting the previous token; a stale holder's lease
+        expires after admin_lease_seconds (LeaseAdminToken semantics)."""
         import time as time_mod
-        body = await request.json()
-        name = body.get("name", "admin")
-        client = body.get("client", "")
-        prev = body.get("previous_token", 0)
+        name = name or "admin"
         now = time_mod.time()
         held = self._admin_locks.get(name)
-        if held and held[2] > now and held[0] != prev:
-            return web.json_response(
-                {"error": f"lock {name} held by {held[1]}",
-                 "holder": held[1]}, status=409)
-        token = (held[0] if held and held[0] == prev
-                 else int(now * 1e9) ^ id(body) & 0xFFFF)
+        if held and held[2] > now and held[0] != previous_token:
+            return ({"error": f"lock {name} held by {held[1]}",
+                     "holder": held[1]}, 409)
+        token = (held[0] if held and held[0] == previous_token
+                 else int(now * 1e9))
         expires = now + self.admin_lease_seconds
         self._admin_locks[name] = (token, client, expires)
-        return web.json_response({"token": token, "expires_at": expires})
+        return {"token": token, "expires_at": expires}, 200
+
+    def release_admin_token(self, name: str, token: int) -> bool:
+        name = name or "admin"
+        held = self._admin_locks.get(name)
+        if held and held[0] == token:
+            del self._admin_locks[name]
+            return True
+        return False
+
+    async def cluster_lock(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        resp, status = self.lease_admin_token(
+            body.get("name", "admin"), body.get("client", ""),
+            body.get("previous_token", 0))
+        return web.json_response(resp, status=status)
 
     async def cluster_unlock(self, request: web.Request) -> web.Response:
         body = await request.json()
-        name = body.get("name", "admin")
-        held = self._admin_locks.get(name)
-        if held and held[0] == body.get("token", 0):
-            del self._admin_locks[name]
+        if self.release_admin_token(body.get("name", "admin"),
+                                    body.get("token", 0)):
             return web.json_response({"ok": True})
         return web.json_response({"error": "not the holder"}, status=409)
 

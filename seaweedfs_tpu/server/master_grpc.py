@@ -78,11 +78,8 @@ class MasterGrpcServicer:
         self.master = master
 
     async def Assign(self, request: pb.AssignRequest, context):
-        if not await self.master.raft.ensure_ready():
+        if not await self.master.ensure_assign_ready():
             return pb.AssignResponse(error="not the leader / not ready")
-        if self.master._seq_synced_term != self.master.raft.term:
-            self.master.sequencer.set_max(self.master._key_bound)
-            self.master._seq_synced_term = self.master.raft.term
         resp, status = await self.master.assign_api(
             count=request.count or 1,
             collection=request.collection,
@@ -196,28 +193,16 @@ class MasterGrpcServicer:
             peers=raft.peers, raft_term=raft.term)
 
     async def LeaseAdminToken(self, request, context):
-        import time as time_mod
-        master = self.master
-        now = time_mod.time()
-        held = master._admin_locks.get(request.name or "admin")
-        if held and held[2] > now and held[0] != request.previous_token:
-            return pb.LeaseAdminTokenResponse(
-                error=f"lock held by {held[1]}")
-        token = (held[0] if held and held[0] == request.previous_token
-                 else int(now * 1e9))
-        expires = now + master.admin_lease_seconds
-        master._admin_locks[request.name or "admin"] = (
-            token, request.client, expires)
-        return pb.LeaseAdminTokenResponse(token=token, expires_at=expires)
+        resp, status = self.master.lease_admin_token(
+            request.name, request.client, request.previous_token)
+        if status != 200:
+            return pb.LeaseAdminTokenResponse(error=resp["error"])
+        return pb.LeaseAdminTokenResponse(token=resp["token"],
+                                          expires_at=resp["expires_at"])
 
     async def ReleaseAdminToken(self, request, context):
-        master = self.master
-        name = request.name or "admin"
-        held = master._admin_locks.get(name)
-        if held and held[0] == request.token:
-            del master._admin_locks[name]
-            return pb.ReleaseAdminTokenResponse(ok=True)
-        return pb.ReleaseAdminTokenResponse(ok=False)
+        return pb.ReleaseAdminTokenResponse(
+            ok=self.master.release_admin_token(request.name, request.token))
 
 
 async def serve_master_grpc(master, host: str, port: int):

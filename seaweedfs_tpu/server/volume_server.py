@@ -315,6 +315,10 @@ class VolumeServer:
                         log.info("grpc heartbeat: following leader %s",
                                  leader)
                         self.master_url = leader
+                        # the explicit target (tests) only described the
+                        # old master; the new leader is reached via the
+                        # port convention
+                        self.master_grpc_target = ""
                         return  # redial the leader's gRPC port
             finally:
                 stop.set()

@@ -30,6 +30,26 @@ import threading
 from typing import Callable, Iterator, Optional
 
 
+def _fallocate_keep_size(fd: int, length: int) -> bool:
+    """Reserve contiguous space without changing the visible file size —
+    the fallocate(FALLOC_FL_KEEP_SIZE) call the reference issues on volume
+    creation (backend/volume_create_linux.go:16). Python's
+    os.posix_fallocate grows the file, so the raw syscall goes through
+    ctypes (the direct syscall layer SURVEY §2.12 calls for)."""
+    import ctypes
+    import ctypes.util
+    try:
+        libc = ctypes.CDLL(ctypes.util.find_library("c"), use_errno=True)
+        FALLOC_FL_KEEP_SIZE = 0x01
+        ret = libc.fallocate(ctypes.c_int(fd),
+                             ctypes.c_int(FALLOC_FL_KEEP_SIZE),
+                             ctypes.c_longlong(0),
+                             ctypes.c_longlong(length))
+        return ret == 0
+    except (OSError, AttributeError, TypeError):
+        return False  # non-Linux or filesystem without fallocate
+
+
 class BackendStorageFile:
     """Positioned-IO file (backend.go:15-24)."""
 
@@ -68,10 +88,13 @@ class DiskFile(BackendStorageFile):
     name = "local"
     writable = True
 
-    def __init__(self, path: str, create: bool = False):
+    def __init__(self, path: str, create: bool = False,
+                 preallocate: int = 0):
         self.path = path
         self._f = open(path, "w+b" if create else "r+b")
         self._lock = threading.Lock()
+        if create and preallocate > 0:
+            _fallocate_keep_size(self._f.fileno(), preallocate)
 
     def read_at(self, n: int, offset: int) -> bytes:
         return os.pread(self._f.fileno(), n, offset)

@@ -98,11 +98,13 @@ class Store:
                  coder_name: str = "auto",
                  geometry: ec_mod.Geometry = ec_mod.DEFAULT,
                  needle_map_kind: str = "memory",
-                 min_free_space_percent: float = 1.0):
+                 min_free_space_percent: float = 1.0,
+                 preallocate: int = 0):
         self.geometry = geometry
         self.coder_name = coder_name
         self.needle_map_kind = needle_map_kind
         self.min_free_space_percent = min_free_space_percent
+        self.preallocate = preallocate
         self.low_disk_space = False
         self._coder: Optional[ErasureCoder] = None
         counts = max_volume_counts or [8] * len(directories)
@@ -184,7 +186,8 @@ class Store:
                 ttl=t.TTL.parse(ttl))
             v = Volume(loc.directory, collection, vid, superblock=sb,
                        create=True,
-                       needle_map_kind=self.needle_map_kind)
+                       needle_map_kind=self.needle_map_kind,
+                       preallocate=self.preallocate)
             loc.volumes[vid] = v
             return v
 

@@ -41,7 +41,8 @@ class Volume:
     def __init__(self, directory: str, collection: str, vid: int,
                  superblock: Optional[SuperBlock] = None,
                  create: bool = False,
-                 needle_map_kind: str = "memory"):
+                 needle_map_kind: str = "memory",
+                 preallocate: int = 0):
         self.dir = directory
         self.collection = collection
         self.vid = vid
@@ -63,7 +64,8 @@ class Volume:
         has_vif = backend_mod.load_volume_info(base) is not None
         if create or (not has_local and not has_vif):
             self.super_block = superblock or SuperBlock()
-            self._dat = DiskFile(dat_path, create=True)
+            self._dat = DiskFile(dat_path, create=True,
+                                 preallocate=preallocate)
             self._dat.write_at(self.super_block.to_bytes(), 0)
             self._dat.flush()
             # fresh .dat invalidates any stale journal from a prior volume

@@ -16,10 +16,18 @@ from collections import defaultdict
 _BUCKETS = [0.0001, 0.001, 0.01, 0.1, 1.0, 10.0]
 
 
+def _escape(value) -> str:
+    """Prometheus exposition label-value escaping: backslash, quote,
+    newline (labels carry user-chosen collection names)."""
+    return (str(value).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
 def _key(name: str, labels: dict | None) -> str:
     if not labels:
         return name
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(f'{k}="{_escape(v)}"'
+                     for k, v in sorted(labels.items()))
     return f"{name}{{{inner}}}"
 
 

@@ -41,17 +41,33 @@ def setup_cpu_profile(path: str) -> None:
 
 def profile_handler():
     """aiohttp handler: GET /debug/profile?seconds=5 returns pstats text
-    for that window (net/http/pprof's /debug/pprof/profile analog)."""
+    for that window (net/http/pprof's /debug/pprof/profile analog).
+    cProfile allows one active profiler per process, so the endpoint
+    answers 409 while -cpuprofile or another window is running."""
     import asyncio
+    import threading
 
     from aiohttp import web
 
+    busy = threading.Lock()
+
     async def handler(request: web.Request) -> web.Response:
-        seconds = min(float(request.query.get("seconds", 5)), 60.0)
-        prof = cProfile.Profile()
-        prof.enable()
-        await asyncio.sleep(seconds)
-        prof.disable()
+        if _active is not None:
+            return web.Response(
+                status=409,
+                text="process-wide -cpuprofile is active; "
+                     "only one profiler can run at a time\n")
+        if not busy.acquire(blocking=False):
+            return web.Response(status=409,
+                                text="another profile window is running\n")
+        try:
+            seconds = min(float(request.query.get("seconds", 5)), 60.0)
+            prof = cProfile.Profile()
+            prof.enable()
+            await asyncio.sleep(seconds)
+            prof.disable()
+        finally:
+            busy.release()
         out = io.StringIO()
         stats = pstats.Stats(prof, stream=out)
         stats.sort_stats("cumulative").print_stats(60)
