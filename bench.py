@@ -77,7 +77,7 @@ def bench_fused(work: str, coder, vol_size: int) -> dict:
     os.makedirs(vdir, exist_ok=True)
     v = Volume(vdir, "", 7, create=True)
     needle_data = (b"fused bench payload: compressible text block. " * 450)
-    target = min(vol_size // 4, 256 * 1024 * 1024)
+    target = min(vol_size // 8, 64 * 1024 * 1024)
     count = max(target // len(needle_data), 10)
     for i in range(1, count + 1):
         v.write_needle(Needle(cookie=i, id=i, data=needle_data))
@@ -133,11 +133,14 @@ def main() -> None:
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
     # CPU fallback keeps the bench runnable in dev; the recorded numbers
-    # come from the driver's TPU run.
-    vol_size = (1024 * 1024 * 1024) if on_tpu else (16 * 1024 * 1024)
+    # come from the driver's TPU run. The TPU volume size is picked so the
+    # shard size is an exact multiple of the batch width: a single kernel
+    # shape compiles once (1120MiB -> 112 small rows -> 112MiB shards =
+    # 7 x 16MiB batches).
+    vol_size = (1120 * 1024 * 1024) if on_tpu else (16 * 1024 * 1024)
     kernel_n = (64 * 1024 * 1024) if on_tpu else (1024 * 1024)
     kernel_reps = 10 if on_tpu else 3
-    rebuild_reps = 3 if on_tpu else 1
+    rebuild_reps = 2 if on_tpu else 1
     batch = 16 * 1024 * 1024 if on_tpu else 1024 * 1024
 
     h2d_gbps, d2h_gbps = measure_link()
@@ -163,23 +166,33 @@ def main() -> None:
         shutil.rmtree(work, ignore_errors=True)
 
 
+def _phase(name: str, t0: float) -> float:
+    now = time.perf_counter()
+    print(f"[bench] {name}: {now - t0:.1f}s", file=sys.stderr, flush=True)
+    return now
+
+
 def _run_configs(work, coder, vol_size, kernel_n, kernel_reps, rebuild_reps,
                  batch, backend, h2d_gbps, d2h_gbps) -> None:
     from seaweedfs_tpu import ec
     from seaweedfs_tpu.ec import pipeline
 
+    t = time.perf_counter()
     base = os.path.join(work, "1")
     _make_volume(base + ".dat", vol_size)
+    t = _phase("volume gen", t)
 
     # run 1 warms every kernel shape (batch + tail widths); run 2 is
     # the steady-state measurement
     pipeline.stream_encode(base, coder, batch_size=batch)
+    t = _phase("encode warm (compile)", t)
     for i in range(14):
         os.remove(base + ec.to_ext(i))
     t0 = time.perf_counter()
     pipeline.stream_encode(base, coder, batch_size=batch)
     pipeline_dt = time.perf_counter() - t0
     pipeline_gbps = vol_size / pipeline_dt / 1e9
+    t = _phase("encode timed", t)
 
     # rebuild p50 (config 3): 4 missing shards from 10 survivors;
     # one untimed warm pass compiles the reconstruction kernel
@@ -194,14 +207,18 @@ def _run_configs(work, coder, vol_size, kernel_n, kernel_reps, rebuild_reps,
             times.append(time.perf_counter() - t0)
     rebuild_p50 = statistics.median(times)
     shard_size = os.path.getsize(base + ec.to_ext(0))
+    t = _phase(f"rebuild x{rebuild_reps + 1}", t)
 
     kernel_gbps = bench_kernel(10, 4, kernel_n, kernel_reps)
+    t = _phase("kernel 10,4", t)
     sweep = {}
     for (k, m) in ((6, 3), (12, 4), (20, 4)):
         n = kernel_n - kernel_n % (16384 * 8)
         sweep[f"{k},{m}"] = round(bench_kernel(k, m, n, kernel_reps), 2)
+        t = _phase(f"kernel sweep {k},{m}", t)
 
     fused = bench_fused(work, coder, vol_size)
+    t = _phase("fused pipeline", t)
 
     print(json.dumps({
         "metric": "ec.encode pipeline GB/s/chip (.dat -> .ec00-13)",

@@ -83,7 +83,8 @@ def cmd_volume(args) -> None:
 
 
 def cmd_server(args) -> None:
-    """master + volume in one process (weed/command/server.go)."""
+    """master + volume (+ filer + s3) in one process
+    (weed/command/server.go:117-221)."""
     from .ec.geometry import Geometry
     from .server.master import run_master
     from .server.volume_server import run_volume_server
@@ -91,16 +92,33 @@ def cmd_server(args) -> None:
 
     async def boot():
         guard = _load_guard()
+        master_url = f"{args.ip}:{args.master_port}"
         await run_master(args.ip, args.master_port,
                          default_replication=args.default_replication,
-                         guard=guard)
+                         guard=guard, url=master_url,
+                         grpc_port=args.master_port + 10000)
         geometry = Geometry(large_block_size=args.ec_large_block,
                             small_block_size=args.ec_small_block)
         store = Store(args.dir.split(","), coder_name=args.coder,
                       geometry=geometry)
-        await run_volume_server(args.ip, args.port, store,
-                                f"{args.ip}:{args.master_port}",
+        await run_volume_server(args.ip, args.port, store, master_url,
                                 guard=guard)
+        if args.filer:
+            from .server.filer_server import run_filer
+            await run_filer(args.ip, args.filer_port, master_url,
+                            store_name="sqlite",
+                            store_kwargs={"path": args.filer_db},
+                            guard=guard)
+        if args.s3:
+            if not args.filer:
+                raise SystemExit("-s3 needs -filer")
+            from .s3.s3_server import run_s3
+            iam = None
+            if args.s3_config:
+                from .s3.auth import Iam
+                iam = Iam.from_file(args.s3_config)
+            await run_s3(args.ip, args.s3_port,
+                         f"{args.ip}:{args.filer_port}", iam=iam)
 
     _run_forever(boot())
 
@@ -621,7 +639,8 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("-ec_small_block", type=int, default=1024 * 1024)
     v.set_defaults(fn=cmd_volume)
 
-    s = sub.add_parser("server", help="master + volume in one process")
+    s = sub.add_parser("server",
+                       help="master + volume (+ filer + s3) in one process")
     s.add_argument("-ip", default="127.0.0.1")
     s.add_argument("-master_port", type=int, default=9333)
     s.add_argument("-port", type=int, default=8080)
@@ -630,6 +649,16 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("-coder", default="auto")
     s.add_argument("-ec_large_block", type=int, default=1024 * 1024 * 1024)
     s.add_argument("-ec_small_block", type=int, default=1024 * 1024)
+    s.add_argument("-filer", action="store_true",
+                   help="also run a filer (weed server -filer)")
+    s.add_argument("-filer_port", type=int, default=8888)
+    s.add_argument("-filer_db", default="./filer.db")
+    s.add_argument("-s3", action="store_true",
+                   help="also run the S3 gateway (needs -filer)")
+    s.add_argument("-s3_port", type=int, default=8333)
+    s.add_argument("-s3_config", default="",
+                   help="JSON identities file for the embedded S3 gateway"
+                        " (anonymous without it, like `weed s3`)")
     s.set_defaults(fn=cmd_server)
 
     f = sub.add_parser("filer", help="run a filer server")
