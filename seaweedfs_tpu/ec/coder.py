@@ -157,8 +157,34 @@ class PallasCoder(ErasureCoder):
             gf256.parity_matrix(data_shards, parity_shards), tile=self._tile)
         self._rec_cache: dict = {}
 
+    def _shrink_tile(self) -> None:
+        """Fallback for chips whose VMEM can't hold the default tile:
+        quarter the tile and rebuild the kernels. VMEM overflows are
+        compile-time errors and jit compiles synchronously on first
+        dispatch, so they surface inside the retry loops below; genuine
+        runtime errors re-raise once the floor tile is reached."""
+        if self._tile <= 16384:
+            raise
+        import logging
+        logging.getLogger("ec.coder").warning(
+            "pallas kernel failed at tile %d; retrying at %d "
+            "(expected only for VMEM-constrained chips)",
+            self._tile, self._tile // 4)
+        self._tile //= 4
+        self._encode = self._mod.gf_apply_pallas(
+            gf256.parity_matrix(self.k, self.m), tile=self._tile)
+        self._rec_cache.clear()
+
+    def _run_encode(self, data):
+        while True:
+            try:
+                return self._encode(data)
+            except Exception:
+                self._shrink_tile()
+
     def encode(self, data: np.ndarray) -> np.ndarray:
-        return np.asarray(self._encode(np.asarray(data, dtype=np.uint8)))
+        return np.asarray(
+            self._run_encode(np.asarray(data, dtype=np.uint8)))
 
     def _rec_apply(self, present, missing):
         key = (present, missing)
@@ -172,13 +198,21 @@ class PallasCoder(ErasureCoder):
 
     def encode_async(self, data: np.ndarray):
         import jax
-        return self._encode(jax.device_put(np.asarray(data, dtype=np.uint8)))
+        return self._run_encode(
+            jax.device_put(np.asarray(data, dtype=np.uint8)))
 
     def rec_apply_async(self, present, missing):
         import jax
-        fn = self._rec_apply(present, missing)
-        return lambda survivors: fn(
-            jax.device_put(np.asarray(survivors, dtype=np.uint8)))
+
+        def run(survivors):
+            d = jax.device_put(np.asarray(survivors, dtype=np.uint8))
+            while True:
+                try:
+                    return self._rec_apply(present, missing)(d)
+                except Exception:
+                    self._shrink_tile()
+
+        return run
 
 
 class CppCoder(ErasureCoder):

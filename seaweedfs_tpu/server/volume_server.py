@@ -201,6 +201,7 @@ class VolumeServer:
         app.router.add_post("/admin/volume/configure_replication",
                             self.admin_volume_configure)
         app.router.add_get("/admin/volume/needle_ids", self.admin_needle_ids)
+        app.router.add_get("/admin/needle_raw", self.admin_needle_raw)
         app.router.add_post("/admin/tier/upload", self.admin_tier_upload)
         app.router.add_post("/admin/tier/download", self.admin_tier_download)
         app.router.add_post("/admin/ec/generate", self.admin_ec_generate)
@@ -401,7 +402,7 @@ class VolumeServer:
                 n = await asyncio.get_event_loop().run_in_executor(
                     None, lambda: self.store.read_needle(
                         fid.volume_id, fid.key, fid.cookie))
-            except (NeedleNotFound, KeyError):
+            except (NeedleNotFound, KeyError) as miss:
                 if (self.read_redirect
                         and self.store.find_volume(fid.volume_id) is None
                         and self.store.find_ec_volume(fid.volume_id) is None):
@@ -409,7 +410,22 @@ class VolumeServer:
                     if url:
                         raise web.HTTPMovedPermanently(
                             f"http://{url}/{fid}")
-                return web.json_response({"error": "not found"}, status=404)
+                # read repair: a replica of a volume we host may still have
+                # the needle (lost local write / corruption); fetch it,
+                # rewrite locally, and serve (the repair hook at
+                # weed/topology/store_replicate.go:163-194)
+                if (isinstance(miss, NeedleNotFound)
+                        and self.store.find_volume(fid.volume_id)
+                        is not None):
+                    repaired = await self._read_repair(fid)
+                    if repaired is not None:
+                        n = repaired
+                    else:
+                        return web.json_response({"error": "not found"},
+                                                 status=404)
+                else:
+                    return web.json_response({"error": "not found"},
+                                             status=404)
             except NeedleDeleted:
                 return web.json_response({"error": "deleted"}, status=404)
         etag = f'"{n.etag()}"'
@@ -484,6 +500,47 @@ class VolumeServer:
                                 content_type=mime)
         return web.Response(status=status, body=body, headers=headers,
                             content_type=mime)
+
+    async def _read_repair(self, fid: FileId):
+        """Fetch a locally-missing needle from a replica, re-append it
+        locally, and return it (None when no replica has it)."""
+        from ..storage.needle import Needle as NeedleCls
+        for url in await self._replica_urls(fid.volume_id):
+            try:
+                async with self._session.get(
+                        f"http://{url}/admin/needle_raw",
+                        params={"fid": str(fid)}) as r:
+                    if r.status != 200:
+                        continue
+                    raw = await r.read()
+                v = self.store.find_volume(fid.volume_id)
+                if v is None:
+                    return None
+                n = NeedleCls.from_bytes(raw, v.version)
+                await asyncio.get_event_loop().run_in_executor(
+                    None, lambda: v.write_needle(
+                        n, preserve_append_at_ns=True))
+                log.info("read-repaired needle %s from %s", fid, url)
+                self.metrics.count("read_repair")
+                return n
+            except Exception as e:
+                log.warning("read repair of %s from %s failed: %s",
+                            fid, url, e)
+        return None
+
+    async def admin_needle_raw(self, request: web.Request) -> web.Response:
+        """Raw needle record bytes for peer read-repair."""
+        try:
+            fid = FileId.parse(request.query["fid"])
+            v = self.store.find_volume(fid.volume_id)
+            if v is None:
+                return web.json_response({"error": "no volume"}, status=404)
+            n = await asyncio.get_event_loop().run_in_executor(
+                None, lambda: v.read_needle(fid.key, cookie=fid.cookie))
+            return web.Response(body=n.to_bytes(v.version),
+                                content_type="application/octet-stream")
+        except (NeedleNotFound, NeedleDeleted, KeyError, ValueError) as e:
+            return web.json_response({"error": str(e)}, status=404)
 
     async def _lookup_replica(self, vid: int) -> Optional[str]:
         try:

@@ -233,3 +233,34 @@ def test_suffix_range(cluster):
         assert r.read() == payload[-100:]
         assert r.headers["Content-Range"] == \
             f"bytes {len(payload)-100}-{len(payload)-1}/{len(payload)}"
+
+
+def test_read_repair_from_replica():
+    """A needle missing locally (lost write / index corruption) on a
+    replicated volume is fetched from a replica, re-appended locally, and
+    served (store_replicate.go:163-194 repair hook)."""
+    from cluster_util import Cluster
+    c = Cluster(n_volume_servers=2, default_replication="010")
+    try:
+        data = b"repair me " * 50
+        fid = c.client.upload(data)
+        c.wait_heartbeats()
+        from seaweedfs_tpu.storage.file_id import FileId
+        f = FileId.parse(fid)
+
+        # simulate the lost write on one replica: drop the needle from its
+        # in-memory map only (the .dat record "never happened")
+        victim = next(vs for vs in c.volume_servers
+                      if vs.store.find_volume(f.volume_id) is not None)
+        v = victim.store.find_volume(f.volume_id)
+        v.nm._map.pop(f.key)
+
+        import urllib.request
+        with urllib.request.urlopen(f"http://{victim.url}/{fid}",
+                                    timeout=10) as r:
+            assert r.read() == data
+        # repaired: the local map has it again, without remote help
+        assert v.nm.get(f.key) is not None
+        assert v.read_needle(f.key).data == data
+    finally:
+        c.shutdown()
