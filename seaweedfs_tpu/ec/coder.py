@@ -55,6 +55,12 @@ class ErasureCoder:
         """Like _rec_apply but the returned fn may defer computation."""
         return self._rec_apply(present, missing)
 
+    def _run_rec(self, present: tuple, missing: tuple,
+                 survivors: np.ndarray):
+        """Apply the reconstruction transform (hook so backends can add
+        retry/fallback around the kernel call)."""
+        return self._rec_apply(present, missing)(survivors)
+
     def materialize(self, handle) -> np.ndarray:
         """Block until a handle from encode_async/rec_apply_async is real."""
         return np.asarray(handle)
@@ -83,10 +89,10 @@ class ErasureCoder:
             return list(shards)
         if len(present) < self.k:
             raise ValueError("too few shards to reconstruct")
-        fn = self._rec_apply(present[:self.k], missing)
         survivors = np.stack([np.asarray(shards[i], dtype=np.uint8)
                               for i in present[:self.k]])
-        rebuilt = np.asarray(fn(survivors))
+        rebuilt = np.asarray(
+            self._run_rec(present[:self.k], missing, survivors))
         out = list(shards)
         for row, tgt in enumerate(missing):
             out[tgt] = rebuilt[row]
@@ -185,6 +191,13 @@ class PallasCoder(ErasureCoder):
     def encode(self, data: np.ndarray) -> np.ndarray:
         return np.asarray(
             self._run_encode(np.asarray(data, dtype=np.uint8)))
+
+    def _run_rec(self, present, missing, survivors):
+        while True:
+            try:
+                return self._rec_apply(present, missing)(survivors)
+            except Exception:
+                self._shrink_tile()
 
     def _rec_apply(self, present, missing):
         key = (present, missing)

@@ -1,12 +1,11 @@
 """Kernel FUSE adapter for WFS (`weed mount` equivalent,
 weed/command/mount_std.go:51).
 
-Thin: every FUSE callback delegates to the corresponding WFS method. The
-binding library is optional — this container images neither fusepy nor a
-/dev/fuse it could use, so the adapter imports lazily and `weed mount`
-reports a clear error when unavailable. All mount logic lives (and is
-tested) in wfs.py / dirty_pages.py, mirroring how the reference only
-unit-tests the pure-logic layers of weed/filesys/.
+Thin: every FUSE callback delegates to the corresponding WFS method. Two
+bindings are supported — fusepy when installed, otherwise the built-in
+ctypes binding to libfuse2 (mount/fuse_ctypes.py). All mount logic lives
+(and is unit-tested) in wfs.py / dirty_pages.py, mirroring how the
+reference splits weed/filesys/ from the bazil.org/fuse glue.
 """
 
 from __future__ import annotations
@@ -15,22 +14,51 @@ import errno
 import stat
 
 
+class _WfsAdapter:
+    """WFS with the couple of shims the kernel surface needs."""
+
+    def __init__(self, wfs):
+        self._w = wfs
+
+    def __getattr__(self, name):
+        return getattr(self._w, name)
+
+    def getattr(self, path: str) -> dict:
+        a = self._w.getattr(path)
+        mode = a["mode"]
+        if stat.S_IFMT(mode) == 0:
+            mode |= stat.S_IFREG
+        return {**a, "mode": mode}
+
+
 def mount(filer_url: str, mountpoint: str, collection: str = "",
           replication: str = "", chunk_size: int = 8 * 1024 * 1024,
           foreground: bool = True) -> None:
+    from .wfs import WFS
+
+    wfs = _WfsAdapter(WFS(filer_url, collection=collection,
+                          replication=replication,
+                          chunk_size=chunk_size, subscribe=True))
     try:
-        from fuse import FUSE, FuseOSError, Operations  # fusepy
-    except ImportError as e:
-        raise SystemExit(
-            "FUSE mount needs the 'fusepy' package and a /dev/fuse device; "
-            "neither ships in this environment. The full mount VFS is "
-            "available programmatically via seaweedfs_tpu.mount.WFS."
-        ) from e
+        _mount_fusepy(wfs, mountpoint, foreground)
+        return
+    except ImportError:
+        pass
+    # built-in ctypes binding (the image has libfuse2 + /dev/fuse but no
+    # fusepy)
+    from .fuse_ctypes import fuse_main
+    try:
+        code = fuse_main(mountpoint, wfs, foreground=foreground)
+        if code != 0:
+            raise SystemExit(f"fuse_main exited with {code}")
+    finally:
+        wfs.destroy()
 
-    from .wfs import WFS, FuseError
 
-    wfs = WFS(filer_url, collection=collection, replication=replication,
-              chunk_size=chunk_size, subscribe=True)
+def _mount_fusepy(wfs, mountpoint: str, foreground: bool) -> None:
+    from fuse import FUSE, FuseOSError, Operations  # fusepy
+
+    from .wfs import FuseError
 
     class WeedFuse(Operations):
         def _wrap(self, fn, *args):
@@ -40,11 +68,9 @@ def mount(filer_url: str, mountpoint: str, collection: str = "",
                 raise FuseOSError(e.errno or errno.EIO)
 
         def getattr(self, path, fh=None):
+            # wfs is the _WfsAdapter: the S_IFREG mode shim lives there
             a = self._wrap(wfs.getattr, path)
-            mode = a["mode"]
-            if stat.S_IFMT(mode) == 0:
-                mode |= stat.S_IFREG
-            return {"st_mode": mode, "st_size": a["size"],
+            return {"st_mode": a["mode"], "st_size": a["size"],
                     "st_mtime": a["mtime"], "st_uid": a["uid"],
                     "st_gid": a["gid"], "st_nlink": 1}
 
@@ -85,6 +111,22 @@ def mount(filer_url: str, mountpoint: str, collection: str = "",
 
         def truncate(self, path, length, fh=None):
             return self._wrap(wfs.truncate, path, length)
+
+        def link(self, link_path, target):
+            # fusepy argument order is (new, existing)
+            return self._wrap(wfs.link, target, link_path)
+
+        def setxattr(self, path, name, value, options, position=0):
+            return self._wrap(wfs.setxattr, path, name, value)
+
+        def getxattr(self, path, name, position=0):
+            return self._wrap(wfs.getxattr, path, name)
+
+        def listxattr(self, path):
+            return self._wrap(wfs.listxattr, path)
+
+        def removexattr(self, path, name):
+            return self._wrap(wfs.removexattr, path, name)
 
         def statfs(self, path):
             s = wfs.statfs()

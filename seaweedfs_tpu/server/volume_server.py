@@ -42,7 +42,8 @@ from ..storage.needle import (FLAG_IS_COMPRESSED,
                               FLAG_HAS_NAME, FLAG_HAS_TTL, Needle)
 from ..storage import types as t
 from ..storage.store import Store
-from ..storage.volume import (NeedleDeleted, NeedleNotFound, VolumeReadOnly)
+from ..storage.volume import (NeedleDeleted, NeedleExpired, NeedleNotFound,
+                              VolumeReadOnly)
 from ..security.guard import Guard, token_from_request
 from ..utils import metrics as metrics_mod
 
@@ -167,6 +168,8 @@ class VolumeServer:
         self._batcher: Optional[WriteBatcher] = None
         self._replica_cache: dict[int, tuple[list[str], float]] = {}
         self._shard_loc_cache: dict[int, tuple[dict, float]] = {}
+        self._repair_neg: dict[str, float] = {}
+        self._repair_inflight = 0
         self.app = self._build_app()
         # the EC read path fetches missing shards from peers through this
         store._remote_shard_reader = self._make_shard_reader
@@ -402,6 +405,9 @@ class VolumeServer:
                 n = await asyncio.get_event_loop().run_in_executor(
                     None, lambda: self.store.read_needle(
                         fid.volume_id, fid.key, fid.cookie))
+            except NeedleExpired:
+                # TTL expiry is not data loss: never repair it back
+                return web.json_response({"error": "not found"}, status=404)
             except (NeedleNotFound, KeyError) as miss:
                 if (self.read_redirect
                         and self.store.find_volume(fid.volume_id) is None
@@ -413,10 +419,13 @@ class VolumeServer:
                 # read repair: a replica of a volume we host may still have
                 # the needle (lost local write / corruption); fetch it,
                 # rewrite locally, and serve (the repair hook at
-                # weed/topology/store_replicate.go:163-194)
+                # weed/topology/store_replicate.go:163-194). Guarded by a
+                # negative cache + concurrency cap so scans of bogus fids
+                # cannot amplify into replica storms.
                 if (isinstance(miss, NeedleNotFound)
                         and self.store.find_volume(fid.volume_id)
-                        is not None):
+                        is not None
+                        and self._repair_permitted(str(fid))):
                     repaired = await self._read_repair(fid)
                     if repaired is not None:
                         n = repaired
@@ -501,15 +510,43 @@ class VolumeServer:
         return web.Response(status=status, body=body, headers=headers,
                             content_type=mime)
 
+    _REPAIR_NEG_TTL = 10.0
+    _REPAIR_MAX_INFLIGHT = 8
+
+    def _repair_permitted(self, fid_str: str) -> bool:
+        import time as time_mod
+        now = time_mod.monotonic()
+        if len(self._repair_neg) > 4096:
+            self._repair_neg = {k: v for k, v in self._repair_neg.items()
+                                if now - v < self._REPAIR_NEG_TTL}
+        seen = self._repair_neg.get(fid_str)
+        if seen is not None and now - seen < self._REPAIR_NEG_TTL:
+            return False
+        if self._repair_inflight >= self._REPAIR_MAX_INFLIGHT:
+            return False
+        return True
+
     async def _read_repair(self, fid: FileId):
         """Fetch a locally-missing needle from a replica, re-append it
         locally, and return it (None when no replica has it)."""
         from ..storage.needle import Needle as NeedleCls
+        self._repair_inflight += 1
+        try:
+            return await self._read_repair_inner(fid, NeedleCls)
+        finally:
+            self._repair_inflight -= 1
+
+    async def _read_repair_inner(self, fid: FileId, NeedleCls):
+        import time as time_mod
+        auth = (self.guard.sign_write(str(fid))
+                if self.guard.signing_key else "")
         for url in await self._replica_urls(fid.volume_id):
             try:
+                headers = ({"Authorization": f"BEARER {auth}"}
+                           if auth else {})
                 async with self._session.get(
                         f"http://{url}/admin/needle_raw",
-                        params={"fid": str(fid)}) as r:
+                        params={"fid": str(fid)}, headers=headers) as r:
                     if r.status != 200:
                         continue
                     raw = await r.read()
@@ -526,12 +563,22 @@ class VolumeServer:
             except Exception as e:
                 log.warning("read repair of %s from %s failed: %s",
                             fid, url, e)
+        self._repair_neg[str(fid)] = time_mod.monotonic()
         return None
 
     async def admin_needle_raw(self, request: web.Request) -> web.Response:
-        """Raw needle record bytes for peer read-repair."""
+        """Raw needle record bytes for peer read-repair. With a signing
+        key configured the peer must present a write or read JWT for the
+        fid — this endpoint returns needle content, so it enforces the
+        same token regime as the data path."""
         try:
             fid = FileId.parse(request.query["fid"])
+            token = token_from_request(request.headers, request.query)
+            canonical = str(fid)
+            if self.guard.verify_write(token, canonical) and \
+                    self.guard.verify_read(token, canonical):
+                return web.json_response({"error": "unauthorized"},
+                                         status=401)
             v = self.store.find_volume(fid.volume_id)
             if v is None:
                 return web.json_response({"error": "no volume"}, status=404)

@@ -29,6 +29,10 @@ class NeedleNotFound(KeyError):
     pass
 
 
+class NeedleExpired(NeedleNotFound):
+    """TTL expiry — distinct from a lost write so read repair skips it."""
+
+
 class NeedleDeleted(KeyError):
     pass
 
@@ -227,7 +231,7 @@ class Volume:
         if n.ttl.minutes() and n.has(FLAG_HAS_LAST_MODIFIED):
             deadline = n.last_modified + n.ttl.minutes() * 60
             if (now if now is not None else time.time()) >= deadline:
-                raise NeedleNotFound(f"needle {needle_id:x} expired")
+                raise NeedleExpired(f"needle {needle_id:x} expired")
         return n
 
     def read_needle_at(self, byte_offset: int, size: int) -> Needle:

@@ -1,0 +1,85 @@
+"""Real kernel FUSE mount e2e (the ctypes libfuse2 binding).
+
+Runs only where /dev/fuse + libfuse + fusermount exist (this image has
+all three). The mount runs as a subprocess; teardown lazy-unmounts.
+"""
+
+import ctypes.util
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+import pytest
+
+from cluster_util import Cluster
+
+fuse_available = (os.path.exists("/dev/fuse")
+                  and ctypes.util.find_library("fuse") is not None
+                  and shutil.which("fusermount") is not None
+                  and hasattr(os, "getuid") and os.getuid() == 0)
+
+pytestmark = pytest.mark.skipif(not fuse_available,
+                                reason="no usable /dev/fuse in this env")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_kernel_mount_end_to_end(tmp_path):
+    c = Cluster(n_volume_servers=1)
+    mnt = tmp_path / "mnt"
+    mnt.mkdir()
+    proc = None
+    try:
+        filer = c.add_filer(chunk_size=64 * 1024)
+        time.sleep(0.3)
+        env = dict(os.environ)
+        env["SEAWEEDFS_FORCE_CPU"] = "1"
+        env["PYTHONPATH"] = ":".join(
+            p for p in (env.get("PYTHONPATH", ""), _REPO_ROOT) if p)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu.cli", "mount",
+             "-filer", filer.url, "-dir", str(mnt)],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if os.path.ismount(mnt):
+                break
+            time.sleep(0.2)
+        assert os.path.ismount(mnt), "mount never appeared"
+
+        # kernel-path file operations
+        p = mnt / "kernel.txt"
+        p.write_bytes(b"written through the kernel")
+        assert p.read_bytes() == b"written through the kernel"
+        (mnt / "d").mkdir()
+        big = os.urandom(300_000)
+        (mnt / "d" / "big.bin").write_bytes(big)
+        assert (mnt / "d" / "big.bin").read_bytes() == big
+        assert sorted(os.listdir(mnt)) == ["d", "kernel.txt"]
+        os.rename(mnt / "kernel.txt", mnt / "d" / "moved.txt")
+        assert (mnt / "d" / "moved.txt").read_bytes() == \
+            b"written through the kernel"
+        os.setxattr(mnt / "d" / "moved.txt", "user.k", b"v")
+        assert os.getxattr(mnt / "d" / "moved.txt", "user.k") == b"v"
+        os.link(mnt / "d" / "moved.txt", mnt / "alias.txt")
+        os.remove(mnt / "d" / "moved.txt")
+        assert (mnt / "alias.txt").read_bytes() == \
+            b"written through the kernel"
+
+        # the data really lives in the filer, not the kernel cache
+        import urllib.request
+        with urllib.request.urlopen(
+                f"http://{filer.url}/alias.txt", timeout=10) as r:
+            assert r.read() == b"written through the kernel"
+    finally:
+        subprocess.run(["fusermount", "-u", "-z", str(mnt)],
+                       stderr=subprocess.DEVNULL)
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        c.shutdown()
