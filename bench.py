@@ -177,7 +177,8 @@ def _run_configs(work, coder, vol_size, kernel_n, kernel_reps, rebuild_reps,
     from seaweedfs_tpu import ec
     from seaweedfs_tpu.ec import pipeline
 
-    t = time.perf_counter()
+    started = time.perf_counter()
+    t = started
     base = os.path.join(work, "1")
     _make_volume(base + ".dat", vol_size)
     t = _phase("volume gen", t)
@@ -205,20 +206,33 @@ def _run_configs(work, coder, vol_size, kernel_n, kernel_reps, rebuild_reps,
         pipeline.stream_rebuild(base, coder, batch_size=batch)
         if rep > 0:
             times.append(time.perf_counter() - t0)
+        if rep >= 1 and time.perf_counter() - started > 420:
+            break  # degraded link: one timed rep is enough
     rebuild_p50 = statistics.median(times)
     shard_size = os.path.getsize(base + ec.to_ext(0))
     t = _phase(f"rebuild x{rebuild_reps + 1}", t)
 
     kernel_gbps = bench_kernel(10, 4, kernel_n, kernel_reps)
     t = _phase("kernel 10,4", t)
+
+    # the dev chip's tunnel degrades unpredictably under sustained load;
+    # optional phases yield once the soft budget is spent so the bench
+    # always emits its JSON line well inside the driver's patience
+    soft_deadline = started + 560
     sweep = {}
     for (k, m) in ((6, 3), (12, 4), (20, 4)):
+        if time.perf_counter() > soft_deadline:
+            sweep[f"{k},{m}"] = "skipped (time budget)"
+            continue
         n = kernel_n - kernel_n % (16384 * 8)
         sweep[f"{k},{m}"] = round(bench_kernel(k, m, n, kernel_reps), 2)
         t = _phase(f"kernel sweep {k},{m}", t)
 
-    fused = bench_fused(work, coder, vol_size)
-    t = _phase("fused pipeline", t)
+    if time.perf_counter() > soft_deadline:
+        fused = "skipped (time budget)"
+    else:
+        fused = bench_fused(work, coder, vol_size)
+        t = _phase("fused pipeline", t)
 
     print(json.dumps({
         "metric": "ec.encode pipeline GB/s/chip (.dat -> .ec00-13)",
