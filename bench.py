@@ -33,6 +33,12 @@ import numpy as np
 
 BASELINE_GBPS = 20.0  # BASELINE.json: ec.encode >= 20 GB/s/chip on v5e
 
+# soft time budgets for the degraded-tunnel case (one policy, two stages):
+# past REBUILD_BUDGET_S the rebuild loop keeps only its first timed rep;
+# past SOFT_BUDGET_S the optional sweep/fused phases are skipped
+REBUILD_BUDGET_S = 420.0
+SOFT_BUDGET_S = 560.0
+
 
 def _make_volume(path: str, size: int) -> None:
     rng = np.random.default_rng(7)
@@ -206,11 +212,11 @@ def _run_configs(work, coder, vol_size, kernel_n, kernel_reps, rebuild_reps,
         pipeline.stream_rebuild(base, coder, batch_size=batch)
         if rep > 0:
             times.append(time.perf_counter() - t0)
-        if rep >= 1 and time.perf_counter() - started > 420:
+        if rep >= 1 and time.perf_counter() - started > REBUILD_BUDGET_S:
             break  # degraded link: one timed rep is enough
     rebuild_p50 = statistics.median(times)
     shard_size = os.path.getsize(base + ec.to_ext(0))
-    t = _phase(f"rebuild x{rebuild_reps + 1}", t)
+    t = _phase(f"rebuild x{len(times) + 1}", t)
 
     kernel_gbps = bench_kernel(10, 4, kernel_n, kernel_reps)
     t = _phase("kernel 10,4", t)
@@ -218,18 +224,18 @@ def _run_configs(work, coder, vol_size, kernel_n, kernel_reps, rebuild_reps,
     # the dev chip's tunnel degrades unpredictably under sustained load;
     # optional phases yield once the soft budget is spent so the bench
     # always emits its JSON line well inside the driver's patience
-    soft_deadline = started + 560
+    soft_deadline = started + SOFT_BUDGET_S
     sweep = {}
     for (k, m) in ((6, 3), (12, 4), (20, 4)):
         if time.perf_counter() > soft_deadline:
-            sweep[f"{k},{m}"] = "skipped (time budget)"
+            sweep[f"{k},{m}"] = None  # skipped (time budget); type-stable
             continue
         n = kernel_n - kernel_n % (16384 * 8)
         sweep[f"{k},{m}"] = round(bench_kernel(k, m, n, kernel_reps), 2)
         t = _phase(f"kernel sweep {k},{m}", t)
 
     if time.perf_counter() > soft_deadline:
-        fused = "skipped (time budget)"
+        fused = {"skipped": True}
     else:
         fused = bench_fused(work, coder, vol_size)
         t = _phase("fused pipeline", t)
@@ -245,6 +251,7 @@ def _run_configs(work, coder, vol_size, kernel_n, kernel_reps, rebuild_reps,
             "kernel_gbps": round(kernel_gbps, 2),
             "kernel_vs_target": round(kernel_gbps / BASELINE_GBPS, 3),
             "rebuild_p50_s": round(rebuild_p50, 3),
+            "rebuild_reps_used": len(times),
             "rebuild_gbps": round(
                 10 * shard_size / rebuild_p50 / 1e9, 2),
             "sweep_kernel_gbps": sweep,
