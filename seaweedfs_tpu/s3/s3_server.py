@@ -431,14 +431,22 @@ class S3Server:
         delimiter = q.get("delimiter", "")
         max_keys = int(q.get("max-keys", 1000))
         marker = q.get("continuation-token" if v2 else "marker", "")
+        if v2 and not marker:
+            # V2 start-after applies only on the first page
+            marker = q.get("start-after", "")
+        url_encode = q.get("encoding-type") == "url"
 
         contents, common_prefixes, truncated, next_marker = \
             await self._walk_listing(bucket, prefix, delimiter, marker,
                                      max_keys)
 
+        def enc(v: str) -> str:
+            # encoding-type=url applies to every key-derived field
+            return urllib.parse.quote(v) if url_encode else v
+
         root = ET.Element("ListBucketResult", xmlns=XMLNS)
         ET.SubElement(root, "Name").text = bucket
-        ET.SubElement(root, "Prefix").text = prefix
+        ET.SubElement(root, "Prefix").text = enc(prefix)
         ET.SubElement(root, "MaxKeys").text = str(max_keys)
         ET.SubElement(root, "IsTruncated").text = \
             "true" if truncated else "false"
@@ -448,12 +456,14 @@ class S3Server:
                 ET.SubElement(root, "NextContinuationToken").text = \
                     next_marker
         elif truncated:
-            ET.SubElement(root, "NextMarker").text = next_marker
+            ET.SubElement(root, "NextMarker").text = enc(next_marker)
         if delimiter:
-            ET.SubElement(root, "Delimiter").text = delimiter
+            ET.SubElement(root, "Delimiter").text = enc(delimiter)
+        if url_encode:
+            ET.SubElement(root, "EncodingType").text = "url"
         for key, entry in contents:
             c = ET.SubElement(root, "Contents")
-            ET.SubElement(c, "Key").text = key
+            ET.SubElement(c, "Key").text = enc(key)
             ET.SubElement(c, "LastModified").text = _iso(
                 entry["attr"].get("mtime", 0))
             ET.SubElement(c, "ETag").text = f'"{_entry_etag(entry)}"'
@@ -461,7 +471,7 @@ class S3Server:
             ET.SubElement(c, "StorageClass").text = "STANDARD"
         for p in sorted(common_prefixes):
             cp = ET.SubElement(root, "CommonPrefixes")
-            ET.SubElement(cp, "Prefix").text = p
+            ET.SubElement(cp, "Prefix").text = enc(p)
         return _xml(root)
 
     async def _walk_listing(self, bucket: str, prefix: str, delimiter: str,

@@ -12,6 +12,7 @@ import hmac
 import json
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 
 import pytest
@@ -326,3 +327,41 @@ def test_multipart_with_manifested_part(cluster, s3):
         assert got == part1 + part2
     finally:
         filer.manifest_batch = old_batch
+
+
+def test_list_v2_start_after_and_encoding(s3):
+    req(s3, "PUT", "/lv2bucket").read()
+    for k in ("a.txt", "b c.txt", "d.txt"):
+        req(s3, "PUT", f"/lv2bucket/{urllib.parse.quote(k)}",
+            data=b"x").read()
+    with req(s3, "GET", "/lv2bucket?list-type=2&start-after=a.txt") as r:
+        xml = r.read().decode()
+    assert "<Key>a.txt</Key>" not in xml
+    assert "<Key>b c.txt</Key>" in xml and "<Key>d.txt</Key>" in xml
+    with req(s3, "GET",
+             "/lv2bucket?list-type=2&encoding-type=url") as r:
+        xml = r.read().decode()
+    assert "<EncodingType>url</EncodingType>" in xml
+    assert "<Key>b%20c.txt</Key>" in xml
+
+
+def test_shell_repl_smoke(cluster, s3):
+    """The interactive REPL accepts piped commands and emits JSON lines."""
+    import subprocess
+    import sys
+    env = dict(__import__("os").environ)
+    env["SEAWEEDFS_FORCE_CPU"] = "1"
+    repo = __import__("os").path.dirname(
+        __import__("os").path.dirname(__import__("os").path.abspath(
+            __file__)))
+    env["PYTHONPATH"] = ":".join(
+        p for p in (env.get("PYTHONPATH", ""), repo) if p)
+    master = cluster.master_url.split(",")[0]
+    out = subprocess.run(
+        [sys.executable, "-m", "seaweedfs_tpu.cli", "shell",
+         "-server", master],
+        input="volume.list\nhelp\nexit\n", text=True,
+        capture_output=True, env=env, timeout=60)
+    assert out.returncode == 0, out.stderr[-500:]
+    assert '"nodes"' in out.stdout
+    assert "volume.balance" in out.stdout
