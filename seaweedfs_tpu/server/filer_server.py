@@ -87,6 +87,11 @@ class FilerServer:
         # entries fold chunk lists into manifest blobs past this many
         # chunks (filechunk_manifest.go ManifestBatch)
         self.manifest_batch = manifest_mod.MANIFEST_BATCH
+        # hot-chunk LRU (weed/util/chunk_cache via filer reader_at.go):
+        # repeated and ranged reads of the same chunk skip the volume
+        # server round trip
+        from ..utils.chunk_cache import ChunkCache
+        self.chunk_cache = ChunkCache()
         self.notifier = notifier
         if notifier is not None:
             self.filer.meta_log.subscribe(notifier.notify)
@@ -406,6 +411,8 @@ class FilerServer:
 
     # --- chunk-freeing queue (filer_deletion.go) ---
     def _queue_chunk_deletes(self, chunks: list[FileChunk]) -> None:
+        for c in chunks:
+            self.chunk_cache.drop(c.fid)  # never serve freed chunks
         if self._loop is None:
             return
         for c in chunks:
@@ -587,16 +594,28 @@ class FilerServer:
                          cipher_key=cipher_key)
 
     async def _fetch_view(self, fid: str, offset_in_chunk: int,
-                          size: int, cipher_key: str = "") -> bytes:
+                          size: int, cipher_key: str = "",
+                          chunk_size: int = 0) -> bytes:
+        cached = self.chunk_cache.get(fid)
+        if cached is not None:
+            return cached[offset_in_chunk:offset_in_chunk + size]
         if cipher_key:
             # encrypted chunks cannot be range-read: fetch whole, decrypt,
-            # slice (reader side of filer_server_handlers_write_cipher.go)
+            # slice (reader side of filer_server_handlers_write_cipher.go);
+            # the cache holds plaintext so the key never needs re-fetching
             from ..utils import cipher as cipher_mod
             whole = await self._fetch_raw(fid)
             plain = await asyncio.get_event_loop().run_in_executor(
                 None, cipher_mod.decrypt, whole,
                 cipher_mod.key_from_str(cipher_key))
+            self.chunk_cache.put(fid, plain)
             return plain[offset_in_chunk:offset_in_chunk + size]
+        if 0 < chunk_size <= self.chunk_cache.max_chunk_bytes:
+            # cacheable chunk: fetch it whole like the reference's
+            # ChunkReaderAt so later views of the same chunk are local
+            whole = await self._fetch_raw(fid)
+            self.chunk_cache.put(fid, whole)
+            return whole[offset_in_chunk:offset_in_chunk + size]
         return await self._fetch_raw(fid, offset_in_chunk, size)
 
     async def _fetch_raw(self, fid: str, offset_in_chunk: int = 0,
@@ -701,6 +720,7 @@ class FilerServer:
                 chunks, self._fetch_manifest_blob)
         plan = read_plan(chunks, start, length)
         keys = {c.fid: c.cipher_key for c in chunks if c.cipher_key}
+        sizes = {c.fid: c.size for c in chunks}
         written = start
         for view in plan:
             if view.logic_offset > written:
@@ -709,7 +729,8 @@ class FilerServer:
                 written = view.logic_offset
             data = await self._fetch_view(view.fid, view.offset_in_chunk,
                                           view.size,
-                                          cipher_key=keys.get(view.fid, ""))
+                                          cipher_key=keys.get(view.fid, ""),
+                                          chunk_size=sizes.get(view.fid, 0))
             await resp.write(data)
             written += len(data)
         if written < start + length:

@@ -206,3 +206,55 @@ def test_super_chunked_file_end_to_end():
         assert live == 0, f"{live} chunks never freed"
     finally:
         c.shutdown()
+
+
+def test_chunk_cache_serves_repeat_reads():
+    c = Cluster(n_volume_servers=1)
+    try:
+        fs = c.add_filer(chunk_size=4 * 1024)
+        body = bytes(range(256)) * 64  # 16KB -> 4 chunks
+        urllib.request.urlopen(
+            urllib.request.Request(f"http://{fs.url}/cc/data.bin",
+                                   data=body, method="PUT"),
+            timeout=10).read()
+        with urllib.request.urlopen(f"http://{fs.url}/cc/data.bin",
+                                    timeout=10) as r:
+            assert r.read() == body
+        stats1 = fs.chunk_cache.stats()
+        assert stats1["chunks"] == 4
+        # second full read + a ranged read come from the cache
+        with urllib.request.urlopen(f"http://{fs.url}/cc/data.bin",
+                                    timeout=10) as r:
+            assert r.read() == body
+        req = urllib.request.Request(f"http://{fs.url}/cc/data.bin",
+                                     headers={"Range": "bytes=5000-9000"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.read() == body[5000:9001]
+        stats2 = fs.chunk_cache.stats()
+        assert stats2["hits"] > stats1["hits"]
+        assert stats2["misses"] == stats1["misses"]
+
+        # overwrite: the stale chunks are dropped, reads see new content
+        body2 = b"Z" * len(body)
+        urllib.request.urlopen(
+            urllib.request.Request(f"http://{fs.url}/cc/data.bin",
+                                   data=body2, method="PUT"),
+            timeout=10).read()
+        with urllib.request.urlopen(f"http://{fs.url}/cc/data.bin",
+                                    timeout=10) as r:
+            assert r.read() == body2
+    finally:
+        c.shutdown()
+
+
+def test_chunk_cache_lru_eviction():
+    from seaweedfs_tpu.utils.chunk_cache import ChunkCache
+    cc = ChunkCache(max_bytes=1000, max_chunk_bytes=400)
+    cc.put("a", b"x" * 400)
+    cc.put("b", b"y" * 400)
+    cc.put("c", b"z" * 400)  # evicts a
+    assert cc.get("a") is None
+    assert cc.get("b") is not None
+    cc.put("big", b"w" * 500)  # over max_chunk_bytes: not cached
+    assert cc.get("big") is None
+    assert cc.stats()["bytes"] <= 1000
