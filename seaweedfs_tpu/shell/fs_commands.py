@@ -187,6 +187,18 @@ def fs_tree(env: CommandEnv, argv: list[str]):
     return {"tree": lines}
 
 
+@command("fs.meta.cat",
+         "print one entry's full metadata (fs.meta.cat /path)")
+def fs_meta_cat(env: CommandEnv, argv: list[str]):
+    _require_filer(env)
+    if not argv:
+        raise ClientError("fs.meta.cat needs a path")
+    out = env.filer_get("/__meta__/lookup", {"path": env.resolve(argv[0])})
+    if "error" in out:
+        raise ClientError(out["error"])
+    return out
+
+
 @command("fs.meta.save",
          "export filer metadata to a local JSONL file "
          "(fs.meta.save [-o file] [path])")

@@ -557,6 +557,30 @@ def plan_evacuate(nodes: list[dict], victim: str) -> list[dict]:
     return moves
 
 
+@command("volumeServer.leave",
+         "evacuate a server and confirm it is empty "
+         "(volumeServer.leave -node url -force)", destructive=True)
+def volume_server_leave(env: CommandEnv, argv: list[str]):
+    """command_volume_server_leave.go: drain then verify nothing remains
+    (the server can then be shut down safely; the master prunes it once
+    heartbeats stop)."""
+    p = parser("volumeServer.leave")
+    p.add_argument("-node", required=True)
+    p.add_argument("-force", action="store_true")
+    args = p.parse_args(argv)
+    out = volume_server_evacuate(
+        env, ["-node", args.node] + (["-force"] if args.force else []))
+    if args.force:
+        nodes = {nd["url"]: nd for nd in _nodes(env)}
+        left = nodes.get(args.node, {})
+        remaining = (len(left.get("volumes", []))
+                     + sum(len(s.get("shard_ids", []))
+                           for s in left.get("ec_shards", [])))
+        out["drained"] = remaining == 0
+        out["remaining"] = remaining
+    return out
+
+
 @command("volumeServer.evacuate",
          "move everything off a server "
          "(volumeServer.evacuate -node url [-force])", destructive=True)

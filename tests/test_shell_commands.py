@@ -234,3 +234,36 @@ def test_e2e_exclusive_lock(cluster):
     out2 = run_command(env2, ["lock"])
     assert out2["token"]
     run_command(env2, ["unlock"])
+
+
+def test_e2e_evacuate_and_leave(cluster):
+    c = cluster
+    fid = c.client.upload(b"evacuate me " * 40)
+    vid = int(fid.split(",")[0])
+    c.wait_heartbeats()
+    src = c.client.lookup(vid)[0]
+    env = _env(c)
+    plan = run_command(env, ["volumeServer.evacuate", "-node", src])
+    assert plan["applied"] is False and plan["plan"]
+    out = run_command(env, ["volumeServer.leave", "-node", src, "-force"])
+    assert out["applied"]
+    c.wait_heartbeats()
+    c.client._vid_cache.clear()
+    assert src not in c.client.lookup(vid)
+    assert c.client.download(fid) == b"evacuate me " * 40
+
+
+def test_e2e_fs_meta_cat(cluster):
+    c = cluster
+    fs = c.add_filer()
+    import time as time_mod
+    time_mod.sleep(0.3)
+    import urllib.request
+    urllib.request.urlopen(
+        urllib.request.Request(f"http://{fs.url}/mc/x.txt",
+                               data=b"meta me", method="PUT"),
+        timeout=10).read()
+    env = _env(c, filer=fs.url)
+    meta = run_command(env, ["fs.meta.cat", "/mc/x.txt"])
+    assert meta["path"] == "/mc/x.txt"
+    assert meta["chunks"]
