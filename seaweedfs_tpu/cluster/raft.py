@@ -37,6 +37,33 @@ log = logging.getLogger("raft")
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
 
 
+def _endpoint_ips(addr: str) -> tuple[set, str]:
+    """(resolved host-IP set incl. the literal, port) for host:port."""
+    import socket
+    host, _, port = addr.rpartition(":")
+    ips = {host}
+    try:
+        for info in socket.getaddrinfo(host, None):
+            ips.add(info[4][0])
+    except OSError:
+        pass
+    return ips, port
+
+
+def same_endpoint(a: str, b: str) -> bool:
+    """Whether two host:port strings name the same endpoint, resolving
+    hostnames — "localhost:9333" and "127.0.0.1:9333" are the same node.
+    A node that fails to recognize itself in the peer list keeps itself
+    as a peer and heartbeats its own HTTP endpoint; the AppendEntries it
+    receives from "the leader" (itself) then demotes it to follower,
+    so elections churn forever."""
+    if a == b:
+        return True
+    a_ips, a_port = _endpoint_ips(a)
+    b_ips, b_port = _endpoint_ips(b)
+    return a_port == b_port and bool(a_ips & b_ips)
+
+
 class RaftNode:
     def __init__(self, node_id: str, peers: list[str],
                  apply_fn: Callable[[dict], None],
@@ -47,7 +74,7 @@ class RaftNode:
                  restore_fn: Optional[Callable[[dict], None]] = None,
                  max_log_entries: int = 256):
         self.id = node_id
-        self.peers = [p for p in peers if p != node_id]
+        self.peers = [p for p in peers if not same_endpoint(p, node_id)]
         self.apply_fn = apply_fn
         # snapshotting (goraft persisted MaxVolumeId the same way,
         # raft_server.go:34-51): capture_fn serializes the applied state
@@ -78,6 +105,11 @@ class RaftNode:
         self.match_index: dict[str, int] = {}
 
         self._session = None
+        # all durable writes ride this one thread, keeping them ordered
+        # while the event loop (raft heartbeats) never waits on fsync
+        import concurrent.futures
+        self._save_exec = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="raft-save")
         self._tasks: list[asyncio.Task] = []
         self._timer_reset = asyncio.Event()
         self._commit_waiters: list[tuple[int, int, asyncio.Future]] = []
@@ -104,6 +136,7 @@ class RaftNode:
             t.cancel()
         if self._session:
             await self._session.close()
+        self._save_exec.shutdown(wait=False)
 
     def _load_state(self) -> None:
         if self.state_path and os.path.exists(self.state_path):
@@ -121,13 +154,56 @@ class RaftNode:
     def _save_state(self) -> None:
         if not self.state_path:
             return
+        self._write_state(self._serialize_state())
+
+    def _serialize_state(self) -> str:
+        """Serialize on the event loop so the written snapshot is always a
+        consistent point-in-time view, even though the write itself may run
+        on the save thread."""
+        return json.dumps({"term": self.term, "voted_for": self.voted_for,
+                           "log": self.log, "snap_index": self.snap_index,
+                           "snap_term": self.snap_term,
+                           "snap_state": self.snap_state})
+
+    def _write_state(self, data: str) -> None:
         tmp = self.state_path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"term": self.term, "voted_for": self.voted_for,
-                       "log": self.log, "snap_index": self.snap_index,
-                       "snap_term": self.snap_term,
-                       "snap_state": self.snap_state}, f)
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, self.state_path)
+        # fsync the directory so the rename itself survives power loss —
+        # a vote that vanishes lets this node vote twice in one term,
+        # breaking election safety
+        dfd = os.open(os.path.dirname(self.state_path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+
+    async def _flush_state(self) -> None:
+        """Durable save without blocking the event loop: the two fsyncs run
+        on a one-thread executor (ordering preserved — serialization happens
+        here on the loop, writes queue in submission order)."""
+        if not self.state_path:
+            return
+        data = self._serialize_state()
+        await asyncio.get_event_loop().run_in_executor(
+            self._save_exec, self._write_state, data)
+
+    def _schedule_flush(self) -> None:
+        """Fire-and-forget flush for synchronous callers (_step_down from
+        response processing, log compaction)."""
+        if not self.state_path:
+            return
+        try:
+            t = asyncio.ensure_future(self._flush_state())
+            t.add_done_callback(
+                lambda t: t.cancelled() or t.exception() is None or
+                log.error("%s: state flush failed: %s",
+                          self.id, t.exception()))
+        except RuntimeError:  # no running loop (tests driving the node)
+            self._save_state()
 
     # --- log helpers (1-based global indices; the in-memory list holds
     #     entries (snap_index, snap_index + len(log)]) ---
@@ -156,7 +232,7 @@ class RaftNode:
         del self.log[:cut]
         self.snap_index = self.last_applied
         self.snap_state = self.capture_fn() if self.capture_fn else {}
-        self._save_state()
+        self._schedule_flush()
 
     @property
     def is_leader(self) -> bool:
@@ -183,8 +259,10 @@ class RaftNode:
         self.role = CANDIDATE
         self.term += 1
         self.voted_for = self.id
-        self._save_state()
         term = self.term
+        await self._flush_state()
+        if self.term != term or self.role != CANDIDATE:
+            return  # a higher-term RPC arrived during the fsync
         log.info("%s: starting election for term %d", self.id, term)
         votes = 1
         req = {"term": term, "candidate_id": self.id,
@@ -219,11 +297,14 @@ class RaftNode:
         self._prune_tasks()
         self._tasks.append(asyncio.create_task(self._leader_loop()))
 
-    def _step_down(self, term: int) -> None:
+    def _step_down(self, term: int, flush: bool = True) -> None:
         if term > self.term:
             self.term = term
             self.voted_for = None
-            self._save_state()
+            # RPC handlers pass flush=False and fold the term bump into
+            # the flush they await before replying — one fsync, not two
+            if flush:
+                self._schedule_flush()
         if self.role != FOLLOWER:
             log.info("%s: stepping down at term %d", self.id, term)
         self.role = FOLLOWER
@@ -328,7 +409,7 @@ class RaftNode:
         if self.role != LEADER:
             return False
         self.log.append({"term": self.term, "cmd": cmd})
-        self._save_state()
+        await self._flush_state()
         index = self._last_index()
         if not self.peers:
             self.commit_index = index
@@ -343,9 +424,10 @@ class RaftNode:
             return False
 
     # --- RPC handlers (wired into the master app) ---
-    def handle_vote(self, req: dict) -> dict:
-        if req["term"] > self.term:
-            self._step_down(req["term"])
+    async def handle_vote(self, req: dict) -> dict:
+        term_changed = req["term"] > self.term
+        if term_changed:
+            self._step_down(req["term"], flush=False)
         granted = False
         if req["term"] == self.term and \
                 self.voted_for in (None, req["candidate_id"]):
@@ -355,15 +437,21 @@ class RaftNode:
             if up_to_date:
                 granted = True
                 self.voted_for = req["candidate_id"]
-                self._save_state()
                 self._timer_reset.set()
+        if term_changed or granted:
+            # persist term + vote BEFORE replying — election safety
+            await self._flush_state()
         return {"term": self.term, "granted": granted}
 
-    def handle_append(self, req: dict) -> dict:
+    async def handle_append(self, req: dict) -> dict:
         if req["term"] < self.term:
             return {"term": self.term, "success": False}
-        if req["term"] > self.term or self.role != FOLLOWER:
-            self._step_down(req["term"])
+        term_changed = req["term"] > self.term
+        if term_changed or self.role != FOLLOWER:
+            self._step_down(req["term"], flush=False)
+        if term_changed:
+            # persist the observed term BEFORE acking anything at it
+            await self._flush_state()
         self.leader_id = req["leader_id"]
         self._timer_reset.set()
 
@@ -378,7 +466,7 @@ class RaftNode:
             self.snap_state = snap["state"]
             self.commit_index = max(self.commit_index, snap["index"])
             self.last_applied = max(self.last_applied, snap["index"])
-            self._save_state()
+            await self._flush_state()
 
         prev = req["prev_log_index"]
         if prev < self.snap_index:
@@ -399,7 +487,8 @@ class RaftNode:
             else:
                 self.log.append(entry)
         if req["entries"]:
-            self._save_state()
+            # persist appended entries BEFORE acking them to the leader
+            await self._flush_state()
         if req["leader_commit"] > self.commit_index:
             self.commit_index = min(req["leader_commit"], self._last_index())
             self._apply_committed()

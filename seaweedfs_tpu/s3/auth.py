@@ -147,58 +147,74 @@ async def read_chunked_sigv4(content, seed_signature: str = "",
         out += data
 
 
-def verify_post_policy(fields: dict, iam: Iam) -> tuple[bool, str]:
+# sentinel for a malformed content-length-range in a signed policy — the
+# upload handler maps exactly this to 400 InvalidPolicyDocument
+ERR_BAD_LENGTH_RANGE = "invalid content-length-range"
+
+
+def verify_post_policy(
+        fields: dict, iam: Iam) -> tuple[bool, str, Optional[tuple[int, int]]]:
     """Verify a browser POST upload (policy/post-policy): the policy is a
     base64 JSON document signed with the SigV4 chain; expiry and eq /
-    starts-with conditions must hold for the submitted fields."""
+    starts-with conditions must hold for the submitted fields. Returns
+    (ok, why, content_length_range) — the range comes from THIS parse so
+    the upload handler, the only place that sees the payload size, never
+    re-parses (and can't drift from) the verified document."""
     policy_b64 = fields.get("policy", "")
     if not policy_b64:
-        return False, "missing policy"
+        return False, "missing policy", None
     credential = fields.get("x-amz-credential", "")
     signature = fields.get("x-amz-signature", "")
     amz_date = fields.get("x-amz-date", "")
     try:
         akid, date, region, service, _ = credential.split("/")
     except ValueError:
-        return False, "malformed credential"
+        return False, "malformed credential", None
     found = iam.lookup(akid)
     if found is None:
-        return False, "unknown access key"
+        return False, "unknown access key", None
     _, secret = found
     key = signing_key(secret, date, region, service)
     want = hmac.new(key, policy_b64.encode(), hashlib.sha256).hexdigest()
     if not hmac.compare_digest(want, signature):
-        return False, "signature mismatch"
+        return False, "signature mismatch", None
     try:
         policy = json.loads(base64.b64decode(policy_b64))
     except (ValueError, binascii.Error):
-        return False, "unreadable policy"
+        return False, "unreadable policy", None
     exp = policy.get("expiration", "")
     try:
         import calendar
         deadline = calendar.timegm(time.strptime(
             exp.split(".")[0].rstrip("Z"), "%Y-%m-%dT%H:%M:%S"))
     except ValueError:
-        return False, "bad expiration"
+        return False, "bad expiration", None
     if time.time() > deadline:
-        return False, "policy expired"
+        return False, "policy expired", None
+    length_range: Optional[tuple[int, int]] = None
     for cond in policy.get("conditions", []):
         if isinstance(cond, dict):
             for k, v in cond.items():
                 k = k.lstrip("$").lower()
                 if k == "bucket":
                     if fields.get("bucket", "") != v:
-                        return False, f"condition failed: bucket != {v}"
+                        return False, f"condition failed: bucket != {v}", None
                 elif fields.get(k, "") != v:
-                    return False, f"condition failed: {k}"
+                    return False, f"condition failed: {k}", None
         elif isinstance(cond, list) and len(cond) == 3:
             op, name, val = cond
             name = str(name).lstrip("$").lower()
             have = fields.get(name, "")
             if op == "eq" and have != val:
-                return False, f"condition failed: {name}"
+                return False, f"condition failed: {name}", None
             if op == "starts-with" and not have.startswith(val):
-                return False, f"condition failed: {name} prefix"
-            # content-length-range is checked by the caller with the
-            # actual payload size
-    return True, ""
+                return False, f"condition failed: {name} prefix", None
+            if str(op).lower() == "content-length-range":
+                # enforced by the caller (only it sees the payload size);
+                # malformed bounds in a *signed* policy are the signer's
+                # bug — reject as a bad document, not a 500
+                try:
+                    length_range = (int(name), int(val))
+                except (TypeError, ValueError):
+                    return False, ERR_BAD_LENGTH_RANGE, None
+    return True, "", length_range

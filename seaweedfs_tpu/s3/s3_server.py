@@ -607,9 +607,24 @@ class S3Server:
         if file_data is None:
             return _error("BadRequest", "missing file field", 400)
         if self.iam.enabled:
-            ok, why = auth_mod.verify_post_policy(fields, self.iam)
+            ok, why, length_range = auth_mod.verify_post_policy(
+                fields, self.iam)
             if not ok:
+                # sentinel match — a *condition* merely named
+                # content-length-range failing is still AccessDenied
+                if why == auth_mod.ERR_BAD_LENGTH_RANGE:
+                    return _error("InvalidPolicyDocument", why, 400)
                 return _error("AccessDenied", why, 403)
+            # content-length-range is the one policy condition only the
+            # caller can check (it needs the actual payload size)
+            if length_range is not None:
+                lo, hi = length_range
+                if len(file_data) < lo:
+                    return _error("EntityTooSmall",
+                                  f"{len(file_data)} < {lo}", 400)
+                if len(file_data) > hi:
+                    return _error("EntityTooLarge",
+                                  f"{len(file_data)} > {hi}", 400)
             # the signing identity still needs Write on this bucket — a
             # policy signature must not bypass the per-action ACL
             akid = fields.get("x-amz-credential", "").split("/")[0]

@@ -22,7 +22,7 @@ from typing import Optional
 
 from aiohttp import web
 
-from ..cluster.raft import RaftNode
+from ..cluster.raft import RaftNode, _endpoint_ips
 from ..security.guard import Guard
 from ..storage.file_id import FileId, new_cookie
 from ..topology.sequence import MemorySequencer
@@ -86,7 +86,9 @@ class MasterServer:
         self.admin_lease_seconds = 10.0
         # peer masters are implicitly trusted: raft RPCs and proxied
         # follower->leader traffic must pass any configured IP whitelist
-        self._peer_ips = {p.split(":")[0] for p in (peers or [])}
+        self._peer_strings = list(peers or [])
+        self._peer_ips = self._resolve_peer_ips(self._peer_strings)
+        self._peer_resolve_ts = 0.0
         self._proxy_session = None
         self.grpc_port = grpc_port
         self._grpc_server = None
@@ -135,7 +137,8 @@ class MasterServer:
             if request.path != "/healthz":
                 remote = request.remote or ""
                 if remote not in self._peer_ips and \
-                        not self.guard.check_whitelist(remote):
+                        not self.guard.check_whitelist(remote) and \
+                        not await self._refresh_peer_ips(remote):
                     return web.json_response({"error": "ip not allowed"},
                                              status=403)
             return await handler(request)
@@ -196,29 +199,65 @@ class MasterServer:
             await self._proxy_session.close()
         await self.raft.stop()
 
+    @staticmethod
+    def _resolve_peer_ips(peers) -> set:
+        """Peer trust set: each configured peer's host part, both as the
+        literal string and every address it resolves to. request.remote is
+        always an IP, so peers configured by hostname (DNS / k8s service
+        names) would never match the literal alone and raft RPCs would all
+        be 403'd — no leader could ever be elected. Resolution itself is
+        shared with raft's self-recognition (cluster/raft.py)."""
+        ips = set()
+        for p in peers:
+            ips |= _endpoint_ips(p)[0]
+        return ips
+
+    async def _refresh_peer_ips(self, remote: str) -> bool:
+        """Re-resolve the peer trust set and report whether `remote` is now
+        in it. DNS entries go stale — a rescheduled k8s peer pod gets a new
+        IP the one-shot resolution at __init__ never saw, and without this
+        its raft RPCs would be 403'd until every other master restarted.
+        Rate-limited so unknown clients can't turn the master into a DNS
+        query loop, and resolved off-loop so a slow resolver never stalls
+        raft heartbeats."""
+        import time as time_mod
+        now = time_mod.monotonic()
+        if now - self._peer_resolve_ts < 2.0:
+            return False
+        self._peer_resolve_ts = now
+        resolved = await asyncio.get_event_loop().run_in_executor(
+            None, self._resolve_peer_ips, self._peer_strings)
+        # merge, never replace: a transient resolver failure must not evict
+        # known-good peer IPs and 403 healthy raft traffic mid-blip
+        self._peer_ips |= resolved
+        return remote in self._peer_ips
+
     # --- raft plumbing ---
-    def _raft_peer_check(self, request: web.Request):
+    async def _raft_peer_check(self, request: web.Request):
         """Raft RPCs are master-to-master only: accept them solely from
         configured peers (single-master deployments reject them outright).
         Without this, any API-whitelisted client could forge AppendEntries
         and depose leaders / inject state."""
-        if (request.remote or "") not in self._peer_ips:
+        remote = request.remote or ""
+        if remote not in self._peer_ips and \
+                not await self._refresh_peer_ips(remote):
             return web.json_response({"error": "not a raft peer"},
                                      status=403)
         return None
 
     async def raft_vote(self, request: web.Request) -> web.Response:
-        denied = self._raft_peer_check(request)
-        if denied:
-            return denied
-        return web.json_response(self.raft.handle_vote(await request.json()))
-
-    async def raft_append(self, request: web.Request) -> web.Response:
-        denied = self._raft_peer_check(request)
+        denied = await self._raft_peer_check(request)
         if denied:
             return denied
         return web.json_response(
-            self.raft.handle_append(await request.json()))
+            await self.raft.handle_vote(await request.json()))
+
+    async def raft_append(self, request: web.Request) -> web.Response:
+        denied = await self._raft_peer_check(request)
+        if denied:
+            return denied
+        return web.json_response(
+            await self.raft.handle_append(await request.json()))
 
     async def _proxy_to(self, leader: str, request: web.Request):
         import aiohttp

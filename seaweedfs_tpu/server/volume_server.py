@@ -85,6 +85,7 @@ class WriteBatcher:
 
     MAX_BATCH = 128
     MAX_BYTES = 4 * 1024 * 1024
+    IDLE_SECONDS = 30.0  # worker exits after this long with no writes
 
     def __init__(self, store: Store):
         self.store = store
@@ -106,7 +107,19 @@ class WriteBatcher:
     async def _worker(self, vid: int, q: asyncio.Queue) -> None:
         loop = asyncio.get_event_loop()
         while True:
-            needle, fut = await q.get()
+            try:
+                needle, fut = await asyncio.wait_for(
+                    q.get(), timeout=self.IDLE_SECONDS)
+            except asyncio.TimeoutError:
+                # submit's critical section (dict get → put_nowait) has no
+                # awaits, so checking emptiness here and deleting is safe:
+                # anything enqueued after the timeout fired makes q
+                # non-empty and we keep running
+                if q.empty():
+                    self._queues.pop(vid, None)
+                    self._workers.pop(vid, None)
+                    return
+                continue
             batch = [(needle, fut)]
             size = len(needle.data)
             while (len(batch) < self.MAX_BATCH and size < self.MAX_BYTES
@@ -116,10 +129,16 @@ class WriteBatcher:
                 size += len(n2.data)
             v = self.store.find_volume(vid)
             if v is None:
+                # volume deleted/unmounted (or bogus vid): fail the batch
+                # and retire this worker instead of idling forever
                 err = KeyError(f"volume {vid} not found")
                 for _, f in batch:
                     if not f.done():
                         f.set_exception(err)
+                if q.empty():
+                    self._queues.pop(vid, None)
+                    self._workers.pop(vid, None)
+                    return
                 continue
             try:
                 results = await loop.run_in_executor(
@@ -575,10 +594,19 @@ class VolumeServer:
             fid = FileId.parse(request.query["fid"])
             token = token_from_request(request.headers, request.query)
             canonical = str(fid)
-            if self.guard.verify_write(token, canonical) and \
-                    self.guard.verify_read(token, canonical):
-                return web.json_response({"error": "unauthorized"},
-                                         status=401)
+            # With any key configured, at least one configured regime must
+            # affirmatively validate the token. verify_* returns None both
+            # on success AND when its own key is unconfigured, so an
+            # "all regimes failed" check would silently pass whenever one
+            # key is absent.
+            if self.guard.signing_key or self.guard.read_signing_key:
+                ok = (self.guard.signing_key and
+                      not self.guard.verify_write(token, canonical)) or \
+                     (self.guard.read_signing_key and
+                      not self.guard.verify_read(token, canonical))
+                if not ok:
+                    return web.json_response({"error": "unauthorized"},
+                                             status=401)
             v = self.store.find_volume(fid.volume_id)
             if v is None:
                 return web.json_response({"error": "no volume"}, status=404)

@@ -298,6 +298,48 @@ def test_post_policy_upload(s3_iam):
     assert e.value.code == 403
 
 
+def test_post_policy_content_length_range(s3_iam):
+    """A signed content-length-range condition bounds the payload size
+    (weed/s3api/policy/post-policy.go) — only the upload handler can
+    enforce it, since only it sees the actual bytes."""
+    signed_req(s3_iam, "PUT", "/clrbucket", "ADMINKEY", "adminsecret").read()
+    exp = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                        time.gmtime(time.time() + 600))
+    policy = base64.b64encode(json.dumps({
+        "expiration": exp,
+        "conditions": [{"bucket": "clrbucket"},
+                       ["starts-with", "$key", "uploads/"],
+                       ["content-length-range", 4, 16]],
+    }).encode()).decode()
+    date = time.strftime("%Y%m%d", time.gmtime())
+    cred = f"ADMINKEY/{date}/us-east-1/s3/aws4_request"
+    key = auth_mod.signing_key("adminsecret", date, "us-east-1")
+    sig = hmac.new(key, policy.encode(), hashlib.sha256).hexdigest()
+    fields = {"key": "uploads/${filename}", "policy": policy,
+              "x-amz-credential": cred, "x-amz-signature": sig,
+              "x-amz-date": time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())}
+    hdrs = {"Content-Type": "multipart/form-data; boundary=bnd123"}
+
+    # in range: accepted
+    body = _post_policy_body(fields, b"12345678", "bnd123")
+    with req(s3_iam, "POST", "/clrbucket", data=body, headers=hdrs) as r:
+        assert r.status == 204
+
+    # too large: EntityTooLarge
+    body = _post_policy_body(fields, b"x" * 17, "bnd123")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req(s3_iam, "POST", "/clrbucket", data=body, headers=hdrs)
+    assert e.value.code == 400
+    assert b"EntityTooLarge" in e.value.read()
+
+    # too small: EntityTooSmall
+    body = _post_policy_body(fields, b"ab", "bnd123")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req(s3_iam, "POST", "/clrbucket", data=body, headers=hdrs)
+    assert e.value.code == 400
+    assert b"EntityTooSmall" in e.value.read()
+
+
 def test_multipart_with_manifested_part(cluster, s3):
     """A part large enough to be chunk-manifested must assemble with
     correct offsets (the filer flattens it at complete time)."""
