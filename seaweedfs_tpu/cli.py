@@ -79,7 +79,9 @@ def cmd_volume(args) -> None:
         args.ip, args.port, store, args.mserver,
         data_center=args.data_center, rack=args.rack,
         pulse_seconds=args.pulse, guard=_load_guard(),
-        use_grpc_heartbeat=args.grpc_heartbeat))
+        use_grpc_heartbeat=args.grpc_heartbeat,
+        grpc_port=(args.port + 10000 if args.grpc_port < 0
+                   else args.grpc_port)))
 
 
 def cmd_server(args) -> None:
@@ -139,7 +141,10 @@ def cmd_filer(args) -> None:
         meta_log_path=args.meta_log,
         peers=[p for p in args.peers.split(",") if p],
         notifier=notifier, guard=_load_guard(),
-        cipher=args.encrypt_volume_data))
+        cipher=args.encrypt_volume_data,
+        url=f"{args.ip}:{args.port}",
+        grpc_port=(args.port + 10000 if args.grpc_port < 0
+                   else args.grpc_port)))
 
 
 def cmd_watch(args) -> None:
@@ -635,6 +640,9 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("-grpc_heartbeat", action="store_true",
                    help="stream heartbeats over gRPC instead of HTTP "
                         "polling")
+    v.add_argument("-grpc_port", type=int, default=-1,
+                   help="gRPC admin/stream port (default HTTP+10000; "
+                        "0 disables)")
     v.add_argument("-ec_large_block", type=int, default=1024 * 1024 * 1024)
     v.add_argument("-ec_small_block", type=int, default=1024 * 1024)
     v.set_defaults(fn=cmd_volume)
@@ -680,6 +688,9 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("-peers", default="",
                    help="comma-separated peer filer host:port for "
                         "active-active metadata sync")
+    f.add_argument("-grpc_port", type=int, default=-1,
+                   help="gRPC meta-plane port (default HTTP+10000; "
+                        "0 disables)")
     f.set_defaults(fn=cmd_filer)
 
     w = sub.add_parser("watch", help="live-tail filer metadata events")

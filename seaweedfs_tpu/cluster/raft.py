@@ -409,8 +409,11 @@ class RaftNode:
         if self.role != LEADER:
             return False
         self.log.append({"term": self.term, "cmd": cmd})
-        await self._flush_state()
+        # capture the index BEFORE awaiting: a concurrent propose can
+        # append during the fsync and _last_index() would then name the
+        # wrong entry for this command's commit waiter
         index = self._last_index()
+        await self._flush_state()
         if not self.peers:
             self.commit_index = index
             self._apply_committed()

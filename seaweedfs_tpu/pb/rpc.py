@@ -1,9 +1,15 @@
-"""Hand-rolled gRPC service plumbing for the Master service.
+"""Hand-rolled gRPC service plumbing for the cluster control plane.
 
 The image ships grpcio + protoc but not grpc_tools, so instead of
-generated *_pb2_grpc stubs the service is registered through gRPC's
-generic-handler API and the client uses multicallables with explicit
+generated *_pb2_grpc stubs each service is registered through gRPC's
+generic-handler API and clients use multicallables with explicit
 serializers — byte-identical on the wire to what generated stubs produce.
+
+Three services (parity with the reference's 4 proto files; messaging rides
+the broker's own surface):
+  seaweedfs_tpu.master.Master        proto/master.proto      (13 RPCs)
+  seaweedfs_tpu.volume.VolumeServer  proto/volume_server.proto (31 RPCs)
+  seaweedfs_tpu.filer.SeaweedFiler   proto/filer.proto       (19 RPCs)
 
 Port convention: gRPC listens on HTTP port + 10000
 (weed/pb/grpc_client_server.go).
@@ -13,10 +19,18 @@ from __future__ import annotations
 
 import grpc
 
-from . import master_pb2 as pb
+from . import filer_pb2 as fpb
+from . import master_pb2 as mpb
+from . import volume_server_pb2 as vpb
 
-SERVICE = "seaweedfs_tpu.master.Master"
 GRPC_PORT_OFFSET = 10000
+
+MASTER_SERVICE = "seaweedfs_tpu.master.Master"
+VOLUME_SERVICE = "seaweedfs_tpu.volume.VolumeServer"
+FILER_SERVICE = "seaweedfs_tpu.filer.SeaweedFiler"
+
+# back-compat alias (pre-round-3 callers)
+SERVICE = MASTER_SERVICE
 
 
 def grpc_address(http_url: str) -> str:
@@ -25,80 +39,208 @@ def grpc_address(http_url: str) -> str:
     return f"{host}:{int(port) + GRPC_PORT_OFFSET}"
 
 
-def master_service_handler(servicer) -> grpc.GenericRpcHandler:
+# --- service specs: name -> (kind, request type, response type) ---
+# kind: uu unary-unary, us unary-stream, ss stream-stream
+
+MASTER_SPEC = {
+    "Assign": ("uu", mpb.AssignRequest, mpb.AssignResponse),
+    "Lookup": ("uu", mpb.LookupRequest, mpb.LookupResponse),
+    "LookupEc": ("uu", mpb.LookupEcRequest, mpb.LookupEcResponse),
+    "Heartbeat": ("ss", mpb.HeartbeatRequest, mpb.HeartbeatResponse),
+    "KeepConnected": ("us", mpb.KeepConnectedRequest,
+                      mpb.VolumeLocationMessage),
+    "ClusterStatus": ("uu", mpb.ClusterStatusRequest,
+                      mpb.ClusterStatusResponse),
+    "LeaseAdminToken": ("uu", mpb.LeaseAdminTokenRequest,
+                        mpb.LeaseAdminTokenResponse),
+    "ReleaseAdminToken": ("uu", mpb.ReleaseAdminTokenRequest,
+                          mpb.ReleaseAdminTokenResponse),
+    "VolumeList": ("uu", mpb.VolumeListRequest, mpb.VolumeListResponse),
+    "Statistics": ("uu", mpb.StatisticsRequest, mpb.StatisticsResponse),
+    "CollectionList": ("uu", mpb.CollectionListRequest,
+                       mpb.CollectionListResponse),
+    "CollectionDelete": ("uu", mpb.CollectionDeleteRequest,
+                         mpb.CollectionDeleteResponse),
+    "GetMasterConfiguration": ("uu", mpb.GetMasterConfigurationRequest,
+                               mpb.GetMasterConfigurationResponse),
+}
+
+VOLUME_SPEC = {
+    "BatchDelete": ("uu", vpb.BatchDeleteRequest, vpb.BatchDeleteResponse),
+    "VolumeNeedleStatus": ("uu", vpb.NeedleStatusRequest,
+                           vpb.NeedleStatusResponse),
+    "VacuumVolumeCheck": ("uu", vpb.VolumeRef, vpb.VacuumCheckResponse),
+    "VacuumVolumeCompact": ("uu", vpb.VacuumCompactRequest, vpb.Ok),
+    "VacuumVolumeCommit": ("uu", vpb.VolumeRef, vpb.Ok),
+    "VacuumVolumeCleanup": ("uu", vpb.VolumeRef, vpb.Ok),
+    "AllocateVolume": ("uu", vpb.AllocateVolumeRequest, vpb.Ok),
+    "VolumeMount": ("uu", vpb.VolumeRef, vpb.Ok),
+    "VolumeUnmount": ("uu", vpb.VolumeRef, vpb.Ok),
+    "VolumeDelete": ("uu", vpb.VolumeRef, vpb.Ok),
+    "VolumeMarkReadonly": ("uu", vpb.VolumeRef, vpb.Ok),
+    "VolumeMarkWritable": ("uu", vpb.VolumeRef, vpb.Ok),
+    "VolumeConfigure": ("uu", vpb.VolumeConfigureRequest, vpb.Ok),
+    "VolumeStatus": ("uu", vpb.VolumeRef, vpb.VolumeStatusResponse),
+    "DeleteCollection": ("uu", vpb.DeleteCollectionRequest, vpb.Ok),
+    "VolumeCopy": ("uu", vpb.VolumeCopyRequest, vpb.Ok),
+    "ReadVolumeFileStatus": ("uu", vpb.VolumeRef,
+                             vpb.VolumeFileStatusResponse),
+    "CopyFile": ("us", vpb.CopyFileRequest, vpb.DataChunk),
+    "VolumeTail": ("us", vpb.TailRequest, vpb.DataChunk),
+    "VolumeTailReceiver": ("uu", vpb.TailReceiverRequest, vpb.Ok),
+    "VolumeIncrementalCopy": ("us", vpb.TailRequest, vpb.DataChunk),
+    "VolumeEcShardsGenerate": ("uu", vpb.EcGenerateRequest, vpb.Ok),
+    "VolumeEcShardsRebuild": ("uu", vpb.EcRebuildRequest,
+                              vpb.EcRebuildResponse),
+    "VolumeEcShardsCopy": ("uu", vpb.EcCopyRequest, vpb.Ok),
+    "VolumeEcShardsDelete": ("uu", vpb.EcShardsRequest, vpb.Ok),
+    "VolumeEcShardsMount": ("uu", vpb.EcShardsRequest, vpb.Ok),
+    "VolumeEcShardsUnmount": ("uu", vpb.EcShardsRequest, vpb.Ok),
+    "VolumeEcShardRead": ("us", vpb.EcShardReadRequest, vpb.DataChunk),
+    "VolumeEcBlobDelete": ("uu", vpb.EcBlobDeleteRequest, vpb.Ok),
+    "VolumeEcShardsToVolume": ("uu", vpb.VolumeRef, vpb.Ok),
+    "VolumeTierMoveDatToRemote": ("uu", vpb.TierMoveRequest, vpb.Ok),
+    "VolumeTierMoveDatFromRemote": ("uu", vpb.TierMoveRequest, vpb.Ok),
+    "VolumeServerStatus": ("uu", vpb.Empty,
+                           vpb.VolumeServerStatusResponse),
+    "VolumeServerLeave": ("uu", vpb.Empty, vpb.Ok),
+    "Query": ("us", vpb.QueryRequest, vpb.DataChunk),
+}
+
+FILER_SPEC = {
+    "LookupDirectoryEntry": ("uu", fpb.LookupEntryRequest,
+                             fpb.EntryResponse),
+    "ListEntries": ("us", fpb.ListEntriesRequest, fpb.EntryResponse),
+    "CreateEntry": ("uu", fpb.EntryRequest, fpb.Ok),
+    "UpdateEntry": ("uu", fpb.EntryRequest, fpb.Ok),
+    "AppendToEntry": ("uu", fpb.AppendToEntryRequest, fpb.Ok),
+    "DeleteEntry": ("uu", fpb.DeleteEntryRequest, fpb.Ok),
+    "AtomicRenameEntry": ("uu", fpb.RenameEntryRequest, fpb.Ok),
+    "AssignVolume": ("uu", fpb.AssignVolumeRequest,
+                     fpb.AssignVolumeResponse),
+    "LookupVolume": ("uu", fpb.LookupVolumeRequest,
+                     fpb.LookupVolumeResponse),
+    "CollectionList": ("uu", fpb.Empty, fpb.CollectionListResponse),
+    "DeleteCollection": ("uu", fpb.DeleteCollectionRequest, fpb.Ok),
+    "Statistics": ("uu", fpb.StatisticsRequest, fpb.StatisticsResponse),
+    "GetFilerConfiguration": ("uu", fpb.Empty,
+                              fpb.FilerConfigurationResponse),
+    "SubscribeMetadata": ("us", fpb.SubscribeMetadataRequest,
+                          fpb.MetaEvent),
+    "SubscribeLocalMetadata": ("us", fpb.SubscribeMetadataRequest,
+                               fpb.MetaEvent),
+    "KeepConnected": ("ss", fpb.KeepConnectedRequest,
+                      fpb.KeepConnectedResponse),
+    "LocateBroker": ("uu", fpb.LocateBrokerRequest,
+                     fpb.LocateBrokerResponse),
+    "KvGet": ("uu", fpb.KvRequest, fpb.KvResponse),
+    "KvPut": ("uu", fpb.KvRequest, fpb.Ok),
+}
+
+_HANDLER_FACTORY = {
+    "uu": grpc.unary_unary_rpc_method_handler,
+    "us": grpc.unary_stream_rpc_method_handler,
+    "ss": grpc.stream_stream_rpc_method_handler,
+}
+
+
+def peer_ip(context) -> str:
+    """Remote IP from a ServicerContext peer string
+    ("ipv4:1.2.3.4:56" / "ipv6:[::1]:56")."""
+    peer = context.peer()
+    if peer.startswith("ipv4:"):
+        return peer[5:].rsplit(":", 1)[0]
+    if peer.startswith("ipv6:"):
+        return peer[5:].rsplit(":", 1)[0].strip("[]")
+    return peer
+
+
+def _guarded(method, kind: str, guard):
+    """Wrap a servicer method with the same IP-whitelist envelope the HTTP
+    surface gets from guard_mw — without this, -whitelist deployments
+    would 403 /admin/* over HTTP while serving the identical operations
+    openly on port+10000 (the reference wraps its gRPC plane in the same
+    security.toml whitelist/TLS envelope, weed/security/guard.go).
+
+    `guard` may be a Guard or a zero-arg callable returning one — the
+    callable form re-resolves per call, matching guard_mw's dynamic
+    self.guard lookup (tests and admins swap guards on live servers)."""
+    def _denied(context) -> bool:
+        g = guard() if callable(guard) else guard
+        return g is not None and not g.check_whitelist(peer_ip(context))
+
+    if kind in ("us", "ss"):
+        async def stream_wrapper(request, context):
+            if _denied(context):
+                await context.abort(grpc.StatusCode.PERMISSION_DENIED,
+                                    "ip not allowed")
+            async for item in method(request, context):
+                yield item
+        return stream_wrapper
+
+    async def unary_wrapper(request, context):
+        if _denied(context):
+            await context.abort(grpc.StatusCode.PERMISSION_DENIED,
+                                "ip not allowed")
+        return await method(request, context)
+    return unary_wrapper
+
+
+def service_handler(service: str, spec: dict, servicer,
+                    guard=None) -> grpc.GenericRpcHandler:
     """Bind a servicer object (async methods named like the RPCs) into a
-    generic handler grpc.aio can serve."""
-    def uu(method, req, resp):
-        return grpc.unary_unary_rpc_method_handler(
+    generic handler grpc.aio can serve. Methods the servicer doesn't
+    implement are simply not registered (grpc returns UNIMPLEMENTED).
+    With a guard, every RPC enforces its IP whitelist."""
+    handlers = {}
+    for name, (kind, req, resp) in spec.items():
+        method = getattr(servicer, name, None)
+        if method is None:
+            continue
+        if guard is not None:
+            method = _guarded(method, kind, guard)
+        handlers[name] = _HANDLER_FACTORY[kind](
             method, request_deserializer=req.FromString,
             response_serializer=resp.SerializeToString)
-
-    def us(method, req, resp):
-        return grpc.unary_stream_rpc_method_handler(
-            method, request_deserializer=req.FromString,
-            response_serializer=resp.SerializeToString)
-
-    def ss(method, req, resp):
-        return grpc.stream_stream_rpc_method_handler(
-            method, request_deserializer=req.FromString,
-            response_serializer=resp.SerializeToString)
-
-    handlers = {
-        "Assign": uu(servicer.Assign, pb.AssignRequest, pb.AssignResponse),
-        "Lookup": uu(servicer.Lookup, pb.LookupRequest, pb.LookupResponse),
-        "LookupEc": uu(servicer.LookupEc, pb.LookupEcRequest,
-                       pb.LookupEcResponse),
-        "Heartbeat": ss(servicer.Heartbeat, pb.HeartbeatRequest,
-                        pb.HeartbeatResponse),
-        "KeepConnected": us(servicer.KeepConnected, pb.KeepConnectedRequest,
-                            pb.VolumeLocationMessage),
-        "ClusterStatus": uu(servicer.ClusterStatus, pb.ClusterStatusRequest,
-                            pb.ClusterStatusResponse),
-        "LeaseAdminToken": uu(servicer.LeaseAdminToken,
-                              pb.LeaseAdminTokenRequest,
-                              pb.LeaseAdminTokenResponse),
-        "ReleaseAdminToken": uu(servicer.ReleaseAdminToken,
-                                pb.ReleaseAdminTokenRequest,
-                                pb.ReleaseAdminTokenResponse),
-    }
-    return grpc.method_handlers_generic_handler(SERVICE, handlers)
+    return grpc.method_handlers_generic_handler(service, handlers)
 
 
-class MasterStub:
+class _SpecStub:
     """Client multicallables (what a generated stub would contain)."""
 
+    def __init__(self, channel, service: str, spec: dict):
+        factories = {"uu": channel.unary_unary,
+                     "us": channel.unary_stream,
+                     "ss": channel.stream_stream}
+        for name, (kind, req, resp) in spec.items():
+            setattr(self, name, factories[kind](
+                f"/{service}/{name}",
+                request_serializer=req.SerializeToString,
+                response_deserializer=resp.FromString))
+
+
+class MasterStub(_SpecStub):
     def __init__(self, channel):
-        def uu(name, req, resp):
-            return channel.unary_unary(
-                f"/{SERVICE}/{name}",
-                request_serializer=req.SerializeToString,
-                response_deserializer=resp.FromString)
+        super().__init__(channel, MASTER_SERVICE, MASTER_SPEC)
 
-        def us(name, req, resp):
-            return channel.unary_stream(
-                f"/{SERVICE}/{name}",
-                request_serializer=req.SerializeToString,
-                response_deserializer=resp.FromString)
 
-        def ss(name, req, resp):
-            return channel.stream_stream(
-                f"/{SERVICE}/{name}",
-                request_serializer=req.SerializeToString,
-                response_deserializer=resp.FromString)
+class VolumeServerStub(_SpecStub):
+    def __init__(self, channel):
+        super().__init__(channel, VOLUME_SERVICE, VOLUME_SPEC)
 
-        self.Assign = uu("Assign", pb.AssignRequest, pb.AssignResponse)
-        self.Lookup = uu("Lookup", pb.LookupRequest, pb.LookupResponse)
-        self.LookupEc = uu("LookupEc", pb.LookupEcRequest,
-                           pb.LookupEcResponse)
-        self.Heartbeat = ss("Heartbeat", pb.HeartbeatRequest,
-                            pb.HeartbeatResponse)
-        self.KeepConnected = us("KeepConnected", pb.KeepConnectedRequest,
-                                pb.VolumeLocationMessage)
-        self.ClusterStatus = uu("ClusterStatus", pb.ClusterStatusRequest,
-                                pb.ClusterStatusResponse)
-        self.LeaseAdminToken = uu("LeaseAdminToken",
-                                  pb.LeaseAdminTokenRequest,
-                                  pb.LeaseAdminTokenResponse)
-        self.ReleaseAdminToken = uu("ReleaseAdminToken",
-                                    pb.ReleaseAdminTokenRequest,
-                                    pb.ReleaseAdminTokenResponse)
+
+class FilerStub(_SpecStub):
+    def __init__(self, channel):
+        super().__init__(channel, FILER_SERVICE, FILER_SPEC)
+
+
+def master_service_handler(servicer, guard=None) -> grpc.GenericRpcHandler:
+    return service_handler(MASTER_SERVICE, MASTER_SPEC, servicer, guard)
+
+
+def volume_service_handler(servicer, guard=None) -> grpc.GenericRpcHandler:
+    return service_handler(VOLUME_SERVICE, VOLUME_SPEC, servicer, guard)
+
+
+def filer_service_handler(servicer, guard=None) -> grpc.GenericRpcHandler:
+    return service_handler(FILER_SERVICE, FILER_SPEC, servicer, guard)

@@ -1,0 +1,347 @@
+"""gRPC face of the filer (role of the reference's
+weed/server/filer_grpc_server*.go family).
+
+Serves the SeaweedFiler service from proto/filer.proto on HTTP port +
+10000: entry CRUD, the ListEntries / SubscribeMetadata server streams,
+assignment/lookup proxying, statistics, KeepConnected liveness, broker
+location, and the KV surface. Handlers delegate to the same Filer
+internals the /__meta__/* HTTP surface uses; SubscribeMetadata is the
+real streaming backbone (filer_grpc_server_sub_meta.go) that the
+ndjson /__meta__/subscribe route approximates for HTTP clients.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+import grpc
+
+from ..filer.entry import Attr, Entry
+from ..filer.chunks import FileChunk
+from ..pb import filer_pb2 as pb
+from ..pb.rpc import filer_service_handler
+
+log = logging.getLogger("filer.grpc")
+
+
+def _run(fn):
+    return asyncio.get_event_loop().run_in_executor(None, fn)
+
+
+def _ok() -> pb.Ok:
+    return pb.Ok(ok=True)
+
+
+def _err(e) -> pb.Ok:
+    return pb.Ok(ok=False, error=str(e))
+
+
+def entry_to_pb(e: Entry) -> pb.Entry:
+    return pb.Entry(
+        path=e.full_path,
+        attr=pb.FuseAttributes(
+            mtime=e.attr.mtime, crtime=e.attr.crtime, mode=e.attr.mode,
+            uid=e.attr.uid, gid=e.attr.gid, mime=e.attr.mime,
+            ttl_sec=e.attr.ttl_sec, user_name=e.attr.user_name,
+            group_names=e.attr.group_names,
+            symlink_target=e.attr.symlink_target, md5=e.attr.md5,
+            replication=e.attr.replication, collection=e.attr.collection),
+        chunks=[pb.FileChunk(
+            fid=c.fid, offset=c.offset, size=c.size, mtime_ns=c.mtime,
+            etag=c.etag, is_chunk_manifest=c.is_chunk_manifest,
+            cipher_key=c.cipher_key) for c in e.chunks],
+        extended=dict(e.extended),
+        hard_link_id=e.hard_link_id)
+
+
+def entry_from_pb(m: pb.Entry) -> Entry:
+    a = m.attr
+    return Entry(
+        full_path=m.path,
+        attr=Attr(mtime=a.mtime, crtime=a.crtime, mode=a.mode, uid=a.uid,
+                  gid=a.gid, mime=a.mime, ttl_sec=a.ttl_sec,
+                  user_name=a.user_name, group_names=list(a.group_names),
+                  symlink_target=a.symlink_target, md5=a.md5,
+                  replication=a.replication, collection=a.collection),
+        chunks=[FileChunk(fid=c.fid, offset=c.offset, size=c.size,
+                          mtime=c.mtime_ns, etag=c.etag,
+                          is_chunk_manifest=c.is_chunk_manifest,
+                          cipher_key=c.cipher_key) for c in m.chunks],
+        extended=dict(m.extended),
+        hard_link_id=m.hard_link_id)
+
+
+def _event_to_pb(e) -> pb.MetaEvent:
+    msg = pb.MetaEvent(tsns=e.tsns, directory=e.directory,
+                       signatures=list(getattr(e, "signatures", ())))
+    if e.old_entry is not None:
+        msg.old_entry.CopyFrom(entry_to_pb(e.old_entry))
+    if e.new_entry is not None:
+        msg.new_entry.CopyFrom(entry_to_pb(e.new_entry))
+    return msg
+
+
+class FilerGrpcServicer:
+    def __init__(self, fs):
+        self.fs = fs            # FilerServer
+        self.filer = fs.filer
+
+    # --- entry CRUD ---
+    async def LookupDirectoryEntry(self, request: pb.LookupEntryRequest,
+                                   context):
+        path = request.directory.rstrip("/")
+        if request.name:
+            path = f"{path}/{request.name}"
+        entry = await _run(lambda: self.filer.find_entry(path or "/"))
+        if entry is None:
+            return pb.EntryResponse(error="not found")
+        return pb.EntryResponse(entry=entry_to_pb(entry))
+
+    async def ListEntries(self, request: pb.ListEntriesRequest, context):
+        entries = await _run(lambda: self.filer.list_directory(
+            request.directory, request.start_from_file_name,
+            request.inclusive_start_from, request.limit or 1024,
+            request.prefix))
+        for e in entries:
+            yield pb.EntryResponse(entry=entry_to_pb(e))
+
+    async def CreateEntry(self, request: pb.EntryRequest, context):
+        entry = entry_from_pb(request.entry)
+        old = await _run(lambda: self.filer.find_entry(entry.full_path))
+        try:
+            await _run(lambda: self.filer.create_entry(
+                entry, o_excl=request.o_excl))
+        except FileExistsError:
+            return _err("exists")
+        except (IsADirectoryError, NotADirectoryError) as e:
+            return _err(e)
+        # hard-link aware: replaced chunks stay if other links remain
+        new_fids = {c.fid for c in entry.chunks}
+        self.fs._queue_chunk_deletes(
+            [c for c in self.filer.freeable_replaced_chunks(old)
+             if c.fid not in new_fids])
+        return _ok()
+
+    async def UpdateEntry(self, request: pb.EntryRequest, context):
+        try:
+            await _run(lambda: self.filer.update_entry(
+                entry_from_pb(request.entry)))
+            return _ok()
+        except FileNotFoundError:
+            return _err("not found")
+
+    async def AppendToEntry(self, request: pb.AppendToEntryRequest,
+                            context):
+        entry = await _run(lambda: self.filer.find_entry(request.path))
+        if entry is None:
+            return _err("not found")
+        offset = entry.size()
+        for c in request.chunks:
+            entry.chunks.append(FileChunk(
+                fid=c.fid, offset=offset, size=c.size, mtime=c.mtime_ns,
+                etag=c.etag, is_chunk_manifest=c.is_chunk_manifest,
+                cipher_key=c.cipher_key))
+            offset += c.size
+        await _run(lambda: self.filer.update_entry(entry))
+        return _ok()
+
+    async def DeleteEntry(self, request: pb.DeleteEntryRequest, context):
+        try:
+            await _run(lambda: self.filer.delete_entry(
+                request.path, recursive=request.is_recursive,
+                free_chunks=request.is_delete_data))
+            return _ok()
+        except FileNotFoundError as e:
+            if request.ignore_recursive_error:
+                return _ok()
+            return _err(e)
+        except OSError as e:
+            return _err(e)
+
+    async def AtomicRenameEntry(self, request: pb.RenameEntryRequest,
+                                context):
+        try:
+            await _run(lambda: self.filer.rename(request.old_path,
+                                                 request.new_path))
+            return _ok()
+        except FileNotFoundError as e:
+            return _err(e)
+
+    # --- assignment / lookup proxy ---
+    async def AssignVolume(self, request: pb.AssignVolumeRequest, context):
+        from aiohttp import web
+        try:
+            a = await self.fs._assign(
+                request.collection or self.fs.default_collection,
+                request.replication or self.fs.default_replication,
+                request.ttl_sec)
+        except web.HTTPError as e:
+            return pb.AssignVolumeResponse(error=str(e))
+        return pb.AssignVolumeResponse(
+            fid=a["fid"], url=a["url"],
+            public_url=a.get("publicUrl", a["url"]),
+            count=a.get("count", 1), auth=a.get("auth", ""))
+
+    async def LookupVolume(self, request: pb.LookupVolumeRequest, context):
+        resp = pb.LookupVolumeResponse()
+        for vid_or_fid in request.volume_or_file_ids:
+            vid = vid_or_fid.split(",")[0]
+            try:
+                urls = await self.fs._lookup(int(vid))
+            except ValueError:
+                urls = []
+            resp.locations_map[vid_or_fid].urls.extend(urls or [])
+        return resp
+
+    # --- collections / stats / config ---
+    async def CollectionList(self, request, context):
+        body = await self.fs._master_get("/col/list", {})
+        return pb.CollectionListResponse(
+            collections=body.get("collections", []))
+
+    async def DeleteCollection(self, request: pb.DeleteCollectionRequest,
+                               context):
+        body = await self.fs._master_get(
+            "/col/delete", {"collection": request.collection})
+        if body.get("error"):
+            return _err(body["error"])
+        return _ok()
+
+    async def Statistics(self, request: pb.StatisticsRequest, context):
+        """Aggregate usage from the master's full inventory (/vol/list),
+        optionally filtered by collection — same computation as the
+        master's own Statistics RPC."""
+        body = await self.fs._master_get("/vol/list", {})
+        limit = body.get("volume_size_limit_mb", 0) * 1024 * 1024
+        total = used = files = 0
+        for node in body.get("nodes", []):
+            total += node.get("max_volume_count", 0) * limit
+            for v in node.get("volumes", []):
+                if request.collection and \
+                        v.get("collection") != request.collection:
+                    continue
+                used += v.get("size", 0)
+                files += v.get("file_count", 0)
+        return pb.StatisticsResponse(total_size=total, used_size=used,
+                                     file_count=files)
+
+    async def GetFilerConfiguration(self, request, context):
+        return pb.FilerConfigurationResponse(
+            masters=self.fs.masters,
+            collection=self.fs.default_collection,
+            replication=self.fs.default_replication,
+            max_mb=self.fs.chunk_size // (1024 * 1024),
+            dir_buckets="/buckets",
+            cipher=self.fs.cipher,
+            signature=self.filer.signature)
+
+    # --- metadata subscription streams ---
+    async def SubscribeMetadata(self, request: pb.SubscribeMetadataRequest,
+                                context):
+        async for msg in self._subscribe(request):
+            yield msg
+
+    async def SubscribeLocalMetadata(self,
+                                     request: pb.SubscribeMetadataRequest,
+                                     context):
+        # this framework's meta log is always the local log (peer events
+        # are folded in by the aggregator before they reach it)
+        async for msg in self._subscribe(request):
+            yield msg
+
+    async def _subscribe(self, request: pb.SubscribeMetadataRequest):
+        """Replay persisted + in-memory events since since_ns, then tail
+        live mutations — the gRPC twin of /__meta__/subscribe."""
+        since = request.since_ns
+        prefix = request.path_prefix or "/"
+        exclude_sig = request.exclude_signature
+        meta_log = self.filer.meta_log
+        queue: asyncio.Queue = asyncio.Queue()
+        loop = asyncio.get_event_loop()
+
+        def on_event(e) -> None:
+            loop.call_soon_threadsafe(queue.put_nowait, e)
+
+        def admit(e) -> bool:
+            return not (exclude_sig and exclude_sig in e.signatures)
+
+        meta_log.subscribe(on_event)
+        try:
+            seen = set()
+            for e in meta_log.read_persisted_since(since, prefix):
+                seen.add(e.tsns)
+                if admit(e):
+                    yield _event_to_pb(e)
+            for e in meta_log.events_since(since, prefix):
+                if e.tsns in seen:
+                    continue
+                seen.add(e.tsns)
+                if admit(e):
+                    yield _event_to_pb(e)
+            while True:
+                e = await queue.get()
+                dup = bool(seen) and e.tsns in seen
+                if seen and queue.empty():
+                    # the replay/live race window is over once the queue
+                    # drains — stop holding every event id ever seen
+                    # (long-lived subscribers would otherwise grow it
+                    # unboundedly)
+                    seen = set()
+                if dup:
+                    continue
+                if not e.directory.startswith(prefix) or not admit(e):
+                    continue
+                yield _event_to_pb(e)
+        finally:
+            meta_log.unsubscribe(on_event)
+
+    async def KeepConnected(self, request_iterator, context):
+        """Bidi liveness: clients announce themselves, the filer echoes.
+        The reference uses this to track attached mounts/brokers
+        (filer_grpc_server.go KeepConnected)."""
+        name = None
+        try:
+            async for req in request_iterator:
+                name = req.name
+                self.fs.connected_clients[name] = list(req.resources)
+                yield pb.KeepConnectedResponse()
+        finally:
+            # stream end = client gone; a stale entry would report dead
+            # mounts as attached forever
+            if name is not None:
+                self.fs.connected_clients.pop(name, None)
+
+    async def LocateBroker(self, request: pb.LocateBrokerRequest, context):
+        brokers = getattr(self.fs, "broker_registry", {})
+        if not brokers:
+            return pb.LocateBrokerResponse(found=False)
+        resources = [pb.BrokerResource(grpc_address=addr,
+                                       resource_count=count)
+                     for addr, count in sorted(brokers.items())]
+        return pb.LocateBrokerResponse(found=True, resources=resources)
+
+    # --- kv ---
+    async def KvGet(self, request: pb.KvRequest, context):
+        val = await _run(lambda: self.filer.store.kv_get(
+            request.key.decode()))
+        if val is None:
+            return pb.KvResponse(error="not found")
+        return pb.KvResponse(value=val)
+
+    async def KvPut(self, request: pb.KvRequest, context):
+        await _run(lambda: self.filer.store.kv_put(
+            request.key.decode(), bytes(request.value)))
+        return _ok()
+
+
+async def serve_filer_grpc(fs, host: str, port: int):
+    """Start the grpc.aio server for a FilerServer; returns it."""
+    server = grpc.aio.server()
+    server.add_generic_rpc_handlers(
+        (filer_service_handler(FilerGrpcServicer(fs),
+                               guard=lambda: fs.guard),))
+    server.add_insecure_port(f"{host}:{port}")
+    await server.start()
+    log.info("filer gRPC on %s:%d", host, port)
+    return server

@@ -67,7 +67,9 @@ class FilerServer:
                  peers: Optional[list[str]] = None,
                  notifier=None,
                  guard=None,
-                 cipher: bool = False):
+                 cipher: bool = False,
+                 grpc_port: int = 0,
+                 url: str = ""):
         # comma-separated HA master list; rotates on failure like the
         # Client/VolumeServer (wdclient/masterclient.go)
         self.masters = [m.strip() for m in master_url.split(",")
@@ -84,6 +86,13 @@ class FilerServer:
         # server-side AES-256-GCM chunk encryption
         # (filer_server_handlers_write_cipher.go:17, util/cipher.go)
         self.cipher = cipher
+        self.grpc_port = grpc_port
+        self.url = url
+        self._grpc_server = None
+        # KeepConnected-announced clients (mounts, brokers): name -> resources
+        self.connected_clients: dict[str, list[str]] = {}
+        # broker registrations for LocateBroker: grpc addr -> resource count
+        self.broker_registry: dict[str, int] = {}
         # entries fold chunk lists into manifest blobs past this many
         # chunks (filechunk_manifest.go ManifestBatch)
         self.manifest_batch = manifest_mod.MANIFEST_BATCH
@@ -349,6 +358,11 @@ class FilerServer:
     async def _on_startup(self, app) -> None:
         self._loop = asyncio.get_event_loop()
         self._session = aiohttp.ClientSession()
+        if self.grpc_port:
+            from .filer_grpc import serve_filer_grpc
+            host = (self.url.rsplit(":", 1)[0] if self.url else "127.0.0.1")
+            self._grpc_server = await serve_filer_grpc(
+                self, host, self.grpc_port)
         self._delete_task = asyncio.create_task(self._deletion_worker())
         self._watch_task = asyncio.create_task(self._watch_master())
         for peer in self.peers:
@@ -356,6 +370,8 @@ class FilerServer:
                 asyncio.create_task(self._aggregate_from_peer(peer)))
 
     async def _on_cleanup(self, app) -> None:
+        if self._grpc_server is not None:
+            await self._grpc_server.stop(grace=0.5)
         if self._delete_task:
             self._delete_task.cancel()
         if self._watch_task:

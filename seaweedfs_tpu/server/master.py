@@ -163,6 +163,9 @@ class MasterServer:
         app.router.add_get("/vol/grow", self.vol_grow)
         app.router.add_get("/vol/vacuum", self.vol_vacuum)
         app.router.add_get("/col/lookup/ec", self.ec_lookup)
+        app.router.add_get("/col/list", self.col_list)
+        app.router.add_get("/col/delete", self.col_delete)
+        app.router.add_get("/vol/list", self.vol_list)
         app.router.add_post("/heartbeat", self.heartbeat)
         app.router.add_get("/cluster/status", self.cluster_status)
         app.router.add_get("/cluster/watch", self.cluster_watch)
@@ -479,6 +482,99 @@ class MasterServer:
                 grown.append(vid)
                 self.metrics.count("volumes_grown")
         return grown
+
+    def collection_names(self) -> list[str]:
+        names = set()
+        for node in self.topology.nodes.values():
+            for v in node.volumes.values():
+                names.add(v.collection)
+            for s2 in node.ec_shards.values():
+                names.add(s2.collection)
+        return sorted(names)
+
+    async def col_list(self, request: web.Request) -> web.Response:
+        """CollectionList (weed/server/master_grpc_server_collection.go)."""
+        return web.json_response({"collections": self.collection_names()})
+
+    async def delete_collection(self, name: str) -> dict:
+        """CollectionDelete: drop every volume of the collection on every
+        holder (master_grpc_server_collection.go:55-86)."""
+        deleted = 0
+        errors = []
+        async with aiohttp.ClientSession() as session:
+            for node in list(self.topology.nodes.values()):
+                vids = [vid for vid, v in node.volumes.items()
+                        if v.collection == name]
+                for vid in vids:
+                    try:
+                        async with session.post(
+                                f"http://{node.url}/admin/volume/delete",
+                                json={"volume_id": vid},
+                                timeout=aiohttp.ClientTimeout(
+                                    total=10)) as r:
+                            if r.status == 200:
+                                deleted += 1
+                            else:
+                                errors.append(f"{node.url}/{vid}: "
+                                              f"{r.status}")
+                    except Exception as e:
+                        errors.append(f"{node.url}/{vid}: {e}")
+                # an EC-encoded collection lives on as shards — drop them
+                # too or the "deleted" collection haunts /col/list forever
+                ec = [(vid, list(sh.shard_ids))
+                      for vid, sh in node.ec_shards.items()
+                      if sh.collection == name]
+                for vid, shard_ids in ec:
+                    try:
+                        async with session.post(
+                                f"http://{node.url}"
+                                "/admin/ec/delete_shards",
+                                json={"volume_id": vid,
+                                      "collection": name,
+                                      "shard_ids": shard_ids},
+                                timeout=aiohttp.ClientTimeout(
+                                    total=10)) as r:
+                            if r.status == 200:
+                                deleted += 1
+                            else:
+                                errors.append(f"{node.url}/ec{vid}: "
+                                              f"{r.status}")
+                    except Exception as e:
+                        errors.append(f"{node.url}/ec{vid}: {e}")
+        # drop the layouts so assignment stops routing to the collection
+        self.topology.layouts = {
+            k: v for k, v in self.topology.layouts.items()
+            if k[0] != name}
+        return {"deleted": deleted, "errors": errors}
+
+    async def col_delete(self, request: web.Request) -> web.Response:
+        name = request.query.get("collection", "")
+        if not name:
+            return web.json_response({"error": "missing collection"},
+                                     status=400)
+        out = await self.delete_collection(name)
+        if out["errors"]:
+            return web.json_response(
+                {"error": "; ".join(out["errors"]), **out}, status=502)
+        return web.json_response({"ok": True, **out})
+
+    async def vol_list(self, request: web.Request) -> web.Response:
+        """VolumeList (weed/server/master_grpc_server_volume.go:117):
+        the full per-node volume/EC inventory."""
+        return web.json_response({
+            "volume_size_limit_mb":
+                self.topology.volume_size_limit // (1024 * 1024),
+            "nodes": [{
+                "url": n.url, "public_url": n.public_url,
+                "data_center": n.data_center, "rack": n.rack,
+                "max_volume_count": n.max_volume_count,
+                "volumes": [vars(v) for v in n.volumes.values()],
+                "ec_shards": [{
+                    "id": e.id, "collection": e.collection,
+                    "shard_ids": e.shard_ids,
+                    "shard_size": e.shard_size,
+                } for e in n.ec_shards.values()],
+            } for n in self.topology.nodes.values()]})
 
     async def vol_vacuum(self, request: web.Request) -> web.Response:
         """Manual vacuum trigger (master /vol/vacuum): compacts every volume

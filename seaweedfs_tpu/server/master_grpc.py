@@ -192,6 +192,64 @@ class MasterGrpcServicer:
             is_leader=raft.is_leader, leader=raft.leader_id or "",
             peers=raft.peers, raft_term=raft.term)
 
+    async def VolumeList(self, request, context):
+        """Full per-node inventory (master_grpc_server_volume.go:117)."""
+        topo = self.master.topology
+        return pb.VolumeListResponse(
+            volume_size_limit_mb=topo.volume_size_limit // (1024 * 1024),
+            nodes=[pb.NodeVolumes(
+                url=n.url, public_url=n.public_url,
+                data_center=n.data_center, rack=n.rack,
+                max_volume_count=n.max_volume_count,
+                volumes=[pb.VolumeInformation(
+                    id=v.id, collection=v.collection, size=v.size,
+                    file_count=v.file_count, delete_count=v.delete_count,
+                    deleted_bytes=v.deleted_bytes, read_only=v.read_only,
+                    replica_placement=str(v.replica_placement),
+                    ttl=str(v.ttl), version=v.version)
+                    for v in n.volumes.values()],
+                ec_shards=[pb.EcShardInformation(
+                    id=e.id, collection=e.collection,
+                    ec_index_bits=shard_bits.from_ids(e.shard_ids),
+                    shard_size=e.shard_size)
+                    for e in n.ec_shards.values()])
+                for n in topo.nodes.values()])
+
+    async def Statistics(self, request, context):
+        """Aggregate usage, optionally filtered by collection
+        (master_grpc_server_volume.go:176)."""
+        topo = self.master.topology
+        total = used = files = 0
+        for n in topo.nodes.values():
+            total += n.max_volume_count * topo.volume_size_limit
+            for v in n.volumes.values():
+                if request.collection and \
+                        v.collection != request.collection:
+                    continue
+                used += v.size
+                files += v.file_count
+        return pb.StatisticsResponse(total_size=total, used_size=used,
+                                     file_count=files)
+
+    async def CollectionList(self, request, context):
+        return pb.CollectionListResponse(
+            collections=self.master.collection_names())
+
+    async def CollectionDelete(self, request, context):
+        out = await self.master.delete_collection(request.name)
+        if out["errors"]:
+            return pb.CollectionDeleteResponse(
+                ok=False, error="; ".join(out["errors"]))
+        return pb.CollectionDeleteResponse(ok=True)
+
+    async def GetMasterConfiguration(self, request, context):
+        m = self.master
+        return pb.GetMasterConfigurationResponse(
+            default_replication=m.default_replication,
+            volume_size_limit_mb=m.topology.volume_size_limit
+            // (1024 * 1024),
+            garbage_threshold=m.garbage_threshold)
+
     async def LeaseAdminToken(self, request, context):
         resp, status = self.master.lease_admin_token(
             request.name, request.client, request.previous_token)
@@ -210,7 +268,8 @@ async def serve_master_grpc(master, host: str, port: int):
     .stop())."""
     server = grpc.aio.server()
     server.add_generic_rpc_handlers(
-        (master_service_handler(MasterGrpcServicer(master)),))
+        (master_service_handler(MasterGrpcServicer(master),
+                                guard=lambda: master.guard),))
     server.add_insecure_port(f"{host}:{port}")
     await server.start()
     log.info("master gRPC on %s:%d", host, port)

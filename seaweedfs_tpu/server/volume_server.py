@@ -41,7 +41,7 @@ from ..storage.needle import (FLAG_IS_COMPRESSED,
                               FLAG_HAS_LAST_MODIFIED, FLAG_HAS_MIME,
                               FLAG_HAS_NAME, FLAG_HAS_TTL, Needle)
 from ..storage import types as t
-from ..storage.store import Store
+from ..storage.store import Store, safe_collection
 from ..storage.volume import (NeedleDeleted, NeedleExpired, NeedleNotFound,
                               VolumeReadOnly)
 from ..security.guard import Guard, token_from_request
@@ -162,7 +162,8 @@ class VolumeServer:
                  pulse_seconds: float = 5.0, read_redirect: bool = False,
                  guard: Optional[Guard] = None,
                  use_grpc_heartbeat: bool = False,
-                 master_grpc_target: str = ""):
+                 master_grpc_target: str = "",
+                 grpc_port: int = 0):
         self.use_grpc_heartbeat = use_grpc_heartbeat
         # explicit gRPC endpoint override; default follows the
         # HTTP-port+10000 convention (grpc_client_server.go)
@@ -185,6 +186,8 @@ class VolumeServer:
         self._hb_task: Optional[asyncio.Task] = None
         self._session: Optional[aiohttp.ClientSession] = None
         self._batcher: Optional[WriteBatcher] = None
+        self.grpc_port = grpc_port
+        self._grpc_server = None
         self._replica_cache: dict[int, tuple[list[str], float]] = {}
         self._shard_loc_cache: dict[int, tuple[dict, float]] = {}
         self._repair_neg: dict[str, float] = {}
@@ -256,8 +259,15 @@ class VolumeServer:
         self._session = aiohttp.ClientSession()
         self._batcher = WriteBatcher(self.store)
         self._hb_task = asyncio.create_task(self._heartbeat_loop())
+        if self.grpc_port:
+            from .volume_grpc import serve_volume_grpc
+            host = self.url.rsplit(":", 1)[0]
+            self._grpc_server = await serve_volume_grpc(
+                self, host, self.grpc_port)
 
     async def _on_cleanup(self, app) -> None:
+        if self._grpc_server is not None:
+            await self._grpc_server.stop(grace=0.5)
         if self._hb_task:
             self._hb_task.cancel()
         if self._batcher is not None:
@@ -1051,6 +1061,9 @@ class VolumeServer:
         body = await request.json()
         vid = int(body["volume_id"])
         collection = body.get("collection", "")
+        if not safe_collection(collection):
+            return web.json_response({"error": "bad collection"},
+                                     status=400)
         shard_ids = [int(s) for s in body["shard_ids"]]
         source = body["source"]
         copy_ecx = body.get("copy_ecx_file", False)
@@ -1156,14 +1169,43 @@ class VolumeServer:
         return [u for u in shards.get(str(shard_id), []) if u != self.url]
 
     def _make_shard_reader(self, ev):
-        """Shard reader hitting peers' /admin/ec/shard_read — used by the EC
-        read path for non-local shards (store_ec.go:282-320). Synchronous
-        (runs in executor threads); a total miss forces one location-cache
-        refresh so reads survive shard moves."""
+        """Shard reader for non-local shards, used by the EC read path
+        (store_ec.go:282-320). Prefers the peer's VolumeEcShardRead gRPC
+        stream (volume_grpc_erasure_coding.go:270-328) and falls back to
+        its /admin/ec/shard_read HTTP analog for peers running without a
+        gRPC port. Synchronous (runs in executor threads); a total miss
+        forces one location-cache refresh so reads survive shard moves."""
         import urllib.request
+
+        def fetch_grpc(url: str, shard_id: int, offset: int,
+                       size: int) -> Optional[bytes]:
+            import grpc as grpc_mod
+
+            from ..pb import volume_server_pb2 as vpb
+            from ..pb.rpc import VolumeServerStub, grpc_address
+            try:
+                with grpc_mod.insecure_channel(grpc_address(url)) as ch:
+                    stub = VolumeServerStub(ch)
+                    buf = bytearray()
+                    for chunk in stub.VolumeEcShardRead(
+                            vpb.EcShardReadRequest(
+                                volume_id=ev.vid, shard_id=shard_id,
+                                offset=offset, size=size),
+                            timeout=10):
+                        if chunk.error:
+                            return None
+                        buf += chunk.data
+                        if chunk.is_last:
+                            break
+                    return bytes(buf) if len(buf) == size else None
+            except grpc_mod.RpcError:
+                return None
 
         def fetch(url: str, shard_id: int, offset: int,
                   size: int) -> Optional[bytes]:
+            data = fetch_grpc(url, shard_id, offset, size)
+            if data is not None:
+                return data
             try:
                 with urllib.request.urlopen(
                         f"http://{url}/admin/ec/shard_read?volume="
@@ -1193,8 +1235,10 @@ class VolumeServer:
         vid = int(q["volume_id"])
         collection = q.get("collection", "")
         ext = q["ext"]
-        if not ext.startswith(".") or "/" in ext or ".." in ext:
-            return web.json_response({"error": "bad ext"}, status=400)
+        if not ext.startswith(".") or "/" in ext or ".." in ext \
+                or not safe_collection(collection):
+            return web.json_response({"error": "bad ext or collection"},
+                                     status=400)
         prefix = f"{collection}_" if collection else ""
         for loc in self.store.locations:
             path = os.path.join(loc.directory, f"{prefix}{vid}{ext}")
@@ -1254,6 +1298,9 @@ class VolumeServer:
         body = await request.json()
         vid = int(body["volume_id"])
         collection = body.get("collection", "")
+        if not safe_collection(collection):
+            return web.json_response({"error": "bad collection"},
+                                     status=400)
         source = body["source"]
         if self.store.find_volume(vid) is not None:
             return web.json_response({"error": "volume exists"}, status=409)

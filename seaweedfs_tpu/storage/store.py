@@ -81,6 +81,14 @@ class DiskLocation:
                 continue
 
 
+def safe_collection(name: str) -> bool:
+    """Collection names become file-name prefixes ("<collection>_<vid>.dat"),
+    so anything that can traverse directories must be rejected before any
+    path is built from caller input."""
+    return ("/" not in name and "\\" not in name and ".." not in name
+            and "\x00" not in name)
+
+
 def _parse_volume_file_name(name: str) -> tuple[str, Optional[int]]:
     if "_" in name:
         collection, _, vid_str = name.rpartition("_")

@@ -29,6 +29,24 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+def free_port_with_grpc_twin() -> int:
+    """A free HTTP port whose +10000 twin (the production gRPC
+    convention, grpc_client_server.go) is also free and <= 65535 — peers
+    derive each other's gRPC target from the HTTP url, so tests must
+    honor the convention."""
+    for _ in range(64):
+        port = free_port()
+        if port + 10000 > 65535:
+            continue
+        with socket.socket() as s:
+            try:
+                s.bind(("127.0.0.1", port + 10000))
+            except OSError:
+                continue
+            return port
+    raise RuntimeError("no free port pair found")
+
+
 class Cluster:
     def __init__(self, n_volume_servers: int = 3,
                  geometry: Geometry = TEST_GEOMETRY,
@@ -133,12 +151,13 @@ class Cluster:
 
     def add_volume_server(self, data_center: str = "dc1",
                           rack: str = "",
-                          use_grpc_heartbeat: bool = False) -> VolumeServer:
+                          use_grpc_heartbeat: bool = False,
+                          with_grpc: bool = False) -> VolumeServer:
         from aiohttp import web
 
         tmp = tempfile.TemporaryDirectory(prefix="weedtpu_vs_")
         self.tmpdirs.append(tmp)
-        port = free_port()
+        port = free_port_with_grpc_twin() if with_grpc else free_port()
         store = Store([tmp.name], max_volume_counts=[self.max_volumes],
                       coder_name=self.coder_name, geometry=self.geometry)
         vs = VolumeServer(store, self.master_url, url=f"127.0.0.1:{port}",
@@ -146,6 +165,7 @@ class Cluster:
                           rack=rack or f"rack{len(self.volume_servers) % 2}",
                           pulse_seconds=self.pulse,
                           use_grpc_heartbeat=use_grpc_heartbeat,
+                          grpc_port=port + 10000 if with_grpc else 0,
                           master_grpc_target=(
                               f"127.0.0.1:{self.master_grpc_port}"
                               if use_grpc_heartbeat else ""))
@@ -157,14 +177,17 @@ class Cluster:
         return vs
 
     def add_filer(self, store_name: str = "memory",
-                  chunk_size: int = 16 * 1024):
+                  chunk_size: int = 16 * 1024,
+                  with_grpc: bool = False):
         from aiohttp import web
 
         from seaweedfs_tpu.server.filer_server import FilerServer
 
-        port = free_port()
+        port = free_port_with_grpc_twin() if with_grpc else free_port()
         fs = FilerServer(self.master_url, store_name=store_name,
-                         chunk_size=chunk_size)
+                         chunk_size=chunk_size,
+                         url=f"127.0.0.1:{port}",
+                         grpc_port=port + 10000 if with_grpc else 0)
 
         self.runners.append(self.serve(fs.app, port))
         fs.url = f"127.0.0.1:{port}"

@@ -157,3 +157,408 @@ def test_grpc_heartbeat_disconnect_unregisters(cluster):
             break
         time.sleep(0.1)
     assert c.client.dir_status()["nodes"] == []
+
+
+# --- round-3: master admin RPCs ---
+
+def test_grpc_master_admin_surface(cluster):
+    """VolumeList / Statistics / CollectionList / GetMasterConfiguration
+    (weed/pb/master.proto:18-30)."""
+    import grpc
+
+    # the disconnect test above removed the node; bring one back
+    if not cluster.client.dir_status()["nodes"]:
+        cluster.add_volume_server(use_grpc_heartbeat=True)
+        cluster.wait_for_nodes(1)
+    cluster.client.upload(b"adm-surface")
+    cluster.wait_heartbeats()
+
+    async def go():
+        async with grpc.aio.insecure_channel(cluster.grpc_target) as ch:
+            stub = MasterStub(ch)
+            vl = await stub.VolumeList(pb.VolumeListRequest())
+            assert vl.volume_size_limit_mb > 0
+            assert len(vl.nodes) == 1
+            assert vl.nodes[0].volumes, "node has no volumes in VolumeList"
+            st = await stub.Statistics(pb.StatisticsRequest())
+            assert st.total_size > 0 and st.file_count >= 1
+            cl = await stub.CollectionList(pb.CollectionListRequest())
+            assert "" in list(cl.collections)
+            cfg = await stub.GetMasterConfiguration(
+                pb.GetMasterConfigurationRequest())
+            assert cfg.volume_size_limit_mb == vl.volume_size_limit_mb
+
+    _call(cluster, go)
+
+
+# --- round-3: VolumeServer service ---
+
+@pytest.fixture(scope="module")
+def vcluster():
+    c = Cluster(n_volume_servers=0)
+    c.add_volume_server(with_grpc=True)
+    c.wait_for_nodes(1)
+    c.vs_grpc_target = f"127.0.0.1:{c.volume_servers[0].grpc_port}"
+    yield c
+    c.shutdown()
+
+
+def test_grpc_volume_service_lifecycle(vcluster):
+    """Status, needle status, batch delete, mark readonly/writable,
+    vacuum check — the unary admin surface over real protobuf."""
+    import grpc
+
+    from seaweedfs_tpu.pb import volume_server_pb2 as vpb
+    from seaweedfs_tpu.pb.rpc import VolumeServerStub
+
+    c = vcluster
+    data = b"grpc-volume-payload " * 10
+    fid = c.client.upload(data)
+    vid = int(fid.split(",")[0])
+    c.wait_heartbeats()
+
+    async def go():
+        from seaweedfs_tpu.storage.file_id import FileId
+        f = FileId.parse(fid)
+        async with grpc.aio.insecure_channel(c.vs_grpc_target) as ch:
+            stub = VolumeServerStub(ch)
+            st = await stub.VolumeStatus(vpb.VolumeRef(volume_id=vid))
+            assert st.error == "" and st.file_count == 1
+            ns = await stub.VolumeNeedleStatus(vpb.NeedleStatusRequest(
+                volume_id=vid, needle_id=f.key))
+            assert ns.error == "" and ns.size == len(data)
+            vc = await stub.VacuumVolumeCheck(vpb.VolumeRef(volume_id=vid))
+            assert vc.error == "" and vc.garbage_ratio == 0.0
+            ro = await stub.VolumeMarkReadonly(vpb.VolumeRef(volume_id=vid))
+            assert ro.ok
+            assert c.volume_servers[0].store.find_volume(vid).read_only
+            rw = await stub.VolumeMarkWritable(vpb.VolumeRef(volume_id=vid))
+            assert rw.ok
+            bd = await stub.BatchDelete(vpb.BatchDeleteRequest(fids=[fid]))
+            assert bd.results[0].error == ""
+            assert bd.results[0].size > 0
+            srv = await stub.VolumeServerStatus(vpb.Empty())
+            assert srv.volume_count >= 1 and srv.disk_statuses
+
+    c.call(go())
+
+
+def test_grpc_copyfile_and_tail_streams(vcluster):
+    """CopyFile streams the raw .dat; VolumeTail streams needle records
+    (volume_grpc_copy.go / volume_grpc_tail.go)."""
+    import grpc
+
+    from seaweedfs_tpu.pb import volume_server_pb2 as vpb
+    from seaweedfs_tpu.pb.rpc import VolumeServerStub
+    from seaweedfs_tpu.storage.needle import Needle
+
+    c = vcluster
+    payload = b"tail-me " * 64
+    fid = c.client.upload(payload)
+    vid = int(fid.split(",")[0])
+    v = c.volume_servers[0].store.find_volume(vid)
+
+    async def go():
+        async with grpc.aio.insecure_channel(c.vs_grpc_target) as ch:
+            stub = VolumeServerStub(ch)
+            buf = bytearray()
+            async for chunk in stub.CopyFile(vpb.CopyFileRequest(
+                    volume_id=vid, ext=".dat")):
+                assert chunk.error == "", chunk.error
+                buf += chunk.data
+                if chunk.is_last:
+                    break
+            with open(v.base_file_name() + ".dat", "rb") as f:
+                assert bytes(buf) == f.read()
+
+            records = []
+            async for chunk in stub.VolumeTail(vpb.TailRequest(
+                    volume_id=vid, since_ns=0)):
+                assert chunk.error == "", chunk.error
+                if chunk.is_last:
+                    break
+                records.append(bytes(chunk.data))
+            assert records, "tail returned no records"
+            needles = [Needle.from_bytes(r, v.version) for r in records]
+            assert any(n.data == payload for n in needles)
+
+    c.call(go())
+
+
+def test_grpc_ec_shard_read_and_degraded_read(vcluster):
+    """EC shard reads ride the VolumeEcShardRead gRPC stream: encode a
+    volume, read a shard range over gRPC and compare with the local file;
+    then prove the degraded-read path uses gRPC by breaking the HTTP
+    fallback."""
+    import grpc
+
+    from cluster_util import TEST_GEOMETRY
+    from seaweedfs_tpu.pb import volume_server_pb2 as vpb
+    from seaweedfs_tpu.pb.rpc import VolumeServerStub
+    from seaweedfs_tpu.shell.ec_commands import EcCommands
+
+    c = vcluster
+    # three more grpc-enabled servers so shards spread out
+    while len(c.volume_servers) < 4:
+        c.add_volume_server(with_grpc=True)
+    c.wait_for_nodes(4)
+
+    fids = {}
+    for i in range(8):
+        data = bytes([65 + i]) * 2048
+        fids[c.client.upload(data, collection="gec")] = data
+    c.wait_heartbeats()
+    vid = int(next(iter(fids)).split(",")[0])
+    shell = EcCommands(c.client, TEST_GEOMETRY)
+    shell.encode(vid, "gec", apply=True)
+    c.wait_heartbeats()
+
+    # find a server holding shard 0 and read its first bytes over gRPC
+    holder = next(vs for vs in c.volume_servers
+                  if (vs.store.find_ec_volume(vid) is not None
+                      and 0 in vs.store.find_ec_volume(vid).shards))
+    local = holder.store.ec_shard_read(vid, 0, 0, 512)
+
+    async def read_remote():
+        async with grpc.aio.insecure_channel(
+                f"127.0.0.1:{holder.grpc_port}") as ch:
+            stub = VolumeServerStub(ch)
+            buf = bytearray()
+            async for chunk in stub.VolumeEcShardRead(
+                    vpb.EcShardReadRequest(volume_id=vid, shard_id=0,
+                                           offset=0, size=512)):
+                assert chunk.error == "", chunk.error
+                buf += chunk.data
+                if chunk.is_last:
+                    break
+            return bytes(buf)
+
+    assert c.call(read_remote()) == local
+
+    # degraded reads must work with the HTTP fallback disabled: the
+    # peer-shard fetch can only have used the gRPC stream
+    import urllib.request as _url
+    real_urlopen = _url.urlopen
+
+    def deny_admin_shard_read(url, *a, **k):
+        if "admin/ec/shard_read" in str(url):
+            raise AssertionError("HTTP fallback used for shard read")
+        return real_urlopen(url, *a, **k)
+
+    _url.urlopen = deny_admin_shard_read
+    try:
+        c.client._vid_cache.clear()
+        for fid, data in list(fids.items())[:4]:
+            assert c.client.download(fid) == data
+    finally:
+        _url.urlopen = real_urlopen
+
+
+# --- round-3: SeaweedFiler service ---
+
+@pytest.fixture(scope="module")
+def fcluster():
+    c = Cluster(n_volume_servers=1)
+    fs = c.add_filer(with_grpc=True)
+    c.filer_grpc_target = f"127.0.0.1:{fs.grpc_port}"
+    c.fs = fs
+    yield c
+    c.shutdown()
+
+
+def test_grpc_filer_entry_crud(fcluster):
+    import grpc
+
+    from seaweedfs_tpu.pb import filer_pb2 as fpb
+    from seaweedfs_tpu.pb.rpc import FilerStub
+
+    c = fcluster
+
+    async def go():
+        async with grpc.aio.insecure_channel(c.filer_grpc_target) as ch:
+            stub = FilerStub(ch)
+            ok = await stub.CreateEntry(fpb.EntryRequest(entry=fpb.Entry(
+                path="/grpc/a.txt",
+                attr=fpb.FuseAttributes(mode=0o100660, mtime=1.0),
+                chunks=[fpb.FileChunk(fid="9,deadbeef01", offset=0,
+                                      size=11)])))
+            assert ok.ok, ok.error
+            got = await stub.LookupDirectoryEntry(
+                fpb.LookupEntryRequest(directory="/grpc", name="a.txt"))
+            assert got.error == "" and got.entry.path == "/grpc/a.txt"
+            assert got.entry.chunks[0].fid == "9,deadbeef01"
+
+            # list streams entries
+            names = []
+            async for resp in stub.ListEntries(
+                    fpb.ListEntriesRequest(directory="/grpc")):
+                names.append(resp.entry.path)
+            assert names == ["/grpc/a.txt"]
+
+            # o_excl create collides
+            dup = await stub.CreateEntry(fpb.EntryRequest(
+                entry=fpb.Entry(path="/grpc/a.txt",
+                                attr=fpb.FuseAttributes(mode=0o100660)),
+                o_excl=True))
+            assert not dup.ok
+
+            ren = await stub.AtomicRenameEntry(fpb.RenameEntryRequest(
+                old_path="/grpc/a.txt", new_path="/grpc/b.txt"))
+            assert ren.ok, ren.error
+            gone = await stub.LookupDirectoryEntry(
+                fpb.LookupEntryRequest(directory="/grpc", name="a.txt"))
+            assert gone.error
+            dele = await stub.DeleteEntry(fpb.DeleteEntryRequest(
+                path="/grpc/b.txt", is_delete_data=False))
+            assert dele.ok, dele.error
+
+            # kv surface
+            put = await stub.KvPut(fpb.KvRequest(key=b"k1", value=b"v1"))
+            assert put.ok
+            got = await stub.KvGet(fpb.KvRequest(key=b"k1"))
+            assert got.value == b"v1"
+
+            cfg = await stub.GetFilerConfiguration(fpb.Empty())
+            assert cfg.masters and cfg.dir_buckets == "/buckets"
+            assert cfg.signature != 0
+
+    c.call(go())
+
+
+def test_grpc_filer_assign_and_lookup_volume(fcluster):
+    import grpc
+
+    from seaweedfs_tpu.pb import filer_pb2 as fpb
+    from seaweedfs_tpu.pb.rpc import FilerStub
+
+    c = fcluster
+
+    async def go():
+        async with grpc.aio.insecure_channel(c.filer_grpc_target) as ch:
+            stub = FilerStub(ch)
+            a = await stub.AssignVolume(fpb.AssignVolumeRequest(count=1))
+            assert a.error == "" and a.fid and a.url
+            vid = a.fid.split(",")[0]
+            lk = await stub.LookupVolume(fpb.LookupVolumeRequest(
+                volume_or_file_ids=[vid]))
+            assert lk.locations_map[vid].urls == [a.url]
+            cl = await stub.CollectionList(fpb.Empty())
+            assert list(cl.collections) is not None
+
+    c.call(go())
+
+
+def test_grpc_filer_subscribe_metadata(fcluster):
+    """SubscribeMetadata streams replay + live events — the gRPC twin of
+    /__meta__/subscribe (filer_grpc_server_sub_meta.go)."""
+    import grpc
+
+    from seaweedfs_tpu.pb import filer_pb2 as fpb
+    from seaweedfs_tpu.pb.rpc import FilerStub
+
+    c = fcluster
+
+    async def go():
+        async with grpc.aio.insecure_channel(c.filer_grpc_target) as ch:
+            stub = FilerStub(ch)
+            ok = await stub.CreateEntry(fpb.EntryRequest(entry=fpb.Entry(
+                path="/sub/replayed.txt",
+                attr=fpb.FuseAttributes(mode=0o100660))))
+            assert ok.ok
+            stream = stub.SubscribeMetadata(fpb.SubscribeMetadataRequest(
+                client_name="t", path_prefix="/sub", since_ns=0))
+            # replayed event arrives first
+            ev = await asyncio.wait_for(stream.read(), 5)
+            assert ev.new_entry.path == "/sub/replayed.txt"
+            # a live create is pushed
+            ok = await stub.CreateEntry(fpb.EntryRequest(entry=fpb.Entry(
+                path="/sub/live.txt",
+                attr=fpb.FuseAttributes(mode=0o100660))))
+            assert ok.ok
+            ev = await asyncio.wait_for(stream.read(), 5)
+            assert ev.new_entry.path == "/sub/live.txt"
+            stream.cancel()
+
+    c.call(go())
+
+
+def test_grpc_plane_enforces_ip_whitelist(vcluster):
+    """The gRPC surface wears the same whitelist envelope as HTTP guard_mw
+    — -whitelist deployments must not serve /admin operations openly on
+    port+10000."""
+    import grpc
+
+    from seaweedfs_tpu.pb import volume_server_pb2 as vpb
+    from seaweedfs_tpu.pb.rpc import VolumeServerStub
+    from seaweedfs_tpu.security.guard import Guard
+
+    c = vcluster
+    vs = c.volume_servers[0]
+    old_guard = vs.guard
+    vs.guard = Guard(whitelist=["10.99.99.99"])
+    try:
+        async def go():
+            async with grpc.aio.insecure_channel(c.vs_grpc_target) as ch:
+                stub = VolumeServerStub(ch)
+                with pytest.raises(grpc.aio.AioRpcError) as e:
+                    await stub.VolumeStatus(vpb.VolumeRef(volume_id=1))
+                assert e.value.code() == grpc.StatusCode.PERMISSION_DENIED
+                # streams are guarded too
+                with pytest.raises(grpc.aio.AioRpcError) as e:
+                    async for _ in stub.CopyFile(vpb.CopyFileRequest(
+                            volume_id=1, ext=".dat")):
+                        pass
+                assert e.value.code() == grpc.StatusCode.PERMISSION_DENIED
+
+        c.call(go())
+    finally:
+        vs.guard = old_guard
+
+
+def test_grpc_copyfile_rejects_traversal(vcluster):
+    """A crafted collection must not escape the data directory."""
+    import grpc
+
+    from seaweedfs_tpu.pb import volume_server_pb2 as vpb
+    from seaweedfs_tpu.pb.rpc import VolumeServerStub
+
+    c = vcluster
+
+    async def go():
+        async with grpc.aio.insecure_channel(c.vs_grpc_target) as ch:
+            stub = VolumeServerStub(ch)
+            chunks = []
+            async for chunk in stub.CopyFile(vpb.CopyFileRequest(
+                    volume_id=1, collection="../../../etc",
+                    ext=".conf")):
+                chunks.append(chunk)
+                if chunk.is_last:
+                    break
+            assert chunks[0].error
+            ok = await stub.VolumeCopy(vpb.VolumeCopyRequest(
+                volume_id=77, collection="../esc",
+                source_data_node="127.0.0.1:1"))
+            assert not ok.ok and "collection" in ok.error
+
+    c.call(go())
+
+
+def test_grpc_filer_statistics_reports_usage(fcluster):
+    import grpc
+
+    from seaweedfs_tpu.pb import filer_pb2 as fpb
+    from seaweedfs_tpu.pb.rpc import FilerStub
+
+    c = fcluster
+    c.client.upload(b"stats-payload " * 100)
+    c.wait_heartbeats()
+
+    async def go():
+        async with grpc.aio.insecure_channel(c.filer_grpc_target) as ch:
+            stub = FilerStub(ch)
+            st = await stub.Statistics(fpb.StatisticsRequest())
+            assert st.total_size > 0
+            assert st.file_count >= 1
+            assert st.used_size > 0
+
+    c.call(go())
