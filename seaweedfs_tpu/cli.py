@@ -104,13 +104,15 @@ def cmd_server(args) -> None:
         store = Store(args.dir.split(","), coder_name=args.coder,
                       geometry=geometry)
         await run_volume_server(args.ip, args.port, store, master_url,
-                                guard=guard)
+                                guard=guard,
+                                grpc_port=args.port + 10000)
         if args.filer:
             from .server.filer_server import run_filer
             await run_filer(args.ip, args.filer_port, master_url,
                             store_name="sqlite",
                             store_kwargs={"path": args.filer_db},
-                            guard=guard)
+                            guard=guard,
+                            grpc_port=args.filer_port + 10000)
         if args.s3:
             if not args.filer:
                 raise SystemExit("-s3 needs -filer")
@@ -439,119 +441,21 @@ def cmd_status(args) -> None:
 def cmd_benchmark(args) -> None:
     """Self-validating write/read benchmark (weed/command/benchmark.go):
     seeded unique payloads, hash-checked on read-back, latency
-    percentiles. Async client with pooled keep-alive connections so the
-    harness itself is not the bottleneck."""
-    import asyncio
-    import hashlib
-    import random
-    import time
+    percentiles. Raw-socket keep-alive engine (utils/bench_client.py) so
+    the harness is not the bottleneck it measures."""
+    from .utils.bench_client import run_benchmark
 
-    import aiohttp
-
-    rng = random.Random(42)
-    payloads: dict[str, str] = {}
     master = args.server.split(",")[0]
-
-    async def run() -> None:
-        conn = aiohttp.TCPConnector(limit=args.concurrency * 2)
-        sem = asyncio.Semaphore(args.concurrency)
-        async with aiohttp.ClientSession(connector=conn) as s:
-
-            async def one_write(i: int, data: bytes,
-                                pre: "tuple[str, str] | None") -> float:
-                async with sem:
-                    t0 = time.perf_counter()
-                    if pre is None:
-                        async with s.get(
-                                f"http://{master}/dir/assign") as r:
-                            a = await r.json()
-                        fid, url = a["fid"], a["url"]
-                        auth = a.get("auth", "")
-                    else:
-                        fid, url = pre
-                        auth = ""
-                    form = aiohttp.FormData()
-                    form.add_field("file", data, filename=f"bench{i}")
-                    headers = {}
-                    if auth:
-                        headers["Authorization"] = f"BEARER {auth}"
-                    async with s.post(f"http://{url}/{fid}",
-                                      data=form, headers=headers) as r:
-                        assert r.status == 201, r.status
-                    dt = time.perf_counter() - t0
-                payloads[fid] = hashlib.sha256(data).hexdigest()
-                return dt
-
-            pres: list = [None] * args.n
-            if args.assign_batch > 1:
-                # assign?count=N reserves N sequential keys in one master
-                # round trip (the reference's batched assignment API);
-                # derived fids share the volume and cookie. Per-fid write
-                # JWTs cannot be derived client-side, so a guarded cluster
-                # falls back to per-file assigns.
-                from seaweedfs_tpu.storage.file_id import FileId
-                got = 0
-                while got < args.n:
-                    want = min(args.assign_batch, args.n - got)
-                    async with s.get(f"http://{master}/dir/assign",
-                                     params={"count": str(want)}) as r:
-                        a = await r.json()
-                    if a.get("auth"):
-                        print("jwt-guarded cluster: falling back to "
-                              "per-file assigns")
-                        pres = [None] * args.n
-                        break
-                    base = FileId.parse(a["fid"])
-                    for j in range(want):
-                        pres[got + j] = (str(FileId(
-                            base.volume_id, base.key + j, base.cookie)),
-                            a["url"])
-                    got += want
-
-            blobs = [(i.to_bytes(8, "big")
-                      + rng.randbytes(max(args.size - 8, 0)))
-                     for i in range(args.n)]
-            t0 = time.perf_counter()
-            lat = await asyncio.gather(
-                *[one_write(i, b, pres[i]) for i, b in enumerate(blobs)])
-            wall = time.perf_counter() - t0
-            lat = sorted(lat)
-            print(f"writes: {args.n} in {wall:.2f}s -> "
-                  f"{args.n/wall:.1f} req/s, "
-                  f"p50={lat[len(lat)//2]*1e3:.1f}ms "
-                  f"p95={lat[int(len(lat)*0.95)]*1e3:.1f}ms "
-                  f"p99={lat[int(len(lat)*0.99)]*1e3:.1f}ms")
-
-            lookup_cache: dict[str, list] = {}
-
-            async def one_read(fid: str) -> bool:
-                async with sem:
-                    vid = fid.split(",")[0]
-                    urls = lookup_cache.get(vid)
-                    if urls is None:
-                        async with s.get(f"http://{master}/dir/lookup",
-                                         params={"volumeId": vid}) as r:
-                            body = await r.json()
-                        urls = [x["url"] for x in body.get("locations", [])]
-                        lookup_cache[vid] = urls
-                    if not urls:
-                        return False  # counted as corrupt, not a crash
-                    async with s.get(f"http://{urls[0]}/{fid}") as r:
-                        if r.status != 200:
-                            return False
-                        data = await r.read()
-                return hashlib.sha256(data).hexdigest() == payloads[fid]
-
-            t0 = time.perf_counter()
-            results = await asyncio.gather(*[one_read(f) for f in payloads])
-            wall = time.perf_counter() - t0
-            bad = results.count(False)
-            print(f"reads: {len(results)} in {wall:.2f}s -> "
-                  f"{len(results)/wall:.1f} req/s, {bad} corrupt")
-            if bad:
-                raise SystemExit(1)
-
-    asyncio.run(run())
+    out = run_benchmark(master, n=args.n, size=args.size,
+                        concurrency=args.concurrency)
+    w, r = out["write"], out["read"]
+    print(f"writes: {w['n']} in {w['wall_s']}s -> {w['req_s']} req/s, "
+          f"p50={w.get('p50_ms')}ms p95={w.get('p95_ms')}ms "
+          f"p99={w.get('p99_ms')}ms ({out['write_errors']} errors)")
+    print(f"reads: {r['n']} in {r['wall_s']}s -> {r['req_s']} req/s, "
+          f"{out['corrupt']} corrupt")
+    if out["corrupt"] or out["write_errors"]:
+        raise SystemExit(1)
 
 
 def cmd_mount(args) -> None:
@@ -818,9 +722,6 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("-n", type=int, default=1000)
     b.add_argument("-size", type=int, default=1024)
     b.add_argument("-concurrency", type=int, default=16)
-    b.add_argument("-assign_batch", type=int, default=1,
-                   help="keys reserved per /dir/assign round trip "
-                        "(1 = a master assign per write)")
     b.set_defaults(fn=cmd_benchmark)
 
     sc = sub.add_parser("scaffold", help="emit default TOML config templates")

@@ -65,6 +65,20 @@ class ErasureCoder:
         """Block until a handle from encode_async/rec_apply_async is real."""
         return np.asarray(handle)
 
+    def encode_digest_async(self, data: np.ndarray):
+        """Dispatch encode + on-device parity digest; handle materializes to
+        [m] uint32 — per parity row, the wrapping byte sum mod 2^32.
+
+        Device backends fuse the reduction into the encode jit so only 4*m
+        bytes ever cross device->host: the link-independent sink the
+        streaming pipeline's bench mode needs (pipeline.stream_encode is
+        otherwise bound by the D2H link, which parity must cross to reach
+        shard files). Digests combine across batches by wrapping addition,
+        and zero-padding contributes nothing (parity of zeros is zeros).
+        """
+        parity = self.encode(data)
+        return np.sum(parity, axis=1, dtype=np.uint32)
+
     def reconstruct(self, shards: Sequence[Optional[np.ndarray]],
                     data_only: bool = False,
                     targets: Optional[Sequence[int]] = None
@@ -122,6 +136,20 @@ class NumpyCoder(ErasureCoder):
         return apply_fn
 
 
+def _fused_digest(encode_fn):
+    """jit(encode -> per-row uint32 byte sum): parity stays on device, the
+    4*m-byte digest is all that materializes."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def fn(data):
+        parity = encode_fn(data)
+        return jnp.sum(parity.astype(jnp.uint32), axis=1, dtype=jnp.uint32)
+
+    return fn
+
+
 class JaxCoder(ErasureCoder):
     def __init__(self, data_shards: int, parity_shards: int,
                  method: str = "bitplane"):
@@ -149,6 +177,15 @@ class JaxCoder(ErasureCoder):
         return lambda survivors: fn(
             jax.device_put(np.asarray(survivors, dtype=np.uint8)))
 
+    def encode_digest_async(self, data: np.ndarray):
+        import jax
+        fn = getattr(self, "_digest_fn", None)
+        if fn is None:
+            fn = self._digest_fn = _fused_digest(
+                lambda d: rs_jax.encode_parity(d, self.m,
+                                               method=self.method))
+        return fn(jax.device_put(np.asarray(data, dtype=np.uint8)))
+
 
 class PallasCoder(ErasureCoder):
     """Fused TPU kernel path (rs_pallas.py); interpret-mode on CPU."""
@@ -162,6 +199,7 @@ class PallasCoder(ErasureCoder):
         self._encode = rs_pallas.gf_apply_pallas(
             gf256.parity_matrix(data_shards, parity_shards), tile=self._tile)
         self._rec_cache: dict = {}
+        self._digest_cache: dict = {}
 
     def _shrink_tile(self) -> None:
         """Fallback for chips whose VMEM can't hold the default tile:
@@ -226,6 +264,19 @@ class PallasCoder(ErasureCoder):
                     self._shrink_tile()
 
         return run
+
+    def encode_digest_async(self, data: np.ndarray):
+        import jax
+        d = jax.device_put(np.asarray(data, dtype=np.uint8))
+        while True:
+            try:
+                fn = self._digest_cache.get(self._tile)
+                if fn is None:
+                    fn = _fused_digest(self._encode)
+                    self._digest_cache[self._tile] = fn
+                return fn(d)
+            except Exception:
+                self._shrink_tile()
 
 
 class CppCoder(ErasureCoder):

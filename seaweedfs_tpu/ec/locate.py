@@ -37,9 +37,14 @@ class Interval:
 def locate_data(g: Geometry, dat_size: int, offset: int,
                 size: int) -> list[Interval]:
     block_index, is_large, inner = _locate_offset(g, dat_size, offset)
-    # + one small row so the large-row count can be derived from a shard size
-    # that was rounded up to whole small blocks (ec_locate.go:19-20)
-    n_large_rows = (dat_size + g.small_row_size) // g.large_row_size
+    # The encoder guarantees < ratio small rows per volume (a tail that
+    # would need a full large_block of small rows is written as a padded
+    # large row instead — striping.write_ec_files), so the plain floor is
+    # exact even for dat_size padded up to whole small blocks. The
+    # reference instead adds one small row here (ec_locate.go:19-20),
+    # which misaddresses layouts whose small region is exactly
+    # large_block-sized — an inconsistency this build removes.
+    n_large_rows = dat_size // g.large_row_size
 
     intervals: list[Interval] = []
     while size > 0:

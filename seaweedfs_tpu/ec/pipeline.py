@@ -107,7 +107,10 @@ def _sub_batches(dat_size: int, g: Geometry,
 
     remaining = dat_size
     processed = 0
-    while remaining > g.large_row_size:
+    # same large-row rule as striping.write_ec_files: a tail needing a full
+    # large_block worth of small rows would make the shard size ambiguous
+    # for locate; pad the final large row instead
+    while remaining > g.large_row_size - g.small_row_size:
         yield from rows(processed, g.large_block_size)
         remaining -= g.large_row_size
         processed += g.large_row_size
@@ -252,6 +255,63 @@ def stream_encode(base_file_name: str, coder: ErasureCoder,
         os.close(dat_fd)
     if fan.errors:
         raise fan.errors[0]
+
+
+def stream_encode_device_sink(base_file_name: str, coder: ErasureCoder,
+                              geometry: Geometry = DEFAULT,
+                              batch_size: int = DEFAULT_BATCH_SIZE,
+                              depth: int = DEFAULT_DEPTH) -> np.ndarray:
+    """stream_encode with the parity landing in an on-device sink.
+
+    Runs the identical reader / H2D / kernel schedule as stream_encode but
+    reduces each batch's parity to a [m] uint32 wrapping byte-sum digest on
+    the device — only 4*m bytes per batch cross device->host and no shard
+    files are written. Returns the combined digest over the whole volume.
+
+    Two uses:
+      * bench.py: measures the disk->host->HBM->kernel pipeline end-to-end
+        on links whose device->host direction is degraded (tunneled dev
+        chips), where stream_encode is bound by the D2H link parity must
+        cross to reach disk.
+      * tests: the digest equals the per-row byte sums of the parity shard
+        files stream_encode writes (padding encodes to zeros), so the sink
+        is provably the same computation, not a shortcut XLA could elide.
+    """
+    g = geometry
+    assert coder.k == g.data_shards and coder.m == g.parity_shards
+    dat_size = os.path.getsize(base_file_name + ".dat")
+    dat_fd = os.open(base_file_name + ".dat", os.O_RDONLY)
+    total = np.zeros(g.parity_shards, dtype=np.uint32)
+
+    def consume(data: np.ndarray, handle) -> None:
+        digest = np.asarray(coder.materialize(handle), dtype=np.uint32)
+        np.add(total, digest, out=total)  # uint32 wraparound combines
+
+    try:
+        with ThreadPoolExecutor(max_workers=_READ_POOL_WORKERS) as pool:
+            _run_pipeline(
+                _encode_batches(pool, dat_fd, dat_size, g, batch_size),
+                coder.encode_digest_async, consume, depth)
+    finally:
+        os.close(dat_fd)
+    return total
+
+
+def parity_file_digest(base_file_name: str,
+                       geometry: Geometry = DEFAULT) -> np.ndarray:
+    """[m] uint32 wrapping byte-sum of each parity shard file — the
+    host-side cross-check for stream_encode_device_sink."""
+    g = geometry
+    out = np.zeros(g.parity_shards, dtype=np.uint32)
+    for row, i in enumerate(range(g.data_shards, g.total_shards)):
+        with open(base_file_name + to_ext(i), "rb") as f:
+            while True:
+                chunk = f.read(1 << 24)
+                if not chunk:
+                    break
+                out[row] += np.sum(np.frombuffer(chunk, dtype=np.uint8),
+                                   dtype=np.uint32)
+    return out
 
 
 def stream_rebuild(base_file_name: str, coder: ErasureCoder,

@@ -53,7 +53,17 @@ def write_ec_files(base_file_name: str, coder: ErasureCoder,
         with open(base_file_name + ".dat", "rb") as dat:
             remaining = dat_size
             processed = 0
-            while remaining > g.large_row_size:
+            # large rows while the tail can't fit in < ratio small rows: a
+            # tail of exactly large_block worth of small blocks would make
+            # the shard size ambiguous (locate derives the large-row count
+            # from k*shard_size, ec_locate.go:19-20 — the reference's own
+            # encoder can produce that ambiguous layout and misaddress it;
+            # here the final large row is zero-padded instead, same shard
+            # size, unambiguous). FORMAT NOTE: this rule changed in-dev
+            # (pre-release, no at-rest migration): shards whose dat tail
+            # fell in (large_row - small_row, large_row) and were encoded
+            # by the older rule must be re-encoded from their volume.
+            while remaining > g.large_row_size - g.small_row_size:
                 _encode_row(dat, coder, processed, g.large_block_size,
                             min(buffer_size, g.large_block_size), outputs, g)
                 remaining -= g.large_row_size
@@ -169,10 +179,15 @@ def write_dat_file(base_file_name: str, dat_size: int,
     try:
         with open(base_file_name + ".dat", "wb") as dat:
             remaining = dat_size
-            while remaining >= g.large_row_size:
+            # inverse of write_ec_files' large-row rule (the final large
+            # row may be zero-padded, so clamp to the live remainder)
+            while remaining > g.large_row_size - g.small_row_size:
                 for f in inputs:
-                    _copy_n(f, dat, g.large_block_size)
-                remaining -= g.large_row_size
+                    n = min(remaining, g.large_block_size)
+                    _copy_n(f, dat, n)
+                    remaining -= n
+                    if remaining <= 0:
+                        break
             while remaining > 0:
                 for f in inputs:
                     n = min(remaining, g.small_block_size)

@@ -235,7 +235,7 @@ class BrokerServer:
 async def run_broker(host: str, port: int, filer_url: str = "",
                      **kwargs) -> web.AppRunner:
     server = BrokerServer(filer_url=filer_url, **kwargs)
-    runner = web.AppRunner(server.app)
+    runner = web.AppRunner(server.app, access_log=None)
     await runner.setup()
     site = web.TCPSite(runner, host, port)
     await site.start()

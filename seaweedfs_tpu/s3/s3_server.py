@@ -796,7 +796,7 @@ def _iso(ts: float) -> str:
 async def run_s3(host: str, port: int, filer_url: str,
                  **kwargs) -> web.AppRunner:
     server = S3Server(filer_url, **kwargs)
-    runner = web.AppRunner(server.app)
+    runner = web.AppRunner(server.app, access_log=None)
     await runner.setup()
     site = web.TCPSite(runner, host, port)
     await site.start()

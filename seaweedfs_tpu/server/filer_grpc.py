@@ -86,6 +86,7 @@ class FilerGrpcServicer:
     def __init__(self, fs):
         self.fs = fs            # FilerServer
         self.filer = fs.filer
+        self._append_locks: dict[str, list] = {}
 
     # --- entry CRUD ---
     async def LookupDirectoryEntry(self, request: pb.LookupEntryRequest,
@@ -133,17 +134,33 @@ class FilerGrpcServicer:
 
     async def AppendToEntry(self, request: pb.AppendToEntryRequest,
                             context):
-        entry = await _run(lambda: self.filer.find_entry(request.path))
-        if entry is None:
-            return _err("not found")
-        offset = entry.size()
-        for c in request.chunks:
-            entry.chunks.append(FileChunk(
-                fid=c.fid, offset=offset, size=c.size, mtime=c.mtime_ns,
-                etag=c.etag, is_chunk_manifest=c.is_chunk_manifest,
-                cipher_key=c.cipher_key))
-            offset += c.size
-        await _run(lambda: self.filer.update_entry(entry))
+        # read-modify-write under a per-path lock: two concurrent appends
+        # would otherwise compute the same base offset and one chunk list
+        # overwrite the other's (the reference serializes in the filer
+        # store transaction, filer_grpc_server_append.go)
+        holder = self._append_locks.get(request.path)
+        if holder is None:  # [lock, refcount]; entry dropped at zero
+            holder = self._append_locks[request.path] = [asyncio.Lock(), 0]
+        holder[1] += 1
+        try:
+            async with holder[0]:
+                entry = await _run(
+                    lambda: self.filer.find_entry(request.path))
+                if entry is None:
+                    return _err("not found")
+                offset = entry.size()
+                for c in request.chunks:
+                    entry.chunks.append(FileChunk(
+                        fid=c.fid, offset=offset, size=c.size,
+                        mtime=c.mtime_ns, etag=c.etag,
+                        is_chunk_manifest=c.is_chunk_manifest,
+                        cipher_key=c.cipher_key))
+                    offset += c.size
+                await _run(lambda: self.filer.update_entry(entry))
+        finally:
+            holder[1] -= 1
+            if holder[1] == 0:
+                self._append_locks.pop(request.path, None)
         return _ok()
 
     async def DeleteEntry(self, request: pb.DeleteEntryRequest, context):
@@ -301,15 +318,20 @@ class FilerGrpcServicer:
         The reference uses this to track attached mounts/brokers
         (filer_grpc_server.go KeepConnected)."""
         name = None
+        entry = None
         try:
             async for req in request_iterator:
                 name = req.name
-                self.fs.connected_clients[name] = list(req.resources)
+                entry = list(req.resources)
+                self.fs.connected_clients[name] = entry
                 yield pb.KeepConnectedResponse()
         finally:
             # stream end = client gone; a stale entry would report dead
-            # mounts as attached forever
-            if name is not None:
+            # mounts as attached forever — but only remove OUR entry: a
+            # client that already reconnected under the same name has
+            # replaced it, and popping would deregister the live stream
+            if (name is not None
+                    and self.fs.connected_clients.get(name) is entry):
                 self.fs.connected_clients.pop(name, None)
 
     async def LocateBroker(self, request: pb.LocateBrokerRequest, context):

@@ -902,7 +902,7 @@ class FilerServer:
 async def run_filer(host: str, port: int, master_url: str,
                     **kwargs) -> web.AppRunner:
     server = FilerServer(master_url, **kwargs)
-    runner = web.AppRunner(server.app)
+    runner = web.AppRunner(server.app, access_log=None)
     await runner.setup()
     site = web.TCPSite(runner, host, port)
     await site.start()

@@ -832,7 +832,7 @@ class MasterServer:
 
 async def run_master(host: str, port: int, **kwargs) -> web.AppRunner:
     server = MasterServer(**kwargs)
-    runner = web.AppRunner(server.app)
+    runner = web.AppRunner(server.app, access_log=None)
     await runner.setup()
     site = web.TCPSite(runner, host, port)
     await site.start()

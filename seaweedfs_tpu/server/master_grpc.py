@@ -236,6 +236,12 @@ class MasterGrpcServicer:
             collections=self.master.collection_names())
 
     async def CollectionDelete(self, request, context):
+        if not request.name:
+            # proto3 zero value must not match the default collection —
+            # that would delete every unlabeled volume cluster-wide (the
+            # HTTP twin rejects empty names the same way)
+            return pb.CollectionDeleteResponse(
+                ok=False, error="collection name required")
         out = await self.master.delete_collection(request.name)
         if out["errors"]:
             return pb.CollectionDeleteResponse(

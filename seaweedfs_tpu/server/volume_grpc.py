@@ -393,7 +393,15 @@ class VolumeGrpcServicer:
                 data = await _run(
                     lambda o=offset, s=n: self.store.ec_shard_read(
                         request.volume_id, request.shard_id, o, s))
-                yield pb.DataChunk(data=data)
+                if data:
+                    yield pb.DataChunk(data=data)
+                if len(data) < n:
+                    # short pread = range past shard EOF; a silent
+                    # truncated stream would look complete to the caller
+                    yield pb.DataChunk(
+                        error=f"short read at {offset + len(data)}",
+                        is_last=True)
+                    return
                 offset += n
                 remaining -= n
             yield pb.DataChunk(is_last=True)

@@ -278,7 +278,7 @@ class WebDavServer:
 async def run_webdav(host: str, port: int, filer_url: str,
                      **kwargs) -> web.AppRunner:
     server = WebDavServer(filer_url, **kwargs)
-    runner = web.AppRunner(server.app)
+    runner = web.AppRunner(server.app, access_log=None)
     await runner.setup()
     site = web.TCPSite(runner, host, port)
     await site.start()

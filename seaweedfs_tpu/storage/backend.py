@@ -55,6 +55,7 @@ class BackendStorageFile:
 
     name = "base"
     writable = False
+    is_local = False  # True = page-cache positioned IO (no network)
 
     def read_at(self, n: int, offset: int) -> bytes:
         raise NotImplementedError
@@ -87,6 +88,7 @@ class DiskFile(BackendStorageFile):
 
     name = "local"
     writable = True
+    is_local = True
 
     def __init__(self, path: str, create: bool = False,
                  preallocate: int = 0):

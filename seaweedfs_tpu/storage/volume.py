@@ -207,6 +207,27 @@ class Volume:
                     out.append(e)
         return out
 
+    def write_needles_batch_nowait(self, needles: list[Needle]
+                                   ) -> Optional[list]:
+        """Non-blocking write_needles_batch for event-loop callers: None
+        (meaning "use the executor") unless the backend is local disk, the
+        lock is uncontended (vacuum holds it for seconds), and no needle
+        overwrites an existing entry big enough to make the unchanged-
+        content re-read a real disk stall."""
+        if not getattr(self._dat, "is_local", False):
+            return None
+        if not self._lock.acquire(blocking=False):
+            return None
+        try:
+            for n in needles:
+                nv = self.nm.get(n.id)
+                if (nv is not None and t.size_is_valid(nv.size)
+                        and nv.size > 64 * 1024):
+                    return None
+            return self.write_needles_batch(needles)
+        finally:
+            self._lock.release()
+
     def _is_unchanged(self, n: Needle, nv: NeedleValue) -> bool:
         if not t.size_is_valid(nv.size):
             return False
@@ -226,6 +247,10 @@ class Volume:
             if t.size_is_deleted(nv.size):
                 raise NeedleDeleted(f"needle {needle_id:x} deleted")
             n = self.read_needle_at(t.stored_to_offset(nv.offset), nv.size)
+        return self._check_read(n, needle_id, cookie, now)
+
+    def _check_read(self, n: Needle, needle_id: int,
+                    cookie: Optional[int], now: Optional[float]) -> Needle:
         if cookie is not None and n.cookie != cookie:
             raise NeedleNotFound(f"needle {needle_id:x} cookie mismatch")
         if n.ttl.minutes() and n.has(FLAG_HAS_LAST_MODIFIED):
@@ -233,6 +258,32 @@ class Volume:
             if (now if now is not None else time.time()) >= deadline:
                 raise NeedleExpired(f"needle {needle_id:x} expired")
         return n
+
+    def read_needle_nowait(self, needle_id: int,
+                           cookie: Optional[int] = None,
+                           max_size: int = 64 * 1024) -> Optional[Needle]:
+        """Non-blocking fast path for event-loop callers: None (meaning
+        "use the executor") unless the backend is local disk, the lock is
+        uncontended (vacuum/compaction hold it for seconds), and the
+        stored needle is small enough that a page-cache pread won't stall
+        the loop. Raises the same not-found/deleted/expired errors as
+        read_needle."""
+        if not getattr(self._dat, "is_local", False):
+            return None
+        if not self._lock.acquire(blocking=False):
+            return None
+        try:
+            nv = self.nm.get(needle_id)
+            if nv is None or nv.offset == 0:
+                raise NeedleNotFound(f"needle {needle_id:x} not found")
+            if t.size_is_deleted(nv.size):
+                raise NeedleDeleted(f"needle {needle_id:x} deleted")
+            if nv.size > max_size:
+                return None
+            n = self.read_needle_at(t.stored_to_offset(nv.offset), nv.size)
+        finally:
+            self._lock.release()
+        return self._check_read(n, needle_id, cookie, None)
 
     def read_needle_at(self, byte_offset: int, size: int) -> Needle:
         # positioned read: does not disturb the append position and is safe

@@ -30,11 +30,16 @@ class _GlogFormatter(logging.Formatter):
         micros = int((record.created % 1) * 1e6)
         letter = {"DEBUG": "D", "INFO": "I", "WARNING": "W",
                   "ERROR": "E", "CRITICAL": "F"}.get(record.levelname, "I")
-        return (f"{letter}{t.tm_mon:02d}{t.tm_mday:02d} "
-                f"{t.tm_hour:02d}:{t.tm_min:02d}:{t.tm_sec:02d}.{micros:06d} "
-                f"{record.thread % 100000:5d} "
-                f"{os.path.basename(record.pathname)}:{record.lineno}] "
-                f"{record.getMessage()}")
+        out = (f"{letter}{t.tm_mon:02d}{t.tm_mday:02d} "
+               f"{t.tm_hour:02d}:{t.tm_min:02d}:{t.tm_sec:02d}.{micros:06d} "
+               f"{record.thread % 100000:5d} "
+               f"{os.path.basename(record.pathname)}:{record.lineno}] "
+               f"{record.getMessage()}")
+        if record.exc_info and record.exc_info[0] is not None:
+            # dropping exc_info here loses every handler traceback
+            # (aiohttp logs 500s through this path)
+            out += "\n" + self.formatException(record.exc_info)
+        return out
 
 
 def setup(verbosity: int = 0, vmodule: str = "", log_file: str = "",

@@ -67,6 +67,69 @@ def test_stream_rebuild_roundtrip(tmp_path):
         assert _sha(base + ec.to_ext(i)) == golden[i], i
 
 
+@pytest.mark.parametrize("dat_blocks", [
+    # mixed-tier sizes in units of GEO.small_block (100B), chosen around the
+    # large-row (10000B -> 1000 small units... here large=10000, small=100,
+    # ratio=100, large_row=100000, small_row=1000) ambiguity window: a tail
+    # needing a full large_block of small rows used to make k*shard_size
+    # decode to the wrong large-row count (the reference's own layout has
+    # this inconsistency, ec_locate.go:19-20 vs ec_encoder.go:57)
+    99_000, 99_001, 100_000, 100_001, 152_000, 199_999, 200_000])
+def test_mixed_tier_layout_consistency(tmp_path, dat_blocks):
+    import numpy as np
+
+    from seaweedfs_tpu.ec.locate import locate_data
+    size = dat_blocks  # bytes
+    rng = np.random.default_rng(size % 89)
+    dat = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+    base = os.path.join(str(tmp_path), "1")
+    with open(base + ".dat", "wb") as f:
+        f.write(dat)
+    coder = ec.get_coder("numpy", 10, 4)
+    ec.write_ec_files(base, coder, GEO, buffer_size=100)
+    base2 = os.path.join(str(tmp_path), "2")
+    with open(base2 + ".dat", "wb") as f:
+        f.write(dat)
+    pipeline.stream_encode(base2, coder, GEO, batch_size=1000)
+    for i in range(14):
+        assert _sha(base + ec.to_ext(i)) == _sha(base2 + ec.to_ext(i)), i
+    # locate() addressing must read back the true bytes through shards
+    shard_bytes = [open(base + ec.to_ext(i), "rb").read()
+                   for i in range(10)]
+    padded = 10 * os.path.getsize(base + ec.to_ext(0))
+    for start, ln in ((0, min(size, 777)), (size // 2, 555),
+                      (max(0, size - 999), 999)):
+        ln = min(ln, size - start)
+        got = b""
+        for iv in locate_data(GEO, padded, start, ln):
+            sid, o = iv.to_shard_id_and_offset(GEO)
+            got += shard_bytes[sid][o:o + iv.size]
+        assert got == dat[start:start + ln], (start, ln)
+    # decode inverts encode
+    os.remove(base + ".dat")
+    ec.write_dat_file(base, size, GEO)
+    assert open(base + ".dat", "rb").read() == dat
+
+
+@pytest.mark.parametrize("coder_name", ["numpy", "jax", "pallas"])
+def test_device_sink_digest_matches_shard_files(tmp_path, coder_name):
+    # the on-device parity sink (bench mode) must be the same computation
+    # as the file-writing path: its [m] uint32 wrapping byte-sum digest has
+    # to equal the sums over the parity shard files stream_encode writes
+    build_volume(tmp_path)
+    coder = ec.get_coder(coder_name, 10, 4)
+    base = os.path.join(str(tmp_path), "1")
+    pipeline.stream_encode(base, coder, GEO, batch_size=4096)
+    want = pipeline.parity_file_digest(base, GEO)
+    got = pipeline.stream_encode_device_sink(base, coder, GEO,
+                                             batch_size=4096)
+    assert got.tolist() == want.tolist()
+    # batch width must not change the combined digest
+    got2 = pipeline.stream_encode_device_sink(base, coder, GEO,
+                                              batch_size=512)
+    assert got2.tolist() == want.tolist()
+
+
 def test_stream_rebuild_too_few_shards(tmp_path):
     build_volume(tmp_path)
     coder = ec.get_coder("numpy", 10, 4)
