@@ -59,11 +59,14 @@ def _make_volume(path: str, size: int) -> None:
             left -= n
 
 
-def measure_link() -> tuple[float, float]:
-    """Host<->device link bandwidth (GB/s). On tunneled single-chip dev
-    environments (axon) the device->host direction can be orders of
-    magnitude slower than HBM — it caps any pipeline that must land parity
-    bytes on host disk, so it is measured and reported explicitly."""
+def measure_link() -> tuple[float, float, float]:
+    """Host<->device link: (h2d GB/s, d2h GB/s, d2h per-op latency s).
+
+    On tunneled single-chip dev environments (axon) the device->host
+    direction can be orders of magnitude slower than HBM AND carries a
+    multi-second per-operation latency — a 16-byte fetch costs the same
+    seconds as a 1MB one. Both numbers are measured so the bench can model
+    a D2H-crossing phase as ops*latency + bytes/bandwidth."""
     import jax
     x = np.zeros(64 * 1024 * 1024, dtype=np.uint8)
     d = jax.device_put(x)
@@ -72,13 +75,20 @@ def measure_link() -> tuple[float, float]:
     d = jax.device_put(x)
     d.block_until_ready()
     h2d = x.nbytes / (time.perf_counter() - t0) / 1e9
-    np.asarray(d)  # first fetch may include warmup
+    tiny = jax.device_put(np.zeros(16, dtype=np.uint8))
+    tiny.block_until_ready()
+    np.asarray(tiny)  # first fetch may include warmup
+    tiny2 = jax.device_put(np.ones(16, dtype=np.uint8))
+    tiny2.block_until_ready()
+    t0 = time.perf_counter()
+    np.asarray(tiny2)
+    d2h_lat = time.perf_counter() - t0
     e = jax.device_put(np.ones_like(x))
     e.block_until_ready()
     t0 = time.perf_counter()
     np.asarray(e)
-    d2h = x.nbytes / (time.perf_counter() - t0) / 1e9
-    return h2d, d2h
+    d2h = x.nbytes / max(time.perf_counter() - t0 - d2h_lat, 1e-9) / 1e9
+    return h2d, d2h, d2h_lat
 
 
 def bench_fused(work: str, coder, vol_size: int) -> dict:
@@ -208,6 +218,43 @@ def bench_system(work: str, n: int = 6000, size: int = 1024,
     return out
 
 
+def bench_needle_map(work: str, n: int = 5_000_000) -> dict:
+    """Disk-backed needle map at volume scale: cold .sdx build from the
+    .idx journal, warm adoption, and random lookup latency — the numbers
+    behind the -index leveldb kinds (needle_map_leveldb.go's role)."""
+    import numpy as np
+
+    from seaweedfs_tpu.storage.needle_map import DiskNeedleMap
+
+    rec = np.empty(n, dtype=[("k", ">u8"), ("o", ">u4"), ("s", ">u4")])
+    rec["k"] = np.arange(1, n + 1)
+    rec["o"] = np.arange(1, n + 1)
+    rec["s"] = 1000
+    path = os.path.join(work, "nmbench.idx")
+    rec.tofile(path)
+    del rec
+    t0 = time.perf_counter()
+    nm = DiskNeedleMap(path)
+    cold_s = time.perf_counter() - t0
+    nm.close()
+    t0 = time.perf_counter()
+    nm = DiskNeedleMap(path)
+    warm_s = time.perf_counter() - t0
+    rng = np.random.default_rng(3)
+    keys = rng.integers(1, n + 1, 2000)
+    lat = []
+    for key in keys:
+        t0 = time.perf_counter()
+        nm.get(int(key))
+        lat.append(time.perf_counter() - t0)
+    nm.close()
+    lat.sort()
+    return {"entries": n, "cold_build_s": round(cold_s, 3),
+            "warm_open_s": round(warm_s, 4),
+            "lookup_p50_us": round(lat[len(lat) // 2] * 1e6, 1),
+            "lookup_p99_us": round(lat[int(len(lat) * 0.99)] * 1e6, 1)}
+
+
 def main() -> None:
     import jax
 
@@ -227,7 +274,7 @@ def main() -> None:
     rebuild_reps = 2 if on_tpu else 1
     batch = 16 * 1024 * 1024 if on_tpu else 1024 * 1024
 
-    h2d_gbps, d2h_gbps = measure_link()
+    h2d_gbps, d2h_gbps, d2h_lat_s = measure_link()
     if on_tpu:
         coder = ec.get_coder("pallas", 10, 4)
     else:
@@ -238,7 +285,8 @@ def main() -> None:
     work = tempfile.mkdtemp(prefix="swfs_bench_")
     try:
         _run_configs(work, coder, vol_size, kernel_n, kernel_reps,
-                     rebuild_reps, batch, backend, h2d_gbps, d2h_gbps)
+                     rebuild_reps, batch, backend, h2d_gbps,
+                     d2h_gbps, d2h_lat_s)
     except Exception as e:
         # keep the one-JSON-line contract even for correctness failures
         print(json.dumps({
@@ -258,7 +306,8 @@ def _phase(name: str, t0: float) -> float:
 
 
 def _run_configs(work, coder, vol_size, kernel_n, kernel_reps, rebuild_reps,
-                 batch, backend, h2d_gbps, d2h_gbps) -> None:
+                 batch, backend, h2d_gbps, d2h_gbps,
+                 d2h_lat_s) -> None:
     from seaweedfs_tpu import ec
     from seaweedfs_tpu.ec import pipeline
 
@@ -316,56 +365,13 @@ def _run_configs(work, coder, vol_size, kernel_n, kernel_reps, rebuild_reps,
     except Exception as e:
         system = {"error": str(e)}
 
-    # --- optional, D2H-bound phases (disk-mode encode writes 4/14 of the
-    # volume back through the degraded link; rebuild writes 4 shards) ---
+    try:
+        needle_map = bench_needle_map(work)
+        t = _phase("disk needle map", t)
+    except Exception as e:
+        needle_map = {"error": str(e)}
+
     soft_deadline = started + SOFT_BUDGET_S
-    est_d2h_s = (0.4 * vol_size / 1e9) / max(d2h_gbps, 1e-6)
-    disk_feasible = (time.perf_counter() + est_d2h_s
-                     < started + DISK_DEADLINE_S)
-
-    disk_gbps = None
-    rebuild_p50 = None
-    rebuild_gbps = None
-    times = []
-    if disk_feasible:
-        t0 = time.perf_counter()
-        pipeline.stream_encode(base, coder, batch_size=batch)
-        cold_s = time.perf_counter() - t0
-        t = _phase("encode (disk sink, cold)", t)
-        # steady-state pass only if the link leaves room; else report the
-        # cold number (includes the file-mode kernel compile)
-        if time.perf_counter() + est_d2h_s < started + DISK_DEADLINE_S:
-            for i in range(14):
-                os.remove(base + ec.to_ext(i))
-            t0 = time.perf_counter()
-            pipeline.stream_encode(base, coder, batch_size=batch)
-            disk_gbps = vol_size / (time.perf_counter() - t0) / 1e9
-            t = _phase("encode timed (disk sink)", t)
-        else:
-            disk_gbps = vol_size / cold_s / 1e9
-        file_digest = pipeline.parity_file_digest(base)
-        if file_digest.tolist() != want_digest.tolist():
-            raise AssertionError(
-                f"parity files {file_digest} != host digest {want_digest}")
-
-        # rebuild p50 (config 3): 4 missing shards from 10 survivors;
-        # first pass also warms the reconstruction kernel
-        victims = [0, 3, 7, 12]
-        for rep in range(rebuild_reps + 1):
-            for v in victims:
-                os.remove(base + ec.to_ext(v))
-            t0 = time.perf_counter()
-            pipeline.stream_rebuild(base, coder, batch_size=batch)
-            if rep > 0:
-                times.append(time.perf_counter() - t0)
-            if time.perf_counter() - started > REBUILD_BUDGET_S:
-                break  # degraded link: stop early
-        if times:
-            rebuild_p50 = statistics.median(times)
-            shard_size = os.path.getsize(base + ec.to_ext(0))
-            rebuild_gbps = 10 * shard_size / rebuild_p50 / 1e9
-        t = _phase(f"rebuild x{len(times) + 1}", t)
-
     tile_sweep = {}
     from seaweedfs_tpu.ops import rs_pallas
     for tl in (65536, 131072, rs_pallas.DEFAULT_TILE):
@@ -394,6 +400,58 @@ def _run_configs(work, coder, vol_size, kernel_n, kernel_reps, rebuild_reps,
         fused = bench_fused(work, coder, vol_size)
         t = _phase("fused pipeline", t)
 
+    # --- optional, D2H-bound phases (disk-mode encode writes 4/14 of the
+    # volume back through the degraded link; rebuild writes 4 shards) ---
+    disk_phase_start = time.perf_counter()
+    n_batches = max(vol_size // batch, 1)
+    est_d2h_s = (n_batches * d2h_lat_s
+                 + (0.4 * vol_size / 1e9) / max(d2h_gbps, 1e-6))
+    disk_feasible = (est_d2h_s < DISK_DEADLINE_S)
+
+    disk_gbps = None
+    rebuild_p50 = None
+    rebuild_gbps = None
+    times = []
+    if disk_feasible:
+        t0 = time.perf_counter()
+        pipeline.stream_encode(base, coder, batch_size=batch)
+        cold_s = time.perf_counter() - t0
+        t = _phase("encode (disk sink, cold)", t)
+        # steady-state pass only if the link leaves room; else report the
+        # cold number (includes the file-mode kernel compile)
+        if (time.perf_counter() - disk_phase_start + est_d2h_s
+                < DISK_DEADLINE_S):
+            for i in range(14):
+                os.remove(base + ec.to_ext(i))
+            t0 = time.perf_counter()
+            pipeline.stream_encode(base, coder, batch_size=batch)
+            disk_gbps = vol_size / (time.perf_counter() - t0) / 1e9
+            t = _phase("encode timed (disk sink)", t)
+        else:
+            disk_gbps = vol_size / cold_s / 1e9
+        file_digest = pipeline.parity_file_digest(base)
+        if file_digest.tolist() != want_digest.tolist():
+            raise AssertionError(
+                f"parity files {file_digest} != host digest {want_digest}")
+
+        # rebuild p50 (config 3): 4 missing shards from 10 survivors;
+        # first pass also warms the reconstruction kernel
+        victims = [0, 3, 7, 12]
+        for rep in range(rebuild_reps + 1):
+            for v in victims:
+                os.remove(base + ec.to_ext(v))
+            t0 = time.perf_counter()
+            pipeline.stream_rebuild(base, coder, batch_size=batch)
+            if rep > 0:
+                times.append(time.perf_counter() - t0)
+            if time.perf_counter() - disk_phase_start > REBUILD_BUDGET_S:
+                break  # degraded link: stop early
+        if times:
+            rebuild_p50 = statistics.median(times)
+            shard_size = os.path.getsize(base + ec.to_ext(0))
+            rebuild_gbps = 10 * shard_size / rebuild_p50 / 1e9
+        t = _phase(f"rebuild x{len(times) + 1}", t)
+
     # arithmetic per input byte at RS(k=10,m): the bitplane matmul does
     # 2*(8m)(8k) int8 MACs per k-byte column = 128*m ops/input byte; HBM
     # sees (k+m)/k bytes per input byte (bytes in + parity out, VMEM-fused)
@@ -415,7 +473,8 @@ def _run_configs(work, coder, vol_size, kernel_n, kernel_reps, rebuild_reps,
             "disk_phase_skipped_reason": (
                 None if disk_feasible else
                 f"estimated {est_d2h_s:.0f}s of D2H on a "
-                f"{d2h_gbps:.3f} GB/s link"),
+                f"{d2h_gbps:.3f} GB/s link with {d2h_lat_s:.2f}s/op "
+                f"latency"),
             "kernel": {
                 "gbps": round(kernel_gbps, 2),
                 "vs_target": round(kernel_gbps / BASELINE_GBPS, 3),
@@ -435,8 +494,10 @@ def _run_configs(work, coder, vol_size, kernel_n, kernel_reps, rebuild_reps,
             "sweep_kernel_gbps": sweep,
             "fused_compact_gzip_rs": fused,
             "system_req_s": system,
+            "disk_needle_map": needle_map,
             "link_h2d_gbps": round(h2d_gbps, 3),
             "link_d2h_gbps": round(d2h_gbps, 3),
+            "link_d2h_latency_s": round(d2h_lat_s, 3),
             "note": ("value = device-parity-sink pipeline (disk read + H2D "
                      "+ kernel overlapped; 16B digest returns per batch, "
                      "verified against an independent host-coder digest of "

@@ -45,6 +45,13 @@ def _load_guard():
             "jwt.signing.read.expires_after_seconds", 60))
 
 
+def _load_tls():
+    """TLS config from security.toml [tls]; None when not configured."""
+    from .security.tls import load_tls_config
+    cfg = load_tls_config()
+    return cfg if cfg.enabled else None
+
+
 def cmd_master(args) -> None:
     from .server.master import run_master
     url = f"{args.ip}:{args.port}"
@@ -55,6 +62,7 @@ def cmd_master(args) -> None:
         default_replication=args.default_replication,
         pulse_seconds=args.pulse,
         guard=_load_guard(),
+        tls=_load_tls(),
         url=url,
         peers=peers or None,
         raft_state_dir=args.mdir or None,
@@ -78,7 +86,7 @@ def cmd_volume(args) -> None:
     _run_forever(run_volume_server(
         args.ip, args.port, store, args.mserver,
         data_center=args.data_center, rack=args.rack,
-        pulse_seconds=args.pulse, guard=_load_guard(),
+        pulse_seconds=args.pulse, guard=_load_guard(), tls=_load_tls(),
         use_grpc_heartbeat=args.grpc_heartbeat,
         grpc_port=(args.port + 10000 if args.grpc_port < 0
                    else args.grpc_port)))
@@ -94,24 +102,25 @@ def cmd_server(args) -> None:
 
     async def boot():
         guard = _load_guard()
+        tls = _load_tls()
         master_url = f"{args.ip}:{args.master_port}"
         await run_master(args.ip, args.master_port,
                          default_replication=args.default_replication,
-                         guard=guard, url=master_url,
+                         guard=guard, url=master_url, tls=tls,
                          grpc_port=args.master_port + 10000)
         geometry = Geometry(large_block_size=args.ec_large_block,
                             small_block_size=args.ec_small_block)
         store = Store(args.dir.split(","), coder_name=args.coder,
                       geometry=geometry)
         await run_volume_server(args.ip, args.port, store, master_url,
-                                guard=guard,
+                                guard=guard, tls=tls,
                                 grpc_port=args.port + 10000)
         if args.filer:
             from .server.filer_server import run_filer
             await run_filer(args.ip, args.filer_port, master_url,
                             store_name="sqlite",
                             store_kwargs={"path": args.filer_db},
-                            guard=guard,
+                            guard=guard, tls=tls,
                             grpc_port=args.filer_port + 10000)
         if args.s3:
             if not args.filer:
@@ -142,7 +151,7 @@ def cmd_filer(args) -> None:
         default_collection=args.collection,
         meta_log_path=args.meta_log,
         peers=[p for p in args.peers.split(",") if p],
-        notifier=notifier, guard=_load_guard(),
+        notifier=notifier, guard=_load_guard(), tls=_load_tls(),
         cipher=args.encrypt_volume_data,
         url=f"{args.ip}:{args.port}",
         grpc_port=(args.port + 10000 if args.grpc_port < 0
@@ -534,7 +543,7 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("-rack", default="")
     v.add_argument("-pulse", type=float, default=5.0)
     v.add_argument("-coder", default="auto")
-    v.add_argument("-index", default="memory", choices=["memory", "compact"],
+    v.add_argument("-index", default="memory", choices=["memory", "compact", "leveldb", "leveldbMedium", "leveldbLarge"],
                    help="needle map kind (weed volume -index)")
     v.add_argument("-minFreeSpacePercent", dest="min_free_space_percent",
                    type=float, default=1.0)

@@ -79,12 +79,16 @@ class EcVolume:
         # volume version comes from the superblock at the head of .ec00
         # (readEcVolumeVersion, ec_decoder.go:73-90); default v3 if absent
         self.version = t.CURRENT_VERSION
+        self.offset_size = t.OFFSET_SIZE
         ec00 = base + to_ext(0)
         if os.path.exists(ec00):
             with open(ec00, "rb") as f:
                 head = f.read(8)
             if len(head) == 8:
-                self.version = SuperBlock.from_bytes(head).version
+                sb = SuperBlock.from_bytes(head)
+                self.version = sb.version
+                self.offset_size = sb.offset_size
+        self._entry_size = t.needle_map_entry_size(self.offset_size)
 
     def base_file_name(self) -> str:
         prefix = f"{self.collection}_" if self.collection else ""
@@ -119,12 +123,13 @@ class EcVolume:
         tombstones (the fsck inventory for EC volumes)."""
         out = []
         with self._lock:
-            n = self.ecx_size // t.NEEDLE_MAP_ENTRY_SIZE
+            n = self.ecx_size // self._entry_size
             for i in range(n):
                 entry = os.pread(self._ecx.fileno(),
-                                 t.NEEDLE_MAP_ENTRY_SIZE,
-                                 i * t.NEEDLE_MAP_ENTRY_SIZE)
-                key, offset, size = idx_mod.unpack_entry(entry)
+                                 self._entry_size,
+                                 i * self._entry_size)
+                key, offset, size = idx_mod.unpack_entry(
+                    entry, offset_size=self.offset_size)
                 if not t.size_is_deleted(size):
                     out.append((key, size))
         return out
@@ -138,15 +143,16 @@ class EcVolume:
     def _search(self, needle_id: int,
                 on_found: Optional[Callable[[int], None]] = None
                 ) -> tuple[int, int]:
-        lo, hi = 0, self.ecx_size // t.NEEDLE_MAP_ENTRY_SIZE
+        lo, hi = 0, self.ecx_size // self._entry_size
         while lo < hi:
             mid = (lo + hi) // 2
-            entry = os.pread(self._ecx.fileno(), t.NEEDLE_MAP_ENTRY_SIZE,
-                             mid * t.NEEDLE_MAP_ENTRY_SIZE)
-            key, offset, size = idx_mod.unpack_entry(entry)
+            entry = os.pread(self._ecx.fileno(), self._entry_size,
+                             mid * self._entry_size)
+            key, offset, size = idx_mod.unpack_entry(
+                entry, offset_size=self.offset_size)
             if key == needle_id:
                 if on_found is not None:
-                    on_found(mid * t.NEEDLE_MAP_ENTRY_SIZE)
+                    on_found(mid * self._entry_size)
                 return offset, size
             if key < needle_id:
                 lo = mid + 1
@@ -254,7 +260,8 @@ class EcVolume:
             def mark(entry_offset: int) -> None:
                 os.pwrite(self._ecx.fileno(),
                           t.put_u32(t.size_to_u32(t.TOMBSTONE_FILE_SIZE)),
-                          entry_offset + t.NEEDLE_ID_SIZE + t.OFFSET_SIZE)
+                          entry_offset + t.NEEDLE_ID_SIZE
+                          + self.offset_size)
 
             try:
                 self._search(needle_id, on_found=mark)
@@ -273,12 +280,14 @@ class EcVolume:
             self._ecj.close()
 
 
-def rebuild_ecx_file(base_file_name: str) -> None:
+def rebuild_ecx_file(base_file_name: str,
+                     offset_size: int = t.OFFSET_SIZE) -> None:
     """Re-apply .ecj tombstones into .ecx after a rebuild, then drop .ecj
     (RebuildEcxFile, ec_volume_delete.go:51-97)."""
     ecj_path = base_file_name + ".ecj"
     if not os.path.exists(ecj_path):
         return
+    entry_size = t.needle_map_entry_size(offset_size)
     ecx_size = os.path.getsize(base_file_name + ".ecx")
     with open(base_file_name + ".ecx", "r+b") as ecx, \
             open(ecj_path, "rb") as ecj:
@@ -287,15 +296,15 @@ def rebuild_ecx_file(base_file_name: str) -> None:
             if len(b) != t.NEEDLE_ID_SIZE:
                 break
             needle_id = t.get_u64(b)
-            lo, hi = 0, ecx_size // t.NEEDLE_MAP_ENTRY_SIZE
+            lo, hi = 0, ecx_size // entry_size
             while lo < hi:
                 mid = (lo + hi) // 2
-                ecx.seek(mid * t.NEEDLE_MAP_ENTRY_SIZE)
+                ecx.seek(mid * entry_size)
                 key, _, _ = idx_mod.unpack_entry(
-                    ecx.read(t.NEEDLE_MAP_ENTRY_SIZE))
+                    ecx.read(entry_size), offset_size=offset_size)
                 if key == needle_id:
-                    ecx.seek(mid * t.NEEDLE_MAP_ENTRY_SIZE
-                             + t.NEEDLE_ID_SIZE + t.OFFSET_SIZE)
+                    ecx.seek(mid * entry_size
+                             + t.NEEDLE_ID_SIZE + offset_size)
                     ecx.write(t.put_u32(t.size_to_u32(t.TOMBSTONE_FILE_SIZE)))
                     break
                 if key < needle_id:

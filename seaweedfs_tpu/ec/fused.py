@@ -71,12 +71,14 @@ def fused_vacuum_gzip_encode(volume, dst_base: str, coder: ErasureCoder,
                 dat.write(bytes(pad))
                 offset += pad
             dat.write(record)
-            idx.write(idx_mod.pack_entry(nv.key, t.offset_to_stored(offset),
-                                         n.size))
+            idx.write(idx_mod.pack_entry(
+                nv.key, t.offset_to_stored(offset, volume.offset_size),
+                n.size, offset_size=volume.offset_size))
             offset += len(record)
 
     stream_encode(dst_base, coder, geometry, batch_size=batch_size)
-    striping.write_sorted_ecx_from_idx(dst_base)
+    striping.write_sorted_ecx_from_idx(
+        dst_base, offset_size=volume.offset_size)
     return {
         "live_needles": len(snapshot),
         "src_bytes": src_size,

@@ -162,7 +162,7 @@ def _encode_batches(pool: ThreadPoolExecutor, dat_fd: int, dat_size: int,
 
 
 def _run_pipeline(batches: Iterator[np.ndarray], dispatch, consume,
-                  depth: int) -> None:
+                  depth: int, start_d2h: bool = True) -> None:
     """reader thread -> main dispatch -> materializer thread."""
     read_q: queue.Queue = queue.Queue(maxsize=depth)
     mat_q: queue.Queue = queue.Queue(maxsize=depth)
@@ -206,7 +206,8 @@ def _run_pipeline(batches: Iterator[np.ndarray], dispatch, consume,
             # kick the device->host copy off immediately so it overlaps the
             # next batch's H2D + kernel instead of starting at materialize
             # time (matters most when the transfer link is the bottleneck)
-            start_async = getattr(handle, "copy_to_host_async", None)
+            start_async = (getattr(handle, "copy_to_host_async", None)
+                           if start_d2h else None)
             if start_async is not None:
                 try:
                     start_async()
@@ -281,20 +282,26 @@ def stream_encode_device_sink(base_file_name: str, coder: ErasureCoder,
     assert coder.k == g.data_shards and coder.m == g.parity_shards
     dat_size = os.path.getsize(base_file_name + ".dat")
     dat_fd = os.open(base_file_name + ".dat", os.O_RDONLY)
-    total = np.zeros(g.parity_shards, dtype=np.uint32)
+    acc = None
 
     def consume(data: np.ndarray, handle) -> None:
-        digest = np.asarray(coder.materialize(handle), dtype=np.uint32)
-        np.add(total, digest, out=total)  # uint32 wraparound combines
+        # combine ON DEVICE (uint32 + wraps on both numpy and jax): a
+        # per-batch materialize would pay the device->host round-trip
+        # latency every batch — seconds each on tunneled dev links
+        nonlocal acc
+        acc = handle if acc is None else acc + handle
 
     try:
         with ThreadPoolExecutor(max_workers=_READ_POOL_WORKERS) as pool:
             _run_pipeline(
                 _encode_batches(pool, dat_fd, dat_size, g, batch_size),
-                coder.encode_digest_async, consume, depth)
+                coder.encode_digest_async, consume, depth,
+                start_d2h=False)
     finally:
         os.close(dat_fd)
-    return total
+    if acc is None:
+        return np.zeros(g.parity_shards, dtype=np.uint32)
+    return np.asarray(coder.materialize(acc), dtype=np.uint32)
 
 
 def parity_file_digest(base_file_name: str,

@@ -33,10 +33,11 @@ from .geometry import DEFAULT, Geometry, to_ext
 DEFAULT_BUFFER_SIZE = 256 * 1024
 
 
-def write_sorted_ecx_from_idx(base_file_name: str, ext: str = ".ecx") -> None:
+def write_sorted_ecx_from_idx(base_file_name: str, ext: str = ".ecx",
+                              offset_size: int = t.OFFSET_SIZE) -> None:
     """Generate the sorted EC index from the .idx journal
     (WriteSortedFileFromIdx, ec_encoder.go:27-54)."""
-    db = SortedNeedleMap.from_idx_file(base_file_name + ".idx")
+    db = SortedNeedleMap.from_idx_file(base_file_name + ".idx", offset_size)
     db.write_sorted_index(base_file_name + ext)
 
 
@@ -140,8 +141,11 @@ def rebuild_ec_files(base_file_name: str, coder: ErasureCoder,
     return missing
 
 
-def iterate_ecx_file(base_file_name: str) -> Iterator[tuple[int, int, int]]:
-    yield from idx_mod.iter_index_file(base_file_name + ".ecx")
+def iterate_ecx_file(base_file_name: str,
+                     offset_size: int = t.OFFSET_SIZE
+                     ) -> Iterator[tuple[int, int, int]]:
+    yield from idx_mod.iter_index_file(base_file_name + ".ecx",
+                                       offset_size=offset_size)
 
 
 def iterate_ecj_file(base_file_name: str) -> Iterator[int]:
@@ -156,11 +160,13 @@ def iterate_ecj_file(base_file_name: str) -> Iterator[int]:
             yield t.get_u64(b)
 
 
-def find_dat_file_size(base_file_name: str, version: int) -> int:
+def find_dat_file_size(base_file_name: str, version: int,
+                       offset_size: int = t.OFFSET_SIZE) -> int:
     """Infer the original .dat size from the furthest live .ecx entry
     (FindDatFileSize, ec_decoder.go:48-71)."""
     dat_size = 0
-    for key, stored_offset, size in iterate_ecx_file(base_file_name):
+    for key, stored_offset, size in iterate_ecx_file(base_file_name,
+                                                     offset_size):
         if t.size_is_deleted(size):
             continue
         stop = (t.stored_to_offset(stored_offset)
@@ -209,9 +215,12 @@ def _copy_n(src, dst, n: int) -> None:
         n -= len(chunk)
 
 
-def write_idx_file_from_ec_index(base_file_name: str) -> None:
+def write_idx_file_from_ec_index(base_file_name: str,
+                                 offset_size: int = t.OFFSET_SIZE) -> None:
     """.idx = .ecx copied verbatim + tombstones for every .ecj entry
     (WriteIdxFileFromEcIndex, ec_decoder.go:18-44)."""
+    from ..storage.needle_map import remove_sidecars
+    remove_sidecars(base_file_name + ".idx")
     with open(base_file_name + ".ecx", "rb") as ecx, \
             open(base_file_name + ".idx", "wb") as out:
         while True:
@@ -220,4 +229,5 @@ def write_idx_file_from_ec_index(base_file_name: str) -> None:
                 break
             out.write(chunk)
         for key in iterate_ecj_file(base_file_name):
-            out.write(idx_mod.pack_entry(key, 0, t.TOMBSTONE_FILE_SIZE))
+            out.write(idx_mod.pack_entry(key, 0, t.TOMBSTONE_FILE_SIZE,
+                                         offset_size=offset_size))

@@ -39,6 +39,48 @@ def grpc_address(http_url: str) -> str:
     return f"{host}:{int(port) + GRPC_PORT_OFFSET}"
 
 
+_tls_config = None
+_tls_loaded = False
+
+
+def set_tls_config(cfg) -> None:
+    """Override the cluster TLS config (tests / embedded use)."""
+    global _tls_config, _tls_loaded
+    _tls_config = cfg
+    _tls_loaded = True
+
+
+def _tls():
+    global _tls_config, _tls_loaded
+    if not _tls_loaded:
+        from ..security.tls import load_tls_config
+        cfg = load_tls_config()
+        _tls_config = cfg if cfg.enabled else None
+        _tls_loaded = True
+    return _tls_config
+
+
+def dial(target: str):
+    """Open a sync channel to a cluster gRPC endpoint, secured with the
+    [tls] certs from security.toml when configured (the reference wraps
+    every internal grpc link the same way, weed/security/tls.go)."""
+    import grpc
+    cfg = _tls()
+    if cfg is not None:
+        return grpc.secure_channel(target, cfg.grpc_channel_credentials())
+    return grpc.insecure_channel(target)
+
+
+def aio_dial(target: str):
+    """grpc.aio variant of dial()."""
+    import grpc
+    cfg = _tls()
+    if cfg is not None:
+        return grpc.aio.secure_channel(target,
+                                       cfg.grpc_channel_credentials())
+    return grpc.aio.insecure_channel(target)
+
+
 # --- service specs: name -> (kind, request type, response type) ---
 # kind: uu unary-unary, us unary-stream, ss stream-stream
 

@@ -1,0 +1,115 @@
+"""TLS/mTLS for the HTTP servers and the gRPC plane.
+
+Role of the reference's security layer (weed/security/tls.go:15-70): when
+security.toml carries a [tls] section, every server can terminate TLS and
+— with verify_client — demand a client certificate signed by the
+configured CA (mutual TLS). The JWT/whitelist guard plus TLS together form
+the reference's full security envelope.
+
+security.toml keys (scaffold `security` template):
+
+    [tls]
+    ca_file = "/etc/seaweedfs/ca.crt"
+    cert_file = "/etc/seaweedfs/server.crt"
+    key_file = "/etc/seaweedfs/server.key"
+    verify_client = true     # mTLS: reject clients without a CA-signed cert
+    https = false            # additionally terminate TLS on the HTTP ports
+
+With certs configured, the gRPC plane (all intra-cluster RPC) is always
+secured — every internal dial goes through pb.rpc.dial/aio_dial which
+pick up these certs. `https` additionally wraps the HTTP listeners; the
+HTTP data path between cluster nodes stays plaintext unless it is on
+(matching the reference, whose TLS layer covers gRPC only).
+"""
+
+from __future__ import annotations
+
+import ssl
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class TlsConfig:
+    ca_file: str = ""
+    cert_file: str = ""
+    key_file: str = ""
+    verify_client: bool = False
+    https: bool = False
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.cert_file and self.key_file)
+
+    @classmethod
+    def from_config(cls, cfg) -> "TlsConfig":
+        """cfg: utils.config Configuration (security.toml)."""
+        if cfg is None:
+            return cls()
+        return cls(
+            ca_file=cfg.get_string("tls.ca_file", ""),
+            cert_file=cfg.get_string("tls.cert_file", ""),
+            key_file=cfg.get_string("tls.key_file", ""),
+            verify_client=cfg.get_bool("tls.verify_client", False),
+            https=cfg.get_bool("tls.https", False),
+        )
+
+    # --- HTTP (aiohttp TCPSite ssl_context) ---
+    def server_ssl_context(self) -> Optional[ssl.SSLContext]:
+        if not self.enabled or not self.https:
+            return None
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.cert_file, self.key_file)
+        if self.verify_client:
+            ctx.verify_mode = ssl.CERT_REQUIRED
+            ctx.load_verify_locations(self.ca_file)
+        return ctx
+
+    def client_ssl_context(self) -> Optional[ssl.SSLContext]:
+        """For intra-cluster clients (peers): trusts the cluster CA and
+        presents this node's own certificate when mTLS is on."""
+        if not self.enabled:
+            return None
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        if self.ca_file:
+            ctx.load_verify_locations(self.ca_file)
+            ctx.check_hostname = False  # cluster nodes dial by ip:port
+        ctx.load_cert_chain(self.cert_file, self.key_file)
+        return ctx
+
+    # --- gRPC (secure port / channel credentials) ---
+    def grpc_server_credentials(self):
+        if not self.enabled:
+            return None
+        import grpc
+        with open(self.key_file, "rb") as f:
+            key = f.read()
+        with open(self.cert_file, "rb") as f:
+            cert = f.read()
+        root = None
+        if self.ca_file:
+            with open(self.ca_file, "rb") as f:
+                root = f.read()
+        return grpc.ssl_server_credentials(
+            [(key, cert)], root_certificates=root,
+            require_client_auth=self.verify_client)
+
+    def grpc_channel_credentials(self):
+        if not self.enabled:
+            return None
+        import grpc
+        root = None
+        if self.ca_file:
+            with open(self.ca_file, "rb") as f:
+                root = f.read()
+        with open(self.key_file, "rb") as f:
+            key = f.read()
+        with open(self.cert_file, "rb") as f:
+            cert = f.read()
+        return grpc.ssl_channel_credentials(
+            root_certificates=root, private_key=key, certificate_chain=cert)
+
+
+def load_tls_config() -> TlsConfig:
+    from ..utils.config import load_configuration
+    return TlsConfig.from_config(load_configuration("security"))

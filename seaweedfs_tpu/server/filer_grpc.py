@@ -357,13 +357,18 @@ class FilerGrpcServicer:
         return _ok()
 
 
-async def serve_filer_grpc(fs, host: str, port: int):
+async def serve_filer_grpc(fs, host: str, port: int, tls=None):
     """Start the grpc.aio server for a FilerServer; returns it."""
     server = grpc.aio.server()
     server.add_generic_rpc_handlers(
         (filer_service_handler(FilerGrpcServicer(fs),
                                guard=lambda: fs.guard),))
-    server.add_insecure_port(f"{host}:{port}")
+    creds = tls.grpc_server_credentials() if tls is not None else None
+    if creds is not None:
+        server.add_secure_port(f"{host}:{port}", creds)
+    else:
+        server.add_insecure_port(f"{host}:{port}")
     await server.start()
-    log.info("filer gRPC on %s:%d", host, port)
+    log.info("filer gRPC on %s:%d%s", host, port,
+             " (mtls)" if creds else "")
     return server

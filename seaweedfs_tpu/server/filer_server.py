@@ -69,6 +69,7 @@ class FilerServer:
                  guard=None,
                  cipher: bool = False,
                  grpc_port: int = 0,
+                 tls=None,
                  url: str = ""):
         # comma-separated HA master list; rotates on failure like the
         # Client/VolumeServer (wdclient/masterclient.go)
@@ -87,6 +88,7 @@ class FilerServer:
         # (filer_server_handlers_write_cipher.go:17, util/cipher.go)
         self.cipher = cipher
         self.grpc_port = grpc_port
+        self.tls = tls
         self.url = url
         self._grpc_server = None
         # KeepConnected-announced clients (mounts, brokers): name -> resources
@@ -362,7 +364,7 @@ class FilerServer:
             from .filer_grpc import serve_filer_grpc
             host = (self.url.rsplit(":", 1)[0] if self.url else "127.0.0.1")
             self._grpc_server = await serve_filer_grpc(
-                self, host, self.grpc_port)
+                self, host, self.grpc_port, tls=self.tls)
         self._delete_task = asyncio.create_task(self._deletion_worker())
         self._watch_task = asyncio.create_task(self._watch_master())
         for peer in self.peers:
@@ -904,7 +906,10 @@ async def run_filer(host: str, port: int, master_url: str,
     server = FilerServer(master_url, **kwargs)
     runner = web.AppRunner(server.app, access_log=None)
     await runner.setup()
-    site = web.TCPSite(runner, host, port)
+    tls = kwargs.get("tls")
+    site = web.TCPSite(runner, host, port,
+                       ssl_context=(tls.server_ssl_context()
+                                    if tls is not None else None))
     await site.start()
     log.info("filer on %s:%d -> master %s", host, port, master_url)
     return runner

@@ -56,7 +56,8 @@ class MasterServer:
                  raft_state_dir: Optional[str] = None,
                  election_timeout: tuple[float, float] = (0.3, 0.6),
                  raft_heartbeat: float = 0.1,
-                 grpc_port: int = 0):
+                 grpc_port: int = 0,
+                 tls=None):
         self.topology = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds)
@@ -91,6 +92,7 @@ class MasterServer:
         self._peer_resolve_ts = 0.0
         self._proxy_session = None
         self.grpc_port = grpc_port
+        self.tls = tls
         self._grpc_server = None
         self.metrics = metrics_mod.Registry("master")
         self.app = self._build_app()
@@ -191,7 +193,7 @@ class MasterServer:
             host = (self.url.rsplit(":", 1)[0] if ":" in self.url
                     else "0.0.0.0")
             self._grpc_server = await serve_master_grpc(
-                self, host or "0.0.0.0", self.grpc_port)
+                self, host or "0.0.0.0", self.grpc_port, tls=self.tls)
 
     async def _on_cleanup(self, app) -> None:
         if self._vacuum_task:
@@ -830,11 +832,14 @@ class MasterServer:
             }), content_type="text/html")
 
 
-async def run_master(host: str, port: int, **kwargs) -> web.AppRunner:
-    server = MasterServer(**kwargs)
+async def run_master(host: str, port: int, tls=None,
+                     **kwargs) -> web.AppRunner:
+    server = MasterServer(tls=tls, **kwargs)
     runner = web.AppRunner(server.app, access_log=None)
     await runner.setup()
-    site = web.TCPSite(runner, host, port)
+    ssl_ctx = tls.server_ssl_context() if tls is not None else None
+    site = web.TCPSite(runner, host, port, ssl_context=ssl_ctx)
     await site.start()
-    log.info("master listening on %s:%d", host, port)
+    log.info("master listening on %s:%d%s", host, port,
+             " (tls)" if ssl_ctx else "")
     return runner

@@ -269,14 +269,19 @@ class MasterGrpcServicer:
             ok=self.master.release_admin_token(request.name, request.token))
 
 
-async def serve_master_grpc(master, host: str, port: int):
+async def serve_master_grpc(master, host: str, port: int, tls=None):
     """Start the grpc.aio server; returns it (caller stops with
     .stop())."""
     server = grpc.aio.server()
     server.add_generic_rpc_handlers(
         (master_service_handler(MasterGrpcServicer(master),
                                 guard=lambda: master.guard),))
-    server.add_insecure_port(f"{host}:{port}")
+    creds = tls.grpc_server_credentials() if tls is not None else None
+    if creds is not None:
+        server.add_secure_port(f"{host}:{port}", creds)
+    else:
+        server.add_insecure_port(f"{host}:{port}")
     await server.start()
-    log.info("master gRPC on %s:%d", host, port)
+    log.info("master gRPC on %s:%d%s", host, port,
+             " (mtls)" if creds else "")
     return server

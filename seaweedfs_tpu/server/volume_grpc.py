@@ -196,6 +196,8 @@ class VolumeGrpcServicer:
             for ext in (".dat", ".idx"):
                 await pull_file_grpc(request.source_data_node, vid,
                                      collection, ext, base + ext)
+            from ..storage.needle_map import remove_sidecars
+            remove_sidecars(base + ".idx")  # never trust a leftover .sdx
             from ..storage.volume import Volume
             v = await _run(lambda: Volume(
                 loc.directory, collection, vid,
@@ -293,8 +295,8 @@ class VolumeGrpcServicer:
             return _err("volume not found")
         target = grpc_target(request.source_volume_server)
         n_applied = 0
-        async with grpc.aio.insecure_channel(target) as channel:
-            from ..pb.rpc import VolumeServerStub
+        from ..pb.rpc import VolumeServerStub, aio_dial
+        async with aio_dial(target) as channel:
             stub = VolumeServerStub(channel)
             async for chunk in stub.VolumeTail(pb.TailRequest(
                     volume_id=request.volume_id,
@@ -525,9 +527,8 @@ async def pull_file_grpc(source_http_url: str, vid: int, collection: str,
                          ext: str, dest_path: str) -> None:
     """Fetch one volume/shard file from a peer's CopyFile stream into
     dest_path. Raises FileNotFoundError when the peer lacks the file."""
-    from ..pb.rpc import VolumeServerStub
-    async with grpc.aio.insecure_channel(
-            grpc_target(source_http_url)) as channel:
+    from ..pb.rpc import VolumeServerStub, aio_dial
+    async with aio_dial(grpc_target(source_http_url)) as channel:
         stub = VolumeServerStub(channel)
         tmp = dest_path + ".tmp"
         try:
@@ -550,13 +551,18 @@ async def pull_file_grpc(source_http_url: str, vid: int, collection: str,
                 os.remove(tmp)
 
 
-async def serve_volume_grpc(vs, host: str, port: int):
+async def serve_volume_grpc(vs, host: str, port: int, tls=None):
     """Start the grpc.aio server for a VolumeServer; returns it."""
     server = grpc.aio.server()
     server.add_generic_rpc_handlers(
         (volume_service_handler(VolumeGrpcServicer(vs),
                                 guard=lambda: vs.guard),))
-    server.add_insecure_port(f"{host}:{port}")
+    creds = tls.grpc_server_credentials() if tls is not None else None
+    if creds is not None:
+        server.add_secure_port(f"{host}:{port}", creds)
+    else:
+        server.add_insecure_port(f"{host}:{port}")
     await server.start()
-    log.info("volume gRPC on %s:%d", host, port)
+    log.info("volume gRPC on %s:%d%s", host, port,
+             " (mtls)" if creds else "")
     return server

@@ -175,7 +175,8 @@ class VolumeServer:
                  guard: Optional[Guard] = None,
                  use_grpc_heartbeat: bool = False,
                  master_grpc_target: str = "",
-                 grpc_port: int = 0):
+                 grpc_port: int = 0,
+                 tls=None):
         self.use_grpc_heartbeat = use_grpc_heartbeat
         # explicit gRPC endpoint override; default follows the
         # HTTP-port+10000 convention (grpc_client_server.go)
@@ -199,6 +200,7 @@ class VolumeServer:
         self._session: Optional[aiohttp.ClientSession] = None
         self._batcher: Optional[WriteBatcher] = None
         self.grpc_port = grpc_port
+        self.tls = tls
         self._grpc_server = None
         self._replica_cache: dict[int, tuple[list[str], float]] = {}
         self._shard_loc_cache: dict[int, tuple[dict, float]] = {}
@@ -277,7 +279,7 @@ class VolumeServer:
             from .volume_grpc import serve_volume_grpc
             host = self.url.rsplit(":", 1)[0]
             self._grpc_server = await serve_volume_grpc(
-                self, host, self.grpc_port)
+                self, host, self.grpc_port, tls=self.tls)
 
     async def _on_cleanup(self, app) -> None:
         for ch in self._peer_grpc_channels.values():
@@ -357,7 +359,8 @@ class VolumeServer:
                 except asyncio.TimeoutError:
                     pass
 
-        async with grpc.aio.insecure_channel(target) as channel:
+        from ..pb.rpc import aio_dial
+        async with aio_dial(target) as channel:
             call = MasterStub(channel).Heartbeat(beats())
             try:
                 async for resp in call:
@@ -1242,7 +1245,8 @@ class VolumeServer:
                 # executor threads don't leak a loser channel)
                 ch = self._peer_grpc_channels.get(url)
                 if ch is None:
-                    new_ch = grpc_mod.insecure_channel(grpc_address(url))
+                    from ..pb.rpc import dial
+                    new_ch = dial(grpc_address(url))
                     ch = self._peer_grpc_channels.setdefault(url, new_ch)
                     if ch is not new_ch:
                         new_ch.close()
@@ -1469,7 +1473,10 @@ async def run_volume_server(host: str, port: int, store: Store,
     server = VolumeServer(store, master_url, url=f"{host}:{port}", **kwargs)
     runner = web.AppRunner(server.app, access_log=None)
     await runner.setup()
-    site = web.TCPSite(runner, host, port)
+    tls = kwargs.get("tls")
+    site = web.TCPSite(runner, host, port,
+                       ssl_context=(tls.server_ssl_context()
+                                    if tls is not None else None))
     await site.start()
     log.info("volume server on %s:%d -> master %s", host, port, master_url)
     return runner

@@ -1,53 +1,70 @@
-""".idx journal format: fixed 16-byte entries (key u64, offset u32, size u32).
+""".idx journal format: fixed entries (key u64, offset u32|u40, size u32).
 
 Matches the reference index file layout (weed/storage/idx/walk.go:45-50,
 weed/storage/needle_map/needle_value.go:25-31). The journal is append-only;
 a delete is an entry with size == TOMBSTONE (0xFFFFFFFF as stored) and the
 offset of the tombstone needle that recorded the delete in the .dat file.
+
+Default entries are 16 bytes (4-byte offsets, 32GB volumes). Large volumes
+(superblock offset_size == 5, reference offset_5bytes.go) use 17-byte
+entries with a 40-bit big-endian offset; every function takes the width.
 """
 
 from __future__ import annotations
 
-import io
 import os
 from typing import Callable, Iterator
 
 from . import types as t
 
 
-def pack_entry(key: int, stored_offset: int, size: int) -> bytes:
-    return t.put_u64(key) + t.put_u32(stored_offset) + t.put_u32(t.size_to_u32(size))
+def pack_entry(key: int, stored_offset: int, size: int,
+               offset_size: int = t.OFFSET_SIZE) -> bytes:
+    return (t.put_u64(key) + t.put_offset(stored_offset, offset_size)
+            + t.put_u32(t.size_to_u32(size)))
 
 
-def unpack_entry(b: bytes, off: int = 0) -> tuple[int, int, int]:
+def unpack_entry(b: bytes, off: int = 0,
+                 offset_size: int = t.OFFSET_SIZE) -> tuple[int, int, int]:
     key = t.get_u64(b, off)
-    stored_offset = t.get_u32(b, off + 8)
-    size = t.u32_to_size(t.get_u32(b, off + 12))
+    stored_offset = t.get_offset(b, off + 8, offset_size)
+    size = t.u32_to_size(t.get_u32(b, off + 8 + offset_size))
     return key, stored_offset, size
 
 
-def iter_index_bytes(data: bytes) -> Iterator[tuple[int, int, int]]:
-    n = len(data) - len(data) % t.NEEDLE_MAP_ENTRY_SIZE
-    for off in range(0, n, t.NEEDLE_MAP_ENTRY_SIZE):
-        yield unpack_entry(data, off)
+def iter_index_bytes(data: bytes, offset_size: int = t.OFFSET_SIZE
+                     ) -> Iterator[tuple[int, int, int]]:
+    entry = t.needle_map_entry_size(offset_size)
+    n = len(data) - len(data) % entry
+    for off in range(0, n, entry):
+        yield unpack_entry(data, off, offset_size)
 
 
 def walk_index_file(path: str | os.PathLike,
-                    fn: Callable[[int, int, int], None]) -> None:
+                    fn: Callable[[int, int, int], None],
+                    offset_size: int = t.OFFSET_SIZE) -> None:
     """Stream (key, stored_offset, size) tuples from an .idx file."""
+    entry = t.needle_map_entry_size(offset_size)
     with open(path, "rb") as f:
         while True:
-            chunk = f.read(t.NEEDLE_MAP_ENTRY_SIZE * 1024)
+            chunk = f.read(entry * 1024)
             if not chunk:
                 return
-            for entry in iter_index_bytes(chunk):
-                fn(*entry)
+            for e in iter_index_bytes(chunk, offset_size):
+                fn(*e)
 
 
-def iter_index_file(path: str | os.PathLike) -> Iterator[tuple[int, int, int]]:
+def iter_index_file(path: str | os.PathLike, start: int = 0,
+                    offset_size: int = t.OFFSET_SIZE
+                    ) -> Iterator[tuple[int, int, int]]:
+    """start: byte offset to resume from (must be entry-aligned; a
+    disk-backed map replays only the journal tail after its last flush)."""
+    entry = t.needle_map_entry_size(offset_size)
     with open(path, "rb") as f:
+        if start:
+            f.seek(start - start % entry)
         while True:
-            chunk = f.read(t.NEEDLE_MAP_ENTRY_SIZE * 1024)
+            chunk = f.read(entry * 1024)
             if not chunk:
                 return
-            yield from iter_index_bytes(chunk)
+            yield from iter_index_bytes(chunk, offset_size)

@@ -419,7 +419,7 @@ class Store:
         # write-back (ec/pipeline.py) — byte-identical to the synchronous
         # write_ec_files layout
         ec_pipeline.stream_encode(base, self.coder(), self.geometry)
-        ec_mod.write_sorted_ecx_from_idx(base)
+        ec_mod.write_sorted_ecx_from_idx(base, offset_size=v.offset_size)
         return list(range(self.geometry.total_shards))
 
     def ec_mount(self, vid: int, collection: str,
@@ -470,7 +470,10 @@ class Store:
         base = os.path.join(loc.directory, f"{prefix}{vid}")
         rebuilt = ec_pipeline.stream_rebuild(base, self.coder(),
                                              self.geometry)
-        ec_mod.rebuild_ecx_file(base)
+        ev = self.find_ec_volume(vid)
+        ec_mod.rebuild_ecx_file(
+            base, offset_size=(ev.offset_size if ev is not None
+                               else t.OFFSET_SIZE))
         return rebuilt
 
     def ec_blob_delete(self, vid: int, needle_id: int) -> None:
@@ -497,9 +500,12 @@ class Store:
             loc = self._location_with_ec_files(vid, collection)
             prefix = f"{collection}_" if collection else ""
             base = os.path.join(loc.directory, f"{prefix}{vid}")
-            dat_size = ec_mod.find_dat_file_size(base, t.CURRENT_VERSION)
+            ev0 = loc.ec_volumes.get(vid)
+            w = ev0.offset_size if ev0 is not None else t.OFFSET_SIZE
+            dat_size = ec_mod.find_dat_file_size(base, t.CURRENT_VERSION,
+                                                 offset_size=w)
             ec_mod.write_dat_file(base, dat_size, self.geometry)
-            ec_mod.write_idx_file_from_ec_index(base)
+            ec_mod.write_idx_file_from_ec_index(base, offset_size=w)
             ev = loc.ec_volumes.pop(vid, None)
             if ev is not None:
                 ev.close()

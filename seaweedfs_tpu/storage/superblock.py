@@ -50,6 +50,12 @@ class ReplicaPlacement:
                 f"{self.diff_rack_count}{self.same_rack_count}")
 
 
+# version-byte high bit marks a large-volume (5-byte-offset) .dat; the
+# reference decides offset width with a build tag (offset_5bytes.go), so
+# its version byte is always < 0x80 and the flag reads back as 4-byte
+_LARGE_VOLUME_FLAG = 0x80
+
+
 @dataclass
 class SuperBlock:
     version: int = t.CURRENT_VERSION
@@ -57,6 +63,7 @@ class SuperBlock:
     ttl: t.TTL = field(default_factory=lambda: t.EMPTY_TTL)
     compaction_revision: int = 0
     extra: bytes = b""
+    offset_size: int = t.OFFSET_SIZE  # 4, or 5 for 8TB volumes
 
     def block_size(self) -> int:
         if self.version in (t.VERSION2, t.VERSION3):
@@ -65,7 +72,9 @@ class SuperBlock:
 
     def to_bytes(self) -> bytes:
         header = bytearray(SUPER_BLOCK_SIZE)
-        header[0] = self.version
+        header[0] = self.version | (
+            _LARGE_VOLUME_FLAG
+            if self.offset_size == t.OFFSET_SIZE_LARGE else 0)
         header[1] = self.replica_placement.to_byte()
         header[2:4] = self.ttl.to_bytes()
         header[4:6] = t.put_u16(self.compaction_revision)
@@ -81,10 +90,12 @@ class SuperBlock:
         if len(b) < SUPER_BLOCK_SIZE:
             raise ValueError("superblock truncated")
         sb = cls(
-            version=b[0],
+            version=b[0] & ~_LARGE_VOLUME_FLAG,
             replica_placement=ReplicaPlacement.from_byte(b[1]),
             ttl=t.TTL.from_bytes(bytes(b[2:4])),
             compaction_revision=t.get_u16(b, 4),
+            offset_size=(t.OFFSET_SIZE_LARGE if b[0] & _LARGE_VOLUME_FLAG
+                         else t.OFFSET_SIZE),
         )
         extra_size = t.get_u16(b, 6)
         if extra_size:

@@ -12,7 +12,10 @@ import struct
 from dataclasses import dataclass
 
 NEEDLE_ID_SIZE = 8
-OFFSET_SIZE = 4
+OFFSET_SIZE = 4        # default build: u32 offsets, 32GB volumes
+OFFSET_SIZE_LARGE = 5  # large-volume build: 40-bit offsets, 8TB volumes
+                       # (reference offset_5bytes.go:13-16 — there a global
+                       # build tag; here a per-volume superblock property)
 SIZE_SIZE = 4
 COOKIE_SIZE = 4
 NEEDLE_HEADER_SIZE = COOKIE_SIZE + NEEDLE_ID_SIZE + SIZE_SIZE  # 16
@@ -22,6 +25,24 @@ TIMESTAMP_SIZE = 8
 NEEDLE_PADDING_SIZE = 8
 TOMBSTONE_FILE_SIZE = -1  # Size(-1) marks a deleted needle in the index
 MAX_POSSIBLE_VOLUME_SIZE = 4 * 1024 * 1024 * 1024 * 8  # 32GB
+
+
+def needle_map_entry_size(offset_size: int = OFFSET_SIZE) -> int:
+    """.idx/.ecx entry width: key u64 + offset + size u32 (16B or 17B)."""
+    return NEEDLE_ID_SIZE + offset_size + SIZE_SIZE
+
+
+def max_volume_size(offset_size: int = OFFSET_SIZE) -> int:
+    return NEEDLE_PADDING_SIZE * (1 << (8 * offset_size))
+
+
+def put_offset(stored: int, offset_size: int = OFFSET_SIZE) -> bytes:
+    return stored.to_bytes(offset_size, "big")
+
+
+def get_offset(b: bytes, off: int = 0,
+               offset_size: int = OFFSET_SIZE) -> int:
+    return int.from_bytes(b[off:off + offset_size], "big")
 
 VERSION1 = 1
 VERSION2 = 2
@@ -74,11 +95,13 @@ def u32_to_size(v: int) -> int:
     return v - (1 << 32) if v & 0x80000000 else v
 
 
-def offset_to_stored(actual_offset: int) -> int:
-    """Byte offset -> stored uint32 (units of NEEDLE_PADDING_SIZE)."""
+def offset_to_stored(actual_offset: int,
+                     offset_size: int = OFFSET_SIZE) -> int:
+    """Byte offset -> stored uint (units of NEEDLE_PADDING_SIZE)."""
     assert actual_offset % NEEDLE_PADDING_SIZE == 0, actual_offset
     stored = actual_offset // NEEDLE_PADDING_SIZE
-    assert stored < (1 << 32), "volume exceeds 32GB addressing"
+    assert stored < (1 << (8 * offset_size)), \
+        f"volume exceeds {max_volume_size(offset_size)}-byte addressing"
     return stored
 
 

@@ -21,7 +21,8 @@ from . import idx as idx_mod
 from . import types as t
 from .backend import DiskFile
 from .needle import (FLAG_HAS_LAST_MODIFIED, FLAG_HAS_TTL, Needle)
-from .needle_map import NeedleValue, create_needle_map
+from .needle_map import (NeedleValue, create_needle_map,
+                         remove_sidecars)
 from .superblock import SUPER_BLOCK_SIZE, SuperBlock
 
 
@@ -75,7 +76,9 @@ class Volume:
             # fresh .dat invalidates any stale journal from a prior volume
             if os.path.exists(base + ".idx"):
                 os.remove(base + ".idx")
-            self.nm = create_needle_map(self.needle_map_kind, base + ".idx")
+            remove_sidecars(base + ".idx")
+            self.nm = create_needle_map(self.needle_map_kind, base + ".idx",
+                                        offset_size=self.offset_size)
         elif not has_local:
             # tiered volume: the .dat lives in an object store, the .idx
             # stays local (volume_tier.go:15-50); reads proxy to the remote
@@ -83,17 +86,25 @@ class Volume:
             self._dat = backend_mod.open_remote_dat(base)
             self.read_only = True
             self.super_block = self._read_superblock()
-            self.nm = create_needle_map(self.needle_map_kind, base + ".idx")
+            self.nm = create_needle_map(self.needle_map_kind, base + ".idx",
+                                        offset_size=self.offset_size)
         else:
             self._dat = DiskFile(dat_path)
             self.super_block = self._read_superblock()
-            self.nm = create_needle_map(self.needle_map_kind, base + ".idx")
+            self.nm = create_needle_map(self.needle_map_kind, base + ".idx",
+                                        offset_size=self.offset_size)
             # conservative freshness floor for TTL expiry across restarts:
             # the .dat mtime bounds the last write even when the index tail
             # is a tombstone and carries no usable timestamp
             self.last_modified_ts = int(os.path.getmtime(dat_path))
             self.check_integrity()
         self._append_offset = self._dat.size()
+
+    @property
+    def offset_size(self) -> int:
+        """Stored-offset width (4 or 5 bytes) — a superblock property here,
+        a build flag in the reference (offset_5bytes.go)."""
+        return self.super_block.offset_size
 
     def _read_superblock(self) -> SuperBlock:
         head = self._dat.read_at(SUPER_BLOCK_SIZE, 0)
@@ -152,7 +163,8 @@ class Volume:
             offset = self._append(n)
             self.last_append_at_ns = n.append_at_ns
             if nv is None or t.stored_to_offset(nv.offset) < offset:
-                self.nm.put(n.id, t.offset_to_stored(offset), n.size)
+                self.nm.put(n.id, t.offset_to_stored(offset,
+                                                 self.offset_size), n.size)
             if n.last_modified > self.last_modified_ts:
                 self.last_modified_ts = n.last_modified
             return offset, n.size, False
@@ -177,7 +189,8 @@ class Volume:
                                  else time.time_ns())
             offset = self._append(tomb)
             self.last_append_at_ns = tomb.append_at_ns
-            self.nm.delete(n.id, t.offset_to_stored(offset))
+            self.nm.delete(n.id, t.offset_to_stored(offset,
+                                                    self.offset_size))
             return freed
 
     def _append(self, n: Needle) -> int:
@@ -219,6 +232,11 @@ class Volume:
         if not self._lock.acquire(blocking=False):
             return None
         try:
+            if self.nm.flush_imminent(len(needles)):
+                # disk-backed maps merge their delta into the segment at
+                # the threshold — an O(n) sort + rewrite that must not run
+                # on the event loop
+                return None
             for n in needles:
                 nv = self.nm.get(n.id)
                 if (nv is not None and t.size_is_valid(nv.size)
@@ -333,11 +351,13 @@ class Volume:
         idx_size = os.path.getsize(idx_path)
         if idx_size == 0:
             return
-        if idx_size % t.NEEDLE_MAP_ENTRY_SIZE != 0:
+        entry = t.needle_map_entry_size(self.offset_size)
+        if idx_size % entry != 0:
             raise IOError(f"index {idx_path} size {idx_size} not aligned")
         with open(idx_path, "rb") as f:
-            f.seek(idx_size - t.NEEDLE_MAP_ENTRY_SIZE)
-            key, stored_offset, size = idx_mod.unpack_entry(f.read(16))
+            f.seek(idx_size - entry)
+            key, stored_offset, size = idx_mod.unpack_entry(
+                f.read(entry), offset_size=self.offset_size)
         if stored_offset == 0 or size == t.TOMBSTONE_FILE_SIZE:
             return
         n = self.read_needle_at(t.stored_to_offset(stored_offset),
@@ -393,7 +413,8 @@ class Volume:
             # journal high-water mark: entries after this index were written
             # during compaction and must be replayed at commit
             self._compact_idx_entries = (
-                os.path.getsize(base + ".idx") // t.NEEDLE_MAP_ENTRY_SIZE)
+                os.path.getsize(base + ".idx")
+                // t.needle_map_entry_size(self.offset_size))
             snapshot = [nv for nv in self.nm.values()
                         if t.size_is_valid(nv.size)]
             new_sb = SuperBlock(
@@ -402,6 +423,7 @@ class Volume:
                 ttl=self.super_block.ttl,
                 compaction_revision=self.super_block.compaction_revision + 1,
                 extra=self.super_block.extra,
+                offset_size=self.super_block.offset_size,
             )
         snapshot.sort(key=lambda nv: nv.offset)
         throttle_t0 = time.monotonic()
@@ -417,7 +439,8 @@ class Volume:
                     record = n.to_bytes(self.version)
                     cpd.write(record)
                     cpx.write(idx_mod.pack_entry(
-                        nv.key, t.offset_to_stored(offset), nv.size))
+                        nv.key, t.offset_to_stored(offset, self.offset_size),
+                        nv.size, offset_size=self.offset_size))
                     offset += len(record)
                     copied += len(record)
                     if compaction_bytes_per_second > 0:
@@ -444,7 +467,8 @@ class Volume:
             new_sb = self._compact_sb
             # makeupDiff: writes/deletes that landed during phase 1
             idx_size = os.path.getsize(base + ".idx")
-            start = self._compact_idx_entries * t.NEEDLE_MAP_ENTRY_SIZE
+            start = (self._compact_idx_entries
+                     * t.needle_map_entry_size(self.offset_size))
             with open(base + ".cpd", "r+b") as cpd, \
                     open(base + ".cpx", "ab") as cpx:
                 cpd.seek(0, os.SEEK_END)
@@ -454,7 +478,8 @@ class Volume:
                         f.seek(start)
                         delta = f.read(idx_size - start)
                     for key, stored_offset, size in \
-                            idx_mod.iter_index_bytes(delta):
+                            idx_mod.iter_index_bytes(
+                                delta, offset_size=self.offset_size):
                         if stored_offset > 0 and \
                                 size != t.TOMBSTONE_FILE_SIZE:
                             n = self.read_needle_at(
@@ -463,19 +488,24 @@ class Volume:
                             record = n.to_bytes(self.version)
                             cpd.write(record)
                             cpx.write(idx_mod.pack_entry(
-                                key, t.offset_to_stored(offset), size))
+                                key,
+                                t.offset_to_stored(offset, self.offset_size),
+                                size, offset_size=self.offset_size))
                             offset += len(record)
                         else:
                             # the .cpx journal folds tombstones on load
                             cpx.write(idx_mod.pack_entry(
-                                key, 0, t.TOMBSTONE_FILE_SIZE))
+                                key, 0, t.TOMBSTONE_FILE_SIZE,
+                                offset_size=self.offset_size))
             self._dat.close()
             self.nm.close()
             os.replace(base + ".cpd", base + ".dat")
+            remove_sidecars(base + ".idx")  # derived from the OLD journal
             os.replace(base + ".cpx", base + ".idx")
             self._dat = DiskFile(base + ".dat")
             self.super_block = new_sb
-            self.nm = create_needle_map(self.needle_map_kind, base + ".idx")
+            self.nm = create_needle_map(self.needle_map_kind, base + ".idx",
+                                        offset_size=self.offset_size)
             self._append_offset = self._dat.size()
             self._compacting = False
 

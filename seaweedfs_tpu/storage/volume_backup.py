@@ -46,11 +46,13 @@ def binary_search_by_append_at_ns(volume: Volume,
     by scanning to a readable neighbour.
     """
     idx_path = volume.base_file_name() + ".idx"
-    n_entries = os.path.getsize(idx_path) // t.NEEDLE_MAP_ENTRY_SIZE
+    entry = t.needle_map_entry_size(volume.offset_size)
+    n_entries = os.path.getsize(idx_path) // entry
     with open(idx_path, "rb") as f:
         def ts_at(i: int) -> Optional[int]:
-            f.seek(i * t.NEEDLE_MAP_ENTRY_SIZE)
-            _, off, size = idx_mod.unpack_entry(f.read(16))
+            f.seek(i * entry)
+            _, off, size = idx_mod.unpack_entry(
+                f.read(entry), offset_size=volume.offset_size)
             return _entry_append_ns(volume, off, size)
 
         lo, hi = 0, n_entries
@@ -74,14 +76,16 @@ def iter_entries_since(volume: Volume, since_ns: int,
                        ) -> Iterator[tuple[int, int, int]]:
     """(key, stored_offset, size) journal entries appended after since_ns."""
     idx_path = volume.base_file_name() + ".idx"
+    entry = t.needle_map_entry_size(volume.offset_size)
     start = binary_search_by_append_at_ns(volume, since_ns)
     with open(idx_path, "rb") as f:
-        f.seek(start * t.NEEDLE_MAP_ENTRY_SIZE)
+        f.seek(start * entry)
         while True:
-            chunk = f.read(t.NEEDLE_MAP_ENTRY_SIZE * 1024)
+            chunk = f.read(entry * 1024)
             if not chunk:
                 return
-            yield from idx_mod.iter_index_bytes(chunk)
+            yield from idx_mod.iter_index_bytes(
+                chunk, offset_size=volume.offset_size)
 
 
 def iter_needles_since(volume: Volume, since_ns: int) -> Iterator[Needle]:
@@ -140,15 +144,19 @@ def rebuild_idx(volume_dir: str, collection: str, vid: int) -> int:
     with open(tmp, "wb") as out:
         def visit(n: Needle, byte_offset: int) -> None:
             nonlocal count
+            w = v.offset_size
             if len(n.data) == 0:
                 out.write(idx_mod.pack_entry(
-                    n.id, t.offset_to_stored(byte_offset),
-                    t.TOMBSTONE_FILE_SIZE))
+                    n.id, t.offset_to_stored(byte_offset, w),
+                    t.TOMBSTONE_FILE_SIZE, offset_size=w))
             else:
                 out.write(idx_mod.pack_entry(
-                    n.id, t.offset_to_stored(byte_offset), n.size))
+                    n.id, t.offset_to_stored(byte_offset, w), n.size,
+                    offset_size=w))
                 count += 1
         v.scan(visit)
     v.close()
+    from .needle_map import remove_sidecars
+    remove_sidecars(base + ".idx")
     os.replace(tmp, base + ".idx")
     return count

@@ -23,6 +23,18 @@ expires_after_seconds = 60
 # it MUST include the volume servers' IPs or they cannot register.
 # Peer masters listed in -peers are trusted implicitly (raft + proxying).
 white_list = ""
+
+[tls]
+# when cert_file+key_file are set every server terminates TLS on its HTTP
+# port and its gRPC port; verify_client additionally demands a client
+# certificate signed by ca_file (mutual TLS) — weed/security/tls.go
+ca_file = ""
+cert_file = ""
+key_file = ""
+verify_client = false
+# https additionally wraps the HTTP listeners; with certs set, the gRPC
+# plane (all intra-cluster RPC) is always secured
+https = false
 """
 
 FILER_TOML = """\

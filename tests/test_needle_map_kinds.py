@@ -111,6 +111,177 @@ def test_volume_runs_on_compact_map(tmp_path):
     v2.close()
 
 
+def test_disk_map_differential_vs_dict_map(tmp_path):
+    from seaweedfs_tpu.storage.needle_map import DiskNeedleMap
+    a = NeedleMap()
+    b = DiskNeedleMap(str(tmp_path / "d.idx"))
+    b.FLUSH_THRESHOLD = 64  # force constant delta->sdx merging
+    ops = _random_ops()
+    _apply_ops(a, ops)
+    _apply_ops(b, ops)
+    assert len(a) == len(b)
+    assert a.file_count == b.file_count
+    assert a.deleted_count == b.deleted_count
+    assert a.file_byte_count == b.file_byte_count
+    assert a.deleted_byte_count == b.deleted_byte_count
+    for key in range(1, 800):
+        va, vb = a.get(key), b.get(key)
+        assert (va is None) == (vb is None), key
+        if va is not None:
+            assert (va.offset, va.size) == (vb.offset, vb.size), key
+        assert (key in a) == (key in b)
+    assert a.live_entries() == b.live_entries()
+    b.close()
+
+
+def test_disk_map_restart_replays_only_tail(tmp_path):
+    from seaweedfs_tpu.storage import idx as idx_mod
+    from seaweedfs_tpu.storage.needle_map import DiskNeedleMap
+    path = str(tmp_path / "m.idx")
+    nm = DiskNeedleMap(path)
+    nm.FLUSH_THRESHOLD = 100
+    ops = _random_ops(n=1500, key_space=300, seed=7)
+    _apply_ops(nm, ops)
+    live = nm.live_entries()
+    counters = (nm.file_count, nm.deleted_count, nm.file_byte_count,
+                nm.deleted_byte_count, len(nm))
+    nm.close()
+    covered_before = os.path.getsize(path)
+
+    # writes after the last flush land only in the journal; reopen must
+    # adopt the .sdx and replay just the tail
+    with open(path, "ab") as f:
+        f.write(idx_mod.pack_entry(9001, 777, 1234))
+    nm2 = DiskNeedleMap(path)
+    assert nm2.get(9001).offset == 777
+    assert dict(nm2.live_entries()) == {**dict(live), 9001: 1234}
+    nm2.close()
+
+    # identical to a cold memory-map replay of the same journal
+    nm3 = create_needle_map("memory", path)
+    assert nm3.live_entries() == nm2.live_entries()
+
+    # a corrupt sdx falls back to a full journal rebuild
+    sdx = path[:-4] + ".sdx"
+    assert os.path.exists(sdx) and covered_before > 0
+    with open(sdx, "r+b") as f:
+        f.write(b"garbage!")
+    nm4 = DiskNeedleMap(path)
+    assert nm4.live_entries() == nm3.live_entries()
+    nm4.close()
+
+
+def test_disk_map_rejects_stale_sidecar(tmp_path):
+    """A wholesale .idx replacement (vacuum commit / volume copy / weed
+    fix) must invalidate the .sdx: its header fingerprints the final
+    journal entry it folded, so a rewritten journal of >= size cannot be
+    mistaken for an appended one."""
+    from seaweedfs_tpu.storage import idx as idx_mod
+    from seaweedfs_tpu.storage.needle_map import DiskNeedleMap
+    path = str(tmp_path / "m.idx")
+    nm = DiskNeedleMap(path)
+    nm.FLUSH_THRESHOLD = 4
+    for key in range(1, 9):
+        nm.put(key, 300 + key, 100)
+    nm.close()  # .sdx now folds offsets 301..308
+
+    # simulate vacuum commit: journal rewritten with new offsets (same or
+    # larger byte size), sidecar left behind
+    with open(path, "wb") as f:
+        for key in range(1, 10):
+            f.write(idx_mod.pack_entry(key, 21 + key, 100))
+    nm2 = DiskNeedleMap(path)
+    assert nm2.get(3).offset == 24, "stale sidecar served old offsets"
+    assert len(nm2) == 9
+    nm2.close()
+
+
+def test_disk_map_10m_entries_bounded_rss(tmp_path):
+    """VERDICT r2 #4: a 30GB-volume-scale index that doesn't live in RAM.
+    10M unique needles are synthesized straight into the .idx journal; a
+    clean subprocess (no jax, no test harness) opens the DiskNeedleMap,
+    does random lookups, and reports peak RSS — which must stay far below
+    the ~600MB a dict map needs for 10M NeedleValues."""
+    import json
+    import subprocess
+    import sys
+    import textwrap
+
+    n = 10_000_000
+    keys = np.arange(1, n + 1, dtype=">u8")
+    offs = np.arange(1, n + 1, dtype=">u4")
+    sizes = np.full(n, 1000, dtype=">u4")
+    rec = np.empty(n, dtype=[("k", ">u8"), ("o", ">u4"), ("s", ">u4")])
+    rec["k"], rec["o"], rec["s"] = keys, offs, sizes
+    path = str(tmp_path / "big.idx")
+    rec.tofile(path)
+
+    code = textwrap.dedent("""
+        import json, sys, time
+        from seaweedfs_tpu.storage.needle_map import DiskNeedleMap
+        def hwm_mb():
+            # NOT ru_maxrss: that survives execve, so a child of a fat
+            # pytest process inherits the parent's high-water mark
+            for line in open("/proc/self/status"):
+                if line.startswith("VmHWM"):
+                    return int(line.split()[1]) / 1024
+        t0 = time.perf_counter()
+        nm = DiskNeedleMap(sys.argv[1])
+        load_s = time.perf_counter() - t0
+        lat = []
+        for key in range(1, 10_000_000, 997_001):
+            t0 = time.perf_counter()
+            nv = nm.get(key)
+            lat.append(time.perf_counter() - t0)
+            assert nv is not None and nv.offset == key, key
+        assert nm.get(10_000_001) is None
+        assert len(nm) == 10_000_000
+        print(json.dumps({
+            "maxrss_mb": hwm_mb(),
+            "load_s": load_s,
+            "lookup_p50_us": sorted(lat)[len(lat)//2] * 1e6,
+        }))
+    """)
+    out = subprocess.run(
+        [sys.executable, "-c", code, path], capture_output=True, text=True,
+        timeout=300, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    stats = json.loads(out.stdout)
+    # hard RSS budgets. Cold rebuild transiently holds the raw journal +
+    # sort permutation (~3.5x the 160MB index; a dict map would hold
+    # ~1.3GB *steady-state*). The reopen below is the disk-resident
+    # claim: the .sdx is adopted via memmap and RSS stays near baseline.
+    assert stats["maxrss_mb"] < 640, stats
+    assert stats["load_s"] < 60, stats
+    # reopen adopts the .sdx: loads without the rebuild cost
+    out2 = subprocess.run(
+        [sys.executable, "-c", code, path], capture_output=True, text=True,
+        timeout=120, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out2.returncode == 0, out2.stderr[-2000:]
+    stats2 = json.loads(out2.stdout)
+    assert stats2["load_s"] < 5, stats2
+    assert stats2["maxrss_mb"] < 250, stats2
+
+
+def test_volume_runs_on_disk_map(tmp_path):
+    from seaweedfs_tpu.storage.needle_map import DiskNeedleMap
+    v = Volume(str(tmp_path), "", 1, create=True,
+               needle_map_kind="leveldb")
+    assert isinstance(v.nm, DiskNeedleMap)
+    for i in range(1, 50):
+        v.write_needle(Needle(cookie=i, id=i, data=b"x" * i))
+    v.delete_needle(Needle(cookie=7, id=7))
+    assert v.read_needle(8).data == b"x" * 8
+    with pytest.raises(KeyError):
+        v.read_needle(7)
+    v.close()
+    v2 = Volume(str(tmp_path), "", 1, needle_map_kind="leveldb")
+    assert v2.read_needle(8).data == b"x" * 8
+    with pytest.raises(KeyError):
+        v2.read_needle(7)
+    v2.close()
+
+
 def test_min_free_space_watchdog(tmp_path):
     st = Store([str(tmp_path)], coder_name="numpy")
     v = st.add_volume(1)
