@@ -184,18 +184,34 @@ def _offset_path(stem: str, *parts: str) -> str:
 
 def cmd_filer_replicate(args) -> None:
     """Continuously replicate one filer into a sink configured by
-    replication.toml (weed filer.replicate)."""
-    from .replication.replicator import Replicator
+    replication.toml (weed filer.replicate). With -from_queue the events
+    come from the configured [source.*] queue (file spool or messaging
+    broker) instead of a live subscribe stream — the reference's
+    Kafka/SQS-fed mode (weed/replication/sub)."""
+    import time as _time
+
+    from .replication.replicator import Replicator, run_from_queue
     from .replication.sink import load_sink
     from .utils.config import load_configuration
-    sink = load_sink(load_configuration("replication"))
+    cfg = load_configuration("replication")
+    sink = load_sink(cfg)
     if sink is None:
         raise SystemExit("no enabled [sink.*] in replication.toml "
                          "(run scaffold -config replication)")
     offset = args.offset_file or _offset_path(
         "replicate_offset", args.filer, sink.identity(), args.path_prefix)
-    Replicator(args.filer, sink, args.path_prefix,
-               offset_path=offset).run()
+    r = Replicator(args.filer, sink, args.path_prefix, offset_path=offset)
+    if args.from_queue:
+        from .replication.sub import load_notification_input
+        inp = load_notification_input(cfg)
+        if inp is None:
+            raise SystemExit("-from_queue needs an enabled [source.*] in "
+                             "replication.toml")
+        while True:
+            run_from_queue(r, inp, idle_timeout=2.0)
+            _time.sleep(1.0)
+    else:
+        r.run()
 
 
 def cmd_filer_sync(args) -> None:
@@ -619,6 +635,9 @@ def build_parser() -> argparse.ArgumentParser:
     fr.add_argument("-pathPrefix", dest="path_prefix", default="/")
     fr.add_argument("-offsetFile", dest="offset_file", default="",
                     help="resume-offset file (default derived from -filer)")
+    fr.add_argument("-from_queue", action="store_true",
+                    help="consume events from the [source.*] queue in "
+                         "replication.toml instead of a live subscribe")
     fr.set_defaults(fn=cmd_filer_replicate)
 
     fsync = sub.add_parser("filer.sync",

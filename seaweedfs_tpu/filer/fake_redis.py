@@ -1,0 +1,158 @@
+"""Minimal in-repo redis-protocol server for the redis filer store.
+
+The environment cannot host a real redis, so the non-SQL distributed
+store plugin (filer/redis_store.py, the reference's
+weed/filer/redis/universal_redis_store.go model) is proven against this
+fake: a threaded socket server speaking enough RESP2 for the store's
+command set (GET/SET/DEL/EXISTS/SADD/SREM/SMEMBERS/PING/FLUSHALL).
+Single-process, in-memory, thread-safe — the contract surface matters,
+not the persistence.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+from typing import Optional
+
+
+def _encode(obj) -> bytes:
+    """Python value -> RESP2 reply."""
+    if obj is None:
+        return b"$-1\r\n"
+    if isinstance(obj, int):
+        return f":{obj}\r\n".encode()
+    if isinstance(obj, bytes):
+        return b"$" + str(len(obj)).encode() + b"\r\n" + obj + b"\r\n"
+    if isinstance(obj, str):
+        return _encode(obj.encode())
+    if isinstance(obj, (list, tuple, set)):
+        items = sorted(obj) if isinstance(obj, set) else list(obj)
+        return (b"*" + str(len(items)).encode() + b"\r\n"
+                + b"".join(_encode(i) for i in items))
+    raise TypeError(type(obj))
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        buf = b""
+        srv: "FakeRedisServer" = self.server.owner  # type: ignore
+        while True:
+            cmd, buf = self._read_command(buf)
+            if cmd is None:
+                return
+            reply = srv.execute(cmd)
+            try:
+                self.request.sendall(reply)
+            except OSError:
+                return
+
+    def _read_command(self, buf: bytes):
+        """Parse one RESP array of bulk strings; returns (cmd, rest)."""
+        while True:
+            cmd, rest = self._try_parse(buf)
+            if cmd is not None or rest is None:
+                return cmd, rest if rest is not None else b""
+            try:
+                chunk = self.request.recv(65536)
+            except OSError:
+                return None, b""
+            if not chunk:
+                return None, b""
+            buf += chunk
+
+    @staticmethod
+    def _try_parse(buf: bytes):
+        """(command_list, remaining) or (None, buf) when incomplete or
+        (None, None) on protocol garbage."""
+        if not buf:
+            return None, buf
+        if buf[0:1] != b"*":
+            return None, None
+        head, _, rest = buf.partition(b"\r\n")
+        if not _:
+            return None, buf
+        n = int(head[1:])
+        items = []
+        for _i in range(n):
+            if rest[0:1] != b"$":
+                return None, buf if b"\r\n" not in rest else None
+            line, sep, rest2 = rest.partition(b"\r\n")
+            if not sep:
+                return None, buf
+            ln = int(line[1:])
+            if len(rest2) < ln + 2:
+                return None, buf
+            items.append(rest2[:ln])
+            rest = rest2[ln + 2:]
+        return items, rest
+
+
+class FakeRedisServer:
+    """`with FakeRedisServer() as (host, port): ...`"""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._strings: dict[bytes, bytes] = {}
+        self._sets: dict[bytes, set] = {}
+        self._lock = threading.Lock()
+        self._tcp = socketserver.ThreadingTCPServer(
+            (host, port), _Handler, bind_and_activate=True)
+        self._tcp.daemon_threads = True
+        self._tcp.owner = self
+        self.host, self.port = self._tcp.server_address
+        self._thread = threading.Thread(target=self._tcp.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def execute(self, cmd: list[bytes]) -> bytes:
+        name = cmd[0].upper().decode()
+        args = cmd[1:]
+        with self._lock:
+            if name == "PING":
+                return b"+PONG\r\n"
+            if name == "SET":
+                self._strings[args[0]] = args[1]
+                self._sets.pop(args[0], None)
+                return b"+OK\r\n"
+            if name == "GET":
+                return _encode(self._strings.get(args[0]))
+            if name == "DEL":
+                n = 0
+                for key in args:
+                    n += (self._strings.pop(key, None) is not None) or \
+                         (self._sets.pop(key, None) is not None)
+                return _encode(int(n))
+            if name == "EXISTS":
+                return _encode(int(sum(
+                    1 for key in args
+                    if key in self._strings or key in self._sets)))
+            if name == "SADD":
+                s = self._sets.setdefault(args[0], set())
+                before = len(s)
+                s.update(args[1:])
+                return _encode(len(s) - before)
+            if name == "SREM":
+                s = self._sets.get(args[0], set())
+                before = len(s)
+                s.difference_update(args[1:])
+                if not s:
+                    self._sets.pop(args[0], None)
+                return _encode(before - len(s))
+            if name == "SMEMBERS":
+                return _encode(self._sets.get(args[0], set()))
+            if name == "FLUSHALL":
+                self._strings.clear()
+                self._sets.clear()
+                return b"+OK\r\n"
+            return f"-ERR unknown command '{name}'\r\n".encode()
+
+    def close(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()
+
+    def __enter__(self):
+        return self.host, self.port
+
+    def __exit__(self, *exc):
+        self.close()

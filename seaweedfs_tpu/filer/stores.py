@@ -188,8 +188,14 @@ def _leveldb(**kw):
     return LevelDbStore(**kw)
 
 
+def _redis(**kw):
+    from .redis_store import RedisStore
+    return RedisStore(**kw)
+
+
 register_store("memory", MemoryStore)
 register_store("sqlite", _sqlite)
 register_store("mysql", _mysql)
 register_store("postgres", _postgres)
 register_store("leveldb", _leveldb)
+register_store("redis", _redis)

@@ -13,6 +13,20 @@ Publish/subscribe are HTTP streams rather than gRPC bidi:
 
 Brokers are stateless over the filer: restart replays nothing into memory
 but subscribers transparently read persisted segments first.
+
+Multi-broker distribution (weed/messaging/broker/consistent_distribution.go,
+topic_manager.go:42-116): each broker registers with the filer over the
+SeaweedFiler KeepConnected gRPC stream (name "broker@host:port"); every
+broker polls the registry and computes the same rendezvous-hash ownership
+of each topic-partition. A request landing on a non-owner answers 307 to
+the owner; when the owner dies its stream drops, the registry shrinks,
+and ownership re-converges on the survivors (segments live in the filer,
+so the new owner serves history transparently).
+
+Ack levels: publish?ack=memory (default) acks once the messages are in
+the owner's in-memory log (segment flush is async — a crash inside the
+flush window can lose acked messages, exactly the reference's posture);
+publish?ack=flush forces the segment out to the filer before acking.
 """
 
 from __future__ import annotations
@@ -79,13 +93,16 @@ class FilerSegmentStore:
         urllib.request.urlopen(req, timeout=60).close()
 
     def drain(self) -> None:
-        """Block until queued segment writes have landed (tests, shutdown)."""
-        pending, self._pending = self._pending, []
-        for fut in pending:
+        """Block until every segment write queued so far has landed.
+        Waits on a snapshot WITHOUT popping: concurrent ack=flush
+        publishes each need their own segment awaited, and popping would
+        let one request steal another's future and ack early."""
+        for fut in list(self._pending):
             try:
                 fut.result(timeout=60)
             except Exception as e:
                 log.warning("segment write failed: %s", e)
+        self._pending = [f for f in self._pending if not f.done()]
 
     async def read_segments(self, session: aiohttp.ClientSession,
                             dir_path: str, since_ns: int) -> list[LogEntry]:
@@ -127,10 +144,19 @@ class FilerSegmentStore:
 
 
 class BrokerServer:
-    def __init__(self, filer_url: str = ""):
+    def __init__(self, filer_url: str = "", advertise_url: str = "",
+                 register: bool = False):
         self.persist = FilerSegmentStore(filer_url) if filer_url else None
+        self.filer_url = filer_url
+        self.advertise_url = advertise_url
+        self.register = register and bool(filer_url and advertise_url)
+        # known brokers for ownership; alone until the registry answers
+        self.peer_brokers: list[str] = (
+            [advertise_url] if advertise_url else [])
         self.partitions: dict[tuple[str, str, int], TopicPartition] = {}
         self._session: Optional[aiohttp.ClientSession] = None
+        self._register_task: Optional[asyncio.Task] = None
+        self._poll_task: Optional[asyncio.Task] = None
         self.app = self._build_app()
 
     def _build_app(self) -> web.Application:
@@ -150,12 +176,90 @@ class BrokerServer:
 
     async def _on_startup(self, app) -> None:
         self._session = aiohttp.ClientSession()
+        if self.register:
+            self._register_task = asyncio.create_task(self._register_loop())
+            self._poll_task = asyncio.create_task(self._poll_brokers_loop())
 
     async def _on_cleanup(self, app) -> None:
+        for task in (self._register_task, self._poll_task):
+            if task:
+                task.cancel()
         for tp in self.partitions.values():
             tp.buffer.flush()
+        if self.persist is not None:
+            await asyncio.get_event_loop().run_in_executor(
+                None, self.persist.drain)
         if self._session:
             await self._session.close()
+
+    # --- membership (KeepConnected registration + registry polling) ---
+    async def _register_loop(self) -> None:
+        """Hold a KeepConnected stream to the filer announcing this
+        broker; the filer drops us from the registry when it breaks."""
+        from ..pb import filer_pb2 as fpb
+        from ..pb.rpc import FilerStub, aio_dial, grpc_address
+        target = grpc_address(self.filer_url)
+        while True:
+            try:
+                async with aio_dial(target) as channel:
+                    stub = FilerStub(channel)
+
+                    async def beats():
+                        while True:
+                            yield fpb.KeepConnectedRequest(
+                                name=f"broker@{self.advertise_url}",
+                                resources=[
+                                    f"{ns}/{topic}/{p}" for (ns, topic, p)
+                                    in self.partitions])
+                            await asyncio.sleep(1.0)
+
+                    async for _ in stub.KeepConnected(beats()):
+                        pass
+            except asyncio.CancelledError:
+                return
+            except Exception as e:
+                log.debug("broker registration retry: %s", e)
+            await asyncio.sleep(1.0)
+
+    async def _poll_brokers_loop(self) -> None:
+        while True:
+            try:
+                async with self._session.get(
+                        f"http://{self.filer_url}/__meta__/brokers",
+                        timeout=aiohttp.ClientTimeout(total=5)) as r:
+                    if r.status == 200:
+                        brokers = (await r.json()).get("brokers", [])
+                        if self.advertise_url not in brokers:
+                            brokers = brokers + [self.advertise_url]
+                        self.peer_brokers = sorted(brokers)
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                pass
+            await asyncio.sleep(1.0)
+
+    def _owner(self, ns: str, topic: str, p: int) -> str:
+        from .client import pick_broker
+        if not self.peer_brokers:
+            return self.advertise_url
+        return pick_broker(self.peer_brokers, ns, topic, p)
+
+    def _maybe_redirect(self, request: web.Request):
+        """307 to the owning broker unless we own it (or were already
+        redirected — a one-hop guard against registry disagreement)."""
+        if not self.register or "redirected" in request.query:
+            return None
+        ns = request.match_info["ns"]
+        topic = request.match_info["topic"]
+        p = int(request.match_info["partition"])
+        owner = self._owner(ns, topic, p)
+        if owner == self.advertise_url:
+            return None
+        q = dict(request.query)
+        q["redirected"] = "1"
+        import urllib.parse as _up
+        raise web.HTTPTemporaryRedirect(
+            f"http://{owner}{request.path}?{_up.urlencode(q)}")
 
     def _partition(self, ns: str, topic: str, p: int) -> TopicPartition:
         key = (ns, topic, p)
@@ -165,6 +269,7 @@ class BrokerServer:
 
     # --- handlers ---
     async def publish(self, request: web.Request) -> web.Response:
+        self._maybe_redirect(request)
         tp = self._partition(request.match_info["ns"],
                              request.match_info["topic"],
                              int(request.match_info["partition"]))
@@ -179,9 +284,15 @@ class BrokerServer:
             added = tp.buffer.add(e.key, e.value, e.headers)
             last_ts = added.ts_ns
             n += 1
+        if request.query.get("ack") == "flush" and self.persist is not None:
+            # durable ack: segment written to the filer before the reply
+            tp.buffer.flush()
+            await asyncio.get_event_loop().run_in_executor(
+                None, self.persist.drain)
         return web.json_response({"published": n, "last_ts": last_ts})
 
     async def subscribe(self, request: web.Request) -> web.StreamResponse:
+        self._maybe_redirect(request)
         tp = self._partition(request.match_info["ns"],
                              request.match_info["topic"],
                              int(request.match_info["partition"]))
@@ -229,11 +340,15 @@ class BrokerServer:
         out: dict[str, list[int]] = {}
         for (ns, topic, p) in self.partitions:
             out.setdefault(f"{ns}/{topic}", []).append(p)
-        return web.json_response({"topics": out})
+        return web.json_response({"topics": out,
+                                  "brokers": self.peer_brokers,
+                                  "url": self.advertise_url})
 
 
 async def run_broker(host: str, port: int, filer_url: str = "",
                      **kwargs) -> web.AppRunner:
+    kwargs.setdefault("advertise_url", f"{host}:{port}")
+    kwargs.setdefault("register", bool(filer_url))
     server = BrokerServer(filer_url=filer_url, **kwargs)
     runner = web.AppRunner(server.app, access_log=None)
     await runner.setup()

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Callable, Iterator, Optional
@@ -41,25 +42,84 @@ def pick_broker(brokers: list[str], ns: str, topic: str,
 
 class Publisher:
     def __init__(self, brokers: list[str], namespace: str, topic: str,
-                 partition_count: int = 4):
+                 partition_count: int = 4, filer: str = "",
+                 ack: str = "memory"):
+        """filer: when set, the broker list is (re)discovered from the
+        filer registry — dead brokers drop out when their KeepConnected
+        stream breaks, so publishes fail over to the new owner.
+        ack: "memory" (default, reference posture) or "flush" (segment
+        persisted to the filer before the ack returns)."""
         self.brokers = brokers
         self.ns = namespace
         self.topic = topic
         self.partition_count = partition_count
+        self.filer = filer
+        self.ack = ack
+        if filer and not brokers:
+            self.refresh_brokers()
+
+    def refresh_brokers(self) -> None:
+        if not self.filer:
+            return
+        try:
+            with urllib.request.urlopen(
+                    f"http://{self.filer}/__meta__/brokers",
+                    timeout=10) as r:
+                brokers = json.load(r).get("brokers", [])
+            if brokers:
+                self.brokers = brokers
+        except OSError:
+            pass
+
+    def _post(self, broker: str, p: int, body: bytes) -> dict:
+        """POST with manual 307 handling (urllib won't re-POST) — the
+        broker redirects to the partition's owner."""
+        url = (f"http://{broker}/publish/{self.ns}/{self.topic}/{p}"
+               f"?ack={self.ack}")
+        for _ in range(3):
+            req = urllib.request.Request(
+                url, data=body, method="POST",
+                headers={"Content-Type": "application/x-ndjson"})
+            try:
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    return json.load(r)
+            except urllib.error.HTTPError as err:
+                if err.code in (301, 302, 307, 308):
+                    url = err.headers["Location"]
+                    continue
+                raise
+        raise OSError("too many broker redirects")
+
+    def _post_with_failover(self, p: int, body: bytes) -> dict:
+        """POST to the partition's owner; with a filer configured, a
+        dead or missing broker triggers registry rediscovery and retry
+        against the re-converged owner."""
+        attempts = 4 if self.filer else 1
+        last_err: Exception = OSError("no brokers")
+        for attempt in range(attempts):
+            try:
+                if not self.brokers:
+                    raise ValueError("no brokers known yet")
+                broker = pick_broker(self.brokers, self.ns, self.topic, p)
+                return self._post(broker, p, body)
+            except urllib.error.HTTPError:
+                raise
+            except (OSError, ValueError) as err:
+                last_err = err
+                if self.filer:
+                    import time as _time
+                    _time.sleep(0.5 * (attempt + 1))
+                    self.refresh_brokers()
+        raise last_err
 
     def publish(self, key: bytes, value: bytes,
                 headers: Optional[dict] = None) -> int:
-        """Send one message; returns its broker-assigned timestamp offset."""
+        """Send one message; returns its broker-assigned timestamp
+        offset."""
         p = pick_partition(key, self.partition_count)
-        broker = pick_broker(self.brokers, self.ns, self.topic, p)
         e = LogEntry(0, key, value, headers or {})
         body = json.dumps(e.to_dict(), separators=(",", ":")).encode() + b"\n"
-        req = urllib.request.Request(
-            f"http://{broker}/publish/{self.ns}/{self.topic}/{p}",
-            data=body, method="POST",
-            headers={"Content-Type": "application/x-ndjson"})
-        with urllib.request.urlopen(req, timeout=60) as r:
-            return json.load(r)["last_ts"]
+        return self._post_with_failover(p, body)["last_ts"]
 
     def publish_many(self, messages: list[tuple[bytes, bytes]]) -> int:
         """Batch publish; groups by partition. Returns count."""
@@ -69,16 +129,10 @@ class Publisher:
                               []).append(LogEntry(0, key, value, {}))
         n = 0
         for p, entries in groups.items():
-            broker = pick_broker(self.brokers, self.ns, self.topic, p)
             body = b"".join(
                 json.dumps(e.to_dict(), separators=(",", ":")).encode()
                 + b"\n" for e in entries)
-            req = urllib.request.Request(
-                f"http://{broker}/publish/{self.ns}/{self.topic}/{p}",
-                data=body, method="POST",
-                headers={"Content-Type": "application/x-ndjson"})
-            with urllib.request.urlopen(req, timeout=60) as r:
-                n += json.load(r)["published"]
+            n += self._post_with_failover(p, body)["published"]
         return n
 
 

@@ -113,6 +113,27 @@ class WebhookQueue:
             f.write(body.decode() + "\n")
 
 
+class BrokerQueue:
+    """Publish every filer event to a messaging-broker topic — the
+    Kafka-class outbound queue (weed/notification/kafka): replicators
+    consume the topic with replication.sub.BrokerQueueInput."""
+
+    def __init__(self, brokers: list, namespace: str = "notifications",
+                 topic: str = "filer", filer: str = "",
+                 ack: str = "flush"):
+        from ..messaging.client import Publisher
+        # single partition: filer events are a strictly ordered stream
+        self._pub = Publisher(brokers, namespace, topic,
+                              partition_count=1, filer=filer, ack=ack)
+
+    def notify(self, event) -> None:
+        body = json.dumps(event.to_dict(), separators=(",", ":")).encode()
+        try:
+            self._pub.publish(b"filer", body)
+        except Exception as e:
+            glog.warning("broker notify failed: %s", e)
+
+
 QUEUES = {
     "log": lambda cfg: LogQueue(),
     "file": lambda cfg: FileQueue(cfg.get_string("directory",
@@ -120,6 +141,11 @@ QUEUES = {
     "webhook": lambda cfg: WebhookQueue(
         cfg.get_string("url", ""),
         cfg.get_string("spool", "")),
+    "broker": lambda cfg: BrokerQueue(
+        [b for b in cfg.get_string("brokers", "").split(",") if b],
+        namespace=cfg.get_string("namespace", "notifications"),
+        topic=cfg.get_string("topic", "filer"),
+        filer=cfg.get_string("filer", "")),
 }
 
 

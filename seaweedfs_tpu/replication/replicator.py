@@ -69,7 +69,11 @@ class Replicator:
     def _fetch_entry_data(self, entry: Entry) -> bytes:
         """Read the file body from the source filer (repl_util chunk fetch
         helpers in the reference; we read through the filer's HTTP API so
-        chunk/manifest resolution stays server-side)."""
+        chunk/manifest resolution stays server-side). Chunkless entries
+        (empty files, metadata-only events off a queue) have no body to
+        fetch."""
+        if not entry.chunks:
+            return b""
         url = f"http://{self.source}" + urllib.parse.quote(entry.full_path)
         with urllib.request.urlopen(url, timeout=300) as r:
             return r.read()
@@ -176,6 +180,22 @@ class Replicator:
                 break
             time.sleep(1.0)
         return applied
+
+
+def run_from_queue(replicator: "Replicator", inp,
+                   idle_timeout: float = 1.0, stop_check=None) -> int:
+    """Apply queued filer events to the replicator's sink until the queue
+    idles — the queue-fed `filer.replicate` mode (the reference consumes
+    Kafka/SQS via weed/replication/sub; here the file spool or the
+    messaging broker via replication.sub)."""
+    from .sub import iter_queue
+    applied = 0
+    for ev in iter_queue(inp, idle_timeout=idle_timeout,
+                         stop_check=stop_check):
+        # apply() prefix-filters on full_path exactly like live mode
+        replicator.apply(ev)
+        applied += 1
+    return applied
 
 
 def consume_spool_file(path: str) -> Iterator[MetaEvent]:

@@ -476,61 +476,126 @@ class S3Server:
 
     async def _walk_listing(self, bucket: str, prefix: str, delimiter: str,
                             marker: str, max_keys: int):
-        """Flatten the filer tree into globally key-ordered S3 results.
+        """Stream the filer tree in global S3 key order.
 
-        Directory walk order is not key order ('a/x' walks before 'a.txt'
-        but sorts after), so all candidate keys under the prefix are
-        collected first and sorted before pagination — correctness over
-        streaming (the reference streams with a merge walk,
-        s3api_objects_list_handlers.go)."""
+        Inside one directory, sorting children by their EFFECTIVE key
+        (name for files, name + "/" for directories) yields exact
+        lexicographic order of all keys — a directory's subtree occupies
+        the contiguous key range starting at name + "/" — so a sequential
+        recursion IS the merge walk the reference streams with
+        (s3api_objects_list_handlers.go). Subtrees entirely at or below
+        the marker are pruned without listing them, common-prefix folds
+        skip whole subtrees, and the walk stops at max_keys + 1: a page
+        over a 100k-key bucket touches ~max_keys entries, not 100k.
+        """
         base = f"{BUCKETS_DIR}/{bucket}"
-        all_keys: list[tuple[str, dict]] = []
-
-        async def walk(dir_path: str, key_prefix: str) -> None:
-            start = ""
-            while True:
-                status, body = await self._meta_get("list", {
-                    "dir": dir_path, "start": start, "limit": "1024"})
-                entries = body.get("entries", [])
-                if not entries:
-                    return
-                for e in entries:
-                    name = e["path"].rsplit("/", 1)[-1]
-                    key = key_prefix + name
-                    is_dir = bool(e["attr"].get("mode", 0) & 0o40000)
-                    if is_dir:
-                        full = key + "/"
-                        # prune subtrees that cannot contain the prefix
-                        if prefix and not (full.startswith(prefix)
-                                           or prefix.startswith(full)):
-                            continue
-                        await walk(e["path"], full)
-                    elif not prefix or key.startswith(prefix):
-                        all_keys.append((key, e))
-                if len(entries) < 1024:
-                    return
-                start = entries[-1]["path"].rsplit("/", 1)[-1]
-
-        await walk(base, "")
-        all_keys.sort(key=lambda kv: kv[0])
-
         contents: list[tuple[str, dict]] = []
         common: set[str] = set()
-        truncated = False
-        next_marker = ""
-        for key, e in all_keys:
+        state = {"truncated": False}
+
+        async def emit(eff: str, is_dir: bool, e: dict) -> bool:
+            """One child in effective-key order; False = stop the walk."""
+            if is_dir:
+                # prune: incompatible with the prefix, or the whole
+                # subtree sorts at/below the marker
+                if prefix and not (eff.startswith(prefix)
+                                   or prefix.startswith(eff)):
+                    return True
+                if marker and marker >= eff \
+                        and not marker.startswith(eff):
+                    return True
+                if (delimiter and eff.startswith(prefix)
+                        and delimiter in eff[len(prefix):-1]):
+                    # every key below folds into one CommonPrefix
+                    cut = eff[len(prefix):].index(delimiter)
+                    common.add(eff[:len(prefix) + cut + 1])
+                    return True
+                if delimiter and delimiter == "/" \
+                        and eff.startswith(prefix):
+                    # the subtree itself is the common prefix
+                    common.add(eff)
+                    return True
+                return await walk(e["path"], eff)
+            key = eff
+            if prefix and not key.startswith(prefix):
+                return True
             if marker and key <= marker:
-                continue
+                return True
             if delimiter and delimiter in key[len(prefix):]:
                 cut = key[len(prefix):].index(delimiter)
                 common.add(key[:len(prefix) + cut + 1])
-                continue
+                return True
             if len(contents) >= max_keys:
-                truncated = True
-                next_marker = contents[-1][0]
-                break
+                state["truncated"] = True
+                return False
             contents.append((key, e))
-        return contents, common, truncated, next_marker
+            return True
+
+        async def walk(dir_path: str, key_prefix: str) -> bool:
+            """Emit this subtree in key order; False = stop the walk.
+
+            Store pages are NAME-ordered, but a directory's effective key
+            (name + "/") can sort after later names ("foo.txt" < "foo/"),
+            so children are held back until the page stream has passed
+            their effective key — only items with eff <= the page's last
+            raw name are safe to emit before fetching the next page.
+            """
+            start = ""
+            include_start = "false"
+            pending: list[tuple[str, bool, dict]] = []
+            if marker and marker.startswith(key_prefix):
+                # resume inside this directory: children sorting before
+                # the marker's first path segment cannot contain keys past
+                # it — EXCEPT directories whose name is a proper prefix of
+                # that segment ("a" sorts before "a.txt" by name but its
+                # keys "a/..." sort after). Probe those few names
+                # explicitly into the merge, then start the store listing
+                # at the segment itself.
+                first_seg = marker[len(key_prefix):].split("/", 1)[0]
+                if first_seg:
+                    for i in range(1, len(first_seg)):
+                        p = first_seg[:i]
+                        st, e = await self._meta_get(
+                            "lookup", {"path": f"{dir_path}/{p}"})
+                        if st != 200:
+                            continue
+                        if bool(e["attr"].get("mode", 0) & 0o40000):
+                            pending.append((key_prefix + p + "/", True, e))
+                    start = first_seg
+                    include_start = "true"
+            while True:
+                status, body = await self._meta_get("list", {
+                    "dir": dir_path, "start": start,
+                    "include_start": include_start, "limit": "1024"})
+                entries = body.get("entries", [])
+                for e in entries:
+                    name = e["path"].rsplit("/", 1)[-1]
+                    is_dir = bool(e["attr"].get("mode", 0) & 0o40000)
+                    eff = key_prefix + name + ("/" if is_dir else "")
+                    pending.append((eff, is_dir, e))
+                pending.sort(key=lambda c: c[0])
+                last_page = len(entries) < 1024
+                if last_page:
+                    safe, pending = pending, []
+                else:
+                    bound = key_prefix + \
+                        entries[-1]["path"].rsplit("/", 1)[-1]
+                    cut = 0
+                    while cut < len(pending) and pending[cut][0] <= bound:
+                        cut += 1
+                    safe, pending = pending[:cut], pending[cut:]
+                for eff, is_dir, e in safe:
+                    if not await emit(eff, is_dir, e):
+                        return False
+                if last_page:
+                    return True
+                start = entries[-1]["path"].rsplit("/", 1)[-1]
+                include_start = "false"
+
+        await walk(base, "")
+        next_marker = contents[-1][0] if state["truncated"] and contents \
+            else ""
+        return contents, common, state["truncated"], next_marker
 
     # --- tagging (s3api_object_tagging_handlers.go; tags live in the
     #     entry's extended attributes) ---

@@ -315,15 +315,20 @@ class FilerGrpcServicer:
 
     async def KeepConnected(self, request_iterator, context):
         """Bidi liveness: clients announce themselves, the filer echoes.
-        The reference uses this to track attached mounts/brokers
-        (filer_grpc_server.go KeepConnected)."""
+        The reference uses this to track attached mounts AND brokers
+        (filer_grpc_server.go KeepConnected; brokers register so
+        LocateBroker / consistent distribution can find them)."""
         name = None
         entry = None
+        broker_addr = None
         try:
             async for req in request_iterator:
                 name = req.name
                 entry = list(req.resources)
                 self.fs.connected_clients[name] = entry
+                if name.startswith("broker@"):
+                    broker_addr = name[len("broker@"):]
+                    self.fs.broker_registry[broker_addr] = len(entry)
                 yield pb.KeepConnectedResponse()
         finally:
             # stream end = client gone; a stale entry would report dead
@@ -333,6 +338,8 @@ class FilerGrpcServicer:
             if (name is not None
                     and self.fs.connected_clients.get(name) is entry):
                 self.fs.connected_clients.pop(name, None)
+                if broker_addr is not None:
+                    self.fs.broker_registry.pop(broker_addr, None)
 
     async def LocateBroker(self, request: pb.LocateBrokerRequest, context):
         brokers = getattr(self.fs, "broker_registry", {})

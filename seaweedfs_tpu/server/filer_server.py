@@ -135,6 +135,7 @@ class FilerServer:
         app.router.add_get("/__meta__/events", self.meta_events)
         app.router.add_get("/__meta__/subscribe", self.meta_subscribe)
         app.router.add_get("/__meta__/info", self.meta_info)
+        app.router.add_get("/__meta__/brokers", self.meta_brokers)
         app.router.add_get("/__meta__/assign", self.meta_assign)
         app.router.add_get("/__meta__/lookup_volume", self.meta_lookup_volume)
         app.router.add_get("/__meta__/resolve_chunks",
@@ -226,6 +227,12 @@ class FilerServer:
             "old": json.loads(e.old_entry.to_json()) if e.old_entry else None,
             "new": json.loads(e.new_entry.to_json()) if e.new_entry else None,
         } for e in events]})
+
+    async def meta_brokers(self, request: "web.Request") -> "web.Response":
+        """Registered message brokers (fed by gRPC KeepConnected broker@
+        announcements) — the HTTP face of LocateBroker."""
+        return web.json_response(
+            {"brokers": sorted(self.broker_registry)})
 
     async def meta_info(self, request: web.Request) -> web.Response:
         """Filer identity: the per-store signature used for sync loop

@@ -93,6 +93,21 @@ directory = "/backup"
 [sink.local]
 enabled = false
 directory = "./replicated"
+
+# queue-fed mode (weed filer.replicate -from_queue): consume events from a
+# queue the source filer's notification layer feeds, instead of a live
+# subscribe (the reference's Kafka/SQS-fed mode, weed/replication/sub)
+[source.file]
+enabled = false
+directory = "./filer_events"     # the notification FileQueue spool
+position_path = ""               # consume position (default: in-spool)
+
+[source.broker]
+enabled = false
+brokers = "localhost:17777"      # messaging brokers (Kafka-class)
+namespace = "notifications"
+topic = "filer"
+position_path = ""
 """
 
 TEMPLATES = {

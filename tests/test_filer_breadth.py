@@ -19,16 +19,24 @@ from seaweedfs_tpu.filer.entry import new_directory, new_file
 from seaweedfs_tpu.filer.stores import create_store
 
 
-@pytest.fixture(params=["memory", "sqlite", "leveldb"])
+@pytest.fixture(params=["memory", "sqlite", "leveldb", "redis"])
 def store(request, tmp_path):
     kwargs = {}
+    fake = None
     if request.param == "sqlite":
         kwargs["path"] = str(tmp_path / "f.db")
     if request.param == "leveldb":
         kwargs["path"] = str(tmp_path / "f.ldb")
+    if request.param == "redis":
+        # non-SQL distributed store proven against the in-repo RESP fake
+        from seaweedfs_tpu.filer.fake_redis import FakeRedisServer
+        fake = FakeRedisServer()
+        kwargs["host"], kwargs["port"] = fake.host, fake.port
     s = create_store(request.param, **kwargs)
     yield s
     s.close()
+    if fake is not None:
+        fake.close()
 
 
 def test_store_contract_crud(store):

@@ -1,0 +1,124 @@
+"""Queue-fed replication (weed/replication/sub role) and the redis-model
+store's live-filer integration.
+
+The replicator's queue mode consumes filer events from a durable queue —
+the notification FileQueue spool or a messaging-broker topic — and
+applies them to a sink, with a persisted consume position so restarts
+resume instead of replaying.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer.filer import MetaEvent
+from seaweedfs_tpu.filer.entry import new_file
+from seaweedfs_tpu.filer.chunks import FileChunk
+from seaweedfs_tpu.notification.queues import FileQueue
+from seaweedfs_tpu.replication.sub import (BrokerQueueInput, FileQueueInput,
+                                           iter_queue)
+
+
+def _event(path: str, tsns: int) -> MetaEvent:
+    return MetaEvent(tsns=tsns, directory=os.path.dirname(path),
+                     old_entry=None,
+                     new_entry=new_file(path, [FileChunk("1,ab", 0, 3)]))
+
+
+def test_file_queue_input_consumes_and_resumes(tmp_path):
+    spool = str(tmp_path / "spool")
+    q = FileQueue(spool)
+    for i in range(5):
+        q.notify(_event(f"/data/f{i}", 100 + i))
+    q.close()
+
+    inp = FileQueueInput(spool)
+    got = [e.new_entry.full_path for e in iter_queue(inp, idle_timeout=0.2)]
+    assert got == [f"/data/f{i}" for i in range(5)]
+
+    # position persisted: a fresh consumer sees only NEW events
+    q = FileQueue(spool)
+    q.notify(_event("/data/late", 200))
+    q.close()
+    inp2 = FileQueueInput(spool)
+    got2 = [e.new_entry.full_path
+            for e in iter_queue(inp2, idle_timeout=0.2)]
+    assert got2 == ["/data/late"]
+
+
+def test_file_queue_input_tolerates_torn_tail(tmp_path):
+    spool = str(tmp_path / "spool")
+    q = FileQueue(spool)
+    q.notify(_event("/d/whole", 10))
+    q.close()
+    # torn write at the tail: no newline yet — must NOT be consumed
+    files = [n for n in os.listdir(spool) if n.endswith(".ndjson")]
+    with open(os.path.join(spool, files[0]), "a", encoding="utf-8") as f:
+        f.write('{"tsns": 11, "directory": "/d"')
+    inp = FileQueueInput(spool)
+    got = [e.new_entry.full_path for e in iter_queue(inp, idle_timeout=0.2)]
+    assert got == ["/d/whole"]
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    from cluster_util import Cluster
+    c = Cluster(n_volume_servers=1)
+    yield c
+    c.shutdown()
+
+
+def test_broker_queue_feeds_replicator(cluster, tmp_path_factory):
+    """Kafka-class path end-to-end: filer events published to the
+    messaging broker (notification BrokerQueue), consumed by
+    BrokerQueueInput, applied to a local sink."""
+    from cluster_util import free_port
+
+    from seaweedfs_tpu.messaging.broker import BrokerServer
+    from seaweedfs_tpu.notification.queues import BrokerQueue
+    from seaweedfs_tpu.replication.replicator import (Replicator,
+                                                      run_from_queue)
+    from seaweedfs_tpu.replication.sink import LocalSink
+
+    tmp = tmp_path_factory.mktemp("qrepl")
+    port = free_port()
+    b = BrokerServer()
+    cluster.runners.append(cluster.serve(b.app, port))
+    broker_url = f"127.0.0.1:{port}"
+
+    outbound = BrokerQueue([broker_url], ack="memory")
+    for i in range(4):
+        outbound.notify(_event(f"/q/file{i}", 1000 + i))
+
+    sink_dir = str(tmp / "sink")
+    sink = LocalSink(sink_dir)
+    # source filer "" : LocalSink applies metadata without fetching chunk
+    # data when the entry has no reachable chunks; use empty-chunk events
+    r = Replicator("127.0.0.1:1", sink, "/q")
+    inp = BrokerQueueInput([broker_url],
+                           position_path=str(tmp / "pos.json"))
+
+    applied = run_from_queue(
+        r, _only_meta(inp), idle_timeout=0.5)
+    assert applied == 4
+    # consume position persisted: nothing replays
+    inp2 = BrokerQueueInput([broker_url],
+                            position_path=str(tmp / "pos.json"))
+    assert run_from_queue(r, _only_meta(inp2), idle_timeout=0.5) == 0
+
+
+def _only_meta(inp):
+    """Wrap an input so events apply as metadata-only (no chunk fetch) —
+    the events in this test carry unreachable chunks on purpose."""
+    class W:
+        def receive(self, timeout=1.0):
+            ev = inp.receive(timeout)
+            if ev is not None and ev.new_entry is not None:
+                ev.new_entry.chunks = []
+            return ev
+
+        def ack(self):
+            inp.ack()
+    return W()
