@@ -497,7 +497,8 @@ def cmd_webdav(args) -> None:
 
 def cmd_msg_broker(args) -> None:
     from .messaging.broker import run_broker
-    _run_forever(run_broker(args.ip, args.port, filer_url=args.filer))
+    _run_forever(run_broker(args.ip, args.port, filer_url=args.filer,
+                            tls=_load_tls()))
 
 
 def cmd_scaffold(args) -> None:

@@ -65,9 +65,13 @@ class ErasureCoder:
         """Block until a handle from encode_async/rec_apply_async is real."""
         return np.asarray(handle)
 
-    def encode_digest_async(self, data: np.ndarray):
+    def encode_digest_async(self, data: np.ndarray, acc=None):
         """Dispatch encode + on-device parity digest; handle materializes to
-        [m] uint32 — per parity row, the wrapping byte sum mod 2^32.
+        [m] uint32 — per parity row, the wrapping byte sum mod 2^32,
+        folded into `acc` when given (so a streaming caller chains ONE
+        executable per batch instead of alternating digest and add
+        programs — remote backends pipeline repeated launches of the same
+        executable far better).
 
         Device backends fuse the reduction into the encode jit so only 4*m
         bytes ever cross device->host: the link-independent sink the
@@ -77,7 +81,10 @@ class ErasureCoder:
         and zero-padding contributes nothing (parity of zeros is zeros).
         """
         parity = self.encode(data)
-        return np.sum(parity, axis=1, dtype=np.uint32)
+        digest = np.sum(parity, axis=1, dtype=np.uint32)
+        if acc is not None:
+            digest = (np.asarray(acc, dtype=np.uint32) + digest)
+        return digest
 
     def reconstruct(self, shards: Sequence[Optional[np.ndarray]],
                     data_only: bool = False,
@@ -137,15 +144,17 @@ class NumpyCoder(ErasureCoder):
 
 
 def _fused_digest(encode_fn):
-    """jit(encode -> per-row uint32 byte sum): parity stays on device, the
-    4*m-byte digest is all that materializes."""
+    """jit((data, acc) -> acc + per-row uint32 byte sum): parity stays on
+    device and the running digest accumulates inside the SAME executable,
+    so a streaming caller repeats one program per batch."""
     import jax
     import jax.numpy as jnp
 
     @jax.jit
-    def fn(data):
+    def fn(data, acc):
         parity = encode_fn(data)
-        return jnp.sum(parity.astype(jnp.uint32), axis=1, dtype=jnp.uint32)
+        return acc + jnp.sum(parity.astype(jnp.uint32), axis=1,
+                             dtype=jnp.uint32)
 
     return fn
 
@@ -177,14 +186,17 @@ class JaxCoder(ErasureCoder):
         return lambda survivors: fn(
             jax.device_put(np.asarray(survivors, dtype=np.uint8)))
 
-    def encode_digest_async(self, data: np.ndarray):
+    def encode_digest_async(self, data: np.ndarray, acc=None):
         import jax
+        import jax.numpy as jnp
         fn = getattr(self, "_digest_fn", None)
         if fn is None:
             fn = self._digest_fn = _fused_digest(
                 lambda d: rs_jax.encode_parity(d, self.m,
                                                method=self.method))
-        return fn(jax.device_put(np.asarray(data, dtype=np.uint8)))
+        if acc is None:
+            acc = jnp.zeros(self.m, dtype=jnp.uint32)
+        return fn(jax.device_put(np.asarray(data, dtype=np.uint8)), acc)
 
 
 class PallasCoder(ErasureCoder):
@@ -265,16 +277,19 @@ class PallasCoder(ErasureCoder):
 
         return run
 
-    def encode_digest_async(self, data: np.ndarray):
+    def encode_digest_async(self, data: np.ndarray, acc=None):
         import jax
+        import jax.numpy as jnp
         d = jax.device_put(np.asarray(data, dtype=np.uint8))
+        if acc is None:
+            acc = jnp.zeros(self.m, dtype=jnp.uint32)
         while True:
             try:
                 fn = self._digest_cache.get(self._tile)
                 if fn is None:
                     fn = _fused_digest(self._encode)
                     self._digest_cache[self._tile] = fn
-                return fn(d)
+                return fn(d, acc)
             except Exception:
                 self._shrink_tile()
 

@@ -163,7 +163,11 @@ def _encode_batches(pool: ThreadPoolExecutor, dat_fd: int, dat_size: int,
 
 def _run_pipeline(batches: Iterator[np.ndarray], dispatch, consume,
                   depth: int, start_d2h: bool = True) -> None:
-    """reader thread -> main dispatch -> materializer thread."""
+    """reader thread -> main dispatch -> materializer thread.
+
+    consume=None runs without the materializer stage entirely (sink mode:
+    dispatch chains its own on-device state and nothing blocks per
+    batch)."""
     read_q: queue.Queue = queue.Queue(maxsize=depth)
     mat_q: queue.Queue = queue.Queue(maxsize=depth)
     errors: list[BaseException] = []
@@ -190,9 +194,11 @@ def _run_pipeline(batches: Iterator[np.ndarray], dispatch, consume,
                 pass
 
     reader = threading.Thread(target=reader_main, daemon=True)
-    mat = threading.Thread(target=mat_main, daemon=True)
+    mat = None
+    if consume is not None:
+        mat = threading.Thread(target=mat_main, daemon=True)
+        mat.start()
     reader.start()
-    mat.start()
     drained = False
     try:
         while True:
@@ -213,15 +219,18 @@ def _run_pipeline(batches: Iterator[np.ndarray], dispatch, consume,
                     start_async()
                 except Exception:
                     pass
-            mat_q.put((batch, handle))
+            if mat is not None:
+                mat_q.put((batch, handle))
     finally:
-        mat_q.put(_SENTINEL)
+        if mat is not None:
+            mat_q.put(_SENTINEL)
         # drain read_q so a reader blocked on a full queue can finish
         # (otherwise a dispatch() exception would deadlock reader.join())
         while not drained and read_q.get() is not _SENTINEL:
             pass
         reader.join()
-        mat.join()
+        if mat is not None:
+            mat.join()
     if errors:
         raise errors[0]
 
@@ -284,19 +293,20 @@ def stream_encode_device_sink(base_file_name: str, coder: ErasureCoder,
     dat_fd = os.open(base_file_name + ".dat", os.O_RDONLY)
     acc = None
 
-    def consume(data: np.ndarray, handle) -> None:
-        # combine ON DEVICE (uint32 + wraps on both numpy and jax): a
-        # per-batch materialize would pay the device->host round-trip
-        # latency every batch — seconds each on tunneled dev links
+    def dispatch(batch: np.ndarray):
+        # the running digest accumulates INSIDE the digest executable
+        # (coder.encode_digest_async(data, acc)): one program repeated per
+        # batch, nothing materialized until the end — per-batch D2H or
+        # program alternation costs seconds each on tunneled dev links
         nonlocal acc
-        acc = handle if acc is None else acc + handle
+        acc = coder.encode_digest_async(batch, acc)
+        return acc
 
     try:
         with ThreadPoolExecutor(max_workers=_READ_POOL_WORKERS) as pool:
             _run_pipeline(
                 _encode_batches(pool, dat_fd, dat_size, g, batch_size),
-                coder.encode_digest_async, consume, depth,
-                start_d2h=False)
+                dispatch, None, depth, start_d2h=False)
     finally:
         os.close(dat_fd)
     if acc is None:

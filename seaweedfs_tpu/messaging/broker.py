@@ -145,7 +145,7 @@ class FilerSegmentStore:
 
 class BrokerServer:
     def __init__(self, filer_url: str = "", advertise_url: str = "",
-                 register: bool = False):
+                 register: bool = False, grpc_port: int = 0, tls=None):
         self.persist = FilerSegmentStore(filer_url) if filer_url else None
         self.filer_url = filer_url
         self.advertise_url = advertise_url
@@ -154,6 +154,10 @@ class BrokerServer:
         self.peer_brokers: list[str] = (
             [advertise_url] if advertise_url else [])
         self.partitions: dict[tuple[str, str, int], TopicPartition] = {}
+        self.topic_configs: dict[tuple[str, str], int] = {}
+        self.grpc_port = grpc_port
+        self.tls = tls
+        self._grpc_server = None
         self._session: Optional[aiohttp.ClientSession] = None
         self._register_task: Optional[asyncio.Task] = None
         self._poll_task: Optional[asyncio.Task] = None
@@ -176,11 +180,19 @@ class BrokerServer:
 
     async def _on_startup(self, app) -> None:
         self._session = aiohttp.ClientSession()
+        if self.grpc_port:
+            from .broker_grpc import serve_messaging_grpc
+            host = (self.advertise_url.rsplit(":", 1)[0]
+                    if self.advertise_url else "127.0.0.1")
+            self._grpc_server = await serve_messaging_grpc(
+                self, host, self.grpc_port, tls=self.tls)
         if self.register:
             self._register_task = asyncio.create_task(self._register_loop())
             self._poll_task = asyncio.create_task(self._poll_brokers_loop())
 
     async def _on_cleanup(self, app) -> None:
+        if self._grpc_server is not None:
+            await self._grpc_server.stop(grace=0.5)
         for task in (self._register_task, self._poll_task):
             if task:
                 task.cancel()
@@ -349,10 +361,14 @@ async def run_broker(host: str, port: int, filer_url: str = "",
                      **kwargs) -> web.AppRunner:
     kwargs.setdefault("advertise_url", f"{host}:{port}")
     kwargs.setdefault("register", bool(filer_url))
+    kwargs.setdefault("grpc_port", port + 10000)
     server = BrokerServer(filer_url=filer_url, **kwargs)
     runner = web.AppRunner(server.app, access_log=None)
     await runner.setup()
-    site = web.TCPSite(runner, host, port)
+    tls = kwargs.get("tls")
+    site = web.TCPSite(runner, host, port,
+                       ssl_context=(tls.server_ssl_context()
+                                    if tls is not None else None))
     await site.start()
     log.info("msg broker on %s:%d (filer=%s)", host, port, filer_url or "-")
     return runner

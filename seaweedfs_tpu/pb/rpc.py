@@ -5,11 +5,11 @@ generated *_pb2_grpc stubs each service is registered through gRPC's
 generic-handler API and clients use multicallables with explicit
 serializers — byte-identical on the wire to what generated stubs produce.
 
-Three services (parity with the reference's 4 proto files; messaging rides
-the broker's own surface):
-  seaweedfs_tpu.master.Master        proto/master.proto      (13 RPCs)
-  seaweedfs_tpu.volume.VolumeServer  proto/volume_server.proto (31 RPCs)
-  seaweedfs_tpu.filer.SeaweedFiler   proto/filer.proto       (19 RPCs)
+Four services (parity with the reference's 4 proto files):
+  seaweedfs_tpu.master.Master             proto/master.proto        (13 RPCs)
+  seaweedfs_tpu.volume.VolumeServer       proto/volume_server.proto (31 RPCs)
+  seaweedfs_tpu.filer.SeaweedFiler        proto/filer.proto         (19 RPCs)
+  seaweedfs_tpu.messaging.SeaweedMessaging proto/messaging.proto    (6 RPCs)
 
 Port convention: gRPC listens on HTTP port + 10000
 (weed/pb/grpc_client_server.go).
@@ -21,6 +21,7 @@ import grpc
 
 from . import filer_pb2 as fpb
 from . import master_pb2 as mpb
+from . import messaging_pb2 as msgpb
 from . import volume_server_pb2 as vpb
 
 GRPC_PORT_OFFSET = 10000
@@ -28,6 +29,7 @@ GRPC_PORT_OFFSET = 10000
 MASTER_SERVICE = "seaweedfs_tpu.master.Master"
 VOLUME_SERVICE = "seaweedfs_tpu.volume.VolumeServer"
 FILER_SERVICE = "seaweedfs_tpu.filer.SeaweedFiler"
+MESSAGING_SERVICE = "seaweedfs_tpu.messaging.SeaweedMessaging"
 
 # back-compat alias (pre-round-3 callers)
 SERVICE = MASTER_SERVICE
@@ -271,9 +273,32 @@ class VolumeServerStub(_SpecStub):
         super().__init__(channel, VOLUME_SERVICE, VOLUME_SPEC)
 
 
+MESSAGING_SPEC = {
+    "Subscribe": ("ss", msgpb.SubscriberMessage, msgpb.BrokerMessage),
+    "Publish": ("ss", msgpb.PublishRequest, msgpb.PublishResponse),
+    "DeleteTopic": ("uu", msgpb.DeleteTopicRequest,
+                    msgpb.DeleteTopicResponse),
+    "ConfigureTopic": ("uu", msgpb.ConfigureTopicRequest,
+                       msgpb.ConfigureTopicResponse),
+    "GetTopicConfiguration": ("uu", msgpb.GetTopicConfigurationRequest,
+                              msgpb.GetTopicConfigurationResponse),
+    "FindBroker": ("uu", msgpb.FindBrokerRequest, msgpb.FindBrokerResponse),
+}
+
+
 class FilerStub(_SpecStub):
     def __init__(self, channel):
         super().__init__(channel, FILER_SERVICE, FILER_SPEC)
+
+
+class MessagingStub(_SpecStub):
+    def __init__(self, channel):
+        super().__init__(channel, MESSAGING_SERVICE, MESSAGING_SPEC)
+
+
+def messaging_service_handler(servicer, guard=None) -> grpc.GenericRpcHandler:
+    return service_handler(MESSAGING_SERVICE, MESSAGING_SPEC, servicer,
+                           guard)
 
 
 def master_service_handler(servicer, guard=None) -> grpc.GenericRpcHandler:

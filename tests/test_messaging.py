@@ -244,6 +244,84 @@ def test_broker_failover_on_death(cluster, broker_pair):
     assert values == [b"before-death", b"after-death"]
 
 
+def test_messaging_grpc_service(cluster):
+    """The 4th proto service (proto/messaging.proto): Publish/Subscribe
+    bidi streams, topic configuration, FindBroker."""
+    import queue as queue_mod
+
+    import grpc
+
+    from cluster_util import free_port_with_grpc_twin
+
+    from seaweedfs_tpu.messaging.broker import BrokerServer
+    from seaweedfs_tpu.pb import messaging_pb2 as mpb
+    from seaweedfs_tpu.pb.rpc import MessagingStub
+
+    port = free_port_with_grpc_twin()
+    b = BrokerServer(grpc_port=port + 10000,
+                     advertise_url=f"127.0.0.1:{port}")
+    cluster.runners.append(cluster.serve(b.app, port))
+
+    ch = grpc.insecure_channel(f"127.0.0.1:{port + 10000}")
+    stub = MessagingStub(ch)
+
+    # configure + read back
+    stub.ConfigureTopic(mpb.ConfigureTopicRequest(
+        namespace="g", topic="t",
+        configuration=mpb.TopicConfiguration(partition_count=8)),
+        timeout=10)
+    got = stub.GetTopicConfiguration(
+        mpb.GetTopicConfigurationRequest(namespace="g", topic="t"),
+        timeout=10)
+    assert got.configuration.partition_count == 8
+
+    # publish a stream of messages
+    def pubs():
+        yield mpb.PublishRequest(init=mpb.PublishRequest.InitMessage(
+            namespace="g", topic="t", partition=0))
+        for i in range(5):
+            yield mpb.PublishRequest(data=mpb.Message(
+                key=b"k", value=f"v{i}".encode()))
+
+    acks = list(stub.Publish(pubs(), timeout=15))
+    assert len(acks) == 5 and all(a.ack_ts_ns > 0 for a in acks)
+
+    # subscribe from EARLIEST replays them, then tails a live message
+    req_q: "queue_mod.Queue" = queue_mod.Queue()
+    req_q.put(mpb.SubscriberMessage(
+        init=mpb.SubscriberMessage.InitMessage(
+            namespace="g", topic="t", partition=0,
+            start_position=mpb.SubscriberMessage.InitMessage.EARLIEST)))
+
+    def reqs():
+        while True:
+            item = req_q.get()
+            if item is None:
+                return
+            yield item
+
+    stream = stub.Subscribe(reqs(), timeout=20)
+    values = []
+    for msg in stream:
+        values.append(msg.data.value)
+        if len(values) == 5:
+            break
+    assert values == [f"v{i}".encode() for i in range(5)]
+    stream.cancel()
+
+    # FindBroker answers the rendezvous owner (single broker: itself)
+    fb = stub.FindBroker(mpb.FindBrokerRequest(
+        namespace="g", topic="t", partition=3), timeout=10)
+    assert fb.broker == f"127.0.0.1:{port}"
+
+    # DeleteTopic clears partitions and configuration
+    stub.DeleteTopic(mpb.DeleteTopicRequest(namespace="g", topic="t"),
+                     timeout=10)
+    assert not [k for k in b.partitions if k[0] == "g"]
+    assert ("g", "t") not in b.topic_configs
+    ch.close()
+
+
 def test_segments_persist_to_filer_and_replay(cluster):
     filer = cluster.add_filer()
     b = _add_broker(cluster, filer_url=filer.url)
