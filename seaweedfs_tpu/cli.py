@@ -56,6 +56,16 @@ def cmd_master(args) -> None:
     from .server.master import run_master
     url = f"{args.ip}:{args.port}"
     peers = [p.strip() for p in args.peers.split(",") if p.strip()]
+    sequencer = None
+    if args.sequencer_kv:
+        # external atomic-counter sequencer (etcd_sequencer.go role):
+        # redis-protocol INCRBY key-range leases
+        from .topology.sequence import KvSequencer
+        host, _, port = args.sequencer_kv.rpartition(":")
+        if not host or not port.isdigit():
+            raise SystemExit(
+                f"-sequencer_kv must be host:port, got {args.sequencer_kv!r}")
+        sequencer = KvSequencer(host, int(port))
     _run_forever(run_master(
         args.ip, args.port,
         volume_size_limit_mb=args.volume_size_limit_mb,
@@ -65,6 +75,7 @@ def cmd_master(args) -> None:
         tls=_load_tls(),
         url=url,
         peers=peers or None,
+        sequencer=sequencer,
         raft_state_dir=args.mdir or None,
         grpc_port=(args.port + 10000 if args.grpc_port < 0
                    else args.grpc_port)))
@@ -540,6 +551,10 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("-peers", default="",
                    help="comma-separated ip:port of ALL masters (incl. self)"
                         " for raft HA (weed master -peers)")
+    m.add_argument("-sequencer_kv", default="",
+                   help="host:port of a redis-protocol KV; file keys are "
+                        "leased from its atomic counter (etcd-sequencer "
+                        "role) instead of the in-memory sequencer")
     m.add_argument("-mdir", default="",
                    help="directory for persisted raft state")
     m.add_argument("-pulse", type=float, default=5.0,

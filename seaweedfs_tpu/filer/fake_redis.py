@@ -4,7 +4,8 @@ The environment cannot host a real redis, so the non-SQL distributed
 store plugin (filer/redis_store.py, the reference's
 weed/filer/redis/universal_redis_store.go model) is proven against this
 fake: a threaded socket server speaking enough RESP2 for the store's
-command set (GET/SET/DEL/EXISTS/SADD/SREM/SMEMBERS/PING/FLUSHALL).
+command set (GET/SET/DEL/EXISTS/SADD/SREM/SMEMBERS/INCRBY/PING/
+FLUSHALL).
 Single-process, in-memory, thread-safe — the contract surface matters,
 not the persistence.
 """
@@ -141,6 +142,11 @@ class FakeRedisServer:
                 return _encode(before - len(s))
             if name == "SMEMBERS":
                 return _encode(self._sets.get(args[0], set()))
+            if name == "INCRBY":
+                cur = int(self._strings.get(args[0], b"0"))
+                cur += int(args[1])
+                self._strings[args[0]] = str(cur).encode()
+                return _encode(cur)
             if name == "FLUSHALL":
                 self._strings.clear()
                 self._sets.clear()

@@ -57,11 +57,15 @@ class MasterServer:
                  election_timeout: tuple[float, float] = (0.3, 0.6),
                  raft_heartbeat: float = 0.1,
                  grpc_port: int = 0,
-                 tls=None):
+                 tls=None,
+                 sequencer=None):
         self.topology = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds)
-        self.sequencer = MemorySequencer()
+        # sequencer=None -> in-memory with the raft-replicated ceiling;
+        # an external KvSequencer (etcd_sequencer.go role) plugs in for
+        # raft-less multi-master deployments
+        self.sequencer = sequencer or MemorySequencer()
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
         self.vacuum_interval_seconds = vacuum_interval_seconds
@@ -344,7 +348,12 @@ class MasterServer:
         if picked is None:
             return {"error": "no writable volumes"}, 500
         vid, nodes = picked
-        key = self.sequencer.next_file_id(count)
+        if getattr(self.sequencer, "blocking", False):
+            # KV-backed sequencers do socket round trips: never on the loop
+            key = await asyncio.get_event_loop().run_in_executor(
+                None, self.sequencer.next_file_id, count)
+        else:
+            key = self.sequencer.next_file_id(count)
         # never hand out keys beyond the raft-committed ceiling: a failover
         # before the bound advances could otherwise re-mint the same keys
         if key + count > self._key_bound:
@@ -699,7 +708,12 @@ class MasterServer:
             max_volume_count=body.get("max_volume_count", 8),
             payload=body,
         )
-        self.sequencer.set_max(body.get("max_file_key", 0))
+        seen_key = body.get("max_file_key", 0)
+        if getattr(self.sequencer, "blocking", False):
+            asyncio.get_event_loop().run_in_executor(
+                None, self.sequencer.set_max, seen_key)
+        else:
+            self.sequencer.set_max(seen_key)
         self._broadcast_location(event)
         for ev in self.topology.prune_dead_nodes():
             self._broadcast_location(ev)

@@ -7,6 +7,8 @@ import threading
 
 
 class MemorySequencer:
+    blocking = False  # safe to call on an event loop
+
     def __init__(self, start: int = 1):
         self._next = max(start, 1)
         self._lock = threading.Lock()
@@ -25,3 +27,77 @@ class MemorySequencer:
 
     def peek(self) -> int:
         return self._next
+
+
+class KvSequencer:
+    """External-KV-backed sequencer — role of the reference's
+    EtcdSequencer (weed/sequence/etcd_sequencer.go): key ranges are
+    batch-leased from a shared atomic counter (redis-protocol INCRBY
+    here, etcd transactions there), so multiple masters WITHOUT raft can
+    still mint globally unique file keys. The local range
+    [current, lease_end) serves allocations; when it runs dry the next
+    batch is leased in one KV round trip.
+    """
+
+    BATCH = 500  # DefaultEtcdSteps in the reference
+    blocking = True  # KV round trips: callers on an event loop must
+    #                  offload to an executor
+
+    def __init__(self, host: str, port: int,
+                 key: str = "master/sequence", batch: int = 0):
+        self._addr = (host, port)
+        self._client = None
+        self._key = key
+        self._batch = batch or self.BATCH
+        self._lock = threading.Lock()
+        self._current = 0
+        self._lease_end = 0
+
+    def _cmd(self, *parts):
+        """One KV command with reconnect-on-broken-socket: a KV restart
+        or idle TCP reset must not wedge fid minting forever."""
+        from ..filer.redis_store import _RespClient
+        for attempt in (0, 1):
+            try:
+                if self._client is None:
+                    self._client = _RespClient(*self._addr)
+                return self._client.command(*parts)
+            except (ConnectionError, OSError):
+                if self._client is not None:
+                    self._client.close()
+                self._client = None
+                if attempt:
+                    raise
+
+    def _lease(self, at_least: int = 1) -> None:
+        step = max(self._batch, at_least)
+        end = int(self._cmd("INCRBY", self._key, step))
+        self._current = end - step + 1
+        self._lease_end = end + 1
+
+    def next_file_id(self, count: int = 1) -> int:
+        with self._lock:
+            if self._current + count > self._lease_end:
+                self._lease(count)
+            first = self._current
+            self._current += count
+            return first
+
+    def set_max(self, seen: int) -> None:
+        """Ensure no FUTURE lease can mint at or below an externally
+        observed key (cold start against a reset KV counter). The current
+        local lease stays: leased ranges are disjoint by construction, so
+        its ids are globally unique regardless of `seen` — abandoning it
+        on every heartbeat crossing would churn a KV round trip and burn
+        a batch of ids per crossing."""
+        with self._lock:
+            if seen < self._lease_end:
+                if seen >= self._current:
+                    self._current = seen + 1
+                return
+            cur = int(self._cmd("GET", self._key) or b"0")
+            if seen > cur:
+                self._cmd("INCRBY", self._key, seen - cur)
+
+    def peek(self) -> int:
+        return self._current

@@ -219,3 +219,31 @@ def test_webhook_notification_queue(tmp_path):
     while time.time() < deadline and not spool.exists():
         time.sleep(0.1)
     assert spool.exists() and "/x" in spool.read_text()
+
+
+def test_kv_sequencer_unique_across_instances():
+    """KvSequencer (etcd_sequencer.go role): two masters leasing key
+    ranges from one shared atomic counter never mint the same id."""
+    from seaweedfs_tpu.filer.fake_redis import FakeRedisServer
+    from seaweedfs_tpu.topology.sequence import KvSequencer
+
+    with FakeRedisServer() as (host, port):
+        a = KvSequencer(host, port, batch=10)
+        b = KvSequencer(host, port, batch=10)
+        seen = set()
+        for _ in range(100):
+            first = a.next_file_id(3)
+            seen.update(range(first, first + 3))
+            other = b.next_file_id(2)
+            seen.update(range(other, other + 2))
+        assert len(seen) == 500  # all unique across both sequencers
+
+        # set_max pushes the shared counter past the observed key, so
+        # every FUTURE lease (any instance) mints above it; the current
+        # leases stay valid (disjoint ranges are unique regardless)
+        a.set_max(10_000)
+        for _ in range(30):  # exhaust both stale leases
+            last_a = a.next_file_id()
+            last_b = b.next_file_id()
+        assert last_a > 10_000 and last_b > 10_000
+        assert last_a != last_b
