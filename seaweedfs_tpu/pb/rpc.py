@@ -7,7 +7,7 @@ serializers — byte-identical on the wire to what generated stubs produce.
 
 Four services (parity with the reference's 4 proto files):
   seaweedfs_tpu.master.Master             proto/master.proto        (13 RPCs)
-  seaweedfs_tpu.volume.VolumeServer       proto/volume_server.proto (31 RPCs)
+  seaweedfs_tpu.volume.VolumeServer       proto/volume_server.proto (33 RPCs)
   seaweedfs_tpu.filer.SeaweedFiler        proto/filer.proto         (19 RPCs)
   seaweedfs_tpu.messaging.SeaweedMessaging proto/messaging.proto    (6 RPCs)
 
@@ -131,7 +131,10 @@ VOLUME_SPEC = {
                              vpb.VolumeFileStatusResponse),
     "CopyFile": ("us", vpb.CopyFileRequest, vpb.DataChunk),
     "VolumeTail": ("us", vpb.TailRequest, vpb.DataChunk),
+    "VolumeTailSender": ("us", vpb.TailRequest, vpb.DataChunk),
     "VolumeTailReceiver": ("uu", vpb.TailReceiverRequest, vpb.Ok),
+    "VolumeSyncStatus": ("uu", vpb.VolumeRef,
+                         vpb.VolumeSyncStatusResponse),
     "VolumeIncrementalCopy": ("us", vpb.TailRequest, vpb.DataChunk),
     "VolumeEcShardsGenerate": ("uu", vpb.EcGenerateRequest, vpb.Ok),
     "VolumeEcShardsRebuild": ("uu", vpb.EcRebuildRequest,

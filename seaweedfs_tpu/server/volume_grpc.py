@@ -284,6 +284,29 @@ class VolumeGrpcServicer:
         async for chunk in self.VolumeTail(request, context):
             yield chunk
 
+    async def VolumeTailSender(self, request: pb.TailRequest, context):
+        """Reference name for the tail stream (volume_grpc_tail.go
+        VolumeTailSender); identical semantics to VolumeTail."""
+        async for chunk in self.VolumeTail(request, context):
+            yield chunk
+
+    async def VolumeSyncStatus(self, request: pb.VolumeRef, context):
+        """Tail offset + compaction revision for incremental sync
+        (VolumeSyncStatus, volume_grpc_sync.go)."""
+        import os as _os
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            return pb.VolumeSyncStatusResponse(error="volume not found")
+        idx_path = v.base_file_name() + ".idx"
+        idx_size = (_os.path.getsize(idx_path)
+                    if _os.path.exists(idx_path) else 0)
+        return pb.VolumeSyncStatusResponse(
+            volume_id=request.volume_id,
+            collection=v.collection,
+            tail_offset=v.data_file_size(),
+            compact_revision=v.super_block.compaction_revision,
+            idx_file_size=idx_size)
+
     async def VolumeTailReceiver(self, request: pb.TailReceiverRequest,
                                  context):
         """Pull new needle records from the source and append them
