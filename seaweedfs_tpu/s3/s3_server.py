@@ -451,7 +451,9 @@ class S3Server:
         ET.SubElement(root, "IsTruncated").text = \
             "true" if truncated else "false"
         if v2:
-            ET.SubElement(root, "KeyCount").text = str(len(contents))
+            # KeyCount includes CommonPrefixes (AWS ListObjectsV2 docs)
+            ET.SubElement(root, "KeyCount").text = \
+                str(len(contents) + len(common_prefixes))
             if truncated:
                 ET.SubElement(root, "NextContinuationToken").text = \
                     next_marker
@@ -491,7 +493,25 @@ class S3Server:
         base = f"{BUCKETS_DIR}/{bucket}"
         contents: list[tuple[str, dict]] = []
         common: set[str] = set()
-        state = {"truncated": False}
+        state = {"truncated": False, "last": ""}
+
+        def add_common(p: str) -> bool:
+            """Fold into a CommonPrefix; counts toward max-keys like S3."""
+            if p in common:
+                return True
+            if marker and p <= marker \
+                    and not (marker.startswith(p) and marker != p):
+                # already returned as the last item of a previous page —
+                # but a marker strictly INSIDE p's subtree (client-supplied
+                # marker / start-after) means keys past it still roll up
+                # into p, so p must be emitted (AWS semantics)
+                return True
+            if len(contents) + len(common) >= max_keys:
+                state["truncated"] = True
+                return False
+            common.add(p)
+            state["last"] = p
+            return True
 
         async def emit(eff: str, is_dir: bool, e: dict) -> bool:
             """One child in effective-key order; False = stop the walk."""
@@ -508,13 +528,15 @@ class S3Server:
                         and delimiter in eff[len(prefix):-1]):
                     # every key below folds into one CommonPrefix
                     cut = eff[len(prefix):].index(delimiter)
-                    common.add(eff[:len(prefix) + cut + 1])
-                    return True
+                    return add_common(eff[:len(prefix) + cut + 1])
                 if delimiter and delimiter == "/" \
-                        and eff.startswith(prefix):
-                    # the subtree itself is the common prefix
-                    common.add(eff)
-                    return True
+                        and eff.startswith(prefix) \
+                        and len(eff) > len(prefix):
+                    # the subtree itself is the common prefix — but only
+                    # when strictly deeper than the prefix; a directory
+                    # whose key EQUALS the prefix (prefix="photos/") must
+                    # be walked so its children are listed
+                    return add_common(eff)
                 return await walk(e["path"], eff)
             key = eff
             if prefix and not key.startswith(prefix):
@@ -523,12 +545,12 @@ class S3Server:
                 return True
             if delimiter and delimiter in key[len(prefix):]:
                 cut = key[len(prefix):].index(delimiter)
-                common.add(key[:len(prefix) + cut + 1])
-                return True
-            if len(contents) >= max_keys:
+                return add_common(key[:len(prefix) + cut + 1])
+            if len(contents) + len(common) >= max_keys:
                 state["truncated"] = True
                 return False
             contents.append((key, e))
+            state["last"] = key
             return True
 
         async def walk(dir_path: str, key_prefix: str) -> bool:
@@ -593,8 +615,10 @@ class S3Server:
                 include_start = "false"
 
         await walk(base, "")
-        next_marker = contents[-1][0] if state["truncated"] and contents \
-            else ""
+        # NextMarker must be the LAST emitted item — content key OR common
+        # prefix — or common prefixes sorting after the last key would be
+        # re-emitted on the next page
+        next_marker = state["last"] if state["truncated"] else ""
         return contents, common, state["truncated"], next_marker
 
     # --- tagging (s3api_object_tagging_handlers.go; tags live in the

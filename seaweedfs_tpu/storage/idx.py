@@ -7,7 +7,9 @@ offset of the tombstone needle that recorded the delete in the .dat file.
 
 Default entries are 16 bytes (4-byte offsets, 32GB volumes). Large volumes
 (superblock offset_size == 5, reference offset_5bytes.go) use 17-byte
-entries with a 40-bit big-endian offset; every function takes the width.
+entries whose offset matches the reference 5BytesOffset byte layout: low
+32 bits big-endian in the first 4 bytes, high byte last
+(offset_5bytes.go:18-24); every function takes the width.
 """
 
 from __future__ import annotations

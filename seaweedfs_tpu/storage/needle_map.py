@@ -57,9 +57,12 @@ class NeedleMap:
             self.maximum_key = max(self.maximum_key, key)
             if offset > 0 and size != t.TOMBSTONE_FILE_SIZE:
                 existing = self._map.get(key)
-                if existing is not None:
+                # a put over a TOMBSTONE is not a deletion — only a live
+                # overwrite orphans bytes (matches put() and the
+                # reference's oldSize.IsValid() check)
+                if existing is not None and existing.size > 0:
                     self.deleted_count += 1
-                    self.deleted_byte_count += max(existing.size, 0)
+                    self.deleted_byte_count += existing.size
                 self._map[key] = NeedleValue(key, offset, size)
                 self.file_count += 1
                 self.file_byte_count += max(size, 0)
@@ -187,9 +190,10 @@ class CompactNeedleMap(NeedleMap):
             self.maximum_key = max(self.maximum_key, key)
             if offset > 0 and size != t.TOMBSTONE_FILE_SIZE:
                 existing = self._store_get(key)
-                if existing is not None:
+                # put-over-tombstone is not a deletion (see NeedleMap._load)
+                if existing is not None and existing.size > 0:
                     self.deleted_count += 1
-                    self.deleted_byte_count += max(existing.size, 0)
+                    self.deleted_byte_count += existing.size
                 self._store_set(NeedleValue(key, offset, size))
                 self.file_count += 1
                 self.file_byte_count += max(size, 0)
@@ -517,9 +521,10 @@ class DiskNeedleMap(NeedleMap):
         self.maximum_key = max(self.maximum_key, key)
         if offset > 0 and size != t.TOMBSTONE_FILE_SIZE:
             existing = self._lookup(key)
-            if existing is not None:
+            # put-over-tombstone is not a deletion (see NeedleMap._load)
+            if existing is not None and existing.size > 0:
                 self.deleted_count += 1
-                self.deleted_byte_count += max(existing.size, 0)
+                self.deleted_byte_count += existing.size
             self._map[key] = NeedleValue(key, offset, size)
             self.file_count += 1
             self.file_byte_count += max(size, 0)

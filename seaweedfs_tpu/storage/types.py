@@ -37,11 +37,22 @@ def max_volume_size(offset_size: int = OFFSET_SIZE) -> int:
 
 
 def put_offset(stored: int, offset_size: int = OFFSET_SIZE) -> bytes:
+    if offset_size == OFFSET_SIZE_LARGE:
+        # reference 5BytesOffset layout (offset_5bytes.go:18-24): the low
+        # 32 bits big-endian in bytes[0:4], the high byte at bytes[4] —
+        # keeps large-volume .idx/.ecx files byte-compatible
+        if not 0 <= stored < (1 << 40):
+            raise OverflowError(
+                f"stored offset {stored} exceeds 40-bit addressing")
+        return (stored & 0xFFFFFFFF).to_bytes(4, "big") \
+            + bytes([stored >> 32])
     return stored.to_bytes(offset_size, "big")
 
 
 def get_offset(b: bytes, off: int = 0,
                offset_size: int = OFFSET_SIZE) -> int:
+    if offset_size == OFFSET_SIZE_LARGE:
+        return int.from_bytes(b[off:off + 4], "big") | (b[off + 4] << 32)
     return int.from_bytes(b[off:off + offset_size], "big")
 
 VERSION1 = 1

@@ -64,6 +64,34 @@ def test_compact_map_differential_vs_dict_map():
     assert visits_a == visits_b
 
 
+@pytest.mark.parametrize("kind", ["dict", "compact", "disk"])
+def test_put_delete_put_replay_counts_one_live(tmp_path, kind):
+    """Replaying a put->delete->put journal must count ONE live needle:
+    a put over a tombstone is not a deletion (reference guards with
+    oldSize.IsValid(), needle_map_metric.go)."""
+    from seaweedfs_tpu.storage.needle_map import DiskNeedleMap
+
+    def make(path):
+        if kind == "dict":
+            return NeedleMap(path)
+        if kind == "compact":
+            return CompactNeedleMap(path)
+        return DiskNeedleMap(path)
+
+    path = str(tmp_path / f"{kind}.idx")
+    nm = make(path)
+    nm.put(7, 8, 100)
+    nm.delete(7)
+    nm.put(7, 16, 120)
+    assert len(nm) == 1
+    assert nm.file_count - nm.deleted_count == 1
+
+    nm2 = make(path)  # cold replay of the same journal
+    assert len(nm2) == 1, "replay disagreed with live counters"
+    assert nm2.file_count - nm2.deleted_count == 1
+    assert nm2.get(7).size == 120
+
+
 def test_compact_map_idx_journal_roundtrip(tmp_path):
     path = str(tmp_path / "m.idx")
     nm = CompactNeedleMap(path)

@@ -36,6 +36,20 @@ def test_idx_entry_roundtrip_both_widths():
         (9, 0, t.TOMBSTONE_FILE_SIZE)
 
 
+def test_5byte_offset_reference_byte_layout():
+    """Pin the exact reference 5BytesOffset wire layout
+    (offset_5bytes.go:18-24): bytes[0:4] = low 32 bits big-endian,
+    bytes[4] = bits 32-39 — so large-volume .idx/.ecx files are
+    byte-compatible with a 5BytesOffset reference build."""
+    stored = 0xAB_12345678
+    b = t.put_offset(stored, offset_size=5)
+    assert b == bytes([0x12, 0x34, 0x56, 0x78, 0xAB])
+    assert t.get_offset(b, offset_size=5) == stored
+    # 4-byte layout is plain big-endian, unchanged
+    assert t.put_offset(0x12345678, offset_size=4) == \
+        bytes([0x12, 0x34, 0x56, 0x78])
+
+
 def test_superblock_offset_size_flag_roundtrip():
     sb = SuperBlock(offset_size=t.OFFSET_SIZE_LARGE)
     again = SuperBlock.from_bytes(sb.to_bytes())

@@ -130,6 +130,74 @@ def test_listing_v1_v2(s3):
     assert keys + keys2 == ["a.txt", "b/one.txt", "b/two.txt", "c.txt"]
 
 
+def test_listing_prefix_plus_delimiter(s3):
+    """Folder navigation: prefix="b/" + delimiter="/" must list b/'s
+    direct children, not fold b/ itself into a CommonPrefix."""
+    req(s3, "PUT", "/navb")
+    for k in ["a.txt", "b/one.txt", "b/two.txt", "b/sub/deep.txt",
+              "c.txt"]:
+        req(s3, "PUT", f"/navb/{k}", data=b"x")
+    with req(s3, "GET", "/navb?prefix=b/&delimiter=/") as r:
+        keys, root, ns = _keys(r.read())
+    prefixes = [p.find(f"{ns}Prefix").text
+                for p in root.findall(f"{ns}CommonPrefixes")]
+    assert keys == ["b/one.txt", "b/two.txt"]
+    assert prefixes == ["b/sub/"]
+
+
+def test_listing_paginated_common_prefixes(s3):
+    """CommonPrefixes count toward max-keys and NextMarker advances past
+    them, so pages never repeat a prefix."""
+    req(s3, "PUT", "/pageb")
+    for k in ["a.txt", "d1/x.txt", "d2/y.txt", "d3/z.txt", "zz.txt"]:
+        req(s3, "PUT", f"/pageb/{k}", data=b"x")
+    seen_keys, seen_prefixes, marker = [], [], ""
+    for _ in range(10):
+        url = "/pageb?delimiter=/&max-keys=2"
+        if marker:
+            url += f"&marker={marker}"
+        with req(s3, "GET", url) as r:
+            keys, root, ns = _keys(r.read())
+        prefixes = [p.find(f"{ns}Prefix").text
+                    for p in root.findall(f"{ns}CommonPrefixes")]
+        assert len(keys) + len(prefixes) <= 2
+        seen_keys += keys
+        seen_prefixes += prefixes
+        if root.find(f"{ns}IsTruncated").text != "true":
+            break
+        marker = root.find(f"{ns}NextMarker").text
+    else:
+        raise AssertionError("listing never terminated")
+    assert seen_keys == ["a.txt", "zz.txt"]
+    assert seen_prefixes == ["d1/", "d2/", "d3/"]  # no duplicates
+
+
+def test_listing_marker_inside_common_prefix(s3):
+    """A client-supplied marker strictly inside a prefix's subtree must
+    still emit that CommonPrefix when live keys past the marker roll up
+    into it (AWS semantics)."""
+    req(s3, "PUT", "/markb")
+    for k in ["d1/sub/a.txt", "d1/sub/m.txt", "d1/sub/z.txt",
+              "d1/top.txt"]:
+        req(s3, "PUT", f"/markb/{k}", data=b"x")
+    with req(s3, "GET",
+             "/markb?prefix=d1/&delimiter=/&marker=d1/sub/m.txt") as r:
+        keys, root, ns = _keys(r.read())
+    prefixes = [p.find(f"{ns}Prefix").text
+                for p in root.findall(f"{ns}CommonPrefixes")]
+    assert prefixes == ["d1/sub/"]
+    assert keys == ["d1/top.txt"]
+    # but a marker EQUAL to the prefix (it was the last item of the
+    # previous page) must not re-emit it
+    with req(s3, "GET",
+             "/markb?prefix=d1/&delimiter=/&marker=d1/sub/") as r:
+        keys, root, ns = _keys(r.read())
+    prefixes = [p.find(f"{ns}Prefix").text
+                for p in root.findall(f"{ns}CommonPrefixes")]
+    assert prefixes == []
+    assert keys == ["d1/top.txt"]
+
+
 def test_multipart_upload(s3):
     req(s3, "PUT", "/mpb")
     rng = random.Random(5)
