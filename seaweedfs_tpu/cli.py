@@ -126,6 +126,22 @@ def cmd_server(args) -> None:
         await run_volume_server(args.ip, args.port, store, master_url,
                                 guard=guard, tls=tls,
                                 grpc_port=args.port + 10000)
+        if getattr(args, "volume_workers", 1) > 1:
+            # share-nothing worker processes: each owns its volumes; the
+            # master balances assigns across them like any other nodes
+            import atexit
+            import subprocess
+            procs = []
+            base_dir = args.dir.split(",")[0]
+            for k in range(1, args.volume_workers):
+                wdir = os.path.join(base_dir, f"worker{k}")
+                os.makedirs(wdir, exist_ok=True)
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-m", "seaweedfs_tpu.cli", "volume",
+                     "-ip", args.ip, "-port", str(args.port + k),
+                     "-dir", wdir, "-mserver", master_url,
+                     "-coder", args.coder]))
+            atexit.register(lambda: [p.terminate() for p in procs])
         if args.filer:
             from .server.filer_server import run_filer
             await run_filer(args.ip, args.filer_port, master_url,
@@ -612,6 +628,12 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("-s3_config", default="",
                    help="JSON identities file for the embedded S3 gateway"
                         " (anonymous without it, like `weed s3`)")
+    s.add_argument("-volume_workers", type=int, default=1,
+                   help="extra volume-server worker PROCESSES (ports "
+                        "port+1..port+N-1, own dirs): CPython's analog of "
+                        "the reference's one multi-core Go server — "
+                        "req/s scales with cores, the master spreads "
+                        "assigns across workers")
     s.set_defaults(fn=cmd_server)
 
     f = sub.add_parser("filer", help="run a filer server")

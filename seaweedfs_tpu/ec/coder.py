@@ -86,6 +86,52 @@ class ErasureCoder:
             digest = (np.asarray(acc, dtype=np.uint32) + digest)
         return digest
 
+    # --- staged-window hooks (latency-aware sink schedule) ---
+    # Tunneled dev links charge a fixed latency per operation AND degrade
+    # the transfer path while kernels execute; the window schedule in
+    # pipeline.stream_encode_device_sink therefore separates "move bytes"
+    # (stage_async) from "run kernels" (one *_window_async dispatch per
+    # staged window) so H2D rides the healthy link and per-launch latency
+    # is paid once per window, not once per batch.
+
+    def stage_async(self, data: np.ndarray):
+        """Move one batch toward the device WITHOUT running any kernel.
+        CPU backends return the array unchanged."""
+        return np.asarray(data, dtype=np.uint8)
+
+    def encode_digest_window_async(self, staged: Sequence, acc=None):
+        """Digest a whole staged window; device backends dispatch ONE
+        multi-input executable. All staged batches must share a shape."""
+        for b in staged:
+            acc = self.encode_digest_async(b, acc)
+        return acc
+
+    def rec_digest_window_async(self, present: tuple, missing: tuple,
+                                staged: Sequence, acc=None):
+        """Like encode_digest_window_async but digesting RECONSTRUCTED
+        shards: staged batches are [k, n] survivor stripes; the digest is
+        the [len(missing)] uint32 wrapping byte sum of the rebuilt rows."""
+        apply_fn = self._rec_apply(present, missing)
+        for b in staged:
+            rebuilt = np.asarray(apply_fn(np.asarray(b, dtype=np.uint8)))
+            d = np.sum(rebuilt, axis=1, dtype=np.uint32)
+            acc = d if acc is None else np.asarray(acc, np.uint32) + d
+        return acc
+
+    def warm_encode_digest_window(self, n_batches: int,
+                                  shape: tuple) -> None:
+        """Ahead-of-time compile the window executable WITHOUT executing
+        anything on device. On tunneled dev chips the transfer path
+        degrades ~100x once any encode kernel has run, so a warm-up
+        execution would poison the very measurement (or production pass)
+        it prepares for; AOT compilation is free of that side effect.
+        CPU backends have nothing to compile."""
+
+    def warm_rec_digest_window(self, present: tuple, missing: tuple,
+                               n_batches: int, shape: tuple) -> None:
+        """AOT-compile the reconstruction window executable (see
+        warm_encode_digest_window)."""
+
     def reconstruct(self, shards: Sequence[Optional[np.ndarray]],
                     data_only: bool = False,
                     targets: Optional[Sequence[int]] = None
@@ -159,6 +205,44 @@ def _fused_digest(encode_fn):
     return fn
 
 
+def _fused_digest_multi(apply_fn):
+    """jit((acc, *batches) -> acc + sum of per-batch row digests): ONE
+    executable covers a whole staged window, so a remote/tunneled backend
+    pays its per-launch latency once per window instead of once per batch
+    (~0.3-0.4s/launch measured on the axon tunnel — at 10+ batches that
+    latency, not bandwidth, was the round-3 headline's 1000x gap)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def fn(acc, *batches):
+        for b in batches:
+            rows = apply_fn(b)
+            acc = acc + jnp.sum(rows.astype(jnp.uint32), axis=1,
+                                dtype=jnp.uint32)
+        return acc
+
+    return fn
+
+
+def _jax_stage(data: np.ndarray):
+    import jax
+    return jax.device_put(np.asarray(data, dtype=np.uint8))
+
+
+def _aot_compile_window(apply_fn, m_rows: int, n_batches: int,
+                        shape: tuple):
+    """Lower + compile the multi-batch digest executable from abstract
+    shapes only — no bytes move, no kernel runs. The returned compiled
+    object is called exactly like the jit fn: compiled(acc, *batches)."""
+    import jax
+    import jax.numpy as jnp
+    jfn = _fused_digest_multi(apply_fn)
+    sds = jax.ShapeDtypeStruct(tuple(shape), jnp.uint8)
+    acc_sds = jax.ShapeDtypeStruct((m_rows,), jnp.uint32)
+    return jfn.lower(acc_sds, *([sds] * n_batches)).compile()
+
+
 class JaxCoder(ErasureCoder):
     def __init__(self, data_shards: int, parity_shards: int,
                  method: str = "bitplane"):
@@ -197,6 +281,52 @@ class JaxCoder(ErasureCoder):
         if acc is None:
             acc = jnp.zeros(self.m, dtype=jnp.uint32)
         return fn(jax.device_put(np.asarray(data, dtype=np.uint8)), acc)
+
+    stage_async = staticmethod(_jax_stage)
+
+    def _encode_fn(self):
+        return lambda d: rs_jax.encode_parity(d, self.m, method=self.method)
+
+    def _wcache(self) -> dict:
+        cache = getattr(self, "_window_cache", None)
+        if cache is None:
+            cache = self._window_cache = {}
+        return cache
+
+    def encode_digest_window_async(self, staged, acc=None):
+        import jax.numpy as jnp
+        cache = self._wcache()
+        key = ("enc", len(staged), tuple(staged[0].shape))
+        fn = cache.get(key)
+        if fn is None:
+            fn = cache[key] = _fused_digest_multi(self._encode_fn())
+        if acc is None:
+            acc = jnp.zeros(self.m, dtype=jnp.uint32)
+        return fn(acc, *staged)
+
+    def rec_digest_window_async(self, present, missing, staged, acc=None):
+        import jax.numpy as jnp
+        cache = self._wcache()
+        key = ("rec", present, missing, len(staged),
+               tuple(staged[0].shape))
+        fn = cache.get(key)
+        if fn is None:
+            fn = cache[key] = _fused_digest_multi(
+                self._rec_apply(present, missing))
+        if acc is None:
+            acc = jnp.zeros(len(missing), dtype=jnp.uint32)
+        return fn(acc, *staged)
+
+    def warm_encode_digest_window(self, n_batches, shape):
+        key = ("enc", n_batches, tuple(shape))
+        self._wcache()[key] = _aot_compile_window(
+            self._encode_fn(), self.m, n_batches, shape)
+
+    def warm_rec_digest_window(self, present, missing, n_batches, shape):
+        key = ("rec", present, missing, n_batches, tuple(shape))
+        self._wcache()[key] = _aot_compile_window(
+            self._rec_apply(present, missing), len(missing), n_batches,
+            shape)
 
 
 class PallasCoder(ErasureCoder):
@@ -292,6 +422,52 @@ class PallasCoder(ErasureCoder):
                 return fn(d, acc)
             except Exception:
                 self._shrink_tile()
+
+    stage_async = staticmethod(_jax_stage)
+
+    def encode_digest_window_async(self, staged, acc=None):
+        import jax.numpy as jnp
+        if acc is None:
+            acc = jnp.zeros(self.m, dtype=jnp.uint32)
+        while True:
+            try:
+                key = ("enc", self._tile, len(staged),
+                       tuple(staged[0].shape))
+                fn = self._digest_cache.get(key)
+                if fn is None:
+                    fn = self._digest_cache[key] = _fused_digest_multi(
+                        self._encode)
+                return fn(acc, *staged)
+            except Exception:
+                self._shrink_tile()
+
+    def rec_digest_window_async(self, present, missing, staged, acc=None):
+        import jax.numpy as jnp
+        if acc is None:
+            acc = jnp.zeros(len(missing), dtype=jnp.uint32)
+        while True:
+            try:
+                key = ("rec", self._tile, present, missing,
+                       len(staged), tuple(staged[0].shape))
+                fn = self._digest_cache.get(key)
+                if fn is None:
+                    fn = self._digest_cache[key] = _fused_digest_multi(
+                        self._rec_apply(present, missing))
+                return fn(acc, *staged)
+            except Exception:
+                self._shrink_tile()
+
+    def warm_encode_digest_window(self, n_batches, shape):
+        key = ("enc", self._tile, n_batches, tuple(shape))
+        self._digest_cache[key] = _aot_compile_window(
+            self._encode, self.m, n_batches, shape)
+
+    def warm_rec_digest_window(self, present, missing, n_batches, shape):
+        key = ("rec", self._tile, present, missing, n_batches,
+               tuple(shape))
+        self._digest_cache[key] = _aot_compile_window(
+            self._rec_apply(present, missing), len(missing), n_batches,
+            shape)
 
 
 class CppCoder(ErasureCoder):

@@ -99,6 +99,12 @@ class MasterServer:
         self.tls = tls
         self._grpc_server = None
         self.metrics = metrics_mod.Registry("master")
+        # per-process secret marking requests proxied from the fastpath
+        # listener (server/fastpath.py): they arrive from 127.0.0.1 but
+        # were already admission-checked against the REAL peer IP
+        import secrets as _secrets
+        self._internal_token = _secrets.token_hex(16)
+        self._fast_srv = None
         self.app = self._build_app()
 
     def _raft_apply(self, cmd: dict) -> None:
@@ -142,7 +148,9 @@ class MasterServer:
             # (documented in the security.toml scaffold).
             if request.path != "/healthz":
                 remote = request.remote or ""
-                if remote not in self._peer_ips and \
+                if request.headers.get("X-Swfs-Internal") \
+                        != self._internal_token \
+                        and remote not in self._peer_ips and \
                         not self.guard.check_whitelist(remote) and \
                         not await self._refresh_peer_ips(remote):
                     return web.json_response({"error": "ip not allowed"},
@@ -200,6 +208,10 @@ class MasterServer:
                 self, host or "0.0.0.0", self.grpc_port, tls=self.tls)
 
     async def _on_cleanup(self, app) -> None:
+        if getattr(self, "_fast_srv", None) is not None:
+            self._fast_srv.close()
+            await self._fast_srv.wait_closed()
+            self._fast_srv = None
         if self._vacuum_task:
             self._vacuum_task.cancel()
         if self._grpc_server is not None:
@@ -847,13 +859,30 @@ class MasterServer:
 
 
 async def run_master(host: str, port: int, tls=None,
-                     **kwargs) -> web.AppRunner:
-    server = MasterServer(tls=tls, **kwargs)
+                     fastpath: bool = True, **kwargs) -> web.AppRunner:
+    """Public listener is the fastpath protocol (/dir/assign inline —
+    server/fastpath.py) with the aiohttp app on an internal loopback
+    port; fastpath=False (or env SEAWEEDFS_NO_FASTPATH) serves aiohttp
+    directly."""
+    import os as _os
+    if _os.environ.get("SEAWEEDFS_NO_FASTPATH"):
+        fastpath = False
+    server = MasterServer(tls=tls, url=kwargs.pop("url", f"{host}:{port}"),
+                          **kwargs)
     runner = web.AppRunner(server.app, access_log=None)
     await runner.setup()
     ssl_ctx = tls.server_ssl_context() if tls is not None else None
-    site = web.TCPSite(runner, host, port, ssl_context=ssl_ctx)
-    await site.start()
+    if fastpath:
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        internal_port = site._server.sockets[0].getsockname()[1]
+        from .fastpath import FastMasterProtocol, start_fastpath
+        server._fast_srv = await start_fastpath(
+            server, host, port, internal_port, ssl_context=ssl_ctx,
+            protocol=FastMasterProtocol)
+    else:
+        site = web.TCPSite(runner, host, port, ssl_context=ssl_ctx)
+        await site.start()
     log.info("master listening on %s:%d%s", host, port,
              " (tls)" if ssl_ctx else "")
     return runner

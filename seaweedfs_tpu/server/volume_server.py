@@ -208,6 +208,12 @@ class VolumeServer:
         self._peer_grpc_dead: dict[str, float] = {}
         self._repair_neg: dict[str, float] = {}
         self._repair_inflight = 0
+        # per-process secret marking requests proxied from the fastpath
+        # listener (server/fastpath.py): they arrive from 127.0.0.1 but
+        # were already whitelist-checked against the REAL peer IP
+        import secrets as _secrets
+        self._internal_token = _secrets.token_hex(16)
+        self._fast_srv = None
         self.app = self._build_app()
         # the EC read path fetches missing shards from peers through this
         store._remote_shard_reader = self._make_shard_reader
@@ -217,9 +223,15 @@ class VolumeServer:
         async def guard_mw(request: web.Request, handler):
             # IP whitelist wraps every route except liveness, admin surface
             # included (Guard.WhiteList, weed/security/guard.go:53); the
-            # per-fid JWT check on the data path happens in data_handler
+            # per-fid JWT check on the data path happens in data_handler.
+            # Requests proxied from the fastpath listener carry the
+            # per-process token: they were already checked against the
+            # real peer IP (this listener only sees 127.0.0.1 for them).
             if request.path != "/healthz":
-                if not self.guard.check_whitelist(request.remote or ""):
+                if (request.headers.get("X-Swfs-Internal")
+                        != self._internal_token
+                        and not self.guard.check_whitelist(
+                            request.remote or "")):
                     return web.json_response({"error": "ip not allowed"},
                                              status=403)
             return await handler(request)
@@ -282,6 +294,10 @@ class VolumeServer:
                 self, host, self.grpc_port, tls=self.tls)
 
     async def _on_cleanup(self, app) -> None:
+        if getattr(self, "_fast_srv", None) is not None:
+            self._fast_srv.close()
+            await self._fast_srv.wait_closed()
+            self._fast_srv = None
         for ch in self._peer_grpc_channels.values():
             try:
                 ch.close()
@@ -1469,14 +1485,29 @@ class VolumeServer:
 
 
 async def run_volume_server(host: str, port: int, store: Store,
-                            master_url: str, **kwargs) -> web.AppRunner:
+                            master_url: str, fastpath: bool = True,
+                            **kwargs) -> web.AppRunner:
+    """Public listener is the hand-rolled data-plane protocol
+    (server/fastpath.py) with the aiohttp app on an internal loopback
+    port for everything it proxies; fastpath=False (or env
+    SEAWEEDFS_NO_FASTPATH) serves aiohttp directly on the public port."""
+    import os as _os
+    if _os.environ.get("SEAWEEDFS_NO_FASTPATH"):
+        fastpath = False
     server = VolumeServer(store, master_url, url=f"{host}:{port}", **kwargs)
     runner = web.AppRunner(server.app, access_log=None)
     await runner.setup()
     tls = kwargs.get("tls")
-    site = web.TCPSite(runner, host, port,
-                       ssl_context=(tls.server_ssl_context()
-                                    if tls is not None else None))
-    await site.start()
+    ssl_ctx = tls.server_ssl_context() if tls is not None else None
+    if fastpath:
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        internal_port = site._server.sockets[0].getsockname()[1]
+        from .fastpath import start_fastpath
+        server._fast_srv = await start_fastpath(
+            server, host, port, internal_port, ssl_context=ssl_ctx)
+    else:
+        site = web.TCPSite(runner, host, port, ssl_context=ssl_ctx)
+        await site.start()
     log.info("volume server on %s:%d -> master %s", host, port, master_url)
     return runner

@@ -31,6 +31,24 @@ def _key(name: str, labels: dict | None) -> str:
     return f"{name}{{{inner}}}"
 
 
+class _Timer:
+    """Context manager feeding Registry.observe — module-level so the
+    per-request hot path never rebuilds a class object."""
+
+    __slots__ = ("_registry", "_name", "t0")
+
+    def __init__(self, registry, name: str):
+        self._registry = registry
+        self._name = name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._registry.observe(self._name, time.perf_counter() - self.t0)
+
+
 class Registry:
     def __init__(self, subsystem: str):
         self.subsystem = subsystem
@@ -82,17 +100,7 @@ class Registry:
                 await asyncio.sleep(interval_seconds)
 
     def timed(self, name: str):
-        registry = self
-
-        class _Timer:
-            def __enter__(self):
-                self.t0 = time.perf_counter()
-                return self
-
-            def __exit__(self, *exc):
-                registry.observe(name, time.perf_counter() - self.t0)
-
-        return _Timer()
+        return _Timer(self, name)
 
     @staticmethod
     def _split(key: str) -> tuple[str, str]:
