@@ -1,36 +1,45 @@
 #!/usr/bin/env python3
 """Headline benchmark: the RS(10,4) ec.encode PIPELINE on one chip.
 
-Round-1 benched only the kernel on pre-staged HBM arrays; the north star
-(BASELINE config 1/2) is the full `.dat` -> `.ec00-13` encode path the
-servers actually run. This bench measures, in order:
+Round-4 architecture: the parent orchestrates PHASES, each TPU phase in
+its OWN subprocess, and assembles exactly one JSON line at the end.
+Three tunneled-dev-chip facts force the shape (all measured):
 
-  pipeline   stream_encode of a >=1GB synthetic volume at the reference
-             geometry (1MB small-block stripes for a 1GB volume — the exact
-             layout ec_encoder.go:194-231 produces), overlapped disk read /
-             host->HBM / Pallas kernel / 14-way shard write-back
-             (seaweedfs_tpu/ec/pipeline.py). Measured twice: once writing
-             the shard files (the production path; D2H-link-bound on
-             tunneled dev chips) and once with the parity landing in an
-             on-device digest sink (the headline: the pipeline's worth
-             independent of a degraded D2H link, digest-verified against
-             the shard files so it provably runs the same computation).
-  kernel     the fused Pallas GF(2^8) kernel on resident data (the on-TPU
-             portion; BASELINE target >=20 GB/s/chip) — pinned n/reps,
-             median of 3 rounds with spread, plus a tile sweep
-  rebuild    stream_rebuild of 4 missing shards from 10 survivors, p50 over
-             repetitions (BASELINE config 3)
-  sweep      kernel encode GB/s at RS(6,3)/(12,4)/(20,4) (BASELINE config 4)
+  * ONE device->host read — even 16 bytes — flips the process's
+    transfer path into a ~100x degraded mode (1.7 -> 0.015 GB/s H2D)
+    for the REST of the process. Fresh processes start healthy, so each
+    TPU phase gets its own subprocess and defers every D2H (including
+    the digest materialize) until after all staging;
+  * some remote compiles trigger the same degradation, so phases
+    compile lazily at dispatch time, after staging;
+  * a compiled executable's FIRST execution pays a one-time program
+    load (~40-100s through the tunnel); steady-state re-execution is
+    ~0.13s for a 1.1GB window. The cold pass carries compile+load; the
+    steady-state reps carry the honest per-volume number.
 
-Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N, "extra": {...}}
-vs_baseline is pipeline GB/s over the 20 GB/s/chip north-star target.
+Phases / BASELINE configs:
+  encode   config 1/2: staged-window device-sink pipeline, digest-
+           verified vs an independent host coder; ledger of measured
+           components (read/stage/execute/materialize) + steady-state
+           per-volume rate (config 2's program-reuse regime) + healthy-
+           link projection from the measured parts
+  rebuild  config 3: same protocol over stream_rebuild_device_sink
+           (4 victims from 10 survivors), digest vs the real shard files
+  kernel   pinned RS(10,4) Pallas kernel + RS(k,m) sweep (config 4) +
+           tile sweep, ordered so every config reports >=1 number
+  fused    config 5: compaction + gzip + RS with per-phase seconds
+  system   req/s vs the reference's published benchmark (README.md:504)
+  needle_map  disk-backed index numbers
+
+Prints one JSON line:
+  {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N, "extra"}
 """
 
 import json
 import os
 import shutil
 import statistics
+import subprocess
 import sys
 import tempfile
 import time
@@ -39,17 +48,14 @@ import numpy as np
 
 BASELINE_GBPS = 20.0  # BASELINE.json: ec.encode >= 20 GB/s/chip on v5e
 
-# time budgets for the degraded-tunnel case. HARD_BUDGET_S bounds the
-# whole run: every optional phase carries a cost estimate (seeded by the
-# measured durations of earlier phases — remote kernel compiles on a
-# tunneled chip range 30-600s) and is skipped, type-stably, when it would
-# blow the budget. REBUILD_BUDGET_S bounds the rebuild rep loop within
-# the disk phase.
 HARD_BUDGET_S = 1000.0
-REBUILD_BUDGET_S = 300.0
-# disk-mode encode + rebuild must cross the D2H link; they are skipped when
-# the measured link predicts they'd blow the budget
-DISK_DEADLINE_S = 600.0
+MB = 1024 * 1024
+
+# encode volume: shard width divides the batch width exactly so one
+# window shape covers the whole volume (10 x 16MB batches x 7)
+VOL_BYTES = 1120 * MB
+BATCH_W = 16 * MB          # per-row width -> 160MB per staged batch
+VICTIMS = [0, 3, 7, 12]
 
 
 def _make_volume(path: str, size: int) -> None:
@@ -57,94 +63,208 @@ def _make_volume(path: str, size: int) -> None:
     with open(path, "wb") as f:
         left = size
         while left > 0:
-            n = min(left, 64 * 1024 * 1024)
+            n = min(left, 64 * MB)
             f.write(rng.integers(0, 256, n, dtype=np.uint8).tobytes())
             left -= n
 
 
-def measure_link() -> tuple[float, float, float]:
-    """Host<->device link: (h2d GB/s, d2h GB/s, d2h per-op latency s).
+def _host_coder():
+    from seaweedfs_tpu import ec
+    try:
+        return ec.get_coder("cpp", 10, 4)
+    except Exception:
+        return ec.get_coder("numpy", 10, 4)
 
-    On tunneled single-chip dev environments (axon) the device->host
-    direction can be orders of magnitude slower than HBM AND carries a
-    multi-second per-operation latency — a 16-byte fetch costs the same
-    seconds as a 1MB one. Both numbers are measured so the bench can model
-    a D2H-crossing phase as ops*latency + bytes/bandwidth."""
+
+def measure_link() -> dict:
+    """Host->device bandwidth on THIS process's fresh tunnel
+    (incompressible data, 1-D array). Deliberately does NO device->host
+    read: a single D2H — even 16 bytes — flips the tunnel's transfer
+    path into a ~100x degraded mode for the rest of the process
+    (measured), which is exactly what poisoned two whole bench runs.
+    D2H latency is reported from the pipeline ledger's wait_s instead
+    (the final 16-byte digest materialize)."""
     import jax
-    x = np.zeros(64 * 1024 * 1024, dtype=np.uint8)
+    x = np.random.default_rng(3).integers(0, 256, 64 * MB, dtype=np.uint8)
     d = jax.device_put(x)
-    d.block_until_ready()
+    d.block_until_ready()  # warm
     t0 = time.perf_counter()
     d = jax.device_put(x)
     d.block_until_ready()
     h2d = x.nbytes / (time.perf_counter() - t0) / 1e9
-    tiny = jax.device_put(np.zeros(16, dtype=np.uint8))
-    tiny.block_until_ready()
-    np.asarray(tiny)  # first fetch may include warmup
-    tiny2 = jax.device_put(np.ones(16, dtype=np.uint8))
-    tiny2.block_until_ready()
-    t0 = time.perf_counter()
-    np.asarray(tiny2)
-    d2h_lat = time.perf_counter() - t0
-    e = jax.device_put(np.ones_like(x))
-    e.block_until_ready()
-    t0 = time.perf_counter()
-    np.asarray(e)
-    d2h = x.nbytes / max(time.perf_counter() - t0 - d2h_lat, 1e-9) / 1e9
-    return h2d, d2h, d2h_lat
+    return {"h2d_gbps": round(h2d, 3)}
 
 
-def bench_fused(work: str, coder, vol_size: int) -> dict:
-    """BASELINE config 5: compaction + gzip + RS(10,4) in one pass over a
-    needle volume that is ~50% garbage."""
-    from seaweedfs_tpu.ec.fused import fused_vacuum_gzip_encode
-    from seaweedfs_tpu.storage.needle import Needle
-    from seaweedfs_tpu.storage.volume import Volume
-
-    vdir = os.path.join(work, "fusedvol")
-    os.makedirs(vdir, exist_ok=True)
-    v = Volume(vdir, "", 7, create=True)
-    needle_data = (b"fused bench payload: compressible text block. " * 450)
-    target = min(vol_size // 8, 64 * 1024 * 1024)
-    count = max(target // len(needle_data), 10)
-    for i in range(1, count + 1):
-        v.write_needle(Needle(cookie=i, id=i, data=needle_data))
-    for i in range(1, count + 1, 2):
-        v.delete_needle(Needle(cookie=i, id=i))
-    src_bytes = v.data_file_size()
-    dst = os.path.join(vdir, "out_7")
-    t0 = time.perf_counter()
-    out = fused_vacuum_gzip_encode(v, dst, coder)
-    dt = time.perf_counter() - t0
-    v.close()
-    return {"src_bytes": src_bytes,
-            "compacted_bytes": out["compacted_bytes"],
-            "gbps": round(src_bytes / dt / 1e9, 3)}
-
-
-def bench_kernel(k: int, m: int, n: int, reps: int, tile: int | None = None,
-                 rounds: int = 1):
-    """Pinned kernel measurement: fixed n, fixed reps, one warm+correctness
-    pass, then `rounds` independent timed rounds of `reps` dispatches each.
-    Returns (median GB/s, spread fraction across rounds) — the spread is
-    what separates a code regression from tunneled-dev-chip variance."""
+def _warm_stage(shape: tuple) -> None:
+    """Warm the exact 2-D staging shape: the tunnel charges a cold-path
+    penalty per array shape (first [10, W] put runs ~7x slower than the
+    steady rate), which would otherwise be billed to the first batch."""
     import jax
-    import jax.numpy as jnp
+    z = np.zeros(shape, dtype=np.uint8)
+    for _ in range(2):
+        h = jax.device_put(z)
+        h.block_until_ready()
+
+
+# ----------------------------------------------------------------- phases
+
+def phase_encode(work: str) -> dict:
+    """Config 1/2: the staged-window encode sink, fresh process."""
+    import jax
+
+    from seaweedfs_tpu import ec
+    from seaweedfs_tpu.ec import pipeline
+
+    out: dict = {"backend": jax.default_backend()}
+    out["link"] = measure_link()
+
+    base = os.path.join(work, "1")
+
+    # ground truth from an independent host implementation
+    t0 = time.perf_counter()
+    want = pipeline.stream_encode_device_sink(
+        base, _host_coder(), batch_size=BATCH_W, window_bytes=2 * VOL_BYTES)
+    out["host_digest_s"] = round(time.perf_counter() - t0, 2)
+
+    coder = ec.get_coder("jax", 10, 4)
+    # NO ahead-of-time compile here: staging needs no program, and on
+    # this tunnel even a chipless remote compile can flip the transfer
+    # path into its degraded mode (measured on the reconstruction
+    # program). The window dispatch compiles lazily AFTER staging; the
+    # cold pass therefore includes compile + one-time program load, and
+    # the steady-state reps below carry the honest per-volume number.
+    _warm_stage((10, BATCH_W))
+    stats: dict = {}
+    t0 = time.perf_counter()
+    saved: dict = {}
+    orig = coder.encode_digest_window_async
+
+    def capture(staged, acc=None):
+        saved["staged"] = staged
+        return orig(staged, acc)
+
+    coder.encode_digest_window_async = capture
+    digest = pipeline.stream_encode_device_sink(
+        base, coder, batch_size=BATCH_W, window_bytes=2 * VOL_BYTES,
+        stats=stats)
+    cold_total = time.perf_counter() - t0
+    if digest.tolist() != want.tolist():
+        raise AssertionError(f"sink digest {digest} != host {want}")
+    out["ledger"] = stats
+    out["cold_pass_s"] = round(cold_total, 2)  # includes program load
+
+    # steady state: the program is loaded, data staged — re-execute.
+    # This is config 2's regime (1000 volumes reuse one program); the
+    # staging cost repeats per volume, the load does not.
+    execs = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        acc = orig(saved["staged"])
+        d2 = np.asarray(coder.materialize(acc), dtype=np.uint32)
+        execs.append(time.perf_counter() - t0)
+    if d2.tolist() != want.tolist():
+        raise AssertionError("steady-state digest mismatch")
+    exec_s = statistics.median(execs)
+    out["exec_steady_s"] = [round(v, 3) for v in execs]
+
+    stage_wall = stats["read_wait_s"] + stats["stage_s"]
+    per_volume_s = stage_wall + exec_s
+    out["steady_state_volume_s"] = round(per_volume_s, 3)
+    out["value_gbps"] = round(VOL_BYTES / per_volume_s / 1e9, 2)
+
+    # arithmetic bound from measured parts: the pipeline cannot beat its
+    # slowest stage; on a healthy host H2D is not the binding stage
+    stage_gbps = stats.get("stage_gbps") or 0.0
+    kernel_gbps = VOL_BYTES / exec_s / 1e9
+    disk_gbps = (VOL_BYTES / stats["read_wait_s"] / 1e9
+                 if stats["read_wait_s"] > 1e-3 else None)
+    out["component_rates_gbps"] = {
+        "disk_read": round(disk_gbps, 2) if disk_gbps else None,
+        "h2d_stage": round(stage_gbps, 2),
+        "kernel_window": round(kernel_gbps, 2),
+    }
+    healthy = [v for v in (disk_gbps, kernel_gbps) if v]
+    out["healthy_link_projection_gbps"] = round(min(healthy), 2) \
+        if healthy else None
+    return out
+
+
+def phase_rebuild(work: str) -> dict:
+    """Config 3: reconstruction digest sink, fresh process. Shard files
+    must already exist in `work` (parent writes them with a host coder)."""
+    import jax
+
+    from seaweedfs_tpu import ec
+    from seaweedfs_tpu.ec import pipeline
+
+    out: dict = {"backend": jax.default_backend(), "victims": VICTIMS}
+    base = os.path.join(work, "1")
+    want = pipeline.shard_file_digest(base, VICTIMS)
+
+    shard_size = os.path.getsize(base + ec.to_ext(0))
+    n_batches = (shard_size + BATCH_W - 1) // BATCH_W
+
+    coder = ec.get_coder("jax", 10, 4)
+    # no AOT compile before staging — see phase_encode
+    _warm_stage((10, BATCH_W))
+    stats: dict = {}
+    saved: dict = {}
+    orig = coder.rec_digest_window_async
+
+    def capture(present_a, missing_a, staged, acc=None):
+        saved["args"] = (present_a, missing_a, staged)
+        return orig(present_a, missing_a, staged, acc)
+
+    coder.rec_digest_window_async = capture
+    t0 = time.perf_counter()
+    digest = pipeline.stream_rebuild_device_sink(
+        base, coder, VICTIMS, batch_size=BATCH_W,
+        window_bytes=20 * VOL_BYTES, stats=stats)
+    out["cold_pass_s"] = round(time.perf_counter() - t0, 2)
+    if digest.tolist() != want.tolist():
+        raise AssertionError(f"rebuild digest {digest} != files {want}")
+    out["ledger"] = stats
+
+    execs = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        acc = orig(*saved["args"])
+        d2 = np.asarray(coder.materialize(acc), dtype=np.uint32)
+        execs.append(time.perf_counter() - t0)
+    if d2.tolist() != want.tolist():
+        raise AssertionError("steady-state rebuild digest mismatch")
+    out["exec_steady_s"] = [round(v, 3) for v in execs]
+    exec_s = statistics.median(execs)
+
+    stage_wall = stats["read_wait_s"] + stats["stage_s"]
+    p50 = stage_wall + exec_s
+    out["rebuild_p50_s"] = round(p50, 3)
+    out["rebuild_reps_used"] = len(execs)
+    out["rebuild_is_cold"] = False
+    # rate over the data the rebuild actually moves + computes: k
+    # survivor shards in, len(victims) shards out
+    out["rebuild_gbps"] = round(10 * shard_size / p50 / 1e9, 2)
+    return out
+
+
+def bench_kernel(k: int, m: int, n: int, reps: int, tile=None, rounds=1):
+    """Pinned kernel measurement (unchanged from round 3): fixed n and
+    reps, one warm+correctness pass, `rounds` timed rounds; returns
+    (median GB/s, spread)."""
+    import jax
+
     from seaweedfs_tpu.ops import gf256, rs_jax, rs_pallas
 
-    data = jnp.asarray(
+    data = jax.numpy.asarray(
         np.random.default_rng(0).integers(0, 256, (k, n), dtype=np.uint8))
     if jax.default_backend() == "tpu":
         fn = rs_pallas.gf_apply_pallas(
             gf256.parity_matrix(k, m), tile=tile or rs_pallas.DEFAULT_TILE)
     else:
-        # pallas interpret mode is a pure-python emulator — useless for
-        # timing; the XLA bitplane path is the honest CPU kernel
         fn = jax.jit(rs_jax.gf_apply_bitplane(gf256.parity_matrix(k, m)))
     out = fn(data)
-    out.block_until_ready()  # compile + warm
+    out.block_until_ready()
 
-    # correctness gate: never report speed for wrong parity
     check = np.asarray(out[:, :65536])
     want = gf256.encode_parity(np.asarray(data[:, :65536]), m)
     if not np.array_equal(check, want):
@@ -162,37 +282,202 @@ def bench_kernel(k: int, m: int, n: int, reps: int, tile: int | None = None,
     return med, spread
 
 
+def phase_kernel(budget_s: float = 500.0) -> dict:
+    """Pinned kernel + RS(k,m) sweep (config 4) + tile sweep, ordered so
+    every config reports at least one number before optional extras."""
+    import jax
+
+    from seaweedfs_tpu.ops import rs_pallas
+
+    on_tpu = jax.default_backend() == "tpu"
+    n = 64 * MB if on_tpu else MB
+    reps = 10 if on_tpu else 3
+    started = time.perf_counter()
+    out: dict = {"backend": jax.default_backend()}
+
+    def left() -> float:
+        return budget_s - (time.perf_counter() - started)
+
+    t0 = time.perf_counter()
+    gbps, spread = bench_kernel(10, 4, n, reps, rounds=3)
+    out["kernel"] = {
+        "gbps": round(gbps, 2),
+        "vs_target": round(gbps / BASELINE_GBPS, 3),
+        "n": n, "reps": reps, "rounds": 3,
+        "spread_pct": round(spread * 100, 1),
+    }
+    last = max(60.0, time.perf_counter() - t0)
+
+    sweep: dict = {}
+    for (k, m) in ((6, 3), (12, 4), (20, 4)):
+        if left() < last * 1.6:
+            sweep[f"{k},{m}"] = None
+            continue
+        t0 = time.perf_counter()
+        nn = n - n % (16384 * 8)
+        g, _ = bench_kernel(k, m, nn, reps)
+        last = max(60.0, time.perf_counter() - t0)
+        sweep[f"{k},{m}"] = round(g, 2)
+    out["sweep_kernel_gbps"] = sweep
+
+    tiles: dict = {}
+    for tl in (65536, 131072, rs_pallas.DEFAULT_TILE):
+        if tl in tiles:
+            continue
+        if left() < last * 1.6:
+            tiles[tl] = None
+            continue
+        t0 = time.perf_counter()
+        g, _ = bench_kernel(10, 4, n, reps, tile=tl)
+        last = max(60.0, time.perf_counter() - t0)
+        tiles[tl] = round(g, 2)
+    out["tile_sweep_gbps"] = tiles
+
+    # arithmetic context for the kernel number
+    ops_per_s = 128 * 4 * out["kernel"]["gbps"] * 1e9
+    out["kernel"]["mxu_fraction"] = round(ops_per_s / 394e12, 4)
+    out["kernel"]["hbm_fraction"] = round(1.4 * out["kernel"]["gbps"] / 819,
+                                          4)
+    out["kernel"]["bound"] = (
+        "VPU (bitplane expand/repack): ~18 int32 VPU ops/input byte puts "
+        "the formulation's ceiling near 52 GB/s on v5e; an MXU-repack "
+        "variant measured SLOWER (32.4 vs 35.4 GB/s — M=4 rows occupy "
+        "~3% of the systolic array; see ops/rs_pallas.py). Wider "
+        "geometries amortize the expand: RS(20,4) exceeds 60 GB/s.")
+    return out
+
+
+def phase_fused(work: str) -> dict:
+    """Config 5: compaction + gzip + RS with per-phase seconds. Mixed
+    payloads (half compressible, half not — real volumes are a mix;
+    round 3's all-text volume measured gzip only). The RS stage runs on
+    the TPU device sink; its compile + one-time program load land in
+    rs_device_cold, the steady re-exec is the per-stream number."""
+    import jax
+
+    from seaweedfs_tpu import ec
+    from seaweedfs_tpu.ec import pipeline
+    from seaweedfs_tpu.ec.fused import fused_vacuum_gzip_encode
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    out: dict = {"backend": jax.default_backend()}
+    vdir = os.path.join(work, "fusedvol")
+    os.makedirs(vdir, exist_ok=True)
+    v = Volume(vdir, "", 7, create=True)
+    rng = np.random.default_rng(11)
+    text = (b"fused bench payload: compressible text block. " * 5700)
+    count = 0
+    target = 192 * MB
+    written = 0
+    while written < target:
+        count += 1
+        if count % 2:
+            data = text[:256 * 1024]
+        else:
+            data = rng.integers(0, 256, 256 * 1024,
+                                dtype=np.uint8).tobytes()
+        v.write_needle(Needle(cookie=count, id=count, data=data))
+        written += len(data)
+    # delete half of EACH kind (ids 1,2 mod 4): the survivors stay a
+    # text/random mix — deleting every other id would remove exactly the
+    # text needles (odd ids) and leave an all-random volume
+    for i in range(1, count + 1):
+        if i % 4 in (1, 2):
+            v.delete_needle(Needle(cookie=i, id=i))
+    src_bytes = v.data_file_size()
+
+    # phase 1+2: compaction + gzip into the destination volume (host)
+    dst = os.path.join(vdir, "out_7")
+    host = _host_coder()
+    t0 = time.perf_counter()
+    res = fused_vacuum_gzip_encode(v, dst, host,
+                                   batch_size=4 * MB)
+    t_host_full = time.perf_counter() - t0
+    v.close()
+    compacted = res["compacted_bytes"]
+
+    # isolate the host RS share by re-encoding the compacted volume alone
+    t0 = time.perf_counter()
+    pipeline.stream_encode(dst, host, batch_size=4 * MB)
+    t_host_rs = time.perf_counter() - t0
+    t_compact_gzip = max(t_host_full - t_host_rs, 1e-3)
+
+    # phase 3 on TPU: device-sink RS of the compacted stream. No
+    # pre-compile (see phase_encode): the window dispatch compiles after
+    # staging; rs_device_cold carries compile + program load, the steady
+    # re-exec is the per-stream number.
+    coder = ec.get_coder("jax", 10, 4)
+    _warm_stage((10, 4 * MB))
+    want = pipeline.stream_encode_device_sink(
+        dst, host, batch_size=4 * MB, window_bytes=1 << 40)
+    saved: dict = {}
+    orig = coder.encode_digest_window_async
+
+    def capture(staged, acc=None):
+        saved["staged"] = staged
+        return orig(staged, acc)
+
+    coder.encode_digest_window_async = capture
+    stats: dict = {}
+    t0 = time.perf_counter()
+    got = pipeline.stream_encode_device_sink(
+        dst, coder, batch_size=4 * MB, window_bytes=1 << 40, stats=stats)
+    t_cold = time.perf_counter() - t0
+    if got.tolist() != want.tolist():
+        raise AssertionError("fused RS digest mismatch")
+    t0 = time.perf_counter()
+    acc = orig(saved["staged"])
+    np.asarray(coder.materialize(acc))
+    t_rs_steady = (stats["read_wait_s"] + stats["stage_s"]
+                   + (time.perf_counter() - t0))
+
+    total = t_compact_gzip + t_rs_steady
+    out.update({
+        "src_bytes": src_bytes,
+        "compacted_bytes": compacted,
+        "phase_s": {"compact_gzip": round(t_compact_gzip, 2),
+                    "rs_device_steady": round(t_rs_steady, 2),
+                    "rs_device_cold": round(t_cold, 2),
+                    "rs_host_cpp": round(t_host_rs, 2)},
+        "gbps": round(src_bytes / total / 1e9, 3),
+        "bottleneck": ("host compaction+gzip (single-core)"
+                       if t_compact_gzip >= t_rs_steady
+                       else "RS device stage (tunnel H2D staging)"),
+    })
+    return out
+
+
 def bench_system(work: str, n: int = 6000, size: int = 1024,
                  concurrency: int = 16) -> dict:
-    """System req/s vs the reference's published benchmark (README.md:504-553:
-    15,708 writes/s, 47,019 reads/s at 1KB, c=16 — measured on multi-core
-    bare metal with a Go client). Spawns the combined master+volume server
-    as a subprocess and drives it with the raw-socket self-validating
-    engine; numbers include the client's CPU share of the same host, so
-    cpu_count is reported alongside."""
-    import subprocess
+    """System req/s vs the reference's published benchmark
+    (README.md:504-553: 15,708 writes/s, 47,019 reads/s at 1KB, c=16 on
+    a multi-core 2014 MacBook i7 running BOTH the Go server and the Go
+    client). Here the combined server + the raw-socket self-validating
+    client share this host; workers scale with available cores."""
     import urllib.request
 
     from seaweedfs_tpu.utils.bench_client import run_benchmark
 
+    workers = max(1, min(4, (os.cpu_count() or 1) - 1)) \
+        if (os.cpu_count() or 1) > 1 else 1
     mport, vport = 19555, 18555
     data_dir = os.path.join(work, "sysbench")
     os.makedirs(data_dir, exist_ok=True)
     import seaweedfs_tpu
     pkg_root = os.path.dirname(os.path.dirname(seaweedfs_tpu.__file__))
-    # servers never need a TPU (JAX_PLATFORMS alone is overridden by the
-    # axon site hook; SEAWEEDFS_FORCE_CPU is honored by the CLI)
     env = dict(os.environ, JAX_PLATFORMS="cpu", SEAWEEDFS_FORCE_CPU="1")
     env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
     proc = subprocess.Popen(
         [sys.executable, "-m", "seaweedfs_tpu.cli", "server",
          "-ip", "127.0.0.1", "-master_port", str(mport),
-         "-port", str(vport), "-dir", data_dir],
+         "-port", str(vport), "-dir", data_dir,
+         "-volume_workers", str(workers)],
         cwd=data_dir, env=env,
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
     try:
         deadline = time.time() + 30
-        while True:  # ready = an assign that actually returns a fid
+        while True:
             try:
                 with urllib.request.urlopen(
                         f"http://127.0.0.1:{mport}/dir/assign",
@@ -213,20 +498,22 @@ def bench_system(work: str, n: int = 6000, size: int = 1024,
         except subprocess.TimeoutExpired:
             proc.kill()
     out["cpu_count"] = os.cpu_count()
+    out["volume_workers"] = workers
     out["vs_reference"] = {
         "ref_write_req_s": 15708, "ref_read_req_s": 47019,
         "write_ratio": round(out["write"]["req_s"] / 15708, 4),
         "read_ratio": round(out["read"]["req_s"] / 47019, 4),
+        "note": ("reference ran server+client on a multi-core i7; this "
+                 "host pins both to os.cpu_count() core(s). Per-core "
+                 "(ref assumed 4 cores): write "
+                 f"{round(out['write']['req_s'] / max((os.cpu_count() or 1), 1) / (15708 / 4), 2)}x, "
+                 "read "
+                 f"{round(out['read']['req_s'] / max((os.cpu_count() or 1), 1) / (47019 / 4), 2)}x"),
     }
     return out
 
 
 def bench_needle_map(work: str, n: int = 5_000_000) -> dict:
-    """Disk-backed needle map at volume scale: cold .sdx build from the
-    .idx journal, warm adoption, and random lookup latency — the numbers
-    behind the -index leveldb kinds (needle_map_leveldb.go's role)."""
-    import numpy as np
-
     from seaweedfs_tpu.storage.needle_map import DiskNeedleMap
 
     rec = np.empty(n, dtype=[("k", ">u8"), ("o", ">u4"), ("s", ">u4")])
@@ -258,288 +545,128 @@ def bench_needle_map(work: str, n: int = 5_000_000) -> dict:
             "lookup_p99_us": round(lat[int(len(lat) * 0.99)] * 1e6, 1)}
 
 
-def main() -> None:
-    import jax
+# ------------------------------------------------------------ orchestration
 
-    from seaweedfs_tpu import ec
-    from seaweedfs_tpu.ec import pipeline
-
-    backend = jax.default_backend()
-    on_tpu = backend == "tpu"
-    # CPU fallback keeps the bench runnable in dev; the recorded numbers
-    # come from the driver's TPU run. The TPU volume size is picked so the
-    # shard size is an exact multiple of the batch width: a single kernel
-    # shape compiles once (1120MiB -> 112 small rows -> 112MiB shards =
-    # 7 x 16MiB batches).
-    vol_size = (1120 * 1024 * 1024) if on_tpu else (16 * 1024 * 1024)
-    kernel_n = (64 * 1024 * 1024) if on_tpu else (1024 * 1024)
-    kernel_reps = 10 if on_tpu else 3
-    rebuild_reps = 2 if on_tpu else 1
-    # tunneled dev chips charge ~a second of round-trip latency per
-    # host<->device op pair; 112MB batches keep the pipeline at 10 ops per
-    # volume instead of 70 (a real PCIe host would prefer smaller batches
-    # for deeper overlap — the batch width changes nothing semantically)
-    batch = 112 * 1024 * 1024 if on_tpu else 1024 * 1024
-
-    h2d_gbps, d2h_gbps, d2h_lat_s = measure_link()
-    if on_tpu:
-        coder = ec.get_coder("pallas", 10, 4)
-    else:
-        try:
-            coder = ec.get_coder("cpp", 10, 4)
-        except Exception:
-            coder = ec.get_coder("jax", 10, 4)
-    work = tempfile.mkdtemp(prefix="swfs_bench_")
+def _run_phase(name: str, work: str, timeout_s: float) -> dict:
+    """Run one phase in a fresh subprocess (fresh tunnel); the phase
+    prints its JSON on the LAST stdout line."""
+    t0 = time.perf_counter()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "SEAWEEDFS_FORCE_CPU")}
     try:
-        _run_configs(work, coder, vol_size, kernel_n, kernel_reps,
-                     rebuild_reps, batch, backend, h2d_gbps,
-                     d2h_gbps, d2h_lat_s)
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--phase", name, "--work", work],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return {"error": f"phase {name} timed out after {timeout_s:.0f}s"}
+    dur = time.perf_counter() - t0
+    if p.returncode != 0:
+        tail = (p.stderr or "")[-2000:]
+        return {"error": f"phase {name} rc={p.returncode}: {tail}"}
+    try:
+        out = json.loads(p.stdout.strip().splitlines()[-1])
     except Exception as e:
-        # keep the one-JSON-line contract even for correctness failures
+        return {"error": f"phase {name} bad output: {e}; "
+                         f"stdout tail: {p.stdout[-500:]}"}
+    out["phase_wall_s"] = round(dur, 1)
+    return out
+
+
+def _log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    started = time.perf_counter()
+    work = tempfile.mkdtemp(prefix="swfs_bench_")
+
+    def left() -> float:
+        return HARD_BUDGET_S - (time.perf_counter() - started)
+
+    try:
+        # host-side prep (parent NEVER touches the TPU: jax stays
+        # un-imported here so subprocess tunnels start clean)
+        t0 = time.perf_counter()
+        _make_volume(os.path.join(work, "1.dat"), VOL_BYTES)
+        _log(f"volume gen: {time.perf_counter() - t0:.1f}s")
+
+        encode = _run_phase("encode", work, min(300.0, left()))
+        _log(f"encode: {encode.get('value_gbps')} GB/s "
+             f"({encode.get('phase_wall_s')}s)")
+
+        # shard files for the rebuild phase (host coder, parent-side)
+        rebuild: dict = {"error": "skipped (budget)"}
+        if left() > 150:
+            t0 = time.perf_counter()
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            from seaweedfs_tpu.ec import pipeline as _pl
+            _pl.stream_encode(os.path.join(work, "1"), _host_coder(),
+                              batch_size=BATCH_W)
+            _log(f"shard gen (host): {time.perf_counter() - t0:.1f}s")
+            rebuild = _run_phase("rebuild", work, min(280.0, left()))
+            _log(f"rebuild: p50 {rebuild.get('rebuild_p50_s')}s "
+                 f"({rebuild.get('phase_wall_s')}s)")
+
+        kernel = _run_phase("kernel", work, min(560.0, max(left(), 60)))
+        _log(f"kernel: {kernel.get('kernel', {}).get('gbps')} GB/s "
+             f"({kernel.get('phase_wall_s')}s)")
+
+        fused = ({"error": "skipped (budget)"} if left() < 120
+                 else _run_phase("fused", work, min(240.0, left())))
+        _log(f"fused: {fused.get('gbps')} GB/s")
+
+        try:
+            system = bench_system(work)
+            _log(f"system: w {system['write']['req_s']} r "
+                 f"{system['read']['req_s']}")
+        except Exception as e:
+            system = {"error": str(e)}
+
+        try:
+            needle_map = bench_needle_map(work)
+        except Exception as e:
+            needle_map = {"error": str(e)}
+
+        value = encode.get("value_gbps") or 0.0
         print(json.dumps({
-            "metric": ("ec.encode pipeline GB/s/chip "
-                       "(disk -> H2D -> kernel, device parity sink)"),
-            "value": 0.0, "unit": "GB/s", "vs_baseline": 0.0,
-            "error": f"{type(e).__name__}: {e}"}))
-        sys.exit(1)
+            "metric": ("ec.encode pipeline GB/s/chip (disk -> H2D -> "
+                       "kernel, device parity sink, steady state)"),
+            "value": value,
+            "unit": "GB/s",
+            "vs_baseline": round(value / BASELINE_GBPS, 3),
+            "extra": {
+                "volume_bytes": VOL_BYTES,
+                "encode": encode,
+                "rebuild": rebuild,
+                "kernel_phase": kernel,
+                "fused_compact_gzip_rs": fused,
+                "system_req_s": system,
+                "disk_needle_map": needle_map,
+                "note": (
+                    "value = steady-state per-volume pipeline rate "
+                    "(read+stage+execute+materialize, program already "
+                    "loaded — the 1000-volume regime of BASELINE config "
+                    "2). Each TPU phase runs in a fresh process because "
+                    "the tunneled dev link degrades ~100x after any "
+                    "encode kernel executes; cold_pass_s includes the "
+                    "one-time program load. Digests verified against an "
+                    "independent host coder in every phase."),
+            },
+        }))
     finally:
         shutil.rmtree(work, ignore_errors=True)
 
 
-def _phase(name: str, t0: float) -> float:
-    now = time.perf_counter()
-    print(f"[bench] {name}: {now - t0:.1f}s", file=sys.stderr, flush=True)
-    return now
-
-
-def _run_configs(work, coder, vol_size, kernel_n, kernel_reps, rebuild_reps,
-                 batch, backend, h2d_gbps, d2h_gbps,
-                 d2h_lat_s) -> None:
-    from seaweedfs_tpu import ec
-    from seaweedfs_tpu.ec import pipeline
-
-    started = time.perf_counter()
-    t = started
-    base = os.path.join(work, "1")
-    _make_volume(base + ".dat", vol_size)
-    t = _phase("volume gen", t)
-
-    # Phase order puts the link-independent essentials (device-sink
-    # pipeline, pinned kernel, system req/s) before anything that must move
-    # parity across the device->host link: on tunneled dev chips that link
-    # has been observed 1000x degraded, and a single disk-mode encode can
-    # eat the entire driver patience (511s measured once).
-
-    # host-side ground truth for the device sink: the same streaming
-    # schedule with the host table coder, producing the [m] uint32 digest
-    # the TPU sink must match (independent implementation, same fixture-
-    # verified RS math)
-    try:
-        host_coder = ec.get_coder("cpp", 10, 4)
-    except Exception:
-        host_coder = ec.get_coder("numpy", 10, 4)
-    want_digest = pipeline.stream_encode_device_sink(
-        base, host_coder, batch_size=batch)
-    t = _phase("host digest (ground truth)", t)
-
-    # device-sink pipeline: disk read + H2D + kernel overlapped; parity is
-    # reduced on-device, 16 bytes return per batch. Headline metric.
-    pipeline.stream_encode_device_sink(base, coder, batch_size=batch)
-    t = _phase("device-sink warm (compile)", t)
-    t0 = time.perf_counter()
-    sink_digest = pipeline.stream_encode_device_sink(base, coder,
-                                                     batch_size=batch)
-    sink_dt = time.perf_counter() - t0
-    sink_gbps = vol_size / sink_dt / 1e9
-    if sink_digest.tolist() != want_digest.tolist():
-        raise AssertionError(
-            f"device-sink digest {sink_digest} != host {want_digest}")
-    t = _phase("encode timed (device sink)", t)
-
-    # pinned headline kernel: fixed n, fixed reps, 3 timed rounds; median +
-    # spread. Round 2's 41.4 -> 33.6 GB/s "regression" at RS(10,4) was
-    # un-diagnosable because neither warm-state nor variance was pinned; a
-    # fixed-shape tile sweep on the same warm chip showed 256K >= 128K >>
-    # 64K (45.9/45.7/35.8 GB/s), i.e. the 256K tile was not the cause —
-    # the spread number now quantifies the chip/tunnel variance instead.
-    kernel_gbps, kernel_spread = bench_kernel(10, 4, kernel_n, kernel_reps,
-                                              rounds=3)
-    t = _phase("kernel 10,4 pinned", t)
-
-    try:
-        system = bench_system(work)
-        t = _phase("system req/s", t)
-    except Exception as e:
-        system = {"error": str(e)}
-
-    try:
-        needle_map = bench_needle_map(work)
-        t = _phase("disk needle map", t)
-    except Exception as e:
-        needle_map = {"error": str(e)}
-
-    # adaptive estimates: a kernel phase costs roughly what the last one
-    # did (compile dominates; the tunnel's remote compiler is the wild
-    # card), floored at 45s
-    last_kernel_s = [45.0]
-
-    def budget_ok(est: float) -> bool:
-        return time.perf_counter() - started + est < HARD_BUDGET_S
-
-    tile_sweep = {}
-    from seaweedfs_tpu.ops import rs_pallas
-    for tl in (65536, 131072, rs_pallas.DEFAULT_TILE):
-        if tl in tile_sweep:
-            continue
-        if not budget_ok(last_kernel_s[0] * 1.5):
-            tile_sweep[tl] = None
-            continue
-        t0 = time.perf_counter()
-        g, _ = bench_kernel(10, 4, kernel_n, kernel_reps, tile=tl)
-        last_kernel_s[0] = max(45.0, time.perf_counter() - t0)
-        tile_sweep[tl] = round(g, 2)
-        t = _phase(f"kernel tile {tl}", t)
-
-    sweep = {}
-    for (k, m) in ((6, 3), (12, 4), (20, 4)):
-        if not budget_ok(last_kernel_s[0] * 2):
-            sweep[f"{k},{m}"] = None  # skipped (time budget); type-stable
-            continue
-        n = kernel_n - kernel_n % (16384 * 8)
-        # measured: geometry-scaled (wider) tiles are SLOWER for small
-        # matrices (RS(6,3): 18.5 vs 22.7 GB/s at the default tile), so
-        # the sweep keeps the default
-        t0 = time.perf_counter()
-        g, _ = bench_kernel(k, m, n, kernel_reps)
-        last_kernel_s[0] = max(45.0, time.perf_counter() - t0)
-        sweep[f"{k},{m}"] = round(g, 2)
-        t = _phase(f"kernel sweep {k},{m}", t)
-
-    if not budget_ok(90.0):
-        fused = {"skipped": True}
-    else:
-        fused = bench_fused(work, coder, vol_size)
-        t = _phase("fused pipeline", t)
-
-    # --- optional, D2H-bound phases (disk-mode encode writes 4/14 of the
-    # volume back through the degraded link; rebuild writes 4 shards) ---
-    disk_phase_start = time.perf_counter()
-    n_batches = max(vol_size // batch, 1)
-    est_d2h_s = (n_batches * d2h_lat_s
-                 + (0.4 * vol_size / 1e9) / max(d2h_gbps, 1e-6))
-    disk_feasible = (est_d2h_s < DISK_DEADLINE_S
-                     and (time.perf_counter() - started + est_d2h_s + 120
-                          < HARD_BUDGET_S))
-
-    disk_gbps = None
-    rebuild_p50 = None
-    rebuild_gbps = None
-    times = []
-    if disk_feasible:
-        t0 = time.perf_counter()
-        pipeline.stream_encode(base, coder, batch_size=batch)
-        cold_s = time.perf_counter() - t0
-        t = _phase("encode (disk sink, cold)", t)
-        # steady-state pass only if the link leaves room; else report the
-        # cold number (includes the file-mode kernel compile)
-        if (time.perf_counter() - disk_phase_start + est_d2h_s
-                < DISK_DEADLINE_S):
-            for i in range(14):
-                os.remove(base + ec.to_ext(i))
-            t0 = time.perf_counter()
-            pipeline.stream_encode(base, coder, batch_size=batch)
-            disk_gbps = vol_size / (time.perf_counter() - t0) / 1e9
-            t = _phase("encode timed (disk sink)", t)
-        else:
-            disk_gbps = vol_size / cold_s / 1e9
-        file_digest = pipeline.parity_file_digest(base)
-        if file_digest.tolist() != want_digest.tolist():
-            raise AssertionError(
-                f"parity files {file_digest} != host digest {want_digest}")
-
-        # rebuild p50 (config 3): 4 missing shards from 10 survivors;
-        # first pass also warms the reconstruction kernel. When the link
-        # budget cuts the timed reps, the cold (compile-inclusive) pass
-        # still reports rather than a null.
-        victims = [0, 3, 7, 12]
-        cold_rebuild_s = None
-        for rep in range(rebuild_reps + 1):
-            for v in victims:
-                os.remove(base + ec.to_ext(v))
-            t0 = time.perf_counter()
-            pipeline.stream_rebuild(base, coder, batch_size=batch)
-            if rep == 0:
-                cold_rebuild_s = time.perf_counter() - t0
-            else:
-                times.append(time.perf_counter() - t0)
-            if time.perf_counter() - disk_phase_start > REBUILD_BUDGET_S:
-                break  # degraded link: stop early
-        shard_size = os.path.getsize(base + ec.to_ext(0))
-        if times:
-            rebuild_p50 = statistics.median(times)
-        elif cold_rebuild_s is not None:
-            rebuild_p50 = cold_rebuild_s  # cold: includes rebuild compile
-        if rebuild_p50 is not None:
-            rebuild_gbps = 10 * shard_size / rebuild_p50 / 1e9
-        t = _phase(f"rebuild x{len(times) + 1}", t)
-
-    # arithmetic per input byte at RS(k=10,m): the bitplane matmul does
-    # 2*(8m)(8k) int8 MACs per k-byte column = 128*m ops/input byte; HBM
-    # sees (k+m)/k bytes per input byte (bytes in + parity out, VMEM-fused)
-    ops_per_s = 128 * 4 * kernel_gbps * 1e9
-    hbm_gbps = 1.4 * kernel_gbps
-
-    print(json.dumps({
-        "metric": ("ec.encode pipeline GB/s/chip "
-                   "(disk -> H2D -> kernel, device parity sink)"),
-        "value": round(sink_gbps, 2),
-        "unit": "GB/s",
-        "vs_baseline": round(sink_gbps / BASELINE_GBPS, 3),
-        "extra": {
-            "backend": backend,
-            "volume_bytes": vol_size,
-            "digest_verified": "vs independent host coder",
-            "pipeline_disk_gbps": (round(disk_gbps, 2)
-                                   if disk_gbps is not None else None),
-            "disk_phase_skipped_reason": (
-                None if disk_feasible else
-                f"estimated {est_d2h_s:.0f}s of D2H on a "
-                f"{d2h_gbps:.3f} GB/s link with {d2h_lat_s:.2f}s/op "
-                f"latency"),
-            "kernel": {
-                "gbps": round(kernel_gbps, 2),
-                "vs_target": round(kernel_gbps / BASELINE_GBPS, 3),
-                "n": kernel_n, "reps": kernel_reps, "rounds": 3,
-                "spread_pct": round(kernel_spread * 100, 1),
-                "tile_sweep_gbps": tile_sweep,
-                "mxu_fraction": round(ops_per_s / 394e12, 4),
-                "hbm_fraction": round(hbm_gbps / 819, 4),
-                "bound": ("VPU (bitplane expand/repack); MXU and HBM "
-                          "fractions show neither is near peak"),
-            },
-            "rebuild_p50_s": (round(rebuild_p50, 3)
-                              if rebuild_p50 is not None else None),
-            "rebuild_reps_used": len(times),
-            "rebuild_is_cold": rebuild_p50 is not None and not times,
-            "rebuild_gbps": (round(rebuild_gbps, 2)
-                             if rebuild_gbps is not None else None),
-            "sweep_kernel_gbps": sweep,
-            "fused_compact_gzip_rs": fused,
-            "system_req_s": system,
-            "disk_needle_map": needle_map,
-            "link_h2d_gbps": round(h2d_gbps, 3),
-            "link_d2h_gbps": round(d2h_gbps, 3),
-            "link_d2h_latency_s": round(d2h_lat_s, 3),
-            "note": ("value = device-parity-sink pipeline (disk read + H2D "
-                     "+ kernel overlapped; 16B digest returns per batch, "
-                     "verified against an independent host-coder digest of "
-                     "the same volume). pipeline_disk_gbps is the same "
-                     "schedule writing all 14 shard files; on a tunneled "
-                     "dev chip it is bound by link_d2h_gbps, which parity "
-                     "must cross to reach disk."),
-        },
-    }))
-
-
 if __name__ == "__main__":
-    main()
+    if "--phase" in sys.argv:
+        name = sys.argv[sys.argv.index("--phase") + 1]
+        work = sys.argv[sys.argv.index("--work") + 1]
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        fn = {"encode": phase_encode, "rebuild": phase_rebuild,
+              "kernel": lambda w: phase_kernel(), "fused": phase_fused}[name]
+        print(json.dumps(fn(work)))
+    else:
+        main()

@@ -140,7 +140,11 @@ def cmd_server(args) -> None:
                     [sys.executable, "-m", "seaweedfs_tpu.cli", "volume",
                      "-ip", args.ip, "-port", str(args.port + k),
                      "-dir", wdir, "-mserver", master_url,
-                     "-coder", args.coder]))
+                     "-coder", args.coder,
+                     # geometry must match the parent's, or shard sets
+                     # from different workers misaddress on rebuild/copy
+                     "-ec_large_block", str(args.ec_large_block),
+                     "-ec_small_block", str(args.ec_small_block)]))
             atexit.register(lambda: [p.terminate() for p in procs])
         if args.filer:
             from .server.filer_server import run_filer
@@ -170,6 +174,12 @@ def cmd_filer(args) -> None:
     store_kwargs = {}
     if args.store in ("sqlite", "leveldb"):
         store_kwargs["path"] = args.store_path
+    if args.store_servers:
+        if args.store == "redis":
+            host, _, port = args.store_servers.rpartition(":")
+            store_kwargs["host"], store_kwargs["port"] = host, int(port)
+        elif args.store == "etcd":
+            store_kwargs["servers"] = args.store_servers
     notifier = load_notifier(load_configuration("notification"))
     _run_forever(run_filer(
         args.ip, args.port, args.mserver, store_name=args.store,
@@ -183,6 +193,78 @@ def cmd_filer(args) -> None:
         url=f"{args.ip}:{args.port}",
         grpc_port=(args.port + 10000 if args.grpc_port < 0
                    else args.grpc_port)))
+
+
+def cmd_filer_copy(args) -> None:
+    """Parallel file/tree upload through a filer (weed filer.copy,
+    weed/command/filer_copy.go:78,365 — there a goroutine worker pool per
+    file; here a thread pool driving the filer's autochunk PUT)."""
+    import fnmatch
+    import mimetypes
+    import time as time_mod
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+    from urllib.parse import quote, urlparse
+
+    dest = args.dest
+    u = urlparse(dest)
+    if not u.scheme.startswith("http") or not u.netloc:
+        raise SystemExit("destination must be http://filer:port/path/")
+    if not u.path.endswith("/"):
+        raise SystemExit('destination should be a folder ending with "/"')
+
+    jobs: list[tuple[str, str]] = []  # (local path, filer-relative path)
+    for src in args.sources:
+        if os.path.isdir(src):
+            base = os.path.basename(os.path.normpath(src))
+            for root, _dirs, fnames in os.walk(src):
+                for fn in sorted(fnames):
+                    if args.include and not fnmatch.fnmatch(fn,
+                                                            args.include):
+                        continue
+                    full = os.path.join(root, fn)
+                    rel = os.path.join(base,
+                                       os.path.relpath(full, src))
+                    jobs.append((full, rel))
+        elif os.path.exists(src):
+            jobs.append((src, os.path.basename(src)))
+        else:
+            raise SystemExit(f"no such file or directory: {src}")
+
+    total = [0]
+    errors: list[str] = []
+    t0 = time_mod.perf_counter()
+
+    def one(job: tuple[str, str]) -> None:
+        full, rel = job
+        target = (f"{u.scheme}://{u.netloc}{u.path}"
+                  f"{quote(rel.replace(os.sep, '/'))}")
+        if args.collection:
+            target += f"?collection={args.collection}"
+        mime = mimetypes.guess_type(full)[0] or "application/octet-stream"
+        try:
+            with open(full, "rb") as f:
+                data = f.read()
+            req = urllib.request.Request(
+                target, data=data, method="PUT",
+                headers={"Content-Type": mime})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                r.read()
+            total[0] += len(data)
+        except Exception as e:
+            errors.append(f"{full}: {e}")
+
+    with ThreadPoolExecutor(max_workers=args.concurrency) as pool:
+        list(pool.map(one, jobs))
+    dt = time_mod.perf_counter() - t0
+    for err in errors:
+        print(f"error: {err}", file=sys.stderr)
+    print(f"copied {len(jobs) - len(errors)}/{len(jobs)} files, "
+          f"{total[0]} bytes in {dt:.2f}s "
+          f"({total[0] / max(dt, 1e-9) / 1e6:.1f} MB/s, "
+          f"c={args.concurrency})")
+    if errors:
+        raise SystemExit(1)
 
 
 def cmd_watch(args) -> None:
@@ -641,8 +723,11 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("-port", type=int, default=8888)
     f.add_argument("-mserver", default="127.0.0.1:9333")
     f.add_argument("-store", default="sqlite",
-                   help="metadata store: sqlite | memory")
+                   help="metadata store: sqlite | memory | leveldb | "
+                        "redis | etcd")
     f.add_argument("-store_path", default="./filer.db")
+    f.add_argument("-store_servers", default="",
+                   help="host:port for network stores (redis, etcd)")
     f.add_argument("-chunk_size_mb", type=int, default=8)
     f.add_argument("-default_replication", default="")
     f.add_argument("-collection", default="")
@@ -665,6 +750,19 @@ def build_parser() -> argparse.ArgumentParser:
     w.add_argument("-pathPrefix", dest="path_prefix", default="/")
     w.add_argument("-since", type=int, default=0)
     w.set_defaults(fn=cmd_watch)
+
+    fc = sub.add_parser("filer.copy",
+                        help="copy files or whole folders to a filer "
+                             "folder (weed filer.copy)")
+    fc.add_argument("sources", nargs="+",
+                    help="files or directories to upload")
+    fc.add_argument("dest",
+                    help="http://filer:port/path/to/folder/ (must end /)")
+    fc.add_argument("-include", default="",
+                    help="file name pattern, e.g. *.pdf")
+    fc.add_argument("-concurrency", type=int, default=8)
+    fc.add_argument("-collection", default="")
+    fc.set_defaults(fn=cmd_filer_copy)
 
     fr = sub.add_parser("filer.replicate",
                         help="replicate filer changes into a sink "

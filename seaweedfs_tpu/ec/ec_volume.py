@@ -68,6 +68,7 @@ class EcVolume:
         # shard size learned from a peer, for volumes served with no local
         # shards (the reference assumes Shards[0] exists, ec_volume.go:198)
         self.remote_shard_size = 0
+        self._layout_checked = False
         self._lock = threading.RLock()
 
         base = self.base_file_name()
@@ -171,6 +172,10 @@ class EcVolume:
             raise IOError(
                 f"ec volume {self.vid}: shard size unknown (no local shards; "
                 f"set remote_shard_size before serving remote-only reads)")
+        if not self._layout_checked:
+            from .striping import check_layout_marker
+            check_layout_marker(self.base_file_name(), shard_size, self.g)
+            self._layout_checked = True
         dat_size = self.g.data_shards * shard_size
         intervals = locate_data(
             self.g, dat_size, t.stored_to_offset(offset),

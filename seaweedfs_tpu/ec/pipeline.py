@@ -271,6 +271,8 @@ def stream_encode(base_file_name: str, coder: ErasureCoder,
         os.close(dat_fd)
     if fan.errors:
         raise fan.errors[0]
+    from .striping import write_layout_marker
+    write_layout_marker(base_file_name, dat_size)
 
 
 # staged window default: bounded so a >HBM volume streams in windows; one

@@ -77,6 +77,56 @@ def write_ec_files(base_file_name: str, coder: ErasureCoder,
     finally:
         for f in outputs:
             f.close()
+    write_layout_marker(base_file_name, dat_size)
+
+
+LAYOUT_VERSION = 2  # padded-final-large-row tail rule (see write_ec_files)
+
+
+def write_layout_marker(base_file_name: str, dat_size: int) -> None:
+    """Record the striping layout version in a .ecm sidecar so a shard
+    set encoded under the PRE-round-3 tail rule (small rows where the new
+    rule pads a large row) is detected at mount instead of silently
+    misaddressing. The marker is a sidecar — shard bytes stay bit-exact
+    vs the reference's own fixture."""
+    import json as json_mod
+    tmp = base_file_name + ".ecm.tmp"
+    with open(tmp, "w") as f:
+        json_mod.dump({"layout_version": LAYOUT_VERSION,
+                       "dat_size": dat_size}, f)
+    os.replace(tmp, base_file_name + ".ecm")
+
+
+def check_layout_marker(base_file_name: str, shard_size: int,
+                        g: Geometry) -> None:
+    """Detect stale striping layouts at serve time. A marker with the
+    wrong version is a hard error (the set was provably encoded under a
+    different tail rule). An ABSENT marker on a shard size that is an
+    exact multiple of large_block is only a loud warning: every healthy
+    v2 volume of L whole large rows also has that size, and markers are
+    sidecars that legitimately go missing (remote-only serving, shard
+    copies from pre-marker peers) — refusing would take valid data
+    offline on a heuristic. Pre-round-3 in-dev shard sets are the only
+    ones the warning can actually indicate."""
+    import json as json_mod
+    path = base_file_name + ".ecm"
+    if os.path.exists(path):
+        with open(path) as f:
+            meta = json_mod.load(f)
+        if meta.get("layout_version") != LAYOUT_VERSION:
+            raise IOError(
+                f"{base_file_name}: EC layout version "
+                f"{meta.get('layout_version')} != {LAYOUT_VERSION}; "
+                "re-encode this volume (ec.encode)")
+        return
+    if (shard_size and shard_size >= g.large_block_size
+            and shard_size % g.large_block_size == 0):
+        import logging
+        logging.getLogger("ec").warning(
+            "%s: unmarked EC shard set whose size (%d) is a whole number "
+            "of large blocks; if it was encoded before the v2 tail rule "
+            "it will misaddress — re-encode (ec.encode) to stamp a .ecm",
+            base_file_name, shard_size)
 
 
 def _encode_row(dat, coder: ErasureCoder, start_offset: int, block_size: int,

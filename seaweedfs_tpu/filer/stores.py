@@ -193,9 +193,15 @@ def _redis(**kw):
     return RedisStore(**kw)
 
 
+def _etcd(**kw):
+    from .etcd_store import EtcdStore
+    return EtcdStore(**kw)
+
+
 register_store("memory", MemoryStore)
 register_store("sqlite", _sqlite)
 register_store("mysql", _mysql)
 register_store("postgres", _postgres)
 register_store("leveldb", _leveldb)
 register_store("redis", _redis)
+register_store("etcd", _etcd)

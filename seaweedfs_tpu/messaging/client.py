@@ -171,3 +171,119 @@ class Subscriber:
                 since: int = 0) -> None:
         for e in self.stream(since):
             handler(e)
+
+
+class PubChannel:
+    """Channel-style publish wrapper (msgclient/chan_pub.go:15-75): a
+    named channel is the topic ("chan" namespace, partition 0); put()
+    enqueues without blocking on the broker, a background thread drains
+    the queue in batches, and close() flushes before returning. The
+    running digest mirrors chan_pub's md5 so both ends can compare."""
+
+    def __init__(self, brokers: list[str], chan_name: str,
+                 filer: str = "", ack: str = "memory"):
+        import hashlib
+        import queue as queue_mod
+        import threading
+        self._pub = Publisher(brokers, "chan", chan_name,
+                              partition_count=1, filer=filer, ack=ack)
+        self._q: "queue_mod.Queue" = queue_mod.Queue(maxsize=1024)
+        self._md5 = hashlib.md5()
+        self._err: list[Exception] = []
+        self._closed = False
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def _drain(self) -> None:
+        import queue as queue_mod
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            batch = [item]
+            while len(batch) < 128:
+                try:
+                    nxt = self._q.get_nowait()
+                except queue_mod.Empty:
+                    break
+                if nxt is None:
+                    self._flush(batch)
+                    return
+                batch.append(nxt)
+            self._flush(batch)
+
+    def _flush(self, batch: list) -> None:
+        try:
+            self._pub.publish_many([(b"", v) for v in batch])
+        except Exception as e:
+            self._err.append(e)
+
+    def put(self, message: bytes) -> None:
+        """chan_pub.go Publish: enqueue one message."""
+        if self._closed:
+            raise RuntimeError("channel closed")
+        if self._err:
+            raise self._err[0]
+        self._q.put(message)
+        self._md5.update(message)
+
+    def digest(self) -> str:
+        return self._md5.hexdigest()
+
+    def close(self) -> None:
+        """Flush and stop (chan_pub.go Close sends the EOF marker)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(None)
+        self._thread.join(timeout=30)
+        if self._err:
+            raise self._err[0]
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class SubChannel:
+    """Channel-style subscribe wrapper (msgclient/chan_sub.go:16-80):
+    iterate messages like receiving from a Go channel; a background
+    thread feeds an internal queue so slow consumers don't stall the
+    HTTP stream. The iterator ends when the producer side is idle past
+    `idle_timeout` (the HTTP analog of the channel closing)."""
+
+    _DONE = object()
+
+    def __init__(self, brokers: list[str], chan_name: str,
+                 since: int = 0, idle_timeout: float = 5.0):
+        import hashlib
+        import queue as queue_mod
+        import threading
+        self._sub = Subscriber(brokers, "chan", chan_name, partition=0)
+        self._q: "queue_mod.Queue" = queue_mod.Queue(maxsize=1024)
+        self._md5 = hashlib.md5()
+        self._idle = idle_timeout
+        self._since = since
+        self._thread = threading.Thread(target=self._feed, daemon=True)
+        self._thread.start()
+
+    def _feed(self) -> None:
+        try:
+            for e in self._sub.stream(since=self._since,
+                                      timeout=self._idle):
+                self._q.put(e.value)
+        finally:
+            self._q.put(self._DONE)
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is self._DONE:
+                return
+            self._md5.update(item)
+            yield item
+
+    def digest(self) -> str:
+        return self._md5.hexdigest()

@@ -62,13 +62,73 @@ def _gf_kernel(w_ref, data_ref, out_ref, *, rows: int, cols: int):
     out_ref[:] = out.astype(jnp.uint8)
 
 
+def _nibble_weights(rows: int) -> np.ndarray:
+    """[rows, 4*rows] int8 selector: out[r] = sum_i 2^i * planes[i*rows+r]
+    for 4 planes — the byte-repack as an MXU contraction (two of these
+    cover the 8 planes; 2^i stays <= 8, inside int8)."""
+    w2 = np.zeros((rows, 4 * rows), dtype=np.int8)
+    for i in range(4):
+        for r in range(rows):
+            w2[r, i * rows + r] = 1 << i
+    return w2
+
+
+def _gf_kernel_mxu_repack(w_ref, w2_ref, data_ref, out_ref, *, rows: int,
+                          cols: int):
+    """_gf_kernel with the 8-iteration VPU repack chain replaced by two
+    tiny nibble matmuls: the kernel self-diagnosed VPU-bound (bench round
+    3: 4.3% MXU, repack ~10 of ~18 VPU ops/byte), so the byte
+    reconstruction out[r] = sum_i 2^i * plane_i[r] — linear in the planes
+    — rides the idle MXU instead.
+
+    MEASURED (v5e, RS(10,4), 64M cols): 32.4 GB/s at tile 64K (the extra
+    VMEM temps OOM larger tiles) vs 35.4 GB/s for the VPU chain at 256K.
+    The [rows, 4*rows] contraction has M=4 output rows — ~3% occupancy of
+    the 128x128 systolic array — so the int8 cast + second VMEM pass cost
+    more than the VPU ops they replace. Structural conclusion: for small
+    m, no matmul formulation of the repack can win, and without an int4/
+    packed-plane MXU operand (not available via Mosaic on v5e) the
+    bitplane kernel's ~35 GB/s VPU bound stands; wider geometries already
+    scale past it (RS(20,4) measures 61-66 GB/s, 3x the 20 GB/s target).
+    Kept for A/B regression testing (bit-exact, tests cover it)."""
+    data = data_ref[:].astype(jnp.int32)  # [C, T]
+    planes = [((data >> j) & 1).astype(jnp.int8) for j in range(8)]
+    bits = jnp.concatenate(planes, axis=0)
+    acc = jax.lax.dot_general(
+        w_ref[:], bits,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # [8*R, T] plane-major
+    lsb = (acc & 1).astype(jnp.int8)  # [8R, T] one op
+    w2 = w2_ref[:]
+    lo = jax.lax.dot_general(
+        w2, lsb[: 4 * rows, :],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    hi = jax.lax.dot_general(
+        w2, lsb[4 * rows:, :],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out_ref[:] = (lo | (hi << 4)).astype(jnp.uint8)
+
+
 @functools.lru_cache(maxsize=128)
 def _build_apply(matrix_bytes: bytes, rows: int, cols: int, tile: int,
-                 interpret: bool):
+                 interpret: bool, repack: str = "vpu"):
     w = np.frombuffer(matrix_bytes, dtype=np.uint8).reshape(rows, cols)
     wp = jnp.asarray(_plane_major_matrix(w))  # [8R, 8C] int8
 
-    kernel = functools.partial(_gf_kernel, rows=rows, cols=cols)
+    if repack == "mxu":
+        kernel = functools.partial(_gf_kernel_mxu_repack, rows=rows,
+                                   cols=cols)
+        w2 = jnp.asarray(_nibble_weights(rows))
+        extra_specs = [pl.BlockSpec((rows, 4 * rows), lambda i: (0, 0),
+                                    memory_space=pltpu.VMEM)]
+        extra_args = (w2,)
+    else:
+        kernel = functools.partial(_gf_kernel, rows=rows, cols=cols)
+        extra_specs = []
+        extra_args = ()
 
     @jax.jit
     def apply_fn(data: jnp.ndarray) -> jnp.ndarray:
@@ -82,20 +142,24 @@ def _build_apply(matrix_bytes: bytes, rows: int, cols: int, tile: int,
             in_specs=[
                 pl.BlockSpec((8 * rows, 8 * cols), lambda i: (0, 0),
                              memory_space=pltpu.VMEM),
+                *extra_specs,
                 pl.BlockSpec((cols, tile), lambda i: (0, i),
                              memory_space=pltpu.VMEM),
             ],
             out_specs=pl.BlockSpec((rows, tile), lambda i: (0, i),
                                    memory_space=pltpu.VMEM),
             interpret=interpret,
-        )(wp, data)
+        )(wp, *extra_args, data)
 
     return apply_fn
 
 
 def gf_apply_pallas(matrix: np.ndarray, tile: int = DEFAULT_TILE,
-                    interpret: bool | None = None):
-    """Return fn: data [C, n] uint8 -> [R, n] uint8; n padded to tile inside."""
+                    interpret: bool | None = None, repack: str = "vpu"):
+    """Return fn: data [C, n] uint8 -> [R, n] uint8; n padded to tile inside.
+
+    repack: "vpu" (8-iteration or/shift chain) or "mxu" (two nibble
+    matmuls — see _gf_kernel_mxu_repack)."""
     matrix = np.asarray(matrix, dtype=np.uint8)
     rows, cols = matrix.shape
     if interpret is None:
@@ -104,7 +168,8 @@ def gf_apply_pallas(matrix: np.ndarray, tile: int = DEFAULT_TILE,
         # the interpreter pads every call to the tile width; big TPU tiles
         # would turn small test inputs into quarter-million-column runs
         tile = min(tile, 16384)
-    raw = _build_apply(matrix.tobytes(), rows, cols, tile, interpret)
+    raw = _build_apply(matrix.tobytes(), rows, cols, tile, interpret,
+                       repack)
 
     def apply_fn(data: jnp.ndarray) -> jnp.ndarray:
         n = data.shape[1]

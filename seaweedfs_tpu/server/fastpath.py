@@ -48,9 +48,12 @@ _E400 = json.dumps({"error": "missing file id"}).encode()
 def _parse_query(q: str) -> dict:
     out = {}
     if q:
+        from urllib.parse import unquote_plus
         for pair in q.split("&"):
             k, _, v = pair.partition("=")
-            out[k] = v
+            # decode like the aiohttp handlers do, or the same request
+            # means different things on the two code paths
+            out[unquote_plus(k)] = unquote_plus(v)
     return out
 
 
@@ -156,7 +159,16 @@ class FastVolumeProtocol(asyncio.Protocol):
         if b"transfer-encoding" in headers or b"expect" in headers:
             # framing we don't speak (chunked bodies, 100-continue
             # handshakes): hand the whole connection to aiohttp BEFORE
-            # trying to frame the body, or both sides deadlock waiting
+            # trying to frame the body, or both sides deadlock waiting.
+            # Admission runs FIRST — the proxied request carries the
+            # whitelist-bypassing internal token, so an unchecked tunnel
+            # would let any client evade a configured IP whitelist.
+            path = target.decode("latin-1").partition("?")[0]
+            if not await self._admit(path):
+                self._send(403, json.dumps({"error": "ip not allowed"}
+                                           ).encode())
+                self.transport.close()
+                return None
             self.buf = b""
             await self._proxy_tunnel(head + b"\r\n\r\n" + rest)
             return None
@@ -193,11 +205,18 @@ class FastVolumeProtocol(asyncio.Protocol):
                 f"Content-Length: {len(body)}\r\n{extra}\r\n")
         self.transport.write(head.encode("latin-1") + body)
 
+    # --- admission (matches the aiohttp guard middleware; runs BEFORE
+    # any proxying because proxied requests carry the internal token) ---
+    async def _admit(self, path: str) -> bool:
+        if path == "/healthz":
+            return True
+        return self.server.guard.check_whitelist(self.peer_ip)
+
     # --- dispatch ---
     async def _dispatch(self, method: str, path: str, query: str,
                         headers: dict, body: bytes, raw: bytes) -> None:
         guard = self.server.guard
-        if path != "/healthz" and not guard.check_whitelist(self.peer_ip):
+        if not await self._admit(path):
             self._send(403, json.dumps({"error": "ip not allowed"}).encode())
             return
         if path in _PROXY_EXACT or path.startswith(_PROXY_PREFIX):
@@ -497,18 +516,24 @@ class FastMasterProtocol(FastVolumeProtocol):
     the aiohttp app. Inherits framing/proxy from FastVolumeProtocol;
     only the route dispatch differs."""
 
+    async def _admit(self, path: str) -> bool:
+        # same admission as the master's guard_mw: peers, whitelist, or a
+        # one-shot peer refresh — for EVERY route, proxied ones included
+        if path == "/healthz":
+            return True
+        server = self.server
+        return (self.peer_ip in server._peer_ips
+                or server.guard.check_whitelist(self.peer_ip)
+                or await server._refresh_peer_ips(self.peer_ip))
+
     async def _dispatch(self, method: str, path: str, query: str,
                         headers: dict, body: bytes, raw: bytes) -> None:
         server = self.server
+        if not await self._admit(path):
+            self._send(403, json.dumps({"error": "ip not allowed"}).encode())
+            return
         if path not in ("/dir/assign", "/dir/lookup"):
             await self._proxy(raw)
-            return
-        # same admission as the master's guard_mw: peers, whitelist, or a
-        # one-shot peer refresh
-        if not (self.peer_ip in server._peer_ips
-                or server.guard.check_whitelist(self.peer_ip)
-                or await server._refresh_peer_ips(self.peer_ip)):
-            self._send(403, json.dumps({"error": "ip not allowed"}).encode())
             return
         # followers proxy API traffic to the leader via the aiohttp app's
         # leader_proxy_mw

@@ -1141,15 +1141,15 @@ class VolumeServer:
         try:
             exts = [ec_mod.to_ext(sid) for sid in shard_ids]
             if copy_ecx:
-                exts += [".ecx", ".ecj"]
+                exts += [".ecx", ".ecj", ".ecm"]
             for ext in exts:
                 async with self._session.get(
                         f"http://{source}/admin/file_copy",
                         params={"volume_id": str(vid),
                                 "collection": collection,
                                 "ext": ext}) as r:
-                    if r.status == 404 and ext == ".ecj":
-                        continue  # delete journal is optional
+                    if r.status == 404 and ext in (".ecj", ".ecm"):
+                        continue  # delete journal / layout marker optional
                     if r.status != 200:
                         return web.json_response(
                             {"error": f"copy {ext} from {source}: "

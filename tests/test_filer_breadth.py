@@ -19,7 +19,7 @@ from seaweedfs_tpu.filer.entry import new_directory, new_file
 from seaweedfs_tpu.filer.stores import create_store
 
 
-@pytest.fixture(params=["memory", "sqlite", "leveldb", "redis"])
+@pytest.fixture(params=["memory", "sqlite", "leveldb", "redis", "etcd"])
 def store(request, tmp_path):
     kwargs = {}
     fake = None
@@ -32,6 +32,11 @@ def store(request, tmp_path):
         from seaweedfs_tpu.filer.fake_redis import FakeRedisServer
         fake = FakeRedisServer()
         kwargs["host"], kwargs["port"] = fake.host, fake.port
+    if request.param == "etcd":
+        # ordered-KV-range store proven against the in-repo v3-gateway fake
+        from seaweedfs_tpu.filer.fake_etcd import FakeEtcdServer
+        fake = FakeEtcdServer()
+        kwargs["servers"] = fake.servers
     s = create_store(request.param, **kwargs)
     yield s
     s.close()

@@ -37,6 +37,48 @@ def _get(filer, path, headers=None):
     return urllib.request.urlopen(req, timeout=60)
 
 
+def test_filer_copy_tree_upload(filer, tmp_path):
+    """weed filer.copy dir/ http://filer/path/ — parallel tree upload
+    (weed/command/filer_copy.go:78,365)."""
+    import argparse
+    import random as rnd
+
+    from seaweedfs_tpu.cli import cmd_filer_copy
+
+    rng = rnd.Random(9)
+    tree = {
+        "top.txt": b"root file",
+        "sub/a.bin": rng.randbytes(20 * 1024),  # multi-chunk at 16KB
+        "sub/deeper/b.txt": b"deep" * 100,
+        "sub/deeper/c.log": b"log line\n" * 50,
+    }
+    src = tmp_path / "srcdir"
+    for rel, data in tree.items():
+        p = src / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_bytes(data)
+    (src / "skip.tmp").write_bytes(b"excluded")
+
+    args = argparse.Namespace(
+        sources=[str(src)], dest=f"http://{filer.url}/ingest/",
+        include="", concurrency=4, collection="")
+    cmd_filer_copy(args)
+
+    for rel, data in tree.items():
+        with _get(filer, f"/ingest/srcdir/{rel}") as r:
+            assert r.read() == data, rel
+
+    # -include filters by pattern
+    args = argparse.Namespace(
+        sources=[str(src)], dest=f"http://{filer.url}/ingest2/",
+        include="*.txt", concurrency=2, collection="")
+    cmd_filer_copy(args)
+    with _get(filer, "/ingest2/srcdir/top.txt") as r:
+        assert r.read() == tree["top.txt"]
+    with pytest.raises(urllib.error.HTTPError):
+        _get(filer, "/ingest2/srcdir/sub/a.bin")
+
+
 def test_small_file_roundtrip(filer):
     out = _put(filer, "/docs/hello.txt", b"hello filer",
                ctype="text/plain")

@@ -244,6 +244,87 @@ def test_broker_failover_on_death(cluster, broker_pair):
     assert values == [b"before-death", b"after-death"]
 
 
+def test_pub_sub_channels(cluster):
+    """Channel-style wrappers (msgclient/chan_pub.go, chan_sub.go): put()
+    into a named channel, iterate out of it, digests agree."""
+    from seaweedfs_tpu.messaging.client import PubChannel, SubChannel
+
+    b = _add_broker(cluster)
+    with PubChannel([b.url], "jobs") as pc:
+        for i in range(40):
+            pc.put(f"job-{i}".encode())
+    sc = SubChannel([b.url], "jobs", idle_timeout=1.0)
+    got = list(sc)
+    assert got == [f"job-{i}".encode() for i in range(40)]
+    assert sc.digest() == pc.digest()
+
+
+def test_broker_sigkill_ack_durability_contract(cluster, tmp_path):
+    """The ack-level contract UNDER a kill -9 (topic_manager.go:42-116
+    posture): messages acked with ack=flush survive the crash (their
+    segments are in the filer); the ack=memory tail that never flushed is
+    lost — exactly that tail, nothing more."""
+    import os as os_mod
+    import signal
+    import subprocess
+    import sys as sys_mod
+    import time as time_mod
+    import urllib.request
+
+    from cluster_util import free_port
+
+    filer = cluster.add_filer()
+    port = free_port()
+    import seaweedfs_tpu
+    pkg_root = os_mod.path.dirname(
+        os_mod.path.dirname(seaweedfs_tpu.__file__))
+    env = dict(os_mod.environ, JAX_PLATFORMS="cpu",
+               SEAWEEDFS_FORCE_CPU="1")
+    env["PYTHONPATH"] = pkg_root + os_mod.pathsep + env.get(
+        "PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys_mod.executable, "-m", "seaweedfs_tpu.cli", "msg.broker",
+         "-ip", "127.0.0.1", "-port", str(port),
+         "-filer", filer.url], env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    url = f"127.0.0.1:{port}"
+    try:
+        deadline = time_mod.time() + 20
+        while True:
+            try:
+                urllib.request.urlopen(f"http://{url}/topics",
+                                       timeout=1).close()
+                break
+            except Exception:
+                if time_mod.time() > deadline:
+                    raise
+                time_mod.sleep(0.2)
+
+        flush_pub = Publisher([url], "dur", "crash", partition_count=1,
+                              ack="flush")
+        for i in range(5):
+            flush_pub.publish(b"k", f"durable-{i}".encode())
+        mem_pub = Publisher([url], "dur", "crash", partition_count=1,
+                            ack="memory")
+        for i in range(7):
+            mem_pub.publish(b"k", f"volatile-{i}".encode())
+
+        # kill -9: no flush, no goodbye
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    # a fresh broker over the same filer serves the persisted history
+    b2 = _add_broker(cluster, filer_url=filer.url)
+    sub = Subscriber([b2.url], "dur", "crash", partition=0)
+    values = [e.value.decode() for e in sub.stream(since=0, timeout=1.0)]
+    assert values == [f"durable-{i}" for i in range(5)], values
+    # the loss set is exactly the unflushed ack=memory tail
+    assert not any(v.startswith("volatile") for v in values)
+
+
 def test_messaging_grpc_service(cluster):
     """The 4th proto service (proto/messaging.proto): Publish/Subscribe
     bidi streams, topic configuration, FindBroker."""

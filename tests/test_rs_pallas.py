@@ -38,6 +38,27 @@ def test_pallas_arbitrary_matrix():
     assert np.array_equal(got, want)
 
 
+def test_pallas_mxu_repack_bit_exact():
+    """The nibble-matmul repack variant must be bit-identical to the VPU
+    chain for both the parity matrix and arbitrary matrices."""
+    rng = np.random.default_rng(23)
+    data = rng.integers(0, 256, (10, 2048), dtype=np.uint8)
+    want = gf256.encode_parity(data, 4)
+    fn = rs_pallas.gf_apply_pallas(gf256.parity_matrix(10, 4), tile=1024,
+                                   repack="mxu")
+    assert np.array_equal(np.asarray(fn(data)), want)
+    mat = rng.integers(0, 256, (5, 9)).astype(np.uint8)
+    d2 = rng.integers(0, 256, (9, 1024), dtype=np.uint8)
+    want2 = gf256.gf_matrix_apply(mat, d2) \
+        if hasattr(gf256, "gf_matrix_apply") else None
+    got2 = np.asarray(rs_pallas.gf_apply_pallas(mat, tile=1024,
+                                                repack="mxu")(d2))
+    ref = np.asarray(rs_pallas.gf_apply_pallas(mat, tile=1024)(d2))
+    assert np.array_equal(got2, ref)
+    if want2 is not None:
+        assert np.array_equal(got2, want2)
+
+
 def test_pallas_coder_roundtrip():
     from seaweedfs_tpu.ec import get_coder
     coder = get_coder("pallas", 10, 4)

@@ -181,6 +181,58 @@ def test_rebuild_device_sink_too_few_survivors(tmp_path):
         pipeline.stream_rebuild_device_sink(base, coder, [5, 6], GEO)
 
 
+def test_ec_layout_marker(tmp_path, caplog):
+    """Both encode paths stamp .ecm; a marker with a stale version is
+    refused; an unmarked set in the ambiguity window (shard size a whole
+    number of large blocks) warns loudly but keeps serving — sidecars
+    legitimately go missing (remote serving, copies), and every healthy
+    L-large-row volume has that size too."""
+    import json
+    import logging
+
+    build_volume(tmp_path)
+    coder = ec.get_coder("numpy", 10, 4)
+    base = os.path.join(str(tmp_path), "1")
+    pipeline.stream_encode(base, coder, GEO, batch_size=4096)
+    ec.write_sorted_ecx_from_idx(base)
+    meta = json.load(open(base + ".ecm"))
+    assert meta["layout_version"] == 2
+
+    ev = ec.EcVolume(str(tmp_path), "", 1, GEO, coder=coder)
+    for sid in range(14):
+        ev.add_shard(sid)
+    ev.read_needle(1)  # marked: serves fine
+    ev.close()
+
+    # stale layout version: hard refusal
+    json.dump({"layout_version": 1}, open(base + ".ecm", "w"))
+    ev = ec.EcVolume(str(tmp_path), "", 1, GEO, coder=coder)
+    for sid in range(14):
+        ev.add_shard(sid)
+    with pytest.raises(IOError, match="layout version"):
+        ev.read_needle(1)
+    ev.close()
+
+    # unmarked + ambiguous size: warning, not refusal
+    os.remove(base + ".ecm")
+    sz = os.path.getsize(base + ec.to_ext(0))
+    pad = (-sz) % GEO.large_block_size or GEO.large_block_size
+    for i in range(14):
+        with open(base + ec.to_ext(i), "ab") as f:
+            f.write(bytes(pad))
+    ev = ec.EcVolume(str(tmp_path), "", 1, GEO, coder=coder)
+    for sid in range(14):
+        ev.add_shard(sid)
+    with caplog.at_level(logging.WARNING, logger="ec"):
+        try:
+            ev.read_needle(1)
+        except Exception:
+            pass  # the padded layout really is misaddressed — the point
+            # here is that the warning fired before any read was served
+    assert any("unmarked EC shard set" in r.message for r in caplog.records)
+    ev.close()
+
+
 def test_stream_rebuild_too_few_shards(tmp_path):
     build_volume(tmp_path)
     coder = ec.get_coder("numpy", 10, 4)
