@@ -1,0 +1,155 @@
+"""In-repo fake mongod: the OP_MSG command subset MongodbStore speaks —
+ping, find (equality + $gt/$gte/$lt on string fields, sort by name,
+limit), upsert update, delete (limit 0/1) — over the real wire framing
+with the same BSON subset codec. One in-memory `filemeta` collection
+keyed by (directory, name). Same fake-server technique as
+filer/fake_redis.py (RESP) and filer/fake_etcd.py (HTTP gateway).
+"""
+
+from __future__ import annotations
+
+import socketserver
+import struct
+import threading
+
+from . import bson_lite as bson
+
+OP_MSG = 2013
+
+
+def _match_cond(value, cond) -> bool:
+    if isinstance(cond, dict):
+        for op, rhs in cond.items():
+            if op == "$gt" and not value > rhs:
+                return False
+            elif op == "$gte" and not value >= rhs:
+                return False
+            elif op == "$lt" and not value < rhs:
+                return False
+            elif op == "$lte" and not value <= rhs:
+                return False
+            elif op not in ("$gt", "$gte", "$lt", "$lte"):
+                raise ValueError(f"fake_mongo: unsupported operator {op}")
+        return True
+    return value == cond
+
+
+def _match(doc: dict, flt: dict) -> bool:
+    return all(_match_cond(doc.get(k), cond) for k, cond in flt.items())
+
+
+class _State:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.docs: dict[tuple[str, str], dict] = {}
+
+    def find(self, flt: dict, sort: dict | None, limit: int) -> list[dict]:
+        with self.lock:
+            rows = [d for d in self.docs.values() if _match(d, flt)]
+        if sort:
+            key, direction = next(iter(sort.items()))
+            rows.sort(key=lambda d: d.get(key) or "",
+                      reverse=direction < 0)
+        else:
+            rows.sort(key=lambda d: (d.get("directory", ""),
+                                     d.get("name", "")))
+        return rows[:limit] if limit else rows
+
+    def upsert(self, q: dict, u: dict) -> int:
+        with self.lock:
+            for k, d in list(self.docs.items()):
+                if _match(d, q):
+                    self.docs[k] = dict(u)
+                    return 1
+            self.docs[(u.get("directory", ""), u.get("name", ""))] = dict(u)
+            return 1
+
+    def delete(self, q: dict, limit: int) -> int:
+        with self.lock:
+            victims = [k for k, d in self.docs.items() if _match(d, q)]
+            if limit:
+                victims = victims[:limit]
+            for k in victims:
+                del self.docs[k]
+            return len(victims)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.request.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError
+            buf += chunk
+        return buf
+
+    def handle(self):
+        state: _State = self.server.state  # type: ignore[attr-defined]
+        try:
+            while True:
+                header = self._read_exact(16)
+                length, req_id, _resp, opcode = struct.unpack("<iiii",
+                                                              header)
+                payload = self._read_exact(length - 16)
+                if opcode != OP_MSG or payload[4] != 0:
+                    return
+                cmd, _ = bson.decode_doc(payload, 5)
+                reply = self._execute(state, cmd)
+                body = (struct.pack("<I", 0) + b"\x00"
+                        + bson.encode_doc(reply))
+                self.request.sendall(
+                    struct.pack("<iiii", 16 + len(body), 1, req_id,
+                                OP_MSG) + body)
+        except (ConnectionError, OSError):
+            return
+
+    @staticmethod
+    def _execute(state: _State, cmd: dict) -> dict:
+        if "ping" in cmd or "ismaster" in cmd or "hello" in cmd:
+            return {"ok": 1.0}
+        if "find" in cmd:
+            rows = state.find(cmd.get("filter", {}), cmd.get("sort"),
+                              int(cmd.get("limit", 0)))
+            ns = f"{cmd.get('$db', 'db')}.{cmd['find']}"
+            return {"cursor": {"id": 0, "ns": ns, "firstBatch": rows},
+                    "ok": 1.0}
+        if "update" in cmd:
+            n = 0
+            for upd in cmd.get("updates", []):
+                if not upd.get("upsert"):
+                    raise ValueError("fake_mongo: only upsert updates")
+                n += state.upsert(upd.get("q", {}), upd.get("u", {}))
+            return {"n": n, "ok": 1.0}
+        if "delete" in cmd:
+            n = 0
+            for dl in cmd.get("deletes", []):
+                n += state.delete(dl.get("q", {}),
+                                  int(dl.get("limit", 0)))
+            return {"n": n, "ok": 1.0}
+        if "insert" in cmd:
+            n = 0
+            with state.lock:
+                for d in cmd.get("documents", []):
+                    state.docs[(d.get("directory", ""),
+                                d.get("name", ""))] = dict(d)
+                    n += 1
+            return {"n": n, "ok": 1.0}
+        return {"ok": 0.0, "errmsg": f"unknown command {list(cmd)[:1]}"}
+
+
+class FakeMongoServer:
+    def __init__(self, host: str = "127.0.0.1"):
+        self.state = _State()
+        self._tcp = socketserver.ThreadingTCPServer((host, 0), _Handler)
+        self._tcp.daemon_threads = True
+        self._tcp.state = self.state  # type: ignore[attr-defined]
+        self.host = host
+        self.port = self._tcp.server_address[1]
+        self._thread = threading.Thread(target=self._tcp.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()

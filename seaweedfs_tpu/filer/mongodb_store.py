@@ -1,0 +1,190 @@
+"""MongoDB filer store — the document-model metadata backend.
+
+Model-faithful port of the reference's mongodb store
+(weed/filer/mongodb/mongodb_store.go:27-200): one `filemeta` collection
+of {directory, name, meta} documents with a unique (directory, name)
+index; FindEntry is a point query, ListDirectoryEntries is
+{directory: d, name: {$gt|$gte: start}} sorted by name with a limit,
+inserts are upserts (InsertEntry delegates to UpdateEntry upstream too).
+
+Speaks the real wire protocol — OP_MSG (opcode 2013) framing with the
+in-repo BSON subset codec (filer/bson_lite.py) — over a plain socket, so
+it works against any mongod; CI proves the store against the in-repo
+fake (filer/fake_mongo.py), the same technique as the redis/etcd/SQL
+backends.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Optional
+
+from . import bson_lite as bson
+from .entry import Entry
+from .stores import FilerStore, _split
+
+OP_MSG = 2013
+_KV_DIR = "\x01kv"  # kv face rows live under a reserved directory
+
+
+class _MongoClient:
+    """Minimal OP_MSG client: one socket, thread-safe, section-0 only."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._req_id = 0
+        self._lock = threading.Lock()
+
+    def command(self, doc: dict) -> dict:
+        # OP_MSG body: flagBits u32 (0) + one kind-0 section (raw BSON)
+        body = struct.pack("<I", 0) + b"\x00" + bson.encode_doc(doc)
+        with self._lock:
+            self._req_id += 1
+            header = struct.pack("<iiii", 16 + len(body), self._req_id,
+                                 0, OP_MSG)
+            self.sock.sendall(header + body)
+            reply = self._read_msg()
+        if reply.get("ok") != 1 and reply.get("ok") != 1.0:
+            raise RuntimeError(f"mongodb error: {reply}")
+        # mongod reports per-document write failures with ok:1 — a
+        # swallowed writeError would be silent metadata loss
+        if reply.get("writeErrors"):
+            raise RuntimeError(f"mongodb write error: "
+                               f"{reply['writeErrors']}")
+        return reply
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("mongodb server closed connection")
+            buf += chunk
+        return buf
+
+    def _read_msg(self) -> dict:
+        header = self._read_exact(16)
+        length, _req, _resp, opcode = struct.unpack("<iiii", header)
+        payload = self._read_exact(length - 16)
+        if opcode != OP_MSG:
+            raise ConnectionError(f"unexpected opcode {opcode}")
+        # flagBits u32, then kind-0 section
+        if payload[4] != 0:
+            raise ConnectionError("only kind-0 sections supported")
+        doc, _ = bson.decode_doc(payload, 5)
+        return doc
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class MongodbStore(FilerStore):
+    name = "mongodb"
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 27017,
+                 database: str = "seaweedfs", **_):
+        self._c = _MongoClient(host, port)
+        self._db = database
+        self._c.command({"ping": 1, "$db": database})
+
+    def _cmd(self, doc: dict) -> dict:
+        doc["$db"] = self._db
+        return self._c.command(doc)
+
+    # --- entry CRUD (mongodb_store.go:95-146) ---
+    def insert_entry(self, entry: Entry) -> None:
+        self.update_entry(entry)  # upstream InsertEntry delegates too
+
+    def update_entry(self, entry: Entry) -> None:
+        d, name = _split(entry.full_path)
+        self._cmd({"update": "filemeta", "updates": [{
+            "q": {"directory": d, "name": name},
+            "u": {"directory": d, "name": name,
+                  "meta": entry.to_json().encode()},
+            "upsert": True}]})
+
+    def find_entry(self, path: str) -> Optional[Entry]:
+        d, name = _split(path)
+        reply = self._cmd({"find": "filemeta",
+                           "filter": {"directory": d, "name": name},
+                           "limit": 1, "singleBatch": True})
+        batch = reply["cursor"]["firstBatch"]
+        if not batch or not batch[0].get("meta"):
+            return None
+        return Entry.from_json(batch[0]["meta"].decode())
+
+    def delete_entry(self, path: str) -> None:
+        d, name = _split(path)
+        self._cmd({"delete": "filemeta", "deletes": [
+            {"q": {"directory": d, "name": name}, "limit": 1}]})
+
+    def delete_folder_children(self, path: str) -> None:
+        # direct children + the deeper tree (directory prefix range) in
+        # two unlimited deletes — same shape as the etcd store
+        self._cmd({"delete": "filemeta", "deletes": [
+            {"q": {"directory": path}, "limit": 0}]})
+        deep = path.rstrip("/") + "/"
+        self._cmd({"delete": "filemeta", "deletes": [
+            {"q": {"directory": {"$gte": deep,
+                                 "$lt": deep[:-1] + "0"}},
+             "limit": 0}]})
+
+    def list_directory_entries(self, dir_path: str, start_file_name: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        flt: dict = {"directory": dir_path}
+        name_cond: dict = {}
+        if start_file_name:
+            name_cond["$gte" if include_start else "$gt"] = start_file_name
+        if prefix:
+            name_cond.setdefault("$gte", prefix)
+            if name_cond["$gte"] < prefix:
+                name_cond["$gte"] = prefix
+        if name_cond:
+            flt["name"] = name_cond
+        want = limit + (64 if prefix else 0)
+        # singleBatch + batchSize: without them a real mongod caps the
+        # first batch at 101 docs and leaves a cursor we never getMore
+        reply = self._cmd({"find": "filemeta", "filter": flt,
+                           "sort": {"name": 1}, "limit": want,
+                           "batchSize": want, "singleBatch": True})
+        out: list[Entry] = []
+        for docu in reply["cursor"]["firstBatch"]:
+            name = docu["name"]
+            if prefix:
+                if name.startswith(prefix):
+                    pass
+                elif name > prefix:
+                    break  # sorted: past the prefix range
+                else:
+                    continue
+            if not docu.get("meta"):
+                continue
+            out.append(Entry.from_json(docu["meta"].decode()))
+            if len(out) >= limit:
+                break
+        return out
+
+    # --- kv face ---
+    def kv_put(self, key: str, value: bytes) -> None:
+        self._cmd({"update": "filemeta", "updates": [{
+            "q": {"directory": _KV_DIR, "name": key},
+            "u": {"directory": _KV_DIR, "name": key, "meta": value},
+            "upsert": True}]})
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        reply = self._cmd({"find": "filemeta",
+                           "filter": {"directory": _KV_DIR, "name": key},
+                           "limit": 1, "singleBatch": True})
+        batch = reply["cursor"]["firstBatch"]
+        return batch[0]["meta"] if batch else None
+
+    def close(self) -> None:
+        self._c.close()

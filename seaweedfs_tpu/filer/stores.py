@@ -198,6 +198,11 @@ def _etcd(**kw):
     return EtcdStore(**kw)
 
 
+def _mongodb(**kw):
+    from .mongodb_store import MongodbStore
+    return MongodbStore(**kw)
+
+
 register_store("memory", MemoryStore)
 register_store("sqlite", _sqlite)
 register_store("mysql", _mysql)
@@ -205,3 +210,4 @@ register_store("postgres", _postgres)
 register_store("leveldb", _leveldb)
 register_store("redis", _redis)
 register_store("etcd", _etcd)
+register_store("mongodb", _mongodb)

@@ -94,6 +94,9 @@ class WriteBatcher:
         self._workers: dict[int, asyncio.Task] = {}
 
     async def write(self, vid: int, needle) -> tuple[int, int, bool]:
+        # (measured: an uncontended inline shortcut here is neutral at
+        # c=16 — the queue is rarely empty under load and the probe cost
+        # is paid on every write — so the single queue path stays)
         q = self._queues.get(vid)
         if q is None:
             q = self._queues[vid] = asyncio.Queue()

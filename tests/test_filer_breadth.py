@@ -19,7 +19,8 @@ from seaweedfs_tpu.filer.entry import new_directory, new_file
 from seaweedfs_tpu.filer.stores import create_store
 
 
-@pytest.fixture(params=["memory", "sqlite", "leveldb", "redis", "etcd"])
+@pytest.fixture(params=["memory", "sqlite", "leveldb", "redis", "etcd",
+                        "mongodb"])
 def store(request, tmp_path):
     kwargs = {}
     fake = None
@@ -37,6 +38,11 @@ def store(request, tmp_path):
         from seaweedfs_tpu.filer.fake_etcd import FakeEtcdServer
         fake = FakeEtcdServer()
         kwargs["servers"] = fake.servers
+    if request.param == "mongodb":
+        # document-model store proven against the in-repo OP_MSG fake
+        from seaweedfs_tpu.filer.fake_mongo import FakeMongoServer
+        fake = FakeMongoServer()
+        kwargs["host"], kwargs["port"] = fake.host, fake.port
     s = create_store(request.param, **kwargs)
     yield s
     s.close()
