@@ -175,10 +175,10 @@ def cmd_filer(args) -> None:
     if args.store in ("sqlite", "leveldb"):
         store_kwargs["path"] = args.store_path
     if args.store_servers:
-        if args.store in ("redis", "mongodb"):
+        if args.store in ("redis", "mongodb", "cassandra"):
             host, _, port = args.store_servers.rpartition(":")
             store_kwargs["host"], store_kwargs["port"] = host, int(port)
-        elif args.store == "etcd":
+        elif args.store in ("etcd", "elastic"):
             store_kwargs["servers"] = args.store_servers
     notifier = load_notifier(load_configuration("notification"))
     _run_forever(run_filer(
@@ -724,10 +724,10 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("-mserver", default="127.0.0.1:9333")
     f.add_argument("-store", default="sqlite",
                    help="metadata store: sqlite | memory | leveldb | "
-                        "redis | etcd | mongodb")
+                        "redis | etcd | mongodb | elastic | cassandra")
     f.add_argument("-store_path", default="./filer.db")
     f.add_argument("-store_servers", default="",
-                   help="host:port for network stores (redis, etcd, mongodb)")
+                   help="host:port (or URL) for network stores (redis, etcd, mongodb, elastic, cassandra)")
     f.add_argument("-chunk_size_mb", type=int, default=8)
     f.add_argument("-default_replication", default="")
     f.add_argument("-collection", default="")

@@ -1,0 +1,165 @@
+"""Elasticsearch filer store — the search-index metadata backend.
+
+Model-faithful port of the reference's elastic7 store
+(weed/filer/elastic/v7/elastic_store.go:33-130): entries are documents
+keyed by the full path, carrying ParentId (the containing directory) so
+a directory listing is ONE filtered+sorted search; the KV face lives in
+a dedicated index (indexKV, elastic_store.go:19-30). Layout here is a
+single `.seaweedfs_filemeta` index with explicit Name sort rather than
+the reference's index-per-top-directory scheme — same model (documents +
+search), simpler operations.
+
+Transport is Elasticsearch's plain REST/JSON API (PUT/GET/DELETE
+`/_doc/`, `_search`, `_delete_by_query`), so it works against a real ES
+cluster; CI proves the store against the in-repo fake
+(filer/fake_elastic.py).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Optional
+from urllib.parse import quote
+
+from .entry import Entry
+from .stores import FilerStore, _split
+
+INDEX = ".seaweedfs_filemeta"
+INDEX_KV = ".seaweedfs_kv_entries"  # elastic_store.go:20
+
+
+class ElasticStore(FilerStore):
+    name = "elastic"
+
+    def __init__(self, servers: str = "http://127.0.0.1:9200",
+                 username: str = "", password: str = "",
+                 timeout: float = 10.0, **_):
+        base = servers.split(",")[0]
+        if not base.startswith("http"):
+            base = "http://" + base
+        self._base = base.rstrip("/")
+        self._timeout = timeout
+        self._auth = None
+        if username and password:
+            import base64
+            self._auth = "Basic " + base64.b64encode(
+                f"{username}:{password}".encode()).decode()
+        self._call("GET", "/")  # connectivity check
+        # explicit keyword mappings: under dynamic mapping a real ES
+        # makes ParentId/Name analyzed `text` fields — term queries then
+        # match analyzer tokens (not literal paths) and sorting on text
+        # is rejected outright (the reference ships explicit kvMappings
+        # for the same reason, elastic_store.go:21-30)
+        for index, props in ((INDEX, {
+                "ParentId": {"type": "keyword"},
+                "Name": {"type": "keyword"},
+                "Entry": {"type": "text", "index": False},
+        }), (INDEX_KV, {"Value": {"type": "binary"}})):
+            try:
+                self._call("PUT", f"/{index}",
+                           {"mappings": {"properties": props}})
+            except urllib.error.HTTPError as e:
+                if e.code != 400:  # resource_already_exists_exception
+                    raise
+
+    # --- transport ---
+    def _call(self, method: str, path: str,
+              payload: Optional[dict] = None,
+              ok_missing: bool = False) -> Optional[dict]:
+        req = urllib.request.Request(
+            self._base + path,
+            data=(json.dumps(payload).encode()
+                  if payload is not None else None),
+            headers={"Content-Type": "application/json",
+                     **({"Authorization": self._auth}
+                        if self._auth else {})},
+            method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=self._timeout) as r:
+                body = r.read()
+                return json.loads(body) if body else {}
+        except urllib.error.HTTPError as e:
+            if e.code == 404 and ok_missing:
+                return None
+            raise
+
+    @staticmethod
+    def _doc_id(path: str) -> str:
+        return quote(path, safe="")
+
+    # --- entry CRUD (elastic_store.go InsertEntry/FindEntry/DeleteEntry) ---
+    def insert_entry(self, entry: Entry) -> None:
+        d, name = _split(entry.full_path)
+        self._call("PUT",
+                   f"/{INDEX}/_doc/{self._doc_id(entry.full_path)}"
+                   "?refresh=true",
+                   {"ParentId": d, "Name": name,
+                    "Entry": entry.to_json()})
+
+    def update_entry(self, entry: Entry) -> None:
+        self.insert_entry(entry)
+
+    def find_entry(self, path: str) -> Optional[Entry]:
+        doc = self._call("GET", f"/{INDEX}/_doc/{self._doc_id(path)}",
+                         ok_missing=True)
+        if doc is None or not doc.get("found"):
+            return None
+        return Entry.from_json(doc["_source"]["Entry"])
+
+    def delete_entry(self, path: str) -> None:
+        self._call("DELETE",
+                   f"/{INDEX}/_doc/{self._doc_id(path)}?refresh=true",
+                   ok_missing=True)
+
+    def delete_folder_children(self, path: str) -> None:
+        # deleteByQuery on the subtree (deleteEntry/deleteDir in the
+        # reference): direct children by ParentId, deeper levels by
+        # ParentId prefix
+        base = path.rstrip("/") or "/"
+        self._call("POST", f"/{INDEX}/_delete_by_query?refresh=true", {
+            "query": {"bool": {"should": [
+                {"term": {"ParentId": base}},
+                {"prefix": {"ParentId": base + "/"}},
+            ]}}}, ok_missing=True)
+
+    def list_directory_entries(self, dir_path: str, start_file_name: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> list[Entry]:
+        filters: list[dict] = [{"term": {"ParentId": dir_path}}]
+        if start_file_name:
+            op = "gte" if include_start else "gt"
+            filters.append({"range": {"Name": {op: start_file_name}}})
+        if prefix:
+            filters.append({"prefix": {"Name": prefix}})
+        result = self._call("POST", f"/{INDEX}/_search", {
+            "query": {"bool": {"filter": filters}},
+            "sort": [{"Name": "asc"}],
+            "size": limit,
+        }, ok_missing=True)  # index not created yet: empty listing
+        if result is None:
+            return []
+        out: list[Entry] = []
+        for hit in result["hits"]["hits"]:
+            out.append(Entry.from_json(hit["_source"]["Entry"]))
+        return out
+
+    # --- kv face (ESKVEntry, elastic_store.go:38-40) ---
+    def kv_put(self, key: str, value: bytes) -> None:
+        import base64
+        self._call("PUT", f"/{INDEX_KV}/_doc/{self._doc_id(key)}"
+                   "?refresh=true",
+                   {"Value": base64.b64encode(value).decode()})
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        import base64
+        doc = self._call("GET", f"/{INDEX_KV}/_doc/{self._doc_id(key)}",
+                         ok_missing=True)
+        if doc is None or not doc.get("found"):
+            return None
+        return base64.b64decode(doc["_source"]["Value"])
+
+    def close(self) -> None:
+        pass  # stateless HTTP client

@@ -1,0 +1,222 @@
+"""In-repo fake Elasticsearch: the REST/JSON subset ElasticStore speaks —
+document PUT/GET/DELETE by _id, `_search` with bool filters (term /
+range / prefix) + Name sort + size, and `_delete_by_query`. Same
+fake-server technique as fake_redis / fake_etcd / fake_mongo; optional
+basic auth to prove the Authorization plumbing.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import unquote, urlparse
+
+_DOC = re.compile(r"^/([^/]+)/_doc/(.+)$")
+_SEARCH = re.compile(r"^/([^/]+)/_search$")
+_DELQ = re.compile(r"^/([^/]+)/_delete_by_query$")
+
+
+def _match_filter(src: dict, f: dict) -> bool:
+    if "term" in f:
+        ((field, want),) = f["term"].items()
+        return src.get(field) == want
+    if "prefix" in f:
+        ((field, want),) = f["prefix"].items()
+        return str(src.get(field, "")).startswith(want)
+    if "range" in f:
+        ((field, conds),) = f["range"].items()
+        v = src.get(field)
+        if v is None:
+            return False
+        for op, rhs in conds.items():
+            if op == "gt" and not v > rhs:
+                return False
+            if op == "gte" and not v >= rhs:
+                return False
+            if op == "lt" and not v < rhs:
+                return False
+            if op == "lte" and not v <= rhs:
+                return False
+        return True
+    raise ValueError(f"fake_elastic: unsupported filter {f}")
+
+
+def _match_query(src: dict, query: dict) -> bool:
+    if not query:
+        return True
+    if "bool" in query:
+        b = query["bool"]
+        if "filter" in b and not all(_match_filter(src, f)
+                                     for f in b["filter"]):
+            return False
+        if "should" in b and not any(_match_filter(src, f)
+                                     for f in b["should"]):
+            return False
+        return True
+    return _match_filter(src, query)
+
+
+def _make_handler(indices: dict, lock: threading.Lock, auth: str):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _reply(self, status: int, doc: dict) -> None:
+            body = json.dumps(doc).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _authed(self) -> bool:
+            return (not auth
+                    or self.headers.get("Authorization") == auth)
+
+        def _body(self) -> dict:
+            ln = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(ln)
+            return json.loads(raw) if raw else {}
+
+        def do_GET(self):
+            if not self._authed():
+                self._reply(401, {"error": "unauthorized"})
+                return
+            path = urlparse(self.path).path
+            if path == "/":
+                self._reply(200, {"name": "fake-elastic",
+                                  "version": {"number": "7.0.0-fake"}})
+                return
+            m = _DOC.match(path)
+            if not m:
+                self._reply(404, {"found": False})
+                return
+            idx, doc_id = m.group(1), unquote(m.group(2))
+            with lock:
+                src = indices.get(idx, {}).get(doc_id)
+            if src is None:
+                self._reply(404, {"found": False, "_id": doc_id})
+            else:
+                self._reply(200, {"found": True, "_id": doc_id,
+                                  "_source": src})
+
+        def do_PUT(self):
+            if not self._authed():
+                self._reply(401, {"error": "unauthorized"})
+                return
+            path = urlparse(self.path).path
+            m = _DOC.match(path)
+            if not m:
+                # index creation with mappings (PUT /<index>)
+                if re.match(r"^/[^/]+$", path):
+                    idx = path[1:]
+                    with lock:
+                        if idx in indices:
+                            self._reply(400, {"error": {
+                                "type":
+                                "resource_already_exists_exception"}})
+                            return
+                        indices[idx] = {}
+                    self._reply(200, {"acknowledged": True})
+                    return
+                self._reply(400, {"error": "bad path"})
+                return
+            idx, doc_id = m.group(1), unquote(m.group(2))
+            src = self._body()
+            with lock:
+                indices.setdefault(idx, {})[doc_id] = src
+            self._reply(200, {"result": "updated", "_id": doc_id})
+
+        def do_DELETE(self):
+            if not self._authed():
+                self._reply(401, {"error": "unauthorized"})
+                return
+            m = _DOC.match(urlparse(self.path).path)
+            if not m:
+                self._reply(400, {"error": "bad path"})
+                return
+            idx, doc_id = m.group(1), unquote(m.group(2))
+            with lock:
+                existed = indices.get(idx, {}).pop(doc_id, None)
+            if existed is None:
+                self._reply(404, {"result": "not_found"})
+            else:
+                self._reply(200, {"result": "deleted"})
+
+        def do_POST(self):
+            if not self._authed():
+                self._reply(401, {"error": "unauthorized"})
+                return
+            path = urlparse(self.path).path
+            body = self._body()
+            m = _SEARCH.match(path)
+            if m:
+                idx = m.group(1)
+                with lock:
+                    missing = idx not in indices
+                if missing:  # real ES: index_not_found_exception
+                    self._reply(404, {"error": {
+                        "type": "index_not_found_exception"}})
+                    return
+                query = body.get("query", {})
+                with lock:
+                    rows = [(doc_id, src) for doc_id, src in
+                            indices.get(idx, {}).items()
+                            if _match_query(src, query)]
+                for sort in reversed(body.get("sort", [])):
+                    ((field, order),) = (sort.items()
+                                         if isinstance(sort, dict)
+                                         else ((sort, "asc"),))
+                    if isinstance(order, dict):
+                        order = order.get("order", "asc")
+                    rows.sort(key=lambda r: r[1].get(field) or "",
+                              reverse=order == "desc")
+                size = int(body.get("size", 10))
+                hits = [{"_id": doc_id, "_source": src}
+                        for doc_id, src in rows[:size]]
+                self._reply(200, {"hits": {
+                    "total": {"value": len(rows)}, "hits": hits}})
+                return
+            m = _DELQ.match(path)
+            if m:
+                idx = m.group(1)
+                with lock:
+                    if idx not in indices:
+                        self._reply(404, {"error": {
+                            "type": "index_not_found_exception"}})
+                        return
+                query = body.get("query", {})
+                with lock:
+                    coll = indices.get(idx, {})
+                    victims = [doc_id for doc_id, src in coll.items()
+                               if _match_query(src, query)]
+                    for doc_id in victims:
+                        del coll[doc_id]
+                self._reply(200, {"deleted": len(victims)})
+                return
+            self._reply(404, {"error": f"no route {path}"})
+
+    return Handler
+
+
+class FakeElasticServer:
+    def __init__(self, host: str = "127.0.0.1", auth: str = ""):
+        self.indices: dict[str, dict[str, dict]] = {}
+        self._lock = threading.Lock()
+        self._srv = ThreadingHTTPServer(
+            (host, 0), _make_handler(self.indices, self._lock, auth))
+        self.host = host
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def servers(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()

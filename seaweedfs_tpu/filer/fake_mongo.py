@@ -13,6 +13,7 @@ import struct
 import threading
 
 from . import bson_lite as bson
+from .netutil import read_exact
 
 OP_MSG = 2013
 
@@ -76,13 +77,7 @@ class _State:
 
 class _Handler(socketserver.BaseRequestHandler):
     def _read_exact(self, n: int) -> bytes:
-        buf = b""
-        while len(buf) < n:
-            chunk = self.request.recv(n - len(buf))
-            if not chunk:
-                raise ConnectionError
-            buf += chunk
-        return buf
+        return read_exact(self.request.recv, n)
 
     def handle(self):
         state: _State = self.server.state  # type: ignore[attr-defined]

@@ -23,6 +23,7 @@ from typing import Optional
 
 from . import bson_lite as bson
 from .entry import Entry
+from .netutil import read_exact
 from .stores import FilerStore, _split
 
 OP_MSG = 2013
@@ -57,13 +58,7 @@ class _MongoClient:
         return reply
 
     def _read_exact(self, n: int) -> bytes:
-        buf = b""
-        while len(buf) < n:
-            chunk = self.sock.recv(n - len(buf))
-            if not chunk:
-                raise ConnectionError("mongodb server closed connection")
-            buf += chunk
-        return buf
+        return read_exact(self.sock.recv, n)
 
     def _read_msg(self) -> dict:
         header = self._read_exact(16)

@@ -1,0 +1,16 @@
+"""Shared socket helpers for the wire-protocol filer stores and their
+in-repo fake servers (mongo OP_MSG, cassandra CQL) — one recv loop to
+maintain instead of a copy per client/handler."""
+
+from __future__ import annotations
+
+
+def read_exact(recv, n: int) -> bytes:
+    """Read exactly n bytes via recv(k) or raise ConnectionError."""
+    buf = b""
+    while len(buf) < n:
+        chunk = recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed connection")
+        buf += chunk
+    return buf

@@ -203,6 +203,16 @@ def _mongodb(**kw):
     return MongodbStore(**kw)
 
 
+def _elastic(**kw):
+    from .elastic_store import ElasticStore
+    return ElasticStore(**kw)
+
+
+def _cassandra(**kw):
+    from .cassandra_store import CassandraStore
+    return CassandraStore(**kw)
+
+
 register_store("memory", MemoryStore)
 register_store("sqlite", _sqlite)
 register_store("mysql", _mysql)
@@ -211,3 +221,5 @@ register_store("leveldb", _leveldb)
 register_store("redis", _redis)
 register_store("etcd", _etcd)
 register_store("mongodb", _mongodb)
+register_store("elastic", _elastic)
+register_store("cassandra", _cassandra)

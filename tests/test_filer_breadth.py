@@ -20,7 +20,7 @@ from seaweedfs_tpu.filer.stores import create_store
 
 
 @pytest.fixture(params=["memory", "sqlite", "leveldb", "redis", "etcd",
-                        "mongodb"])
+                        "mongodb", "elastic", "cassandra"])
 def store(request, tmp_path):
     kwargs = {}
     fake = None
@@ -42,6 +42,16 @@ def store(request, tmp_path):
         # document-model store proven against the in-repo OP_MSG fake
         from seaweedfs_tpu.filer.fake_mongo import FakeMongoServer
         fake = FakeMongoServer()
+        kwargs["host"], kwargs["port"] = fake.host, fake.port
+    if request.param == "elastic":
+        # search-index store proven against the in-repo REST fake
+        from seaweedfs_tpu.filer.fake_elastic import FakeElasticServer
+        fake = FakeElasticServer()
+        kwargs["servers"] = fake.servers
+    if request.param == "cassandra":
+        # wide-column store proven against the in-repo CQL v4 fake
+        from seaweedfs_tpu.filer.fake_cassandra import FakeCassandraServer
+        fake = FakeCassandraServer()
         kwargs["host"], kwargs["port"] = fake.host, fake.port
     s = create_store(request.param, **kwargs)
     yield s
