@@ -184,14 +184,16 @@ class CassandraStore(FilerStore):
         base = path.rstrip("/") or "/"
         # one partition per directory: direct children are one partition
         # delete (cassandra_store.go DeleteFolderChildren); deeper
-        # directories are enumerated via their partition keys
+        # directories are enumerated via their partition keys. Root is
+        # special: every non-kv partition is under it.
+        deep_prefix = "/" if base == "/" else base + "/"
         self._c.query("DELETE FROM filemeta WHERE directory=?",
                       (base.encode(),))
         rows = self._c.query(
             "SELECT DISTINCT directory FROM filemeta", ())
         for (d,) in rows:
             ds = d.decode()
-            if ds.startswith(base + "/"):
+            if ds.startswith(deep_prefix) and ds != _KV_DIR:
                 self._c.query("DELETE FROM filemeta WHERE directory=?",
                               (d,))
 

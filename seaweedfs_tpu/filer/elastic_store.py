@@ -61,8 +61,11 @@ class ElasticStore(FilerStore):
                 self._call("PUT", f"/{index}",
                            {"mappings": {"properties": props}})
             except urllib.error.HTTPError as e:
-                if e.code != 400:  # resource_already_exists_exception
-                    raise
+                body = e.read().decode("utf-8", "replace")
+                if e.code == 400 and "resource_already_exists" in body:
+                    continue  # index from a previous run: fine
+                raise  # anything else (e.g. mapper_parsing_exception)
+                # would leave dynamic text mappings that break listings
 
     # --- transport ---
     def _call(self, method: str, path: str,
@@ -118,10 +121,12 @@ class ElasticStore(FilerStore):
         # reference): direct children by ParentId, deeper levels by
         # ParentId prefix
         base = path.rstrip("/") or "/"
+        # root is special: every document's ParentId starts with "/"
+        deep_prefix = "/" if base == "/" else base + "/"
         self._call("POST", f"/{INDEX}/_delete_by_query?refresh=true", {
             "query": {"bool": {"should": [
                 {"term": {"ParentId": base}},
-                {"prefix": {"ParentId": base + "/"}},
+                {"prefix": {"ParentId": deep_prefix}},
             ]}}}, ok_missing=True)
 
     def list_directory_entries(self, dir_path: str, start_file_name: str = "",

@@ -93,6 +93,35 @@ class S3Server:
         if self._session:
             await self._session.close()
 
+    @staticmethod
+    def _sigv4_string_to_sign(request: web.Request, signed_headers: list,
+                              payload_hash: str, amz_date: str,
+                              scope: str,
+                              skip_query: tuple = ()) -> str:
+        """Canonical request -> string-to-sign, shared by the header and
+        presigned auth paths so the canonical form cannot drift."""
+        cq = []
+        for k in sorted(request.query.keys()):
+            if k in skip_query:
+                continue
+            for v in request.query.getall(k):
+                cq.append(f"{urllib.parse.quote(k, safe='-_.~')}="
+                          f"{urllib.parse.quote(v, safe='-_.~')}")
+        canonical_headers = "".join(
+            f"{h}:{' '.join(request.headers.get(h, '').split())}\n"
+            for h in signed_headers)
+        canonical = "\n".join([
+            request.method,
+            urllib.parse.quote(request.path, safe="/-_.~"),
+            "&".join(cq),
+            canonical_headers,
+            ";".join(signed_headers),
+            payload_hash,
+        ])
+        return "\n".join([
+            "AWS4-HMAC-SHA256", amz_date, scope,
+            hashlib.sha256(canonical.encode()).hexdigest()])
+
     # --- auth (SigV4 header scheme + per-action ACLs) ---
     def _check_auth(self, request: web.Request,
                     action: str = "", bucket: str = ""
@@ -102,6 +131,8 @@ class S3Server:
         context on the request for streaming-chunked payloads."""
         if not self.iam.enabled:
             return None  # anonymous mode
+        if request.query.get("X-Amz-Algorithm") == "AWS4-HMAC-SHA256":
+            return self._check_presigned(request, action, bucket)
         auth = request.headers.get("Authorization", "")
         if not auth.startswith("AWS4-HMAC-SHA256 "):
             return _error("AccessDenied", "missing signature", 403)
@@ -119,29 +150,13 @@ class S3Server:
                     "AccessDenied",
                     f"{identity.name} may not {action} on {bucket}", 403)
             signed_headers = parts["SignedHeaders"].split(";")
-            # canonical request
-            canonical_headers = "".join(
-                f"{h}:{' '.join(request.headers.get(h, '').split())}\n"
-                for h in signed_headers)
-            cq = []
-            for k in sorted(request.query.keys()):
-                for v in request.query.getall(k):
-                    cq.append(f"{urllib.parse.quote(k, safe='-_.~')}="
-                              f"{urllib.parse.quote(v, safe='-_.~')}")
-            canonical = "\n".join([
-                request.method,
-                urllib.parse.quote(request.path, safe="/-_.~"),
-                "&".join(cq),
-                canonical_headers,
-                ";".join(signed_headers),
-                request.headers.get("x-amz-content-sha256",
-                                    "UNSIGNED-PAYLOAD"),
-            ])
             amz_date = request.headers.get("x-amz-date", "")
             scope = f"{date}/{region}/{service}/aws4_request"
-            string_to_sign = "\n".join([
-                "AWS4-HMAC-SHA256", amz_date, scope,
-                hashlib.sha256(canonical.encode()).hexdigest()])
+            string_to_sign = self._sigv4_string_to_sign(
+                request, signed_headers,
+                request.headers.get("x-amz-content-sha256",
+                                    "UNSIGNED-PAYLOAD"),
+                amz_date, scope)
 
             k = auth_mod.signing_key(secret_key, date, region, service)
             want = hmac.new(k, string_to_sign.encode(),
@@ -153,6 +168,55 @@ class S3Server:
                                 "amz_date": amz_date, "scope": scope}
         except (KeyError, IndexError, ValueError) as e:
             return _error("AuthorizationHeaderMalformed", str(e), 400)
+        return None
+
+    def _check_presigned(self, request: web.Request, action: str,
+                         bucket: str) -> Optional[web.Response]:
+        """Presigned-URL query auth (doesPresignedSignatureMatch,
+        weed/s3api/auth_signature_v4.go): the SigV4 parameters ride the
+        query string, the payload is UNSIGNED-PAYLOAD, and the signature
+        expires X-Amz-Expires seconds after X-Amz-Date."""
+        import time as time_mod
+
+        q = request.query
+        try:
+            cred = q["X-Amz-Credential"].split("/")
+            akid, date, region, service = (cred[0], cred[1], cred[2],
+                                           cred[3])
+            amz_date = q["X-Amz-Date"]
+            expires = int(q.get("X-Amz-Expires", "900"))
+            signed_headers = q["X-Amz-SignedHeaders"].split(";")
+            given = q["X-Amz-Signature"]
+        except (KeyError, IndexError, ValueError) as e:
+            return _error("AuthorizationQueryParametersError", str(e), 400)
+        found = self.iam.lookup(akid)
+        if found is None:
+            return _error("InvalidAccessKeyId", "unknown key", 403)
+        identity, secret_key = found
+        if action and not identity.allows(action, bucket):
+            return _error("AccessDenied",
+                          f"{identity.name} may not {action} on {bucket}",
+                          403)
+        try:
+            import calendar
+            t0 = calendar.timegm(time_mod.strptime(amz_date,
+                                                   "%Y%m%dT%H%M%SZ"))
+        except ValueError:
+            return _error("AuthorizationQueryParametersError",
+                          "bad X-Amz-Date", 400)
+        now = time_mod.time()
+        if now > t0 + expires or now < t0 - 900:
+            return _error("AccessDenied", "Request has expired", 403)
+        scope = f"{date}/{region}/{service}/aws4_request"
+        # canonical request: every query param except the signature itself
+        string_to_sign = self._sigv4_string_to_sign(
+            request, signed_headers, "UNSIGNED-PAYLOAD", amz_date, scope,
+            skip_query=("X-Amz-Signature",))
+        k = auth_mod.signing_key(secret_key, date, region, service)
+        want = hmac.new(k, string_to_sign.encode(),
+                        hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(want, given):
+            return _error("SignatureDoesNotMatch", "bad signature", 403)
         return None
 
     # --- filer plumbing ---
