@@ -100,13 +100,15 @@ class S3Server:
                               skip_query: tuple = ()) -> str:
         """Canonical request -> string-to-sign, shared by the header and
         presigned auth paths so the canonical form cannot drift."""
+        # sorted (key, value) pairs: MultiDict.keys() repeats duplicated
+        # keys, which would double every repeated parameter; AWS canonical
+        # form sorts by key then value
         cq = []
-        for k in sorted(request.query.keys()):
+        for k, v in sorted(request.query.items()):
             if k in skip_query:
                 continue
-            for v in request.query.getall(k):
-                cq.append(f"{urllib.parse.quote(k, safe='-_.~')}="
-                          f"{urllib.parse.quote(v, safe='-_.~')}")
+            cq.append(f"{urllib.parse.quote(k, safe='-_.~')}="
+                      f"{urllib.parse.quote(v, safe='-_.~')}")
         canonical_headers = "".join(
             f"{h}:{' '.join(request.headers.get(h, '').split())}\n"
             for h in signed_headers)
@@ -145,10 +147,6 @@ class S3Server:
             if found is None:
                 return _error("InvalidAccessKeyId", "unknown key", 403)
             identity, secret_key = found
-            if action and not identity.allows(action, bucket):
-                return _error(
-                    "AccessDenied",
-                    f"{identity.name} may not {action} on {bucket}", 403)
             signed_headers = parts["SignedHeaders"].split(";")
             amz_date = request.headers.get("x-amz-date", "")
             scope = f"{date}/{region}/{service}/aws4_request"
@@ -163,6 +161,13 @@ class S3Server:
                             hashlib.sha256).hexdigest()
             if not hmac.compare_digest(want, parts["Signature"]):
                 return _error("SignatureDoesNotMatch", "bad signature", 403)
+            # ACL only AFTER the signature is proven: identity names and
+            # per-action permissions must not leak to unauthenticated
+            # callers (AWS orders its checks the same way)
+            if action and not identity.allows(action, bucket):
+                return _error(
+                    "AccessDenied",
+                    f"{identity.name} may not {action} on {bucket}", 403)
             # context for STREAMING-AWS4-HMAC-SHA256-PAYLOAD bodies
             request["sigv4"] = {"seed": parts["Signature"], "key": k,
                                 "amz_date": amz_date, "scope": scope}
@@ -193,20 +198,9 @@ class S3Server:
         if found is None:
             return _error("InvalidAccessKeyId", "unknown key", 403)
         identity, secret_key = found
-        if action and not identity.allows(action, bucket):
-            return _error("AccessDenied",
-                          f"{identity.name} may not {action} on {bucket}",
-                          403)
-        try:
-            import calendar
-            t0 = calendar.timegm(time_mod.strptime(amz_date,
-                                                   "%Y%m%dT%H%M%SZ"))
-        except ValueError:
-            return _error("AuthorizationQueryParametersError",
-                          "bad X-Amz-Date", 400)
-        now = time_mod.time()
-        if now > t0 + expires or now < t0 - 900:
-            return _error("AccessDenied", "Request has expired", 403)
+        # signature FIRST: expiry/ACL answers before the signature is
+        # proven would hand an unauthenticated caller an oracle for
+        # identity names and per-action permissions
         scope = f"{date}/{region}/{service}/aws4_request"
         # canonical request: every query param except the signature itself
         string_to_sign = self._sigv4_string_to_sign(
@@ -217,6 +211,20 @@ class S3Server:
                         hashlib.sha256).hexdigest()
         if not hmac.compare_digest(want, given):
             return _error("SignatureDoesNotMatch", "bad signature", 403)
+        try:
+            import calendar
+            t0 = calendar.timegm(time_mod.strptime(amz_date,
+                                                   "%Y%m%dT%H%M%SZ"))
+        except ValueError:
+            return _error("AuthorizationQueryParametersError",
+                          "bad X-Amz-Date", 400)
+        now = time_mod.time()
+        if now > t0 + expires or now < t0 - 900:
+            return _error("AccessDenied", "Request has expired", 403)
+        if action and not identity.allows(action, bucket):
+            return _error("AccessDenied",
+                          f"{identity.name} may not {action} on {bucket}",
+                          403)
         return None
 
     # --- filer plumbing ---
