@@ -100,15 +100,14 @@ class S3Server:
                               skip_query: tuple = ()) -> str:
         """Canonical request -> string-to-sign, shared by the header and
         presigned auth paths so the canonical form cannot drift."""
-        # sorted (key, value) pairs: MultiDict.keys() repeats duplicated
-        # keys, which would double every repeated parameter; AWS canonical
-        # form sorts by key then value
-        cq = []
-        for k, v in sorted(request.query.items()):
-            if k in skip_query:
-                continue
-            cq.append(f"{urllib.parse.quote(k, safe='-_.~')}="
-                      f"{urllib.parse.quote(v, safe='-_.~')}")
+        # MultiDict.keys() repeats duplicated keys (which would double
+        # every repeated parameter); AWS sorts the PERCENT-ENCODED pairs
+        # (botocore does the same), which differs from raw order for
+        # characters like '/' vs '.'
+        cq = sorted(
+            f"{urllib.parse.quote(k, safe='-_.~')}="
+            f"{urllib.parse.quote(v, safe='-_.~')}"
+            for k, v in request.query.items() if k not in skip_query)
         canonical_headers = "".join(
             f"{h}:{' '.join(request.headers.get(h, '').split())}\n"
             for h in signed_headers)
