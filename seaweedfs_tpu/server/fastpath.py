@@ -172,7 +172,15 @@ class FastVolumeProtocol(asyncio.Protocol):
             self.buf = b""
             await self._proxy_tunnel(head + b"\r\n\r\n" + rest)
             return None
-        length = int(headers.get(b"content-length", b"0") or 0)
+        # strict HTTP grammar: digits only (int() would also accept
+        # '+5' / '5_0', a framing-desync risk behind stricter proxies)
+        cl = headers.get(b"content-length", b"0") or b"0"
+        length = int(cl) if cl.isdigit() else -1
+        if length < 0:
+            self._send(400, json.dumps({"error": "invalid content-length"}
+                                       ).encode())
+            self.transport.close()
+            return None
         if length > self.MAX_BODY:
             self._send(413, json.dumps({"error": "entry too large"}
                                        ).encode())
@@ -475,10 +483,25 @@ class FastVolumeProtocol(asyncio.Protocol):
                 k, _, v = line.partition(b":")
                 lk = k.strip().lower()
                 if lk == b"content-length":
-                    length = int(v)
+                    try:
+                        length = int(v)
+                    except ValueError:
+                        length = None
                 elif lk == b"transfer-encoding" and b"chunked" in v.lower():
                     chunked = True
             self.transport.write(hdr + b"\r\n\r\n" + rest)
+            # HEAD answers and 204/304 statuses carry headers (often incl.
+            # Content-Length) but NO body — waiting for body bytes here
+            # stalls the serial per-connection loop until aiohttp's
+            # keep-alive timeout (~75s)
+            method = raw[:raw.find(b" ")]
+            status_line = hdr.split(b"\r\n", 1)[0].split(b" ")
+            try:
+                status = int(status_line[1])
+            except (IndexError, ValueError):
+                status = 200
+            if method == b"HEAD" or status in (204, 304):
+                return
             if length is not None and not chunked:
                 got = len(rest)
                 while got < length:
@@ -547,8 +570,14 @@ class FastMasterProtocol(FastVolumeProtocol):
                 self._send(503, json.dumps(
                     {"error": "not the leader / not ready"}).encode())
                 return
+            try:
+                count = int(q.get("count", 1))
+            except ValueError:
+                self._send(400, json.dumps({"error": "invalid count"}
+                                           ).encode())
+                return
             resp, status = await server.assign_api(
-                count=int(q.get("count", 1)),
+                count=count,
                 collection=q.get("collection", ""),
                 replication=q.get("replication",
                                   server.default_replication),

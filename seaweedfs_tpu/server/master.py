@@ -312,8 +312,12 @@ class MasterServer:
             return web.json_response(
                 {"error": "not the leader / not ready"}, status=503)
         q = request.query
+        try:
+            count = int(q.get("count", 1))
+        except ValueError:
+            return web.json_response({"error": "invalid count"}, status=400)
         resp, status = await self.assign_api(
-            count=int(q.get("count", 1)),
+            count=count,
             collection=q.get("collection", ""),
             replication=q.get("replication", self.default_replication),
             ttl=q.get("ttl", ""),
@@ -339,6 +343,10 @@ class MasterServer:
                          replication: str = "", ttl: str = "",
                          data_center: str = "") -> tuple[dict, int]:
         """Core assignment, shared by the HTTP and gRPC surfaces."""
+        if count < 1:
+            # a negative count would roll the sequencer backwards and
+            # re-mint keys already handed to other clients
+            return ({"error": "invalid count"}, 400)
         replication = replication or self.default_replication
         picked = self.topology.pick_for_write(collection, replication, ttl)
         if picked is None:
@@ -446,7 +454,12 @@ class MasterServer:
 
     async def vol_grow(self, request: web.Request) -> web.Response:
         q = request.query
-        count = int(q.get("count", 1))
+        try:
+            count = int(q.get("count", 1))
+        except ValueError:
+            return web.json_response({"error": "invalid count"}, status=400)
+        if count < 1:
+            return web.json_response({"error": "invalid count"}, status=400)
         async with self._grow_lock:
             grown = await self._grow(
                 count, q.get("collection", ""),

@@ -180,6 +180,65 @@ def test_proxied_surface_and_errors(srv):
     s.close()
 
 
+def test_proxied_head_no_hang(srv):
+    """HEAD responses on the proxied path carry Content-Length but no
+    body; the relay must not wait for body bytes (it used to stall until
+    aiohttp's keep-alive timeout, ~75s)."""
+    import time
+    t0 = time.time()
+    # missing needle -> proxied repair path -> 404 with a JSON error body
+    # advertised in Content-Length but never sent for HEAD
+    status, _, got = _req(srv.port, "HEAD", "/1,99aaaaaaaa")
+    assert status == 404 and got == b""
+    # proxied admin surface
+    status, _, got = _req(srv.port, "HEAD", "/status")
+    assert status == 200 and got == b""
+    assert time.time() - t0 < 10
+
+    # the per-connection loop is serial: a request pipelined after a
+    # proxied HEAD must still be answered promptly
+    s = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+    s.sendall(b"HEAD /1,99aaaaaaaa HTTP/1.1\r\nHost: x\r\n"
+              b"Content-Length: 0\r\n\r\n"
+              b"GET /status HTTP/1.1\r\nHost: x\r\n"
+              b"Content-Length: 0\r\n\r\n")
+    buf = b""
+    deadline = time.time() + 10
+    while buf.count(b"HTTP/1.1") < 2 and time.time() < deadline:
+        chunk = s.recv(1 << 16)
+        if not chunk:
+            break
+        buf += chunk
+    s.close()
+    assert buf.count(b"HTTP/1.1") >= 2 and b" 200 " in buf
+
+
+def test_malformed_content_length_400(srv):
+    s = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+    s.sendall(b"POST /" + FID.encode() + b" HTTP/1.1\r\nHost: x\r\n"
+              b"Content-Length: banana\r\n\r\n")
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = s.recv(1 << 16)
+        if not chunk:
+            break
+        buf += chunk
+    assert b" 400 " in buf.split(b"\r\n", 1)[0]
+    s.close()
+    # negative declared length is equally malformed
+    s = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+    s.sendall(b"POST /" + FID.encode() + b" HTTP/1.1\r\nHost: x\r\n"
+              b"Content-Length: -5\r\n\r\n")
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = s.recv(1 << 16)
+        if not chunk:
+            break
+        buf += chunk
+    assert b" 400 " in buf.split(b"\r\n", 1)[0]
+    s.close()
+
+
 def test_keepalive_many_requests(srv):
     payload = b"ka" * 100
     body, ct = _multipart(payload)
