@@ -163,21 +163,34 @@ def verify_post_policy(
     policy_b64 = fields.get("policy", "")
     if not policy_b64:
         return False, "missing policy", None
-    credential = fields.get("x-amz-credential", "")
-    signature = fields.get("x-amz-signature", "")
-    amz_date = fields.get("x-amz-date", "")
-    try:
-        akid, date, region, service, _ = credential.split("/")
-    except ValueError:
-        return False, "malformed credential", None
-    found = iam.lookup(akid)
-    if found is None:
-        return False, "unknown access key", None
-    _, secret = found
-    key = signing_key(secret, date, region, service)
-    want = hmac.new(key, policy_b64.encode(), hashlib.sha256).hexdigest()
-    if not hmac.compare_digest(want, signature):
-        return False, "signature mismatch", None
+    if "x-amz-credential" not in fields and "awsaccesskeyid" in fields:
+        # V2 policy signature (doesPolicySignatureV2Match,
+        # auth_signature_v2.go): Base64(HMAC-SHA1(secret, policy))
+        found = iam.lookup(fields.get("awsaccesskeyid", ""))
+        if found is None:
+            return False, "unknown access key", None
+        _, secret = found
+        want = base64.b64encode(hmac.new(
+            secret.encode(), policy_b64.encode(), hashlib.sha1).digest()
+        ).decode()
+        if not hmac.compare_digest(want, fields.get("signature", "")):
+            return False, "signature mismatch", None
+    else:
+        credential = fields.get("x-amz-credential", "")
+        signature = fields.get("x-amz-signature", "")
+        try:
+            akid, date, region, service, _ = credential.split("/")
+        except ValueError:
+            return False, "malformed credential", None
+        found = iam.lookup(akid)
+        if found is None:
+            return False, "unknown access key", None
+        _, secret = found
+        key = signing_key(secret, date, region, service)
+        want = hmac.new(key, policy_b64.encode(),
+                        hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(want, signature):
+            return False, "signature mismatch", None
     try:
         policy = json.loads(base64.b64decode(policy_b64))
     except (ValueError, binascii.Error):

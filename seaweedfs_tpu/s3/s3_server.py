@@ -101,13 +101,14 @@ class S3Server:
         """Canonical request -> string-to-sign, shared by the header and
         presigned auth paths so the canonical form cannot drift."""
         # MultiDict.keys() repeats duplicated keys (which would double
-        # every repeated parameter); AWS sorts the PERCENT-ENCODED pairs
-        # (botocore does the same), which differs from raw order for
-        # characters like '/' vs '.'
-        cq = sorted(
-            f"{urllib.parse.quote(k, safe='-_.~')}="
-            f"{urllib.parse.quote(v, safe='-_.~')}"
-            for k, v in request.query.items() if k not in skip_query)
+        # every repeated parameter); AWS sorts the PERCENT-ENCODED
+        # (key, value) TUPLES (botocore does the same) — joined "k=v"
+        # strings diverge when one key prefixes another and the longer
+        # key's next character sorts above '=' (any letter)
+        cq = [f"{k}={v}" for k, v in sorted(
+            (urllib.parse.quote(k, safe="-_.~"),
+             urllib.parse.quote(v, safe="-_.~"))
+            for k, v in request.query.items() if k not in skip_query)]
         canonical_headers = "".join(
             f"{h}:{' '.join(request.headers.get(h, '').split())}\n"
             for h in signed_headers)
@@ -135,6 +136,11 @@ class S3Server:
         if request.query.get("X-Amz-Algorithm") == "AWS4-HMAC-SHA256":
             return self._check_presigned(request, action, bucket)
         auth = request.headers.get("Authorization", "")
+        if auth.startswith("AWS ") and not auth.startswith("AWS4"):
+            return self._check_v2(request, auth, action, bucket)
+        if (not auth and "Signature" in request.query
+                and "AWSAccessKeyId" in request.query):
+            return self._check_presigned_v2(request, action, bucket)
         if not auth.startswith("AWS4-HMAC-SHA256 "):
             return _error("AccessDenied", "missing signature", 403)
         try:
@@ -174,6 +180,74 @@ class S3Server:
             return _error("AuthorizationHeaderMalformed", str(e), 400)
         return None
 
+    def _check_v2(self, request: web.Request, auth: str, action: str,
+                  bucket: str) -> Optional[web.Response]:
+        """Signature V2 header scheme (isReqAuthenticatedV2,
+        weed/s3api/auth_signature_v2.go:1-412): HMAC-SHA1 over the
+        Method/MD5/Type/Date/amz-headers/resource string."""
+        from . import sigv2
+        fields = auth[len("AWS "):].split(":")
+        if len(fields) != 2 or not fields[0]:
+            return _error("AuthorizationHeaderMalformed",
+                          "malformed V2 Authorization", 400)
+        akid, given = fields
+        found = self.iam.lookup(akid)
+        if found is None:
+            return _error("InvalidAccessKeyId", "unknown key", 403)
+        identity, secret_key = found
+        # V2 signs the percent-ENCODED path as sent (request.path is
+        # decoded; a key with a space/%/+ would mismatch)
+        sts = sigv2.string_to_sign(
+            request.method, request.rel_url.raw_path, request.query,
+            request.headers)
+        want = sigv2.signature(secret_key, sts)
+        if not hmac.compare_digest(want, given):
+            return _error("SignatureDoesNotMatch", "bad signature", 403)
+        if action and not identity.allows(action, bucket):
+            return _error("AccessDenied",
+                          f"{identity.name} may not {action} on {bucket}",
+                          403)
+        return None
+
+    def _check_presigned_v2(self, request: web.Request, action: str,
+                            bucket: str) -> Optional[web.Response]:
+        """Presigned V2 (doesPresignV2SignatureMatch): AWSAccessKeyId /
+        Expires / Signature in the query, epoch Expires in the Date
+        slot."""
+        from . import sigv2
+        q = request.query
+        akid = q.get("AWSAccessKeyId", "")
+        expires = q.get("Expires", "")
+        given = q.get("Signature", "")
+        if not akid or not expires or not given:
+            return _error("AuthorizationQueryParametersError",
+                          "missing V2 query parameters", 400)
+        found = self.iam.lookup(akid)
+        if found is None:
+            return _error("InvalidAccessKeyId", "unknown key", 403)
+        identity, secret_key = found
+        sts = sigv2.presigned_string_to_sign(
+            request.method, request.rel_url.raw_path, q, request.headers,
+            expires)
+        want = sigv2.signature(secret_key, sts)
+        # signature first — expiry answers before the signature is proven
+        # would give unauthenticated callers an oracle (same order as the
+        # V4 presigned path above)
+        if not hmac.compare_digest(want, given):
+            return _error("SignatureDoesNotMatch", "bad signature", 403)
+        try:
+            deadline = int(expires)
+        except ValueError:
+            return _error("AuthorizationQueryParametersError",
+                          "malformed Expires", 400)
+        if time.time() > deadline:
+            return _error("AccessDenied", "Request has expired", 403)
+        if action and not identity.allows(action, bucket):
+            return _error("AccessDenied",
+                          f"{identity.name} may not {action} on {bucket}",
+                          403)
+        return None
+
     def _check_presigned(self, request: web.Request, action: str,
                          bucket: str) -> Optional[web.Response]:
         """Presigned-URL query auth (doesPresignedSignatureMatch,
@@ -193,6 +267,12 @@ class S3Server:
             given = q["X-Amz-Signature"]
         except (KeyError, IndexError, ValueError) as e:
             return _error("AuthorizationQueryParametersError", str(e), 400)
+        if not 1 <= expires <= 604800:
+            # AWS bounds X-Amz-Expires to [1s, 7 days]; a negative value
+            # must be rejected as malformed, not treated as pre-expired
+            return _error("AuthorizationQueryParametersError",
+                          "X-Amz-Expires must be between 1 and 604800",
+                          400)
         found = self.iam.lookup(akid)
         if found is None:
             return _error("InvalidAccessKeyId", "unknown key", 403)
@@ -787,7 +867,9 @@ class S3Server:
                                   f"{len(file_data)} > {hi}", 400)
             # the signing identity still needs Write on this bucket — a
             # policy signature must not bypass the per-action ACL
-            akid = fields.get("x-amz-credential", "").split("/")[0]
+            # (V2 policies carry the bare key in AWSAccessKeyId)
+            akid = (fields.get("x-amz-credential", "").split("/")[0]
+                    or fields.get("awsaccesskeyid", ""))
             found = self.iam.lookup(akid)
             if found is None or not found[0].allows(auth_mod.ACTION_WRITE,
                                                     bucket):

@@ -40,14 +40,19 @@ def sign_request(method: str, url: str, headers: dict, payload: bytes,
     canonical_headers = "".join(
         f"{h}:{' '.join(str(out[_orig(out, h)]).split())}\n"
         for h in signed_headers)
-    query = []
-    for k, v in urllib.parse.parse_qsl(parsed.query, keep_blank_values=True):
-        query.append(f"{urllib.parse.quote(k, safe='-_.~')}="
-                     f"{urllib.parse.quote(v, safe='-_.~')}")
+    # sort (encoded key, encoded value) tuples, not joined "k=v" strings:
+    # the two orders diverge when one key prefixes another (e.g. "key"
+    # vs "key1") because '=' is compared against the longer key's next
+    # character
+    query = sorted(
+        (urllib.parse.quote(k, safe="-_.~"),
+         urllib.parse.quote(v, safe="-_.~"))
+        for k, v in urllib.parse.parse_qsl(parsed.query,
+                                           keep_blank_values=True))
     canonical = "\n".join([
         method,
         urllib.parse.quote(parsed.path or "/", safe="/-_.~"),
-        "&".join(sorted(query)),
+        "&".join(f"{k}={v}" for k, v in query),
         canonical_headers,
         ";".join(signed_headers),
         payload_hash,

@@ -1,0 +1,314 @@
+"""Signature V2 acceptance + SigV4 conformance against AWS's own
+published vectors.
+
+The reference accepts V2 alongside V4 (weed/s3api/auth_signature_v2.go)
+and proves its gateway with the real AWS SDK (test/s3/basic). boto3 is
+not in this image, so the independent-conformance role is played by the
+official AWS Signature V4 examples instead: the documented signing-key
+derivation, the IAM ListUsers worked example, and the test-suite's
+get-vanilla case — values pinned from AWS's documentation, not computed
+by this codebase.
+"""
+
+import hashlib
+import hmac
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from cluster_util import Cluster, free_port
+from seaweedfs_tpu.s3 import auth as auth_mod
+from seaweedfs_tpu.s3 import sigv2
+from seaweedfs_tpu.s3.s3_server import S3Server
+from seaweedfs_tpu.s3.sigv4 import sign_request
+
+AWS_SECRET = "wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY"
+
+
+# --- SigV4 conformance: AWS-published vectors ---
+
+def test_signing_key_matches_aws_docs_example():
+    # docs.aws.amazon.com "Deriving the signing key" worked example
+    k = auth_mod.signing_key(AWS_SECRET, "20150830", "us-east-1", "iam")
+    assert k.hex() == ("c4afb1cc5771d871763a393e44b703571b"
+                      "55cc28424d1a5e86da6ed3c154a4b9")
+
+
+class _FakeQuery(dict):
+    pass
+
+
+class _FakeRequest:
+    """Just enough of aiohttp's Request for _sigv4_string_to_sign."""
+
+    def __init__(self, method, path, query, headers):
+        self.method = method
+        self.path = path
+        self.query = _FakeQuery(query)
+        self.headers = headers
+
+
+def _server_signature(req, signed_headers, payload_hash, amz_date, scope,
+                      secret):
+    sts = S3Server._sigv4_string_to_sign(
+        req, signed_headers, payload_hash, amz_date, scope)
+    date, region, service, _ = scope.split("/")
+    k = auth_mod.signing_key(secret, date, region, service)
+    return hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()
+
+
+def test_sigv4_get_vanilla_vector():
+    """SigV4 test suite 'get-vanilla': GET / against service 'service'."""
+    req = _FakeRequest("GET", "/", {}, {
+        "host": "example.amazonaws.com",
+        "x-amz-date": "20150830T123600Z"})
+    sig = _server_signature(
+        req, ["host", "x-amz-date"], hashlib.sha256(b"").hexdigest(),
+        "20150830T123600Z", "20150830/us-east-1/service/aws4_request",
+        AWS_SECRET)
+    assert sig == ("5fa00fa31553b73ebf1942676e86291e"
+                   "8372ff2a2260956d9b8aae1d763fbf31")
+
+
+def test_sigv4_iam_listusers_vector():
+    """The IAM ListUsers worked example from the AWS SigV4 docs."""
+    req = _FakeRequest(
+        "GET", "/", {"Action": "ListUsers", "Version": "2010-05-08"},
+        {"content-type": "application/x-www-form-urlencoded; charset=utf-8",
+         "host": "iam.amazonaws.com",
+         "x-amz-date": "20150830T123600Z"})
+    sig = _server_signature(
+        req, ["content-type", "host", "x-amz-date"],
+        hashlib.sha256(b"").hexdigest(), "20150830T123600Z",
+        "20150830/us-east-1/iam/aws4_request", AWS_SECRET)
+    assert sig == ("5d672d79c15b13162d9279b0855cfba6"
+                   "789a8edb4c82c400e06b5924a6f2b5d7")
+
+
+def test_canonical_query_prefix_key_ordering():
+    """'key' vs 'key1': sorting joined "k=v" strings puts key1 first
+    ('1' < '='); AWS sorts (key, value) tuples, which puts key first.
+    Pin the tuple order on the server's canonical form."""
+    req = _FakeRequest("GET", "/", {"key": "x", "key1": "y"},
+                       {"host": "h"})
+    sts = S3Server._sigv4_string_to_sign(
+        req, ["host"], "UNSIGNED-PAYLOAD", "20250101T000000Z",
+        "20250101/us-east-1/s3/aws4_request")
+    canonical_hash = sts.split("\n")[3]
+    want = hashlib.sha256("\n".join([
+        "GET", "/", "key=x&key1=y", "host:h\n", "host",
+        "UNSIGNED-PAYLOAD"]).encode()).hexdigest()
+    assert canonical_hash == want
+
+
+# --- live-gateway fixtures ---
+
+IDENTITIES = [
+    {"name": "admin",
+     "credentials": [{"accessKey": "V2ADMIN", "secretKey": "v2adminsecret"}],
+     "actions": ["Admin"]},
+    {"name": "reader",
+     "credentials": [{"accessKey": "V2READ", "secretKey": "v2readsecret"}],
+     "actions": ["Read", "List"]},
+]
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(n_volume_servers=1, pulse=0.15)
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def s3_iam(cluster):
+    from aiohttp import web
+
+    filer = cluster.add_filer(chunk_size=16 * 1024)
+    port = free_port()
+    server = S3Server(filer.url, iam=auth_mod.Iam(IDENTITIES))
+
+    async def boot():
+        runner = web.AppRunner(server.app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        await site.start()
+        return runner
+
+    cluster.runners.append(cluster.call(boot()))
+    server.url = f"127.0.0.1:{port}"
+    return server
+
+
+def _v2_req(s3, method, path, access, secret, data=b"", headers=None):
+    url = f"http://{s3.url}{path}"
+    headers = dict(headers or {})
+    if data and not any(k.lower() == "content-type" for k in headers):
+        # urllib injects this default AFTER signing; sign what is sent
+        headers["Content-Type"] = "application/x-www-form-urlencoded"
+    hdrs = sigv2.sign_header(method, url, headers, access, secret)
+    r = urllib.request.Request(url, data=data or None, method=method,
+                               headers=hdrs)
+    return urllib.request.urlopen(r, timeout=60)
+
+
+def _v4_req(s3, method, path, access, secret, data=b""):
+    url = f"http://{s3.url}{path}"
+    hdrs = sign_request(method, url, {}, data, access, secret)
+    r = urllib.request.Request(url, data=data or None, method=method,
+                               headers=hdrs)
+    return urllib.request.urlopen(r, timeout=60)
+
+
+# --- SigV2 end-to-end ---
+
+def test_v2_header_auth_crud(s3_iam):
+    _v2_req(s3_iam, "PUT", "/v2bucket", "V2ADMIN", "v2adminsecret").read()
+    _v2_req(s3_iam, "PUT", "/v2bucket/hello.txt", "V2ADMIN",
+            "v2adminsecret", data=b"v2 payload",
+            headers={"Content-Type": "text/plain"}).read()
+    with _v2_req(s3_iam, "GET", "/v2bucket/hello.txt", "V2READ",
+                 "v2readsecret") as r:
+        assert r.read() == b"v2 payload"
+    # sub-resource in CanonicalizedResource (?tagging)
+    with _v2_req(s3_iam, "GET", "/v2bucket/hello.txt?tagging", "V2READ",
+                 "v2readsecret") as r:
+        assert r.status == 200
+    # percent-encoded key: V2 signs the encoded path as sent
+    _v2_req(s3_iam, "PUT", "/v2bucket/a%20b%2Bc.txt", "V2ADMIN",
+            "v2adminsecret", data=b"enc key").read()
+    with _v2_req(s3_iam, "GET", "/v2bucket/a%20b%2Bc.txt", "V2READ",
+                 "v2readsecret") as r:
+        assert r.read() == b"enc key"
+
+
+def test_v2_rejections(s3_iam):
+    # wrong secret
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _v2_req(s3_iam, "GET", "/v2bucket/hello.txt", "V2READ", "WRONG")
+    assert e.value.code == 403
+    # unknown key
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _v2_req(s3_iam, "GET", "/v2bucket/hello.txt", "NOKEY", "x")
+    assert e.value.code == 403
+    # ACL: reader cannot write
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _v2_req(s3_iam, "PUT", "/v2bucket/no.txt", "V2READ",
+                "v2readsecret", data=b"nope")
+    assert e.value.code == 403
+    # malformed Authorization
+    r = urllib.request.Request(
+        f"http://{s3_iam.url}/v2bucket/hello.txt",
+        headers={"Authorization": "AWS garbage"})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(r, timeout=60)
+    assert e.value.code == 400
+
+
+def test_v2_presigned_url(s3_iam):
+    _v2_req(s3_iam, "PUT", "/v2bucket/pre.txt", "V2ADMIN",
+            "v2adminsecret", data=b"presigned v2").read()
+    url = sigv2.presign("GET", f"http://{s3_iam.url}/v2bucket/pre.txt",
+                        "V2READ", "v2readsecret", expires_in=300)
+    with urllib.request.urlopen(url, timeout=60) as r:
+        assert r.read() == b"presigned v2"
+    # expired
+    old = sigv2.presign("GET", f"http://{s3_iam.url}/v2bucket/pre.txt",
+                        "V2READ", "v2readsecret", expires_in=-10)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(old, timeout=60)
+    assert e.value.code == 403
+    # tampered signature
+    bad = url.replace("Signature=", "Signature=ZZ")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(bad, timeout=60)
+    assert e.value.code == 403
+
+
+def test_v2_post_policy_upload(s3_iam):
+    """Browser POST with a V2-signed policy (doesPolicySignatureV2Match):
+    Base64(HMAC-SHA1(secret, policy)) in the `signature` field."""
+    import base64
+    import json
+
+    _v2_req(s3_iam, "PUT", "/v2postb", "V2ADMIN", "v2adminsecret").read()
+    exp = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                        time.gmtime(time.time() + 600))
+    policy = base64.b64encode(json.dumps({
+        "expiration": exp,
+        "conditions": [{"bucket": "v2postb"},
+                       ["starts-with", "$key", "up/"]],
+    }).encode()).decode()
+    sig = base64.b64encode(hmac.new(
+        b"v2adminsecret", policy.encode(), hashlib.sha1).digest()).decode()
+    fields = {"key": "up/${filename}", "policy": policy,
+              "AWSAccessKeyId": "V2ADMIN", "signature": sig}
+    bnd = "v2bnd"
+    body = bytearray()
+    for k, v in fields.items():
+        body += (f"--{bnd}\r\nContent-Disposition: form-data; "
+                 f'name="{k}"\r\n\r\n{v}\r\n').encode()
+    body += (f"--{bnd}\r\nContent-Disposition: form-data; "
+             f'name="file"; filename="f2.bin"\r\n'
+             f"Content-Type: application/octet-stream\r\n\r\n").encode()
+    body += b"v2 posted" + f"\r\n--{bnd}--\r\n".encode()
+    r = urllib.request.Request(
+        f"http://{s3_iam.url}/v2postb", data=bytes(body), method="POST",
+        headers={"Content-Type": f"multipart/form-data; boundary={bnd}"})
+    with urllib.request.urlopen(r, timeout=60) as resp:
+        assert resp.status == 204
+    with _v2_req(s3_iam, "GET", "/v2postb/up/f2.bin", "V2ADMIN",
+                 "v2adminsecret") as resp:
+        assert resp.read() == b"v2 posted"
+    # broken V2 policy signature
+    fields["signature"] = "AAAA" + sig[4:]
+    body2 = bytearray()
+    for k, v in fields.items():
+        body2 += (f"--{bnd}\r\nContent-Disposition: form-data; "
+                  f'name="{k}"\r\n\r\n{v}\r\n').encode()
+    body2 += (f"--{bnd}\r\nContent-Disposition: form-data; "
+              f'name="file"; filename="f2.bin"\r\n\r\n').encode()
+    body2 += b"nope" + f"\r\n--{bnd}--\r\n".encode()
+    r = urllib.request.Request(
+        f"http://{s3_iam.url}/v2postb", data=bytes(body2), method="POST",
+        headers={"Content-Type": f"multipart/form-data; boundary={bnd}"})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(r, timeout=60)
+    assert e.value.code == 403
+
+
+# --- V4 regressions on the live gateway ---
+
+def test_v4_prefix_query_keys_end_to_end(s3_iam):
+    """Query keys where joined-string sort and tuple sort diverge must
+    still verify (handlers ignore unknown params on a bucket list)."""
+    _v4_req(s3_iam, "PUT", "/v4qbucket", "V2ADMIN", "v2adminsecret").read()
+    with _v4_req(s3_iam, "GET", "/v4qbucket?key=x&key1=y", "V2ADMIN",
+                 "v2adminsecret") as r:
+        assert r.status == 200
+
+
+def test_presigned_expires_bounds(s3_iam):
+    """X-Amz-Expires outside [1, 604800] is AuthorizationQueryParameters-
+    Error (400), not silently pre-expired."""
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    date = amz_date[:8]
+    scope = f"{date}/us-east-1/s3/aws4_request"
+    for bad in ("-5", "0", "604801"):
+        q = {
+            "X-Amz-Algorithm": "AWS4-HMAC-SHA256",
+            "X-Amz-Credential": f"V2READ/{scope}",
+            "X-Amz-Date": amz_date,
+            "X-Amz-Expires": bad,
+            "X-Amz-SignedHeaders": "host",
+            "X-Amz-Signature": "0" * 64,
+        }
+        url = (f"http://{s3_iam.url}/v2bucket/pre.txt?"
+               + urllib.parse.urlencode(q))
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(url, timeout=60)
+        assert e.value.code == 400
+        assert b"AuthorizationQueryParametersError" in e.value.read()
