@@ -172,10 +172,10 @@ def cmd_filer(args) -> None:
     from .server.filer_server import run_filer
     from .utils.config import load_configuration
     store_kwargs = {}
-    if args.store in ("sqlite", "leveldb"):
+    if args.store in ("sqlite", "leveldb", "leveldb2"):
         store_kwargs["path"] = args.store_path
     if args.store_servers:
-        if args.store in ("redis", "mongodb", "cassandra"):
+        if args.store in ("redis", "redis2", "mongodb", "cassandra"):
             host, _, port = args.store_servers.rpartition(":")
             store_kwargs["host"], store_kwargs["port"] = host, int(port)
         elif args.store in ("etcd", "elastic"):
@@ -724,10 +724,12 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("-mserver", default="127.0.0.1:9333")
     f.add_argument("-store", default="sqlite",
                    help="metadata store: sqlite | memory | leveldb | "
-                        "redis | etcd | mongodb | elastic | cassandra")
+                        "leveldb2 | redis | redis2 | etcd | mongodb | "
+                        "elastic | cassandra")
     f.add_argument("-store_path", default="./filer.db")
     f.add_argument("-store_servers", default="",
-                   help="host:port (or URL) for network stores (redis, etcd, mongodb, elastic, cassandra)")
+                   help="host:port (or URL) for network stores (redis, "
+                        "redis2, etcd, mongodb, elastic, cassandra)")
     f.add_argument("-chunk_size_mb", type=int, default=8)
     f.add_argument("-default_replication", default="")
     f.add_argument("-collection", default="")

@@ -383,7 +383,8 @@ def stream_encode_device_sink(base_file_name: str, coder: ErasureCoder,
                               batch_size: int = DEFAULT_BATCH_SIZE,
                               depth: int = DEFAULT_DEPTH,
                               window_bytes: int = DEFAULT_WINDOW_BYTES,
-                              stats: dict | None = None) -> np.ndarray:
+                              stats: dict | None = None,
+                              materialize: bool = True) -> np.ndarray:
     """stream_encode with the parity landing in an on-device sink.
 
     Runs the same reader schedule as stream_encode but stages batches onto
@@ -419,6 +420,15 @@ def stream_encode_device_sink(base_file_name: str, coder: ErasureCoder,
         os.close(dat_fd)
     if acc is None:
         out = np.zeros(g.parity_shards, dtype=np.uint32)
+    elif not materialize:
+        # deferred mode for multi-volume batches: return the on-device
+        # acc so windows pipeline across volumes (each device->host sync
+        # costs tunnel round-trip latency; a batch pays it once at the
+        # end via coder.materialize on each returned acc)
+        if stats is not None:
+            stats["total_s"] = round(time.perf_counter() - t_all, 3)
+            stats["volume_bytes"] = dat_size
+        return acc
     else:
         t0 = time.perf_counter()
         out = np.asarray(coder.materialize(acc), dtype=np.uint32)
@@ -436,7 +446,8 @@ def stream_rebuild_device_sink(base_file_name: str, coder: ErasureCoder,
                                batch_size: int = DEFAULT_BATCH_SIZE,
                                depth: int = DEFAULT_DEPTH,
                                window_bytes: int = DEFAULT_WINDOW_BYTES,
-                               stats: dict | None = None) -> np.ndarray:
+                               stats: dict | None = None,
+                               materialize: bool = True) -> np.ndarray:
     """stream_rebuild with the reconstructed shards landing in an on-device
     digest sink (BASELINE config 3's link-independent measurement).
 
@@ -496,6 +507,12 @@ def stream_rebuild_device_sink(base_file_name: str, coder: ErasureCoder,
             os.close(fd)
     if acc is None:
         out = np.zeros(len(victims), dtype=np.uint32)
+    elif not materialize:
+        # deferred mode: see stream_encode_device_sink
+        if stats is not None:
+            stats["total_s"] = round(time.perf_counter() - t_all, 3)
+            stats["shard_bytes"] = shard_size
+        return acc
     else:
         t0 = time.perf_counter()
         out = np.asarray(coder.materialize(acc), dtype=np.uint32)

@@ -96,6 +96,7 @@ class FakeRedisServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._strings: dict[bytes, bytes] = {}
         self._sets: dict[bytes, set] = {}
+        self._zsets: dict[bytes, dict] = {}  # key -> {member: score}
         self._lock = threading.Lock()
         self._tcp = socketserver.ThreadingTCPServer(
             (host, port), _Handler, bind_and_activate=True)
@@ -122,12 +123,14 @@ class FakeRedisServer:
                 n = 0
                 for key in args:
                     n += (self._strings.pop(key, None) is not None) or \
-                         (self._sets.pop(key, None) is not None)
+                         (self._sets.pop(key, None) is not None) or \
+                         (self._zsets.pop(key, None) is not None)
                 return _encode(int(n))
             if name == "EXISTS":
                 return _encode(int(sum(
                     1 for key in args
-                    if key in self._strings or key in self._sets)))
+                    if key in self._strings or key in self._sets
+                    or key in self._zsets)))
             if name == "SADD":
                 s = self._sets.setdefault(args[0], set())
                 before = len(s)
@@ -147,9 +150,60 @@ class FakeRedisServer:
                 cur += int(args[1])
                 self._strings[args[0]] = str(cur).encode()
                 return _encode(cur)
+            if name == "ZADD":
+                # ZADD key [NX] score member [score member ...]
+                key = args[0]
+                rest = args[1:]
+                nx = False
+                if rest and rest[0].upper() == b"NX":
+                    nx = True
+                    rest = rest[1:]
+                z = self._zsets.setdefault(key, {})
+                added = 0
+                for i in range(0, len(rest) - 1, 2):
+                    score = float(rest[i])
+                    member = rest[i + 1]
+                    if member not in z:
+                        added += 1
+                        z[member] = score
+                    elif not nx:
+                        z[member] = score
+                return _encode(added)
+            if name == "ZREM":
+                z = self._zsets.get(args[0], {})
+                n = 0
+                for m in args[1:]:
+                    n += z.pop(m, None) is not None
+                if not z:
+                    self._zsets.pop(args[0], None)
+                return _encode(n)
+            if name == "ZCARD":
+                return _encode(len(self._zsets.get(args[0], {})))
+            if name == "ZRANK":
+                z = self._zsets.get(args[0], {})
+                members = [m for m, _s in sorted(z.items(),
+                                                 key=lambda kv:
+                                                 (kv[1], kv[0]))]
+                try:
+                    return _encode(members.index(args[1]))
+                except ValueError:
+                    return b"$-1\r\n"
+            if name == "ZRANGE":
+                z = self._zsets.get(args[0], {})
+                members = [m for m, _s in sorted(z.items(),
+                                                 key=lambda kv:
+                                                 (kv[1], kv[0]))]
+                start, stop = int(args[1]), int(args[2])
+                n = len(members)
+                if start < 0:
+                    start += n
+                if stop < 0:
+                    stop += n
+                return _encode(members[max(start, 0):stop + 1])
             if name == "FLUSHALL":
                 self._strings.clear()
                 self._sets.clear()
+                self._zsets.clear()
                 return b"+OK\r\n"
             return f"-ERR unknown command '{name}'\r\n".encode()
 

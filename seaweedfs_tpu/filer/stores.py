@@ -188,9 +188,19 @@ def _leveldb(**kw):
     return LevelDbStore(**kw)
 
 
+def _leveldb2(**kw):
+    from .leveldb2_store import Leveldb2Store
+    return Leveldb2Store(**kw)
+
+
 def _redis(**kw):
     from .redis_store import RedisStore
     return RedisStore(**kw)
+
+
+def _redis2(**kw):
+    from .redis2_store import Redis2Store
+    return Redis2Store(**kw)
 
 
 def _etcd(**kw):
@@ -218,7 +228,9 @@ register_store("sqlite", _sqlite)
 register_store("mysql", _mysql)
 register_store("postgres", _postgres)
 register_store("leveldb", _leveldb)
+register_store("leveldb2", _leveldb2)
 register_store("redis", _redis)
+register_store("redis2", _redis2)
 register_store("etcd", _etcd)
 register_store("mongodb", _mongodb)
 register_store("elastic", _elastic)

@@ -134,8 +134,47 @@ class BrokerQueue:
             glog.warning("broker notify failed: %s", e)
 
 
+class KafkaQueue:
+    """Publish every filer event to a Kafka topic over the real wire
+    protocol (weed/notification/kafka/kafka_queue.go:1-70 — sarama
+    SendMessage with the event path as the message key). Works against
+    any v0-compatible broker; CI proves it with messaging/fake_kafka."""
+
+    def __init__(self, bootstrap: str, topic: str = "seaweedfs_filer",
+                 partition: int = 0):
+        from ..messaging.kafka_wire import KafkaClient
+        self._client = KafkaClient.from_addr(bootstrap)
+        self.topic = topic
+        self.partition = partition
+        # surface connectivity/topic problems at configure time, like
+        # the reference's NewSyncProducer does
+        md = self._client.metadata([topic])
+        terr = md["topics"].get(topic, {}).get("error", 0)
+        if terr:
+            from ..messaging.kafka_wire import KafkaError
+            raise KafkaError(terr, f"topic {topic}")
+
+    def notify(self, event) -> None:
+        d = event.to_dict()
+        # message key = entry path (the reference keys on event path so
+        # per-entry ordering survives partitioned topics)
+        key = ((d.get("new") or d.get("old") or {}).get("path")
+               or d.get("directory", "")).encode()
+        body = json.dumps(d, separators=(",", ":")).encode()
+        try:
+            self._client.produce(self.topic, self.partition, key, body)
+        except Exception as e:
+            glog.warning("kafka notify failed: %s", e)
+
+    def close(self) -> None:
+        self._client.close()
+
+
 QUEUES = {
     "log": lambda cfg: LogQueue(),
+    "kafka": lambda cfg: KafkaQueue(
+        cfg.get_string("hosts", "127.0.0.1:9092").split(",")[0],
+        topic=cfg.get_string("topic", "seaweedfs_filer")),
     "file": lambda cfg: FileQueue(cfg.get_string("directory",
                                                  "./notifications")),
     "webhook": lambda cfg: WebhookQueue(
@@ -165,6 +204,6 @@ def load_notifier(config) -> Optional[object]:
             continue
         if name in QUEUES:
             return QUEUES[name](sub)
-        if name in ("kafka", "aws_sqs", "google_pub_sub", "gocdk"):
+        if name in ("aws_sqs", "google_pub_sub", "gocdk"):
             _broker_stub(name)
     return None

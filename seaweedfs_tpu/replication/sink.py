@@ -247,11 +247,146 @@ class GcsSink(ReplicationSink):
                 raise
 
 
+def azure_shared_key_signature(account: str, key_b64: str, verb: str,
+                               path: str, query: dict, headers: dict,
+                               body_len: int) -> str:
+    """Azure Storage SharedKey signature (2015-02-21+ rules: empty
+    Content-Length slot when the body is empty). `headers` must already
+    contain the x-ms-* headers to be signed; `path` is
+    /{container}/{blob}. Shared by AzureSink and fake_azure so client
+    and verifier cannot drift."""
+    import base64
+    import hashlib
+    import hmac as hmac_mod
+
+    h = {k.lower(): str(v) for k, v in headers.items()}
+    canonical_headers = "".join(
+        f"{k}:{h[k]}\n" for k in sorted(h) if k.startswith("x-ms-"))
+    canonical_resource = f"/{account}{path}"
+    for k in sorted(query):
+        canonical_resource += f"\n{k.lower()}:{query[k]}"
+    sts = "\n".join([
+        verb,
+        h.get("content-encoding", ""),
+        h.get("content-language", ""),
+        str(body_len) if body_len else "",
+        h.get("content-md5", ""),
+        h.get("content-type", ""),
+        "",  # Date: empty — x-ms-date is signed instead
+        h.get("if-modified-since", ""),
+        h.get("if-match", ""),
+        h.get("if-none-match", ""),
+        h.get("if-unmodified-since", ""),
+        h.get("range", ""),
+    ]) + "\n" + canonical_headers + canonical_resource
+    mac = hmac_mod.new(base64.b64decode(key_b64), sts.encode("utf-8"),
+                       hashlib.sha256)
+    return base64.b64encode(mac.digest()).decode()
+
+
+class AzureSink(ReplicationSink):
+    """Replicate entries into an Azure Blob container
+    (weed/replication/sink/azuresink/azure_sink.go:1-133) over the Blob
+    REST API with SharedKey auth — Put Blob for small bodies, Put Block
+    + Put Block List beyond `block_size`, Delete Blob for removals. No
+    SDK: the API surface is plain HTTPS, and CI proves it against the
+    in-repo fake (replication/fake_azure.py) speaking the same
+    protocol + signature scheme."""
+
+    API_VERSION = "2020-10-02"
+
+    def __init__(self, account: str, account_key_b64: str, container: str,
+                 directory: str = "/", endpoint: str = "",
+                 block_size: int = 8 * 1024 * 1024):
+        self.account = account
+        self.key = account_key_b64
+        self.container = container
+        self.prefix = directory.strip("/")
+        self.endpoint = (endpoint.rstrip("/") if endpoint
+                         else f"https://{account}.blob.core.windows.net")
+        self.block_size = block_size
+
+    def identity(self) -> str:
+        return (f"AzureSink:{self.endpoint}/{self.container}/"
+                f"{self.prefix}")
+
+    def _key_for(self, entry_path: str) -> str:
+        key = entry_path.lstrip("/")
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    def _request(self, verb: str, blob: str, query: dict,
+                 body: bytes, extra_headers: dict) -> None:
+        import email.utils
+
+        path = f"/{self.container}/{blob}"
+        headers = {
+            "x-ms-date": email.utils.formatdate(usegmt=True),
+            "x-ms-version": self.API_VERSION,
+            **extra_headers,
+        }
+        if body and not any(k.lower() == "content-type" for k in headers):
+            # urllib injects a default Content-Type AFTER signing; pin it
+            # explicitly so the signature covers what is actually sent
+            headers["Content-Type"] = "application/octet-stream"
+        headers["Authorization"] = (
+            f"SharedKey {self.account}:"
+            + azure_shared_key_signature(
+                self.account, self.key, verb, path, query, headers,
+                len(body)))
+        qs = urllib.parse.urlencode(query)
+        url = (self.endpoint + urllib.parse.quote(path)
+               + (f"?{qs}" if qs else ""))
+        req = urllib.request.Request(url, data=body or None, method=verb,
+                                     headers=headers)
+        with urllib.request.urlopen(req, timeout=60) as r:
+            r.read()
+
+    def create_entry(self, entry: Entry,
+                     fetch_data: Callable[[], bytes],
+                     signatures: tuple[int, ...] = ()) -> None:
+        if entry.is_directory:
+            return  # azure_sink.go:92: blob stores have no directories
+        import base64
+        data = fetch_data()
+        blob = self._key_for(entry.full_path)
+        if len(data) <= self.block_size:
+            self._request("PUT", blob, {}, data,
+                          {"x-ms-blob-type": "BlockBlob",
+                           "Content-Type": "application/octet-stream"})
+            return
+        # staged upload: Put Block per chunk, then commit the list
+        ids = []
+        for i in range(0, len(data), self.block_size):
+            bid = base64.b64encode(f"{i // self.block_size:08d}"
+                                   .encode()).decode()
+            self._request("PUT", blob,
+                          {"comp": "block", "blockid": bid},
+                          data[i:i + self.block_size], {})
+            ids.append(bid)
+        manifest = ("<?xml version=\"1.0\" encoding=\"utf-8\"?>"
+                    "<BlockList>"
+                    + "".join(f"<Latest>{i}</Latest>" for i in ids)
+                    + "</BlockList>").encode()
+        self._request("PUT", blob, {"comp": "blocklist"}, manifest,
+                      {"Content-Type": "application/octet-stream"})
+
+    def delete_entry(self, entry: Entry,
+                     signatures: tuple[int, ...] = ()) -> None:
+        if entry.is_directory:
+            return
+        try:
+            self._request("DELETE", self._key_for(entry.full_path), {},
+                          b"", {})
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+
+
 def _cloud_stub(name: str) -> ReplicationSink:
     raise RuntimeError(
         f"replication sink {name!r} needs its cloud SDK, which this image "
         "does not ship; the s3 sink covers any S3-compatible endpoint "
-        "(azure/b2 declared non-goals in COVERAGE.md; gcs is native)")
+        "(including backblaze b2's S3-compatible gateway)")
 
 
 def load_sink(config) -> Optional[ReplicationSink]:
@@ -281,6 +416,22 @@ def load_sink(config) -> Optional[ReplicationSink]:
                 sub.get_string("endpoint",
                                "https://storage.googleapis.com"),
                 sub.get_string("token", ""))
-        if name in ("azure", "backblaze"):
-            _cloud_stub(name)
+        if name == "azure":
+            return AzureSink(
+                sub.get_string("account", ""),
+                sub.get_string("account_key", ""),
+                sub.get_string("container", ""),
+                sub.get_string("directory", "/"),
+                sub.get_string("endpoint", ""))
+        if name == "backblaze":
+            # B2's S3-compatible gateway: the s3 sink with B2's endpoint
+            # and key pair is the supported route (b2_sink.go's role)
+            return S3Sink(
+                sub.get_string("endpoint",
+                               "https://s3.us-west-000.backblazeb2.com"),
+                sub.get_string("bucket", ""),
+                sub.get_string("directory", "/"),
+                sub.get_string("b2_account_id", ""),
+                sub.get_string("b2_master_application_key", ""),
+                sub.get_string("region", "us-west-000"))
     return None

@@ -163,6 +163,58 @@ class BrokerQueueInput(NotificationInput):
             os.replace(tmp, self.position_path)
 
 
+class KafkaQueueInput(NotificationInput):
+    """Consume filer events from a Kafka topic over the real wire
+    protocol (weed/replication/sub/notification_kafka.go:22-117 — the
+    reference's sarama consumer with a progress file persisting the
+    resume offset)."""
+
+    name = "kafka"
+
+    def __init__(self, bootstrap: str, topic: str = "seaweedfs_filer",
+                 partition: int = 0, position_path: str = ""):
+        from ..messaging.kafka_wire import KafkaClient
+        self._client = KafkaClient.from_addr(bootstrap)
+        self.topic = topic
+        self.partition = partition
+        self.position_path = position_path
+        self._offset = 0
+        if position_path and os.path.exists(position_path):
+            try:
+                with open(position_path, encoding="utf-8") as f:
+                    self._offset = json.load(f).get("offset", 0)
+            except (OSError, ValueError):
+                pass
+        self._pending: list = []
+
+    def receive(self, timeout: float = 1.0) -> Optional[MetaEvent]:
+        if not self._pending:
+            try:
+                self._pending = self._client.fetch(
+                    self.topic, self.partition, self._offset,
+                    max_wait_ms=int(timeout * 1000))
+            except Exception:
+                return None
+        if not self._pending:
+            return None
+        offset, _key, value = self._pending.pop(0)
+        self._offset = offset + 1
+        try:
+            return MetaEvent.from_dict(json.loads((value or b"").decode()))
+        except Exception:
+            return None
+
+    def ack(self) -> None:
+        if self.position_path:
+            tmp = self.position_path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"offset": self._offset}, f)
+            os.replace(tmp, self.position_path)
+
+    def close(self) -> None:
+        self._client.close()
+
+
 def iter_queue(inp: NotificationInput, idle_timeout: float = 1.0,
                stop_check=None) -> Iterator[MetaEvent]:
     """Drain an input until it idles past idle_timeout (or stop_check)."""
@@ -193,4 +245,11 @@ def load_notification_input(cfg) -> Optional[NotificationInput]:
             topic=cfg.get_string("source.broker.topic", "filer"),
             partition=cfg.get_int("source.broker.partition", 0),
             position_path=cfg.get_string("source.broker.position_path", ""))
+    if cfg.get_bool("source.kafka.enabled", False):
+        return KafkaQueueInput(
+            cfg.get_string("source.kafka.hosts",
+                           "127.0.0.1:9092").split(",")[0],
+            topic=cfg.get_string("source.kafka.topic", "seaweedfs_filer"),
+            partition=cfg.get_int("source.kafka.partition", 0),
+            position_path=cfg.get_string("source.kafka.position_path", ""))
     return None

@@ -897,13 +897,29 @@ class FilerServer:
                             content_type="text/plain")
 
     async def status_ui(self, request: web.Request) -> web.Response:
-        """Status page (weed/server/filer_ui/)."""
+        """Status page with a root-directory table
+        (weed/server/filer_ui/)."""
         from ..utils.status_ui import render_status
+        entries = []
+        try:
+            for e in self.filer.store.list_directory_entries("/",
+                                                             limit=100):
+                size = sum(c.size for c in e.chunks)
+                entries.append({
+                    "name": e.full_path.rsplit("/", 1)[-1],
+                    "type": "dir" if e.is_directory else "file",
+                    "size": size, "chunks": len(e.chunks),
+                    "mtime": int(e.attr.mtime),
+                })
+        except Exception:
+            pass
         return web.Response(
             text=render_status("seaweedfs-tpu filer", {
-                "store": self.filer.store.name,
-                "masters": self.masters,
-                "cipher": self.cipher,
+                "server": {"store": self.filer.store.name,
+                           "masters": ", ".join(self.masters),
+                           "cipher": bool(self.cipher),
+                           "peers": ", ".join(self.peers) or "(none)"},
+                "root entries": entries,
                 "metrics": self.metrics.render(),
             }), content_type="text/html")
 

@@ -858,17 +858,51 @@ class MasterServer:
                             content_type="text/plain")
 
     async def status_ui(self, request: web.Request) -> web.Response:
-        """Status page (weed/server/master_ui/)."""
+        """Status page with topology + volume tables
+        (weed/server/master_ui/templates.go)."""
         from ..utils.status_ui import render_status
+        topo = self.topology.to_dict()
+        nodes = [{
+            "node": n.get("id"), "url": n.get("url"),
+            "data center": n.get("data_center"),
+            "rack": n.get("rack"),
+            "volumes": n.get("volume_count"),
+            "max": n.get("max_volume_count"),
+            "free slots": n.get("free_slots"),
+            "ec shards": n.get("ec_shard_count"),
+        } for n in topo.get("nodes", [])]
+        volumes = [{
+            "id": v.get("id"), "collection": v.get("collection") or "-",
+            "size": v.get("size"), "files": v.get("file_count"),
+            "deleted": v.get("delete_count"),
+            "replication": v.get("replica_placement"),
+            "ttl": v.get("ttl") or "-",
+            "read only": v.get("read_only", False),
+            "node": n.get("id"),
+        } for n in topo.get("nodes", []) for v in n.get("volumes", [])]
+        ec = [{
+            "volume": s.get("volume_id"),
+            "collection": s.get("collection") or "-",
+            "shards": s.get("shard_ids"), "node": n.get("id"),
+        } for n in topo.get("nodes", []) for s in n.get("ec_shards", [])]
         return web.Response(
-            text=render_status(f"seaweedfs-tpu master {self.url}", {
-                "raft": {"is_leader": self.raft.is_leader,
-                         "leader": self.raft.leader_id,
-                         "term": self.raft.term,
-                         "peers": self.raft.peers},
-                "topology": self.topology.to_dict(),
-                "metrics": self.metrics.render(),
-            }), content_type="text/html")
+            text=render_status(
+                f"seaweedfs-tpu master", {
+                    "cluster": {
+                        "is_leader": self.raft.is_leader,
+                        "leader": self.raft.leader_id,
+                        "raft term": self.raft.term,
+                        "peers": ", ".join(self.raft.peers) or "(single)",
+                        "max volume id": topo.get("max_volume_id"),
+                        "volume size limit":
+                            topo.get("volume_size_limit"),
+                    },
+                    "data nodes": nodes,
+                    "volumes": volumes,
+                    "ec shards": ec,
+                    "metrics": self.metrics.render(),
+                }, subtitle=self.url),
+            content_type="text/html")
 
 
 async def run_master(host: str, port: int, tls=None,

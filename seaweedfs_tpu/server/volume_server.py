@@ -1477,14 +1477,44 @@ class VolumeServer:
                             content_type="text/plain")
 
     async def status_ui(self, request: web.Request) -> web.Response:
-        """Status page (weed/server/volume_server_ui/)."""
+        """Status page with volume + EC tables
+        (weed/server/volume_server_ui/templates.go)."""
         from ..utils.status_ui import render_status
+        st = self.store.status()
+        volumes = [{
+            "id": v.get("id"), "collection": v.get("collection") or "-",
+            "size": v.get("size"), "files": v.get("file_count"),
+            "deleted": v.get("delete_count"),
+            "garbage bytes": v.get("deleted_bytes"),
+            "replication": v.get("replica_placement"),
+            "ttl": v.get("ttl") or "-",
+            "version": v.get("version"),
+            "read only": v.get("read_only", False),
+        } for v in st.get("volumes", [])]
+        ec = [{
+            "volume": s.get("id"),
+            "collection": s.get("collection") or "-",
+            "shards": s.get("shard_ids"),
+            "shard size": s.get("shard_size"),
+        } for s in st.get("ec_shards", [])]
+        disks = [{
+            "directory": loc.directory,
+            "volumes": len(loc.volumes),
+            "ec volumes": len(loc.ec_volumes),
+            "max": loc.max_volume_count,
+        } for loc in self.store.locations]
         return web.Response(
-            text=render_status(f"seaweedfs-tpu volume {self.url}", {
-                "store": self.store.status(),
-                "master": self.master_url,
-                "metrics": self.metrics.render(),
-            }), content_type="text/html")
+            text=render_status(
+                "seaweedfs-tpu volume server", {
+                    "server": {"master": self.master_url,
+                               "volumes": len(volumes),
+                               "ec volumes": len(ec)},
+                    "disks": disks,
+                    "volumes": volumes,
+                    "ec shards": ec,
+                    "metrics": self.metrics.render(),
+                }, subtitle=self.url),
+            content_type="text/html")
 
 
 async def run_volume_server(host: str, port: int, store: Store,

@@ -24,7 +24,7 @@ _DAV_HEADERS = {
     "DAV": "1,2",
     "MS-Author-Via": "DAV",
     "Allow": ("OPTIONS, PROPFIND, GET, HEAD, PUT, DELETE, MKCOL, "
-              "MOVE, COPY"),
+              "MOVE, COPY, LOCK, UNLOCK, PROPPATCH"),
 }
 
 
@@ -40,10 +40,107 @@ def _is_dir(entry: dict) -> bool:
     return (int(mode) & 0o170000) == 0o040000
 
 
+_DEFAULT_LOCK_SECONDS = 3600.0
+_MAX_LOCK_SECONDS = 4 * 3600.0  # memLS's infiniteTimeout stand-in
+
+
+class _Lock:
+    __slots__ = ("path", "token", "expires", "depth_infinity")
+
+    def __init__(self, path: str, token: str, expires: float,
+                 depth_infinity: bool):
+        self.path = path
+        self.token = token
+        self.expires = expires
+        self.depth_infinity = depth_infinity
+
+    @property
+    def depth(self) -> str:
+        return "infinity" if self.depth_infinity else "0"
+
+
+class LockManager:
+    """Exclusive write locks with expiry — the role of x/net/webdav's
+    memLS (the lock system the reference inherits,
+    weed/server/webdav_server.go:101). An infinite-depth lock covers the
+    whole subtree; acquiring conflicts with locks on the path, any
+    ancestor with depth infinity, or (for an infinite lock) any
+    descendant. Expired locks are collected lazily."""
+
+    def __init__(self):
+        self._locks: dict[str, _Lock] = {}
+
+    def _gc(self) -> None:
+        import time
+        now = time.monotonic()
+        for p in [p for p, lk in self._locks.items()
+                  if lk.expires <= now]:
+            del self._locks[p]
+
+    def holder(self, path: str) -> Optional[_Lock]:
+        """The live lock governing `path` (own or covering ancestor)."""
+        self._gc()
+        lk = self._locks.get(path)
+        if lk is not None:
+            return lk
+        parts = path.rstrip("/").split("/")
+        for i in range(len(parts) - 1, 0, -1):
+            anc = "/".join(parts[:i]) or "/"
+            lk = self._locks.get(anc)
+            if lk is not None and lk.depth_infinity:
+                return lk
+        return None
+
+    def descendant_holder(self, path: str) -> Optional[_Lock]:
+        """A live lock held BELOW `path` — deleting/moving the ancestor
+        would destroy that locked resource (RFC 4918: 423 without its
+        token)."""
+        self._gc()
+        prefix = path.rstrip("/") + "/"
+        for p, lk in self._locks.items():
+            if p.startswith(prefix):
+                return lk
+        return None
+
+    def acquire(self, path: str, timeout: float,
+                depth_infinity: bool = True) -> Optional[_Lock]:
+        import time
+        import uuid
+        self._gc()
+        if self.holder(path) is not None:
+            return None
+        if depth_infinity:
+            prefix = path.rstrip("/") + "/"
+            if any(p.startswith(prefix) for p in self._locks):
+                return None
+        lk = _Lock(path, f"opaquelocktoken:{uuid.uuid4()}",
+                   time.monotonic() + timeout, depth_infinity)
+        self._locks[path] = lk
+        return lk
+
+    def refresh(self, path: str, tokens: set,
+                timeout: float) -> Optional[_Lock]:
+        import time
+        lk = self.holder(path)
+        if lk is None or lk.token not in tokens:
+            return None
+        lk.expires = time.monotonic() + timeout
+        return lk
+
+    def release(self, path: str, token: str) -> bool:
+        self._gc()
+        lk = self.holder(path)
+        if lk is None or lk.token != token:
+            return False
+        del self._locks[lk.path]
+        return True
+
+
 class WebDavServer:
     def __init__(self, filer_url: str):
         self.filer = filer_url.rstrip("/")
         self._session: Optional[aiohttp.ClientSession] = None
+        self.locks = LockManager()
         self.app = self._build_app()
 
     def _build_app(self) -> web.Application:
@@ -169,6 +266,9 @@ class WebDavServer:
             return resp
 
     async def handle_put(self, request, path) -> web.Response:
+        denied = self._lock_conflict(request, path)
+        if denied:
+            return denied
         data = await request.read()
         async with self._session.put(
                 f"http://{self.filer}{quote(path)}", data=data,
@@ -178,6 +278,9 @@ class WebDavServer:
             return web.Response(status=201 if r.status < 300 else r.status)
 
     async def handle_delete(self, request, path) -> web.Response:
+        denied = self._lock_conflict(request, path, subtree=True)
+        if denied:
+            return denied
         async with self._session.delete(
                 f"http://{self.filer}{quote(path)}",
                 params={"recursive": "true"}) as r:
@@ -186,6 +289,9 @@ class WebDavServer:
             return web.Response(status=204)
 
     async def handle_mkcol(self, request, path) -> web.Response:
+        denied = self._lock_conflict(request, path)
+        if denied:
+            return denied
         if await self._lookup(path) is not None:
             return web.Response(status=405)
         async with self._session.post(
@@ -203,6 +309,9 @@ class WebDavServer:
         dest = self._dest_path(request)
         if dest is None:
             return web.Response(status=400, text="missing Destination")
+        denied = self._lock_conflict(request, path, dest, subtree=True)
+        if denied:
+            return denied
         existed = await self._lookup(dest) is not None
         if existed and request.headers.get("Overwrite", "T") == "F":
             return web.Response(status=412)
@@ -217,6 +326,9 @@ class WebDavServer:
         dest = self._dest_path(request)
         if dest is None:
             return web.Response(status=400, text="missing Destination")
+        denied = self._lock_conflict(request, dest, subtree=True)
+        if denied:
+            return denied
         entry = await self._lookup(path)
         if entry is None:
             return web.Response(status=404)
@@ -249,23 +361,102 @@ class WebDavServer:
                     data=data)
         return web.Response(status=201)
 
-    # --- lock stubs (class 2 compliance for finder/office clients) ---
-    async def handle_lock(self, request, path) -> web.Response:
-        token = "opaquelocktoken:seaweedfs-tpu-nolock"
-        body = ('<?xml version="1.0" encoding="utf-8"?>'
+    # --- locks (class 2: real exclusive write locks with expiry, the
+    # role x/net/webdav's memLS plays for the reference,
+    # weed/server/webdav_server.go:101) ---
+    def _submitted_tokens(self, request) -> set:
+        """Tokens from the If header: (<opaquelocktoken:...>) groups."""
+        import re
+        return set(re.findall(r"<(opaquelocktoken:[^>]+)>",
+                              request.headers.get("If", "")))
+
+    def _lock_conflict(self, request, *paths,
+                       subtree: bool = False) -> Optional[web.Response]:
+        """423 unless every locked path among `paths` has its token in
+        the request's If header. subtree=True also requires tokens for
+        locks held on descendants (DELETE/MOVE of an ancestor destroys
+        them)."""
+        tokens = self._submitted_tokens(request)
+        for p in paths:
+            holders = [self.locks.holder(p)]
+            if subtree:
+                holders.append(self.locks.descendant_holder(p))
+            for holder in holders:
+                if holder is not None and holder.token not in tokens:
+                    return web.Response(
+                        status=423, content_type="application/xml",
+                        text=('<?xml version="1.0" encoding="utf-8"?>'
+                              '<D:error xmlns:D="DAV:">'
+                              "<D:lock-token-submitted><D:href>"
+                              f"{escape(quote(holder.path))}</D:href>"
+                              "</D:lock-token-submitted></D:error>"))
+        return None
+
+    @staticmethod
+    def _parse_timeout(request) -> float:
+        """Timeout: Second-N | Infinite (capped like memLS's max)."""
+        raw = request.headers.get("Timeout", "")
+        for part in raw.split(","):
+            part = part.strip()
+            if part.lower().startswith("second-"):
+                try:
+                    return min(float(part[7:]), _MAX_LOCK_SECONDS)
+                except ValueError:
+                    pass
+            if part.lower() == "infinite":
+                return _MAX_LOCK_SECONDS
+        return _DEFAULT_LOCK_SECONDS
+
+    @staticmethod
+    def _lock_body(lock: "_Lock") -> str:
+        import time as time_mod
+        remain = max(0, int(lock.expires - time_mod.monotonic()))
+        return ('<?xml version="1.0" encoding="utf-8"?>'
                 '<D:prop xmlns:D="DAV:"><D:lockdiscovery><D:activelock>'
                 '<D:locktype><D:write/></D:locktype>'
                 '<D:lockscope><D:exclusive/></D:lockscope>'
-                f'<D:locktoken><D:href>{token}</D:href></D:locktoken>'
-                "</D:activelock></D:lockdiscovery></D:prop>")
-        return web.Response(status=200, text=body,
+                f"<D:depth>{lock.depth}</D:depth>"
+                f"<D:timeout>Second-{remain}</D:timeout>"
+                f"<D:locktoken><D:href>{lock.token}</D:href></D:locktoken>"
+                f"<D:lockroot><D:href>{escape(quote(lock.path))}</D:href>"
+                "</D:lockroot></D:activelock></D:lockdiscovery></D:prop>")
+
+    async def handle_lock(self, request, path) -> web.Response:
+        timeout = self._parse_timeout(request)
+        depth = request.headers.get("Depth", "infinity")
+        body = await request.read()
+        if not body:
+            # empty body = refresh of the lock named in the If header
+            tokens = self._submitted_tokens(request)
+            lock = self.locks.refresh(path, tokens, timeout)
+            if lock is None:
+                return web.Response(status=412)  # precondition failed
+            return web.Response(status=200, text=self._lock_body(lock),
+                                content_type="application/xml")
+        lock = self.locks.acquire(path, timeout,
+                                  depth_infinity=(depth != "0"))
+        if lock is None:
+            return web.Response(status=423)
+        return web.Response(status=200, text=self._lock_body(lock),
                             content_type="application/xml",
-                            headers={"Lock-Token": f"<{token}>"})
+                            headers={"Lock-Token": f"<{lock.token}>"})
 
     async def handle_unlock(self, request, path) -> web.Response:
+        raw = request.headers.get("Lock-Token", "").strip()
+        token = raw[1:-1] if raw.startswith("<") else raw
+        if not token:
+            return web.Response(status=400)
+        ok = self.locks.release(path, token)
+        if not ok:
+            # RFC 4918 9.11.1: wrong token on a locked resource
+            return web.Response(status=409 if self.locks.holder(path)
+                                is None else 403)
         return web.Response(status=204)
 
     async def handle_proppatch(self, request, path) -> web.Response:
+        denied = self._lock_conflict(request, path)
+        if denied:
+            return denied
         body = ('<?xml version="1.0" encoding="utf-8"?>'
                 '<D:multistatus xmlns:D="DAV:"><D:response>'
                 f"<D:href>{escape(quote(path))}</D:href>"

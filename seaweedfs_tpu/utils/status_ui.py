@@ -1,26 +1,93 @@
-"""Tiny status pages (role of weed/server/*_ui/ templates)."""
+"""Server status pages (role of weed/server/master_ui/templates.go,
+volume_server_ui/templates.go and the filer UI).
+
+The reference ships real HTML status pages per server with volume and
+EC-shard tables; render_status produces the same kind of page without a
+template engine: a header bar, key/value summary cards, and striped
+tables for list-shaped sections. Sections map:
+
+  str                              -> <pre>
+  {"columns": [...], "rows": [...]} -> <table>
+  list[dict]                       -> <table> (columns = union of keys)
+  dict                             -> key/value card table
+"""
 
 from __future__ import annotations
 
 import html
 import json
 
+_STYLE = (
+    "body{font-family:-apple-system,'Segoe UI',sans-serif;margin:0;"
+    "background:#f4f5f7;color:#172b4d}"
+    ".bar{background:#0747a6;color:#fff;padding:.8em 1.4em;"
+    "font-size:1.15em;font-weight:600}"
+    ".bar small{opacity:.75;font-weight:400;margin-left:.8em}"
+    ".wrap{padding:1.2em 1.4em;max-width:1100px}"
+    "h2{font-size:.95em;text-transform:uppercase;letter-spacing:.04em;"
+    "color:#5e6c84;margin:1.4em 0 .4em}"
+    "table{border-collapse:collapse;width:100%;background:#fff;"
+    "box-shadow:0 1px 2px rgba(9,30,66,.12);font-size:.9em}"
+    "th{background:#fafbfc;text-align:left;color:#5e6c84;"
+    "font-weight:600}"
+    "th,td{padding:.45em .8em;border-bottom:1px solid #ebecf0;"
+    "font-variant-numeric:tabular-nums}"
+    "tr:nth-child(even) td{background:#fafbfc}"
+    "pre{background:#fff;border:1px solid #ebecf0;padding:.8em;"
+    "overflow-x:auto;box-shadow:0 1px 2px rgba(9,30,66,.12)}"
+    ".kv td:first-child{color:#5e6c84;width:14em}"
+)
 
-def render_status(title: str, sections: dict) -> str:
-    """One HTML page: a heading plus <pre> blocks per section."""
+
+def _cell(v) -> str:
+    if isinstance(v, float):
+        v = round(v, 3)
+    if isinstance(v, (dict, list)):
+        v = json.dumps(v, default=str)
+    return html.escape(str(v))
+
+
+def _table(columns, rows) -> str:
+    head = "".join(f"<th>{_cell(c)}</th>" for c in columns)
+    body = "".join(
+        "<tr>" + "".join(f"<td>{_cell(c)}</td>" for c in row) + "</tr>"
+        for row in rows)
+    return f"<table><tr>{head}</tr>{body}</table>"
+
+
+def _section_html(value) -> str:
+    if isinstance(value, str):
+        return f"<pre>{html.escape(value)}</pre>"
+    if isinstance(value, dict) and "columns" in value and "rows" in value:
+        return _table(value["columns"], value["rows"])
+    if (isinstance(value, list) and value
+            and all(isinstance(r, dict) for r in value)):
+        cols: list = []
+        for r in value:
+            for k in r:
+                if k not in cols:
+                    cols.append(k)
+        return _table(cols, [[r.get(c, "") for c in cols]
+                             for r in value])
+    if isinstance(value, dict):
+        rows = "".join(f"<tr><td>{_cell(k)}</td><td>{_cell(v)}</td></tr>"
+                       for k, v in value.items())
+        return f"<table class='kv'>{rows}</table>"
+    return (f"<pre>{html.escape(json.dumps(value, indent=1, default=str))}"
+            "</pre>")
+
+
+def render_status(title: str, sections: dict, subtitle: str = "") -> str:
     parts = [
         "<!doctype html><html><head><meta charset='utf-8'>",
         f"<title>{html.escape(title)}</title>",
-        "<style>body{font-family:monospace;margin:2em;background:#fafafa}"
-        "h1{font-size:1.3em}h2{font-size:1.05em;margin-top:1.2em}"
-        "pre{background:#fff;border:1px solid #ddd;padding:.8em;"
-        "overflow-x:auto}</style></head><body>",
-        f"<h1>{html.escape(title)}</h1>",
+        f"<style>{_STYLE}</style></head><body>",
+        f"<div class='bar'>{html.escape(title)}"
+        + (f"<small>{html.escape(subtitle)}</small>" if subtitle else "")
+        + "</div><div class='wrap'>",
     ]
     for name, value in sections.items():
-        body = (value if isinstance(value, str)
-                else json.dumps(value, indent=1, default=str))
-        parts.append(f"<h2>{html.escape(name)}</h2>"
-                     f"<pre>{html.escape(body)}</pre>")
-    parts.append("</body></html>")
+        parts.append(f"<h2>{html.escape(name)}</h2>")
+        parts.append(_section_html(value))
+    parts.append("</div></body></html>")
     return "".join(parts)

@@ -111,15 +111,24 @@ def test_labeled_metrics_render():
 
 
 def test_status_ui_and_profile_endpoints(cluster, filer):
+    # write a blob first so the volume tables have rows
+    cluster.client.upload(b"ui page blob", collection="")
     url = cluster.master_url.split(",")[0]
     with urllib.request.urlopen(f"http://{url}/ui", timeout=10) as r:
         page = r.read().decode()
-    assert "master" in page and "topology" in page
+    # real status page: cluster card + data-node/volume TABLES
+    # (master_ui/templates.go parity, not a JSON dump)
+    assert "master" in page and "<table" in page
+    assert "data nodes" in page and "volumes" in page
+    assert "raft term" in page
     vs_url = cluster.volume_servers[0].url
     with urllib.request.urlopen(f"http://{vs_url}/ui", timeout=10) as r:
-        assert "volume" in r.read().decode()
+        vpage = r.read().decode()
+    assert "volume" in vpage and "<table" in vpage
+    assert "disks" in vpage and "collection" in vpage
     with urllib.request.urlopen(f"http://{filer.url}/ui", timeout=10) as r:
-        assert "filer" in r.read().decode()
+        fpage = r.read().decode()
+    assert "filer" in fpage and "root entries" in fpage
     with urllib.request.urlopen(
             f"http://{vs_url}/debug/profile?seconds=0.2", timeout=10) as r:
         assert "cumulative" in r.read().decode()

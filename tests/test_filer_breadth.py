@@ -19,8 +19,8 @@ from seaweedfs_tpu.filer.entry import new_directory, new_file
 from seaweedfs_tpu.filer.stores import create_store
 
 
-@pytest.fixture(params=["memory", "sqlite", "leveldb", "redis", "etcd",
-                        "mongodb", "elastic", "cassandra"])
+@pytest.fixture(params=["memory", "sqlite", "leveldb", "leveldb2", "redis",
+                        "redis2", "etcd", "mongodb", "elastic", "cassandra"])
 def store(request, tmp_path):
     kwargs = {}
     fake = None
@@ -28,8 +28,12 @@ def store(request, tmp_path):
         kwargs["path"] = str(tmp_path / "f.db")
     if request.param == "leveldb":
         kwargs["path"] = str(tmp_path / "f.ldb")
-    if request.param == "redis":
+    if request.param == "leveldb2":
+        # 8-way dir-hash sharded LSM (leveldb2_store.go model)
+        kwargs["path"] = str(tmp_path / "f2.ldb")
+    if request.param in ("redis", "redis2"):
         # non-SQL distributed store proven against the in-repo RESP fake
+        # (redis2 = the sorted-set listing model)
         from seaweedfs_tpu.filer.fake_redis import FakeRedisServer
         fake = FakeRedisServer()
         kwargs["host"], kwargs["port"] = fake.host, fake.port
@@ -108,6 +112,69 @@ def test_store_contract_folder_purge_and_kv(store):
     store.kv_put("offset.peer1", b"\x00\x01\x02")
     assert store.kv_get("offset.peer1") == b"\x00\x01\x02"
     assert store.kv_get("missing") is None
+
+
+def test_leveldb2_shards_by_directory_hash(tmp_path):
+    """leveldb2's defining property (leveldb2_store.go:239-248): the
+    parent dir picks one of 8 LSM shards; many dirs spread across
+    shards, one dir's children stay together; state survives reopen."""
+    import os
+
+    from seaweedfs_tpu.filer.leveldb2_store import _shard_of
+
+    path = str(tmp_path / "ldb2")
+    s = create_store("leveldb2", path=path, wal_flush_entries=8)
+    dirs = [f"/spread/d{i}" for i in range(32)]
+    for d in dirs:
+        s.insert_entry(new_directory(d))
+        for j in range(3):
+            s.insert_entry(new_file(f"{d}/f{j}",
+                                    [FileChunk(f"1,{j:x}", 0, 1)]))
+    # the hash rule spreads 32 dirs over >1 shard (md5 is uniform)
+    assert len({_shard_of(d) for d in dirs}) > 4
+    # all 8 shard dirs exist on disk (00..07)
+    assert sorted(os.listdir(path)) == [f"{i:02d}" for i in range(8)]
+    s.close()
+
+    s2 = create_store("leveldb2", path=path)
+    for d in dirs:
+        names = [e.full_path.rsplit("/", 1)[-1]
+                 for e in s2.list_directory_entries(d, limit=10)]
+        assert names == ["f0", "f1", "f2"], d
+    # subtree delete prunes every shard's slice
+    s2.delete_folder_children("/spread")
+    for d in dirs:
+        assert s2.list_directory_entries(d, limit=10) == []
+        assert s2.find_entry(f"{d}/f0") is None
+    s2.close()
+
+
+def test_redis2_uses_sorted_set_listing():
+    """redis2's defining property (redis2/universal_redis_store.go:51,
+    :142): children live in a ZSET — ZADD NX on insert, index-ranged
+    ZRANGE pages already sorted — not in an unordered SET."""
+    from seaweedfs_tpu.filer.fake_redis import FakeRedisServer
+
+    fake = FakeRedisServer()
+    try:
+        s = create_store("redis2", host=fake.host, port=fake.port)
+        for i in (3, 1, 2, 0):
+            s.insert_entry(new_file(f"/zd/f{i}", []))
+        # the directory membership is a zset, and no legacy SET exists
+        assert ("/zd\x00").encode() in fake._zsets
+        assert ("/zd\x00").encode() not in fake._sets
+        got = [e.full_path for e in s.list_directory_entries("/zd")]
+        assert got == [f"/zd/f{i}" for i in range(4)]
+        # pagination from a start marker
+        got = [e.full_path for e in s.list_directory_entries(
+            "/zd", start_file_name="f1", limit=2)]
+        assert got == ["/zd/f2", "/zd/f3"]
+        s.delete_entry("/zd/f2")
+        got = [e.full_path for e in s.list_directory_entries("/zd")]
+        assert got == ["/zd/f0", "/zd/f1", "/zd/f3"]
+        s.close()
+    finally:
+        fake.close()
 
 
 def test_leveldb_store_persistence_and_compaction(tmp_path):

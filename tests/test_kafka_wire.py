@@ -1,0 +1,152 @@
+"""Kafka wire-protocol backend: client vs the in-repo fake broker, the
+notification queue, and the filer.replicate input.
+
+Counterparts: weed/notification/kafka/kafka_queue.go:1-70 (produce side)
+and weed/replication/sub/notification_kafka.go:22-117 (consume side with
+a persisted resume offset). The fake speaks the v0 Metadata/Produce/
+Fetch binary APIs, so what is proven here is the actual wire format.
+"""
+
+import json
+import os
+
+import pytest
+
+from seaweedfs_tpu.filer.chunks import FileChunk
+from seaweedfs_tpu.filer.entry import new_file
+from seaweedfs_tpu.filer.filer import MetaEvent
+from seaweedfs_tpu.messaging.fake_kafka import FakeKafkaServer
+from seaweedfs_tpu.messaging.kafka_wire import (KafkaClient, KafkaError,
+                                                decode_message_set,
+                                                encode_message)
+from seaweedfs_tpu.notification.queues import KafkaQueue
+from seaweedfs_tpu.replication.sub import KafkaQueueInput, iter_queue
+
+
+@pytest.fixture()
+def broker():
+    b = FakeKafkaServer()
+    yield b
+    b.close()
+
+
+def _event(path: str, tsns: int) -> MetaEvent:
+    return MetaEvent(tsns=tsns, directory=os.path.dirname(path),
+                     old_entry=None,
+                     new_entry=new_file(path, [FileChunk("1,ab", 0, 3)]))
+
+
+def test_message_codec_roundtrip():
+    raw = encode_message(b"k1", b"v1") + encode_message(None, b"v2")
+    # broker-side offsets are rewritten; emulate offsets 5 and 6
+    import struct
+    m1 = encode_message(b"k1", b"v1")
+    m2 = encode_message(None, b"v2")
+    raw = (struct.pack(">qi", 5, len(m1) - 12) + m1[12:]
+           + struct.pack(">qi", 6, len(m2) - 12) + m2[12:])
+    got = decode_message_set(raw)
+    assert got == [(5, b"k1", b"v1"), (6, None, b"v2")]
+    # corrupted payload fails the CRC
+    bad = bytearray(raw)
+    bad[-1] ^= 0xFF
+    with pytest.raises(KafkaError):
+        decode_message_set(bytes(bad))
+    # trailing partial message is dropped, not an error
+    assert decode_message_set(raw[:-3]) == [(5, b"k1", b"v1")]
+
+
+def test_produce_fetch_metadata(broker):
+    c = KafkaClient(broker.host, broker.port)
+    assert c.produce("t1", 0, b"a", b"hello") == 0
+    assert c.produce("t1", 0, b"b", b"world") == 1
+    md = c.metadata(["t1"])
+    assert md["topics"]["t1"]["error"] == 0
+    assert 0 in md["topics"]["t1"]["partitions"]
+    got = c.fetch("t1", 0, 0)
+    assert [(o, v) for o, _k, v in got] == [(0, b"hello"), (1, b"world")]
+    # offset-based resume
+    assert [v for _o, _k, v in c.fetch("t1", 0, 1)] == [b"world"]
+    assert c.fetch("t1", 0, 2) == []
+    # max_bytes windows the fetch but always returns >= 1 message
+    one = c.fetch("t1", 0, 0, max_bytes=10)
+    assert len(one) == 1 and one[0][2] == b"hello"
+    c.close()
+
+
+def test_produce_acks0_fire_and_forget(broker):
+    """acks=0 sends with no broker response: must not block waiting for
+    one, and the connection stays usable for acked requests after."""
+    c = KafkaClient(broker.host, broker.port, timeout=3.0)
+    assert c.produce("ff", 0, None, b"quiet", acks=0) == -1
+    # same connection, acked produce still correlates correctly
+    assert c.produce("ff", 0, None, b"loud", acks=1) == 1
+    got = [v for _o, _k, v in c.fetch("ff", 0, 0)]
+    assert got == [b"quiet", b"loud"]
+    c.close()
+
+
+def test_unknown_topic_rejected_at_configure_time():
+    b = FakeKafkaServer(auto_create=False)
+    try:
+        with pytest.raises(Exception):
+            KafkaQueue(b.addr, topic="never_created")
+    finally:
+        b.close()
+
+
+def test_notification_queue_to_input(broker, tmp_path):
+    q = KafkaQueue(broker.addr, topic="swfs_events")
+    for i in range(4):
+        q.notify(_event(f"/data/k{i}", 100 + i))
+    q.close()
+
+    pos = str(tmp_path / "kafka.pos")
+    inp = KafkaQueueInput(broker.addr, topic="swfs_events",
+                          position_path=pos)
+    got = [e.new_entry.full_path for e in iter_queue(inp, idle_timeout=0.2)]
+    assert got == [f"/data/k{i}" for i in range(4)]
+    inp.close()
+
+    # the persisted offset resumes past consumed events
+    q2 = KafkaQueue(broker.addr, topic="swfs_events")
+    q2.notify(_event("/data/late", 200))
+    q2.close()
+    inp2 = KafkaQueueInput(broker.addr, topic="swfs_events",
+                           position_path=pos)
+    got2 = [e.new_entry.full_path
+            for e in iter_queue(inp2, idle_timeout=0.2)]
+    assert got2 == ["/data/late"]
+    inp2.close()
+
+
+def test_kafka_message_key_is_entry_path(broker):
+    q = KafkaQueue(broker.addr, topic="keyed")
+    q.notify(_event("/buckets/b/obj.txt", 1))
+    q.close()
+    c = KafkaClient(broker.host, broker.port)
+    [(off, key, value)] = c.fetch("keyed", 0, 0)
+    assert off == 0 and key == b"/buckets/b/obj.txt"
+    assert json.loads(value)["directory"] == "/buckets/b"
+    c.close()
+
+
+def test_registries_accept_kafka(broker, tmp_path):
+    from seaweedfs_tpu.notification.queues import load_notifier
+    from seaweedfs_tpu.replication.sub import load_notification_input
+    from seaweedfs_tpu.utils.config import Configuration as Config
+
+    cfg = Config({"notification": {"kafka": {
+        "enabled": True, "hosts": broker.addr, "topic": "regtest"}}})
+    notifier = load_notifier(cfg)
+    assert isinstance(notifier, KafkaQueue)
+    notifier.notify(_event("/r/x", 5))
+    notifier.close()
+
+    icfg = Config({"source": {"kafka": {
+        "enabled": True, "hosts": broker.addr, "topic": "regtest",
+        "position_path": str(tmp_path / "p")}}})
+    inp = load_notification_input(icfg)
+    assert isinstance(inp, KafkaQueueInput)
+    ev = inp.receive(timeout=0.5)
+    assert ev is not None and ev.new_entry.full_path == "/r/x"
+    inp.close()

@@ -115,10 +115,111 @@ def test_delete(dav):
     assert e.value.code == 404
 
 
-def test_lock_unlock_stubs(dav):
+_LOCKINFO = (b'<?xml version="1.0" encoding="utf-8"?>'
+             b'<D:lockinfo xmlns:D="DAV:"><D:lockscope><D:exclusive/>'
+             b'</D:lockscope><D:locktype><D:write/></D:locktype>'
+             b'</D:lockinfo>')
+
+
+def test_lock_enforcement(dav):
+    """Real class-2 locks (x/net/webdav memLS role,
+    weed/server/webdav_server.go:101): writes on a locked resource are
+    rejected without the token, accepted with it; UNLOCK verifies the
+    token; refresh extends the lease."""
     _req(f"{dav}/lk.txt", "PUT", b"lockable").close()
-    with _req(f"{dav}/lk.txt", "LOCK") as r:
+    with _req(f"{dav}/lk.txt", "LOCK", _LOCKINFO,
+              {"Timeout": "Second-600"}) as r:
         assert r.status == 200
-        assert "Lock-Token" in r.headers
-    with _req(f"{dav}/lk.txt", "UNLOCK") as r:
+        token = r.headers["Lock-Token"].strip("<>")
+        assert token.startswith("opaquelocktoken:")
+        assert "lockdiscovery" in r.read().decode()
+
+    # writes without the token are 423 Locked
+    for method, extra in (("PUT", b"nope"), ("DELETE", None)):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _req(f"{dav}/lk.txt", method, extra)
+        assert e.value.code == 423
+    # MOVE onto the locked path is refused too
+    _req(f"{dav}/mover.txt", "PUT", b"m").close()
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _req(f"{dav}/mover.txt", "MOVE", None,
+             {"Destination": f"http://{dav}/lk.txt"})
+    assert e.value.code == 423
+    # a second LOCK conflicts
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _req(f"{dav}/lk.txt", "LOCK", _LOCKINFO)
+    assert e.value.code == 423
+
+    # with the token (If header) the write goes through
+    with _req(f"{dav}/lk.txt", "PUT", b"holder writes",
+              {"If": f"(<{token}>)"}) as r:
+        assert r.status == 201
+    with _req(f"{dav}/lk.txt") as r:
+        assert r.read() == b"holder writes"
+
+    # refresh: empty-body LOCK with the If header
+    with _req(f"{dav}/lk.txt", "LOCK", None,
+              {"If": f"(<{token}>)", "Timeout": "Second-900"}) as r:
+        assert r.status == 200
+        assert "Second-" in r.read().decode()
+
+    # UNLOCK with a wrong token is 403; right token releases
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _req(f"{dav}/lk.txt", "UNLOCK", None,
+             {"Lock-Token": "<opaquelocktoken:wrong>"})
+    assert e.value.code == 403
+    with _req(f"{dav}/lk.txt", "UNLOCK", None,
+              {"Lock-Token": f"<{token}>"}) as r:
         assert r.status == 204
+    _req(f"{dav}/lk.txt", "PUT", b"free again").close()
+
+
+def test_lock_depth_infinity_covers_children(dav):
+    _req(f"{dav}/locked_dir/child.txt", "PUT", b"c").close()
+    with _req(f"{dav}/locked_dir", "LOCK", _LOCKINFO) as r:
+        token = r.headers["Lock-Token"].strip("<>")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _req(f"{dav}/locked_dir/child.txt", "PUT", b"x")
+    assert e.value.code == 423
+    with _req(f"{dav}/locked_dir/child.txt", "PUT", b"x",
+              {"If": f"(<{token}>)"}) as r:
+        assert r.status == 201
+    _req(f"{dav}/locked_dir", "UNLOCK", None,
+         {"Lock-Token": f"<{token}>"}).close()
+
+
+def test_delete_ancestor_of_locked_child_is_423(dav):
+    """DELETE/MOVE of an ancestor must not destroy a locked descendant
+    without its token (RFC 4918 lock-token-submitted)."""
+    _req(f"{dav}/anc/deep/kid.txt", "PUT", b"k").close()
+    with _req(f"{dav}/anc/deep/kid.txt", "LOCK", _LOCKINFO) as r:
+        token = r.headers["Lock-Token"].strip("<>")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _req(f"{dav}/anc", "DELETE")
+    assert e.value.code == 423
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _req(f"{dav}/anc", "MOVE", None,
+             {"Destination": f"http://{dav}/anc2"})
+    assert e.value.code == 423
+    # the child survived; with the token the ancestor delete proceeds
+    with _req(f"{dav}/anc/deep/kid.txt") as r:
+        assert r.read() == b"k"
+    with _req(f"{dav}/anc", "DELETE", None,
+              {"If": f"(<{token}>)"}) as r:
+        assert r.status == 204
+
+
+def test_lock_expiry():
+    """Leases expire: a 0-second lock is gone on the next check."""
+    import time
+
+    from seaweedfs_tpu.server.webdav_server import LockManager
+
+    lm = LockManager()
+    lk = lm.acquire("/x", timeout=0.05)
+    assert lk is not None
+    assert lm.acquire("/x", timeout=10) is None  # still held
+    time.sleep(0.06)
+    assert lm.holder("/x") is None  # expired
+    lk2 = lm.acquire("/x", timeout=10)
+    assert lk2 is not None and lk2.token != lk.token
