@@ -1,0 +1,195 @@
+// Second-language wire conformance client.
+//
+// The reference proves its wire protocol with a Java client
+// (other/java/client); nothing but this repo's own Python had ever
+// spoken this framework's protocol. This standalone C++ program drives
+// the cluster the way an external SDK would — hand-rolled HTTP/1.1 over
+// raw sockets, no Python anywhere in the path:
+//
+//   1. GET  /dir/assign on the master        -> fid + volume URL
+//   2. POST /<fid> multipart on the volume   -> size/eTag JSON
+//   3. GET  /<fid>                           -> bytes must equal upload
+//   4. HEAD /<fid>                           -> Content-Length == size
+//   5. GET  /<fid> with If-None-Match        -> 304
+//   6. GET  range bytes=4-9                  -> 206 + exact slice
+//   7. DELETE /<fid>                         -> 200; GET -> 404
+//   8. GET /dir/lookup?volumeId=N            -> locations JSON
+//
+// Exit 0 on full success; prints FAIL + reason and exits 1 otherwise.
+// Usage: wire_conformance <master_host:port>
+//
+// Build: make -C native wire  (g++, no third-party deps)
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Response {
+  int status = 0;
+  std::string headers;
+  std::string body;
+};
+
+[[noreturn]] void fail(const std::string& why) {
+  std::fprintf(stderr, "FAIL: %s\n", why.c_str());
+  std::exit(1);
+}
+
+int dial(const std::string& hostport) {
+  auto colon = hostport.rfind(':');
+  if (colon == std::string::npos) fail("bad address " + hostport);
+  std::string host = hostport.substr(0, colon);
+  std::string port = hostport.substr(colon + 1);
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  if (getaddrinfo(host.c_str(), port.c_str(), &hints, &res) != 0 || !res)
+    fail("resolve " + hostport);
+  int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd < 0) fail("socket");
+  if (connect(fd, res->ai_addr, res->ai_addrlen) != 0)
+    fail("connect " + hostport);
+  freeaddrinfo(res);
+  return fd;
+}
+
+Response request(const std::string& hostport, const std::string& method,
+                 const std::string& path, const std::string& body = "",
+                 const std::string& extra_headers = "") {
+  int fd = dial(hostport);
+  std::string req = method + " " + path + " HTTP/1.1\r\nHost: " + hostport +
+                    "\r\nConnection: close\r\nContent-Length: " +
+                    std::to_string(body.size()) + "\r\n" + extra_headers +
+                    "\r\n" + body;
+  size_t sent = 0;
+  while (sent < req.size()) {
+    ssize_t n = write(fd, req.data() + sent, req.size() - sent);
+    if (n <= 0) fail("send");
+    sent += static_cast<size_t>(n);
+  }
+  std::string raw;
+  char buf[65536];
+  ssize_t n;
+  while ((n = read(fd, buf, sizeof buf)) > 0) raw.append(buf, n);
+  close(fd);
+  auto hdr_end = raw.find("\r\n\r\n");
+  if (hdr_end == std::string::npos) fail("no header terminator");
+  Response r;
+  r.headers = raw.substr(0, hdr_end);
+  r.body = raw.substr(hdr_end + 4);
+  if (sscanf(raw.c_str(), "HTTP/1.1 %d", &r.status) != 1 &&
+      sscanf(raw.c_str(), "HTTP/1.0 %d", &r.status) != 1)
+    fail("bad status line: " + raw.substr(0, 40));
+  return r;
+}
+
+// minimal JSON string-field extraction: "key": "value"
+std::string json_str(const std::string& body, const std::string& key) {
+  std::string pat = "\"" + key + "\"";
+  auto at = body.find(pat);
+  if (at == std::string::npos) return "";
+  at = body.find('"', at + pat.size() + 1);  // opening quote of value
+  if (at == std::string::npos) return "";
+  auto end = body.find('"', at + 1);
+  return body.substr(at + 1, end - at - 1);
+}
+
+std::string header_value(const Response& r, const std::string& name) {
+  std::string lower_headers;
+  for (char c : r.headers) lower_headers += std::tolower(c);
+  std::string pat = "\r\n";
+  for (char c : name) pat += std::tolower(c);
+  pat += ":";
+  auto at = lower_headers.find(pat);
+  if (at == std::string::npos) return "";
+  auto start = at + pat.size();
+  auto end = r.headers.find("\r\n", start);
+  std::string v = r.headers.substr(start, end - start);
+  while (!v.empty() && v.front() == ' ') v.erase(v.begin());
+  return v;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 2) fail("usage: wire_conformance <master_host:port>");
+  std::string master = argv[1];
+
+  // 1. assign
+  Response a = request(master, "GET", "/dir/assign");
+  if (a.status != 200) fail("assign status " + std::to_string(a.status));
+  std::string fid = json_str(a.body, "fid");
+  std::string vol = json_str(a.body, "url");
+  if (fid.empty() || vol.empty()) fail("assign fields: " + a.body);
+  std::printf("assign: fid=%s url=%s\n", fid.c_str(), vol.c_str());
+
+  // 2. multipart upload
+  std::string payload;
+  for (int i = 0; i < 1000; i++) payload += "cpp-wire-";
+  std::string bnd = "cppwirebnd";
+  std::string mp = "--" + bnd +
+                   "\r\nContent-Disposition: form-data; name=\"file\"; "
+                   "filename=\"c.bin\"\r\nContent-Type: "
+                   "application/octet-stream\r\n\r\n" +
+                   payload + "\r\n--" + bnd + "--\r\n";
+  Response up = request(vol, "POST", "/" + fid, mp,
+                        "Content-Type: multipart/form-data; boundary=" +
+                            bnd + "\r\n");
+  if (up.status != 201) fail("upload status " + std::to_string(up.status));
+  std::string etag = json_str(up.body, "eTag");
+  if (etag.empty()) fail("upload eTag: " + up.body);
+  std::printf("upload: eTag=%s\n", etag.c_str());
+
+  // 3. read back
+  Response g = request(vol, "GET", "/" + fid);
+  if (g.status != 200) fail("get status " + std::to_string(g.status));
+  if (g.body != payload)
+    fail("payload mismatch: got " + std::to_string(g.body.size()) +
+         " bytes, want " + std::to_string(payload.size()));
+  std::printf("get: %zu bytes identical\n", g.body.size());
+
+  // 4. HEAD
+  Response h = request(vol, "HEAD", "/" + fid);
+  if (h.status != 200) fail("head status " + std::to_string(h.status));
+  if (header_value(h, "Content-Length") != std::to_string(payload.size()))
+    fail("head content-length " + header_value(h, "Content-Length"));
+  if (!h.body.empty()) fail("head returned a body");
+
+  // 5. conditional GET
+  Response c =
+      request(vol, "GET", "/" + fid, "",
+              "If-None-Match: \"" + etag + "\"\r\n");
+  if (c.status != 304) fail("if-none-match status " +
+                            std::to_string(c.status));
+
+  // 6. range
+  Response rg = request(vol, "GET", "/" + fid, "", "Range: bytes=4-9\r\n");
+  if (rg.status != 206) fail("range status " + std::to_string(rg.status));
+  if (rg.body != payload.substr(4, 6)) fail("range bytes: " + rg.body);
+
+  // 7. delete
+  Response d = request(vol, "DELETE", "/" + fid);
+  if (d.status != 200) fail("delete status " + std::to_string(d.status));
+  Response gone = request(vol, "GET", "/" + fid);
+  if (gone.status != 404) fail("post-delete status " +
+                               std::to_string(gone.status));
+
+  // 8. lookup
+  std::string vid = fid.substr(0, fid.find(','));
+  Response lk = request(master, "GET", "/dir/lookup?volumeId=" + vid);
+  if (lk.status != 200) fail("lookup status " + std::to_string(lk.status));
+  if (lk.body.find("locations") == std::string::npos)
+    fail("lookup body: " + lk.body);
+
+  std::printf("WIRE CONFORMANCE PASS\n");
+  return 0;
+}
