@@ -232,8 +232,13 @@ def phase_rebuild(work: str) -> dict:
 
     shard_size = os.path.getsize(base + ec.to_ext(0))
 
-    coder = ec.get_coder(
-        "pallas" if jax.default_backend() == "tpu" else "jax", 10, 4)
+    # jax (XLA bitplane) coder here: its rec-window program is the one
+    # round 4 proved completes through this tunnel. The pallas rec
+    # window was measured in round 5 to wedge the phase (its compile
+    # degrades the process's transfer path and the program load then
+    # crawls on the degraded link); the pipelined XLA window still runs
+    # at ~35 GB/s, on par with the pinned pallas kernel.
+    coder = ec.get_coder("jax", 10, 4)
 
     present = [i for i in range(14) if i not in VICTIMS]
     survivors = tuple(present[:10])
@@ -241,17 +246,25 @@ def phase_rebuild(work: str) -> dict:
            for i in survivors}
 
     def read_batches() -> list:
-        """ONE [k, shard_size] batch per volume: the window program then
-        contains a single pallas call + digest, which compiles several
-        times faster through the remote compiler than the 7-call variant
-        (the 7-call rec window blew the phase budget twice)."""
-        rows = [np.frombuffer(os.pread(fds[i], shard_size, 0),
-                              dtype=np.uint8) for i in survivors]
-        return [np.stack(rows)]
+        """7 x [k, 16MB] batches per volume — the round-4-proven window
+        shape for the XLA rec program (a single [k, shard_size] batch
+        would blow HBM: the bitplane formulation materializes ~25x the
+        input in intermediates)."""
+        rows_out = []
+        offset = 0
+        while offset < shard_size:
+            n = min(BATCH_W, shard_size - offset)
+            rows = [np.frombuffer(os.pread(fds[i], n, offset),
+                                  dtype=np.uint8) for i in survivors]
+            if n < BATCH_W:
+                rows = [np.pad(r, (0, BATCH_W - n)) for r in rows]
+            rows_out.append(np.stack(rows))
+            offset += n
+        return rows_out
 
     # --- stage N volumes (healthy link: nothing has compiled yet) ---
     N_BATCHED = 6  # 6 x 1.12GB staged concurrently fits a v5e's HBM
-    _warm_stage((10, shard_size))
+    _warm_stage((10, BATCH_W))
     t0 = time.perf_counter()
     staged_vols = []
     read_s = 0.0
