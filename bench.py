@@ -48,7 +48,7 @@ import numpy as np
 
 BASELINE_GBPS = 20.0  # BASELINE.json: ec.encode >= 20 GB/s/chip on v5e
 
-HARD_BUDGET_S = 1100.0
+HARD_BUDGET_S = 1400.0  # the rec-window compile+load alone can take 400s
 MB = 1024 * 1024
 
 # encode volume: shard width divides the batch width exactly so one
@@ -810,11 +810,12 @@ def main() -> None:
             _pl.stream_encode(os.path.join(work, "1"), _host_coder(),
                               batch_size=BATCH_W)
             _log(f"shard gen (host): {time.perf_counter() - t0:.1f}s")
-            rebuild = _run_phase("rebuild", work, min(430.0, left()))
+            # the rec-window compile+load alone measured 140-403s
+            rebuild = _run_phase("rebuild", work, min(540.0, left()))
             _log(f"rebuild: p50 {rebuild.get('rebuild_p50_s')}s "
                  f"({rebuild.get('phase_wall_s')}s)")
 
-        kernel = _run_phase("kernel", work, min(560.0, max(left(), 60)))
+        kernel = _run_phase("kernel", work, min(420.0, max(left(), 60)))
         _log(f"kernel: {kernel.get('kernel', {}).get('gbps')} GB/s "
              f"({kernel.get('phase_wall_s')}s)")
 
