@@ -420,7 +420,7 @@ def bench_kernel(k: int, m: int, n: int, reps: int, tile=None, rounds=1):
     return med, spread, single_launch_s
 
 
-def phase_kernel(budget_s: float = 500.0) -> dict:
+def phase_kernel(budget_s: float = 390.0) -> dict:
     """Pinned kernel + RS(k,m) sweep (config 4) + tile sweep, ordered so
     every config reports at least one number before optional extras."""
     import jax
