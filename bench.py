@@ -206,9 +206,22 @@ def phase_encode(work: str) -> dict:
         "h2d_stage": round(stage_gbps, 2),
         "kernel_window": round(kernel_gbps, 2),
     }
-    healthy = [v for v in (disk_gbps, kernel_gbps) if v]
-    out["healthy_link_projection_gbps"] = round(min(healthy), 2) \
-        if healthy else None
+    # chip-side capability (the BASELINE north star is GB/s/CHIP): the
+    # window executable — H2D-fed compute incl. the digest reduction —
+    # measured with pipelined dispatches. Host-side stages are reported
+    # separately: the disk feed is this 1-core container's page-cache
+    # memcpy ceiling (a host property — real TPU hosts feed from many
+    # cores), and H2D here is the tunnel, not a PCIe/DMA link.
+    out["chip_encode_gbps"] = round(kernel_gbps, 2)
+    healthy = {"disk_read (1-core host feed)": disk_gbps,
+               "kernel_window (chip)": kernel_gbps}
+    healthy = {k: v for k, v in healthy.items() if v}
+    if healthy:
+        binding = min(healthy, key=healthy.get)
+        out["healthy_link_projection_gbps"] = round(healthy[binding], 2)
+        out["healthy_link_binding_stage"] = binding
+    else:
+        out["healthy_link_projection_gbps"] = None
     return out
 
 
@@ -873,8 +886,11 @@ def main() -> None:
             "unit": "GB/s",
             "vs_baseline": round(value / BASELINE_GBPS, 3),
             "extra": {
+                "chip_encode_gbps": encode.get("chip_encode_gbps"),
                 "healthy_link_projection_gbps":
                     encode.get("healthy_link_projection_gbps"),
+                "healthy_link_binding_stage":
+                    encode.get("healthy_link_binding_stage"),
                 "kernel_window_gbps": enc_rates.get("kernel_window"),
                 "pinned_kernel_gbps":
                     (kernel.get("kernel") or {}).get("gbps"),
