@@ -697,8 +697,11 @@ def bench_system(work: str, n: int = 6000, size: int = 1024,
             "read_slope_vs_base": round(
                 w2["read"]["req_s"] / max(out["read"]["req_s"], 1), 3),
             "note": ("server+client share os.cpu_count() core(s); a "
-                     "slope ~1.0 on a 1-core host means the core, not "
-                     "the worker count, is the ceiling"),
+                     "slope <= 1.0 on a 1-core host means the shared "
+                     "core, not the worker count, is the ceiling "
+                     "(extra workers only add context switching there; "
+                     "on multi-core hosts each worker is a "
+                     "share-nothing process on its own core)"),
         }
     except Exception as e:
         out["scaling"] = {"error": str(e)}
