@@ -275,16 +275,34 @@ def phase_rebuild(work: str) -> dict:
             offset += n
         return rows_out
 
-    # --- stage N volumes (healthy link: nothing has compiled yet) ---
+    # --- stage N volumes (healthy link: nothing has compiled yet).
+    # A reader thread keeps one volume of host batches ahead, so disk
+    # reads overlap device staging (pread + device transfer both release
+    # the GIL); the steady per-volume cost is max(read, stage), as in
+    # the production pipeline's reader/stager split. ---
+    import queue as queue_mod
+    import threading
+
     N_BATCHED = 6  # 6 x 1.12GB staged concurrently fits a v5e's HBM
     _warm_stage((10, BATCH_W))
+    read_q: "queue_mod.Queue" = queue_mod.Queue(maxsize=2)
+    read_meter = {"s": 0.0}
+
+    def reader_main() -> None:
+        for _ in range(N_BATCHED):
+            tr = time.perf_counter()
+            hb = read_batches()
+            read_meter["s"] += time.perf_counter() - tr
+            read_q.put(hb)
+        read_q.put(None)
+
     t0 = time.perf_counter()
+    threading.Thread(target=reader_main, daemon=True).start()
     staged_vols = []
-    read_s = 0.0
-    for _ in range(N_BATCHED):
-        tr = time.perf_counter()
-        host_batches = read_batches()
-        read_s += time.perf_counter() - tr
+    while True:
+        host_batches = read_q.get()
+        if host_batches is None:
+            break
         sv = []
         for b in host_batches:
             h = coder.stage_async(b)
@@ -297,7 +315,7 @@ def phase_rebuild(work: str) -> dict:
     stage_per_volume_s = stage_all_s / N_BATCHED
     out["ledger"] = {
         "n_volumes_staged": N_BATCHED,
-        "read_s": round(read_s, 2),
+        "read_s": round(read_meter["s"], 2),
         "stage_all_s": round(stage_all_s, 2),
         "stage_per_volume_s": round(stage_per_volume_s, 3),
         "stage_gbps": round(
