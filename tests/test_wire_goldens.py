@@ -1,0 +1,88 @@
+"""Pinned wire-format bytes for the round-5 protocol surfaces.
+
+The fakes prove behavior; these goldens prove the exact BYTES, so a
+refactor cannot silently change what goes on the wire (the same role
+the shard SHA256s play for the on-disk formats):
+
+- Kafka v0 message framing (offset/size/crc/magic/attrs/key/value);
+- Azure SharedKey string-to-sign -> signature for a fixed request;
+- S3 SigV2 string-to-sign -> signature for a fixed request.
+
+Every constant below was produced once against the implementations the
+fakes verified end-to-end, and is now load-bearing.
+"""
+
+from seaweedfs_tpu.messaging import kafka_wire
+from seaweedfs_tpu.replication.sink import azure_shared_key_signature
+from seaweedfs_tpu.s3 import sigv2
+
+
+def test_kafka_v0_message_bytes_pinned():
+    raw = kafka_wire.encode_message(b"key1", b"value-1")
+    assert raw.hex() == (
+        "0000000000000000"            # offset slot (broker assigns)
+        "00000019"                    # message size = 25
+        "ca722e59"                    # crc32 of magic..value
+        "00"                          # magic v0
+        "00"                          # attributes
+        "00000004" + b"key1".hex() +  # key
+        "00000007" + b"value-1".hex())
+    # null key/value use length -1
+    raw = kafka_wire.encode_message(None, None)
+    assert raw[12 + 4 + 2:].hex() == "ffffffff" + "ffffffff"
+    # decode round-trips the encoding
+    assert kafka_wire.decode_message_set(
+        kafka_wire.encode_message(b"k", b"v")) == [(0, b"k", b"v")]
+
+
+def test_azure_shared_key_signature_pinned():
+    sig = azure_shared_key_signature(
+        account="devaccount",
+        key_b64="ZmFrZS1henVyZS1rZXktZm9yLWNp",
+        verb="PUT",
+        path="/cont/dir/blob.bin",
+        query={"comp": "block", "blockid": "MDAwMDAwMDA="},
+        headers={"x-ms-date": "Thu, 01 Jan 2026 00:00:00 GMT",
+                 "x-ms-version": "2020-10-02",
+                 "x-ms-blob-type": "BlockBlob",
+                 "Content-Type": "application/octet-stream"},
+        body_len=1024)
+    assert sig == "Pm/lgzoRh0DUVJQWzedMtt1uHc6Me5+n79FczCC9wnY="
+    # the signature covers the x-ms headers: changing one changes it
+    sig2 = azure_shared_key_signature(
+        "devaccount", "ZmFrZS1henVyZS1rZXktZm9yLWNp", "PUT",
+        "/cont/dir/blob.bin",
+        {"comp": "block", "blockid": "MDAwMDAwMDA="},
+        {"x-ms-date": "Thu, 01 Jan 2026 00:00:01 GMT",
+         "x-ms-version": "2020-10-02",
+         "x-ms-blob-type": "BlockBlob",
+         "Content-Type": "application/octet-stream"}, 1024)
+    assert sig2 != sig
+    # empty body leaves the Content-Length slot EMPTY (2015+ rule)
+    sig3 = azure_shared_key_signature(
+        "devaccount", "ZmFrZS1henVyZS1rZXktZm9yLWNp", "DELETE",
+        "/cont/b", {}, {"x-ms-date": "Thu, 01 Jan 2026 00:00:00 GMT",
+                        "x-ms-version": "2020-10-02"}, 0)
+    assert sig3 == "/AxxlL1o/0kkqLqW0eDlaQwuj9udS4n7gMiZEraztec="
+
+
+def test_sigv2_signature_pinned():
+    sts = sigv2.string_to_sign(
+        "GET", "/bucket/key.txt", {"tagging": "", "other": "x"},
+        {"Date": "Thu, 01 Jan 2026 00:00:00 GMT",
+         "Content-Type": "text/plain",
+         "x-amz-meta-b": "two",
+         "x-amz-meta-a": "one"})
+    # sub-resource whitelist keeps ?tagging, drops ?other; amz headers
+    # sorted; Date in its slot
+    assert sts == ("GET\n\ntext/plain\n"
+                   "Thu, 01 Jan 2026 00:00:00 GMT\n"
+                   "x-amz-meta-a:one\nx-amz-meta-b:two\n"
+                   "/bucket/key.txt?tagging")
+    assert sigv2.signature("secret", sts) == \
+        "yKpg9RfyXyUgu1EdisVeS01wEZ0="
+    # x-amz-date empties the Date slot (the amz header wins)
+    sts2 = sigv2.string_to_sign(
+        "GET", "/b/k", {}, {"Date": "Thu, 01 Jan 2026 00:00:00 GMT",
+                            "x-amz-date": "Thu, 01 Jan 2026 00:00:00 GMT"})
+    assert "\n\n\n\n" in sts2  # md5, type, date slots all empty
