@@ -876,7 +876,13 @@ def main() -> None:
                 "process because the tunneled dev link degrades ~100x "
                 "after any D2H read; cold_pass_s includes the one-time "
                 "program load. Digests verified against an independent "
-                "host coder in every phase."),
+                "host coder in every phase. The stage rate trails the "
+                "isolated H2D link rate because the disk reader and the "
+                "device_put copy contend for this host's ONE core "
+                "(probed: [10,16M] puts alone run at full link rate); "
+                "host-side feed rates are host properties — the "
+                "chip-side rates are chip_encode_gbps / "
+                "rebuild_window_gbps."),
         }
         # full record to a side file; stdout's LAST line stays small and
         # single-line so the driver's parse cannot truncate it
