@@ -33,6 +33,12 @@ def _encode_stored(offset: int, key: Optional[bytes],
 
 
 class _Handler(socketserver.BaseRequestHandler):
+    def setup(self) -> None:
+        self.server.owner._conns.add(self.request)  # type: ignore
+
+    def finish(self) -> None:
+        self.server.owner._conns.discard(self.request)  # type: ignore
+
     def handle(self) -> None:
         srv: "FakeKafkaServer" = self.server.owner  # type: ignore
         while True:
@@ -78,6 +84,13 @@ class _Handler(socketserver.BaseRequestHandler):
         return b"".join(parts)
 
 
+class _Server(socketserver.ThreadingTCPServer):
+    # reuse lets a restarted broker rebind its old port immediately —
+    # the crash/restart contract tests depend on it
+    allow_reuse_address = True
+    daemon_threads = True
+
+
 class FakeKafkaServer:
     """topics: {name: [(key, value), ...]} — offset == list index."""
 
@@ -86,9 +99,9 @@ class FakeKafkaServer:
         self.auto_create = auto_create
         self.topics: dict[str, list] = {}
         self._lock = threading.Lock()
-        self._tcp = socketserver.ThreadingTCPServer((host, port), _Handler,
-                                                    bind_and_activate=True)
-        self._tcp.daemon_threads = True
+        self._conns: set = set()
+        self._tcp = _Server((host, port), _Handler,
+                            bind_and_activate=True)
         self._tcp.owner = self  # type: ignore
         self.host, self.port = self._tcp.server_address
         self._thread = threading.Thread(target=self._tcp.serve_forever,
@@ -102,6 +115,23 @@ class FakeKafkaServer:
     def close(self) -> None:
         self._tcp.shutdown()
         self._tcp.server_close()
+
+    def kill(self) -> None:
+        """Crash simulation: stop accepting AND sever every established
+        connection (close() alone leaves accepted sockets served by
+        their handler threads — a client holding one would still get
+        answers from the 'dead' broker)."""
+        self.close()
+        for sock in list(self._conns):
+            try:
+                sock.shutdown(2)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._conns.clear()
 
     # --- API handlers (each returns the response body) ---
     def handle_metadata(self, r: _Reader) -> bytes:
