@@ -687,6 +687,10 @@ def bench_system(work: str, n: int = 6000, size: int = 1024,
                 if time.time() > deadline:
                     raise RuntimeError("combined server failed to start")
                 time.sleep(0.3)
+            # warm pass (discarded): volume growth, page allocation and
+            # connection setup otherwise land in the first timed batch
+            run_benchmark(f"127.0.0.1:{mport}", n=400, size=size,
+                          concurrency=concurrency)
             return run_benchmark(f"127.0.0.1:{mport}", n=n, size=size,
                                  concurrency=concurrency)
         finally:
