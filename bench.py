@@ -828,9 +828,18 @@ def main() -> None:
         _log(f"encode: {encode.get('value_gbps')} GB/s "
              f"({encode.get('phase_wall_s')}s)")
 
+        # kernel before rebuild: its per-config compiles are the
+        # predictable TPU work (~340s total), while the rec-window
+        # compile+load has measured anywhere from 140 to 540+s — the
+        # unpredictable phase runs LAST among the TPU phases and gets
+        # all the remaining TPU budget
+        kernel = _run_phase("kernel", work, min(420.0, max(left(), 60)))
+        _log(f"kernel: {kernel.get('kernel', {}).get('gbps')} GB/s "
+             f"({kernel.get('phase_wall_s')}s)")
+
         # shard files for the rebuild phase (host coder, parent-side)
         rebuild: dict = {"error": "skipped (budget)"}
-        if left() > 150:
+        if left() > 200:
             t0 = time.perf_counter()
             sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
             os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -838,14 +847,11 @@ def main() -> None:
             _pl.stream_encode(os.path.join(work, "1"), _host_coder(),
                               batch_size=BATCH_W)
             _log(f"shard gen (host): {time.perf_counter() - t0:.1f}s")
-            # the rec-window compile+load alone measured 140-403s
-            rebuild = _run_phase("rebuild", work, min(540.0, left()))
+            # leave ~180s for fused+system+needle_map after rebuild
+            rebuild = _run_phase("rebuild", work,
+                                 min(650.0, max(left() - 180.0, 60.0)))
             _log(f"rebuild: p50 {rebuild.get('rebuild_p50_s')}s "
                  f"({rebuild.get('phase_wall_s')}s)")
-
-        kernel = _run_phase("kernel", work, min(420.0, max(left(), 60)))
-        _log(f"kernel: {kernel.get('kernel', {}).get('gbps')} GB/s "
-             f"({kernel.get('phase_wall_s')}s)")
 
         fused = ({"error": "skipped (budget)"} if left() < 120
                  else _run_phase("fused", work, min(240.0, left())))
