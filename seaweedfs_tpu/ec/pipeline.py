@@ -30,6 +30,7 @@ between the two paths).
 
 from __future__ import annotations
 
+import contextlib
 import os
 import queue
 import threading
@@ -38,6 +39,7 @@ from typing import Iterator, Sequence
 
 import numpy as np
 
+from .. import observe
 from .coder import ErasureCoder
 from .geometry import DEFAULT, Geometry, to_ext
 
@@ -167,8 +169,33 @@ def _encode_batches(pool: ThreadPoolExecutor, dat_fd: int, dat_size: int,
         yield agg if pad_final else agg[:, :col]
 
 
+def _traced_batches(batches: Iterator[np.ndarray],
+                    ctx: "observe.TraceCtx") -> Iterator[np.ndarray]:
+    """Wrap the read stage with one ec.read span per batch (runs in the
+    reader thread, so spans use the explicit captured context). Manual
+    record_span rather than observe.stage: the final next() pull only
+    learns it was the sentinel after the timing window closes, and that
+    empty pull must not record a span."""
+    import time as time_mod
+    it = iter(batches)
+    i = 0
+    while True:
+        start_us = int(time_mod.time() * 1e6)
+        t0 = time_mod.perf_counter()
+        item = next(it, None)
+        if item is None:
+            return
+        observe.record_span(
+            "ec.read", ctx, start_us,
+            int((time_mod.perf_counter() - t0) * 1e6),
+            tags={"batch": i, "bytes": int(item.nbytes)})
+        yield item
+        i += 1
+
+
 def _run_pipeline(batches: Iterator[np.ndarray], dispatch, consume,
-                  depth: int, start_d2h: bool = True) -> None:
+                  depth: int, start_d2h: bool = True,
+                  trace_ctx: "observe.TraceCtx | None" = None) -> None:
     """reader thread -> main dispatch -> materializer thread.
 
     consume=None runs without the materializer stage entirely (sink mode:
@@ -206,6 +233,7 @@ def _run_pipeline(batches: Iterator[np.ndarray], dispatch, consume,
         mat.start()
     reader.start()
     drained = False
+    batch_i = 0
     try:
         while True:
             batch = read_q.get()
@@ -213,8 +241,15 @@ def _run_pipeline(batches: Iterator[np.ndarray], dispatch, consume,
                 drained = True
                 break
             from ..utils.profiling import trace_annotation
-            with trace_annotation("ec_pipeline_dispatch"):
+            with contextlib.ExitStack() as stack:
+                if trace_ctx is not None:
+                    stack.enter_context(observe.stage(
+                        "ec.dispatch", trace_ctx,
+                        tags={"batch": batch_i}))
+                stack.enter_context(
+                    trace_annotation("ec_pipeline_dispatch"))
                 handle = dispatch(batch)
+            batch_i += 1
             # kick the device->host copy off immediately so it overlaps the
             # next batch's H2D + kernel instead of starting at materialize
             # time (matters most when the transfer link is the bottleneck)
@@ -256,16 +291,26 @@ def stream_encode(base_file_name: str, coder: ErasureCoder,
     dat_fd = os.open(base_file_name + ".dat", os.O_RDONLY)
     fan = _FanOut([base_file_name + to_ext(i) for i in range(g.total_shards)],
                   depth)
+    # per-stage spans share the caller's trace (volume server passes its
+    # request context into this thread via observe.run_with); a fresh
+    # root is minted when none is active (CLI/bench encodes)
+    tctx = observe.ensure_ctx("ec")
 
     def consume(data: np.ndarray, handle) -> None:
-        parity = coder.materialize(handle)
-        fan.put_rows(iter([*data, *parity]))
+        from ..utils.profiling import trace_annotation
+        with observe.stage("ec.kernel", tctx), \
+                trace_annotation("ec_pipeline_kernel_wait"):
+            parity = coder.materialize(handle)
+        with observe.stage("ec.write", tctx):
+            fan.put_rows(iter([*data, *parity]))
 
     try:
         with ThreadPoolExecutor(max_workers=_READ_POOL_WORKERS) as pool:
             _run_pipeline(
-                _encode_batches(pool, dat_fd, dat_size, g, batch_size),
-                coder.encode_async, consume, depth)
+                _traced_batches(
+                    _encode_batches(pool, dat_fd, dat_size, g, batch_size),
+                    tctx),
+                coder.encode_async, consume, depth, trace_ctx=tctx)
     finally:
         fan.close()
         os.close(dat_fd)
@@ -307,6 +352,7 @@ def _windowed_digest_sink(batches: Iterator[np.ndarray], dispatch_window,
 
     read_q: queue.Queue = queue.Queue(maxsize=depth)
     errors: list[BaseException] = []
+    tctx = observe.ensure_ctx("ec")
 
     def reader_main() -> None:
         try:
@@ -332,7 +378,9 @@ def _windowed_digest_sink(batches: Iterator[np.ndarray], dispatch_window,
         if not staged:
             return
         t0 = time.perf_counter()
-        acc = dispatch_window(staged, acc)
+        with observe.stage("ec.dispatch_window", tctx,
+                           tags={"batches": len(staged)}):
+            acc = dispatch_window(staged, acc)
         t_dispatch += time.perf_counter() - t0
         n_windows += 1
         staged = []
@@ -578,6 +626,7 @@ def stream_rebuild(base_file_name: str, coder: ErasureCoder,
            for i in survivors_ids}
     shard_size = os.path.getsize(base_file_name + to_ext(survivors_ids[0]))
     fan = _FanOut([base_file_name + to_ext(i) for i in missing], depth)
+    tctx = observe.ensure_ctx("ec")
 
     def batches(pool: ThreadPoolExecutor) -> Iterator[np.ndarray]:
         offset = 0
@@ -596,12 +645,17 @@ def stream_rebuild(base_file_name: str, coder: ErasureCoder,
             offset += n
 
     def consume(survivors: np.ndarray, handle) -> None:
-        rebuilt = coder.materialize(handle)
-        fan.put_rows(iter(rebuilt))
+        from ..utils.profiling import trace_annotation
+        with observe.stage("ec.kernel", tctx), \
+                trace_annotation("ec_pipeline_kernel_wait"):
+            rebuilt = coder.materialize(handle)
+        with observe.stage("ec.write", tctx):
+            fan.put_rows(iter(rebuilt))
 
     try:
         with ThreadPoolExecutor(max_workers=_READ_POOL_WORKERS) as pool:
-            _run_pipeline(batches(pool), fn, consume, depth)
+            _run_pipeline(_traced_batches(batches(pool), tctx), fn,
+                          consume, depth, trace_ctx=tctx)
     finally:
         fan.close()
         for fd in fds.values():

@@ -1,0 +1,403 @@
+"""Cluster-wide request tracing: spans, propagation, Chrome-trace export.
+
+Every server process keeps a bounded ring buffer of completed spans.  A
+request entering any HTTP surface (master, volume, filer, webdav, S3 — and
+the raw-socket fastpath) gets a per-request trace ID, carried downstream
+over HTTP via the ``X-Seaweed-Trace: <trace_id>:<parent_span_id>`` header
+and over gRPC via ``x-seaweed-trace`` metadata (pb/rpc.py), so one S3 GET
+that fans out s3 -> filer -> volume -> EC-reconstruct yields one mergeable
+span timeline.
+
+``/debug/trace`` serves the ring as Chrome trace-event JSON (open in
+Perfetto / chrome://tracing); ``?format=spans`` returns the raw span dicts
+the ``cluster.trace`` shell command fetches from every node and merges into
+one document.  A root span slower than WEED_TRACE_SLOW_MS (default 1000)
+emits a slow-request glog line.
+
+Spans are contextvars-based so they nest naturally across awaits within a
+task; worker threads don't inherit context — capture() the ambient context
+on the event loop and re-enter it in the thread with bind()/run_with()
+(the EC pipeline stages do exactly this, ec/pipeline.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import random
+import threading
+import time
+from collections import deque
+from typing import Iterable, NamedTuple, Optional
+
+TRACE_HEADER = "X-Seaweed-Trace"
+GRPC_TRACE_KEY = "x-seaweed-trace"
+
+
+def _ring_size() -> int:
+    """A config typo must not stop every server from importing —
+    malformed/negative values fall back like slow_threshold_ms does."""
+    try:
+        size = int(os.environ.get("WEED_TRACE_RING", "4096"))
+    except ValueError:
+        return 4096
+    return size if size > 0 else 4096
+
+
+RING_SIZE = _ring_size()
+
+_trace_id: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "sw_trace_id", default="")
+_span_id: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "sw_span_id", default="")
+_service: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "sw_service", default="")
+_instance: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "sw_instance", default="")
+
+_ring: deque = deque(maxlen=RING_SIZE)
+_ring_lock = threading.Lock()
+
+
+def slow_threshold_ms() -> float:
+    """Root spans slower than this log a glog warning (env-tunable so a
+    busy cluster can raise it without a restart-and-redeploy of code)."""
+    try:
+        return float(os.environ.get("WEED_TRACE_SLOW_MS", "1000"))
+    except ValueError:
+        return 1000.0
+
+
+# ids only need uniqueness, not unpredictability: SystemRandom-seeded
+# PRNG hex is ~60x cheaper than os.urandom per id on this host class,
+# which matters on the fastpath (one trace id + one span id per request)
+_id_rng = random.Random(random.SystemRandom().getrandbits(64))
+_id_lock = threading.Lock()
+
+
+def new_id() -> str:
+    with _id_lock:
+        return f"{_id_rng.getrandbits(64):016x}"
+
+
+class TraceCtx(NamedTuple):
+    """A captured trace position, safe to hand across threads."""
+    trace_id: str
+    span_id: str
+    service: str
+    instance: str
+
+
+def capture() -> TraceCtx:
+    """Snapshot the ambient trace context (for worker threads)."""
+    return TraceCtx(_trace_id.get(), _span_id.get(),
+                    _service.get(), _instance.get())
+
+
+@contextlib.contextmanager
+def bind(ctx: TraceCtx):
+    """Re-enter a captured context (typically inside a worker thread)."""
+    tokens = (_trace_id.set(ctx.trace_id), _span_id.set(ctx.span_id),
+              _service.set(ctx.service), _instance.set(ctx.instance))
+    try:
+        yield
+    finally:
+        for var, tok in zip((_trace_id, _span_id, _service, _instance),
+                            tokens):
+            var.reset(tok)
+
+
+def run_with(ctx: TraceCtx, fn, *args, **kwargs):
+    """Run fn under a captured context — the run_in_executor bridge
+    (run_in_executor does NOT copy contextvars, unlike call_soon)."""
+    with bind(ctx):
+        return fn(*args, **kwargs)
+
+
+def parse_header(value: str) -> tuple[str, str]:
+    """'<trace_id>:<parent_span_id>' -> (trace_id, parent_id); either part
+    may be empty. Bounded so a hostile header can't bloat the ring."""
+    if not value:
+        return "", ""
+    tid, _, parent = value.partition(":")
+    return tid.strip()[:64], parent.strip()[:64]
+
+
+def header_value() -> str:
+    """Outbound header for the ambient trace ('' when not tracing)."""
+    tid = _trace_id.get()
+    if not tid:
+        return ""
+    return f"{tid}:{_span_id.get()}"
+
+
+def inject(headers: dict) -> dict:
+    """Add the trace header to an outbound-request header dict."""
+    hv = header_value()
+    if hv:
+        headers[TRACE_HEADER] = hv
+    return headers
+
+
+def grpc_metadata(existing=None):
+    """Outbound gRPC metadata with the trace pair appended (pb/rpc.py
+    client stubs call this on every RPC)."""
+    hv = header_value()
+    if not hv:
+        return existing
+    meta = list(existing) if existing else []
+    meta.append((GRPC_TRACE_KEY, hv))
+    return meta
+
+
+class Span:
+    """Context manager measuring one operation; records into the ring on
+    exit. Usable in async code (contextvars are task-local) and — with an
+    explicit ctx= — in plain threads."""
+
+    __slots__ = ("name", "tags", "_ctx", "_root", "trace_id", "span_id",
+                 "parent_id", "_service", "_instance", "_t0", "_start_us",
+                 "_tokens")
+
+    def __init__(self, name: str, tags: Optional[dict] = None,
+                 ctx: Optional[TraceCtx] = None,
+                 service: str = "", root: bool = False):
+        self.name = name
+        self.tags = dict(tags) if tags else {}
+        self._ctx = ctx
+        self._root = root
+        self._service = service
+        self._tokens = None
+
+    def __enter__(self) -> "Span":
+        ctx = self._ctx if self._ctx is not None else capture()
+        self.trace_id = ctx.trace_id or new_id()
+        self.parent_id = "" if self._root else ctx.span_id
+        self.span_id = new_id()
+        svc = self._service or ctx.service
+        self._service = svc
+        self._instance = ctx.instance
+        self._tokens = (_trace_id.set(self.trace_id),
+                        _span_id.set(self.span_id),
+                        _service.set(svc),
+                        _instance.set(ctx.instance))
+        self._start_us = int(time.time() * 1e6)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        dur_us = int((time.perf_counter() - self._t0) * 1e6)
+        for var, tok in zip((_trace_id, _span_id, _service, _instance),
+                            self._tokens):
+            var.reset(tok)
+        if exc_type is not None:
+            self.tags.setdefault("error", exc_type.__name__)
+        record({
+            "trace": self.trace_id,
+            "id": self.span_id,
+            "parent": self.parent_id,
+            "name": self.name,
+            "svc": self._service,
+            "inst": self._instance,
+            "start_us": self._start_us,
+            "dur_us": dur_us,
+            "tid": threading.get_ident() & 0x7FFFFFFF,
+            "tags": self.tags,
+        })
+
+    @property
+    def dur_ms(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e3
+
+
+def span(name: str, tags: Optional[dict] = None,
+         ctx: Optional[TraceCtx] = None, service: str = "") -> Span:
+    return Span(name, tags=tags, ctx=ctx, service=service)
+
+
+def record(span_dict: dict) -> None:
+    with _ring_lock:
+        _ring.append(span_dict)
+
+
+def record_span(name: str, ctx: TraceCtx, start_us: int, dur_us: int,
+                tags: Optional[dict] = None) -> str:
+    """Record a completed span against an explicit context — the
+    zero-contextvar path for hot worker threads (EC pipeline stages).
+    Returns the span id so callers can chain children if they need to."""
+    sid = new_id()
+    record({
+        "trace": ctx.trace_id,
+        "id": sid,
+        "parent": ctx.span_id,
+        "name": name,
+        "svc": ctx.service,
+        "inst": ctx.instance,
+        "start_us": start_us,
+        "dur_us": dur_us,
+        "tid": threading.get_ident() & 0x7FFFFFFF,
+        "tags": dict(tags) if tags else {},
+    })
+    return sid
+
+
+@contextlib.contextmanager
+def stage(name: str, ctx: TraceCtx, tags: Optional[dict] = None):
+    """Time a block and record_span it against an explicit context — the
+    with-form of record_span for hot worker threads (EC pipeline stages),
+    no contextvar traffic."""
+    start_us = int(time.time() * 1e6)
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        record_span(name, ctx, start_us,
+                    int((time.perf_counter() - t0) * 1e6), tags)
+
+
+def ensure_ctx(service: str = "") -> TraceCtx:
+    """The ambient context, or a fresh root one (trace id minted) when no
+    trace is active — lets background operations (EC encode from the CLI)
+    still produce one coherent trace."""
+    ctx = capture()
+    if ctx.trace_id:
+        return ctx
+    return TraceCtx(new_id(), "", ctx.service or service, ctx.instance)
+
+
+def spans(trace_id: str = "", limit: int = 0) -> list[dict]:
+    """Completed spans, oldest first, optionally filtered by trace id."""
+    with _ring_lock:
+        out = list(_ring)
+    if trace_id:
+        out = [s for s in out if s["trace"] == trace_id]
+    if limit and len(out) > limit:
+        out = out[-limit:]
+    return out
+
+
+def reset() -> None:
+    """Drop all recorded spans (tests)."""
+    with _ring_lock:
+        _ring.clear()
+
+
+def maybe_log_slow(span_obj: Span) -> None:
+    """Slow-request glog line for a request-level span (the per-process
+    root); threshold WEED_TRACE_SLOW_MS."""
+    dur = span_obj.dur_ms
+    if dur >= slow_threshold_ms():
+        from ..utils import glog
+        glog.warning("slow request trace=%s svc=%s %s took %.1fms",
+                     span_obj.trace_id, span_obj._service or "?",
+                     span_obj.name, dur)
+
+
+# --- Chrome trace-event export (Perfetto / chrome://tracing) ---
+
+def to_chrome_trace(span_dicts: Iterable[dict]) -> dict:
+    """Span dicts -> one Chrome trace-event JSON document. Each distinct
+    (service, instance) pair becomes a synthetic pid with a process_name
+    metadata record, so a merged multi-node trace renders as one process
+    lane per server."""
+    span_dicts = list(span_dicts)
+    procs: dict[tuple[str, str], int] = {}
+    for s in span_dicts:
+        key = (s.get("svc") or "unknown", s.get("inst") or "")
+        procs.setdefault(key, len(procs) + 1)
+    events = []
+    for (svc, inst), pid in procs.items():
+        events.append({
+            "ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+            "args": {"name": f"{svc}@{inst}" if inst else svc}})
+    for s in span_dicts:
+        pid = procs[(s.get("svc") or "unknown", s.get("inst") or "")]
+        args = {"trace_id": s.get("trace", ""),
+                "span_id": s.get("id", "")}
+        if s.get("parent"):
+            args["parent_id"] = s["parent"]
+        for k, v in (s.get("tags") or {}).items():
+            args[str(k)] = v
+        events.append({
+            "name": s.get("name", "?"),
+            "cat": s.get("svc") or "unknown",
+            "ph": "X",
+            "ts": s.get("start_us", 0),
+            "dur": max(int(s.get("dur_us", 0)), 1),
+            "pid": pid,
+            "tid": s.get("tid", 0),
+            "args": args,
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# --- aiohttp server middleware + /debug/trace handler ---
+
+def trace_middleware(service: str, instance: str = ""):
+    """Per-request root span: extract/mint the trace id, bind context for
+    the handler (so nested spans and outbound calls ride along), record,
+    and log slow requests."""
+    from aiohttp import web
+
+    @web.middleware
+    async def trace_mw(request: web.Request, handler):
+        tid, parent = parse_header(request.headers.get(TRACE_HEADER, ""))
+        ctx = TraceCtx(tid or new_id(), parent, service, instance)
+        sp = Span(f"{request.method} {request.path}", ctx=ctx)
+        streamed = False
+        try:
+            with sp:
+                resp = await handler(request)
+                sp.tags["status"] = resp.status
+                # a bare StreamResponse is a long-lived stream
+                # (/cluster/watch, meta subscribe, tail): its lifetime is
+                # not latency — same exemption the gRPC stream wrapper
+                # makes. /debug/profile blocks for its sample window by
+                # design.
+                streamed = (not isinstance(resp, web.Response)
+                            or request.path == "/debug/profile")
+                return resp
+        finally:
+            if not streamed:
+                maybe_log_slow(sp)
+
+    return trace_mw
+
+
+def trace_handler():
+    """aiohttp handler for GET /debug/trace[?trace_id=&limit=&format=].
+
+    Default: Chrome trace-event JSON of this process's span ring.
+    format=spans: the raw span dicts (what cluster.trace merges)."""
+    from aiohttp import web
+
+    async def handler(request: web.Request) -> web.Response:
+        trace_id = request.query.get("trace_id", "")
+        try:
+            limit = int(request.query.get("limit", "0"))
+        except ValueError:
+            limit = 0
+        out = spans(trace_id=trace_id, limit=limit)
+        if request.query.get("format") == "spans":
+            return web.json_response({"spans": out})
+        return web.json_response(to_chrome_trace(out))
+
+    return handler
+
+
+def client_trace_config():
+    """aiohttp TraceConfig injecting the trace header into every outbound
+    request of a session created with it — one hook instead of touching
+    each call site (params.headers is the live request header dict)."""
+    import aiohttp
+
+    tc = aiohttp.TraceConfig()
+
+    async def on_request_start(session, trace_ctx, params) -> None:
+        hv = header_value()
+        if hv and TRACE_HEADER not in params.headers:
+            params.headers[TRACE_HEADER] = hv
+
+    tc.on_request_start.append(on_request_start)
+    return tc

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import grpc
 
+from .. import observe
 from . import filer_pb2 as fpb
 from . import master_pb2 as mpb
 from . import messaging_pb2 as msgpb
@@ -202,6 +203,51 @@ def peer_ip(context) -> str:
     return peer
 
 
+def _trace_ctx_from(context, service: str,
+                    instance: str) -> "observe.TraceCtx":
+    """Build the span context from incoming x-seaweed-trace metadata (the
+    gRPC twin of the X-Seaweed-Trace HTTP header)."""
+    tid = parent = ""
+    try:
+        for k, v in (context.invocation_metadata() or ()):
+            if k == observe.GRPC_TRACE_KEY:
+                tid, parent = observe.parse_header(
+                    v if isinstance(v, str) else v.decode())
+                break
+    except Exception:
+        pass
+    return observe.TraceCtx(tid or observe.new_id(), parent, service,
+                            instance)
+
+
+def _traced(method, kind: str, service: str, rpc_name: str,
+            instance: str = ""):
+    """Wrap a servicer method in a per-RPC root span so gRPC-plane work
+    joins the same trace as the HTTP surfaces; slow RPCs log like slow
+    HTTP requests."""
+    name = f"grpc {rpc_name}"
+
+    if kind in ("us", "ss"):
+        # streams can live for hours (Heartbeat/KeepConnected): record the
+        # span at close but never slow-log — lifetime is not latency
+        async def stream_wrapper(request, context):
+            with observe.Span(
+                    name, ctx=_trace_ctx_from(context, service, instance)):
+                async for item in method(request, context):
+                    yield item
+        return stream_wrapper
+
+    async def unary_wrapper(request, context):
+        sp = observe.Span(name,
+                          ctx=_trace_ctx_from(context, service, instance))
+        try:
+            with sp:
+                return await method(request, context)
+        finally:
+            observe.maybe_log_slow(sp)
+    return unary_wrapper
+
+
 def _guarded(method, kind: str, guard):
     """Wrap a servicer method with the same IP-whitelist envelope the HTTP
     surface gets from guard_mw — without this, -whitelist deployments
@@ -234,11 +280,15 @@ def _guarded(method, kind: str, guard):
 
 
 def service_handler(service: str, spec: dict, servicer,
-                    guard=None) -> grpc.GenericRpcHandler:
+                    guard=None, trace_service: str = "",
+                    trace_instance: str = "") -> grpc.GenericRpcHandler:
     """Bind a servicer object (async methods named like the RPCs) into a
     generic handler grpc.aio can serve. Methods the servicer doesn't
     implement are simply not registered (grpc returns UNIMPLEMENTED).
-    With a guard, every RPC enforces its IP whitelist."""
+    With a guard, every RPC enforces its IP whitelist. Every RPC runs
+    inside a trace span (tracing is outermost so denied calls still show
+    up in /debug/trace with their abort)."""
+    svc_label = trace_service or service.rsplit(".", 1)[-1].lower()
     handlers = {}
     for name, (kind, req, resp) in spec.items():
         method = getattr(servicer, name, None)
@@ -246,10 +296,25 @@ def service_handler(service: str, spec: dict, servicer,
             continue
         if guard is not None:
             method = _guarded(method, kind, guard)
+        method = _traced(method, kind, svc_label, f"{service}/{name}",
+                         instance=trace_instance)
         handlers[name] = _HANDLER_FACTORY[kind](
             method, request_deserializer=req.FromString,
             response_serializer=resp.SerializeToString)
     return grpc.method_handlers_generic_handler(service, handlers)
+
+
+def _traced_call(multicallable):
+    """Wrap a client multicallable so every RPC carries the ambient trace
+    as x-seaweed-trace metadata (the gRPC twin of the HTTP header the
+    aiohttp sessions inject). Works for sync and aio channels and all
+    stream kinds — the metadata kwarg is uniform."""
+    def call(request, **kwargs):
+        meta = observe.grpc_metadata(kwargs.get("metadata"))
+        if meta is not None:
+            kwargs["metadata"] = meta
+        return multicallable(request, **kwargs)
+    return call
 
 
 class _SpecStub:
@@ -260,10 +325,10 @@ class _SpecStub:
                      "us": channel.unary_stream,
                      "ss": channel.stream_stream}
         for name, (kind, req, resp) in spec.items():
-            setattr(self, name, factories[kind](
+            setattr(self, name, _traced_call(factories[kind](
                 f"/{service}/{name}",
                 request_serializer=req.SerializeToString,
-                response_deserializer=resp.FromString))
+                response_deserializer=resp.FromString)))
 
 
 class MasterStub(_SpecStub):
@@ -299,18 +364,37 @@ class MessagingStub(_SpecStub):
         super().__init__(channel, MESSAGING_SERVICE, MESSAGING_SPEC)
 
 
-def messaging_service_handler(servicer, guard=None) -> grpc.GenericRpcHandler:
+def messaging_service_handler(servicer, guard=None,
+                              trace_service: str = "broker",
+                              trace_instance: str = ""
+                              ) -> grpc.GenericRpcHandler:
     return service_handler(MESSAGING_SERVICE, MESSAGING_SPEC, servicer,
-                           guard)
+                           guard, trace_service=trace_service,
+                           trace_instance=trace_instance)
 
 
-def master_service_handler(servicer, guard=None) -> grpc.GenericRpcHandler:
-    return service_handler(MASTER_SERVICE, MASTER_SPEC, servicer, guard)
+def master_service_handler(servicer, guard=None,
+                           trace_service: str = "master",
+                           trace_instance: str = ""
+                           ) -> grpc.GenericRpcHandler:
+    return service_handler(MASTER_SERVICE, MASTER_SPEC, servicer, guard,
+                           trace_service=trace_service,
+                           trace_instance=trace_instance)
 
 
-def volume_service_handler(servicer, guard=None) -> grpc.GenericRpcHandler:
-    return service_handler(VOLUME_SERVICE, VOLUME_SPEC, servicer, guard)
+def volume_service_handler(servicer, guard=None,
+                           trace_service: str = "volume",
+                           trace_instance: str = ""
+                           ) -> grpc.GenericRpcHandler:
+    return service_handler(VOLUME_SERVICE, VOLUME_SPEC, servicer, guard,
+                           trace_service=trace_service,
+                           trace_instance=trace_instance)
 
 
-def filer_service_handler(servicer, guard=None) -> grpc.GenericRpcHandler:
-    return service_handler(FILER_SERVICE, FILER_SPEC, servicer, guard)
+def filer_service_handler(servicer, guard=None,
+                          trace_service: str = "filer",
+                          trace_instance: str = ""
+                          ) -> grpc.GenericRpcHandler:
+    return service_handler(FILER_SERVICE, FILER_SPEC, servicer, guard,
+                           trace_service=trace_service,
+                           trace_instance=trace_instance)

@@ -30,6 +30,7 @@ import aiohttp
 from aiohttp import web
 
 from . import auth as auth_mod
+from .. import observe
 from ..utils import metrics as metrics_mod
 
 log = logging.getLogger("s3")
@@ -56,8 +57,12 @@ def _error(code: str, message: str, status: int) -> web.Response:
 class S3Server:
     def __init__(self, filer_url: str,
                  access_key: str = "", secret_key: str = "",
-                 iam: Optional["auth_mod.Iam"] = None):
+                 iam: Optional["auth_mod.Iam"] = None,
+                 url: str = ""):
         self.filer_url = filer_url
+        # own advertised host:port — the trace-span instance label, so a
+        # merged multi-gateway trace gets one Perfetto lane per gateway
+        self.url = url
         self.access_key = access_key
         self.secret_key = secret_key
         # identity registry with per-action ACLs
@@ -78,7 +83,24 @@ class S3Server:
         self.app = self._build_app()
 
     def _build_app(self) -> web.Application:
-        app = web.Application(client_max_size=5 * 1024 * 1024 * 1024)
+        app = web.Application(
+            client_max_size=5 * 1024 * 1024 * 1024,
+            middlewares=[observe.trace_middleware("s3", self.url)])
+        # ops surface registered before the catch-alls (exact routes win
+        # over the {bucket} patterns; these names are reserved like the
+        # reference's /status endpoints)
+        self._trace_handler = observe.trace_handler()
+        from ..utils.profiling import profile_handler
+        self._profile_handler = profile_handler()
+        # reserved for ALL methods: a GET-only route would let
+        # PUT /metrics fall through to the {bucket} catch-all and mint a
+        # bucket the gateway can never read back
+        for path, handler in (("/healthz", self.healthz),
+                              ("/metrics", self.metrics_handler),
+                              ("/debug/trace", self.trace_handler),
+                              ("/debug/profile", self.profile_handler)):
+            app.router.add_get(path, handler)
+            app.router.add_route("*", path, self._reserved)
         app.router.add_route("*", "/", self.dispatch_root)
         app.router.add_route("*", "/{bucket}", self.dispatch_bucket)
         app.router.add_route("*", "/{bucket}/{key:.*}", self.dispatch_object)
@@ -86,8 +108,38 @@ class S3Server:
         app.on_cleanup.append(self._on_cleanup)
         return app
 
+    async def healthz(self, request: web.Request) -> web.Response:
+        return web.json_response({"ok": True})
+
+    async def _reserved(self, request: web.Request) -> web.Response:
+        return _error("MethodNotAllowed",
+                      "reserved operational endpoint", 405)
+
+    async def metrics_handler(self, request: web.Request) -> web.Response:
+        # with credentials configured, the ops surface needs an Admin
+        # signature — spans/metrics leak object keys and topology, and
+        # unlike master/volume/filer there is no IP-whitelist in front
+        err = self._check_auth(request, action=auth_mod.ACTION_ADMIN)
+        if err is not None:
+            return err
+        return web.Response(text=self.metrics.render(),
+                            content_type="text/plain")
+
+    async def trace_handler(self, request: web.Request) -> web.Response:
+        err = self._check_auth(request, action=auth_mod.ACTION_ADMIN)
+        if err is not None:
+            return err
+        return await self._trace_handler(request)
+
+    async def profile_handler(self, request: web.Request) -> web.Response:
+        err = self._check_auth(request, action=auth_mod.ACTION_ADMIN)
+        if err is not None:
+            return err
+        return await self._profile_handler(request)
+
     async def _on_startup(self, app) -> None:
-        self._session = aiohttp.ClientSession()
+        self._session = aiohttp.ClientSession(
+            trace_configs=[observe.client_trace_config()])
 
     async def _on_cleanup(self, app) -> None:
         if self._session:
@@ -1037,6 +1089,7 @@ def _iso(ts: float) -> str:
 
 async def run_s3(host: str, port: int, filer_url: str,
                  **kwargs) -> web.AppRunner:
+    kwargs.setdefault("url", f"{host}:{port}")
     server = S3Server(filer_url, **kwargs)
     runner = web.AppRunner(server.app, access_log=None)
     await runner.setup()

@@ -25,6 +25,7 @@ import logging
 import time
 from typing import Optional
 
+from .. import observe
 from ..security.guard import token_from_request
 from ..storage.file_id import FileId
 from ..storage.needle import (FLAG_HAS_LAST_MODIFIED, FLAG_HAS_MIME,
@@ -74,6 +75,7 @@ class FastVolumeProtocol(asyncio.Protocol):
         self._queue: asyncio.Queue = asyncio.Queue()
         self._closed = False
         self._paused = False
+        self._proxied = False
 
     # --- connection lifecycle ---
     def connection_made(self, transport) -> None:
@@ -118,6 +120,10 @@ class FastVolumeProtocol(asyncio.Protocol):
             raise ConnectionResetError
         return data
 
+    # the span/service label for this listener's root spans (the master
+    # subclass overrides it)
+    TRACE_SERVICE = "volume"
+
     # --- main loop ---
     async def _run(self) -> None:
         try:
@@ -125,13 +131,40 @@ class FastVolumeProtocol(asyncio.Protocol):
                 req = await self._read_request()
                 if req is None:
                     return
-                await self._dispatch(*req)
+                await self._dispatch_traced(*req)
         except (ConnectionResetError, asyncio.CancelledError):
             pass
         except Exception:
             log.exception("fastpath connection error")
             if self.transport is not None:
                 self.transport.close()
+
+    async def _dispatch_traced(self, method: str, path: str, query: str,
+                               headers: dict, body: bytes,
+                               raw: bytes) -> None:
+        """Root span for the raw-socket data plane: join the trace from
+        the X-Seaweed-Trace header when present, mint one otherwise.
+        Proxied requests re-enter the aiohttp app whose middleware span
+        parents under this one (the header is rewritten in
+        _mark_internal to point at the ambient span)."""
+        tid, parent = observe.parse_header(
+            headers.get(b"x-seaweed-trace", b"").decode("latin-1"))
+        ctx = observe.TraceCtx(tid or observe.new_id(), parent,
+                               self.TRACE_SERVICE,
+                               getattr(self.server, "url", ""))
+        sp = observe.Span(f"fast {method} {path}", ctx=ctx)
+        self._proxied = False
+        try:
+            with sp:
+                await self._dispatch(method, path, query, headers, body,
+                                     raw)
+        finally:
+            # proxied requests re-enter the aiohttp app, whose middleware
+            # applies the proper slow-log rules (streams exempt); logging
+            # here too would double-count — and charge stream lifetime
+            # (/cluster/watch, tails) as latency
+            if not self._proxied:
+                observe.maybe_log_slow(sp)
 
     # matches the aiohttp app's client_max_size in volume_server.py
     MAX_BODY = 256 * 1024 * 1024
@@ -420,15 +453,24 @@ class FastVolumeProtocol(asyncio.Protocol):
         request under a whitelist — and (b) log the true client."""
         line, _, rest = raw.partition(b"\r\n")
         tok = self.server._internal_token.encode()
+        extra = b""
+        hv = observe.header_value()
+        if hv:
+            # parent the aiohttp-side span under the fastpath span; the
+            # injected header is first so it wins over the client's copy
+            # further down the head (headers.get returns the first)
+            extra = (b"X-Seaweed-Trace: " + hv.encode("latin-1")
+                     + b"\r\n")
         return (line + b"\r\nX-Swfs-Internal: " + tok
                 + b"\r\nX-Swfs-Peer: " + self.peer_ip.encode("latin-1")
-                + b"\r\n" + rest)
+                + b"\r\n" + extra + rest)
 
     async def _proxy_tunnel(self, initial: bytes) -> None:
         """Bidirectional relay for requests we cannot frame (chunked,
         Expect: 100-continue): everything from here on belongs to the
         aiohttp listener; the client connection closes when either side
         does."""
+        self._proxied = True
         reader, writer = await asyncio.open_connection(
             "127.0.0.1", self.internal_port)
         writer.write(self._mark_internal(initial))
@@ -465,6 +507,7 @@ class FastVolumeProtocol(asyncio.Protocol):
 
     # --- loopback proxy to the aiohttp app ---
     async def _proxy(self, raw: bytes) -> None:
+        self._proxied = True
         reader, writer = await asyncio.open_connection(
             "127.0.0.1", self.internal_port)
         try:
@@ -538,6 +581,8 @@ class FastMasterProtocol(FastVolumeProtocol):
     weed/server/master_server_handlers.go:96-150), the rest proxied to
     the aiohttp app. Inherits framing/proxy from FastVolumeProtocol;
     only the route dispatch differs."""
+
+    TRACE_SERVICE = "master"
 
     async def _admit(self, path: str) -> bool:
         # same admission as the master's guard_mw: peers, whitelist, or a

@@ -369,7 +369,8 @@ async def serve_filer_grpc(fs, host: str, port: int, tls=None):
     server = grpc.aio.server()
     server.add_generic_rpc_handlers(
         (filer_service_handler(FilerGrpcServicer(fs),
-                               guard=lambda: fs.guard),))
+                               guard=lambda: fs.guard,
+                               trace_instance=fs.url),))
     creds = tls.grpc_server_credentials() if tls is not None else None
     if creds is not None:
         server.add_secure_port(f"{host}:{port}", creds)

@@ -27,6 +27,7 @@ from typing import Optional
 import aiohttp
 from aiohttp import web
 
+from .. import observe
 from ..client import _PUSHED
 from ..filer import manifest as manifest_mod
 from ..filer.chunks import FileChunk, etag as chunks_etag, read_plan, total_size
@@ -117,11 +118,14 @@ class FilerServer:
         self.app = self._build_app()
 
     def _build_app(self) -> web.Application:
-        app = web.Application(client_max_size=1024 * 1024 * 1024)
+        app = web.Application(
+            client_max_size=1024 * 1024 * 1024,
+            middlewares=[observe.trace_middleware("filer", self.url)])
         app.router.add_get("/healthz", _healthz)
         app.router.add_get("/metrics", self.metrics_handler)
         from ..utils.profiling import profile_handler
         app.router.add_get("/debug/profile", profile_handler())
+        app.router.add_get("/debug/trace", observe.trace_handler())
         app.router.add_get("/ui", self.status_ui)
         # entry-level meta API: the JSON face of the reference's filer gRPC
         # (weed/pb/filer.proto LookupDirectoryEntry/ListEntries/CreateEntry/
@@ -366,7 +370,10 @@ class FilerServer:
 
     async def _on_startup(self, app) -> None:
         self._loop = asyncio.get_event_loop()
-        self._session = aiohttp.ClientSession()
+        # outbound chunk reads/writes and master calls carry the ambient
+        # trace header so one filer request merges with its volume spans
+        self._session = aiohttp.ClientSession(
+            trace_configs=[observe.client_trace_config()])
         if self.grpc_port:
             from .filer_grpc import serve_filer_grpc
             host = (self.url.rsplit(":", 1)[0] if self.url else "127.0.0.1")
@@ -577,46 +584,53 @@ class FilerServer:
                             replication: str, ttl: str,
                             offset: int, name_hint: str = "",
                             mime_hint: str = "") -> FileChunk:
-        a = await self._assign(collection, replication, ttl)
-        cipher_key = ""
-        payload = data
-        if self.cipher:
-            # per-chunk AES-256-GCM: the volume server stores ciphertext,
-            # the key lives only in the filer's chunk metadata
-            # (filer_server_handlers_write_cipher.go:17)
-            from ..utils import cipher as cipher_mod
-            payload, key = await asyncio.get_event_loop().run_in_executor(
-                None, cipher_mod.encrypt, data)
-            cipher_key = cipher_mod.key_to_str(key)
-        form = aiohttp.FormData()
-        # name/mime hints let the volume server's compression decision
-        # table see the real content type (chunks themselves are opaque)
-        form.add_field("file", payload,
-                       filename=name_hint or "chunk",
-                       content_type=(mime_hint if not cipher_key else "")
-                       or "application/octet-stream")
-        url = f"http://{a['url']}/{a['fid']}"
-        params = []
-        if cipher_key:
-            # ciphertext is incompressible and must round-trip bit-exact
-            params.append("compress=false")
-        if ttl:
-            params.append(f"ttl={ttl}")
-        if params:
-            url += "?" + "&".join(params)
-        headers = {}
-        if a.get("auth"):
-            # carry the master-signed per-fid write token to the volume
-            # server (weed/security/jwt.go)
-            headers["Authorization"] = f"BEARER {a['auth']}"
-        async with self._session.post(url, data=form, headers=headers) as r:
-            if r.status >= 300:
-                raise web.HTTPBadGateway(
-                    text=f"chunk upload to {a['url']}: {r.status}")
-            body = await r.json()
-        return FileChunk(fid=a["fid"], offset=offset, size=len(data),
-                         mtime=time.time_ns(), etag=body.get("eTag", ""),
-                         cipher_key=cipher_key)
+        with observe.span("filer.upload_chunk",
+                          tags={"bytes": len(data)}):
+            a = await self._assign(collection, replication, ttl)
+            cipher_key = ""
+            payload = data
+            if self.cipher:
+                # per-chunk AES-256-GCM: the volume server stores
+                # ciphertext, the key lives only in the filer's chunk
+                # metadata (filer_server_handlers_write_cipher.go:17)
+                from ..utils import cipher as cipher_mod
+                payload, key = \
+                    await asyncio.get_event_loop().run_in_executor(
+                        None, cipher_mod.encrypt, data)
+                cipher_key = cipher_mod.key_to_str(key)
+            form = aiohttp.FormData()
+            # name/mime hints let the volume server's compression decision
+            # table see the real content type (chunks themselves are
+            # opaque)
+            form.add_field("file", payload,
+                           filename=name_hint or "chunk",
+                           content_type=(mime_hint if not cipher_key
+                                         else "")
+                           or "application/octet-stream")
+            url = f"http://{a['url']}/{a['fid']}"
+            params = []
+            if cipher_key:
+                # ciphertext is incompressible, must round-trip bit-exact
+                params.append("compress=false")
+            if ttl:
+                params.append(f"ttl={ttl}")
+            if params:
+                url += "?" + "&".join(params)
+            headers = {}
+            if a.get("auth"):
+                # carry the master-signed per-fid write token to the
+                # volume server (weed/security/jwt.go)
+                headers["Authorization"] = f"BEARER {a['auth']}"
+            async with self._session.post(url, data=form,
+                                          headers=headers) as r:
+                if r.status >= 300:
+                    raise web.HTTPBadGateway(
+                        text=f"chunk upload to {a['url']}: {r.status}")
+                body = await r.json()
+            return FileChunk(fid=a["fid"], offset=offset, size=len(data),
+                             mtime=time.time_ns(),
+                             etag=body.get("eTag", ""),
+                             cipher_key=cipher_key)
 
     async def _fetch_view(self, fid: str, offset_in_chunk: int,
                           size: int, cipher_key: str = "",
@@ -645,43 +659,46 @@ class FilerServer:
 
     async def _fetch_raw(self, fid: str, offset_in_chunk: int = 0,
                          size: int = -1) -> bytes:
-        vid = int(fid.split(",")[0])
-        last: Optional[Exception] = None
-        read_auth = ""
-        urls = await self._lookup(vid)
-        for attempt in range(2):
-            needs_auth = False
-            for url in urls:
-                headers = {}
-                if size >= 0:
-                    headers["Range"] = (f"bytes={offset_in_chunk}-"
-                                        f"{offset_in_chunk + size - 1}")
-                if read_auth:
-                    headers["Authorization"] = f"BEARER {read_auth}"
-                try:
-                    async with self._session.get(f"http://{url}/{fid}",
-                                                 headers=headers) as r:
-                        if r.status in (200, 206):
-                            data = await r.read()
-                            if r.status == 200 and size >= 0:
-                                data = data[offset_in_chunk:
-                                            offset_in_chunk + size]
-                            return data
-                        last = RuntimeError(f"{url}/{fid}: HTTP {r.status}")
-                        if r.status == 401 and attempt == 0:
-                            needs_auth = True
-                            break
-                except aiohttp.ClientError as e:
-                    last = e
-            if needs_auth:
-                # volume server wants a read token: per-fid lookup signs one
-                body = await self._master_get("/dir/lookup",
-                                              {"fileId": fid})
-                read_auth = body.get("auth", "")
-                if read_auth:
-                    continue
-            break
-        raise web.HTTPBadGateway(text=f"fetch chunk {fid}: {last}")
+        with observe.span("filer.fetch_chunk", tags={"fid": fid}):
+            vid = int(fid.split(",")[0])
+            last: Optional[Exception] = None
+            read_auth = ""
+            urls = await self._lookup(vid)
+            for attempt in range(2):
+                needs_auth = False
+                for url in urls:
+                    headers = {}
+                    if size >= 0:
+                        headers["Range"] = (f"bytes={offset_in_chunk}-"
+                                            f"{offset_in_chunk + size - 1}")
+                    if read_auth:
+                        headers["Authorization"] = f"BEARER {read_auth}"
+                    try:
+                        async with self._session.get(f"http://{url}/{fid}",
+                                                     headers=headers) as r:
+                            if r.status in (200, 206):
+                                data = await r.read()
+                                if r.status == 200 and size >= 0:
+                                    data = data[offset_in_chunk:
+                                                offset_in_chunk + size]
+                                return data
+                            last = RuntimeError(
+                                f"{url}/{fid}: HTTP {r.status}")
+                            if r.status == 401 and attempt == 0:
+                                needs_auth = True
+                                break
+                    except aiohttp.ClientError as e:
+                        last = e
+                if needs_auth:
+                    # volume server wants a read token: the per-fid
+                    # lookup signs one
+                    body = await self._master_get("/dir/lookup",
+                                                  {"fileId": fid})
+                    read_auth = body.get("auth", "")
+                    if read_auth:
+                        continue
+                break
+            raise web.HTTPBadGateway(text=f"fetch chunk {fid}: {last}")
 
     # --- request dispatch ---
     async def dispatch(self, request: web.Request) -> web.StreamResponse:

@@ -20,8 +20,10 @@ import asyncio
 import logging
 from typing import Optional
 
+import aiohttp
 from aiohttp import web
 
+from .. import observe
 from ..cluster.raft import RaftNode, _endpoint_ips
 from ..security.guard import Guard
 from ..storage.file_id import FileId, new_cookie
@@ -37,7 +39,7 @@ log = logging.getLogger("master")
 # leader instead of buffering the stream through the proxy)
 _LOCAL_PATHS = ("/healthz", "/metrics", "/cluster/status", "/cluster/watch",
                 "/cluster/raft/vote", "/cluster/raft/append",
-                "/ui", "/debug/profile")
+                "/ui", "/debug/profile", "/debug/trace")
 
 
 async def _healthz(request: "web.Request") -> "web.Response":
@@ -169,8 +171,13 @@ class MasterServer:
                     {"error": "no leader elected"}, status=503)
             return await self._proxy_to(leader, request)
 
-        app = web.Application(client_max_size=64 * 1024 * 1024,
-                              middlewares=[guard_mw, leader_proxy_mw])
+        # tracing is outermost so denied/proxied requests still record a
+        # span (the fastpath listener rewrites the header so proxied
+        # requests parent under its span, server/fastpath.py)
+        app = web.Application(
+            client_max_size=64 * 1024 * 1024,
+            middlewares=[observe.trace_middleware("master", self.url),
+                         guard_mw, leader_proxy_mw])
         app.router.add_get("/dir/assign", self.dir_assign)
         app.router.add_get("/dir/lookup", self.dir_lookup)
         app.router.add_get("/dir/status", self.dir_status)
@@ -191,6 +198,7 @@ class MasterServer:
         app.router.add_get("/healthz", _healthz)
         from ..utils.profiling import profile_handler
         app.router.add_get("/debug/profile", profile_handler())
+        app.router.add_get("/debug/trace", observe.trace_handler())
         app.router.add_get("/ui", self.status_ui)
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
@@ -281,19 +289,23 @@ class MasterServer:
             await self.raft.handle_append(await request.json()))
 
     async def _proxy_to(self, leader: str, request: web.Request):
-        import aiohttp
         body = await request.read()
         url = f"http://{leader}{request.path_qs}"
         if self._proxy_session is None or self._proxy_session.closed:
             # one keep-alive pool for the follower->leader hop
             self._proxy_session = aiohttp.ClientSession(
-                timeout=aiohttp.ClientTimeout(total=60))
+                timeout=aiohttp.ClientTimeout(total=60),
+                trace_configs=[observe.client_trace_config()])
         try:
             async with self._proxy_session.request(
                     request.method, url, data=body or None,
+                    # x-seaweed-trace is stripped so the session's trace
+                    # hook injects the follower's span as the leader's
+                    # parent (forwarding the client's copy verbatim would
+                    # make the leader span a sibling, not a child)
                     headers={k: v for k, v in request.headers.items()
-                             if k.lower() not in ("host",
-                                                  "content-length")}) as r:
+                             if k.lower() not in ("host", "content-length",
+                                                  "x-seaweed-trace")}) as r:
                 payload = await r.read()
                 return web.Response(
                     body=payload, status=r.status,
@@ -478,7 +490,6 @@ class MasterServer:
         """AutomaticGrowByType (weed/topology/volume_growth.go:70-208):
         pick placement-satisfying nodes, allocate on each. Returns None if
         leadership was lost (callers answer 503 so HA clients fail over)."""
-        import aiohttp
         grown: list[int] = []
         # barrier: apply any replicated max_volume_id from prior terms
         # before computing the next id (avoids duplicate volume ids after
@@ -496,7 +507,8 @@ class MasterServer:
                 log.warning("lost leadership while growing volume %d", vid)
                 return None
             ok = True
-            async with aiohttp.ClientSession() as session:
+            async with aiohttp.ClientSession(
+                    trace_configs=[observe.client_trace_config()]) as session:
                 for node in nodes:
                     try:
                         async with session.post(
@@ -537,7 +549,8 @@ class MasterServer:
         holder (master_grpc_server_collection.go:55-86)."""
         deleted = 0
         errors = []
-        async with aiohttp.ClientSession() as session:
+        async with aiohttp.ClientSession(
+                trace_configs=[observe.client_trace_config()]) as session:
             for node in list(self.topology.nodes.values()):
                 vids = [vid for vid, v in node.volumes.items()
                         if v.collection == name]
@@ -638,9 +651,9 @@ class MasterServer:
         (batchVacuumVolumeCompact/Commit, topology_vacuum.go:17-103).
         Passes are serialized; a failure on one volume never aborts the
         rest of the scan."""
-        import aiohttp
         compacted: list[int] = []
-        async with self._vacuum_lock, aiohttp.ClientSession() as s:
+        async with self._vacuum_lock, aiohttp.ClientSession(
+                trace_configs=[observe.client_trace_config()]) as s:
             for layout in list(self.topology.layouts.values()):
                 for vid, nodes in list(layout.locations.items()):
                     if not nodes:

@@ -275,7 +275,8 @@ async def serve_master_grpc(master, host: str, port: int, tls=None):
     server = grpc.aio.server()
     server.add_generic_rpc_handlers(
         (master_service_handler(MasterGrpcServicer(master),
-                                guard=lambda: master.guard),))
+                                guard=lambda: master.guard,
+                                trace_instance=master.url),))
     creds = tls.grpc_server_credentials() if tls is not None else None
     if creds is not None:
         server.add_secure_port(f"{host}:{port}", creds)

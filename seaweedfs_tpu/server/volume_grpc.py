@@ -579,7 +579,8 @@ async def serve_volume_grpc(vs, host: str, port: int, tls=None):
     server = grpc.aio.server()
     server.add_generic_rpc_handlers(
         (volume_service_handler(VolumeGrpcServicer(vs),
-                                guard=lambda: vs.guard),))
+                                guard=lambda: vs.guard,
+                                trace_instance=vs.url),))
     creds = tls.grpc_server_credentials() if tls is not None else None
     if creds is not None:
         server.add_secure_port(f"{host}:{port}", creds)

@@ -35,6 +35,7 @@ from typing import Optional
 import aiohttp
 from aiohttp import web
 
+from .. import observe
 from ..storage.file_id import FileId
 from ..utils import compression, fast_multipart
 from ..storage.needle import (FLAG_IS_COMPRESSED,
@@ -239,8 +240,11 @@ class VolumeServer:
                                              status=403)
             return await handler(request)
 
-        app = web.Application(client_max_size=256 * 1024 * 1024,
-                              middlewares=[guard_mw])
+        # tracing outermost: denied requests still record a span
+        app = web.Application(
+            client_max_size=256 * 1024 * 1024,
+            middlewares=[observe.trace_middleware("volume", self.url),
+                         guard_mw])
         app.router.add_post("/admin/assign_volume", self.admin_assign_volume)
         app.router.add_post("/admin/vacuum", self.admin_vacuum)
         app.router.add_get("/admin/vacuum/check", self.admin_vacuum_check)
@@ -280,6 +284,7 @@ class VolumeServer:
         app.router.add_get("/healthz", _healthz)
         from ..utils.profiling import profile_handler
         app.router.add_get("/debug/profile", profile_handler())
+        app.router.add_get("/debug/trace", observe.trace_handler())
         app.router.add_get("/ui", self.status_ui)
         app.router.add_route("*", "/{fid:[^{}]*}", self.data_handler)
         app.on_startup.append(self._on_startup)
@@ -287,7 +292,8 @@ class VolumeServer:
         return app
 
     async def _on_startup(self, app) -> None:
-        self._session = aiohttp.ClientSession()
+        self._session = aiohttp.ClientSession(
+            trace_configs=[observe.client_trace_config()])
         self._batcher = WriteBatcher(self.store)
         self._hb_task = asyncio.create_task(self._heartbeat_loop())
         if self.grpc_port:
@@ -471,7 +477,8 @@ class VolumeServer:
     async def _read(self, request: web.Request, fid: FileId) -> web.Response:
         """GetOrHeadHandler (volume_server_handlers_read.go:28-272)."""
         self.metrics.count("read")
-        with self.metrics.timed("read"):
+        with self.metrics.timed("read"), \
+                observe.span("volume.read", tags={"fid": str(fid)}):
             try:
                 # small needles (the request-rate-bound workload) read
                 # inline: a page-cache pread is microseconds while the
@@ -613,7 +620,9 @@ class VolumeServer:
         from ..storage.needle import Needle as NeedleCls
         self._repair_inflight += 1
         try:
-            return await self._read_repair_inner(fid, NeedleCls)
+            with observe.span("volume.read_repair",
+                              tags={"fid": str(fid)}):
+                return await self._read_repair_inner(fid, NeedleCls)
         finally:
             self._repair_inflight -= 1
 
@@ -765,7 +774,8 @@ class VolumeServer:
         n.set_flag(FLAG_HAS_LAST_MODIFIED)
         n.last_modified = int(_time.time())
 
-        with self.metrics.timed("write"):
+        with self.metrics.timed("write"), \
+                observe.span("volume.write", tags={"fid": str(fid)}):
             try:
                 _, size, unchanged = await self._batcher.write(
                     fid.volume_id, n)
@@ -778,7 +788,8 @@ class VolumeServer:
                 return web.json_response({"error": str(e)}, status=409)
 
         if request.query.get("type") != "replicate":
-            ok = await self._replicate(request, fid, n)
+            with observe.span("volume.replicate", tags={"fid": str(fid)}):
+                ok = await self._replicate(request, fid, n)
             if not ok:
                 return web.json_response(
                     {"error": "replication failed"}, status=500)
@@ -1089,9 +1100,11 @@ class VolumeServer:
     async def admin_ec_generate(self, request: web.Request) -> web.Response:
         body = await request.json()
         vid = int(body["volume_id"])
+        tctx = observe.capture()
         try:
             shards = await asyncio.get_event_loop().run_in_executor(
-                None, lambda: self.store.ec_generate(vid))
+                None, lambda: observe.run_with(
+                    tctx, self.store.ec_generate, vid))
         except KeyError as e:
             return web.json_response({"error": str(e)}, status=404)
         return web.json_response({"ok": True, "shards": shards})
@@ -1116,9 +1129,11 @@ class VolumeServer:
 
     async def admin_ec_rebuild(self, request: web.Request) -> web.Response:
         body = await request.json()
+        tctx = observe.capture()
         try:
             rebuilt = await asyncio.get_event_loop().run_in_executor(
-                None, lambda: self.store.ec_rebuild(
+                None, lambda: observe.run_with(
+                    tctx, self.store.ec_rebuild,
                     int(body["volume_id"]), body.get("collection", "")))
         except (KeyError, ValueError) as e:
             return web.json_response({"error": str(e)}, status=409)

@@ -18,6 +18,9 @@ from xml.sax.saxutils import escape
 import aiohttp
 from aiohttp import web
 
+from .. import observe
+from ..utils import metrics as metrics_mod
+
 log = logging.getLogger("webdav")
 
 _DAV_HEADERS = {
@@ -137,21 +140,48 @@ class LockManager:
 
 
 class WebDavServer:
-    def __init__(self, filer_url: str):
+    def __init__(self, filer_url: str, url: str = ""):
         self.filer = filer_url.rstrip("/")
+        self.url = url  # trace-span instance label (own host:port)
         self._session: Optional[aiohttp.ClientSession] = None
         self.locks = LockManager()
+        self.metrics = metrics_mod.Registry("webdav")
         self.app = self._build_app()
 
     def _build_app(self) -> web.Application:
-        app = web.Application(client_max_size=1024 * 1024 * 1024)
+        app = web.Application(
+            client_max_size=1024 * 1024 * 1024,
+            middlewares=[observe.trace_middleware("webdav", self.url)])
+        # ops surface before the catch-all (exact routes win); reserved
+        # for ALL methods so a PUT can't create a file that GET then
+        # shadows. Like the rest of the webdav protocol surface, these
+        # carry no auth — deploy this gateway on trusted networks only.
+        from ..utils.profiling import profile_handler
+        for path, handler in (("/healthz", self.healthz),
+                              ("/metrics", self.metrics_handler),
+                              ("/debug/trace", observe.trace_handler()),
+                              ("/debug/profile", profile_handler())):
+            app.router.add_get(path, handler)
+            app.router.add_route("*", path, self._reserved)
         app.router.add_route("*", "/{path:.*}", self.dispatch)
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         return app
 
+    async def healthz(self, request: web.Request) -> web.Response:
+        return web.json_response({"ok": True})
+
+    async def _reserved(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"error": "reserved operational endpoint"}, status=405)
+
+    async def metrics_handler(self, request: web.Request) -> web.Response:
+        return web.Response(text=self.metrics.render(),
+                            content_type="text/plain")
+
     async def _on_startup(self, app) -> None:
-        self._session = aiohttp.ClientSession()
+        self._session = aiohttp.ClientSession(
+            trace_configs=[observe.client_trace_config()])
 
     async def _on_cleanup(self, app) -> None:
         if self._session:
@@ -194,6 +224,9 @@ class WebDavServer:
         }.get(method)
         if handler is None:
             return web.Response(status=405, headers=_DAV_HEADERS)
+        # counted only for recognized methods: a client-chosen label
+        # value would grow the registry without bound
+        self.metrics.count("request", labels={"method": method})
         return await handler(request, path)
 
     async def handle_options(self, request, path) -> web.Response:
@@ -468,6 +501,7 @@ class WebDavServer:
 
 async def run_webdav(host: str, port: int, filer_url: str,
                      **kwargs) -> web.AppRunner:
+    kwargs.setdefault("url", f"{host}:{port}")
     server = WebDavServer(filer_url, **kwargs)
     runner = web.AppRunner(server.app, access_log=None)
     await runner.setup()

@@ -138,5 +138,6 @@ def _register_all() -> None:
     from . import bucket_commands  # noqa: F401
     from . import fs_commands  # noqa: F401
     from . import lock_commands  # noqa: F401
+    from . import trace_commands  # noqa: F401
     from . import volume_commands  # noqa: F401
     from . import ec_shell  # noqa: F401

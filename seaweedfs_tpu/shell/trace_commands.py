@@ -1,0 +1,91 @@
+"""cluster.trace: fetch one trace's spans from every node and merge them
+into a single Chrome trace-event document (viewable in Perfetto /
+chrome://tracing).
+
+Every server keeps its own bounded span ring served at /debug/trace
+(observe/__init__.py); this command is the cluster-wide merge: master +
+every registered volume server (from /vol/list) + the shell's filer +
+any -node extras (S3/webdav gateways), deduplicated by span id.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+from ..observe import to_chrome_trace
+from .commands import CommandEnv, command, parser
+
+
+def _fetch_spans(url: str, trace_id: str, timeout: float = 10.0
+                 ) -> tuple[list[dict], str]:
+    """(spans, error) — a dead/denied node must not hide the rest of the
+    trace, but the failure is surfaced per-node in the command output
+    (an IAM-protected S3 gateway answers 403 to this unsigned GET)."""
+    qs = urllib.parse.urlencode({"format": "spans", "trace_id": trace_id})
+    try:
+        with urllib.request.urlopen(
+                f"http://{url}/debug/trace?{qs}", timeout=timeout) as r:
+            return json.load(r).get("spans", []), ""
+    except Exception as e:
+        return [], str(e)
+
+
+@command("cluster.trace",
+         "merge one trace id's spans from every node into Chrome "
+         "trace-event JSON (cluster.trace -traceId X [-node host:port]... "
+         "[-output trace.json])")
+def cluster_trace(env: CommandEnv, argv: list[str]):
+    p = parser("cluster.trace")
+    p.add_argument("-traceId", required=True)
+    p.add_argument("-node", action="append", default=[],
+                   help="extra nodes to query (S3/webdav gateways)")
+    p.add_argument("-output", default="",
+                   help="write the merged Chrome JSON to this file")
+    args = p.parse_args(argv)
+
+    targets = [env.client.master]
+    try:
+        with urllib.request.urlopen(
+                f"http://{env.client.master}/vol/list", timeout=10) as r:
+            for node in json.load(r).get("nodes", []):
+                if node.get("url"):
+                    targets.append(node["url"])
+    except Exception:
+        pass  # master down: still query filer/-node extras
+    if env.filer:
+        targets.append(env.filer)
+    targets.extend(args.node)
+
+    # fetches are independent — run them concurrently so a few dead
+    # nodes cost one timeout for the whole merge, not one each
+    urls = list(dict.fromkeys(targets))  # de-dup, keep order
+    with ThreadPoolExecutor(max_workers=min(16, len(urls))) as pool:
+        results = list(pool.map(
+            lambda u: _fetch_spans(u, args.traceId), urls))
+    seen: set[str] = set()
+    spans: list[dict] = []
+    queried = []
+    for url, (got, err) in zip(urls, results):
+        entry = {"node": url, "spans": len(got)}
+        if err:
+            entry["error"] = err
+        queried.append(entry)
+        for s in got:
+            if s.get("id") in seen:
+                continue
+            seen.add(s.get("id"))
+            spans.append(s)
+    spans.sort(key=lambda s: s.get("start_us", 0))
+    doc = to_chrome_trace(spans)
+    out = {"trace_id": args.traceId, "span_count": len(spans),
+           "nodes": queried}
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(doc, f)
+        out["output"] = args.output
+    else:
+        out["trace"] = doc
+    return out

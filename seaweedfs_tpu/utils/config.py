@@ -10,7 +10,10 @@ weed/command/scaffold.go:18-22.
 from __future__ import annotations
 
 import os
-import tomllib
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: same API from tomli
+    import tomli as tomllib
 from typing import Any, Optional
 
 SEARCH_PATHS = [".", os.path.expanduser("~/.seaweedfs"), "/etc/seaweedfs"]

@@ -3,12 +3,15 @@
 Counterpart of the reference's central registry (weed/stats/metrics.go:19-118)
 — counters, gauges and duration histograms rendered in Prometheus exposition
 format at /metrics, with optional label sets
-(`count("read", labels={"collection": "c"})`) and a push-gateway loop
+(`count("read", labels={"collection": "c"})`,
+`observe("read", dt, labels={"collection": "c"})`) and a push-gateway loop
 (LoopPushingMetric, metrics.go:140).
 """
 
 from __future__ import annotations
 
+import asyncio
+import random
 import threading
 import time
 from collections import defaultdict
@@ -35,18 +38,20 @@ class _Timer:
     """Context manager feeding Registry.observe — module-level so the
     per-request hot path never rebuilds a class object."""
 
-    __slots__ = ("_registry", "_name", "t0")
+    __slots__ = ("_registry", "_name", "_labels", "t0")
 
-    def __init__(self, registry, name: str):
+    def __init__(self, registry, name: str, labels: dict | None = None):
         self._registry = registry
         self._name = name
+        self._labels = labels
 
     def __enter__(self):
         self.t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
-        self._registry.observe(self._name, time.perf_counter() - self.t0)
+        self._registry.observe(self._name, time.perf_counter() - self.t0,
+                               labels=self._labels)
 
 
 class Registry:
@@ -69,23 +74,28 @@ class Registry:
         with self._lock:
             self._gauges[_key(name, labels)] = value
 
-    def observe(self, name: str, seconds: float) -> None:
+    def observe(self, name: str, seconds: float,
+                labels: dict | None = None) -> None:
+        key = _key(name, labels)
         with self._lock:
-            buckets = self._hist.setdefault(name, [0] * (len(_BUCKETS) + 1))
+            buckets = self._hist.setdefault(key, [0] * (len(_BUCKETS) + 1))
             for i, b in enumerate(_BUCKETS):
                 if seconds <= b:
                     buckets[i] += 1
                     break
             else:
                 buckets[-1] += 1
-            self._hist_sum[name] += seconds
-            self._hist_count[name] += 1
+            self._hist_sum[key] += seconds
+            self._hist_count[key] += 1
 
     async def push_loop(self, gateway_url: str, job: str,
                         interval_seconds: float = 15.0) -> None:
         """Push-gateway mode (LoopPushingMetric, weed/stats/metrics.go:140):
-        POST the exposition text to <gateway>/metrics/job/<job> forever."""
+        POST the exposition text to <gateway>/metrics/job/<job> forever.
+        Failures back off exponentially with jitter so a flapping gateway
+        isn't hammered in lockstep by every server in the cluster."""
         import aiohttp
+        failures = 0
         async with aiohttp.ClientSession() as session:
             while True:
                 try:
@@ -94,13 +104,16 @@ class Registry:
                             data=self.render(),
                             headers={"Content-Type": "text/plain"}) as r:
                         await r.read()
+                    failures = 0
                 except Exception:
-                    pass  # the gateway being down must never hurt serving
-                import asyncio
-                await asyncio.sleep(interval_seconds)
+                    # the gateway being down must never hurt serving
+                    failures = min(failures + 1, 5)
+                delay = interval_seconds * (2 ** failures if failures else 1)
+                # +/-25% jitter de-synchronizes the fleet after an outage
+                await asyncio.sleep(delay * (0.75 + 0.5 * random.random()))
 
-    def timed(self, name: str):
-        return _Timer(self, name)
+    def timed(self, name: str, labels: dict | None = None):
+        return _Timer(self, name, labels)
 
     @staticmethod
     def _split(key: str) -> tuple[str, str]:
@@ -110,34 +123,52 @@ class Registry:
             return name, "{" + rest
         return key, ""
 
+    @classmethod
+    def _families(cls, keys) -> dict[str, list[str]]:
+        """Group metric keys by family name, families and label sets both
+        sorted — exposition format requires all samples of one family to
+        be contiguous under a single # TYPE line."""
+        fams: dict[str, list[str]] = {}
+        for key in sorted(keys):
+            fams.setdefault(cls._split(key)[0], []).append(key)
+        return dict(sorted(fams.items()))
+
     def render(self) -> str:
         with self._lock:
             lines = []
             p = f"seaweedfs_tpu_{self.subsystem}"
-            typed: set[str] = set()
-            for key, v in sorted(self._counters.items()):
-                name, lbl = self._split(key)
-                if name not in typed:
-                    typed.add(name)
-                    lines.append(f"# TYPE {p}_{name}_total counter")
-                lines.append(f"{p}_{name}_total{lbl} {v}")
-            for key, v in sorted(self._gauges.items()):
-                name, lbl = self._split(key)
-                if ("g", name) not in typed:
-                    typed.add(("g", name))
-                    lines.append(f"# TYPE {p}_{name} gauge")
-                lines.append(f"{p}_{name}{lbl} {v}")
-            for name, buckets in sorted(self._hist.items()):
+            # _families groups each kind's keys by unique family name, so
+            # one # TYPE line at the top of each family iteration is
+            # exactly once per family (the old flat-key loop needed a
+            # seen-set that mixed str and tuple entries)
+            for name, keys in self._families(self._counters).items():
+                lines.append(f"# TYPE {p}_{name}_total counter")
+                for key in keys:
+                    _, lbl = self._split(key)
+                    lines.append(f"{p}_{name}_total{lbl} "
+                                 f"{self._counters[key]}")
+            for name, keys in self._families(self._gauges).items():
+                lines.append(f"# TYPE {p}_{name} gauge")
+                for key in keys:
+                    _, lbl = self._split(key)
+                    lines.append(f"{p}_{name}{lbl} {self._gauges[key]}")
+            for name, keys in self._families(self._hist).items():
                 lines.append(f"# TYPE {p}_{name}_seconds histogram")
-                acc = 0
-                for i, b in enumerate(_BUCKETS):
-                    acc += buckets[i]
-                    lines.append(
-                        f'{p}_{name}_seconds_bucket{{le="{b}"}} {acc}')
-                acc += buckets[-1]
-                lines.append(f'{p}_{name}_seconds_bucket{{le="+Inf"}} {acc}')
-                lines.append(
-                    f"{p}_{name}_seconds_sum {self._hist_sum[name]}")
-                lines.append(
-                    f"{p}_{name}_seconds_count {self._hist_count[name]}")
+                for key in keys:
+                    _, lbl = self._split(key)
+                    # merge the key's labels with the per-bucket le label
+                    inner = lbl[1:-1] + "," if lbl else ""
+                    buckets = self._hist[key]
+                    acc = 0
+                    for i, b in enumerate(_BUCKETS):
+                        acc += buckets[i]
+                        lines.append(f"{p}_{name}_seconds_bucket"
+                                     f'{{{inner}le="{b}"}} {acc}')
+                    acc += buckets[-1]
+                    lines.append(f"{p}_{name}_seconds_bucket"
+                                 f'{{{inner}le="+Inf"}} {acc}')
+                    lines.append(f"{p}_{name}_seconds_sum{lbl} "
+                                 f"{self._hist_sum[key]}")
+                    lines.append(f"{p}_{name}_seconds_count{lbl} "
+                                 f"{self._hist_count[key]}")
             return "\n".join(lines) + "\n"

@@ -1,0 +1,102 @@
+"""Guards for the servers' operational HTTP surface: every server class
+must register /metrics + /healthz (plus /debug/trace) and render them
+without error — refactors of _build_app can't silently drop them. Also a
+lint-style check that no module under seaweedfs_tpu/ uses bare print()
+instead of glog.
+"""
+
+import io
+import json
+import pathlib
+import time
+import tokenize
+import urllib.request
+
+import pytest
+
+from cluster_util import Cluster, free_port
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(n_volume_servers=1)
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def filer(cluster):
+    fs = cluster.add_filer(chunk_size=8 * 1024)
+    time.sleep(0.3)
+    return fs
+
+
+@pytest.fixture(scope="module")
+def gateways(cluster, filer):
+    """S3 + WebDAV apps served on the cluster loop."""
+    from seaweedfs_tpu.s3.s3_server import S3Server
+    from seaweedfs_tpu.server.webdav_server import WebDavServer
+
+    out = {}
+    for name, server in (("s3", S3Server(filer.url)),
+                         ("webdav", WebDavServer(filer.url))):
+        port = free_port()
+        cluster.serve(server.app, port)
+        out[name] = f"127.0.0.1:{port}"
+    return out
+
+
+def _get(url, path):
+    with urllib.request.urlopen(f"http://{url}{path}", timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+def test_all_servers_serve_ops_surface(cluster, filer, gateways):
+    targets = {
+        "master": cluster.master_url.split(",")[0],
+        "volume": cluster.volume_servers[0].url,
+        "filer": filer.url,
+        **gateways,
+    }
+    for name, url in targets.items():
+        status, body = _get(url, "/healthz")
+        assert status == 200, (name, status)
+        assert json.loads(body)["ok"] is True, name
+        status, body = _get(url, "/metrics")
+        assert status == 200, (name, status)
+        # exposition text parses: every non-comment line is "name value"
+        for ln in body.splitlines():
+            if not ln or ln.startswith("#"):
+                continue
+            parts = ln.rsplit(" ", 1)
+            assert len(parts) == 2, (name, ln)
+            float(parts[1])
+        status, body = _get(url, "/debug/trace")
+        assert status == 200, (name, status)
+        assert "traceEvents" in json.loads(body), name
+        status, body = _get(url, "/debug/profile?seconds=0.05")
+        assert status == 200, (name, status)
+        assert "cumulative" in body, name
+
+
+def test_no_bare_print_under_package():
+    """Diagnostics must go through glog (utils/glog.py), not print() —
+    cli.py is exempt: its prints ARE the command-line output contract."""
+    pkg = pathlib.Path(__file__).resolve().parent.parent / "seaweedfs_tpu"
+    allowed = {pkg / "cli.py"}
+    offenders = []
+    for path in sorted(pkg.rglob("*.py")):
+        if path in allowed:
+            continue
+        toks = list(tokenize.generate_tokens(
+            io.StringIO(path.read_text()).readline))
+        for i, tok in enumerate(toks):
+            if tok.type == tokenize.NAME and tok.string == "print":
+                nxt = next((t for t in toks[i + 1:]
+                            if t.type not in (tokenize.NL,
+                                              tokenize.NEWLINE,
+                                              tokenize.COMMENT)), None)
+                if nxt is not None and nxt.string == "(":
+                    offenders.append(f"{path.relative_to(pkg)}:"
+                                     f"{tok.start[0]}")
+    assert not offenders, f"bare print() calls: {offenders}"

@@ -157,7 +157,10 @@ def test_jwt_enforced_end_to_end():
 # --- scaffold ---
 
 def test_scaffold_templates_parse(tmp_path):
-    import tomllib
+    try:
+        import tomllib
+    except ModuleNotFoundError:  # Python < 3.11
+        import tomli as tomllib
 
     from seaweedfs_tpu.utils.scaffold import TEMPLATES
     assert set(TEMPLATES) == {"security", "filer", "master",
