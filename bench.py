@@ -808,6 +808,24 @@ def _log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
+DETAIL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_DETAIL.json")
+
+
+def _checkpoint(detail: dict, path: str = "") -> None:
+    """Write the (partial) detail record NOW, atomically. Each phase
+    checkpoints as it completes, so a later phase timing out — or the
+    whole run being killed — no longer nulls every earlier number."""
+    path = path or DETAIL_PATH
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(detail, f, indent=1)
+        os.replace(tmp, path)
+    except OSError as e:
+        _log(f"checkpoint write failed: {e}")
+
+
 def main() -> None:
     started = time.perf_counter()
     work = tempfile.mkdtemp(prefix="swfs_bench_")
@@ -822,11 +840,17 @@ def main() -> None:
         _make_volume(os.path.join(work, "1.dat"), VOL_BYTES)
         _log(f"volume gen: {time.perf_counter() - t0:.1f}s")
 
+        # per-phase incremental record: every phase lands in
+        # BENCH_DETAIL.json the moment it completes
+        detail = {"volume_bytes": VOL_BYTES, "incomplete": True}
+
         # the one-time program load alone varies 40-280s through the
         # tunnel; 300s was measured to clip real runs
         encode = _run_phase("encode", work, min(430.0, left()))
         _log(f"encode: {encode.get('value_gbps')} GB/s "
              f"({encode.get('phase_wall_s')}s)")
+        detail["encode"] = encode
+        _checkpoint(detail)
 
         # kernel before rebuild: its per-config compiles are the
         # predictable TPU work (~340s total), while the rec-window
@@ -836,6 +860,8 @@ def main() -> None:
         kernel = _run_phase("kernel", work, min(420.0, max(left(), 60)))
         _log(f"kernel: {kernel.get('kernel', {}).get('gbps')} GB/s "
              f"({kernel.get('phase_wall_s')}s)")
+        detail["kernel_phase"] = kernel
+        _checkpoint(detail)
 
         # shard files for the rebuild phase (host coder, parent-side)
         rebuild: dict = {"error": "skipped (budget)"}
@@ -852,10 +878,14 @@ def main() -> None:
                                  min(650.0, max(left() - 180.0, 60.0)))
             _log(f"rebuild: p50 {rebuild.get('rebuild_p50_s')}s "
                  f"({rebuild.get('phase_wall_s')}s)")
+        detail["rebuild"] = rebuild
+        _checkpoint(detail)
 
         fused = ({"error": "skipped (budget)"} if left() < 120
                  else _run_phase("fused", work, min(240.0, left())))
         _log(f"fused: {fused.get('gbps')} GB/s")
+        detail["fused_compact_gzip_rs"] = fused
+        _checkpoint(detail)
 
         try:
             system = bench_system(work)
@@ -863,21 +893,18 @@ def main() -> None:
                  f"{system['read']['req_s']}")
         except Exception as e:
             system = {"error": str(e)}
+        detail["system_req_s"] = system
+        _checkpoint(detail)
 
         try:
             needle_map = bench_needle_map(work)
         except Exception as e:
             needle_map = {"error": str(e)}
+        detail["disk_needle_map"] = needle_map
 
         value = encode.get("value_gbps") or 0.0
-        detail = {
-            "volume_bytes": VOL_BYTES,
-            "encode": encode,
-            "rebuild": rebuild,
-            "kernel_phase": kernel,
-            "fused_compact_gzip_rs": fused,
-            "system_req_s": system,
-            "disk_needle_map": needle_map,
+        detail.pop("incomplete", None)
+        detail.update({
             "note": (
                 "value = steady-state per-volume pipeline rate "
                 "(read+stage+execute, program already loaded, window "
@@ -893,17 +920,10 @@ def main() -> None:
                 "host-side feed rates are host properties — the "
                 "chip-side rates are chip_encode_gbps / "
                 "rebuild_window_gbps."),
-        }
-        # full record to a side file; stdout's LAST line stays small and
+        })
+        # final full record; stdout's LAST line stays small and
         # single-line so the driver's parse cannot truncate it
-        detail_path = os.path.join(
-            os.path.dirname(os.path.abspath(__file__)),
-            "BENCH_DETAIL.json")
-        try:
-            with open(detail_path, "w") as f:
-                json.dump(detail, f, indent=1)
-        except OSError:
-            pass
+        _checkpoint(detail)
         enc_rates = encode.get("component_rates_gbps") or {}
         print(json.dumps({
             "metric": ("ec.encode pipeline GB/s/chip (disk -> H2D -> "

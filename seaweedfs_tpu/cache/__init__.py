@@ -1,0 +1,34 @@
+"""Read-path performance tier: caching, coalescing, pooled HTTP.
+
+The role of the reference's hot-read machinery in one package:
+
+- ``tiered``      : weed/util/chunk_cache — a size-class-accounted
+                    in-memory LRU front backed by an optional on-disk
+                    tier, TTL'd, with hit/miss/eviction counters and
+                    ``cache.lookup`` spans.
+- ``singleflight``: golang.org/x/sync/singleflight as used by the filer
+                    reader and EC shard reads — N concurrent fetches of
+                    one key collapse into one backend read; waiters emit
+                    ``singleflight.wait`` spans.
+- ``http_pool``   : keep-alive pooled HTTP connections for the sync
+                    intra-cluster clients (weed/util/http_util keeps one
+                    shared transport; urllib opened a fresh TCP+close
+                    per request).
+- ``ttl``         : the wdclient vid-location cache shape — TTL'd lookup
+                    cache with pinned (push-fed) entries.
+"""
+
+from .http_pool import HttpPool, PoolResponse, shared_pool
+from .singleflight import AsyncSingleflight, Singleflight
+from .tiered import TieredChunkCache
+from .ttl import TTLCache
+
+__all__ = [
+    "AsyncSingleflight",
+    "HttpPool",
+    "PoolResponse",
+    "Singleflight",
+    "TieredChunkCache",
+    "TTLCache",
+    "shared_pool",
+]

@@ -1,0 +1,172 @@
+"""Pooled keep-alive HTTP for the sync intra-cluster clients.
+
+urllib.request opens a fresh TCP connection per call and closes it on
+exit — on the hot GET path that is a 3-way handshake plus slow-start per
+chunk fetch. The reference keeps one shared keep-alive transport for all
+intra-cluster HTTP (weed/util/http_util.go's global client); this is
+that shape for the sync callers (client.py, mount, EC shard fallback):
+a bounded per-host stack of live ``http.client.HTTPConnection``s, reused
+across requests, with one transparent retry when a pooled connection
+turns out to have gone stale (server closed it between requests).
+
+Responses are read fully before the connection returns to the pool —
+callers get a ``PoolResponse`` (status/headers/data), never a live
+socket, so a forgotten response can't poison the pool. Streaming
+endpoints (watch/subscribe/tail) stay on urllib by design.
+"""
+
+from __future__ import annotations
+
+import http.client
+import threading
+import urllib.parse
+from typing import Optional
+
+_RETRYABLE = (http.client.BadStatusLine, http.client.CannotSendRequest,
+              http.client.ImproperConnectionState, BrokenPipeError,
+              ConnectionResetError, ConnectionAbortedError)
+
+# only these methods ride pooled connections: a stale keep-alive socket
+# can die after the server processed the request, and transparently
+# re-sending a POST/DELETE would execute the write twice. Non-idempotent
+# methods always dial fresh (exactly the old urllib behavior) — their
+# response connection still joins the pool for the read path to reuse.
+_POOLED_METHODS = frozenset({"GET", "HEAD", "OPTIONS"})
+
+
+class PoolResponse:
+    __slots__ = ("status", "headers", "data")
+
+    def __init__(self, status: int, headers: dict, data: bytes):
+        self.status = status
+        self.headers = headers  # lower-cased header names
+        self.data = data
+
+    def json(self):
+        import json
+        return json.loads(self.data)
+
+
+class HttpPool:
+    def __init__(self, max_idle_per_host: int = 8,
+                 timeout: float = 30.0, metrics=None):
+        self.max_idle_per_host = max_idle_per_host
+        self.default_timeout = timeout
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._idle: dict[tuple[str, int], list] = {}
+        self._closed = False
+
+    def _count(self, name: str) -> None:
+        if self.metrics is not None:
+            self.metrics.count(f"http_pool_{name}")
+
+    def _checkout(self, host: str, port: int, timeout: float):
+        """(connection, was_reused)"""
+        with self._lock:
+            stack = self._idle.get((host, port))
+            if stack:
+                conn = stack.pop()
+                conn.timeout = timeout
+                if conn.sock is not None:
+                    conn.sock.settimeout(timeout)
+                self._count("reuse")
+                return conn, True
+        self._count("dial")
+        return http.client.HTTPConnection(host, port, timeout=timeout), False
+
+    def _checkin(self, host: str, port: int, conn) -> None:
+        with self._lock:
+            if not self._closed:
+                stack = self._idle.setdefault((host, port), [])
+                if len(stack) < self.max_idle_per_host:
+                    stack.append(conn)
+                    return
+        conn.close()
+
+    def _flush_host(self, host: str, port: int) -> None:
+        """Drop every idle connection to one host — when a pooled socket
+        turns out stale (server restarted), its siblings in the stack
+        are from the same dead server; the retry must dial fresh, not
+        draw the next corpse."""
+        with self._lock:
+            stale = self._idle.pop((host, port), [])
+        for c in stale:
+            c.close()
+
+    def request(self, method: str, url: str,
+                body: Optional[bytes] = None,
+                headers: Optional[dict] = None,
+                timeout: Optional[float] = None) -> PoolResponse:
+        """One full request/response. `url` may carry or omit the
+        http:// scheme; HTTP error statuses are returned, not raised."""
+        if "://" not in url:
+            url = "http://" + url
+        parts = urllib.parse.urlsplit(url)
+        host, port = parts.hostname or "", parts.port or 80
+        path = parts.path or "/"
+        if parts.query:
+            path += "?" + parts.query
+        timeout = self.default_timeout if timeout is None else timeout
+        hdrs = dict(headers or {})
+        from .. import observe
+        observe.inject(hdrs)
+        poolable = method.upper() in _POOLED_METHODS
+        last: Optional[Exception] = None
+        for attempt in range(2):
+            if poolable:
+                conn, reused = self._checkout(host, port, timeout)
+            else:
+                self._count("dial")
+                conn, reused = http.client.HTTPConnection(
+                    host, port, timeout=timeout), False
+            try:
+                conn.request(method, path, body=body, headers=hdrs)
+                resp = conn.getresponse()
+                data = resp.read()
+            except _RETRYABLE as e:
+                conn.close()
+                last = e
+                if reused:
+                    # stale keep-alive connection: its idle siblings are
+                    # just as dead — flush them so the retry dials fresh
+                    self._flush_host(host, port)
+                    continue
+                raise
+            except Exception:
+                conn.close()
+                raise
+            if resp.will_close:
+                conn.close()
+            else:
+                self._checkin(host, port, conn)
+            return PoolResponse(
+                resp.status,
+                {k.lower(): v for k, v in resp.getheaders()},
+                data)
+        raise last  # both attempts hit a stale/broken connection
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            conns = [c for stack in self._idle.values() for c in stack]
+            self._idle.clear()
+        for c in conns:
+            c.close()
+
+    def idle_count(self) -> int:
+        with self._lock:
+            return sum(len(s) for s in self._idle.values())
+
+
+_shared: Optional[HttpPool] = None
+_shared_lock = threading.Lock()
+
+
+def shared_pool() -> HttpPool:
+    """Process-wide pool (the reference's global http client)."""
+    global _shared
+    with _shared_lock:
+        if _shared is None:
+            _shared = HttpPool()
+        return _shared

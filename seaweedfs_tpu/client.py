@@ -7,6 +7,7 @@ used by the CLI, the shell commands, and tests; servers talk aiohttp.
 
 from __future__ import annotations
 
+import http.client
 import json
 import time
 import urllib.error
@@ -15,40 +16,38 @@ import urllib.request
 import uuid
 from typing import Optional
 
+from .cache.http_pool import shared_pool
+from .cache.ttl import TTLCache
+
 
 class ClientError(RuntimeError):
     pass
 
 
-# sentinel timestamp for vid-cache entries fed by the KeepConnected push
-# stream: they are authoritative until the stream says otherwise
-_PUSHED = -1.0
+# connection errors worth a replica/master rotation (the pool already
+# retried once on a stale keep-alive socket)
+_CONN_ERRORS = (OSError, http.client.HTTPException)
 
 
 def _get_json(url: str, timeout: float = 30.0) -> dict:
+    r = shared_pool().request("GET", url, timeout=timeout)
     try:
-        with urllib.request.urlopen(url, timeout=timeout) as r:
-            return json.load(r)
-    except urllib.error.HTTPError as e:
-        try:
-            return json.load(e)
-        except Exception:
-            raise ClientError(f"GET {url}: HTTP {e.code}") from e
+        return r.json()
+    except Exception:
+        raise ClientError(f"GET {url}: HTTP {r.status}")
 
 
 def _post_json(url: str, body: dict, timeout: float = 300.0) -> dict:
-    data = json.dumps(body).encode()
-    req = urllib.request.Request(url, data=data, method="POST",
-                                 headers={"Content-Type": "application/json"})
+    r = shared_pool().request(
+        "POST", url, body=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, timeout=timeout)
     try:
-        with urllib.request.urlopen(req, timeout=timeout) as r:
-            return json.load(r)
-    except urllib.error.HTTPError as e:
-        try:
-            detail = json.load(e)
-        except Exception:
-            detail = {"error": f"HTTP {e.code}"}
-        raise ClientError(f"POST {url}: {detail.get('error')}") from e
+        detail = r.json()
+    except Exception:
+        detail = {"error": f"HTTP {r.status}"}
+    if r.status >= 400:
+        raise ClientError(f"POST {url}: {detail.get('error')}")
+    return detail
 
 
 class Client:
@@ -60,8 +59,10 @@ class Client:
                         for m in master_url.split(",") if m.strip()]
         self._master_i = 0
         self.guard = guard  # security Guard for signing delete jwts
-        self._vid_cache: dict[int, tuple[list[str], float]] = {}
-        self._vid_cache_ttl = 60.0
+        # TTL'd vid -> locations cache (wdclient vid_map): GETs stop
+        # round-tripping to the master; KeepConnected-pushed entries pin
+        self._vid_cache = TTLCache(ttl=60.0)
+        self._pool = shared_pool()
         self._watch_thread = None
         self._watch_stop = False
 
@@ -77,21 +78,15 @@ class Client:
         for _ in range(max(2 * len(self.masters), 2)):
             try:
                 url = f"http://{self.master}{path_qs}"
+                r = self._pool.request("GET", url, timeout=timeout)
+                if r.status in (502, 503, 504):
+                    raise ClientError(
+                        f"master {self.master}: HTTP {r.status}")
                 try:
-                    with urllib.request.urlopen(url, timeout=timeout) as r:
-                        return json.load(r)
-                except urllib.error.HTTPError as e:
-                    if e.code in (502, 503, 504):
-                        raise ClientError(
-                            f"master {self.master}: HTTP {e.code}") from e
-                    try:
-                        return json.load(e)
-                    except ClientError:
-                        raise
-                    except Exception:
-                        raise ClientError(
-                            f"GET {url}: HTTP {e.code}") from e
-            except (ClientError, urllib.error.URLError, OSError) as e:
+                    return r.json()
+                except Exception:
+                    raise ClientError(f"GET {url}: HTTP {r.status}")
+            except (ClientError, *_CONN_ERRORS) as e:
                 last = e
                 if len(self.masters) > 1:
                     self._master_i = (self._master_i + 1) % len(self.masters)
@@ -133,14 +128,13 @@ class Client:
 
     def lookup(self, vid: int) -> list[str]:
         cached = self._vid_cache.get(vid)
-        if cached and (cached[1] == _PUSHED
-                       or time.time() - cached[1] < self._vid_cache_ttl):
-            return cached[0]
+        if cached:
+            return cached
         out = self._master_get(f"/dir/lookup?volumeId={vid}")
         urls = [loc["url"] for loc in out.get("locations", [])]
         if not urls:
             raise ClientError(out.get("error", f"volume {vid} not found"))
-        self._vid_cache[vid] = (urls, time.time())
+        self._vid_cache.put(vid, urls)
         return urls
 
     # --- KeepConnected vid-location subscription ---
@@ -178,24 +172,24 @@ class Client:
 
     def _watch_apply(self, msg: dict) -> None:
         if msg.get("type") == "snapshot":
-            fresh = {int(vid): ([loc["url"] for loc in locs], _PUSHED)
-                     for vid, locs in msg.get("volumes", {}).items()}
             self._vid_cache.clear()
-            self._vid_cache.update(fresh)
+            for vid, locs in msg.get("volumes", {}).items():
+                self._vid_cache.put(int(vid),
+                                    [loc["url"] for loc in locs], pin=True)
         elif msg.get("type") == "update":
             url = msg["url"]
             for vid in msg.get("new_vids", []):
-                urls, _ = self._vid_cache.get(vid, ([], _PUSHED))
+                urls = self._vid_cache.get(vid) or []
                 if url not in urls:
                     urls = urls + [url]
-                self._vid_cache[vid] = (urls, _PUSHED)
+                self._vid_cache.put(vid, urls, pin=True)
             for vid in msg.get("deleted_vids", []):
-                urls, _ = self._vid_cache.get(vid, ([], _PUSHED))
-                urls = [u for u in urls if u != url]
+                urls = [u for u in (self._vid_cache.get(vid) or [])
+                        if u != url]
                 if urls:
-                    self._vid_cache[vid] = (urls, _PUSHED)
+                    self._vid_cache.put(vid, urls, pin=True)
                 else:
-                    self._vid_cache.pop(vid, None)
+                    self._vid_cache.pop(vid)
 
     def grow(self, count: int = 1, collection: str = "",
              replication: str = "", ttl: str = "") -> dict:
@@ -230,14 +224,12 @@ class Client:
         if auth:
             # master-signed per-fid write token (weed/security/jwt.go)
             headers["Authorization"] = f"BEARER {auth}"
-        req = urllib.request.Request(
-            target, data=body, method="POST", headers=headers)
-        try:
-            with urllib.request.urlopen(req, timeout=300) as r:
-                return json.load(r)
-        except urllib.error.HTTPError as e:
-            raise ClientError(f"upload {fid}: HTTP {e.code} "
-                              f"{e.read()[:200]!r}") from e
+        r = self._pool.request("POST", target, body=body, headers=headers,
+                               timeout=300)
+        if r.status >= 300:
+            raise ClientError(f"upload {fid}: HTTP {r.status} "
+                              f"{r.data[:200]!r}")
+        return r.json()
 
     def upload(self, data: bytes, filename: str = "", mime: str = "",
                collection: str = "", replication: str = "",
@@ -266,24 +258,24 @@ class Client:
         auth = ""
         urls = self.lookup(vid)
         for attempt in range(2):
+            denied = False
             for url in urls:
-                req = urllib.request.Request(f"http://{url}/{fid}")
-                if auth:
-                    req.add_header("Authorization", f"BEARER {auth}")
+                headers = ({"Authorization": f"BEARER {auth}"}
+                           if auth else {})
                 try:
-                    with urllib.request.urlopen(req, timeout=300) as r:
-                        return r.read()
-                except urllib.error.HTTPError as e:
+                    r = self._pool.request("GET", f"http://{url}/{fid}",
+                                           headers=headers, timeout=300)
+                except _CONN_ERRORS as e:  # conn refused etc: try replica
                     last_err = e
-                    if e.code == 404:
-                        continue
-                    if e.code == 401 and attempt == 0:
-                        break  # fetch a read token and retry
-                except Exception as e:  # connection refused etc: try replica
-                    last_err = e
-                    self._vid_cache.pop(vid, None)
-            if (attempt == 0 and isinstance(last_err, urllib.error.HTTPError)
-                    and last_err.code == 401):
+                    self._vid_cache.pop(vid)
+                    continue
+                if r.status in (200, 206):
+                    return r.data
+                last_err = ClientError(f"{url}/{fid}: HTTP {r.status}")
+                if r.status == 401 and attempt == 0:
+                    denied = True
+                    break  # fetch a read token and retry
+            if denied:
                 urls, auth = self.lookup_with_auth(fid)
                 continue
             break
@@ -292,16 +284,14 @@ class Client:
     def delete(self, fid: str) -> None:
         vid = int(fid.split(",")[0])
         for url in self.lookup(vid):
-            req = urllib.request.Request(f"http://{url}/{fid}",
-                                         method="DELETE",
-                                         headers=self._write_auth_header(fid))
-            try:
-                with urllib.request.urlopen(req, timeout=60):
-                    return
-            except urllib.error.HTTPError as e:
-                if e.code == 404:
-                    continue
-                raise ClientError(f"delete {fid}: HTTP {e.code}") from e
+            r = self._pool.request("DELETE", f"http://{url}/{fid}",
+                                   headers=self._write_auth_header(fid),
+                                   timeout=60)
+            if r.status < 300:
+                return
+            if r.status == 404:
+                continue
+            raise ClientError(f"delete {fid}: HTTP {r.status}")
         raise ClientError(f"delete {fid}: no replica accepted")
 
     # --- volume-server admin (used by shell commands) ---
@@ -362,11 +352,10 @@ class Client:
         for server, group in by_server.items():
             body = json_mod.dumps({"fids": group, "filter": filter,
                                    "projections": projections}).encode()
-            req = urllib.request.Request(
-                f"http://{server}/admin/query", data=body,
-                headers={"Content-Type": "application/json"})
-            with urllib.request.urlopen(req, timeout=300) as r:
-                for line in r.read().splitlines():
-                    if line.strip():
-                        out.append(json_mod.loads(line))
+            r = self._pool.request(
+                "POST", f"http://{server}/admin/query", body=body,
+                headers={"Content-Type": "application/json"}, timeout=300)
+            for line in r.data.splitlines():
+                if line.strip():
+                    out.append(json_mod.loads(line))
         return out

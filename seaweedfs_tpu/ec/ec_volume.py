@@ -22,6 +22,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from ..cache import Singleflight
 from ..storage import idx as idx_mod
 from ..storage import types as t
 from ..storage.needle import Needle
@@ -70,6 +71,11 @@ class EcVolume:
         self.remote_shard_size = 0
         self._layout_checked = False
         self._lock = threading.RLock()
+        # concurrent cold reads of one missing interval collapse into a
+        # single peer fetch / reconstruction (a reconstruct reads k
+        # shards and runs the coder — the most expensive read this
+        # server can serve)
+        self.read_flight = Singleflight("ec.read")
 
         base = self.base_file_name()
         if not os.path.exists(base + ".ecx"):
@@ -203,12 +209,18 @@ class EcVolume:
             data = shard.read_at(offset, iv.size)
             if len(data) == iv.size:
                 return data
-        if shard_reader is not None:
-            data = shard_reader(shard_id, offset, iv.size)
-            if data is not None and len(data) == iv.size:
-                return data
-        return self._reconstruct_interval(shard_id, offset, iv.size,
-                                          shard_reader)
+        # non-local interval: peer fetch or (worst case) an on-line
+        # reconstruction from k shards — N concurrent readers of the
+        # same cold interval share one flight
+        def fetch() -> bytes:
+            if shard_reader is not None:
+                data = shard_reader(shard_id, offset, iv.size)
+                if data is not None and len(data) == iv.size:
+                    return data
+            return self._reconstruct_interval(shard_id, offset, iv.size,
+                                              shard_reader)
+
+        return self.read_flight.do((shard_id, offset, iv.size), fetch)
 
     def _reconstruct_interval(self, missing_shard: int, offset: int,
                               size: int,

@@ -149,7 +149,9 @@ class Filer:
                  on_delete_chunks: Optional[Callable[[list[FileChunk]],
                                                      None]] = None,
                  meta_log_path: str = "",
-                 signature: int = 0):
+                 signature: int = 0,
+                 entry_cache_ttl: Optional[float] = None,
+                 metrics=None):
         self.store = store
         self.meta_log = MetaLog(persist_path=meta_log_path)
         self.on_delete_chunks = on_delete_chunks or (lambda chunks: None)
@@ -158,6 +160,24 @@ class Filer:
         import random as _random
         self.signature = signature or _random.getrandbits(31)
         self._lock = threading.RLock()
+        # read-through entry cache on the lookup path (the role of the
+        # reference's FilerStore wrapper caches): every mutation routed
+        # through this Filer invalidates, the TTL bounds staleness from
+        # anything that isn't (<=0 disables). Negative lookups cache too
+        # — gateways probe nonexistent paths constantly.
+        if entry_cache_ttl is None:
+            import os as _os
+            try:
+                entry_cache_ttl = float(_os.environ.get(
+                    "WEED_FILER_ENTRY_CACHE_TTL", "5.0"))
+            except ValueError:
+                entry_cache_ttl = 5.0
+        self._entry_cache = None
+        if entry_cache_ttl > 0:
+            from ..cache import TTLCache
+            self._entry_cache = TTLCache(ttl=entry_cache_ttl,
+                                         max_entries=8192,
+                                         metrics=metrics, name="entry")
 
     # --- CRUD ---
     def create_entry(self, entry: Entry,
@@ -241,11 +261,24 @@ class Filer:
         self._notify(entry.parent, old, entry, signatures=signatures)
         return entry
 
+    _CACHE_MISS = object()
+
     def find_entry(self, path: str) -> Optional[Entry]:
         path = _norm(path)
         if path == "/":
             return new_directory("/")
-        return self.store.find_entry(path)
+        if self._entry_cache is None:
+            return self.store.find_entry(path)
+        hit = self._entry_cache.get(path, self._CACHE_MISS)
+        if hit is not self._CACHE_MISS:
+            return hit
+        # snapshot the invalidation generation before the store read: a
+        # value read while a mutation was committing must not be cached
+        # (put_if_fresh discards it), or it would serve stale for a TTL
+        gen = self._entry_cache.generation
+        entry = self.store.find_entry(path)
+        self._entry_cache.put_if_fresh(path, entry, gen)
+        return entry
 
     def list_directory(self, dir_path: str, start_file: str = "",
                        include_start: bool = False, limit: int = 1024,
@@ -275,6 +308,10 @@ class Filer:
                 # free_chunks=False but the link still goes away)
                 self._collect_chunks_recursive(path, freed)
                 self.store.delete_folder_children(path)
+                if self._entry_cache is not None:
+                    # children vanish without per-entry events: sweep
+                    # the whole cached subtree
+                    self._entry_cache.drop_prefix(path.rstrip("/") + "/")
             else:
                 if entry.hard_link_id:
                     # shared chunks are freed only with the last link;
@@ -352,6 +389,14 @@ class Filer:
     def _notify(self, directory: str, old: Optional[Entry],
                 new: Optional[Entry], delete_chunks: bool = False,
                 signatures: tuple[int, ...] = ()) -> None:
+        if self._entry_cache is not None:
+            # every mutation flows through here (including auto-created
+            # parents and sync replays): drop both sides so the next
+            # lookup reads through — negative entries included
+            if old is not None:
+                self._entry_cache.pop(old.full_path)
+            if new is not None:
+                self._entry_cache.pop(new.full_path)
         self.meta_log.append(MetaEvent(
             tsns=time.time_ns(), directory=directory,
             old_entry=old, new_entry=new, delete_chunks=delete_chunks,

@@ -20,6 +20,7 @@ from typing import Optional
 from .kafka_wire import (API_FETCH, API_METADATA, API_PRODUCE, _Reader,
                          _bytes, _str, decode_message_set)
 
+ERR_OFFSET_OUT_OF_RANGE = 1
 ERR_UNKNOWN_TOPIC_OR_PARTITION = 3
 
 
@@ -208,6 +209,15 @@ class FakeKafkaServer:
                 if topic not in self.topics and not self.auto_create:
                     parts.append((pid, ERR_UNKNOWN_TOPIC_OR_PARTITION,
                                   0, b""))
+                    continue
+                if offset < 0:
+                    # the -1 "latest" sentinel (and any negative offset)
+                    # is not a fetchable position: answering it by
+                    # slicing from the end duplicated messages under
+                    # wrong offsets. Real brokers answer
+                    # OFFSET_OUT_OF_RANGE and let the client reset.
+                    parts.append((pid, ERR_OFFSET_OUT_OF_RANGE,
+                                  len(log), b""))
                     continue
                 mset = bytearray()
                 for off in range(offset, len(log)):

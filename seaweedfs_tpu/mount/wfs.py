@@ -21,9 +21,9 @@ import threading
 import time
 import urllib.error
 import urllib.parse
-import urllib.request
 from typing import Optional
 
+from ..cache import Singleflight, TieredChunkCache, TTLCache, shared_pool
 from .dirty_pages import ContinuousIntervals
 from .meta_cache import MetaCache
 
@@ -41,25 +41,34 @@ def _norm(path: str) -> str:
 
 
 class FilerClient:
-    """Thin sync HTTP client for the filer's meta + data endpoints."""
+    """Thin sync HTTP client for the filer's meta + data endpoints.
+    Intra-cluster requests ride pooled keep-alive connections; direct
+    chunk reads go through a local chunk cache with singleflight so N
+    threads re-reading one hot chunk cost one volume-server fetch."""
 
-    def __init__(self, filer_url: str):
+    def __init__(self, filer_url: str,
+                 chunk_cache: Optional[TieredChunkCache] = None):
         self.filer = filer_url.rstrip("/")
-        self._vid_cache: dict[int, tuple[list[str], float]] = {}
+        self._pool = shared_pool()
+        self._vid_cache = TTLCache(ttl=60.0)
+        self.chunk_cache = chunk_cache if chunk_cache is not None \
+            else TieredChunkCache(max_bytes=32 * 1024 * 1024)
+        self._read_flight = Singleflight("mount.read_chunk")
         # set after the first 401: subsequent chunk reads fetch the read
         # token up front instead of paying a guaranteed-401 round trip
         self._read_auth_needed = False
         self._fid_auth: dict[str, tuple[str, float]] = {}
 
     def _get_json(self, path_qs: str) -> Optional[dict]:
-        try:
-            with urllib.request.urlopen(
-                    f"http://{self.filer}{path_qs}", timeout=60) as r:
-                return json.load(r)
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                return None
-            raise
+        r = self._pool.request("GET", f"http://{self.filer}{path_qs}",
+                               timeout=60)
+        if r.status == 404:
+            return None
+        if r.status >= 400:
+            raise urllib.error.HTTPError(
+                f"http://{self.filer}{path_qs}", r.status, "filer error",
+                None, None)
+        return r.json()
 
     def lookup(self, path: str) -> Optional[dict]:
         return self._get_json("/__meta__/lookup?"
@@ -70,33 +79,31 @@ class FilerClient:
             {"dir": path, "limit": str(limit)}))
         return out.get("entries", []) if out else []
 
+    def _post(self, path_qs: str, body: Optional[bytes] = None) -> None:
+        headers = {"Content-Type": "application/json"} if body else {}
+        r = self._pool.request("POST", f"http://{self.filer}{path_qs}",
+                               body=body, headers=headers, timeout=60)
+        if r.status >= 400:
+            raise IOError(f"POST {path_qs}: HTTP {r.status} "
+                          f"{r.data[:200]!r}")
+
     def create_entry(self, entry: dict, free_old_chunks: bool = True) -> None:
-        body = json.dumps({"entry": entry,
-                           "free_old_chunks": free_old_chunks}).encode()
-        req = urllib.request.Request(
-            f"http://{self.filer}/__meta__/create_entry", data=body,
-            method="POST", headers={"Content-Type": "application/json"})
-        urllib.request.urlopen(req, timeout=60).close()
+        self._post("/__meta__/create_entry",
+                   json.dumps({"entry": entry,
+                               "free_old_chunks": free_old_chunks}).encode())
 
     def update_entry(self, entry: dict) -> None:
-        body = json.dumps({"entry": entry}).encode()
-        req = urllib.request.Request(
-            f"http://{self.filer}/__meta__/update_entry", data=body,
-            method="POST", headers={"Content-Type": "application/json"})
-        urllib.request.urlopen(req, timeout=60).close()
+        self._post("/__meta__/update_entry",
+                   json.dumps({"entry": entry}).encode())
 
     def delete(self, path: str, recursive: bool = False) -> None:
-        body = json.dumps({"path": path, "recursive": recursive}).encode()
-        req = urllib.request.Request(
-            f"http://{self.filer}/__meta__/delete", data=body,
-            method="POST", headers={"Content-Type": "application/json"})
-        urllib.request.urlopen(req, timeout=60).close()
+        self._post("/__meta__/delete",
+                   json.dumps({"path": path,
+                               "recursive": recursive}).encode())
 
     def rename(self, old: str, new: str) -> None:
-        req = urllib.request.Request(
-            f"http://{self.filer}" + urllib.parse.quote(old)
-            + "?" + urllib.parse.urlencode({"mv.to": new}), method="POST")
-        urllib.request.urlopen(req, timeout=60).close()
+        self._post(urllib.parse.quote(old) + "?"
+                   + urllib.parse.urlencode({"mv.to": new}))
 
     def assign(self, collection: str = "", replication: str = "",
                ttl: str = "") -> dict:
@@ -113,34 +120,34 @@ class FilerClient:
         headers = {"Content-Type": "application/octet-stream"}
         if assign.get("auth"):
             headers["Authorization"] = f"BEARER {assign['auth']}"
-        req = urllib.request.Request(
-            f"http://{assign['url']}/{assign['fid']}", data=data,
-            method="POST", headers=headers)
-        urllib.request.urlopen(req, timeout=300).close()
+        r = self._pool.request(
+            "POST", f"http://{assign['url']}/{assign['fid']}",
+            body=data, headers=headers, timeout=300)
+        if r.status >= 300:
+            raise IOError(f"upload {assign['fid']}: HTTP {r.status}")
 
     def read_range(self, path: str, offset: int, size: int) -> bytes:
-        req = urllib.request.Request(
-            f"http://{self.filer}" + urllib.parse.quote(path),
-            headers={"Range": f"bytes={offset}-{offset + size - 1}"})
-        try:
-            with urllib.request.urlopen(req, timeout=300) as r:
-                data = r.read()
-                if r.status == 200:
-                    data = data[offset:offset + size]
-                return data
-        except urllib.error.HTTPError as e:
-            if e.code in (404, 416):
-                return b""
-            raise
+        r = self._pool.request(
+            "GET", f"http://{self.filer}" + urllib.parse.quote(path),
+            headers={"Range": f"bytes={offset}-{offset + size - 1}"},
+            timeout=300)
+        if r.status in (404, 416):
+            return b""
+        if r.status >= 400:
+            raise IOError(f"read {path}: HTTP {r.status}")
+        data = r.data
+        if r.status == 200:
+            data = data[offset:offset + size]
+        return data
 
     def lookup_volume(self, vid: int) -> list[str]:
         cached = self._vid_cache.get(vid)
-        if cached and time.time() - cached[1] < 60.0:
-            return cached[0]
+        if cached:
+            return cached
         out = self._get_json(f"/__meta__/lookup_volume?volumeId={vid}")
         urls = [loc["url"] for loc in (out or {}).get("locations", [])]
         if urls:
-            self._vid_cache[vid] = (urls, time.time())
+            self._vid_cache.put(vid, urls)
         return urls
 
     def _cache_fid_auth(self, fid: str, auth: str) -> None:
@@ -167,7 +174,24 @@ class FilerClient:
     def read_chunk(self, fid: str, offset_in_chunk: int, size: int) -> bytes:
         """Fetch a sub-range of one chunk straight from a volume server —
         used for handle-local chunks the filer doesn't know about yet.
-        Falls back to a per-fid read-jwt lookup on 401."""
+        Cached per view, and N concurrent readers of one cold view
+        coalesce into one backend fetch."""
+        key = f"{fid}@{offset_in_chunk}:{size}"
+        cached = self.chunk_cache.get(key)
+        if cached is not None:
+            return cached
+
+        def fetch() -> bytes:
+            data = self._read_chunk_backend(fid, offset_in_chunk, size)
+            self.chunk_cache.put(key, data)
+            return data
+
+        return self._read_flight.do(key, fetch)
+
+    def _read_chunk_backend(self, fid: str, offset_in_chunk: int,
+                            size: int) -> bytes:
+        """One volume-server round trip (pooled); falls back to a per-fid
+        read-jwt lookup on 401."""
         vid = int(fid.split(",")[0])
         last: Optional[Exception] = None
         urls, auth = self.lookup_volume(vid), ""
@@ -181,29 +205,30 @@ class FilerClient:
                 if auth:
                     self._cache_fid_auth(fid, auth)
         for attempt in range(2):
+            denied = False
             for url in urls:
                 headers = {"Range": f"bytes={offset_in_chunk}-"
                                     f"{offset_in_chunk + size - 1}"}
                 if auth:
                     headers["Authorization"] = f"BEARER {auth}"
-                req = urllib.request.Request(f"http://{url}/{fid}",
-                                             headers=headers)
                 try:
-                    with urllib.request.urlopen(req, timeout=300) as r:
-                        data = r.read()
-                        if r.status == 200:
-                            data = data[offset_in_chunk:
-                                        offset_in_chunk + size]
-                        return data
-                except urllib.error.HTTPError as e:
-                    last = e
-                    if e.code == 401 and attempt == 0:
-                        break  # acquire a read token and retry
+                    r = self._pool.request("GET", f"http://{url}/{fid}",
+                                           headers=headers, timeout=300)
                 except Exception as e:
                     last = e
-                    self._vid_cache.pop(vid, None)
-            if (attempt == 0 and isinstance(last, urllib.error.HTTPError)
-                    and last.code == 401):
+                    self._vid_cache.pop(vid)
+                    continue
+                if r.status in (200, 206):
+                    data = r.data
+                    if r.status == 200:
+                        data = data[offset_in_chunk:
+                                    offset_in_chunk + size]
+                    return data
+                last = IOError(f"{url}/{fid}: HTTP {r.status}")
+                if r.status == 401 and attempt == 0:
+                    denied = True
+                    break  # acquire a read token and retry
+            if denied:
                 self._read_auth_needed = True
                 fid_urls, auth = self.lookup_fid_with_auth(fid)
                 urls = fid_urls or urls

@@ -30,6 +30,24 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops import gf256, rs_jax, rs_pallas
 
 
+def _shard_map(step, mesh, in_specs, out_specs):
+    """jax.shard_map across jax versions: 0.4.x carries it only under
+    jax.experimental with the check_rep spelling; the top-level API
+    first kept check_rep, then renamed it to check_vma. Replication
+    checks are off either way — pallas_call outputs carry no vma/rep
+    metadata."""
+    if hasattr(jax, "shard_map"):
+        try:
+            return jax.shard_map(step, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False)
+        except TypeError:  # top-level but pre-rename: check_rep era
+            return jax.shard_map(step, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=False)
+    from jax.experimental.shard_map import shard_map as sm
+    return sm(step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              check_rep=False)
+
+
 def make_mesh(n_devices: int | None = None,
               axis_name: str = "batch") -> Mesh:
     devs = jax.devices()
@@ -58,12 +76,8 @@ def _sharded_encode_fn(k: int, m: int, mesh_key, use_pallas: bool):
         parity = apply_fn(flat)
         return jnp.transpose(parity.reshape(-1, b, n), (1, 0, 2))
 
-    shard_step = jax.shard_map(
-        step, mesh=mesh,
-        in_specs=P("batch", None, None),
-        out_specs=P("batch", None, None),
-        check_vma=False,  # pallas_call outputs carry no vma metadata
-    )
+    shard_step = _shard_map(step, mesh, P("batch", None, None),
+                            P("batch", None, None))
     return jax.jit(shard_step)
 
 
@@ -114,12 +128,8 @@ def _sharded_rebuild_fn(k: int, m: int, present: tuple[int, ...],
         local = jax.lax.dynamic_slice(full, (0, idx * cols), (k, cols))
         return apply_fn(local)
 
-    shard_step = jax.shard_map(
-        step, mesh=mesh,
-        in_specs=P("batch", None),
-        out_specs=P(None, "batch"),
-        check_vma=False,  # pallas_call outputs carry no vma metadata
-    )
+    shard_step = _shard_map(step, mesh, P("batch", None),
+                            P(None, "batch"))
     return jax.jit(shard_step)
 
 

@@ -20,11 +20,14 @@ persists the consume position.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import time
 from typing import Iterator, Optional
 
 from ..filer.filer import MetaEvent
+
+log = logging.getLogger("replication.sub")
 
 
 class NotificationInput:
@@ -141,19 +144,24 @@ class BrokerQueueInput(NotificationInput):
         self._pending: list = []
 
     def receive(self, timeout: float = 1.0) -> Optional[MetaEvent]:
-        if not self._pending:
-            for entry in self._sub.stream(since=self._since,
-                                          timeout=timeout):
-                self._pending.append(entry)
-                break  # one at a time; stream() reopens per receive
-        if not self._pending:
-            return None
-        entry = self._pending.pop(0)
-        self._since = entry.ts_ns
-        try:
-            return MetaEvent.from_dict(json.loads(entry.value.decode()))
-        except Exception:
-            return None
+        while True:
+            if not self._pending:
+                for entry in self._sub.stream(since=self._since,
+                                              timeout=timeout):
+                    self._pending.append(entry)
+                    break  # one at a time; stream() reopens per receive
+            if not self._pending:
+                return None
+            entry = self._pending.pop(0)
+            self._since = entry.ts_ns
+            try:
+                return MetaEvent.from_dict(
+                    json.loads(entry.value.decode()))
+            except Exception:
+                # dropped-one is not caught-up: advance past the corrupt
+                # message and keep consuming
+                log.warning("broker input: dropping corrupt message at "
+                            "ts %d", entry.ts_ns)
 
     def ack(self) -> None:
         if self.position_path:
@@ -188,21 +196,31 @@ class KafkaQueueInput(NotificationInput):
         self._pending: list = []
 
     def receive(self, timeout: float = 1.0) -> Optional[MetaEvent]:
-        if not self._pending:
-            try:
-                self._pending = self._client.fetch(
-                    self.topic, self.partition, self._offset,
-                    max_wait_ms=int(timeout * 1000))
-            except Exception:
-                return None
-        if not self._pending:
-            return None
-        offset, _key, value = self._pending.pop(0)
-        self._offset = offset + 1
-        try:
-            return MetaEvent.from_dict(json.loads((value or b"").decode()))
-        except Exception:
-            return None
+        # a corrupt message must read as "dropped one, keep going", not
+        # as "caught up": skip it and serve the next message — looping
+        # back to fetch when the drop emptied the batch (a corrupt TAIL
+        # must not look like an empty queue), so iter_queue's
+        # None-means-idle contract stays true
+        while True:
+            if not self._pending:
+                try:
+                    self._pending = self._client.fetch(
+                        self.topic, self.partition, self._offset,
+                        max_wait_ms=int(timeout * 1000))
+                except Exception:
+                    return None
+                if not self._pending:
+                    return None  # genuinely caught up
+            while self._pending:
+                offset, _key, value = self._pending.pop(0)
+                self._offset = offset + 1
+                try:
+                    return MetaEvent.from_dict(
+                        json.loads((value or b"").decode()))
+                except Exception:
+                    log.warning("kafka input: dropping corrupt message "
+                                "at %s/%d offset %d", self.topic,
+                                self.partition, offset)
 
     def ack(self) -> None:
         if self.position_path:

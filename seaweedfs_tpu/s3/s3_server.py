@@ -376,7 +376,7 @@ class S3Server:
     # --- dispatch ---
     async def dispatch_root(self, request: web.Request) -> web.Response:
         denied = self._check_auth(request)
-        if denied:
+        if denied is not None:
             return denied
         if request.method == "GET":
             return await self.list_buckets(request)
@@ -394,7 +394,7 @@ class S3Server:
                   "GET": auth_mod.ACTION_LIST,
                   "POST": auth_mod.ACTION_WRITE}.get(request.method, "")
         denied = self._check_auth(request, action, bucket)
-        if denied:
+        if denied is not None:
             return denied
         if request.method == "PUT":
             return await self.put_bucket(bucket)
@@ -421,7 +421,7 @@ class S3Server:
         else:
             action = auth_mod.ACTION_WRITE
         denied = self._check_auth(request, action, bucket)
-        if denied:
+        if denied is not None:
             return denied
         if tagging:
             if request.method == "GET":

@@ -24,14 +24,15 @@ import time
 import urllib.parse
 
 # Sub-resources included in CanonicalizedResource, alphabetical — the
-# same whitelist AWS documents (and auth_signature_v2.go pins)
+# same whitelist AWS documents (and auth_signature_v2.go pins; 'tagging'
+# is deliberately NOT in the reference's V2 list)
 RESOURCE_LIST = (
     "acl", "delete", "lifecycle", "location", "logging", "notification",
     "partNumber", "policy", "requestPayment", "response-cache-control",
     "response-content-disposition", "response-content-encoding",
     "response-content-language", "response-content-type",
     "response-expires", "torrent", "uploadId", "uploads", "versionId",
-    "versioning", "versions", "website", "tagging",
+    "versioning", "versions", "website",
 )
 
 

@@ -28,7 +28,6 @@ import aiohttp
 from aiohttp import web
 
 from .. import observe
-from ..client import _PUSHED
 from ..filer import manifest as manifest_mod
 from ..filer.chunks import FileChunk, etag as chunks_etag, read_plan, total_size
 from ..filer.entry import Entry, new_directory, new_file
@@ -80,9 +79,11 @@ class FilerServer:
         self.chunk_size = chunk_size
         self.default_replication = default_replication
         self.default_collection = default_collection
+        self.metrics = metrics_mod.Registry("filer")
         self.filer = Filer(create_store(store_name, **(store_kwargs or {})),
                            on_delete_chunks=self._queue_chunk_deletes,
-                           meta_log_path=meta_log_path)
+                           meta_log_path=meta_log_path,
+                           metrics=self.metrics)
         self.peers = [p for p in (peers or []) if p]
         self.guard = guard
         # server-side AES-256-GCM chunk encryption
@@ -99,22 +100,29 @@ class FilerServer:
         # entries fold chunk lists into manifest blobs past this many
         # chunks (filechunk_manifest.go ManifestBatch)
         self.manifest_batch = manifest_mod.MANIFEST_BATCH
-        # hot-chunk LRU (weed/util/chunk_cache via filer reader_at.go):
-        # repeated and ranged reads of the same chunk skip the volume
-        # server round trip
-        from ..utils.chunk_cache import ChunkCache
-        self.chunk_cache = ChunkCache()
+        # hot-chunk tier (weed/util/chunk_cache via filer reader_at.go):
+        # size-classed memory LRU + optional disk tier (WEED_CHUNK_CACHE_*
+        # env knobs); repeated and ranged reads of the same chunk skip
+        # the volume server round trip entirely
+        from ..cache import AsyncSingleflight, TieredChunkCache
+        self.chunk_cache = TieredChunkCache.from_env(metrics=self.metrics)
+        # N concurrent fetches of one cold chunk collapse into one
+        # volume-server read (the filer reader's singleflight)
+        self._fetch_flight = AsyncSingleflight("filer.fetch",
+                                               metrics=self.metrics)
         self.notifier = notifier
         if notifier is not None:
             self.filer.meta_log.subscribe(notifier.notify)
-        self.metrics = metrics_mod.Registry("filer")
         self._session: Optional[aiohttp.ClientSession] = None
         self._delete_queue: asyncio.Queue = asyncio.Queue()
         self._delete_task: Optional[asyncio.Task] = None
         self._aggregator_tasks: list[asyncio.Task] = []
         self._watch_task: Optional[asyncio.Task] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
-        self._vid_cache: dict[int, tuple[list[str], float]] = {}
+        # TTL'd vid -> locations; KeepConnected-pushed entries are pinned
+        from ..cache import TTLCache
+        self._vid_cache = TTLCache(ttl=60.0, metrics=self.metrics,
+                                   name="vid")
         self.app = self._build_app()
 
     def _build_app(self) -> web.Application:
@@ -413,11 +421,12 @@ class FilerServer:
                     async for line in r.content:
                         msg = json_mod.loads(line)
                         if msg.get("type") == "snapshot":
-                            self._vid_cache = {
-                                int(vid): ([x["url"] for x in locs],
-                                           _PUSHED)
-                                for vid, locs in
-                                msg.get("volumes", {}).items()}
+                            self._vid_cache.clear()
+                            for vid, locs in \
+                                    msg.get("volumes", {}).items():
+                                self._vid_cache.put(
+                                    int(vid), [x["url"] for x in locs],
+                                    pin=True)
                         elif msg.get("type") == "update":
                             self._apply_location_update(msg)
             except asyncio.CancelledError:
@@ -429,17 +438,17 @@ class FilerServer:
     def _apply_location_update(self, msg: dict) -> None:
         url = msg["url"]
         for vid in msg.get("new_vids", []):
-            urls, _ = self._vid_cache.get(vid, ([], _PUSHED))
+            urls = self._vid_cache.get(vid) or []
             if url not in urls:
                 urls = urls + [url]
-            self._vid_cache[vid] = (urls, _PUSHED)
+            self._vid_cache.put(vid, urls, pin=True)
         for vid in msg.get("deleted_vids", []):
-            urls, _ = self._vid_cache.get(vid, ([], _PUSHED))
-            urls = [u for u in urls if u != url]
+            urls = [u for u in (self._vid_cache.get(vid) or [])
+                    if u != url]
             if urls:
-                self._vid_cache[vid] = (urls, _PUSHED)
+                self._vid_cache.put(vid, urls, pin=True)
             else:
-                self._vid_cache.pop(vid, None)
+                self._vid_cache.pop(vid)
 
     # --- chunk-freeing queue (filer_deletion.go) ---
     def _queue_chunk_deletes(self, chunks: list[FileChunk]) -> None:
@@ -560,14 +569,13 @@ class FilerServer:
 
     async def _lookup(self, vid: int) -> list[str]:
         cached = self._vid_cache.get(vid)
-        if cached and (cached[1] == _PUSHED
-                       or time.time() - cached[1] < 60):
-            return cached[0]
+        if cached:
+            return cached
         body = await self._master_get("/dir/lookup",
                                       {"volumeId": str(vid)})
         urls = [loc["url"] for loc in body.get("locations", [])]
         if urls:
-            self._vid_cache[vid] = (urls, time.time())
+            self._vid_cache.put(vid, urls)
         return urls
 
     async def _assign(self, collection: str, replication: str,
@@ -632,28 +640,55 @@ class FilerServer:
                              etag=body.get("eTag", ""),
                              cipher_key=cipher_key)
 
+    async def _cache_get(self, fid: str):
+        """Chunk-cache lookup that keeps disk-tier file I/O (and the
+        cache lock held around it) off the event loop; pure memory
+        lookups stay inline — they're microseconds."""
+        if self.chunk_cache._disk is None:
+            return self.chunk_cache.get(fid)
+        return await asyncio.get_event_loop().run_in_executor(
+            None, self.chunk_cache.get, fid)
+
+    def _cache_put(self, fid: str, data: bytes) -> None:
+        """put() can demote evicted chunks to disk: run it off-loop
+        when the disk tier is enabled."""
+        if self.chunk_cache._disk is None:
+            self.chunk_cache.put(fid, data)
+        else:
+            asyncio.get_event_loop().run_in_executor(
+                None, self.chunk_cache.put, fid, data)
+
     async def _fetch_view(self, fid: str, offset_in_chunk: int,
                           size: int, cipher_key: str = "",
                           chunk_size: int = 0) -> bytes:
-        cached = self.chunk_cache.get(fid)
+        cached = await self._cache_get(fid)
         if cached is not None:
             return cached[offset_in_chunk:offset_in_chunk + size]
         if cipher_key:
             # encrypted chunks cannot be range-read: fetch whole, decrypt,
             # slice (reader side of filer_server_handlers_write_cipher.go);
             # the cache holds plaintext so the key never needs re-fetching
-            from ..utils import cipher as cipher_mod
-            whole = await self._fetch_raw(fid)
-            plain = await asyncio.get_event_loop().run_in_executor(
-                None, cipher_mod.decrypt, whole,
-                cipher_mod.key_from_str(cipher_key))
-            self.chunk_cache.put(fid, plain)
+            async def fetch_plain() -> bytes:
+                from ..utils import cipher as cipher_mod
+                whole = await self._fetch_raw(fid)
+                plain = await asyncio.get_event_loop().run_in_executor(
+                    None, cipher_mod.decrypt, whole,
+                    cipher_mod.key_from_str(cipher_key))
+                self._cache_put(fid, plain)
+                return plain
+
+            plain = await self._fetch_flight.do(fid, fetch_plain)
             return plain[offset_in_chunk:offset_in_chunk + size]
         if 0 < chunk_size <= self.chunk_cache.max_chunk_bytes:
             # cacheable chunk: fetch it whole like the reference's
-            # ChunkReaderAt so later views of the same chunk are local
-            whole = await self._fetch_raw(fid)
-            self.chunk_cache.put(fid, whole)
+            # ChunkReaderAt so later views of the same chunk are local;
+            # concurrent readers of the same cold chunk share one fetch
+            async def fetch_whole() -> bytes:
+                whole = await self._fetch_raw(fid)
+                self._cache_put(fid, whole)
+                return whole
+
+            whole = await self._fetch_flight.do(fid, fetch_whole)
             return whole[offset_in_chunk:offset_in_chunk + size]
         return await self._fetch_raw(fid, offset_in_chunk, size)
 

@@ -276,14 +276,14 @@ class MasterServer:
 
     async def raft_vote(self, request: web.Request) -> web.Response:
         denied = await self._raft_peer_check(request)
-        if denied:
+        if denied is not None:
             return denied
         return web.json_response(
             await self.raft.handle_vote(await request.json()))
 
     async def raft_append(self, request: web.Request) -> web.Response:
         denied = await self._raft_peer_check(request)
-        if denied:
+        if denied is not None:
             return denied
         return web.json_response(
             await self.raft.handle_append(await request.json()))

@@ -429,6 +429,16 @@ class VolumeServer:
                 "ec_shards", len(s.get("shard_ids", [])),
                 labels={"collection": s.get("collection", "") or "default",
                         "volume": str(s.get("id"))})
+        # EC read-coalescing totals: how many cold interval reads led a
+        # flight vs rode one (singleflight in ec/ec_volume.py)
+        leaders = shared = 0
+        for loc in self.store.locations:
+            for ev in loc.ec_volumes.values():
+                st = ev.read_flight.stats()
+                leaders += st["leaders"]
+                shared += st["shared"]
+        self.metrics.gauge("ec_read_flight_leaders", leaders)
+        self.metrics.gauge("ec_read_flight_shared", shared)
 
     async def send_heartbeat(self) -> None:
         payload = self._hb_payload()
@@ -1309,12 +1319,15 @@ class VolumeServer:
             if data is not None:
                 return data
             try:
-                with urllib.request.urlopen(
-                        f"http://{url}/admin/ec/shard_read?volume="
-                        f"{ev.vid}&shard={shard_id}&offset={offset}"
-                        f"&size={size}", timeout=10) as r:
-                    data = r.read()
-                    return data if len(data) == size else None
+                from ..cache import shared_pool
+                r = shared_pool().request(
+                    "GET",
+                    f"http://{url}/admin/ec/shard_read?volume="
+                    f"{ev.vid}&shard={shard_id}&offset={offset}"
+                    f"&size={size}", timeout=10)
+                if r.status != 200:
+                    return None
+                return r.data if len(r.data) == size else None
             except Exception:
                 return None
 

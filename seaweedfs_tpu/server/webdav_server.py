@@ -138,6 +138,16 @@ class LockManager:
         del self._locks[lk.path]
         return True
 
+    def release_subtree(self, path: str) -> None:
+        """Drop every lock at `path` and below. A successful DELETE/MOVE
+        destroys those resources; RFC 4918 (9.6/7.5) says their locks go
+        with them — leaving them registered would 423 the recreated path
+        until expiry."""
+        prefix = path.rstrip("/") + "/"
+        for p in [p for p in self._locks
+                  if p == path or p.startswith(prefix)]:
+            del self._locks[p]
+
 
 class WebDavServer:
     def __init__(self, filer_url: str, url: str = ""):
@@ -300,7 +310,7 @@ class WebDavServer:
 
     async def handle_put(self, request, path) -> web.Response:
         denied = self._lock_conflict(request, path)
-        if denied:
+        if denied is not None:
             return denied
         data = await request.read()
         async with self._session.put(
@@ -312,18 +322,23 @@ class WebDavServer:
 
     async def handle_delete(self, request, path) -> web.Response:
         denied = self._lock_conflict(request, path, subtree=True)
-        if denied:
+        if denied is not None:
             return denied
         async with self._session.delete(
                 f"http://{self.filer}{quote(path)}",
                 params={"recursive": "true"}) as r:
             if r.status == 404:
                 return web.Response(status=404)
+            if r.status >= 300:
+                # the resource still exists: its locks must survive
+                return web.Response(status=502)
+            # the subtree is gone; its locks must not outlive it
+            self.locks.release_subtree(path)
             return web.Response(status=204)
 
     async def handle_mkcol(self, request, path) -> web.Response:
         denied = self._lock_conflict(request, path)
-        if denied:
+        if denied is not None:
             return denied
         if await self._lookup(path) is not None:
             return web.Response(status=405)
@@ -343,7 +358,7 @@ class WebDavServer:
         if dest is None:
             return web.Response(status=400, text="missing Destination")
         denied = self._lock_conflict(request, path, dest, subtree=True)
-        if denied:
+        if denied is not None:
             return denied
         existed = await self._lookup(dest) is not None
         if existed and request.headers.get("Overwrite", "T") == "F":
@@ -353,6 +368,14 @@ class WebDavServer:
                 params={"mv.to": dest}) as r:
             if r.status == 404:
                 return web.Response(status=404)
+            if r.status >= 300:
+                # the move didn't happen: source locks must survive
+                return web.Response(status=502)
+            # nothing exists at the source anymore, and an overwritten
+            # destination went through an implicit DELETE (RFC 4918
+            # 9.9.4) — locks on either side die with the old resources
+            self.locks.release_subtree(path)
+            self.locks.release_subtree(dest)
             return web.Response(status=204 if existed else 201)
 
     async def handle_copy(self, request, path) -> web.Response:
@@ -360,7 +383,7 @@ class WebDavServer:
         if dest is None:
             return web.Response(status=400, text="missing Destination")
         denied = self._lock_conflict(request, dest, subtree=True)
-        if denied:
+        if denied is not None:
             return denied
         entry = await self._lookup(path)
         if entry is None:
@@ -488,7 +511,7 @@ class WebDavServer:
 
     async def handle_proppatch(self, request, path) -> web.Response:
         denied = self._lock_conflict(request, path)
-        if denied:
+        if denied is not None:
             return denied
         body = ('<?xml version="1.0" encoding="utf-8"?>'
                 '<D:multistatus xmlns:D="DAV:"><D:response>'

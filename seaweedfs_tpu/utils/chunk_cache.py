@@ -1,63 +1,8 @@
-"""In-memory LRU chunk cache (role of weed/util/chunk_cache: the filer's
-ChunkReaderAt keeps hot chunks close so repeated/ranged reads don't re-hit
-volume servers).
+"""Back-compat shim: the chunk cache moved to the read-path performance
+tier (``seaweedfs_tpu.cache.tiered``) where it grew size-class
+accounting, an optional on-disk tier, TTL invalidation, and metrics/span
+emission. The old import path and constructor keep working."""
 
-Byte-budgeted LRU keyed by fid; whole chunks only (partial ranges are
-sliced by the caller). Thread-safe — the filer serves from an asyncio loop
-plus executor threads.
-"""
+from ..cache.tiered import TieredChunkCache as ChunkCache
 
-from __future__ import annotations
-
-import collections
-import threading
-from typing import Optional
-
-
-class ChunkCache:
-    def __init__(self, max_bytes: int = 64 * 1024 * 1024,
-                 max_chunk_bytes: int = 8 * 1024 * 1024):
-        self.max_bytes = max_bytes
-        # chunks bigger than this aren't worth caching (they'd evict
-        # everything else); the reference tiers by chunk size similarly
-        self.max_chunk_bytes = max_chunk_bytes
-        self._lock = threading.Lock()
-        self._data: "collections.OrderedDict[str, bytes]" = \
-            collections.OrderedDict()
-        self._bytes = 0
-        self.hits = 0
-        self.misses = 0
-
-    def get(self, fid: str) -> Optional[bytes]:
-        with self._lock:
-            data = self._data.get(fid)
-            if data is None:
-                self.misses += 1
-                return None
-            self._data.move_to_end(fid)
-            self.hits += 1
-            return data
-
-    def put(self, fid: str, data: bytes) -> None:
-        if len(data) > self.max_chunk_bytes:
-            return
-        with self._lock:
-            old = self._data.pop(fid, None)
-            if old is not None:
-                self._bytes -= len(old)
-            self._data[fid] = data
-            self._bytes += len(data)
-            while self._bytes > self.max_bytes and self._data:
-                _, evicted = self._data.popitem(last=False)
-                self._bytes -= len(evicted)
-
-    def drop(self, fid: str) -> None:
-        with self._lock:
-            old = self._data.pop(fid, None)
-            if old is not None:
-                self._bytes -= len(old)
-
-    def stats(self) -> dict:
-        with self._lock:
-            return {"bytes": self._bytes, "chunks": len(self._data),
-                    "hits": self.hits, "misses": self.misses}
+__all__ = ["ChunkCache"]

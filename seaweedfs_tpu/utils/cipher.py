@@ -11,14 +11,29 @@ from __future__ import annotations
 import base64
 import os
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+# gated: hosts without the `cryptography` wheel can still import every
+# module that reaches cipher helpers transitively (filer server, tests);
+# only actually encrypting/decrypting requires the dependency
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    HAVE_AESGCM = True
+except ImportError:  # pragma: no cover - env-dependent
+    AESGCM = None
+    HAVE_AESGCM = False
 
 KEY_SIZE = 32
 NONCE_SIZE = 12
 
 
+def _require() -> None:
+    if not HAVE_AESGCM:
+        raise RuntimeError(
+            "chunk encryption requires the 'cryptography' package")
+
+
 def encrypt(data: bytes) -> tuple[bytes, bytes]:
     """Encrypt with a fresh key; returns (nonce||ciphertext||tag, key)."""
+    _require()
     key = os.urandom(KEY_SIZE)
     nonce = os.urandom(NONCE_SIZE)
     ct = AESGCM(key).encrypt(nonce, data, None)
@@ -26,6 +41,7 @@ def encrypt(data: bytes) -> tuple[bytes, bytes]:
 
 
 def decrypt(payload: bytes, key: bytes) -> bytes:
+    _require()
     if len(payload) < NONCE_SIZE:
         raise ValueError("cipher payload too short")
     nonce, ct = payload[:NONCE_SIZE], payload[NONCE_SIZE:]

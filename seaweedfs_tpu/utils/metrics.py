@@ -115,6 +115,17 @@ class Registry:
     def timed(self, name: str, labels: dict | None = None):
         return _Timer(self, name, labels)
 
+    def value(self, name: str, labels: dict | None = None,
+              default: float = 0.0) -> float:
+        """Current value of a counter or gauge — for tests and code that
+        branches on its own counters (e.g. cache hit-rate probes)
+        without re-parsing the exposition text."""
+        key = _key(name, labels)
+        with self._lock:
+            if key in self._counters:
+                return self._counters[key]
+            return self._gauges.get(key, default)
+
     @staticmethod
     def _split(key: str) -> tuple[str, str]:
         """'read{a="b"}' -> ('read', '{a="b"}')."""

@@ -1,0 +1,84 @@
+"""bench.py per-phase incremental checkpointing (VERDICT r5: a timed-out
+rebuild phase nulled the whole BENCH_DETAIL.json record two rounds
+running — now each phase lands on disk the moment it completes)."""
+
+import json
+import os
+import sys
+
+
+def _bench():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+    return bench
+
+
+def test_checkpoint_writes_partial_record(tmp_path):
+    bench = _bench()
+    path = str(tmp_path / "BENCH_DETAIL.json")
+    detail = {"volume_bytes": 123, "incomplete": True,
+              "encode": {"value_gbps": 1.5}}
+    bench._checkpoint(detail, path=path)
+    got = json.load(open(path))
+    assert got["encode"]["value_gbps"] == 1.5
+    assert got["incomplete"] is True
+
+    # a later phase extends the same record; earlier numbers survive
+    detail["rebuild"] = {"rebuild_p50_s": 2.0}
+    bench._checkpoint(detail, path=path)
+    got = json.load(open(path))
+    assert got["encode"]["value_gbps"] == 1.5
+    assert got["rebuild"]["rebuild_p50_s"] == 2.0
+
+
+def test_checkpoint_is_atomic(tmp_path):
+    """The write goes through a tmp file + os.replace: a reader never
+    sees a torn record, and a failed write leaves the old one intact."""
+    bench = _bench()
+    path = str(tmp_path / "BENCH_DETAIL.json")
+    bench._checkpoint({"phase": 1}, path=path)
+    # unwritable tmp target: the old record must survive
+    bench._checkpoint({"phase": 2},
+                      path=str(tmp_path / "nodir" / "x.json"))
+    assert json.load(open(path)) == {"phase": 1}
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_main_checkpoints_every_phase(monkeypatch, tmp_path):
+    """Drive bench.main() with every phase stubbed: each phase completes
+    -> the on-disk record already contains it (and a phase that 'hangs'
+    forever would still leave all earlier phases on disk)."""
+    bench = _bench()
+    path = str(tmp_path / "BENCH_DETAIL.json")
+    monkeypatch.setattr(bench, "DETAIL_PATH", path)
+    snapshots = []
+
+    def fake_phase(name, work, timeout_s):
+        if os.path.exists(path):
+            snapshots.append(set(json.load(open(path))))
+        return {"value_gbps": 1.0, "kernel": {}, "phase_wall_s": 0.1}
+
+    monkeypatch.setattr(bench, "_run_phase", fake_phase)
+    monkeypatch.setattr(bench, "_make_volume", lambda *a: None)
+    monkeypatch.setattr(bench, "bench_system",
+                        lambda w: {"write": {"req_s": 1},
+                                   "read": {"req_s": 1}})
+    monkeypatch.setattr(bench, "bench_needle_map", lambda w: {})
+    monkeypatch.setattr(bench, "HARD_BUDGET_S", 10_000.0)
+    # main() imports ec.pipeline for parent-side shard gen: stub the
+    # real module attribute (patching sys.modules is not enough once the
+    # package attribute is already bound by an earlier import)
+    import seaweedfs_tpu.ec.pipeline as _pl
+    monkeypatch.setattr(_pl, "stream_encode", lambda *a, **k: None)
+    bench.main()
+
+    # the kernel phase saw encode's checkpoint; rebuild saw kernel's
+    assert {"encode"} <= snapshots[1]
+    assert {"encode", "kernel_phase"} <= snapshots[2]
+    final = json.load(open(path))
+    assert "incomplete" not in final
+    for key in ("encode", "kernel_phase", "rebuild",
+                "fused_compact_gzip_rs", "system_req_s",
+                "disk_needle_map"):
+        assert key in final, key

@@ -1,0 +1,537 @@
+"""Read-path performance tier (seaweedfs_tpu/cache/): tiered chunk
+cache, singleflight coalescing, pooled HTTP, TTL lookup caches — unit
+level plus the filer end-to-end microbenchmarks the tier exists for:
+
+- a warm GET through the filer chunk path skips the volume-server fetch
+  entirely (asserted via hit counters AND a poisoned backend);
+- N concurrent reads of one uncached chunk issue exactly 1 backend
+  fetch;
+- hit/miss/eviction counters appear in /metrics exposition and
+  cache.lookup spans appear in /debug/trace output.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from seaweedfs_tpu import observe
+from seaweedfs_tpu.cache import (AsyncSingleflight, HttpPool, Singleflight,
+                                 TieredChunkCache, TTLCache)
+from seaweedfs_tpu.utils import metrics as metrics_mod
+
+
+# --- tiered chunk cache: memory front ---
+
+def test_lru_eviction_order():
+    cc = TieredChunkCache(max_bytes=1000, max_chunk_bytes=400)
+    cc.put("a", b"x" * 400)
+    cc.put("b", b"y" * 400)
+    assert cc.get("a") is not None  # refresh a: b becomes the LRU victim
+    cc.put("c", b"z" * 400)
+    assert cc.get("b") is None
+    assert cc.get("a") is not None
+    assert cc.get("c") is not None
+    cc.put("big", b"w" * 500)  # over max_chunk_bytes: not cached
+    assert cc.get("big") is None
+    assert cc.stats()["bytes"] <= 1000
+    assert cc.stats()["evictions"] >= 1
+
+
+def test_size_class_accounting():
+    cc = TieredChunkCache(max_bytes=64 * 1024 * 1024)
+    cc.put("small", b"s" * 1024)            # <= 64K class
+    cc.put("medium", b"m" * (256 * 1024))   # <= 1M class
+    cc.put("large", b"l" * (2 * 1024 * 1024))  # big class
+    classes = cc.stats()["classes"]
+    assert classes["64K"] == {"bytes": 1024, "chunks": 1}
+    assert classes["1M"] == {"bytes": 256 * 1024, "chunks": 1}
+    assert classes["big"] == {"bytes": 2 * 1024 * 1024, "chunks": 1}
+    cc.drop("medium")
+    assert cc.stats()["classes"]["1M"] == {"bytes": 0, "chunks": 0}
+    # totals stay consistent with the class breakdown
+    st = cc.stats()
+    assert st["bytes"] == sum(c["bytes"] for c in st["classes"].values())
+
+
+def test_ttl_expiry_and_invalidation():
+    cc = TieredChunkCache(ttl=0.05)
+    cc.put("k", b"data")
+    assert cc.get("k") == b"data"
+    time.sleep(0.06)
+    assert cc.get("k") is None  # TTL'd out without any event
+
+    # overwrite/delete invalidate immediately, including sub-chunk views
+    cc2 = TieredChunkCache()
+    cc2.put("5,abc", b"whole")
+    cc2.put("5,abc@100:50", b"view")
+    cc2.drop("5,abc")
+    assert cc2.get("5,abc") is None
+    assert cc2.get("5,abc@100:50") is not None
+    cc2.drop_prefix("5,abc")
+    assert cc2.get("5,abc@100:50") is None
+
+
+def test_disk_tier_round_trip(tmp_path):
+    cc = TieredChunkCache(max_bytes=1000, max_chunk_bytes=600,
+                          disk_dir=str(tmp_path / "tier"),
+                          disk_max_bytes=10_000)
+    cc.put("a", b"A" * 600)
+    cc.put("b", b"B" * 600)  # evicts a from memory -> demoted to disk
+    assert cc.stats()["disk"]["chunks"] == 1
+    got = cc.get("a")       # disk hit, promoted back to memory
+    assert got == b"A" * 600
+    st = cc.stats()
+    assert st["hits"] >= 1
+    # promotion displaced b; b now lives on disk and still round-trips
+    assert cc.get("b") == b"B" * 600
+    # drop reaches the disk tier too
+    cc.drop("a")
+    cc.drop("b")
+    assert cc.get("a") is None and cc.get("b") is None
+
+
+def test_cache_metrics_and_spans():
+    reg = metrics_mod.Registry("testcache")
+    cc = TieredChunkCache(metrics=reg)
+    observe.reset()
+    cc.get("missing")
+    cc.put("k", b"v")
+    cc.get("k")
+    text = reg.render()
+    assert "chunk_cache_miss_total" in text
+    assert 'chunk_cache_hit_total{tier="memory"} 1' in text
+    names = [s["name"] for s in observe.spans()]
+    assert names.count("cache.lookup") == 2
+    tags = [s["tags"].get("tier") for s in observe.spans()
+            if s["name"] == "cache.lookup"]
+    assert tags == ["-", "memory"]
+
+
+# --- singleflight ---
+
+def test_singleflight_collapses_concurrent_fetches():
+    flight = Singleflight("t")
+    calls = []
+    gate = threading.Event()
+
+    def fetch():
+        calls.append(1)
+        gate.wait(2.0)
+        return b"payload"
+
+    with ThreadPoolExecutor(max_workers=8) as ex:
+        futs = [ex.submit(flight.do, "key", fetch) for _ in range(8)]
+        time.sleep(0.2)  # let every caller join the flight
+        gate.set()
+        results = [f.result(timeout=5) for f in futs]
+    assert results == [b"payload"] * 8
+    assert len(calls) == 1  # exactly one backend fetch
+    assert flight.stats() == {"leaders": 1, "shared": 7}
+    # a later call is a fresh flight (coalescing, not caching)
+    assert flight.do("key", lambda: b"fresh") == b"fresh"
+    assert len(calls) == 1
+
+
+def test_singleflight_propagates_errors_and_forgets():
+    flight = Singleflight()
+
+    def boom():
+        raise ValueError("nope")
+
+    with pytest.raises(ValueError):
+        flight.do("k", boom)
+    # the failed flight is forgotten; the next call runs anew
+    assert flight.do("k", lambda: 42) == 42
+
+
+def test_singleflight_wait_emits_span():
+    flight = Singleflight("spans")
+    observe.reset()
+    gate = threading.Event()
+
+    def slow():
+        gate.wait(2.0)
+        return 1
+
+    with ThreadPoolExecutor(max_workers=2) as ex:
+        f1 = ex.submit(flight.do, "k", slow)
+        time.sleep(0.1)
+        f2 = ex.submit(flight.do, "k", slow)
+        time.sleep(0.1)
+        gate.set()
+        f1.result(timeout=5), f2.result(timeout=5)
+    waits = [s for s in observe.spans() if s["name"] == "singleflight.wait"]
+    assert len(waits) == 1
+    assert waits[0]["tags"]["group"] == "spans"
+
+
+def test_async_singleflight_collapses():
+    import asyncio
+
+    async def main():
+        flight = AsyncSingleflight("a")
+        calls = []
+
+        async def fetch():
+            calls.append(1)
+            await asyncio.sleep(0.1)
+            return "x"
+
+        out = await asyncio.gather(*[flight.do("k", fetch)
+                                     for _ in range(6)])
+        assert out == ["x"] * 6
+        assert len(calls) == 1
+        assert flight.stats() == {"leaders": 1, "shared": 5}
+
+    asyncio.new_event_loop().run_until_complete(main())
+
+
+# --- TTL lookup cache ---
+
+def test_ttl_cache_expiry_pin_and_prefix_drop():
+    c = TTLCache(ttl=0.05, max_entries=3)
+    c.put("a", 1)
+    c.put("pinned", 2, pin=True)
+    assert c.get("a") == 1 and "a" in c
+    time.sleep(0.06)
+    assert c.get("a") is None          # expired
+    assert c.get("pinned") == 2        # pinned entries never expire
+    assert c.is_pinned("pinned")
+    c.put("/d/x", 1), c.put("/d/y", 2)
+    c.drop_prefix("/d/")
+    assert c.get("/d/x") is None and c.get("/d/y") is None
+    # bounded: oldest falls out past max_entries
+    for i in range(5):
+        c.put(f"k{i}", i)
+    assert len(c) <= 3
+
+
+# --- pooled HTTP ---
+
+class _CountingHandler:
+    """HTTP/1.1 handler counting connections; optionally drops the
+    socket after a response while still advertising keep-alive (the
+    stale-pooled-connection case)."""
+
+
+def _start_server(silent_close=False):
+    import http.server
+
+    state = {"connections": 0, "requests": 0}
+
+    class H(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def setup(self):
+            state["connections"] += 1
+            super().setup()
+
+        def do_GET(self):
+            state["requests"] += 1
+            body = b'{"ok": true}'
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            if silent_close:
+                self.close_connection = True
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, state
+
+
+def test_http_pool_reuses_connections():
+    srv, state = _start_server()
+    try:
+        pool = HttpPool()
+        url = f"http://127.0.0.1:{srv.server_address[1]}/x"
+        for _ in range(5):
+            r = pool.request("GET", url)
+            assert r.status == 200 and r.json() == {"ok": True}
+        assert state["requests"] == 5
+        assert state["connections"] == 1  # keep-alive reuse
+        assert pool.idle_count() == 1
+        pool.close()
+        assert pool.idle_count() == 0
+    finally:
+        srv.shutdown()
+
+
+def test_http_pool_retries_stale_connection():
+    srv, state = _start_server(silent_close=True)
+    try:
+        pool = HttpPool()
+        url = f"http://127.0.0.1:{srv.server_address[1]}/x"
+        # response 1 pools the connection; the server then drops it
+        # behind our back — response 2 must transparently redial
+        assert pool.request("GET", url).status == 200
+        time.sleep(0.05)  # let the server-side close land
+        assert pool.request("GET", url).status == 200
+        assert state["requests"] == 2
+        pool.close()
+    finally:
+        srv.shutdown()
+
+
+# --- filer entry read-through cache ---
+
+def _mem_filer(ttl=60.0):
+    from seaweedfs_tpu.filer.filer import Filer
+    from seaweedfs_tpu.filer.stores import create_store
+    return Filer(create_store("memory"), entry_cache_ttl=ttl)
+
+
+def test_entry_cache_read_through_and_invalidation():
+    from seaweedfs_tpu.filer.entry import new_file
+    f = _mem_filer()
+    f.create_entry(new_file("/a/one.txt", []))
+    calls = []
+    real = f.store.find_entry
+    f.store.find_entry = lambda p: (calls.append(p), real(p))[1]
+
+    assert f.find_entry("/a/one.txt") is not None
+    assert f.find_entry("/a/one.txt") is not None
+    assert calls == ["/a/one.txt"]  # second lookup served from cache
+
+    # negative lookups cache too
+    assert f.find_entry("/a/nope") is None
+    assert f.find_entry("/a/nope") is None
+    assert calls.count("/a/nope") == 1
+    # ...until the path is created
+    f.create_entry(new_file("/a/nope", []))
+    assert f.find_entry("/a/nope") is not None
+
+    # overwrite invalidates
+    from seaweedfs_tpu.filer.chunks import FileChunk
+    f.create_entry(new_file("/a/one.txt", [FileChunk("1,ff", 0, 3)]))
+    assert len(f.find_entry("/a/one.txt").chunks) == 1
+
+    # rename invalidates both sides
+    f.rename("/a/one.txt", "/a/two.txt")
+    assert f.find_entry("/a/one.txt") is None
+    assert f.find_entry("/a/two.txt") is not None
+
+    # recursive directory delete sweeps cached children
+    assert f.find_entry("/a/nope") is not None  # warm the cache
+    f.delete_entry("/a", recursive=True)
+    assert f.find_entry("/a/nope") is None
+    assert f.find_entry("/a/two.txt") is None
+
+
+# --- filer end-to-end: the microbenchmarks the tier exists for ---
+
+@pytest.fixture(scope="module")
+def cluster():
+    from cluster_util import Cluster
+    c = Cluster(n_volume_servers=1)
+    yield c
+    c.shutdown()
+
+
+def test_warm_get_skips_volume_fetch(cluster):
+    """Repeated-read microbenchmark: the second GET is served wholly
+    from the chunk cache — zero volume-server round trips, proven by
+    poisoning the backend fetch."""
+    fs = cluster.add_filer(chunk_size=4 * 1024)
+    body = bytes(range(256)) * 32  # 8KB -> 2 chunks
+    urllib.request.urlopen(
+        urllib.request.Request(f"http://{fs.url}/hot/file.bin",
+                               data=body, method="PUT"), timeout=10).read()
+    with urllib.request.urlopen(f"http://{fs.url}/hot/file.bin",
+                                timeout=10) as r:
+        assert r.read() == body
+    stats_cold = fs.chunk_cache.stats()
+    assert stats_cold["chunks"] == 2
+
+    async def poisoned(*a, **k):
+        raise AssertionError("volume-server fetch on a warm GET")
+
+    real = fs._fetch_raw
+    fs._fetch_raw = poisoned
+    try:
+        with urllib.request.urlopen(f"http://{fs.url}/hot/file.bin",
+                                    timeout=10) as r:
+            assert r.read() == body
+    finally:
+        fs._fetch_raw = real
+    stats_warm = fs.chunk_cache.stats()
+    assert stats_warm["hits"] >= stats_cold["hits"] + 2
+    assert stats_warm["misses"] == stats_cold["misses"]
+    # the registry agrees with the cache's own accounting
+    assert fs.metrics.value("chunk_cache_hit",
+                            labels={"tier": "memory"}) >= 2
+
+    # counters surface in /metrics exposition
+    with urllib.request.urlopen(f"http://{fs.url}/metrics",
+                                timeout=10) as r:
+        text = r.read().decode()
+    assert "seaweedfs_tpu_filer_chunk_cache_hit_total" in text
+    assert "seaweedfs_tpu_filer_chunk_cache_miss_total" in text
+
+    # and cache.lookup spans surface in /debug/trace
+    with urllib.request.urlopen(
+            f"http://{fs.url}/debug/trace?format=spans", timeout=10) as r:
+        spans = json.load(r)["spans"]
+    assert any(s["name"] == "cache.lookup" for s in spans)
+    chrome = json.load(urllib.request.urlopen(
+        f"http://{fs.url}/debug/trace", timeout=10))
+    assert any(e.get("name") == "cache.lookup"
+               for e in chrome["traceEvents"])
+
+
+def test_concurrent_cold_reads_issue_one_backend_fetch(cluster):
+    """N concurrent GETs of one uncached chunk coalesce into exactly 1
+    volume-server fetch (singleflight on the filer chunk reader)."""
+    import asyncio
+    fs = cluster.add_filer(chunk_size=8 * 1024)
+    body = b"S" * 4096  # single chunk
+    urllib.request.urlopen(
+        urllib.request.Request(f"http://{fs.url}/sf/one.bin",
+                               data=body, method="PUT"), timeout=10).read()
+
+    fetches = []
+    real = fs._fetch_raw
+
+    async def counting(fid, *a, **k):
+        fetches.append(fid)
+        await asyncio.sleep(0.2)  # hold the flight open for followers
+        return await real(fid, *a, **k)
+
+    fs._fetch_raw = counting
+    try:
+        def get():
+            with urllib.request.urlopen(
+                    f"http://{fs.url}/sf/one.bin", timeout=10) as r:
+                return r.read()
+
+        with ThreadPoolExecutor(max_workers=6) as ex:
+            results = list(ex.map(lambda _: get(), range(6)))
+    finally:
+        fs._fetch_raw = real
+    assert all(r == body for r in results)
+    assert len(fetches) == 1  # exactly one backend fetch
+    assert fs._fetch_flight.stats()["shared"] >= 5
+
+    # the coalesced waits are visible as singleflight.wait spans
+    with urllib.request.urlopen(
+            f"http://{fs.url}/debug/trace?format=spans", timeout=10) as r:
+        spans = json.load(r)["spans"]
+    assert any(s["name"] == "singleflight.wait" for s in spans)
+
+
+# --- EC read coalescing ---
+
+def test_ec_cold_interval_reads_coalesce(tmp_path):
+    """N concurrent reads of a needle on a missing EC shard share one
+    reconstruction (singleflight on the EC interval reader)."""
+    import os
+    import random
+
+    from seaweedfs_tpu import ec
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    geo = ec.Geometry(data_shards=10, parity_shards=4,
+                      large_block_size=10000, small_block_size=100)
+    rng = random.Random(7)
+    v = Volume(str(tmp_path), "", 1, create=True)
+    payloads = {}
+    for i in range(1, 20):
+        data = bytes(rng.getrandbits(8) for _ in range(200))
+        payloads[i] = data
+        v.write_needle(Needle(cookie=0x9000 + i, id=i, data=data))
+    v.close()
+    base = os.path.join(str(tmp_path), "1")
+    coder = ec.get_coder("numpy", 10, 4)
+    ec.write_ec_files(base, coder, geo, buffer_size=100)
+    ec.write_sorted_ecx_from_idx(base)
+
+    ev = ec.EcVolume(str(tmp_path), "", 1, geo, coder=coder)
+    for sid in range(14):
+        ev.add_shard(sid)
+    # find a needle whose data lives on shard 0, then delete that shard
+    # so its reads must reconstruct
+    victim_nid = next(nid for nid in payloads
+                      if ev.locate(nid)[2][0].to_shard_id_and_offset(
+                          geo)[0] == 0)
+    ev.delete_shard(0)
+
+    reconstructs = []
+    real = ev._reconstruct_interval
+
+    def counting(*a, **k):
+        reconstructs.append(1)
+        time.sleep(0.1)  # hold the flight open for followers
+        return real(*a, **k)
+
+    ev._reconstruct_interval = counting
+    with ThreadPoolExecutor(max_workers=6) as ex:
+        results = list(ex.map(
+            lambda _: ev.read_needle(victim_nid).data, range(6)))
+    assert all(r == payloads[victim_nid] for r in results)
+    assert len(reconstructs) == 1  # one reconstruction served all six
+    assert ev.read_flight.stats()["shared"] >= 5
+    ev.close()
+
+
+def test_http_pool_survives_server_restart():
+    """A restarted server leaves EVERY pooled connection to it dead: the
+    stale-retry must flush the idle stack and dial fresh, not draw the
+    next corpse (seen as download failures after SIGKILL recovery)."""
+    import http.server
+    srv, state = _start_server()
+    port = srv.server_address[1]
+    pool = HttpPool()
+    url = f"http://127.0.0.1:{port}/x"
+    # park two live keep-alive connections
+    from concurrent.futures import ThreadPoolExecutor as TPE
+    with TPE(max_workers=2) as ex:
+        list(ex.map(lambda _: pool.request("GET", url), range(2)))
+    assert pool.idle_count() >= 2
+    srv.shutdown()
+    srv.server_close()
+    srv2, state2 = _start_server()
+    # rebind the same port so the pooled conns point at the new server
+    try:
+        srv2.server_close()
+        srv2 = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", port), srv2.RequestHandlerClass)
+        threading.Thread(target=srv2.serve_forever, daemon=True).start()
+        r = pool.request("GET", url)
+        assert r.status == 200
+        pool.close()
+    finally:
+        srv2.shutdown()
+
+
+def test_ttl_cache_full_of_pins_keeps_fresh_entry():
+    """With the cache full of pinned entries, a new TTL'd put must not
+    evict itself (that would disable polled-lookup caching entirely)."""
+    c = TTLCache(ttl=60.0, max_entries=4)
+    for i in range(4):
+        c.put(f"pin{i}", i, pin=True)
+    c.put("polled", "v")
+    assert c.get("polled") == "v"  # survived; a pin was evicted instead
+    assert sum(1 for i in range(4) if c.get(f"pin{i}") is not None) == 3
+
+
+def test_ttl_cache_put_if_fresh_generation_guard():
+    """The read-through race guard: a value read before an invalidation
+    must not be cached after it (it may predate the mutation)."""
+    c = TTLCache(ttl=60.0)
+    gen = c.generation
+    assert c.put_if_fresh("k", "v1", gen)   # no invalidation: cached
+    assert c.get("k") == "v1"
+    gen = c.generation
+    c.pop("k")                              # concurrent mutation
+    assert not c.put_if_fresh("k", "stale", gen)
+    assert c.get("k") is None

@@ -193,3 +193,41 @@ def test_registries_accept_kafka(broker, tmp_path):
     ev = inp.receive(timeout=0.5)
     assert ev is not None and ev.new_entry.full_path == "/r/x"
     inp.close()
+
+
+def test_fetch_negative_offset_is_out_of_range(broker):
+    """The -1 "latest" sentinel (or any negative offset) must answer
+    OFFSET_OUT_OF_RANGE (error code 1), not slice from the end of the
+    log and replay messages under wrong offsets (ADVICE r5)."""
+    c = KafkaClient(broker.host, broker.port)
+    c.produce("neg", 0, None, b"m0")
+    c.produce("neg", 0, None, b"m1")
+    with pytest.raises(KafkaError) as e:
+        c.fetch("neg", 0, -1)
+    assert e.value.code == 1
+    # a valid offset still serves the full log, exactly once each
+    got = [v for _o, _k, v in c.fetch("neg", 0, 0)]
+    assert got == [b"m0", b"m1"]
+    c.close()
+
+
+def test_kafka_input_skips_corrupt_message(broker):
+    """A corrupt-JSON message is dropped-and-logged, not conflated with
+    "caught up": receive() continues to the next pending message
+    (ADVICE r5 on replication/sub.py)."""
+    from seaweedfs_tpu.messaging.kafka_wire import KafkaClient as KC
+    c = KC(broker.host, broker.port)
+    q = KafkaQueue(broker.addr, topic="corrupt_mix")
+    q.notify(_event("/data/ok0", 1))
+    q.close()
+    c.produce("corrupt_mix", 0, None, b"{not json")
+    q2 = KafkaQueue(broker.addr, topic="corrupt_mix")
+    q2.notify(_event("/data/ok1", 2))
+    q2.close()
+    c.close()
+
+    inp = KafkaQueueInput(broker.addr, topic="corrupt_mix")
+    got = [e.new_entry.full_path for e in iter_queue(inp, idle_timeout=0.2)]
+    # both valid events arrive despite the corrupt one between them
+    assert got == ["/data/ok0", "/data/ok1"]
+    inp.close()

@@ -52,14 +52,16 @@ def test_client_vid_cache_fed_by_push(cluster):
     fid = c.client.upload(b"push-proto-2")
     vid = int(fid.split(",")[0])
 
-    from seaweedfs_tpu.client import Client, _PUSHED
+    from seaweedfs_tpu.client import Client
     cl = Client(c.master_url)
     cl.watch_start()
     deadline = time.time() + 5
     while time.time() < deadline and vid not in cl._vid_cache:
         time.sleep(0.05)
     assert vid in cl._vid_cache
-    assert cl._vid_cache[vid][1] == _PUSHED
+    # pushed entries are pinned: authoritative until the stream says
+    # otherwise, never TTL-expired
+    assert cl._vid_cache.is_pinned(vid)
 
     # reads are served from the pushed cache without any /dir/lookup —
     # make master GETs explode to prove it
@@ -83,7 +85,7 @@ def test_dead_node_pushes_deletions(cluster):
     deadline = time.time() + 5
     while time.time() < deadline and vid not in cl._vid_cache:
         time.sleep(0.05)
-    holder = cl._vid_cache[vid][0][0]
+    holder = (cl._vid_cache.get(vid) or [])[0]
 
     idx = next(i for i, vs in enumerate(c.volume_servers)
                if vs.url == holder)
@@ -91,7 +93,7 @@ def test_dead_node_pushes_deletions(cluster):
     # the master prunes the dead node after ~5 pulses and pushes DeletedVids
     deadline = time.time() + 10
     while time.time() < deadline and \
-            holder in cl._vid_cache.get(vid, ([], 0))[0]:
+            holder in (cl._vid_cache.get(vid) or []):
         time.sleep(0.1)
-    assert holder not in cl._vid_cache.get(vid, ([], 0))[0]
+    assert holder not in (cl._vid_cache.get(vid) or [])
     cl.watch_stop()

@@ -312,3 +312,15 @@ def test_presigned_expires_bounds(s3_iam):
             urllib.request.urlopen(url, timeout=60)
         assert e.value.code == 400
         assert b"AuthorizationQueryParametersError" in e.value.read()
+
+
+def test_v2_resource_list_matches_reference():
+    """The V2 sub-resource whitelist pins the reference's
+    (auth_signature_v2.go): no 'tagging', strictly alphabetical so the
+    canonical resource is deterministic (ADVICE r5)."""
+    assert "tagging" not in sigv2.RESOURCE_LIST
+    assert list(sigv2.RESOURCE_LIST) == sorted(sigv2.RESOURCE_LIST)
+    # a ?tagging request still signs/verifies consistently — the
+    # subresource simply stays out of CanonicalizedResource
+    assert sigv2.canonicalized_resource(
+        "/b/k", {"tagging": "", "acl": ""}) == "/b/k?acl"

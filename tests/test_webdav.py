@@ -223,3 +223,55 @@ def test_lock_expiry():
     assert lm.holder("/x") is None  # expired
     lk2 = lm.acquire("/x", timeout=10)
     assert lk2 is not None and lk2.token != lk.token
+
+
+def test_delete_with_token_releases_lock(dav):
+    """RFC 4918 9.6: a successful DELETE destroys the resource AND its
+    locks — recreating the path must not answer 423 until lock expiry
+    (ADVICE r5)."""
+    _req(f"{dav}/relock/f.txt", "PUT", b"v1").close()
+    with _req(f"{dav}/relock/f.txt", "LOCK", _LOCKINFO,
+              {"Timeout": "Second-600"}) as r:
+        token = r.headers["Lock-Token"].strip("<>")
+    with _req(f"{dav}/relock/f.txt", "DELETE", None,
+              {"If": f"(<{token}>)"}) as r:
+        assert r.status == 204
+    # the path is free again: PUT without any token succeeds
+    with _req(f"{dav}/relock/f.txt", "PUT", b"v2") as r:
+        assert r.status == 201
+
+
+def test_move_with_token_releases_source_subtree_locks(dav):
+    """MOVE with the valid token: locks on the source subtree die with
+    the source (they do not follow the resource, RFC 4918 7.5)."""
+    _req(f"{dav}/mvlock/dir/child.txt", "PUT", b"c").close()
+    with _req(f"{dav}/mvlock/dir/child.txt", "LOCK", _LOCKINFO,
+              {"Timeout": "Second-600"}) as r:
+        token = r.headers["Lock-Token"].strip("<>")
+    with _req(f"{dav}/mvlock/dir", "MOVE", None,
+              {"Destination": f"http://{dav}/mvlock/moved",
+               "If": f"(<{token}>)"}) as r:
+        assert r.status in (201, 204)
+    # neither the old nor the new path is still lock-blocked
+    with _req(f"{dav}/mvlock/dir/child.txt", "PUT", b"new") as r:
+        assert r.status == 201
+    with _req(f"{dav}/mvlock/moved/child.txt", "PUT", b"overwrite") as r:
+        assert r.status == 201
+
+
+def test_move_overwrite_releases_destination_locks(dav):
+    """MOVE with Overwrite performs an implicit DELETE of the
+    destination (RFC 4918 9.9.4): locks on the overwritten destination
+    die with it and must not 423-block the new resource."""
+    _req(f"{dav}/ovw/src.txt", "PUT", b"s").close()
+    _req(f"{dav}/ovw/dst.txt", "PUT", b"d").close()
+    with _req(f"{dav}/ovw/dst.txt", "LOCK", _LOCKINFO,
+              {"Timeout": "Second-600"}) as r:
+        token = r.headers["Lock-Token"].strip("<>")
+    with _req(f"{dav}/ovw/src.txt", "MOVE", None,
+              {"Destination": f"http://{dav}/ovw/dst.txt",
+               "If": f"(<{token}>)"}) as r:
+        assert r.status == 204
+    # the old destination's lock died with the overwritten resource
+    with _req(f"{dav}/ovw/dst.txt", "PUT", b"unblocked") as r:
+        assert r.status == 201

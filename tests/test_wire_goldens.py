@@ -68,19 +68,20 @@ def test_azure_shared_key_signature_pinned():
 
 def test_sigv2_signature_pinned():
     sts = sigv2.string_to_sign(
-        "GET", "/bucket/key.txt", {"tagging": "", "other": "x"},
+        "GET", "/bucket/key.txt", {"acl": "", "tagging": "", "other": "x"},
         {"Date": "Thu, 01 Jan 2026 00:00:00 GMT",
          "Content-Type": "text/plain",
          "x-amz-meta-b": "two",
          "x-amz-meta-a": "one"})
-    # sub-resource whitelist keeps ?tagging, drops ?other; amz headers
-    # sorted; Date in its slot
+    # sub-resource whitelist keeps ?acl, drops ?other AND ?tagging (the
+    # reference's V2 list has no tagging); amz headers sorted; Date in
+    # its slot
     assert sts == ("GET\n\ntext/plain\n"
                    "Thu, 01 Jan 2026 00:00:00 GMT\n"
                    "x-amz-meta-a:one\nx-amz-meta-b:two\n"
-                   "/bucket/key.txt?tagging")
+                   "/bucket/key.txt?acl")
     assert sigv2.signature("secret", sts) == \
-        "yKpg9RfyXyUgu1EdisVeS01wEZ0="
+        "2K8vtWqjUddAg0zZMIQ1P8pxHgo="
     # x-amz-date empties the Date slot (the amz header wins)
     sts2 = sigv2.string_to_sign(
         "GET", "/b/k", {}, {"Date": "Thu, 01 Jan 2026 00:00:00 GMT",
