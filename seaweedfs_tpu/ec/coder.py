@@ -15,6 +15,7 @@ is purely a placement/performance decision.
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Optional, Sequence
 
 import numpy as np
@@ -205,6 +206,26 @@ def _fused_digest(encode_fn):
     return fn
 
 
+def _rec_window_cap() -> int:
+    """Max batches per RECONSTRUCTION window executable
+    (WEED_EC_REC_WINDOW_BATCHES, default 8). The rec-window compile+load
+    measured 140-540+s through the tunneled dev link and wedged the whole
+    bench phase (BENCH_r05 rebuild_p50_s: null); capping the window bounds
+    the program size, and with the shared dynamic-matrix executable a cap
+    >= the encode window's batch count means rebuild compiles NOTHING new.
+    """
+    try:
+        cap = int(os.environ.get("WEED_EC_REC_WINDOW_BATCHES", "8"))
+    except ValueError:
+        return 8
+    return cap if cap > 0 else 8
+
+
+def _chunks(seq: Sequence, cap: int):
+    for i in range(0, len(seq), cap):
+        yield seq[i:i + cap]
+
+
 def _fused_digest_multi(apply_fn):
     """jit((acc, *batches) -> acc + sum of per-batch row digests): ONE
     executable covers a whole staged window, so a remote/tunneled backend
@@ -223,6 +244,42 @@ def _fused_digest_multi(apply_fn):
         return acc
 
     return fn
+
+
+def _fused_digest_multi_dyn():
+    """One executable, ANY coefficient matrix: fn(acc, w, *batches)
+    applies the expanded binary matrix w (rs_jax.gf_apply_bitplane_dyn)
+    to every batch and folds the per-row uint32 byte sums into acc.
+
+    Compiled once per (n_batches, batch shape) — the encode window and
+    every reconstruction window share the program (the zero-padded rec
+    matrix rides in as data), so a rebuild in a process (or persistent
+    compilation cache) that has encoded never compiles anything."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def fn(acc, w, *batches):
+        for b in batches:
+            rows = rs_jax.gf_apply_bitplane_dyn(w, b)
+            acc = acc + jnp.sum(rows.astype(jnp.uint32), axis=1,
+                                dtype=jnp.uint32)
+        return acc
+
+    return fn
+
+
+def _aot_compile_window_dyn(m_rows: int, k: int, n_batches: int,
+                            shape: tuple):
+    """AOT-compile the dynamic-matrix window executable (abstract shapes
+    only — no bytes move, nothing executes). compiled(acc, w, *batches)."""
+    import jax
+    import jax.numpy as jnp
+    jfn = _fused_digest_multi_dyn()
+    sds = jax.ShapeDtypeStruct(tuple(shape), jnp.uint8)
+    w_sds = jax.ShapeDtypeStruct((m_rows * 8, k * 8), jnp.int8)
+    acc_sds = jax.ShapeDtypeStruct((m_rows,), jnp.uint32)
+    return jfn.lower(acc_sds, w_sds, *([sds] * n_batches)).compile()
 
 
 def _jax_stage(data: np.ndarray):
@@ -293,40 +350,119 @@ class JaxCoder(ErasureCoder):
             cache = self._window_cache = {}
         return cache
 
+    # --- dynamic-matrix window path (bitplane method) ---
+    # The window executable takes the expanded binary matrix as DATA, so
+    # encode and every reconstruction share one program per
+    # (n_batches, shape): warming the encode window warms every rebuild.
+
+    def _dyn_w(self, key, build):
+        cache = getattr(self, "_dyn_mats", None)
+        if cache is None:
+            cache = self._dyn_mats = {}
+        w = cache.get(key)
+        if w is None:
+            import jax.numpy as jnp
+            w = cache[key] = jnp.asarray(rs_jax.bitplane_matrix(build()))
+        return w
+
+    def _dyn_w_enc(self):
+        return self._dyn_w(
+            "enc", lambda: gf256.parity_matrix(self.k, self.m))
+
+    def _dyn_w_rec(self, present: tuple, missing: tuple):
+        def build() -> np.ndarray:
+            rec = gf256.reconstruction_matrix(self.k, self.m, present,
+                                              missing)
+            if rec.shape[0] < self.m:
+                # zero rows reconstruct zeros (digest 0): padding to the
+                # parity matrix's shape is what lets the rec window reuse
+                # the encode executable; callers slice the pad rows off
+                rec = np.vstack([
+                    rec, np.zeros((self.m - rec.shape[0], self.k),
+                                  dtype=rec.dtype)])
+            return rec
+        return self._dyn_w(("rec", present, missing), build)
+
+    def _dyn_window_fn(self, n_batches: int, shape: tuple):
+        cache = self._wcache()
+        key = ("dynw", n_batches, tuple(shape))
+        fn = cache.get(key)
+        if fn is None:
+            fn = cache[key] = _fused_digest_multi_dyn()
+        return fn
+
     def encode_digest_window_async(self, staged, acc=None):
         import jax.numpy as jnp
+        if acc is None:
+            acc = jnp.zeros(self.m, dtype=jnp.uint32)
+        if self.method == "bitplane":
+            fn = self._dyn_window_fn(len(staged), staged[0].shape)
+            return fn(acc, self._dyn_w_enc(), *staged)
         cache = self._wcache()
         key = ("enc", len(staged), tuple(staged[0].shape))
         fn = cache.get(key)
         if fn is None:
             fn = cache[key] = _fused_digest_multi(self._encode_fn())
-        if acc is None:
-            acc = jnp.zeros(self.m, dtype=jnp.uint32)
         return fn(acc, *staged)
 
     def rec_digest_window_async(self, present, missing, staged, acc=None):
         import jax.numpy as jnp
-        cache = self._wcache()
-        key = ("rec", present, missing, len(staged),
-               tuple(staged[0].shape))
-        fn = cache.get(key)
-        if fn is None:
-            fn = cache[key] = _fused_digest_multi(
-                self._rec_apply(present, missing))
+        present, missing = tuple(present), tuple(missing)
+        cap = _rec_window_cap()
+        if self.method == "bitplane":
+            n_missing = len(missing)
+            if acc is None:
+                full = jnp.zeros(self.m, dtype=jnp.uint32)
+            elif n_missing == self.m:
+                full = jnp.asarray(acc, dtype=jnp.uint32)
+            else:
+                full = jnp.pad(jnp.asarray(acc, dtype=jnp.uint32),
+                               (0, self.m - n_missing))
+            w = self._dyn_w_rec(present, missing)
+            for chunk in _chunks(list(staged), cap):
+                fn = self._dyn_window_fn(len(chunk), chunk[0].shape)
+                full = fn(full, w, *chunk)
+            return full if n_missing == self.m else full[:n_missing]
         if acc is None:
             acc = jnp.zeros(len(missing), dtype=jnp.uint32)
-        return fn(acc, *staged)
+        cache = self._wcache()
+        for chunk in _chunks(list(staged), cap):
+            key = ("rec", present, missing, len(chunk),
+                   tuple(chunk[0].shape))
+            fn = cache.get(key)
+            if fn is None:
+                fn = cache[key] = _fused_digest_multi(
+                    self._rec_apply(present, missing))
+            acc = fn(acc, *chunk)
+        return acc
 
     def warm_encode_digest_window(self, n_batches, shape):
+        if self.method == "bitplane":
+            key = ("dynw", n_batches, tuple(shape))
+            self._wcache()[key] = _aot_compile_window_dyn(
+                self.m, self.k, n_batches, shape)
+            return
         key = ("enc", n_batches, tuple(shape))
         self._wcache()[key] = _aot_compile_window(
             self._encode_fn(), self.m, n_batches, shape)
 
     def warm_rec_digest_window(self, present, missing, n_batches, shape):
-        key = ("rec", present, missing, n_batches, tuple(shape))
-        self._wcache()[key] = _aot_compile_window(
-            self._rec_apply(present, missing), len(missing), n_batches,
-            shape)
+        cap = _rec_window_cap()
+        sizes = {min(cap, n_batches)}
+        if n_batches > cap and n_batches % cap:
+            sizes.add(n_batches % cap)
+        if self.method == "bitplane":
+            for n in sizes:
+                key = ("dynw", n, tuple(shape))
+                if key not in self._wcache():
+                    self._wcache()[key] = _aot_compile_window_dyn(
+                        self.m, self.k, n, shape)
+            return
+        present, missing = tuple(present), tuple(missing)
+        for n in sizes:
+            key = ("rec", present, missing, n, tuple(shape))
+            self._wcache()[key] = _aot_compile_window(
+                self._rec_apply(present, missing), len(missing), n, shape)
 
 
 class PallasCoder(ErasureCoder):
@@ -445,17 +581,22 @@ class PallasCoder(ErasureCoder):
         import jax.numpy as jnp
         if acc is None:
             acc = jnp.zeros(len(missing), dtype=jnp.uint32)
-        while True:
-            try:
-                key = ("rec", self._tile, present, missing,
-                       len(staged), tuple(staged[0].shape))
-                fn = self._digest_cache.get(key)
-                if fn is None:
-                    fn = self._digest_cache[key] = _fused_digest_multi(
-                        self._rec_apply(present, missing))
-                return fn(acc, *staged)
-            except Exception:
-                self._shrink_tile()
+        # capped like the Jax path: a bounded rec program per chunk
+        # instead of one giant window executable (see _rec_window_cap)
+        for chunk in _chunks(list(staged), _rec_window_cap()):
+            while True:
+                try:
+                    key = ("rec", self._tile, present, missing,
+                           len(chunk), tuple(chunk[0].shape))
+                    fn = self._digest_cache.get(key)
+                    if fn is None:
+                        fn = self._digest_cache[key] = _fused_digest_multi(
+                            self._rec_apply(present, missing))
+                    acc = fn(acc, *chunk)
+                    break
+                except Exception:
+                    self._shrink_tile()
+        return acc
 
     def warm_encode_digest_window(self, n_batches, shape):
         key = ("enc", self._tile, n_batches, tuple(shape))

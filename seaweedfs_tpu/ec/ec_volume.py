@@ -40,19 +40,43 @@ ShardReader = Callable[[int, int, int], Optional[bytes]]
 
 
 class EcShard:
-    """One local .ecNN file (EcVolumeShard, ec_shard.go:16-95)."""
+    """One local .ecNN file (EcVolumeShard, ec_shard.go:16-95).
+
+    Reads come off a shared read-only mmap when available (one page-cache
+    copy, no syscall per interval — the serving-path twin of the encode
+    feed in ec/feed.py, same WEED_EC_MMAP switch); os.pread is the
+    fallback and the out-of-bounds path."""
 
     def __init__(self, base_file_name: str, shard_id: int):
         self.shard_id = shard_id
         self.path = base_file_name + to_ext(shard_id)
         self._f = open(self.path, "rb")
         self.size = os.path.getsize(self.path)
+        self._mm = None
+        from .feed import use_mmap_default
+        if self.size and use_mmap_default():
+            import mmap as mmap_mod
+            try:
+                self._mm = mmap_mod.mmap(self._f.fileno(), self.size,
+                                         mmap_mod.MAP_SHARED,
+                                         mmap_mod.PROT_READ)
+            except (OSError, ValueError):
+                self._mm = None
 
     def read_at(self, offset: int, size: int) -> bytes:
-        # positioned read: no shared seek state, safe under concurrency
+        if self._mm is not None and 0 <= offset and offset + size <= self.size:
+            return self._mm[offset:offset + size]
+        # positioned read: no shared seek state, safe under concurrency;
+        # short reads past EOF keep the reference semantics
         return os.pread(self._f.fileno(), size, offset)
 
     def close(self) -> None:
+        if self._mm is not None:
+            try:
+                self._mm.close()
+            except BufferError:
+                pass
+            self._mm = None
         self._f.close()
 
 

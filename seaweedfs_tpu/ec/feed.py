@@ -1,0 +1,396 @@
+"""Zero-copy host feed for the EC pipeline (ec/pipeline.py).
+
+BENCH_r05 pinned the encode pipeline at 0.72 GB/s with
+``healthy_link_binding_stage: "disk_read (1-core host feed)"`` while the
+window executable ran at 30-40 GB/s: the chip is starved by a host feed
+that assembles every [k, B] batch through os.pread -> bytes object ->
+np.frombuffer -> copy-into-aggregate — two full memcpys plus a heap
+allocation per byte fed, all on one core. This module deletes that work:
+
+- ``MmapFeed`` maps the source file once and exposes it as a numpy view
+  over the page cache. A batch whose k rows sit at one uniform stride is
+  yielded as an ``as_strided`` view: ZERO host copies (``device_put`` or
+  the CPU coder gathers straight from the page cache). Aggregated batches
+  (small-block rows) are assembled with one vectorized 2-D copy per
+  contiguous k-row file run into a reusable staging buffer — one memcpy,
+  no syscalls, no bytes objects.
+- ``PreadvFeed`` is the fallback when mmap is unavailable (or forced via
+  ``WEED_EC_MMAP=0``): ``os.preadv`` scatters each contiguous k-row file
+  run straight into the staging-buffer rows — one syscall per run and no
+  intermediate bytes objects (the classic pread path allocates and copies
+  one bytes per row per batch).
+- ``ShardFeed`` is the same idea for the rebuild path's k survivor shard
+  files (one source file per row instead of one strided file).
+
+Staging buffers come from a bounded ``BufferPool`` so the pipeline
+double-buffers: batch N+1 assembles while batch N's device_put + kernel
+are in flight, and memory stays at pool_size * k * batch bytes no matter
+how long the volume is. The pipeline recycles a buffer once its batch is
+fully consumed (parity materialized AND every shard row written). Feeds
+with ``pooled=False`` hand out fresh buffers and recycling is a no-op —
+the device-sink bench paths use that mode because a whole window of
+batches stays referenced until its single dispatch.
+
+Assembly runs single-threaded in the pipeline's reader thread (the old
+path fanned k preads over a thread pool). That trades copy parallelism
+for half — often all — of the copies; on the one-core hosts where the
+feed binds, fewer copies is strictly faster, and on multi-core hosts the
+reader thread still overlaps assembly with dispatch/compute.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import queue
+import threading
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+# Segment = (k file offsets, width); produced by striping.stripe_segments
+Segment = "tuple[list[int], int]"
+
+
+def use_mmap_default() -> bool:
+    """WEED_EC_MMAP=0 forces the preadv fallback (e.g. filesystems where
+    mmap faults are slower than reads, or for A/B measurement)."""
+    return os.environ.get("WEED_EC_MMAP", "1") not in ("0", "false", "no")
+
+
+class BufferPool:
+    """Bounded free-list of [k, width] uint8 staging buffers.
+
+    ``pooled=False`` turns the pool into an allocator: acquire returns a
+    fresh buffer, release is a no-op (for consumers that hold many
+    batches at once, e.g. a whole staged window).
+    """
+
+    def __init__(self, k: int, width: int, count: int, pooled: bool = True):
+        self.shape = (k, width)
+        self.pooled = pooled
+        self._closed = threading.Event()
+        self._q: queue.Queue = queue.Queue()
+        if pooled:
+            for _ in range(max(count, 2)):
+                self._q.put(np.empty(self.shape, dtype=np.uint8))
+
+    def acquire(self) -> np.ndarray:
+        if not self.pooled:
+            return np.empty(self.shape, dtype=np.uint8)
+        # poll with a timeout so a consumer that stops recycling (error
+        # paths) can never wedge the reader thread: close() unblocks us
+        while True:
+            if self._closed.is_set():
+                raise RuntimeError("feed closed while awaiting a buffer")
+            try:
+                return self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+
+    def release(self, buf: np.ndarray) -> None:
+        if self.pooled:
+            self._q.put(buf)
+
+    def close(self) -> None:
+        self._closed.set()
+
+
+class _FeedBase:
+    """Common assembly bookkeeping: lent-buffer tracking + recycling."""
+
+    def __init__(self, k: int, width: int, pool_buffers: int, pooled: bool):
+        self.k = k
+        self.width = width
+        self.pool = BufferPool(k, width, pool_buffers, pooled)
+        self._lent: dict[int, np.ndarray] = {}
+        self._lent_lock = threading.Lock()
+
+    def _lend(self, buf: np.ndarray, out: np.ndarray) -> np.ndarray:
+        """Register `out` (a view of pool buffer `buf`) as lent."""
+        if self.pool.pooled:
+            with self._lent_lock:
+                self._lent[id(out)] = buf
+        return out
+
+    def recycle(self, batch: np.ndarray) -> None:
+        """Return a batch's staging buffer to the pool. No-op for
+        zero-copy views and unpooled buffers — always safe to call."""
+        with self._lent_lock:
+            buf = self._lent.pop(id(batch), None)
+        if buf is not None:
+            self.pool.release(buf)
+
+    def _zero_copy(self, offsets: Sequence[int],
+                   w: int) -> Optional[np.ndarray]:
+        return None  # only the mmap feed can avoid the staging copy
+
+    def _fill_segment(self, buf: np.ndarray, col: int,
+                      offsets: Sequence[int], w: int) -> None:
+        raise NotImplementedError
+
+    def batches(self, segments: Iterator[Segment],
+                pad_final: bool = False) -> Iterator[np.ndarray]:
+        """Aggregate stripe segments into [k, width] batches — the same
+        column-concatenation the pipeline always used (consecutive
+        segments append to the same shard files), so batch width never
+        changes the on-disk layout. pad_final yields the last batch at
+        full width, zero-padded (window executables need one shape)."""
+        buf: Optional[np.ndarray] = None
+        col = 0
+        for offsets, w in segments:
+            if col == 0 and w == self.width:
+                zc = self._zero_copy(offsets, w)
+                if zc is not None:
+                    yield zc
+                    continue
+            if buf is None:
+                buf = self.pool.acquire()
+            if col + w > self.width:
+                yield self._lend(buf, buf[:, :col])
+                buf = self.pool.acquire()
+                col = 0
+            self._fill_segment(buf, col, offsets, w)
+            col += w
+        if buf is not None and col:
+            if col < self.width and pad_final:
+                buf[:, col:] = 0
+                yield self._lend(buf, buf)
+            else:
+                yield self._lend(buf, buf[:, :col] if col < self.width
+                                 else buf)
+
+    def close(self) -> None:
+        self.pool.close()
+
+
+class MmapFeed(_FeedBase):
+    """Page-cache-mapped stripe feed over one .dat file."""
+
+    def __init__(self, path: str, k: int, width: int,
+                 pool_buffers: int = 4, pooled: bool = True):
+        super().__init__(k, width, pool_buffers, pooled)
+        self.size = os.path.getsize(path)
+        self._fd = os.open(path, os.O_RDONLY)
+        self._mm: Optional[mmap.mmap] = None
+        self._view: Optional[np.ndarray] = None
+        if self.size:
+            try:
+                self._mm = mmap.mmap(self._fd, self.size, mmap.MAP_SHARED,
+                                     mmap.PROT_READ)
+            except (OSError, ValueError):
+                os.close(self._fd)  # open_feed falls back to PreadvFeed
+                self._fd = -1
+                raise
+            # advise sequential so readahead keeps the page cache ahead of
+            # the feed (harmless no-op where unsupported)
+            try:
+                self._mm.madvise(mmap.MADV_SEQUENTIAL)
+            except (AttributeError, OSError):
+                pass
+            self._view = np.frombuffer(self._mm, dtype=np.uint8)
+
+    def _zero_copy(self, offsets: Sequence[int], w: int
+                   ) -> Optional[np.ndarray]:
+        """[k, w] as_strided view when the segment's rows are uniformly
+        strided and fully inside the file — no bytes move at all."""
+        if self._view is None or offsets[-1] + w > self.size:
+            return None
+        if self.k == 1:
+            return self._view[offsets[0]:offsets[0] + w].reshape(1, w)
+        stride = offsets[1] - offsets[0]
+        if any(offsets[i + 1] - offsets[i] != stride
+               for i in range(self.k - 1)):
+            return None
+        return np.lib.stride_tricks.as_strided(
+            self._view[offsets[0]:], shape=(self.k, w),
+            strides=(stride, 1))
+
+    def _fill_segment(self, buf: np.ndarray, col: int,
+                      offsets: Sequence[int], w: int) -> None:
+        view, size = self._view, self.size
+        if (view is not None and len(offsets) > 1
+                and all(offsets[i + 1] - offsets[i] == w
+                        for i in range(len(offsets) - 1))
+                and offsets[0] + len(offsets) * w <= size):
+            # contiguous k-row run (small-block rows): ONE vectorized copy
+            start = offsets[0]
+            src = view[start:start + len(offsets) * w]
+            np.copyto(buf[:, col:col + w], src.reshape(len(offsets), w))
+            return
+        for i, off in enumerate(offsets):
+            n = min(w, size - off) if off < size else 0
+            if n > 0:
+                np.copyto(buf[i, col:col + n], view[off:off + n])
+            if n < w:
+                buf[i, col + n:col + w] = 0
+
+    def close(self) -> None:
+        super().close()
+        self._view = None
+        if self._mm is not None:
+            try:
+                self._mm.close()
+            except BufferError:
+                # live views (queued batches on an error path) still
+                # reference the map; the GC closes it when they die
+                pass
+            self._mm = None
+        if self._fd >= 0:
+            os.close(self._fd)
+            self._fd = -1
+
+
+def _readinto(fd: int, dest: np.ndarray, offset: int) -> int:
+    """preadv straight into a (contiguous) numpy row; loops on short
+    reads, zero-fills past EOF. Returns bytes actually read."""
+    done = 0
+    n = dest.shape[0]
+    while done < n:
+        got = os.preadv(fd, [dest[done:]], offset + done)
+        if got <= 0:
+            dest[done:] = 0
+            break
+        done += got
+    return done
+
+
+class PreadvFeed(_FeedBase):
+    """preadv-into-staging fallback (no mmap): still zero intermediate
+    bytes objects, one syscall per contiguous k-row run."""
+
+    def __init__(self, path: str, k: int, width: int,
+                 pool_buffers: int = 4, pooled: bool = True):
+        super().__init__(k, width, pool_buffers, pooled)
+        self.size = os.path.getsize(path)
+        self._fd = os.open(path, os.O_RDONLY)
+
+    def _fill_segment(self, buf: np.ndarray, col: int,
+                      offsets: Sequence[int], w: int) -> None:
+        k = len(offsets)
+        if (k > 1 and all(offsets[i + 1] - offsets[i] == w
+                          for i in range(k - 1))
+                and offsets[0] + k * w <= self.size):
+            # contiguous k-row run: one preadv scatters the whole run
+            # across the k staging rows
+            rows = [buf[i, col:col + w] for i in range(k)]
+            done = 0
+            total = k * w
+            while done < total:
+                row, sub = divmod(done, w)
+                iov = [rows[row][sub:]] + rows[row + 1:]
+                got = os.preadv(self._fd, iov, offsets[0] + done)
+                if got <= 0:
+                    break
+                done += got
+            if done < total:  # unexpected EOF: zero the remainder
+                row, sub = divmod(done, w)
+                rows[row][sub:] = 0
+                for r in rows[row + 1:]:
+                    r[:] = 0
+            return
+        for i, off in enumerate(offsets):
+            if off >= self.size:
+                buf[i, col:col + w] = 0
+            else:
+                _readinto(self._fd, buf[i, col:col + w], off)
+
+    def close(self) -> None:
+        super().close()
+        if self._fd >= 0:
+            os.close(self._fd)
+            self._fd = -1
+
+
+class ShardFeed(_FeedBase):
+    """[k, n] batches whose row i comes from survivor shard file i — the
+    rebuild-path twin of the stripe feeds. A short survivor file raises
+    IOError (a truncated shard must fail the rebuild, not feed zeros)."""
+
+    def __init__(self, paths: Sequence[str], width: int,
+                 pool_buffers: int = 4, pooled: bool = True,
+                 use_mmap: Optional[bool] = None):
+        super().__init__(len(paths), width, pool_buffers, pooled)
+        if use_mmap is None:
+            use_mmap = use_mmap_default()
+        self.shard_size = os.path.getsize(paths[0])
+        self._fds = [os.open(p, os.O_RDONLY) for p in paths]
+        self._sizes = [os.path.getsize(p) for p in paths]
+        self._paths = list(paths)
+        self._mms: list[Optional[mmap.mmap]] = [None] * self.k
+        self._views: list[Optional[np.ndarray]] = [None] * self.k
+        if use_mmap:
+            for i, fd in enumerate(self._fds):
+                if not self._sizes[i]:
+                    continue
+                try:
+                    mm = mmap.mmap(fd, self._sizes[i], mmap.MAP_SHARED,
+                                   mmap.PROT_READ)
+                except (OSError, ValueError):
+                    continue  # this file reads via preadv instead
+                try:
+                    mm.madvise(mmap.MADV_SEQUENTIAL)
+                except (AttributeError, OSError):
+                    pass
+                self._mms[i] = mm
+                self._views[i] = np.frombuffer(mm, dtype=np.uint8)
+
+    def batches(self, batch_size: int,
+                pad_final: bool = False) -> Iterator[np.ndarray]:
+        offset = 0
+        while offset < self.shard_size:
+            n = min(batch_size, self.shard_size - offset)
+            buf = self.pool.acquire()
+            for i in range(self.k):
+                if offset + n > self._sizes[i]:
+                    raise IOError(
+                        f"shard file {self._paths[i]} short read "
+                        f"{max(self._sizes[i] - offset, 0)} != {n}")
+                view = self._views[i]
+                if view is not None:
+                    np.copyto(buf[i, :n], view[offset:offset + n])
+                else:
+                    got = _readinto(self._fds[i], buf[i, :n], offset)
+                    if got != n:
+                        raise IOError(
+                            f"shard file {self._paths[i]} short read "
+                            f"{got} != {n}")
+            if n < batch_size:
+                if pad_final:
+                    buf[:, n:] = 0
+                    yield self._lend(buf, buf)
+                else:
+                    yield self._lend(buf, buf[:, :n])
+            else:
+                yield self._lend(buf, buf)
+            offset += n
+
+    def close(self) -> None:
+        super().close()
+        for i, mm in enumerate(self._mms):
+            self._views[i] = None
+            if mm is not None:
+                try:
+                    mm.close()
+                except BufferError:
+                    pass
+                self._mms[i] = None
+        for i, fd in enumerate(self._fds):
+            if fd >= 0:
+                os.close(fd)
+                self._fds[i] = -1
+
+
+def open_feed(path: str, k: int, width: int, pool_buffers: int = 4,
+              pooled: bool = True,
+              use_mmap: Optional[bool] = None) -> "_FeedBase":
+    """The stripe feed for <base>.dat: mmap when possible, preadv
+    otherwise. width must equal the pipeline batch size."""
+    if use_mmap is None:
+        use_mmap = use_mmap_default()
+    if use_mmap:
+        try:
+            return MmapFeed(path, k, width, pool_buffers, pooled)
+        except (OSError, ValueError):
+            pass  # e.g. filesystems that refuse MAP_SHARED; fall through
+    return PreadvFeed(path, k, width, pool_buffers, pooled)

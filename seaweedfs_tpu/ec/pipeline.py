@@ -9,9 +9,10 @@ and shard write-back all overlapped.
 Stages (bounded queues between them; every file gets its own writer thread so
 shard write-back parallelizes across the 14 files):
 
-  reader thread   -- os.pread the .dat at the stripe offsets into [k, B]
-                     uint8 batches (k preads fanned over a thread pool; pread
-                     releases the GIL so page-cache copies run in parallel),
+  reader thread   -- assemble [k, B] uint8 batches through the zero-copy
+                     host feed (ec/feed.py): mmap'd page-cache views where
+                     the stripe allows, pooled double-buffered staging
+                     otherwise (preadv fallback when mmap is unavailable),
                      push to a depth-bounded queue
   main thread     -- pop a batch, dispatch coder.encode_async (device_put +
                      jitted kernel; JAX dispatch is asynchronous so this
@@ -20,10 +21,14 @@ shard write-back parallelizes across the 14 files):
                      the device), then fan rows out to the per-file queues;
                      data rows go straight from the host buffer — data shards
                      never round-trip through the device
-  k+m writers     -- one thread per shard file, appending rows in order
+  k+m writers     -- one thread per shard file, coalescing queued rows into
+                     single writev appends
 
-Only parity bytes (m/k of the input) cross device->host. Layout semantics are
-identical to striping.write_ec_files: row-major two-tier striping, final batch
+Batch size and queue depths default to the adaptive governor's operating
+point (ec/governor.py), tuned from the per-stage observe spans this module
+emits; explicit arguments pin them. Only parity bytes (m/k of the input)
+cross device->host. Layout semantics are identical to
+striping.write_ec_files: row-major two-tier striping, final batch
 zero-padded and written full-length (tests assert byte-identical output
 between the two paths).
 """
@@ -34,34 +39,47 @@ import contextlib
 import os
 import queue
 import threading
-from concurrent.futures import ThreadPoolExecutor
-from typing import Iterator, Sequence
+from typing import Iterator, Optional, Sequence
 
 import numpy as np
 
 from .. import observe
+from . import feed as feed_mod
+from . import governor
 from .coder import ErasureCoder
 from .geometry import DEFAULT, Geometry, to_ext
+from .striping import stripe_segments
 
-# 8MB per shard-row batch: 80MB host buffer per in-flight batch at RS(10,4),
-# large enough to amortize dispatch, small enough for depth-4 on any host.
+# fallback operating point when the governor is bypassed (explicit args):
+# 8MB per shard-row batch = 80MB host buffer per in-flight batch at RS(10,4)
 DEFAULT_BATCH_SIZE = 8 * 1024 * 1024
 DEFAULT_DEPTH = 4
-_READ_POOL_WORKERS = 8
 
 _SENTINEL = None
 
 
-def _clamp_batch(batch_size: int, block_size: int) -> int:
-    """Largest usable buffer: divides block_size, <= batch_size."""
-    b = min(batch_size, block_size)
-    while block_size % b:
-        b -= 1
-    return b
+def _resolve_op(batch_size: Optional[int], depth: Optional[int],
+                nbytes: int, k: int) -> tuple["governor.OperatingPoint",
+                                              bool]:
+    """(operating point, governed?) — explicit args pin the plan and opt
+    the run out of the governor entirely: no retuning from this run's
+    shapes AND no export of a plan the run isn't using (tests and
+    benches must neither steer nor misreport the process-global
+    operating point)."""
+    if batch_size is None and depth is None:
+        return governor.get().plan(nbytes, k), True
+    b = batch_size if batch_size is not None else DEFAULT_BATCH_SIZE
+    d = depth if depth is not None else DEFAULT_DEPTH
+    return governor.OperatingPoint(b, d, d), False
 
 
 class _FanOut:
-    """One writer thread per output file, each with a bounded row queue."""
+    """One writer thread per output file, each with a bounded row queue;
+    writers drain their queue greedily and append every waiting row in
+    ONE os.writev call (straight from the row memory — no userspace
+    write buffer, no per-row syscall)."""
+
+    MAX_COALESCE = 16  # rows per writev: bounds latency and iov count
 
     def __init__(self, paths: Sequence[str], depth: int):
         self.queues = [queue.Queue(maxsize=depth) for _ in paths]
@@ -73,100 +91,88 @@ class _FanOut:
             th.start()
             self.threads.append(th)
 
+    @staticmethod
+    def _writev_all(fd: int, rows: list) -> None:
+        bufs = [memoryview(r) for r in rows]
+        while bufs:
+            n = os.writev(fd, bufs)
+            if n <= 0:
+                raise IOError("writev wrote nothing")
+            while bufs and n >= bufs[0].nbytes:
+                n -= bufs[0].nbytes
+                bufs.pop(0)
+            if n:
+                bufs[0] = bufs[0][n:]
+
     def _writer(self, q: queue.Queue, path: str) -> None:
+        batch: list = []
+        stop = False  # close()'s sentinel already consumed
         try:
-            with open(path, "wb", buffering=1 << 20) as f:
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+            try:
                 while True:
-                    row = q.get()
-                    if row is _SENTINEL:
+                    item = q.get()
+                    if item is _SENTINEL:
                         return
-                    f.write(row)
+                    batch = [item]
+                    while len(batch) < self.MAX_COALESCE and not q.empty():
+                        nxt = q.get_nowait()
+                        if nxt is _SENTINEL:
+                            stop = True
+                            break
+                        batch.append(nxt)
+                    self._writev_all(fd, [row for row, _ in batch])
+                    for _, cb in batch:
+                        if cb is not None:
+                            cb()
+                    batch = []
+                    if stop:
+                        return
+            finally:
+                os.close(fd)
         except BaseException as e:
             self.errors.append(e)
-            while q.get() is not _SENTINEL:  # drain; never deadlock producer
-                pass
+            # the coalesced rows already popped when the write failed
+            # still need their callbacks: each belongs to a different
+            # put_rows batch, and a skipped callback strands that
+            # batch's pooled staging buffer for the rest of the run
+            for _, cb in batch:
+                if cb is not None:
+                    cb()
+            while not stop:  # drain (unless the sentinel was already
+                item = q.get()  # swallowed mid-coalesce); never
+                if item is _SENTINEL:  # deadlock the producer
+                    return
+                _, cb = item
+                if cb is not None:
+                    cb()  # keep buffers recycling on the error path
 
-    def put_rows(self, rows: Iterator[np.ndarray]) -> None:
+    def put_rows(self, rows: Iterator[np.ndarray],
+                 on_done=None) -> None:
+        """Enqueue one batch's rows; on_done fires once after EVERY row
+        of this call has been handed to the kernel (the host batch may be
+        a pooled staging buffer that must not be reused earlier)."""
+        rows = [np.ascontiguousarray(r) for r in rows]
+        cb = None
+        if on_done is not None:
+            state = {"left": len(rows)}
+            lock = threading.Lock()
+
+            def cb() -> None:
+                with lock:
+                    state["left"] -= 1
+                    done = state["left"] == 0
+                if done:
+                    on_done()
+
         for q, row in zip(self.queues, rows):
-            q.put(np.ascontiguousarray(row))
+            q.put((row, cb))
 
     def close(self) -> None:
         for q in self.queues:
             q.put(_SENTINEL)
         for th in self.threads:
             th.join()
-
-
-def _sub_batches(dat_size: int, g: Geometry,
-                 batch_size: int) -> Iterator[tuple[list[int], int]]:
-    """(k strided offsets, width) per stripe batch, in shard-file append
-    order (row-major two-tier striping, ec_encoder.go:194-231)."""
-    def rows(start: int, block_size: int) -> Iterator[tuple[list[int], int]]:
-        b = _clamp_batch(batch_size, block_size)
-        for batch_start in range(0, block_size, b):
-            yield ([start + block_size * i + batch_start
-                    for i in range(g.data_shards)], b)
-
-    remaining = dat_size
-    processed = 0
-    # same large-row rule as striping.write_ec_files: a tail needing a full
-    # large_block worth of small rows would make the shard size ambiguous
-    # for locate; pad the final large row instead
-    while remaining > g.large_row_size - g.small_row_size:
-        yield from rows(processed, g.large_block_size)
-        remaining -= g.large_row_size
-        processed += g.large_row_size
-    while remaining > 0:
-        yield from rows(processed, g.small_block_size)
-        remaining -= g.small_row_size
-        processed += g.small_row_size
-
-
-def _encode_batches(pool: ThreadPoolExecutor, dat_fd: int, dat_size: int,
-                    g: Geometry, batch_size: int,
-                    pad_final: bool = False) -> Iterator[np.ndarray]:
-    """Yield [k, <=batch_size] aggregated batches.
-
-    Every stripe batch appends its row i to shard file i, so consecutive
-    batches concatenate along the width axis without changing the on-disk
-    layout — this is what lets small-block rows (1MB in the reference
-    geometry) still feed the chip in multi-MB dispatches.
-
-    pad_final=True yields the last batch at full width (zero-padded past
-    the final stripe row): digest sinks need every batch the same shape so
-    one window executable covers them, and zero columns encode to zero
-    parity, contributing nothing to the digest.
-    """
-    agg: np.ndarray | None = None
-    col = 0
-    jobs: list[tuple[int, int, int, int]] = []  # (row, col, width, offset)
-
-    def flush_reads() -> None:
-        def one(job: tuple[int, int, int, int]) -> None:
-            i, c, w, off = job
-            chunk = os.pread(dat_fd, w, off)
-            if chunk:
-                agg[i, c:c + len(chunk)] = np.frombuffer(chunk,
-                                                         dtype=np.uint8)
-        list(pool.map(one, jobs))
-        jobs.clear()
-
-    for offsets, w in _sub_batches(dat_size, g, batch_size):
-        if agg is None:
-            agg = np.zeros((g.data_shards, max(batch_size, w)),
-                           dtype=np.uint8)
-        if col + w > agg.shape[1]:
-            flush_reads()
-            yield agg[:, :col]
-            agg = np.zeros((g.data_shards, max(batch_size, w)),
-                           dtype=np.uint8)
-            col = 0
-        jobs.extend((i, col, w, off) for i, off in enumerate(offsets)
-                    if off < dat_size)
-        col += w
-    if agg is not None and col:
-        flush_reads()
-        yield agg if pad_final else agg[:, :col]
 
 
 def _traced_batches(batches: Iterator[np.ndarray],
@@ -195,15 +201,24 @@ def _traced_batches(batches: Iterator[np.ndarray],
 
 def _run_pipeline(batches: Iterator[np.ndarray], dispatch, consume,
                   depth: int, start_d2h: bool = True,
-                  trace_ctx: "observe.TraceCtx | None" = None) -> None:
+                  trace_ctx: "observe.TraceCtx | None" = None,
+                  recycle=None) -> None:
     """reader thread -> main dispatch -> materializer thread.
 
     consume=None runs without the materializer stage entirely (sink mode:
     dispatch chains its own on-device state and nothing blocks per
-    batch)."""
+    batch). recycle (optional) is called on batches drained without being
+    consumed on error paths, so pooled feed buffers keep circulating."""
     read_q: queue.Queue = queue.Queue(maxsize=depth)
     mat_q: queue.Queue = queue.Queue(maxsize=depth)
     errors: list[BaseException] = []
+
+    def _recycle(batch) -> None:
+        if recycle is not None:
+            try:
+                recycle(batch)
+            except Exception:
+                pass
 
     def reader_main() -> None:
         try:
@@ -223,8 +238,11 @@ def _run_pipeline(batches: Iterator[np.ndarray], dispatch, consume,
                 consume(*item)
         except BaseException as e:
             errors.append(e)
-            while mat_q.get() is not _SENTINEL:
-                pass
+            while True:
+                item = mat_q.get()
+                if item is _SENTINEL:
+                    return
+                _recycle(item[0])
 
     reader = threading.Thread(target=reader_main, daemon=True)
     mat = None
@@ -267,8 +285,11 @@ def _run_pipeline(batches: Iterator[np.ndarray], dispatch, consume,
             mat_q.put(_SENTINEL)
         # drain read_q so a reader blocked on a full queue can finish
         # (otherwise a dispatch() exception would deadlock reader.join())
-        while not drained and read_q.get() is not _SENTINEL:
-            pass
+        while not drained:
+            item = read_q.get()
+            if item is _SENTINEL:
+                break
+            _recycle(item)
         reader.join()
         if mat is not None:
             mat.join()
@@ -278,19 +299,23 @@ def _run_pipeline(batches: Iterator[np.ndarray], dispatch, consume,
 
 def stream_encode(base_file_name: str, coder: ErasureCoder,
                   geometry: Geometry = DEFAULT,
-                  batch_size: int = DEFAULT_BATCH_SIZE,
-                  depth: int = DEFAULT_DEPTH) -> None:
+                  batch_size: Optional[int] = None,
+                  depth: Optional[int] = None) -> None:
     """Encode <base>.dat into shard files with the overlapped pipeline.
 
     Byte-identical output to striping.write_ec_files (WriteEcFiles,
-    ec_encoder.go:57) — only the schedule differs.
+    ec_encoder.go:57) — only the schedule differs. batch_size/depth
+    default to the adaptive governor's operating point; passing them
+    explicitly pins the schedule and skips retuning.
     """
     g = geometry
     assert coder.k == g.data_shards and coder.m == g.parity_shards
     dat_size = os.path.getsize(base_file_name + ".dat")
-    dat_fd = os.open(base_file_name + ".dat", os.O_RDONLY)
+    op, governed = _resolve_op(batch_size, depth, dat_size, g.data_shards)
+    src = feed_mod.open_feed(base_file_name + ".dat", g.data_shards,
+                             op.batch_size, pool_buffers=op.depth + 2)
     fan = _FanOut([base_file_name + to_ext(i) for i in range(g.total_shards)],
-                  depth)
+                  op.write_depth)
     # per-stage spans share the caller's trace (volume server passes its
     # request context into this thread via observe.run_with); a fresh
     # root is minted when none is active (CLI/bench encodes)
@@ -302,22 +327,29 @@ def stream_encode(base_file_name: str, coder: ErasureCoder,
                 trace_annotation("ec_pipeline_kernel_wait"):
             parity = coder.materialize(handle)
         with observe.stage("ec.write", tctx):
-            fan.put_rows(iter([*data, *parity]))
+            # data rows are written straight from the host batch (a
+            # page-cache view or a pooled staging buffer); the buffer
+            # recycles only after every row has been handed off
+            fan.put_rows(iter([*data, *parity]),
+                         on_done=lambda b=data: src.recycle(b))
 
     try:
-        with ThreadPoolExecutor(max_workers=_READ_POOL_WORKERS) as pool:
-            _run_pipeline(
-                _traced_batches(
-                    _encode_batches(pool, dat_fd, dat_size, g, batch_size),
-                    tctx),
-                coder.encode_async, consume, depth, trace_ctx=tctx)
+        _run_pipeline(
+            _traced_batches(
+                src.batches(stripe_segments(dat_size, g, op.batch_size)),
+                tctx),
+            coder.encode_async, consume, op.depth, trace_ctx=tctx,
+            recycle=src.recycle)
     finally:
         fan.close()
-        os.close(dat_fd)
+        src.close()
     if fan.errors:
         raise fan.errors[0]
     from .striping import write_layout_marker
     write_layout_marker(base_file_name, dat_size)
+    if governed:
+        governor.get().finish_run(tctx.trace_id, op, dat_size,
+                                  g.data_shards)
 
 
 # staged window default: bounded so a >HBM volume streams in windows; one
@@ -455,17 +487,20 @@ def stream_encode_device_sink(base_file_name: str, coder: ErasureCoder,
     g = geometry
     assert coder.k == g.data_shards and coder.m == g.parity_shards
     dat_size = os.path.getsize(base_file_name + ".dat")
-    dat_fd = os.open(base_file_name + ".dat", os.O_RDONLY)
+    # unpooled feed: a whole window of batches stays referenced until its
+    # single dispatch, so buffers are fresh (zero-copy mmap views where
+    # the stripe allows — those reference no buffer at all)
+    src = feed_mod.open_feed(base_file_name + ".dat", g.data_shards,
+                             batch_size, pooled=False)
     t_all = time.perf_counter()
     try:
-        with ThreadPoolExecutor(max_workers=_READ_POOL_WORKERS) as pool:
-            acc = _windowed_digest_sink(
-                _encode_batches(pool, dat_fd, dat_size, g, batch_size,
-                                pad_final=True),
-                coder.encode_digest_window_async, coder.stage_async,
-                depth, window_bytes, stats)
+        acc = _windowed_digest_sink(
+            src.batches(stripe_segments(dat_size, g, batch_size),
+                        pad_final=True),
+            coder.encode_digest_window_async, coder.stage_async,
+            depth, window_bytes, stats)
     finally:
-        os.close(dat_fd)
+        src.close()
     if acc is None:
         out = np.zeros(g.parity_shards, dtype=np.uint32)
     elif not materialize:
@@ -518,41 +553,22 @@ def stream_rebuild_device_sink(base_file_name: str, coder: ErasureCoder,
         raise ValueError(
             f"need {g.data_shards} survivors, have {len(present)}")
     survivors_ids = tuple(present[:g.data_shards])
-    fds = {i: os.open(base_file_name + to_ext(i), os.O_RDONLY)
-           for i in survivors_ids}
-    shard_size = os.path.getsize(base_file_name + to_ext(survivors_ids[0]))
+    src = feed_mod.ShardFeed(
+        [base_file_name + to_ext(i) for i in survivors_ids],
+        batch_size, pooled=False)
+    shard_size = src.shard_size
     t_all = time.perf_counter()
-
-    def batches(pool: ThreadPoolExecutor) -> Iterator[np.ndarray]:
-        offset = 0
-        while offset < shard_size:
-            n = min(batch_size, shard_size - offset)
-
-            def one(i: int, off: int = offset, ln: int = n) -> np.ndarray:
-                chunk = os.pread(fds[i], ln, off)
-                if len(chunk) != ln:
-                    raise IOError(
-                        f"shard {i} short read {len(chunk)} != {ln}")
-                return np.frombuffer(chunk, dtype=np.uint8)
-
-            rows = list(pool.map(one, survivors_ids))
-            if n < batch_size:  # pad final batch: zero columns digest to 0
-                rows = [np.pad(r, (0, batch_size - n)) for r in rows]
-            yield np.stack(rows)
-            offset += n
 
     def dispatch_window(staged, acc):
         return coder.rec_digest_window_async(survivors_ids, victims,
                                              staged, acc)
 
     try:
-        with ThreadPoolExecutor(max_workers=_READ_POOL_WORKERS) as pool:
-            acc = _windowed_digest_sink(batches(pool), dispatch_window,
-                                        coder.stage_async, depth,
-                                        window_bytes, stats)
+        acc = _windowed_digest_sink(
+            src.batches(batch_size, pad_final=True), dispatch_window,
+            coder.stage_async, depth, window_bytes, stats)
     finally:
-        for fd in fds.values():
-            os.close(fd)
+        src.close()
     if acc is None:
         out = np.zeros(len(victims), dtype=np.uint32)
     elif not materialize:
@@ -603,12 +619,13 @@ def parity_file_digest(base_file_name: str,
 
 def stream_rebuild(base_file_name: str, coder: ErasureCoder,
                    geometry: Geometry = DEFAULT,
-                   batch_size: int = DEFAULT_BATCH_SIZE,
-                   depth: int = DEFAULT_DEPTH) -> list[int]:
+                   batch_size: Optional[int] = None,
+                   depth: Optional[int] = None) -> list[int]:
     """Regenerate missing shard files from k survivors, overlapped
     (RebuildEcFiles, ec_encoder.go:233-287 — but with multi-MB strides and
     read/compute/write overlap instead of synchronous 1MB loops).
-    Returns the rebuilt shard ids.
+    Returns the rebuilt shard ids. Runs on the same zero-copy feed and
+    governed operating point as stream_encode.
     """
     g = geometry
     present = [i for i in range(g.total_shards)
@@ -622,44 +639,39 @@ def stream_rebuild(base_file_name: str, coder: ErasureCoder,
     survivors_ids = tuple(present[:g.data_shards])
     fn = coder.rec_apply_async(survivors_ids, tuple(missing))
 
-    fds = {i: os.open(base_file_name + to_ext(i), os.O_RDONLY)
-           for i in survivors_ids}
     shard_size = os.path.getsize(base_file_name + to_ext(survivors_ids[0]))
-    fan = _FanOut([base_file_name + to_ext(i) for i in missing], depth)
+    op, governed = _resolve_op(batch_size, depth,
+                               g.data_shards * shard_size, g.data_shards)
+    src = feed_mod.ShardFeed(
+        [base_file_name + to_ext(i) for i in survivors_ids],
+        op.batch_size, pool_buffers=op.depth + 2)
+    fan = _FanOut([base_file_name + to_ext(i) for i in missing],
+                  op.write_depth)
     tctx = observe.ensure_ctx("ec")
-
-    def batches(pool: ThreadPoolExecutor) -> Iterator[np.ndarray]:
-        offset = 0
-        while offset < shard_size:
-            n = min(batch_size, shard_size - offset)
-
-            def one(i: int, off: int = offset, ln: int = n) -> np.ndarray:
-                chunk = os.pread(fds[i], ln, off)
-                if len(chunk) != ln:
-                    raise IOError(
-                        f"shard {i} short read {len(chunk)} != {ln}")
-                return np.frombuffer(chunk, dtype=np.uint8)
-
-            rows = list(pool.map(one, survivors_ids))
-            yield np.stack(rows)
-            offset += n
 
     def consume(survivors: np.ndarray, handle) -> None:
         from ..utils.profiling import trace_annotation
         with observe.stage("ec.kernel", tctx), \
                 trace_annotation("ec_pipeline_kernel_wait"):
             rebuilt = coder.materialize(handle)
+        # the kernel has consumed the survivor batch: recycle it now —
+        # the rebuilt rows fanned out below are device-materialized
+        # arrays, not views of the staging buffer
+        src.recycle(survivors)
         with observe.stage("ec.write", tctx):
             fan.put_rows(iter(rebuilt))
 
     try:
-        with ThreadPoolExecutor(max_workers=_READ_POOL_WORKERS) as pool:
-            _run_pipeline(_traced_batches(batches(pool), tctx), fn,
-                          consume, depth, trace_ctx=tctx)
+        _run_pipeline(
+            _traced_batches(src.batches(op.batch_size), tctx), fn,
+            consume, op.depth, trace_ctx=tctx, recycle=src.recycle)
     finally:
         fan.close()
-        for fd in fds.values():
-            os.close(fd)
+        src.close()
     if fan.errors:
         raise fan.errors[0]
+    if governed:
+        governor.get().finish_run(tctx.trace_id, op,
+                                  g.data_shards * shard_size,
+                                  g.data_shards)
     return missing

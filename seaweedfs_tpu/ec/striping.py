@@ -33,6 +33,46 @@ from .geometry import DEFAULT, Geometry, to_ext
 DEFAULT_BUFFER_SIZE = 256 * 1024
 
 
+def clamp_batch(batch_size: int, block_size: int) -> int:
+    """Largest usable stripe-batch width: divides block_size, <= batch_size."""
+    b = min(batch_size, block_size)
+    while block_size % b:
+        b -= 1
+    return b
+
+
+def stripe_segments(dat_size: int, g: Geometry,
+                    batch_size: int) -> Iterator[tuple[list[int], int]]:
+    """(k strided .dat offsets, width) per stripe batch, in shard-file
+    append order (row-major two-tier striping, ec_encoder.go:194-231).
+
+    This is THE layout iteration — write_ec_files' row loop, the streaming
+    pipeline and the zero-copy feed (ec/feed.py) all derive shard bytes
+    from these segments, which is what keeps their outputs byte-identical.
+    Offsets within one segment are uniformly strided by the block size;
+    offsets at or past dat_size read as zeros (final-row padding).
+    """
+    def rows(start: int, block_size: int) -> Iterator[tuple[list[int], int]]:
+        b = clamp_batch(batch_size, block_size)
+        for batch_start in range(0, block_size, b):
+            yield ([start + block_size * i + batch_start
+                    for i in range(g.data_shards)], b)
+
+    remaining = dat_size
+    processed = 0
+    # same large-row rule as write_ec_files: a tail needing a full
+    # large_block worth of small rows would make the shard size ambiguous
+    # for locate; pad the final large row instead
+    while remaining > g.large_row_size - g.small_row_size:
+        yield from rows(processed, g.large_block_size)
+        remaining -= g.large_row_size
+        processed += g.large_row_size
+    while remaining > 0:
+        yield from rows(processed, g.small_block_size)
+        remaining -= g.small_row_size
+        processed += g.small_row_size
+
+
 def write_sorted_ecx_from_idx(base_file_name: str, ext: str = ".ecx",
                               offset_size: int = t.OFFSET_SIZE) -> None:
     """Generate the sorted EC index from the .idx journal

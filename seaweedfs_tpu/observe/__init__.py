@@ -283,6 +283,23 @@ def reset() -> None:
         _ring.clear()
 
 
+def stage_totals(trace_id: str = "",
+                 prefix: str = "") -> dict[str, tuple[int, int]]:
+    """Aggregate completed spans by name -> (count, total_us), optionally
+    filtered by trace id and name prefix.  The EC feed governor derives
+    its per-stage time model from these — the same spans /debug/trace
+    serves, so the numbers driving auto-tuning are the ones an operator
+    can inspect."""
+    out: dict[str, tuple[int, int]] = {}
+    for s in spans(trace_id=trace_id):
+        name = s.get("name", "")
+        if prefix and not name.startswith(prefix):
+            continue
+        c, t = out.get(name, (0, 0))
+        out[name] = (c + 1, t + int(s.get("dur_us", 0)))
+    return out
+
+
 def maybe_log_slow(span_obj: Span) -> None:
     """Slow-request glog line for a request-level span (the per-process
     root); threshold WEED_TRACE_SLOW_MS."""

@@ -112,6 +112,30 @@ def gf_apply_bitplane(matrix: np.ndarray):
     return apply_fn
 
 
+def gf_apply_bitplane_dyn(w: jnp.ndarray, shards: jnp.ndarray) -> jnp.ndarray:
+    """gf_apply_bitplane with the EXPANDED binary matrix as a runtime
+    input instead of a baked constant: one compiled executable serves
+    ANY coefficient matrix of the same [R, C] shape.
+
+    This is what lets the reconstruction window reuse the encode-warmed
+    program — a rec matrix for len(missing) <= m victims zero-pads to the
+    parity matrix's [m, k] shape (zero rows produce zero output rows,
+    ec/coder.py slices them off) — instead of paying its own compile +
+    program load, the step that wedged the rebuild bench phase through
+    the tunneled dev link (BENCH_r05: rebuild_p50_s null after a 650s
+    timeout).  The bitplane contraction is already matrix-generic on the
+    MXU, so nothing is lost by not constant-folding W.
+    """
+    rows = w.shape[0] // 8
+    bits = _unpack_bits(shards)
+    acc = jax.lax.dot_general(
+        w, bits,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return _pack_bits(acc & 1, rows)
+
+
 def gf_apply_lut(matrix: np.ndarray):
     """Return a jittable fn: shards [C, n] uint8 -> [R, n] uint8 via nibble LUTs."""
     lo_np, hi_np = nibble_tables(matrix)

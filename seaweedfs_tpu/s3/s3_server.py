@@ -122,7 +122,8 @@ class S3Server:
         err = self._check_auth(request, action=auth_mod.ACTION_ADMIN)
         if err is not None:
             return err
-        return web.Response(text=self.metrics.render(),
+        return web.Response(text=(self.metrics.render()
+                          + metrics_mod.render_shared()),
                             content_type="text/plain")
 
     async def trace_handler(self, request: web.Request) -> web.Response:

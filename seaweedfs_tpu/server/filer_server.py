@@ -945,7 +945,8 @@ class FilerServer:
         return web.json_response({"ok": True}, status=202)
 
     async def metrics_handler(self, request: web.Request) -> web.Response:
-        return web.Response(text=self.metrics.render(),
+        return web.Response(text=(self.metrics.render()
+                          + metrics_mod.render_shared()),
                             content_type="text/plain")
 
     async def status_ui(self, request: web.Request) -> web.Response:

@@ -1501,7 +1501,10 @@ class VolumeServer:
         return web.json_response({"url": self.url, **self.store.status()})
 
     async def metrics_handler(self, request: web.Request) -> web.Response:
-        return web.Response(text=self.metrics.render(),
+        # shared registries carry non-server subsystems hosted in this
+        # process (the EC feed governor's operating point + stage model)
+        return web.Response(text=(self.metrics.render()
+                                  + metrics_mod.render_shared()),
                             content_type="text/plain")
 
     async def status_ui(self, request: web.Request) -> web.Response:

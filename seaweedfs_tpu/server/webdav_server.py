@@ -186,7 +186,8 @@ class WebDavServer:
             {"error": "reserved operational endpoint"}, status=405)
 
     async def metrics_handler(self, request: web.Request) -> web.Response:
-        return web.Response(text=self.metrics.render(),
+        return web.Response(text=(self.metrics.render()
+                          + metrics_mod.render_shared()),
                             content_type="text/plain")
 
     async def _on_startup(self, app) -> None:

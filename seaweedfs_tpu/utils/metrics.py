@@ -183,3 +183,35 @@ class Registry:
                     lines.append(f"{p}_{name}_seconds_count{lbl} "
                                  f"{self._hist_count[key]}")
             return "\n".join(lines) + "\n"
+
+    def is_empty(self) -> bool:
+        with self._lock:
+            return not (self._counters or self._gauges or self._hist)
+
+
+# --- process-wide shared registries ---
+# Subsystems that are not servers (the EC feed governor, background
+# maintenance) publish through whichever server process hosts them: they
+# register here and every server's /metrics handler appends
+# render_shared() to its own registry's exposition text. Family names
+# can't collide across registries because each subsystem gets its own
+# seaweedfs_tpu_<subsystem>_ prefix.
+
+_shared: dict[str, "Registry"] = {}
+_shared_lock = threading.Lock()
+
+
+def shared(subsystem: str) -> "Registry":
+    """The process-wide registry for `subsystem` (created on first use)."""
+    with _shared_lock:
+        reg = _shared.get(subsystem)
+        if reg is None:
+            reg = _shared[subsystem] = Registry(subsystem)
+        return reg
+
+
+def render_shared() -> str:
+    """Exposition text of every non-empty shared registry, stable order."""
+    with _shared_lock:
+        regs = [_shared[name] for name in sorted(_shared)]
+    return "".join(r.render() for r in regs if not r.is_empty())

@@ -1,0 +1,133 @@
+"""Tier-1 static guard: no blocking os.fsync/time.sleep directly inside
+``async def`` bodies in the server layer or the EC pipeline.
+
+A single synchronous fsync (milliseconds to seconds on a busy disk) or
+time.sleep inside a coroutine stalls the whole event loop — every
+in-flight request on that server. Blocking calls belong in executors
+(run_in_executor) or threads; this walker fails the build the moment one
+sneaks into an async body, so feed-path work can't silently regress the
+serving planes.
+
+Scope: every module under seaweedfs_tpu/server/ plus ec/pipeline.py.
+Nested *synchronous* defs/lambdas inside a coroutine are exempt — that
+is exactly the run_in_executor pattern (the sync fn runs off-loop).
+"""
+
+import ast
+import os
+
+import seaweedfs_tpu
+
+PKG_ROOT = os.path.dirname(seaweedfs_tpu.__file__)
+
+BLOCKING = {("os", "fsync"), ("time", "sleep")}
+
+
+def _guarded_files():
+    server_dir = os.path.join(PKG_ROOT, "server")
+    for name in sorted(os.listdir(server_dir)):
+        if name.endswith(".py"):
+            yield os.path.join(server_dir, name)
+    yield os.path.join(PKG_ROOT, "ec", "pipeline.py")
+
+
+def _alias_map(tree: ast.Module) -> dict:
+    """name-in-module -> (module, attr) for the blocking calls we track,
+    covering `import os [as o]` and `from time import sleep [as s]`."""
+    mods = {m for m, _ in BLOCKING}
+    aliases: dict[str, tuple[str, str] | str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in mods:
+                    aliases[a.asname or a.name] = a.name  # module alias
+        elif isinstance(node, ast.ImportFrom):
+            if node.module in mods:
+                for a in node.names:
+                    if (node.module, a.name) in BLOCKING:
+                        aliases[a.asname or a.name] = (node.module, a.name)
+    return aliases
+
+
+def _resolve_call(node: ast.Call, aliases: dict):
+    f = node.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        mod = aliases.get(f.value.id)
+        if isinstance(mod, str) and (mod, f.attr) in BLOCKING:
+            return (mod, f.attr)
+    elif isinstance(f, ast.Name):
+        target = aliases.get(f.id)
+        if isinstance(target, tuple):
+            return target
+    return None
+
+
+def _async_body_calls(fn: ast.AsyncFunctionDef):
+    """Every node lexically inside the coroutine, NOT descending into
+    nested function definitions (sync nested defs are executor bodies;
+    nested async defs are visited as their own AsyncFunctionDef)."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def test_no_blocking_calls_in_async_bodies():
+    violations = []
+    for path in _guarded_files():
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        aliases = _alias_map(tree)
+        if not aliases:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            for call in _async_body_calls(node):
+                hit = _resolve_call(call, aliases)
+                if hit is not None:
+                    rel = os.path.relpath(path, PKG_ROOT)
+                    violations.append(
+                        f"{rel}:{call.lineno} async def {node.name} calls "
+                        f"{hit[0]}.{hit[1]}() on the event loop — use "
+                        "run_in_executor")
+    assert not violations, "\n".join(violations)
+
+
+def test_guard_walker_catches_violations():
+    """The walker itself must detect the patterns it guards against —
+    direct calls, aliased modules and from-imports — and must NOT flag
+    executor-style nested sync defs."""
+    src = (
+        "import os\n"
+        "import time as t\n"
+        "from time import sleep as zzz\n"
+        "async def bad1(fd):\n"
+        "    os.fsync(fd)\n"
+        "async def bad2():\n"
+        "    t.sleep(1)\n"
+        "async def bad3():\n"
+        "    zzz(2)\n"
+        "async def good(loop, fd):\n"
+        "    def _sync():\n"
+        "        os.fsync(fd)\n"
+        "    await loop.run_in_executor(None, _sync)\n"
+    )
+    tree = ast.parse(src)
+    aliases = _alias_map(tree)
+    hits = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AsyncFunctionDef):
+            hits[node.name] = [
+                _resolve_call(c, aliases)
+                for c in _async_body_calls(node)
+                if _resolve_call(c, aliases) is not None]
+    assert hits["bad1"] == [("os", "fsync")]
+    assert hits["bad2"] == [("time", "sleep")]
+    assert hits["bad3"] == [("time", "sleep")]
+    assert hits["good"] == []

@@ -1,0 +1,134 @@
+"""Feed governor (ec/governor.py): planning, retuning and /metrics export.
+
+The governor's contract: operating points stay inside the configured
+bounds and memory budget, retuning moves TOWARD the measured bottleneck
+(never past a bound), explicit pipeline arguments bypass retuning, and
+the chosen point + per-stage model land in the shared "ec" registry that
+servers merge into /metrics.
+"""
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu import ec, observe
+from seaweedfs_tpu.ec import governor
+from seaweedfs_tpu.ec import pipeline
+from seaweedfs_tpu.utils import metrics as metrics_mod
+
+MB = 1024 * 1024
+
+
+@pytest.fixture(autouse=True)
+def fresh_governor():
+    governor.reset()
+    yield
+    governor.reset()
+
+
+def _fake_run(gov, read_s, dispatch_s, kernel_s, write_s, n=8,
+              nbytes=100 * MB):
+    """Inject one run's worth of ec.* spans and let the governor fold
+    them (per-batch spans, like the pipeline emits)."""
+    ctx = observe.TraceCtx(observe.new_id(), "", "ec", "")
+    for name, secs in (("ec.read", read_s), ("ec.dispatch", dispatch_s),
+                       ("ec.kernel", kernel_s), ("ec.write", write_s)):
+        for _ in range(n):
+            observe.record_span(name, ctx, 0, int(secs / n * 1e6))
+    op = gov.plan(nbytes, 10)
+    gov.finish_run(ctx.trace_id, op, nbytes, 10)
+    return op
+
+
+def test_plan_respects_memory_budget(monkeypatch):
+    monkeypatch.setenv("WEED_EC_HOST_BUDGET_MB", "128")
+    monkeypatch.setenv("WEED_EC_BATCH_BYTES", str(64 * MB))
+    gov = governor.FeedGovernor()
+    op = gov.plan(1 << 30, k=10)
+    assert (op.depth + 2) * 10 * op.batch_size <= 128 * MB
+    assert op.batch_size >= gov.batch_min and op.depth >= gov.depth_min
+
+
+def test_overhead_dominated_read_grows_batch(monkeypatch):
+    monkeypatch.setenv("WEED_EC_HOST_BUDGET_MB", "4096")
+    gov = governor.FeedGovernor()
+    start = gov.plan(1 << 30, 10).batch_size
+    # read slowest overall but tiny per batch -> overhead-bound
+    _fake_run(gov, read_s=0.05, dispatch_s=0.01, kernel_s=0.01,
+              write_s=0.01, n=100)
+    assert gov.plan(1 << 30, 10).batch_size == min(start * 2,
+                                                   gov.batch_max)
+
+
+def test_kernel_bound_deepens_queue():
+    gov = governor.FeedGovernor()
+    start = gov.plan(1 << 30, 10).depth
+    op = _fake_run(gov, read_s=0.1, dispatch_s=0.1, kernel_s=5.0,
+                   write_s=0.1)
+    assert gov.plan(1 << 30, 10).depth == min(start + 1, gov.depth_max)
+
+
+def test_write_bound_deepens_writer_queues():
+    gov = governor.FeedGovernor()
+    start = gov.plan(1 << 30, 10).write_depth
+    _fake_run(gov, read_s=0.1, dispatch_s=0.1, kernel_s=0.1, write_s=5.0)
+    assert gov.plan(1 << 30, 10).write_depth > start
+
+
+def test_bounds_are_hard(monkeypatch):
+    monkeypatch.setenv("WEED_EC_BATCH_MAX", str(8 * MB))
+    monkeypatch.setenv("WEED_EC_DEPTH_MAX", "4")
+    gov = governor.FeedGovernor()
+    for _ in range(10):
+        _fake_run(gov, read_s=0.05, dispatch_s=0.01, kernel_s=5.0,
+                  write_s=0.01, n=200)
+    op = gov.plan(1 << 30, 10)
+    assert op.batch_size <= 8 * MB
+    assert op.depth <= 4
+
+
+def test_disabled_governor_never_retunes(monkeypatch):
+    monkeypatch.setenv("WEED_EC_GOVERNOR", "0")
+    gov = governor.FeedGovernor()
+    before = gov.plan(1 << 30, 10)
+    _fake_run(gov, read_s=0.01, dispatch_s=0.01, kernel_s=9.0,
+              write_s=0.01, n=100)
+    assert gov.plan(1 << 30, 10) == before
+
+
+def test_operating_point_and_stages_exported_to_metrics():
+    gov = governor.FeedGovernor()
+    _fake_run(gov, read_s=0.2, dispatch_s=0.1, kernel_s=0.4, write_s=0.3)
+    text = metrics_mod.render_shared()
+    assert "seaweedfs_tpu_ec_feed_batch_bytes" in text
+    assert 'seaweedfs_tpu_ec_feed_queue_depth{queue="read"}' in text
+    assert 'seaweedfs_tpu_ec_feed_queue_depth{queue="write"}' in text
+    assert 'seaweedfs_tpu_ec_feed_stage_seconds{stage="kernel"}' in text
+    assert 'seaweedfs_tpu_ec_feed_stage_gbps{stage="read"}' in text
+
+
+def test_stream_encode_with_explicit_args_does_not_retune(tmp_path):
+    """Tests/benches pin batch_size; those runs must not steer the
+    process-global operating point."""
+    gov = governor.get()
+    before = (gov._batch, gov._depth, gov._write_depth)
+    geo = ec.Geometry(10, 4, large_block_size=10000, small_block_size=100)
+    rng = np.random.default_rng(3)
+    base = str(tmp_path / "1")
+    with open(base + ".dat", "wb") as f:
+        f.write(rng.integers(0, 256, 50_001, dtype=np.uint8).tobytes())
+    coder = ec.get_coder("numpy", 10, 4)
+    pipeline.stream_encode(base, coder, geo, batch_size=1000)
+    assert (gov._batch, gov._depth, gov._write_depth) == before
+
+
+def test_governed_stream_encode_records_a_run(tmp_path):
+    gov = governor.get()
+    geo = ec.Geometry(10, 4, large_block_size=10000, small_block_size=100)
+    rng = np.random.default_rng(4)
+    base = str(tmp_path / "1")
+    with open(base + ".dat", "wb") as f:
+        f.write(rng.integers(0, 256, 40_001, dtype=np.uint8).tobytes())
+    coder = ec.get_coder("numpy", 10, 4)
+    pipeline.stream_encode(base, coder, geo)  # governed defaults
+    assert gov.runs == 1
+    assert gov.metrics.value("feed_runs") == 1
