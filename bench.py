@@ -857,6 +857,164 @@ def bench_needle_map(work: str, n: int = 5_000_000) -> dict:
             "lookup_p99_us": round(lat[int(len(lat) * 0.99)] * 1e6, 1)}
 
 
+def phase_degraded(work: str, budget_s: float = 240.0,
+                   n_reads: int = 300) -> dict:
+    """p50/p99 degraded-read latency with one shard holder faulted —
+    the warm-storage tier's brownout regime. A real multi-process
+    cluster (master + 4 volume server subprocesses, so the fault
+    registry is per NODE) EC-encodes the uploaded volume, then
+    ``POST /admin/faults`` makes one holder answer every shard read
+    with an injected error: reads served by another holder reconstruct
+    the missing intervals from the survivors. Budget-aware and
+    checkpointed into degraded_partial.json like the other phases."""
+    import random as random_mod
+    import socket
+    import urllib.request
+
+    started = time.perf_counter()
+
+    def left() -> float:
+        return budget_s - (time.perf_counter() - started)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from seaweedfs_tpu.client import Client
+    from seaweedfs_tpu.shell.ec_commands import EcCommands
+
+    import seaweedfs_tpu
+    pkg_root = os.path.dirname(os.path.dirname(seaweedfs_tpu.__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", SEAWEEDFS_FORCE_CPU="1")
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def spawn(args, tag):
+        log = open(os.path.join(work, f"degraded_{tag}.log"), "ab")
+        return subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu.cli"] + args,
+            cwd=work, env=env, stdout=log, stderr=log)
+
+    procs = []
+    out: dict = {}
+    try:
+        mport = free_port()
+        master = f"127.0.0.1:{mport}"
+        procs.append(spawn(["master", "-port", str(mport), "-mdir", work],
+                           "master"))
+        for i in range(4):
+            vdir = os.path.join(work, f"degraded_vs{i}")
+            os.makedirs(vdir, exist_ok=True)
+            procs.append(spawn(["volume", "-port", str(free_port()),
+                                "-dir", vdir, "-mserver", master,
+                                "-pulse", "1"], f"vs{i}"))
+        client = Client(master)
+        deadline = time.time() + 45
+        while time.time() < deadline:
+            try:
+                if len(client.dir_status().get("nodes", [])) >= 4:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.3)
+
+        rng = random_mod.Random(5)
+        blobs: dict[str, bytes] = {}
+        for _ in range(60):
+            data = bytes(rng.getrandbits(8)
+                         for _ in range(rng.randint(4096, 32768)))
+            blobs[client.upload(data, collection="deg")] = data
+        time.sleep(2.0)  # heartbeat rounds so the master sees the volumes
+        vids = sorted({int(f.split(",")[0]) for f in blobs})
+        shell = EcCommands(client)  # production RS(10,4) geometry
+        for vid in vids:
+            shell.encode(vid, "deg", apply=True)
+        time.sleep(2.0)
+
+        # a ~1.5MB volume striped at 1MB small blocks puts ALL the data
+        # in shards 0-1 — fault the holder of shard 0 (where the bytes
+        # live) and read through a holder that has NO data shard
+        # locally, so every measured read crosses the wire and shard-0
+        # reads reconstruct from survivors
+        shards_map = client.ec_lookup(vids[0]).get("shards", {})
+        holder_urls = sorted({u for urls in shards_map.values()
+                              for u in urls})
+        assert len(holder_urls) >= 2, holder_urls
+        data_holders = {u for sid in ("0", "1")
+                        for u in shards_map.get(sid, [])}
+        victim = shards_map["0"][0]
+        non_data = [u for u in holder_urls if u not in data_holders]
+        reader = non_data[0] if non_data else next(
+            u for u in holder_urls if u != victim)
+        fids = list(blobs)
+
+        def measure(n: int) -> list[float]:
+            lat = []
+            for i in range(n):
+                if left() < 20:
+                    break
+                fid = fids[i % len(fids)]
+                t0 = time.perf_counter()
+                with urllib.request.urlopen(
+                        f"http://{reader}/{fid}", timeout=30) as r:
+                    body = r.read()
+                lat.append(time.perf_counter() - t0)
+                assert body == blobs[fid], f"corrupt read of {fid}"
+            return lat
+
+        def pctl(lat: list[float], q: float) -> float:
+            return round(
+                sorted(lat)[min(len(lat) - 1, int(len(lat) * q))] * 1e3, 3)
+
+        healthy = measure(min(n_reads, 100))
+        out["healthy_p50_ms"] = pctl(healthy, 0.50)
+        out["healthy_p99_ms"] = pctl(healthy, 0.99)
+        _phase_checkpoint(work, "degraded", out)
+
+        # fault the victim's shard serving (both its HTTP shard endpoint
+        # and its gRPC plane): reads touching its shards now reconstruct
+        req = urllib.request.Request(
+            f"http://{victim}/admin/faults",
+            data=json.dumps({"set": [
+                {"point": "ec.shard_read", "action": "error"},
+                {"point": "rpc.VolumeEcShardRead", "action": "error"},
+            ]}).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=10).close()
+        degraded = measure(n_reads)
+        out.update({
+            "n_reads": len(degraded),
+            "degraded_p50_ms": pctl(degraded, 0.50),
+            "degraded_p99_ms": pctl(degraded, 0.99),
+            "degraded_over_healthy_p50": round(
+                pctl(degraded, 0.50) / max(out["healthy_p50_ms"], 1e-6),
+                2),
+            "faulted_holder": victim,
+            "note": ("one shard holder answers every shard read with an "
+                     "injected error (fault plane, per-process "
+                     "registry); reads served by another holder "
+                     "reconstruct missing intervals from survivors"),
+        })
+        _phase_checkpoint(work, "degraded", out)
+    finally:
+        for p in procs:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except Exception:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+    return out
+
+
 # ------------------------------------------------------------ orchestration
 
 def _run_phase(name: str, work: str, timeout_s: float) -> dict:
@@ -993,6 +1151,19 @@ def main() -> None:
         detail["system_req_s"] = system
         _checkpoint(detail)
 
+        degraded: dict = {"error": "skipped (budget)"}
+        if left() > 120:
+            try:
+                degraded = phase_degraded(
+                    work, budget_s=min(240.0, left() - 60.0))
+                _log(f"degraded: p50 {degraded.get('degraded_p50_ms')}ms "
+                     f"p99 {degraded.get('degraded_p99_ms')}ms")
+            except Exception as e:
+                degraded = {"error": str(e), **_load_partial(work,
+                                                             "degraded")}
+        detail["degraded_read"] = degraded
+        _checkpoint(detail)
+
         try:
             needle_map = bench_needle_map(work)
         except Exception as e:
@@ -1054,6 +1225,8 @@ def main() -> None:
                 "system_read_req_s":
                     (system.get("read") or {}).get("req_s")
                     if isinstance(system.get("read"), dict) else None,
+                "degraded_read_p50_ms": degraded.get("degraded_p50_ms"),
+                "degraded_read_p99_ms": degraded.get("degraded_p99_ms"),
                 "detail_file": "BENCH_DETAIL.json",
             },
         }))
@@ -1070,7 +1243,9 @@ if __name__ == "__main__":
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         fn = {"encode": phase_encode,
               "rebuild": lambda w: phase_rebuild(w, budget_s=budget),
-              "kernel": lambda w: phase_kernel(), "fused": phase_fused}[name]
+              "kernel": lambda w: phase_kernel(), "fused": phase_fused,
+              "degraded": lambda w: phase_degraded(w, budget_s=budget),
+              }[name]
         print(json.dumps(fn(work)))
     else:
         main()

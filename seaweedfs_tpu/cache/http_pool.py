@@ -49,10 +49,15 @@ class PoolResponse:
 
 class HttpPool:
     def __init__(self, max_idle_per_host: int = 8,
-                 timeout: float = 30.0, metrics=None):
+                 timeout: float = 30.0, metrics=None, breaker=None):
         self.max_idle_per_host = max_idle_per_host
         self.default_timeout = timeout
         self.metrics = metrics
+        # per-host circuit breaker (utils/retry.py): a peer that failed
+        # failure_threshold dials in a row fails fast — BreakerOpen is a
+        # ConnectionError, so replica/master rotation handles it like any
+        # refused dial, just without paying the connect timeout
+        self.breaker = breaker
         self._lock = threading.Lock()
         self._idle: dict[tuple[str, int], list] = {}
         self._closed = False
@@ -109,8 +114,33 @@ class HttpPool:
             path += "?" + parts.query
         timeout = self.default_timeout if timeout is None else timeout
         hdrs = dict(headers or {})
-        from .. import observe
+        from .. import faults, observe
+        from ..utils import retry as retry_mod
         observe.inject(hdrs)
+        # propagate the caller's remaining deadline budget and never wait
+        # on the socket longer than it (utils/retry.py); raises
+        # DeadlineExceeded when the budget is already gone
+        retry_mod.inject_deadline(hdrs)
+        timeout = retry_mod.cap_timeout(timeout)
+        hostkey = f"{host}:{port}"
+        breaker = self.breaker
+        if breaker is not None:
+            breaker.check(hostkey)  # fail fast on an open host
+        try:
+            dropped = faults.fire("http_pool.request")
+        except faults.FaultError:
+            # injected error counts as a host failure so chaos tests can
+            # drive the breaker through its whole open/half-open cycle
+            if breaker is not None:
+                breaker.record_failure(hostkey)
+            raise
+        if dropped:
+            # injected wire-level drop: indistinguishable from a peer
+            # that vanished mid-request
+            if breaker is not None:
+                breaker.record_failure(hostkey)
+            raise ConnectionResetError(
+                f"injected drop for {hostkey}")
         poolable = method.upper() in _POOLED_METHODS
         last: Optional[Exception] = None
         for attempt in range(2):
@@ -132,19 +162,33 @@ class HttpPool:
                     # just as dead — flush them so the retry dials fresh
                     self._flush_host(host, port)
                     continue
+                if breaker is not None:
+                    breaker.record_failure(hostkey)
                 raise
-            except Exception:
+            except Exception as e:
                 conn.close()
+                # record any wire-level failure class (OSError AND
+                # http.client exceptions like IncompleteRead) so a
+                # half-open probe ending here always reports back
+                if breaker is not None and isinstance(
+                        e, (OSError, http.client.HTTPException)):
+                    breaker.record_failure(hostkey)
                 raise
             if resp.will_close:
                 conn.close()
             else:
                 self._checkin(host, port, conn)
+            if breaker is not None:
+                breaker.record_success(hostkey)
             return PoolResponse(
                 resp.status,
                 {k.lower(): v for k, v in resp.getheaders()},
-                data)
-        raise last  # both attempts hit a stale/broken connection
+                faults.corrupt("http_pool.response", data))
+        # both attempts hit a stale/broken connection: the host itself is
+        # suspect, not just one idle socket
+        if breaker is not None:
+            breaker.record_failure(hostkey)
+        raise last
 
     def close(self) -> None:
         with self._lock:
@@ -164,9 +208,11 @@ _shared_lock = threading.Lock()
 
 
 def shared_pool() -> HttpPool:
-    """Process-wide pool (the reference's global http client)."""
+    """Process-wide pool (the reference's global http client), breaker
+    included — dead-peer evidence is shared by every sync caller."""
     global _shared
     with _shared_lock:
         if _shared is None:
-            _shared = HttpPool()
+            from ..utils.retry import shared_breaker
+            _shared = HttpPool(breaker=shared_breaker())
         return _shared

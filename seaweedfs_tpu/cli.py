@@ -78,7 +78,10 @@ def cmd_master(args) -> None:
         sequencer=sequencer,
         raft_state_dir=args.mdir or None,
         grpc_port=(args.port + 10000 if args.grpc_port < 0
-                   else args.grpc_port)))
+                   else args.grpc_port),
+        maintenance_interval_seconds=(None if args.maintenance_interval < 0
+                                      else args.maintenance_interval),
+        repair_concurrency=args.repair_concurrency))
 
 
 def cmd_volume(args) -> None:
@@ -661,6 +664,13 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("-grpc_port", type=int, default=-1,
                    help="gRPC control-plane port (default HTTP+10000; "
                         "0 disables)")
+    m.add_argument("-maintenance_interval", type=float, default=-1.0,
+                   help="seconds between maintenance-daemon passes "
+                        "(prune + repair planner; default: pulse, "
+                        "0 disables the daemon)")
+    m.add_argument("-repair_concurrency", type=int, default=2,
+                   help="max concurrent repairs (re-replication / "
+                        "auto ec.rebuild) the daemon drives")
     m.set_defaults(fn=cmd_master)
 
     v = sub.add_parser("volume", help="run a volume server")

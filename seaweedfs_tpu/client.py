@@ -18,6 +18,7 @@ from typing import Optional
 
 from .cache.http_pool import shared_pool
 from .cache.ttl import TTLCache
+from .utils.retry import RetryPolicy
 
 
 class ClientError(RuntimeError):
@@ -63,6 +64,9 @@ class Client:
         # round-tripping to the master; KeepConnected-pushed entries pin
         self._vid_cache = TTLCache(ttl=60.0)
         self._pool = shared_pool()
+        # one failure discipline for master rotation (utils/retry.py);
+        # the pool already carries the per-host circuit breaker
+        self._retry = RetryPolicy(base_delay=0.05, max_delay=1.0)
         self._watch_thread = None
         self._watch_stop = False
 
@@ -73,9 +77,13 @@ class Client:
     def _master_get(self, path_qs: str, timeout: float = 30.0) -> dict:
         """GET against the current master, rotating through the HA list on
         connection failure, 502/503/504, or leaderless/proxy-failed
-        replies (covering the follower whose leader just died)."""
+        replies (covering the follower whose leader just died). Backoff
+        between full rotations follows the unified RetryPolicy (jittered
+        exponential) instead of a fixed sleep; a master whose breaker is
+        open fails fast inside the pool and rotation moves on."""
         last: Optional[Exception] = None
-        for _ in range(max(2 * len(self.masters), 2)):
+        attempts = max(2 * len(self.masters), 2)
+        for attempt in range(attempts):
             try:
                 url = f"http://{self.master}{path_qs}"
                 r = self._pool.request("GET", url, timeout=timeout)
@@ -90,7 +98,10 @@ class Client:
                 last = e
                 if len(self.masters) > 1:
                     self._master_i = (self._master_i + 1) % len(self.masters)
-                    time.sleep(0.05)
+                    if attempt < attempts - 1:
+                        # back off once per full rotation, not per host
+                        time.sleep(self._retry.backoff(
+                            attempt // len(self.masters)))
                 else:
                     raise
         raise ClientError(f"all masters failed: {last}")
@@ -163,7 +174,13 @@ class Client:
                     for line in r:
                         if self._watch_stop:
                             return
-                        self._watch_apply(json.loads(line))
+                        msg = json.loads(line)
+                        if msg.get("type") == "resync":
+                            # the master overflowed our queue and dropped
+                            # us: redial for a fresh full snapshot (the
+                            # cache may have missed deltas)
+                            break
+                        self._watch_apply(msg)
             except Exception:
                 # stream loss (leader death, network): rotate and redial,
                 # picking up a fresh snapshot from the new leader

@@ -608,6 +608,51 @@ def shard_file_digest(base_file_name: str,
     return np.asarray(out, dtype=np.uint32)
 
 
+def read_stamped_digests(base_file_name: str) -> dict[int, int]:
+    """shard id -> stamped uint32 byte-sum digest from the .ecm sidecar
+    ({} when the marker is absent or carries no digests)."""
+    import json as json_mod
+    try:
+        with open(base_file_name + ".ecm") as f:
+            meta = json_mod.load(f)
+    except (OSError, ValueError):
+        return {}
+    return {int(k): int(v)
+            for k, v in (meta.get("shard_digests") or {}).items()}
+
+
+def stamp_shard_digests(base_file_name: str,
+                        geometry: Geometry = DEFAULT) -> dict[int, int]:
+    """Record each local shard file's digest into the .ecm sidecar — the
+    reference the EC scrubber verifies against. Merge-only: a shard id
+    already stamped keeps its original value (recomputing over a shard
+    that has since rotted would launder the corruption into the record),
+    so the truth is established exactly once, at encode/rebuild time
+    when the bytes are known-good. No-op without an existing marker: a
+    digests-only .ecm would fail the layout-version check at mount."""
+    import json as json_mod
+    path = base_file_name + ".ecm"
+    try:
+        with open(path) as f:
+            meta = json_mod.load(f)
+    except (OSError, ValueError):
+        return {}
+    digests = {int(k): int(v)
+               for k, v in (meta.get("shard_digests") or {}).items()}
+    for sid in range(geometry.total_shards):
+        if sid in digests or not os.path.exists(
+                base_file_name + to_ext(sid)):
+            continue
+        digests[sid] = int(shard_file_digest(base_file_name, [sid])[0])
+    meta["shard_digests"] = {str(k): v
+                             for k, v in sorted(digests.items())}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json_mod.dump(meta, f)
+    os.replace(tmp, path)
+    return digests
+
+
 def parity_file_digest(base_file_name: str,
                        geometry: Geometry = DEFAULT) -> np.ndarray:
     """[m] uint32 wrapping byte-sum of each parity shard file — the

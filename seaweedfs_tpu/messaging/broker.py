@@ -179,7 +179,11 @@ class BrokerServer:
         return web.json_response({"ok": True})
 
     async def _on_startup(self, app) -> None:
-        self._session = aiohttp.ClientSession()
+        self._session = aiohttp.ClientSession(
+            # connect/inactivity bounds, no total cap: publish
+            # fan-out must not hang on a dead peer, long streams ok
+            timeout=aiohttp.ClientTimeout(total=None, sock_connect=10,
+                                          sock_read=60))
         if self.grpc_port:
             from .broker_grpc import serve_messaging_grpc
             host = (self.advertise_url.rsplit(":", 1)[0]

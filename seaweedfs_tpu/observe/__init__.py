@@ -362,6 +362,12 @@ def trace_middleware(service: str, instance: str = ""):
         tid, parent = parse_header(request.headers.get(TRACE_HEADER, ""))
         ctx = TraceCtx(tid or new_id(), parent, service, instance)
         sp = Span(f"{request.method} {request.path}", ctx=ctx)
+        # bind the caller's deadline budget (X-Seaweed-Deadline) so the
+        # handler's own outbound requests inherit what's LEFT of it —
+        # piggybacked here because this is the one middleware every
+        # server installs (utils/retry.py owns the semantics)
+        from ..utils import retry as _retry
+        _dl_token = _retry.bind_deadline(request.headers)
         streamed = False
         try:
             with sp:
@@ -376,6 +382,7 @@ def trace_middleware(service: str, instance: str = ""):
                             or request.path == "/debug/profile")
                 return resp
         finally:
+            _retry.reset_deadline(_dl_token)
             if not streamed:
                 maybe_log_slow(sp)
 
@@ -415,6 +422,10 @@ def client_trace_config():
         hv = header_value()
         if hv and TRACE_HEADER not in params.headers:
             params.headers[TRACE_HEADER] = hv
+        # the deadline budget rides every outbound aiohttp request the
+        # same way the trace id does
+        from ..utils import retry as _retry
+        _retry.inject_deadline(params.headers)
 
     tc.on_request_start.append(on_request_start)
     return tc

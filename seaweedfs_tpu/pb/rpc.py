@@ -19,7 +19,8 @@ from __future__ import annotations
 
 import grpc
 
-from .. import observe
+from .. import faults, observe
+from ..utils import retry as retry_mod
 from . import filer_pb2 as fpb
 from . import master_pb2 as mpb
 from . import messaging_pb2 as msgpb
@@ -248,6 +249,37 @@ def _traced(method, kind: str, service: str, rpc_name: str,
     return unary_wrapper
 
 
+def _faulted(method, kind: str, rpc_name: str):
+    """Wrap a servicer method in a fault-point gate named
+    ``rpc.<Method>`` — the gRPC planes' injection surface. drop aborts
+    UNAVAILABLE (a vanished peer), error aborts INTERNAL."""
+    point = f"rpc.{rpc_name.rsplit('/', 1)[-1]}"
+
+    if kind in ("us", "ss"):
+        async def stream_wrapper(request, context):
+            try:
+                dropped = await faults.fire_async(point)
+            except faults.FaultError as e:
+                await context.abort(grpc.StatusCode.INTERNAL, str(e))
+            if dropped:
+                await context.abort(grpc.StatusCode.UNAVAILABLE,
+                                    "injected drop")
+            async for item in method(request, context):
+                yield item
+        return stream_wrapper
+
+    async def unary_wrapper(request, context):
+        try:
+            dropped = await faults.fire_async(point)
+        except faults.FaultError as e:
+            await context.abort(grpc.StatusCode.INTERNAL, str(e))
+        if dropped:
+            await context.abort(grpc.StatusCode.UNAVAILABLE,
+                                "injected drop")
+        return await method(request, context)
+    return unary_wrapper
+
+
 def _guarded(method, kind: str, guard):
     """Wrap a servicer method with the same IP-whitelist envelope the HTTP
     surface gets from guard_mw — without this, -whitelist deployments
@@ -294,6 +326,7 @@ def service_handler(service: str, spec: dict, servicer,
         method = getattr(servicer, name, None)
         if method is None:
             continue
+        method = _faulted(method, kind, name)
         if guard is not None:
             method = _guarded(method, kind, guard)
         method = _traced(method, kind, svc_label, f"{service}/{name}",
@@ -317,6 +350,72 @@ def _traced_call(multicallable):
     return call
 
 
+_RPC_RETRY = retry_mod.RetryPolicy(max_attempts=3, base_delay=0.05,
+                                   max_delay=1.0)
+
+# Only these unary RPCs are transparently retried on UNAVAILABLE — the
+# gRPC twin of http_pool's _POOLED_METHODS rule. UNAVAILABLE *usually*
+# means the request never reached a serving peer, but a connection can
+# also break after the server executed (killed mid-response, GOAWAY),
+# and re-sending a destructive op (VolumeDelete, VacuumVolumeCommit,
+# shard deletes...) would double-execute it. Reads/lookups/status are
+# always safe; Assign merely mints fresh ids (a burned fid is garbage,
+# not corruption). Everything else fails fast to its caller.
+_RETRYABLE_RPCS = frozenset({
+    "Assign", "Lookup", "LookupEc", "ClusterStatus", "VolumeList",
+    "Statistics", "CollectionList", "GetMasterConfiguration",
+    "VolumeNeedleStatus", "VacuumVolumeCheck", "VolumeStatus",
+    "ReadVolumeFileStatus", "VolumeSyncStatus", "VolumeServerStatus",
+    "LookupDirectoryEntry", "LookupVolume", "GetFilerConfiguration",
+    "KvGet", "LocateBroker", "FindBroker", "GetTopicConfiguration",
+})
+
+
+def _retried_unary(call_fn):
+    """Retry a unary multicallable on UNAVAILABLE with the unified
+    jittered backoff (utils/retry.py) — the gRPC twin of the HTTP
+    clients' rotation loops, applied only to the idempotent RPCs in
+    _RETRYABLE_RPCS. When the caller gives no timeout, the ambient
+    X-Seaweed-Deadline budget becomes the grpc deadline. Streams are
+    never retried (redelivery semantics belong to their callers)."""
+
+    def call(request, **kwargs):
+        if kwargs.get("timeout") is None:
+            left = retry_mod.remaining_budget()
+            if left is not None:
+                kwargs["timeout"] = max(left, 0.001)
+        attempt = 0
+        while True:
+            try:
+                result = call_fn(request, **kwargs)
+            except grpc.RpcError as e:  # sync channel raises inline
+                if (e.code() != grpc.StatusCode.UNAVAILABLE
+                        or attempt >= _RPC_RETRY.max_attempts - 1):
+                    raise
+                import time as time_mod
+                time_mod.sleep(_RPC_RETRY.backoff(attempt))
+                attempt += 1
+                continue
+            if hasattr(result, "__await__"):  # aio: errors surface at await
+                async def awaited(first_call=result):
+                    import asyncio
+                    a, c = 0, first_call
+                    while True:
+                        try:
+                            return await c
+                        except grpc.RpcError as e:
+                            if (e.code() != grpc.StatusCode.UNAVAILABLE
+                                    or a >= _RPC_RETRY.max_attempts - 1):
+                                raise
+                            await asyncio.sleep(_RPC_RETRY.backoff(a))
+                            a += 1
+                            c = call_fn(request, **kwargs)
+                return awaited()
+            return result
+
+    return call
+
+
 class _SpecStub:
     """Client multicallables (what a generated stub would contain)."""
 
@@ -325,10 +424,15 @@ class _SpecStub:
                      "us": channel.unary_stream,
                      "ss": channel.stream_stream}
         for name, (kind, req, resp) in spec.items():
-            setattr(self, name, _traced_call(factories[kind](
+            call = _traced_call(factories[kind](
                 f"/{service}/{name}",
                 request_serializer=req.SerializeToString,
-                response_deserializer=resp.FromString)))
+                response_deserializer=resp.FromString))
+            if kind == "uu" and name in _RETRYABLE_RPCS:
+                # retries re-enter _traced_call, so every attempt
+                # re-injects fresh trace metadata
+                call = _retried_unary(call)
+            setattr(self, name, call)
 
 
 class MasterStub(_SpecStub):

@@ -95,12 +95,20 @@ class S3Server:
         # reserved for ALL methods: a GET-only route would let
         # PUT /metrics fall through to the {bucket} catch-all and mint a
         # bucket the gateway can never read back
+        from .. import faults
         for path, handler in (("/healthz", self.healthz),
                               ("/metrics", self.metrics_handler),
                               ("/debug/trace", self.trace_handler),
                               ("/debug/profile", self.profile_handler)):
             app.router.add_get(path, handler)
             app.router.add_route("*", path, self._reserved)
+        if faults.admin_enabled():
+            # opt-in only (WEED_FAULTS_ADMIN=1): this route sits OUTSIDE
+            # the SigV4 auth that fences every other mutating S3 route
+            _faults_handler = faults.admin_handler()
+            app.router.add_get("/admin/faults", _faults_handler)
+            app.router.add_post("/admin/faults", _faults_handler)
+            app.router.add_route("*", "/admin/faults", self._reserved)
         app.router.add_route("*", "/", self.dispatch_root)
         app.router.add_route("*", "/{bucket}", self.dispatch_bucket)
         app.router.add_route("*", "/{bucket}/{key:.*}", self.dispatch_object)
@@ -140,6 +148,9 @@ class S3Server:
 
     async def _on_startup(self, app) -> None:
         self._session = aiohttp.ClientSession(
+            # inactivity-bounded, no total cap (large object streams)
+            timeout=aiohttp.ClientTimeout(total=None, sock_connect=10,
+                                          sock_read=60),
             trace_configs=[observe.client_trace_config()])
 
     async def _on_cleanup(self, app) -> None:

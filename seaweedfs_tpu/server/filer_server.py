@@ -131,6 +131,14 @@ class FilerServer:
             middlewares=[observe.trace_middleware("filer", self.url)])
         app.router.add_get("/healthz", _healthz)
         app.router.add_get("/metrics", self.metrics_handler)
+        from .. import faults
+        if faults.admin_enabled():
+            # opt-in only (WEED_FAULTS_ADMIN=1): the filer app installs
+            # no guard middleware, so this endpoint would otherwise be
+            # an unauthenticated process-wide fault switch
+            _faults_handler = faults.admin_handler()
+            app.router.add_get("/admin/faults", _faults_handler)
+            app.router.add_post("/admin/faults", _faults_handler)
         from ..utils.profiling import profile_handler
         app.router.add_get("/debug/profile", profile_handler())
         app.router.add_get("/debug/trace", observe.trace_handler())
@@ -381,6 +389,9 @@ class FilerServer:
         # outbound chunk reads/writes and master calls carry the ambient
         # trace header so one filer request merges with its volume spans
         self._session = aiohttp.ClientSession(
+            # inactivity-bounded, no total cap (large chunk streams)
+            timeout=aiohttp.ClientTimeout(total=None, sock_connect=10,
+                                          sock_read=60),
             trace_configs=[observe.client_trace_config()])
         if self.grpc_port:
             from .filer_grpc import serve_filer_grpc

@@ -18,15 +18,17 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Optional
 
 import aiohttp
 from aiohttp import web
 
-from .. import observe
+from .. import faults, observe
 from ..cluster.raft import RaftNode, _endpoint_ips
 from ..security.guard import Guard
 from ..storage.file_id import FileId, new_cookie
+from ..storage.superblock import ReplicaPlacement
 from ..topology.sequence import MemorySequencer
 from ..topology.topology import Topology
 from ..utils import metrics as metrics_mod
@@ -39,7 +41,10 @@ log = logging.getLogger("master")
 # leader instead of buffering the stream through the proxy)
 _LOCAL_PATHS = ("/healthz", "/metrics", "/cluster/status", "/cluster/watch",
                 "/cluster/raft/vote", "/cluster/raft/append",
-                "/ui", "/debug/profile", "/debug/trace")
+                "/ui", "/debug/profile", "/debug/trace",
+                # fault injection is per-PROCESS state: proxying it to the
+                # leader would arm the fault on the wrong node
+                "/admin/faults")
 
 
 async def _healthz(request: "web.Request") -> "web.Response":
@@ -60,7 +65,10 @@ class MasterServer:
                  raft_heartbeat: float = 0.1,
                  grpc_port: int = 0,
                  tls=None,
-                 sequencer=None):
+                 sequencer=None,
+                 maintenance_interval_seconds: Optional[float] = None,
+                 repair_concurrency: int = 2,
+                 ec_total_shards: int = 14):
         self.topology = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds)
@@ -83,6 +91,36 @@ class MasterServer:
         self._grow_lock = asyncio.Lock()
         self._vacuum_lock = asyncio.Lock()
         self._vacuum_task: Optional[asyncio.Task] = None
+        # --- maintenance daemon (leader-only) state ---
+        # the reference master runs a periodic maintenance loop
+        # (weed/server/master_server.go:187-257); here: time-driven dead-
+        # node pruning + a repair planner that re-replicates volumes and
+        # auto-drives ec.rebuild when live shard count drops
+        self.maintenance_interval_seconds = (
+            maintenance_interval_seconds
+            if maintenance_interval_seconds is not None
+            else max(pulse_seconds, 0.05))
+        self.repair_concurrency = repair_concurrency
+        self.ec_total_shards = ec_total_shards
+        # pruning always runs with the daemon; the repair planner can be
+        # paused (operators during planned maintenance, tests driving
+        # the manual ec.rebuild path)
+        self.repair_enabled = True
+        self._maint_task: Optional[asyncio.Task] = None
+        self._maint_session: Optional[aiohttp.ClientSession] = None
+        self._repair_sem = asyncio.Semaphore(max(1, repair_concurrency))
+        self._repairs_inflight: set = set()     # (kind, vid) keys
+        self._repair_tasks: set = set()         # live asyncio.Tasks
+        # per-volume failure backoff: key -> (failures, next_attempt_mono)
+        self._repair_backoff: dict = {}
+        # deficits must be seen on two consecutive passes before repair
+        # fires — one heartbeat round of lag (or an ec.encode mid-spread)
+        # must not trigger shard copies against a transient view
+        self._ec_deficit_seen: dict[int, int] = {}
+        self._replica_deficit_seen: dict[int, int] = {}
+        # scrub-reported bad shards: vid -> holder url -> set of shard ids
+        self._scrub_bad: dict[int, dict[str, set]] = {}
+        self.watch_queue_depth = 1024
         self._key_bound = 0          # replicated sequencer high-water mark
         self._key_bound_step = 10000  # one raft round per this many keys
         self._seq_synced_term = -1   # term whose ceiling was folded in
@@ -194,6 +232,10 @@ class MasterServer:
         app.router.add_post("/cluster/unlock", self.cluster_unlock)
         app.router.add_post("/cluster/raft/vote", self.raft_vote)
         app.router.add_post("/cluster/raft/append", self.raft_append)
+        app.router.add_post("/ec/scrub_report", self.ec_scrub_report)
+        _faults_handler = faults.admin_handler()
+        app.router.add_get("/admin/faults", _faults_handler)
+        app.router.add_post("/admin/faults", _faults_handler)
         app.router.add_get("/metrics", self.metrics_handler)
         app.router.add_get("/healthz", _healthz)
         from ..utils.profiling import profile_handler
@@ -208,6 +250,8 @@ class MasterServer:
         await self.raft.start()
         if self.vacuum_interval_seconds > 0:
             self._vacuum_task = asyncio.create_task(self._vacuum_loop())
+        if self.maintenance_interval_seconds > 0:
+            self._maint_task = asyncio.create_task(self._maintenance_loop())
         if self.grpc_port:
             from .master_grpc import serve_master_grpc
             host = (self.url.rsplit(":", 1)[0] if ":" in self.url
@@ -222,10 +266,16 @@ class MasterServer:
             self._fast_srv = None
         if self._vacuum_task:
             self._vacuum_task.cancel()
+        if self._maint_task:
+            self._maint_task.cancel()
+        for task in list(self._repair_tasks):
+            task.cancel()
         if self._grpc_server is not None:
             await self._grpc_server.stop(grace=0.5)
         if self._proxy_session is not None:
             await self._proxy_session.close()
+        if self._maint_session is not None:
+            await self._maint_session.close()
         await self.raft.stop()
 
     @staticmethod
@@ -508,6 +558,7 @@ class MasterServer:
                 return None
             ok = True
             async with aiohttp.ClientSession(
+                    timeout=aiohttp.ClientTimeout(total=30),
                     trace_configs=[observe.client_trace_config()]) as session:
                 for node in nodes:
                     try:
@@ -550,6 +601,7 @@ class MasterServer:
         deleted = 0
         errors = []
         async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=30),
                 trace_configs=[observe.client_trace_config()]) as session:
             for node in list(self.topology.nodes.values()):
                 vids = [vid for vid, v in node.volumes.items()
@@ -653,6 +705,7 @@ class MasterServer:
         rest of the scan."""
         compacted: list[int] = []
         async with self._vacuum_lock, aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=300),
                 trace_configs=[observe.client_trace_config()]) as s:
             for layout in list(self.topology.layouts.values()):
                 for vid, nodes in list(layout.locations.items()):
@@ -708,6 +761,275 @@ class MasterServer:
             if was_writable:
                 layout.writable.add(vid)
 
+    # --- maintenance daemon (leader-only): time-driven prune + repair
+    #     planner (the reference's periodic maintenance loop,
+    #     weed/server/master_server.go:187-257) ---
+
+    async def _maintenance_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.maintenance_interval_seconds)
+            try:
+                await self._maintenance_pass()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.warning("maintenance pass failed: %s", e)
+
+    async def _maintenance_pass(self) -> None:
+        """One scan: prune dead nodes, then plan + launch repairs. Only
+        the raft leader acts — a follower's stale topology must never
+        drive shard copies (and two masters must never both repair)."""
+        if not self.raft.is_leader or not await self.raft.ensure_ready():
+            # a demoted leader forgets its pass counters so a later
+            # re-election starts from a fresh 2-pass confirmation
+            self._ec_deficit_seen.clear()
+            self._replica_deficit_seen.clear()
+            return
+        for ev in self.topology.prune_dead_nodes():
+            self.metrics.count("dead_nodes_pruned")
+            with observe.span("master.prune_dead_node",
+                              tags={"url": ev.get("url", "")}):
+                self._broadcast_location(ev)
+        if self.repair_enabled:
+            await self._repair_pass()
+
+    def _live_ec_shards(self, vid: int) -> set:
+        """Shard ids with at least one holder whose copy is not
+        scrub-flagged as corrupt."""
+        shards = self.topology.lookup_ec_shards(vid)
+        bad = self._scrub_bad.get(vid, {})
+        live = set()
+        for sid, nodes in shards.items():
+            for n in nodes:
+                if sid not in bad.get(n.url, ()):
+                    live.add(sid)
+                    break
+        return live
+
+    def _repair_due(self, key, seen: dict, vid: int) -> bool:
+        """Deficit gating: two consecutive sightings (transient heartbeat
+        lag / mid-encode spreads must not trigger), plus per-volume
+        exponential backoff after failures, plus the in-flight guard."""
+        if key in self._repairs_inflight:
+            return False
+        count = seen.get(vid, 0) + 1
+        seen[vid] = count
+        if count < 2:
+            return False
+        back = self._repair_backoff.get(key)
+        if back is not None and time.monotonic() < back[1]:
+            return False
+        # launching: drop the confirmation count, so the passes right
+        # after a successful repair (which may still see the stale
+        # pre-heartbeat topology) must re-confirm the deficit from
+        # scratch instead of immediately re-running a redundant repair
+        seen.pop(vid, None)
+        return True
+
+    async def _repair_pass(self) -> None:
+        # EC volumes below full shard count (scrub-flagged copies don't
+        # count as live)
+        ec_vids: dict[int, str] = {}
+        for node in self.topology.nodes.values():
+            for vid, info in node.ec_shards.items():
+                ec_vids.setdefault(vid, info.collection)
+        for vid in list(self._ec_deficit_seen):
+            if vid not in ec_vids:
+                self._ec_deficit_seen.pop(vid, None)
+        for vid, collection in ec_vids.items():
+            live = self._live_ec_shards(vid)
+            if len(live) >= self.ec_total_shards:
+                self._ec_deficit_seen.pop(vid, None)
+                self._repair_backoff.pop(("ec", vid), None)
+                continue
+            if self._repair_due(("ec", vid), self._ec_deficit_seen, vid):
+                self._launch_repair(("ec", vid), self._repair_ec,
+                                    vid, collection)
+        # under-replicated normal volumes
+        seen_vids = set()
+        for key, layout in list(self.topology.layouts.items()):
+            need = ReplicaPlacement.parse(layout.replication).copy_count()
+            if need <= 1:
+                continue
+            for vid, nodes in list(layout.locations.items()):
+                seen_vids.add(vid)
+                if not nodes or len(nodes) >= need:
+                    self._replica_deficit_seen.pop(vid, None)
+                    self._repair_backoff.pop(("replica", vid), None)
+                    continue
+                if self._repair_due(("replica", vid),
+                                    self._replica_deficit_seen, vid):
+                    self._launch_repair(
+                        ("replica", vid), self._repair_replica,
+                        vid, key[0], layout.replication, list(nodes))
+        for vid in list(self._replica_deficit_seen):
+            if vid not in seen_vids:
+                self._replica_deficit_seen.pop(vid, None)
+
+    def _launch_repair(self, key, fn, *args) -> None:
+        self._repairs_inflight.add(key)
+        task = asyncio.create_task(self._run_repair(key, fn, *args))
+        self._repair_tasks.add(task)
+        task.add_done_callback(self._repair_tasks.discard)
+
+    async def _run_repair(self, key, fn, *args) -> None:
+        kind, vid = key
+        try:
+            async with self._repair_sem:
+                self.metrics.count("repairs_started",
+                                   labels={"kind": kind})
+                with observe.span(f"master.repair.{kind}",
+                                  tags={"vid": vid}):
+                    ok = await fn(*args)
+            if not ok:
+                raise RuntimeError(f"{kind} repair of {vid} incomplete")
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            failures = self._repair_backoff.get(key, (0, 0.0))[0] + 1
+            delay = min(self.maintenance_interval_seconds
+                        * (2 ** failures), 300.0)
+            self._repair_backoff[key] = (failures,
+                                         time.monotonic() + delay)
+            self.metrics.count("repairs_failed", labels={"kind": kind})
+            log.warning("%s repair of volume %d failed (attempt %d, "
+                        "next in %.1fs): %s", kind, vid, failures,
+                        delay, e)
+        else:
+            self._repair_backoff.pop(key, None)
+            self.metrics.count("repairs_succeeded", labels={"kind": kind})
+            log.info("%s repair of volume %d succeeded", kind, vid)
+        finally:
+            self._repairs_inflight.discard(key)
+
+    def _maint_http(self) -> aiohttp.ClientSession:
+        if self._maint_session is None or self._maint_session.closed:
+            self._maint_session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=300),
+                trace_configs=[observe.client_trace_config()])
+        return self._maint_session
+
+    async def _admin_post(self, url: str, op: str, body: dict,
+                          timeout: float = 60.0) -> dict:
+        async with self._maint_http().post(
+                f"http://{url}/admin/{op}", json=body,
+                timeout=aiohttp.ClientTimeout(total=timeout)) as r:
+            out = await r.json()
+            if r.status != 200:
+                raise RuntimeError(f"{url}/admin/{op}: "
+                                   f"{out.get('error', r.status)}")
+            return out
+
+    async def _repair_ec(self, vid: int, collection: str) -> bool:
+        """Auto ec.rebuild: drop scrub-flagged shard copies, then drive
+        the same plan the shell command uses (copy survivors to the
+        richest holder -> rebuild -> mount -> drop borrowed copies).
+        Leadership is re-checked between steps so a deposed leader
+        aborts instead of racing the new one."""
+        from ..shell.ec_commands import collect_ec_nodes, plan_rebuild
+        bad = self._scrub_bad.get(vid, {})
+        live_urls = {n.url for n in self.topology.nodes.values()}
+        for url, sids in list(bad.items()):
+            if url not in live_urls:
+                # the flagged holder died: its rotten copies went with
+                # it — keeping the entry would retry a dead url forever
+                bad.pop(url, None)
+                continue
+            if not self.raft.is_leader:
+                return False
+            await self._admin_post(url, "ec/delete_shards",
+                                   {"volume_id": vid,
+                                    "collection": collection,
+                                    "shard_ids": sorted(sids)})
+            bad.pop(url, None)
+            self.metrics.count("scrub_shards_dropped", value=len(sids))
+        if not bad:
+            self._scrub_bad.pop(vid, None)
+        nodes = collect_ec_nodes(self.topology.to_dict())
+        rebuilder, missing, copy_plan = plan_rebuild(
+            nodes, vid, self.ec_total_shards)
+        if not missing:
+            return True
+        copied: list[int] = []
+        for src, sids in copy_plan.items():
+            if not self.raft.is_leader:
+                return False
+            await self._admin_post(rebuilder, "ec/copy",
+                                   {"volume_id": vid,
+                                    "collection": collection,
+                                    "shard_ids": sids, "source": src})
+            copied.extend(sids)
+        if not self.raft.is_leader:
+            return False
+        out = await self._admin_post(rebuilder, "ec/rebuild",
+                                     {"volume_id": vid,
+                                      "collection": collection},
+                                     timeout=600.0)
+        rebuilt = out.get("rebuilt", [])
+        # mount everything that was missing, not just what THIS rebuild
+        # regenerated: an earlier interrupted repair may have left the
+        # shard file on disk unmounted, and rebuild reports only files it
+        # had to create — mounting `rebuilt` alone would wedge the volume
+        # at 13/14 forever
+        await self._admin_post(rebuilder, "ec/mount",
+                               {"volume_id": vid,
+                                "collection": collection,
+                                "shard_ids": sorted(set(rebuilt)
+                                                    | set(missing))})
+        if copied:
+            await self._admin_post(rebuilder, "ec/delete_shards",
+                                   {"volume_id": vid,
+                                    "collection": collection,
+                                    "shard_ids": copied})
+        return True
+
+    async def _repair_replica(self, vid: int, collection: str,
+                              replication: str, holders: list) -> bool:
+        """Re-replicate an under-replicated volume onto a fresh node,
+        rack-aware: when the placement spreads racks/DCs, prefer a rack
+        the surviving copies don't already occupy (the same constraint
+        find_empty_slots enforces at grow time)."""
+        rp = ReplicaPlacement.parse(replication)
+        held = {n.id for n in holders}
+        candidates = [n for n in self.topology.nodes.values()
+                      if n.free_slots() > 0 and n.id not in held]
+        if not candidates or not holders:
+            return False
+        used_racks = {(n.data_center, n.rack) for n in holders}
+        if rp.diff_rack_count or rp.diff_data_center_count:
+            spread = [n for n in candidates
+                      if (n.data_center, n.rack) not in used_racks]
+            if spread:
+                candidates = spread
+        target = max(candidates, key=lambda n: n.free_slots())
+        if not self.raft.is_leader:
+            return False
+        await self._admin_post(target.url, "volume/copy",
+                               {"volume_id": vid,
+                                "collection": collection,
+                                "source": holders[0].url},
+                               timeout=600.0)
+        return True
+
+    async def ec_scrub_report(self, request: web.Request) -> web.Response:
+        """Volume servers report shards whose on-disk bytes no longer
+        match their stamped digest; the repair daemon drops + rebuilds
+        them (bit-rot -> self-heal, closed loop)."""
+        body = await request.json()
+        try:
+            vid = int(body["volume_id"])
+            url = body["url"]
+            bad = {int(s) for s in body.get("bad_shards", [])}
+        except (KeyError, ValueError):
+            return web.json_response({"error": "bad report"}, status=400)
+        if bad:
+            per_node = self._scrub_bad.setdefault(vid, {})
+            per_node[url] = per_node.get(url, set()) | bad
+            self.metrics.count("scrub_reports")
+            log.warning("scrub: %s reports bad shards %s of volume %d",
+                        url, sorted(bad), vid)
+        return web.json_response({"ok": True})
+
     async def ec_lookup(self, request: web.Request) -> web.Response:
         """LookupEcVolume (weed/server/master_grpc_server_volume.go:148)."""
         try:
@@ -753,8 +1075,9 @@ class MasterServer:
         else:
             self.sequencer.set_max(seen_key)
         self._broadcast_location(event)
-        for ev in self.topology.prune_dead_nodes():
-            self._broadcast_location(ev)
+        # dead-node pruning is time-driven in the maintenance daemon now
+        # (the reference's periodic loop) — piggybacking it on OTHER
+        # nodes' heartbeats meant a quiet cluster never pruned at all
         return {
             "volume_size_limit": self.topology.volume_size_limit,
             "leader": self.raft.leader_id or "",
@@ -763,15 +1086,30 @@ class MasterServer:
     # --- KeepConnected push (weed/server/master_grpc_server.go:178-233,
     #     wdclient/masterclient.go) ---
     def _broadcast_location(self, event: Optional[dict]) -> None:
-        """Push a vid-location delta to every subscriber; drops nothing —
-        queues are unbounded and subscriber death is handled by the
-        streaming handler."""
+        """Push a vid-location delta to every subscriber. Queues are
+        bounded (watch_queue_depth): a subscriber that can't drain fast
+        enough is dropped with a trailing ``resync`` marker — deltas are
+        incremental, so silently skipping one would leave that client's
+        vid cache wrong forever, while a reconnect refetches the full
+        snapshot. (An unbounded queue let one wedged subscriber grow the
+        master's heap without limit.)"""
         if not event or (not event["new_vids"] and not event["deleted_vids"]):
             return
         msg = dict(event)
         msg["type"] = "update"
         for q in list(self._watchers):
-            q.put_nowait(msg)
+            try:
+                q.put_nowait(msg)
+            except asyncio.QueueFull:
+                self._watchers.discard(q)
+                self.metrics.count("watchers_overflowed")
+                try:
+                    # make room so the marker always fits; everything the
+                    # subscriber still drains before it is valid
+                    q.get_nowait()
+                except asyncio.QueueEmpty:
+                    pass
+                q.put_nowait({"type": "resync"})
 
     def _location_snapshot(self) -> dict:
         """Current vid -> location urls map, sent on watch connect (the
@@ -803,7 +1141,7 @@ class MasterServer:
         resp = web.StreamResponse(
             headers={"Content-Type": "application/x-ndjson"})
         await resp.prepare(request)
-        q: asyncio.Queue = asyncio.Queue()
+        q: asyncio.Queue = asyncio.Queue(maxsize=self.watch_queue_depth)
         self._watchers.add(q)
         try:
             await resp.write(
@@ -811,6 +1149,10 @@ class MasterServer:
             while True:
                 msg = await q.get()
                 await resp.write(json_mod.dumps(msg).encode() + b"\n")
+                if msg.get("type") == "resync":
+                    # overflow: the broadcaster already unsubscribed us;
+                    # end the stream so the client redials for a snapshot
+                    break
         except (ConnectionResetError, asyncio.CancelledError):
             pass
         finally:

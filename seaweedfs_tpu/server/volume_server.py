@@ -35,7 +35,7 @@ from typing import Optional
 import aiohttp
 from aiohttp import web
 
-from .. import observe
+from .. import faults, observe
 from ..storage.file_id import FileId
 from ..utils import compression, fast_multipart
 from ..storage.needle import (FLAG_IS_COMPRESSED,
@@ -180,7 +180,8 @@ class VolumeServer:
                  use_grpc_heartbeat: bool = False,
                  master_grpc_target: str = "",
                  grpc_port: int = 0,
-                 tls=None):
+                 tls=None,
+                 scrub_interval_seconds: Optional[float] = None):
         self.use_grpc_heartbeat = use_grpc_heartbeat
         # explicit gRPC endpoint override; default follows the
         # HTTP-port+10000 convention (grpc_client_server.go)
@@ -212,6 +213,17 @@ class VolumeServer:
         self._peer_grpc_dead: dict[str, float] = {}
         self._repair_neg: dict[str, float] = {}
         self._repair_inflight = 0
+        # EC scrubber: low-priority digest verify of local shards
+        # (WEED_EC_SCRUB_INTERVAL seconds; 0 disables)
+        if scrub_interval_seconds is None:
+            import os as _os
+            try:
+                scrub_interval_seconds = float(
+                    _os.environ.get("WEED_EC_SCRUB_INTERVAL", "3600"))
+            except ValueError:
+                scrub_interval_seconds = 3600.0
+        self.scrub_interval_seconds = scrub_interval_seconds
+        self._scrub_task: Optional[asyncio.Task] = None
         # per-process secret marking requests proxied from the fastpath
         # listener (server/fastpath.py): they arrive from 127.0.0.1 but
         # were already whitelist-checked against the REAL peer IP
@@ -274,6 +286,10 @@ class VolumeServer:
         app.router.add_post("/admin/ec/blob_delete", self.admin_ec_blob_delete)
         app.router.add_post("/admin/ec/to_volume", self.admin_ec_to_volume)
         app.router.add_get("/admin/ec/shard_read", self.admin_ec_shard_read)
+        app.router.add_post("/admin/ec/scrub", self.admin_ec_scrub)
+        _faults_handler = faults.admin_handler()
+        app.router.add_get("/admin/faults", _faults_handler)
+        app.router.add_post("/admin/faults", _faults_handler)
         app.router.add_get("/admin/file_copy", self.admin_file_copy)
         app.router.add_get("/admin/tail", self.admin_tail)
         app.router.add_post("/admin/volume/copy", self.admin_volume_copy)
@@ -293,9 +309,17 @@ class VolumeServer:
 
     async def _on_startup(self, app) -> None:
         self._session = aiohttp.ClientSession(
+            # connect/inactivity bounds with no total cap: replicate
+            # fan-out and heartbeats must never hang on a dead peer,
+            # while multi-GB volume/shard copies stream as long as bytes
+            # keep flowing
+            timeout=aiohttp.ClientTimeout(total=None, sock_connect=10,
+                                          sock_read=60),
             trace_configs=[observe.client_trace_config()])
         self._batcher = WriteBatcher(self.store)
         self._hb_task = asyncio.create_task(self._heartbeat_loop())
+        if self.scrub_interval_seconds > 0:
+            self._scrub_task = asyncio.create_task(self._scrub_loop())
         if self.grpc_port:
             from .volume_grpc import serve_volume_grpc
             host = self.url.rsplit(":", 1)[0]
@@ -317,6 +341,8 @@ class VolumeServer:
             await self._grpc_server.stop(grace=0.5)
         if self._hb_task:
             self._hb_task.cancel()
+        if self._scrub_task:
+            self._scrub_task.cancel()
         if self._batcher is not None:
             self._batcher.stop()
         if self._session:
@@ -487,6 +513,14 @@ class VolumeServer:
     async def _read(self, request: web.Request, fid: FileId) -> web.Response:
         """GetOrHeadHandler (volume_server_handlers_read.go:28-272)."""
         self.metrics.count("read")
+        try:
+            if await faults.fire_async("volume.read"):
+                # injected drop: the needle "isn't here" — clients fall
+                # back to replicas / degraded EC paths
+                return web.json_response({"error": "injected drop"},
+                                         status=404)
+        except faults.FaultError as e:
+            return web.json_response({"error": str(e)}, status=500)
         with self.metrics.timed("read"), \
                 observe.span("volume.read", tags={"fid": str(fid)}):
             try:
@@ -638,18 +672,31 @@ class VolumeServer:
 
     async def _read_repair_inner(self, fid: FileId, NeedleCls):
         import time as time_mod
+
+        from ..utils.retry import BreakerOpen, shared_breaker
+        breaker = shared_breaker()
         auth = (self.guard.sign_write(str(fid))
                 if self.guard.signing_key else "")
         for url in await self._replica_urls(fid.volume_id):
+            # unified failure discipline: a replica that keeps refusing
+            # dials is skipped fast instead of paying a connect timeout
+            # per missing needle
+            try:
+                breaker.check(url)
+            except BreakerOpen:
+                continue
             try:
                 headers = ({"Authorization": f"BEARER {auth}"}
                            if auth else {})
                 async with self._session.get(
                         f"http://{url}/admin/needle_raw",
-                        params={"fid": str(fid)}, headers=headers) as r:
+                        params={"fid": str(fid)}, headers=headers,
+                        timeout=aiohttp.ClientTimeout(total=10)) as r:
                     if r.status != 200:
+                        breaker.record_success(url)  # host is alive
                         continue
                     raw = await r.read()
+                breaker.record_success(url)
                 v = self.store.find_volume(fid.volume_id)
                 if v is None:
                     return None
@@ -661,6 +708,9 @@ class VolumeServer:
                 self.metrics.count("read_repair")
                 return n
             except Exception as e:
+                if isinstance(e, (aiohttp.ClientConnectionError, OSError,
+                                  asyncio.TimeoutError)):
+                    breaker.record_failure(url)
                 log.warning("read repair of %s from %s failed: %s",
                             fid, url, e)
         self._repair_neg[str(fid)] = time_mod.monotonic()
@@ -715,6 +765,12 @@ class VolumeServer:
         """PostHandler + ReplicatedWrite (volume_server_handlers_write.go:19,
         weed/topology/store_replicate.go:21-161)."""
         self.metrics.count("write")
+        try:
+            if await faults.fire_async("volume.write"):
+                return web.json_response({"error": "injected drop"},
+                                         status=503)
+        except faults.FaultError as e:
+            return web.json_response({"error": str(e)}, status=500)
         n = Needle(cookie=fid.cookie, id=fid.key)
         # raw header compare, NOT request.content_type: that property (and
         # request.multipart()) routes through email.parser — ~40% of write
@@ -811,6 +867,13 @@ class VolumeServer:
 
     async def _replicate(self, request: web.Request, fid: FileId,
                          n: Needle) -> bool:
+        try:
+            if await faults.fire_async("volume.replicate"):
+                # injected drop: fan-out silently skipped — exactly the
+                # lost-replica divergence read-repair must later heal
+                return True
+        except faults.FaultError:
+            return False
         replicas = await self._replica_urls(fid.volume_id)
         if not replicas:
             return True
@@ -1221,12 +1284,17 @@ class VolumeServer:
     async def admin_ec_shard_read(self, request: web.Request) -> web.Response:
         q = request.query
         try:
+            if await faults.fire_async("ec.shard_read"):
+                return web.json_response({"error": "injected drop"},
+                                         status=404)
             data = self.store.ec_shard_read(
                 int(q["volume"]), int(q["shard"]),
                 int(q.get("offset", 0)), int(q["size"]))
+        except faults.FaultError as e:
+            return web.json_response({"error": str(e)}, status=500)
         except KeyError as e:
             return web.json_response({"error": str(e)}, status=404)
-        return web.Response(body=data,
+        return web.Response(body=faults.corrupt("ec.shard_read", data),
                             content_type="application/octet-stream")
 
     # shard-location freshness tiers (store_ec.go:221-262): a missing
@@ -1341,6 +1409,87 @@ class VolumeServer:
             return None
 
         return read
+
+    # --- EC scrubber: bit-rot -> self-heal, closing the repair loop ---
+
+    async def _scrub_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.scrub_interval_seconds)
+            try:
+                await self.scrub_pass()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.warning("ec scrub pass failed: %s", e)
+
+    async def scrub_pass(self, throttle_seconds: float = 0.05) -> dict:
+        """Verify every locally mounted EC shard against the digest
+        stamped into its .ecm at encode time (ec/pipeline.py). Low
+        priority by construction: each shard digests in an executor
+        thread and the loop sleeps between shards, so serving traffic is
+        never starved. Mismatches are reported to the master, whose
+        repair daemon drops the rotten copy and schedules a targeted
+        rebuild. Returns {vid: [bad shard ids]}."""
+        from ..ec.pipeline import read_stamped_digests, shard_file_digest
+        loop = asyncio.get_event_loop()
+        bad_by_vid: dict[int, list[int]] = {}
+        with observe.span("volume.scrub"):
+            for loc in self.store.locations:
+                for vid, ev in list(loc.ec_volumes.items()):
+                    base = ev.base_file_name()
+                    stamped = read_stamped_digests(base)
+                    if not stamped:
+                        continue
+                    bad: list[int] = []
+                    for sid in ev.shard_ids():
+                        want = stamped.get(sid)
+                        if want is None:
+                            continue
+                        try:
+                            got = await loop.run_in_executor(
+                                None, lambda s=sid: int(
+                                    shard_file_digest(base, [s])[0]))
+                        except OSError:
+                            continue  # shard unmounted/moved mid-scan
+                        self.metrics.count("scrub_shards_checked")
+                        if got != want:
+                            bad.append(sid)
+                            self.metrics.count("scrub_shards_bad")
+                            log.warning(
+                                "scrub: shard %d of volume %d digest "
+                                "mismatch (%d != %d)", sid, vid, got,
+                                want)
+                        await asyncio.sleep(throttle_seconds)
+                    if bad:
+                        bad_by_vid[vid] = bad
+        for vid, bad in bad_by_vid.items():
+            await self._report_bad_shards(vid, bad)
+        return bad_by_vid
+
+    async def _report_bad_shards(self, vid: int, bad: list[int]) -> None:
+        try:
+            async with self._session.post(
+                    f"http://{self.master_url}/ec/scrub_report",
+                    json={"volume_id": vid, "url": self.url,
+                          "bad_shards": bad},
+                    timeout=aiohttp.ClientTimeout(total=10)) as r:
+                await r.read()
+        except Exception as e:
+            log.warning("scrub report for volume %d failed: %s", vid, e)
+
+    async def admin_ec_scrub(self, request: web.Request) -> web.Response:
+        """Run one scrub pass now (operators / chaos tests)."""
+        body = {}
+        if request.can_read_body:
+            try:
+                body = await request.json()
+            except Exception:
+                body = {}
+        bad = await self.scrub_pass(
+            throttle_seconds=float(body.get("throttle_seconds", 0.0)))
+        return web.json_response(
+            {"ok": True,
+             "bad": {str(vid): sids for vid, sids in bad.items()}})
 
     async def admin_file_copy(self, request: web.Request) -> web.StreamResponse:
         """Stream a volume/shard file to a pulling peer (CopyFile,

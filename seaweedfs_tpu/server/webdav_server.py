@@ -166,6 +166,7 @@ class WebDavServer:
         # for ALL methods so a PUT can't create a file that GET then
         # shadows. Like the rest of the webdav protocol surface, these
         # carry no auth — deploy this gateway on trusted networks only.
+        from .. import faults
         from ..utils.profiling import profile_handler
         for path, handler in (("/healthz", self.healthz),
                               ("/metrics", self.metrics_handler),
@@ -173,6 +174,13 @@ class WebDavServer:
                               ("/debug/profile", profile_handler())):
             app.router.add_get(path, handler)
             app.router.add_route("*", path, self._reserved)
+        if faults.admin_enabled():
+            # opt-in only (WEED_FAULTS_ADMIN=1): the webdav surface
+            # carries no auth at all
+            _faults_handler = faults.admin_handler()
+            app.router.add_get("/admin/faults", _faults_handler)
+            app.router.add_post("/admin/faults", _faults_handler)
+            app.router.add_route("*", "/admin/faults", self._reserved)
         app.router.add_route("*", "/{path:.*}", self.dispatch)
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
@@ -192,6 +200,9 @@ class WebDavServer:
 
     async def _on_startup(self, app) -> None:
         self._session = aiohttp.ClientSession(
+            # inactivity-bounded, no total cap (large file streams)
+            timeout=aiohttp.ClientTimeout(total=None, sock_connect=10,
+                                          sock_read=60),
             trace_configs=[observe.client_trace_config()])
 
     async def _on_cleanup(self, app) -> None:

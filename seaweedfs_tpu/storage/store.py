@@ -420,6 +420,9 @@ class Store:
         # write_ec_files layout
         ec_pipeline.stream_encode(base, self.coder(), self.geometry)
         ec_mod.write_sorted_ecx_from_idx(base, offset_size=v.offset_size)
+        # record per-shard digests into the .ecm while the bytes are
+        # known-good — the EC scrubber's bit-rot reference
+        ec_pipeline.stamp_shard_digests(base, self.geometry)
         return list(range(self.geometry.total_shards))
 
     def ec_mount(self, vid: int, collection: str,
@@ -474,6 +477,9 @@ class Store:
         ec_mod.rebuild_ecx_file(
             base, offset_size=(ev.offset_size if ev is not None
                                else t.OFFSET_SIZE))
+        # merge-only stamp: freshly reconstructed shards get their digest
+        # recorded; already-stamped ids keep the encode-time value
+        ec_pipeline.stamp_shard_digests(base, self.geometry)
         return rebuilt
 
     def ec_blob_delete(self, vid: int, needle_id: int) -> None:

@@ -96,7 +96,8 @@ class Registry:
         isn't hammered in lockstep by every server in the cluster."""
         import aiohttp
         failures = 0
-        async with aiohttp.ClientSession() as session:
+        async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=30)) as session:
             while True:
                 try:
                     async with session.post(

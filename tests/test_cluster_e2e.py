@@ -133,6 +133,11 @@ def ec_encode_setup(cluster):
 
 def test_ec_lifecycle(cluster):
     client = cluster.client
+    # this test drives the MANUAL rebuild path — pause the master's
+    # repair planner so the daemon doesn't beat shell.rebuild to it
+    # (tests/test_self_heal.py covers the automatic path)
+    for m in cluster.masters:
+        m.repair_enabled = False
     vid, fids = ec_encode_setup(cluster)
     assert fids
     shell = EcCommands(client, TEST_GEOMETRY)
@@ -176,6 +181,8 @@ def test_ec_lifecycle(cluster):
     client._vid_cache.clear()
     for fid, data in list(fids.items())[:10]:
         assert client.download(fid) == data, fid
+    for m in cluster.masters:
+        m.repair_enabled = True
 
 
 def test_vacuum_via_admin(cluster):

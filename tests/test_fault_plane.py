@@ -1,0 +1,131 @@
+"""Fault-injection plane unit + e2e: spec parsing, WEED_FAULTS env,
+deterministic probability/corruption, budgets, and the /admin/faults
+endpoint flipping real server behavior declaratively."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cluster_util import Cluster
+from seaweedfs_tpu import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def test_spec_parsing_round_trip():
+    f = faults._parse_spec("volume.read:error:p=0.5:count=3:seed=7")
+    assert (f.point, f.action, f.p, f.count, f.seed) == \
+        ("volume.read", "error", 0.5, 3, 7)
+    f = faults._parse_spec("ec.shard_read:delay:ms=200")
+    assert f.action == "delay" and f.ms == 200.0 and f.count is None
+    with pytest.raises(ValueError):
+        faults._parse_spec("justapoint")
+    with pytest.raises(ValueError):
+        faults._parse_spec("p:unknownaction")
+    with pytest.raises(ValueError):
+        faults._parse_spec("p:error:bogus=1")
+
+
+def test_env_loading(monkeypatch):
+    monkeypatch.setenv("WEED_FAULTS",
+                       "a.b:error:count=1, c.d:delay:ms=5")
+    monkeypatch.setattr(faults, "_env_loaded", False)
+    monkeypatch.setattr(faults, "_faults", [])
+    assert {f["point"] for f in faults.active()} == {"a.b", "c.d"}
+    with pytest.raises(faults.FaultError):
+        faults.fire("a.b")
+    assert faults.fire("a.b") is False  # budget spent
+
+
+def test_count_budget_and_drop():
+    faults.set_fault("x", "drop", count=2)
+    assert faults.fire("x") is True
+    assert faults.fire("x") is True
+    assert faults.fire("x") is False
+    assert faults.active()[0]["fired"] == 2
+
+
+def test_probability_deterministic_with_seed():
+    def run():
+        faults.clear()
+        faults.set_fault("p", "drop", p=0.5, seed=42)
+        return [faults.fire("p") for _ in range(50)]
+
+    a, b = run(), run()
+    assert a == b, "same seed must replay the same decision stream"
+    assert 5 < sum(a) < 45, "p=0.5 should fire sometimes, not always"
+
+
+def test_delay_fault_sleeps():
+    faults.set_fault("d", "delay", ms=50, count=1)
+    t0 = time.perf_counter()
+    assert faults.fire("d") is False
+    assert time.perf_counter() - t0 >= 0.045
+
+
+def test_corrupt_flips_exactly_one_byte_deterministically():
+    data = bytes(range(256))
+    faults.set_fault("c", "corrupt", seed=3, count=2)
+    out1 = faults.corrupt("c", data)
+    diff = [i for i in range(256) if out1[i] != data[i]]
+    assert len(diff) == 1 and out1[diff[0]] == data[diff[0]] ^ 0xFF
+    # a corrupt fault is never consumed by flow-control fire()
+    faults.clear()
+    faults.set_fault("c", "corrupt", count=1)
+    assert faults.fire("c") is False
+    assert faults.corrupt("c", b"abc") != b"abc"
+
+
+def test_prefix_wildcard_points():
+    faults.set_fault("rpc.*", "drop", count=2)
+    assert faults.fire("rpc.Assign") is True
+    assert faults.fire("volume.read") is False
+    assert faults.fire("rpc.Lookup") is True
+
+
+def test_admin_endpoint_flips_server_behavior():
+    """POST /admin/faults on one volume server: its reads fail exactly
+    `count` times, then recover — no monkeypatching anywhere."""
+    c = Cluster(n_volume_servers=1)
+    try:
+        fid = c.client.upload(b"fault-plane-payload")
+        url = c.client.lookup(int(fid.split(",")[0]))[0]
+
+        req = urllib.request.Request(
+            f"http://{url}/admin/faults",
+            data=json.dumps(
+                {"set": [{"point": "volume.read", "action": "error",
+                          "count": 2}]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            listed = json.load(r)["faults"]
+        assert any(f["point"] == "volume.read" for f in listed)
+
+        for _ in range(2):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"http://{url}/{fid}", timeout=10)
+            assert ei.value.code == 500
+        with urllib.request.urlopen(f"http://{url}/{fid}",
+                                    timeout=10) as r:
+            assert r.read() == b"fault-plane-payload"
+
+        # GET lists the firing count; clear empties the registry
+        with urllib.request.urlopen(f"http://{url}/admin/faults",
+                                    timeout=10) as r:
+            assert json.load(r)["faults"][0]["fired"] == 2
+        req = urllib.request.Request(
+            f"http://{url}/admin/faults",
+            data=json.dumps({"clear": "*"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert json.load(r)["faults"] == []
+    finally:
+        c.shutdown()

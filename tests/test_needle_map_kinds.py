@@ -16,6 +16,18 @@ from seaweedfs_tpu.storage.store import Store
 from seaweedfs_tpu.storage.volume import Volume
 
 
+def _rss_probe_available() -> bool:
+    """The 10M-entry RSS test measures peak RSS via VmHWM in
+    /proc/self/status; sandboxed kernels (gVisor-style) omit that line,
+    so the probe would read None and the budget assertions are
+    meaningless there — capability-gate instead of failing."""
+    try:
+        with open("/proc/self/status") as f:
+            return any(line.startswith("VmHWM") for line in f)
+    except OSError:
+        return False
+
+
 def _apply_ops(nm, ops):
     for op, key, offset, size in ops:
         if op == "put":
@@ -224,6 +236,9 @@ def test_disk_map_rejects_stale_sidecar(tmp_path):
     nm2.close()
 
 
+@pytest.mark.skipif(not _rss_probe_available(),
+                    reason="no VmHWM in /proc/self/status "
+                           "(sandboxed kernel) — RSS probe unusable")
 def test_disk_map_10m_entries_bounded_rss(tmp_path):
     """VERDICT r2 #4: a 30GB-volume-scale index that doesn't live in RAM.
     10M unique needles are synthesized straight into the .idx journal; a

@@ -1,0 +1,176 @@
+"""Unified failure discipline unit tests: RetryPolicy backoff, the
+X-Seaweed-Deadline budget, and the per-host circuit breaker state
+machine (utils/retry.py)."""
+
+import random
+import time
+
+import pytest
+
+from seaweedfs_tpu.utils import retry
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_deadline():
+    token = retry._deadline.set(0.0)
+    yield
+    retry._deadline.reset(token)
+
+
+def test_backoff_exponential_bounded_and_jittered():
+    p = retry.RetryPolicy(max_attempts=10, base_delay=0.1, max_delay=1.0,
+                          multiplier=2.0, jitter=0.5,
+                          rng=random.Random(1))
+    d0, d3, d9 = p.backoff(0), p.backoff(3), p.backoff(9)
+    assert 0.05 <= d0 <= 0.15          # 0.1 +/- 50%
+    assert 0.4 <= d3 <= 1.2            # 0.8 +/- 50%
+    assert d9 <= 1.5                   # capped at max_delay (+ jitter)
+    nojit = retry.RetryPolicy(base_delay=0.1, jitter=0.0)
+    assert nojit.backoff(0) == 0.1 and nojit.backoff(2) == 0.4
+
+
+def test_call_retries_then_succeeds():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("boom")
+        return "ok"
+
+    p = retry.RetryPolicy(max_attempts=5, base_delay=0.001)
+    assert p.call(flaky) == "ok"
+    assert len(calls) == 3
+
+
+def test_call_exhausts_and_raises_last():
+    p = retry.RetryPolicy(max_attempts=3, base_delay=0.001)
+    calls = []
+
+    def dead():
+        calls.append(1)
+        raise ConnectionError("always")
+
+    with pytest.raises(ConnectionError):
+        p.call(dead)
+    assert len(calls) == 3
+
+
+def test_deadline_budget_stops_retries_early():
+    p = retry.RetryPolicy(max_attempts=50, base_delay=0.05, jitter=0.0)
+    token = retry.set_deadline(0.12)
+    try:
+        calls = []
+
+        def dead():
+            calls.append(1)
+            raise ConnectionError("x")
+
+        t0 = time.perf_counter()
+        with pytest.raises(ConnectionError):
+            p.call(dead)
+        assert time.perf_counter() - t0 < 0.5
+        assert len(calls) < 10, "budget must stop the schedule early"
+    finally:
+        retry._deadline.reset(token)
+
+
+def test_deadline_header_round_trip():
+    token = retry.set_deadline(5.0)
+    try:
+        headers: dict = {}
+        retry.inject_deadline(headers)
+        raw = headers[retry.DEADLINE_HEADER]
+        # the wire carries REMAINING seconds (relative, like a grpc
+        # deadline) — an absolute stamp would break on clock skew
+        assert 3.5 < float(raw) <= 5.0
+        # the receiving server rebases it onto its own clock
+        tok2 = retry.bind_deadline({retry.DEADLINE_HEADER: raw})
+        assert tok2 is not None
+        left = retry.remaining_budget()
+        assert left is not None and 3.5 < left <= 5.0
+        retry.reset_deadline(tok2)
+    finally:
+        retry._deadline.reset(token)
+    assert retry.bind_deadline({}) is None
+    assert retry.bind_deadline({retry.DEADLINE_HEADER: "junk"}) is None
+
+
+def test_cap_timeout_against_budget():
+    assert retry.cap_timeout(30.0) == 30.0  # no budget -> untouched
+    token = retry.set_deadline(1.0)
+    try:
+        assert retry.cap_timeout(30.0) <= 1.0
+        assert retry.cap_timeout(None) <= 1.0
+    finally:
+        retry._deadline.reset(token)
+    token = retry._deadline.set(time.time() - 1.0)  # already expired
+    try:
+        with pytest.raises(retry.DeadlineExceeded):
+            retry.cap_timeout(30.0)
+    finally:
+        retry._deadline.reset(token)
+
+
+def test_breaker_full_state_machine():
+    b = retry.CircuitBreaker(failure_threshold=3, open_seconds=0.1)
+    host = "h:1"
+    # closed: failures below threshold don't open
+    b.record_failure(host)
+    b.record_failure(host)
+    b.check(host)
+    # a success resets the consecutive count
+    b.record_success(host)
+    b.record_failure(host)
+    b.record_failure(host)
+    b.check(host)
+    # third consecutive failure opens
+    b.record_failure(host)
+    assert b.is_open(host)
+    with pytest.raises(retry.BreakerOpen):
+        b.check(host)
+    time.sleep(0.12)
+    b.check(host)  # half-open: this caller is the probe
+    with pytest.raises(retry.BreakerOpen):
+        b.check(host)  # concurrent callers still fail fast
+    b.record_failure(host)  # probe failed -> window restarts
+    with pytest.raises(retry.BreakerOpen):
+        b.check(host)
+    time.sleep(0.12)
+    b.check(host)
+    b.record_success(host)  # probe succeeded -> closed
+    assert not b.is_open(host)
+    b.check(host)
+
+
+def test_breaker_lost_probe_forfeits_slot():
+    """A probe whose caller dies past both record_* calls must not wedge
+    the host fast-failing forever: after another open window the slot is
+    forfeited to a new probe."""
+    b = retry.CircuitBreaker(failure_threshold=1, open_seconds=0.05)
+    b.record_failure("h")
+    assert b.is_open("h")
+    time.sleep(0.06)
+    b.check("h")  # probe admitted... and its caller never reports back
+    with pytest.raises(retry.BreakerOpen):
+        b.check("h")
+    time.sleep(0.06)
+    b.check("h")  # lost probe forfeited: a NEW probe is admitted
+    b.record_success("h")
+    assert not b.is_open("h")
+
+
+def test_breaker_gated_call():
+    b = retry.CircuitBreaker(failure_threshold=2, open_seconds=10.0)
+    p = retry.RetryPolicy(max_attempts=2, base_delay=0.001)
+
+    def dead():
+        raise ConnectionError("nope")
+
+    with pytest.raises(ConnectionError):
+        p.call(dead, host="h", breaker=b)
+    assert b.is_open("h")
+    t0 = time.perf_counter()
+    with pytest.raises(retry.BreakerOpen):
+        p.call(dead, host="h", breaker=b)
+    assert time.perf_counter() - t0 < 0.01

@@ -1,0 +1,365 @@
+"""Chaos e2e for the self-healing layer: with the master's repair daemon
+running, shard loss / holder death / bit-rot all converge back to 14/14
+live shards with NO manual ec.rebuild; a tripped circuit breaker fails
+fast and recovers through a half-open probe; a master failover mid-repair
+doesn't double-schedule the rebuild.
+
+Faults are driven declaratively through the fault plane
+(seaweedfs_tpu/faults/) instead of monkeypatching server internals.
+"""
+
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cluster_util import TEST_GEOMETRY, Cluster, free_port
+from seaweedfs_tpu import faults
+from seaweedfs_tpu.shell.ec_commands import EcCommands
+
+TOTAL = TEST_GEOMETRY.total_shards  # 14, matching production RS(10,4)
+
+
+def _wait(predicate, timeout=40.0, what=""):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.15)
+    raise AssertionError(f"timeout waiting for {what}")
+
+
+def _ec_setup(c, collection="heal", seed=11):
+    rng = random.Random(seed)
+    data = bytes(rng.getrandbits(8) for _ in range(60_000))
+    fid = c.client.upload(data, collection=collection)
+    c.wait_heartbeats()
+    vid = int(fid.split(",")[0])
+    EcCommands(c.client, TEST_GEOMETRY).encode(vid, collection, apply=True)
+    c.wait_heartbeats()
+    return vid, fid, data
+
+
+def _shard_count(c, vid) -> int:
+    try:
+        return len(c.client.ec_lookup(vid).get("shards", {}))
+    except Exception:
+        return 0
+
+
+def _leader(c):
+    return next(m for m in c.masters if m.raft.is_leader)
+
+
+def test_shard_delete_auto_rebuilds_to_full():
+    """VERDICT item 2, end to end: delete one shard -> the repair daemon
+    restores 14/14 with no manual ec.rebuild, visibly in /metrics and
+    /debug/trace."""
+    c = Cluster(n_volume_servers=4)
+    try:
+        vid, fid, data = _ec_setup(c)
+        assert _shard_count(c, vid) == TOTAL
+        victim = next(vs for vs in c.volume_servers
+                      if vs.store.find_ec_volume(vid) is not None)
+        sid = victim.store.find_ec_volume(vid).shard_ids()[0]
+        c.client.volume_admin(victim.url, "ec/delete_shards",
+                              {"volume_id": vid, "collection": "heal",
+                               "shard_ids": [sid]})
+        c.wait_heartbeats()
+
+        _wait(lambda: _shard_count(c, vid) == TOTAL,
+              what="auto rebuild back to 14/14")
+
+        # the repair is observable: master metrics counters...
+        leader = _leader(c)
+        with urllib.request.urlopen(f"http://{leader.url}/metrics",
+                                    timeout=10) as r:
+            metrics_text = r.read().decode()
+        assert "master_repairs_started_total" in metrics_text
+        succeeded = [ln for ln in metrics_text.splitlines()
+                     if ln.startswith(
+                         "seaweedfs_tpu_master_repairs_succeeded_total")]
+        assert succeeded and float(succeeded[0].rsplit(" ", 1)[1]) >= 1, \
+            metrics_text
+        # ...and a master.repair.ec span in /debug/trace
+        with urllib.request.urlopen(
+                f"http://{leader.url}/debug/trace?format=spans",
+                timeout=10) as r:
+            spans = json.load(r)["spans"]
+        assert any(s["name"] == "master.repair.ec" for s in spans)
+
+        # the data is intact through the healed shard set
+        c.client._vid_cache.clear()
+        assert c.client.download(fid) == data
+    finally:
+        c.shutdown()
+
+
+def test_holder_death_auto_rebuilds():
+    """Kill a whole shard holder: prune (time-driven) drops it, then the
+    repair daemon rebuilds its shards onto the survivors."""
+    c = Cluster(n_volume_servers=4)
+    try:
+        vid, fid, data = _ec_setup(c, seed=12)
+        victim_i, victim = next(
+            (i, vs) for i, vs in enumerate(c.volume_servers)
+            if vs.store.find_ec_volume(vid) is not None)
+        lost = victim.store.find_ec_volume(vid).shard_ids()
+        assert lost
+        c.stop_volume_server(victim_i)
+
+        def fully_rebuilt():
+            info = {}
+            try:
+                info = c.client.ec_lookup(vid).get("shards", {})
+            except Exception:
+                return False
+            live_urls = {u for urls in info.values() for u in urls}
+            return (len(info) == TOTAL and victim.url not in live_urls)
+
+        _wait(fully_rebuilt, timeout=60,
+              what="holder death -> rebuild on survivors")
+        c.client._vid_cache.clear()
+        assert c.client.download(fid) == data
+    finally:
+        c.shutdown()
+
+
+def test_scrub_bitrot_reported_and_autohealed():
+    """Flip one byte of a shard file on disk: the scrubber catches the
+    digest mismatch, reports it, and the repair daemon drops + rebuilds
+    the rotten copy — bit-rot to self-heal with no operator."""
+    from seaweedfs_tpu.ec import to_ext
+    c = Cluster(n_volume_servers=4)
+    try:
+        vid, fid, data = _ec_setup(c, seed=13)
+        victim = next(vs for vs in c.volume_servers
+                      if vs.store.find_ec_volume(vid) is not None)
+        ev = victim.store.find_ec_volume(vid)
+        sid = ev.shard_ids()[-1]
+        path = ev.base_file_name() + to_ext(sid)
+        with open(path, "r+b") as f:
+            f.seek(100)
+            b = f.read(1)
+            f.seek(100)
+            f.write(bytes([b[0] ^ 0xFF]))
+
+        out = c.client.volume_admin(victim.url, "ec/scrub",
+                                    {"throttle_seconds": 0})
+        assert out["bad"] == {str(vid): [sid]}, out
+
+        def healed():
+            if _shard_count(c, vid) != TOTAL:
+                return False
+            # every holder's copy of every shard verifies clean again
+            for vs in c.volume_servers:
+                if vs.store.find_ec_volume(vid) is None:
+                    continue
+                if c.client.volume_admin(vs.url, "ec/scrub",
+                                         {"throttle_seconds": 0})["bad"]:
+                    return False
+            return True
+
+        _wait(healed, timeout=60, what="bit-rot scrub -> rebuild")
+        c.client._vid_cache.clear()
+        assert c.client.download(fid) == data
+    finally:
+        c.shutdown()
+
+
+def test_under_replicated_volume_auto_rereplicates():
+    """Delete one replica of a 001-replicated volume: the repair daemon
+    re-replicates onto a fresh (rack-aware) node with no shell command."""
+    c = Cluster(n_volume_servers=3)
+    try:
+        fid = c.client.upload(b"auto-fix" * 120, replication="001")
+        vid = int(fid.split(",")[0])
+        c.wait_heartbeats()
+        holders = c.client.lookup(vid)
+        assert len(holders) == 2
+        c.client.volume_admin(holders[0], "volume/delete",
+                              {"volume_id": vid})
+
+        def restored():
+            c.client._vid_cache.clear()
+            try:
+                return len(c.client.lookup(vid)) == 2
+            except Exception:
+                return False
+
+        _wait(restored, timeout=40, what="auto re-replication to 2 copies")
+        assert c.client.download(fid) == b"auto-fix" * 120
+        leader = _leader(c)
+        with urllib.request.urlopen(f"http://{leader.url}/metrics",
+                                    timeout=10) as r:
+            text = r.read().decode()
+        assert 'master_repairs_succeeded_total{kind="replica"}' in text
+    finally:
+        c.shutdown()
+
+
+def test_master_failover_mid_repair_no_double_schedule():
+    """Kill the raft leader while its repair daemon is mid-rebuild: the
+    new leader finishes the job; the rebuild is not stormed (at most the
+    interrupted attempt plus the new leader's one)."""
+    c = Cluster(n_volume_servers=4, n_masters=3)
+    try:
+        vid, fid, data = _ec_setup(c, seed=14)
+        rebuild_calls = []
+        for vs in c.volume_servers:
+            orig = vs.store.ec_rebuild
+
+            def slow(v, collection="", _orig=orig, _u=vs.url):
+                rebuild_calls.append(_u)
+                time.sleep(1.0)  # executor thread: hold the repair open
+                return _orig(v, collection)
+
+            vs.store.ec_rebuild = slow
+
+        victim = next(vs for vs in c.volume_servers
+                      if vs.store.find_ec_volume(vid) is not None)
+        sid = victim.store.find_ec_volume(vid).shard_ids()[0]
+        c.client.volume_admin(victim.url, "ec/delete_shards",
+                              {"volume_id": vid, "collection": "heal",
+                               "shard_ids": [sid]})
+
+        _wait(lambda: rebuild_calls, timeout=40, what="repair to start")
+        leader = _leader(c)
+        c.stop_master(c.masters.index(leader))
+
+        _wait(lambda: sum(m.raft.is_leader for m in c.masters
+                          if m is not leader) == 1,
+              timeout=30, what="new leader after failover")
+        _wait(lambda: _shard_count(c, vid) == TOTAL, timeout=60,
+              what="repair completion under the new leader")
+        # interrupted attempt + (at most) one rescheduled by the new
+        # leader — never a storm of concurrent rebuilds
+        assert len(rebuild_calls) <= 3, rebuild_calls
+    finally:
+        c.shutdown()
+
+
+class _OkHandler:
+    """Minimal HTTP 200 server for breaker-recovery probes."""
+
+    def __init__(self, port):
+        import http.server
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = b'{"ok": true}'
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.srv = http.server.HTTPServer(("127.0.0.1", port), H)
+        self.thread = threading.Thread(target=self.srv.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    def close(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+def test_breaker_fast_fail_and_half_open_recovery():
+    """Acceptance: a tripped breaker fails fast (<10ms) and recovers via
+    a half-open probe once the host is back."""
+    from seaweedfs_tpu.cache.http_pool import HttpPool
+    from seaweedfs_tpu.utils.retry import BreakerOpen, CircuitBreaker
+
+    port = free_port()
+    pool = HttpPool(timeout=2.0,
+                    breaker=CircuitBreaker(failure_threshold=3,
+                                           open_seconds=0.4))
+    for _ in range(3):
+        with pytest.raises(OSError):
+            pool.request("GET", f"http://127.0.0.1:{port}/healthz")
+    t0 = time.perf_counter()
+    with pytest.raises(BreakerOpen):
+        pool.request("GET", f"http://127.0.0.1:{port}/healthz")
+    assert time.perf_counter() - t0 < 0.010, "open breaker must not dial"
+
+    srv = _OkHandler(port)
+    try:
+        time.sleep(0.45)  # open window elapses -> one probe admitted
+        r = pool.request("GET", f"http://127.0.0.1:{port}/healthz")
+        assert r.status == 200
+        assert not pool.breaker.is_open(f"127.0.0.1:{port}")
+        # breaker closed: traffic flows normally again
+        assert pool.request(
+            "GET", f"http://127.0.0.1:{port}/healthz").status == 200
+    finally:
+        srv.close()
+        pool.close()
+
+
+def test_injected_errors_trip_breaker_then_recover():
+    """The whole loop through the fault plane: N injected errors open the
+    breaker, a failed half-open probe re-opens it, budget exhaustion lets
+    the next probe close it."""
+    from seaweedfs_tpu.cache.http_pool import HttpPool
+    from seaweedfs_tpu.utils.retry import BreakerOpen, CircuitBreaker
+
+    faults.clear()
+    port = free_port()
+    srv = _OkHandler(port)
+    pool = HttpPool(timeout=2.0,
+                    breaker=CircuitBreaker(failure_threshold=3,
+                                           open_seconds=0.3))
+    try:
+        faults.set_fault("http_pool.request", "error", count=4)
+        for _ in range(3):
+            with pytest.raises(faults.FaultError):
+                pool.request("GET", f"http://127.0.0.1:{port}/healthz")
+        with pytest.raises(BreakerOpen):  # tripped: fails fast
+            pool.request("GET", f"http://127.0.0.1:{port}/healthz")
+        time.sleep(0.35)
+        with pytest.raises(faults.FaultError):  # probe burns fault #4
+            pool.request("GET", f"http://127.0.0.1:{port}/healthz")
+        with pytest.raises(BreakerOpen):  # failed probe re-opened it
+            pool.request("GET", f"http://127.0.0.1:{port}/healthz")
+        time.sleep(0.35)  # budget exhausted: next probe goes through
+        assert pool.request(
+            "GET", f"http://127.0.0.1:{port}/healthz").status == 200
+    finally:
+        faults.clear()
+        srv.close()
+        pool.close()
+
+
+def test_watch_queue_overflow_drops_subscriber_with_resync():
+    """Satellite: bounded KeepConnected queues — an overflowing
+    subscriber is unsubscribed and handed a resync marker instead of the
+    master's heap growing without limit."""
+    import asyncio
+
+    from seaweedfs_tpu.server.master import MasterServer
+
+    async def scenario():
+        m = MasterServer(url="127.0.0.1:9")
+        q: asyncio.Queue = asyncio.Queue(maxsize=2)
+        m._watchers.add(q)
+        ev = {"url": "vs", "public_url": "vs",
+              "new_vids": [1], "deleted_vids": []}
+        m._broadcast_location(dict(ev))
+        m._broadcast_location(dict(ev))
+        assert q.full()
+        m._broadcast_location(dict(ev))  # overflow
+        assert q not in m._watchers
+        msgs = [q.get_nowait(), q.get_nowait()]
+        assert msgs[-1]["type"] == "resync"
+        # subsequent broadcasts no longer touch the dropped queue
+        m._broadcast_location(dict(ev))
+        assert q.empty()
+        return True
+
+    assert asyncio.run(scenario())

@@ -115,6 +115,12 @@ def _env(c, filer=""):
 
 def test_e2e_fix_under_replicated_volume(cluster):
     c = cluster
+    # this test drives the MANUAL volume.fix.replication path — pause
+    # the master's repair planner so the maintenance daemon doesn't
+    # re-replicate first (tests/test_self_heal.py covers automatic
+    # repair)
+    for m in c.masters:
+        m.repair_enabled = False
     fid = c.client.upload(b"fix-me" * 100, replication="001")
     vid = int(fid.split(",")[0])
     c.wait_heartbeats()
@@ -139,6 +145,8 @@ def test_e2e_fix_under_replicated_volume(cluster):
     c.client._vid_cache.clear()
     assert len(c.client.lookup(vid)) == 2
     assert c.client.download(fid) == b"fix-me" * 100
+    for m in c.masters:
+        m.repair_enabled = True
 
 
 def test_e2e_volume_move(cluster):

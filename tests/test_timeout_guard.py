@@ -1,0 +1,130 @@
+"""Tier-1 static guard: no infinite-hang intra-cluster call sites.
+
+A urllib request without a timeout blocks its thread forever when the
+peer wedges (accepts the TCP connection but never answers); an aiohttp
+ClientSession built without a timeout leaves every request on that
+session with only aiohttp's implicit default. Self-healing depends on
+failures *surfacing* — a hung socket is a failure that never surfaces.
+
+Rules, enforced by AST walk over everything under ``seaweedfs_tpu/``:
+
+  * every ``urllib.request.urlopen(...)`` call passes ``timeout=``
+  * every ``aiohttp.ClientSession(...)`` constructor passes ``timeout=``
+    (session-level bound; per-request overrides remain free)
+  * every ``http.client.HTTPConnection(...)`` passes ``timeout=``
+
+Style of tests/test_async_guard.py: the walker itself is also tested.
+"""
+
+import ast
+import os
+
+import seaweedfs_tpu
+
+PKG_ROOT = os.path.dirname(seaweedfs_tpu.__file__)
+
+# (qualified attribute path, human label)
+_GUARDED_CALLS = {
+    ("urllib", "request", "urlopen"): "urllib.request.urlopen",
+    ("urllib.request", "urlopen"): "urllib.request.urlopen",
+    ("aiohttp", "ClientSession"): "aiohttp.ClientSession",
+    ("http.client", "HTTPConnection"): "http.client.HTTPConnection",
+    ("http", "client", "HTTPConnection"): "http.client.HTTPConnection",
+}
+
+
+def _attr_path(node) -> tuple:
+    """Name/Attribute chain -> tuple of parts ('urllib','request','urlopen');
+    () when the callee isn't a plain dotted name."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return ()
+
+
+def _import_aliases(tree: ast.Module) -> dict:
+    """alias -> canonical dotted prefix, for `import urllib.request as ur`
+    and `from aiohttp import ClientSession`."""
+    aliases = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def _violations_in(tree: ast.Module, filename: str) -> list:
+    aliases = _import_aliases(tree)
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        path = _attr_path(node.func)
+        if not path:
+            continue
+        # resolve a leading alias (import x as y / from m import f)
+        head = aliases.get(path[0])
+        if head is not None:
+            path = tuple(head.split(".")) + path[1:]
+        label = _GUARDED_CALLS.get(path)
+        if label is None:
+            continue
+        kwargs = {k.arg for k in node.keywords}
+        if "timeout" not in kwargs and None not in kwargs:  # **kw exempt
+            out.append(f"{filename}:{node.lineno} {label}() without an "
+                       "explicit timeout= — a wedged peer hangs this "
+                       "call site forever")
+    return out
+
+
+def _package_files():
+    for dirpath, _, names in os.walk(PKG_ROOT):
+        if "__pycache__" in dirpath:
+            continue
+        for name in sorted(names):
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def test_all_intra_cluster_requests_have_timeouts():
+    violations = []
+    for path in _package_files():
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        violations.extend(
+            _violations_in(tree, os.path.relpath(path, PKG_ROOT)))
+    assert not violations, "\n".join(violations)
+
+
+def test_timeout_walker_catches_violations():
+    src = (
+        "import urllib.request\n"
+        "import aiohttp\n"
+        "import http.client\n"
+        "from aiohttp import ClientSession\n"
+        "def bad1(u):\n"
+        "    return urllib.request.urlopen(u)\n"
+        "def bad2():\n"
+        "    return aiohttp.ClientSession()\n"
+        "def bad3(h):\n"
+        "    return http.client.HTTPConnection(h)\n"
+        "def bad4():\n"
+        "    return ClientSession()\n"
+        "def good1(u):\n"
+        "    return urllib.request.urlopen(u, timeout=5)\n"
+        "def good2():\n"
+        "    return aiohttp.ClientSession(timeout=object())\n"
+        "def good3(h, kw):\n"
+        "    return http.client.HTTPConnection(h, **kw)\n"
+    )
+    hits = _violations_in(ast.parse(src), "x.py")
+    lines = sorted(int(v.split(":")[1].split(" ")[0]) for v in hits)
+    assert lines == [6, 8, 10, 12], hits
