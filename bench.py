@@ -825,6 +825,112 @@ def bench_system(work: str, n: int = 6000, size: int = 1024,
     return out
 
 
+def phase_largefile(work: str, size_mb: int = 64) -> dict:
+    """Write-tier number beyond req/s: single-stream large-file filer
+    PUT and GET MB/s through the pipelined chunk-upload window + fid
+    lease (ISSUE 5). Boots master+volume+filer in one combined-server
+    process (8 MB chunks -> size_mb/8 chunks per PUT), uploads one
+    large body, reads it back, verifies byte identity. Every measured
+    value checkpoints to largefile_partial.json the moment it exists."""
+    import hashlib
+    import urllib.request
+
+    import seaweedfs_tpu
+    pkg_root = os.path.dirname(os.path.dirname(seaweedfs_tpu.__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", SEAWEEDFS_FORCE_CPU="1")
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+
+    mport, vport, fport = 19666, 18666, 18999
+    data_dir = os.path.join(work, "largefile")
+    os.makedirs(data_dir, exist_ok=True)
+    out: dict = {"size_mb": size_mb}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "seaweedfs_tpu.cli", "server",
+         "-ip", "127.0.0.1", "-master_port", str(mport),
+         "-port", str(vport), "-dir", data_dir,
+         "-filer", "-filer_port", str(fport),
+         "-filer_db", os.path.join(data_dir, "filer.db")],
+        cwd=data_dir, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + 30
+        while True:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{fport}/healthz",
+                        timeout=2) as r:
+                    if json.load(r).get("ok"):
+                        break
+            except Exception:
+                pass
+            if time.time() > deadline:
+                raise RuntimeError("combined server failed to start")
+            time.sleep(0.3)
+
+        rng = np.random.default_rng(11)
+        body = rng.integers(0, 256, size_mb * 1024 * 1024,
+                            dtype=np.uint8).tobytes()
+        digest = hashlib.md5(body).hexdigest()
+
+        def put(path: str) -> float:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{fport}{path}", data=body,
+                method="PUT",
+                headers={"Content-Type": "application/octet-stream"})
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(req, timeout=300) as r:
+                r.read()
+            return time.perf_counter() - t0
+
+        def get(path: str) -> tuple[float, str]:
+            t0 = time.perf_counter()
+            h = hashlib.md5()
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{fport}{path}", timeout=300) as r:
+                while True:
+                    block = r.read(1 << 20)
+                    if not block:
+                        break
+                    h.update(block)
+            return time.perf_counter() - t0, h.hexdigest()
+
+        put("/bench/warm.bin")  # volume growth + connection warmup
+        put_s = put("/bench/large.bin")
+        out["put_mb_s"] = round(size_mb / put_s, 1)
+        out["put_wall_s"] = round(put_s, 3)
+        _phase_checkpoint(work, "largefile", out)
+        get_s, got = get("/bench/large.bin")
+        out["get_mb_s"] = round(size_mb / get_s, 1)
+        out["get_wall_s"] = round(get_s, 3)
+        out["verified"] = got == digest
+        if not out["verified"]:
+            out["error"] = "GET digest mismatch"
+        # lease effectiveness during the run, straight from the filer
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{fport}/metrics", timeout=5) as r:
+                text = r.read().decode()
+            vals = {}
+            for line in text.splitlines():
+                if line.startswith("seaweedfs_tpu_filer_assign_lease_"):
+                    k, _, v = line.partition(" ")
+                    vals[k.rsplit("_", 2)[-2]] = float(v)
+            h_, m_ = vals.get("hit", 0.0), vals.get("miss", 0.0)
+            if h_ + m_:
+                out["assign_lease_hit_rate"] = round(h_ / (h_ + m_), 3)
+        except Exception:
+            pass
+        _phase_checkpoint(work, "largefile", out)
+        return out
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        time.sleep(0.5)
+
+
 def bench_needle_map(work: str, n: int = 5_000_000) -> dict:
     from seaweedfs_tpu.storage.needle_map import DiskNeedleMap
 
@@ -1151,6 +1257,18 @@ def main() -> None:
         detail["system_req_s"] = system
         _checkpoint(detail)
 
+        largefile: dict = {"error": "skipped (budget)"}
+        if left() > 90:
+            try:
+                largefile = phase_largefile(work)
+                _log(f"largefile: PUT {largefile.get('put_mb_s')} MB/s "
+                     f"GET {largefile.get('get_mb_s')} MB/s")
+            except Exception as e:
+                largefile = {"error": str(e),
+                             **_load_partial(work, "largefile")}
+        detail["largefile_mb_s"] = largefile
+        _checkpoint(detail)
+
         degraded: dict = {"error": "skipped (budget)"}
         if left() > 120:
             try:
@@ -1225,6 +1343,8 @@ def main() -> None:
                 "system_read_req_s":
                     (system.get("read") or {}).get("req_s")
                     if isinstance(system.get("read"), dict) else None,
+                "largefile_put_mb_s": largefile.get("put_mb_s"),
+                "largefile_get_mb_s": largefile.get("get_mb_s"),
                 "degraded_read_p50_ms": degraded.get("degraded_p50_ms"),
                 "degraded_read_p99_ms": degraded.get("degraded_p99_ms"),
                 "detail_file": "BENCH_DETAIL.json",
@@ -1245,6 +1365,7 @@ if __name__ == "__main__":
               "rebuild": lambda w: phase_rebuild(w, budget_s=budget),
               "kernel": lambda w: phase_kernel(), "fused": phase_fused,
               "degraded": lambda w: phase_degraded(w, budget_s=budget),
+              "largefile": phase_largefile,
               }[name]
         print(json.dumps(fn(work)))
     else:

@@ -18,6 +18,7 @@ from typing import Optional
 
 from .cache.http_pool import shared_pool
 from .cache.ttl import TTLCache
+from .filer.assign_lease import AssignLeasePool
 from .utils.retry import RetryPolicy
 
 
@@ -67,6 +68,10 @@ class Client:
         # one failure discipline for master rotation (utils/retry.py);
         # the pool already carries the per-host circuit breaker
         self._retry = RetryPolicy(base_delay=0.05, max_delay=1.0)
+        # bulk fid lease (operation.Assign with count=N): upload() draws
+        # write targets from here so steady-state uploads skip the
+        # per-blob master round trip
+        self._lease = AssignLeasePool(self._assign_fetch)
         self._watch_thread = None
         self._watch_stop = False
 
@@ -136,6 +141,18 @@ class Client:
         if "error" in out:
             raise ClientError(out["error"])
         return out
+
+    def _assign_fetch(self, params: dict, count: int) -> dict:
+        """Lease-pool refill hook: one real master assignment through the
+        HA rotation."""
+        return self.assign(count=count, **params)
+
+    def assign_leased(self, collection: str = "", replication: str = "",
+                      ttl: str = "") -> dict:
+        """One write target from the bulk-assignment lease — zero master
+        round trips while the per-(collection, replication, ttl) lease
+        is live."""
+        return self._lease.get(collection, replication, ttl)
 
     def lookup(self, vid: int) -> list[str]:
         cached = self._vid_cache.get(vid)
@@ -251,11 +268,29 @@ class Client:
     def upload(self, data: bytes, filename: str = "", mime: str = "",
                collection: str = "", replication: str = "",
                ttl: str = "") -> str:
-        """Assign + upload; returns the fid."""
-        a = self.assign(collection=collection, replication=replication,
-                        ttl=ttl)
-        self.upload_blob(a["url"], a["fid"], data, filename, mime, ttl,
-                         auth=a.get("auth", ""))
+        """Assign (leased) + upload; returns the fid. A failed POST to a
+        leased target invalidates every lease on that volume (it may be
+        sealed read-only, deleted, or breaker-open) and retries once
+        against a fresh direct assignment — a new fid, so the re-POST
+        can't double-write."""
+        a = self.assign_leased(collection=collection,
+                               replication=replication, ttl=ttl)
+        try:
+            self.upload_blob(a["url"], a["fid"], data, filename, mime, ttl,
+                             auth=a.get("auth", ""))
+        except (ClientError, *_CONN_ERRORS):
+            self._lease.invalidate(a["fid"])
+            failed_fid = a["fid"]
+            a = self.assign(collection=collection, replication=replication,
+                            ttl=ttl)
+            self.upload_blob(a["url"], a["fid"], data, filename, mime, ttl,
+                             auth=a.get("auth", ""))
+            try:
+                # the failed POST may have landed (conn dropped after
+                # persist): best-effort reap so retries can't leak blobs
+                self.delete(failed_fid)
+            except Exception:
+                pass
         return a["fid"]
 
     def lookup_with_auth(self, fid: str) -> tuple[list[str], str]:

@@ -16,14 +16,18 @@ is saved with the merged chunk list.
 
 from __future__ import annotations
 
+import http.client
 import json
+import os
 import threading
 import time
 import urllib.error
 import urllib.parse
+from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
 from ..cache import Singleflight, TieredChunkCache, TTLCache, shared_pool
+from ..filer.assign_lease import AssignLeasePool
 from .dirty_pages import ContinuousIntervals
 from .meta_cache import MetaCache
 
@@ -58,6 +62,9 @@ class FilerClient:
         # token up front instead of paying a guaranteed-401 round trip
         self._read_auth_needed = False
         self._fid_auth: dict[str, tuple[str, float]] = {}
+        # bulk fid lease over the filer's assign proxy: flush fan-outs
+        # draw N write targets from one /__meta__/assign?count=N trip
+        self._lease = AssignLeasePool(self._assign_fetch)
 
     def _get_json(self, path_qs: str) -> Optional[dict]:
         r = self._pool.request("GET", f"http://{self.filer}{path_qs}",
@@ -105,26 +112,64 @@ class FilerClient:
         self._post(urllib.parse.quote(old) + "?"
                    + urllib.parse.urlencode({"mv.to": new}))
 
-    def assign(self, collection: str = "", replication: str = "",
-               ttl: str = "") -> dict:
-        qs = urllib.parse.urlencode({k: v for k, v in
-                                     [("collection", collection),
-                                      ("replication", replication),
-                                      ("ttl", ttl)] if v})
+    def _assign_fetch(self, params: dict, count: int) -> dict:
+        """Lease refill: one real assignment through the filer proxy
+        (?count=N reaches the master's bulk path)."""
+        p = dict(params)
+        if count > 1:
+            p["count"] = str(count)
+        qs = urllib.parse.urlencode(p)
         out = self._get_json("/__meta__/assign" + (f"?{qs}" if qs else ""))
         if out is None or "error" in out:
             raise IOError(f"assign failed: {out}")
         return out
 
+    def assign(self, collection: str = "", replication: str = "",
+               ttl: str = "") -> dict:
+        """One write target from the bulk lease (zero round trips while
+        the lease is live)."""
+        return self._lease.get(collection, replication, ttl)
+
+    def assign_direct(self, collection: str = "", replication: str = "",
+                      ttl: str = "") -> dict:
+        """A genuinely fresh master assignment: direct=true makes the
+        filer proxy bypass ITS lease pool too (which may still hold fids
+        on the volume whose failure triggered this retry)."""
+        params = {k: v for k, v in (("collection", collection),
+                                    ("replication", replication),
+                                    ("ttl", ttl),
+                                    ("direct", "true")) if v}
+        return self._assign_fetch(params, 1)
+
     def upload_chunk(self, assign: dict, data: bytes) -> None:
         headers = {"Content-Type": "application/octet-stream"}
         if assign.get("auth"):
             headers["Authorization"] = f"BEARER {assign['auth']}"
-        r = self._pool.request(
-            "POST", f"http://{assign['url']}/{assign['fid']}",
-            body=data, headers=headers, timeout=300)
+        try:
+            r = self._pool.request(
+                "POST", f"http://{assign['url']}/{assign['fid']}",
+                body=data, headers=headers, timeout=300)
+        except (OSError, http.client.HTTPException):
+            # conn refused / breaker open: this volume is a bad target
+            self._lease.invalidate(assign["fid"])
+            raise
+        if r.status in (404, 409):
+            # volume gone or sealed read-only: the lease is stale
+            self._lease.invalidate(assign["fid"])
         if r.status >= 300:
             raise IOError(f"upload {assign['fid']}: HTTP {r.status}")
+
+    def delete_blob(self, assign: dict) -> None:
+        """Best-effort delete of one assigned blob (the retry path's
+        reap: a failed POST may still have landed on the server)."""
+        headers = ({"Authorization": f"BEARER {assign['auth']}"}
+                   if assign.get("auth") else {})
+        try:
+            self._pool.request(
+                "DELETE", f"http://{assign['url']}/{assign['fid']}",
+                headers=headers, timeout=30)
+        except (OSError, http.client.HTTPException):
+            pass
 
     def read_range(self, path: str, offset: int, size: int) -> bytes:
         r = self._pool.request(
@@ -311,10 +356,45 @@ class FileHandle:
 
     # --- flush ---
     def _upload_interval(self, iv) -> dict:
-        a = self.wfs.client.assign(self.wfs.collection, self.wfs.replication)
-        self.wfs.client.upload_chunk(a, iv.data)
+        client = self.wfs.client
+        a = client.assign(self.wfs.collection, self.wfs.replication)
+        try:
+            client.upload_chunk(a, iv.data)
+        except (OSError, http.client.HTTPException):
+            # the leased target failed (upload_chunk already invalidated
+            # the lease): best-effort reap of the fid (the POST may have
+            # landed before the error) and retry once against a fresh
+            # direct assignment — a new fid, so the re-POST can't
+            # double-write
+            client.delete_blob(a)
+            a = client.assign_direct(self.wfs.collection,
+                                     self.wfs.replication)
+            client.upload_chunk(a, iv.data)
         return {"fid": a["fid"], "offset": iv.start, "size": len(iv.data),
                 "mtime": time.time_ns(), "etag": ""}
+
+    def _upload_intervals(self, ivs: list) -> tuple[list[dict],
+                                                    Optional[Exception]]:
+        """Fan dirty-run uploads through the mount's bounded upload
+        window (same WEED_FILER_UPLOAD_CONCURRENCY knob as the filer's
+        pipelined PUT). Returns (successful chunks in interval order,
+        first error): the caller must KEEP the successes even on partial
+        failure — the intervals are already popped from the dirty set,
+        so dropping a landed chunk would silently lose its bytes."""
+        if len(ivs) <= 1:
+            try:
+                return [self._upload_interval(iv) for iv in ivs], None
+            except Exception as e:
+                return [], e
+        futures = [self.wfs.flush_pool.submit(self._upload_interval, iv)
+                   for iv in ivs]
+        results, first_err = [], None
+        for f in futures:
+            try:
+                results.append(f.result())
+            except Exception as e:
+                first_err = first_err or e
+        return results, first_err
 
     def _flush_largest_locked(self) -> None:
         # early-flushed chunks stay handle-local until flush(); read()
@@ -330,9 +410,16 @@ class FileHandle:
         """Upload remaining dirty runs and save the entry
         (FileHandle.Flush, filehandle.go)."""
         with self._lock:
-            for iv in self.dirty.pop_all():
-                self.entry.setdefault("chunks", []).append(
-                    self._upload_interval(iv))
+            results, err = self._upload_intervals(self.dirty.pop_all())
+            # landed chunks join the entry even when a sibling failed:
+            # a later flush()/release() then saves them (the old serial
+            # loop appended each success before the failure, same
+            # guarantee)
+            self.entry.setdefault("chunks", []).extend(results)
+            if err is not None:
+                self._has_local_chunks = self._has_local_chunks \
+                    or bool(results)
+                raise err
             self.entry.setdefault("attr", {})["mtime"] = time.time()
             self.wfs.client.create_entry(self.entry, free_old_chunks=False)
             self._has_local_chunks = False
@@ -356,6 +443,12 @@ class WFS:
         self.handles: dict[int, FileHandle] = {}
         self._next_fh = 1
         self._lock = threading.Lock()
+        # bounded window for flush fan-out: dirty-run chunk uploads from
+        # one handle overlap instead of paying their latencies end to end
+        workers = max(1, int(os.environ.get(
+            "WEED_FILER_UPLOAD_CONCURRENCY", "") or 4))
+        self.flush_pool = ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="wfs-flush")
         if subscribe:
             self.meta_cache.start_subscriber(filer_url)
 
@@ -570,3 +663,4 @@ class WFS:
         for fh in list(self.handles):
             self.release(fh)
         self.meta_cache.stop()
+        self.flush_pool.shutdown(wait=False)

@@ -22,10 +22,11 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import time
 from typing import Optional
 
-from .. import observe
+from .. import faults, observe
 from ..security.guard import token_from_request
 from ..storage.file_id import FileId
 from ..storage.needle import (FLAG_HAS_LAST_MODIFIED, FLAG_HAS_MIME,
@@ -356,6 +357,17 @@ class FastVolumeProtocol(asyncio.Protocol):
     async def _write(self, fid: FileId, q: dict, headers: dict,
                      body: bytes, raw: bytes) -> None:
         server = self.server
+        # same named fault point as the aiohttp handler: the fastpath
+        # serves the common unreplicated write inline, and chaos drills
+        # against deployed (subprocess) clusters must still reach it
+        try:
+            if await faults.fire_async("volume.write"):
+                self._send(503, json.dumps({"error": "injected drop"}
+                                           ).encode())
+                return
+        except faults.FaultError as e:
+            self._send(500, json.dumps({"error": str(e)}).encode())
+            return
         vol = server.store.find_volume(fid.volume_id)
         if vol is None:
             await self._proxy(raw)  # 404 / EC semantics
@@ -392,8 +404,7 @@ class FastVolumeProtocol(asyncio.Protocol):
         if already_gzipped and compression.is_gzipped(n.data):
             n.set_flag(FLAG_IS_COMPRESSED)
         elif q.get("compress") != "false":
-            import os as _os
-            ext = _os.path.splitext(filename)[1] if filename else ""
+            ext = os.path.splitext(filename)[1] if filename else ""
             payload, compressed = compression.maybe_compress(
                 n.data, ext, ctype)
             if compressed:
@@ -611,6 +622,14 @@ class FastMasterProtocol(FastVolumeProtocol):
         q = _parse_query(query)
         if path == "/dir/assign":
             server.metrics.count("assign")
+            try:
+                if await faults.fire_async("master.assign"):
+                    self._send(503, json.dumps({"error": "injected drop"}
+                                               ).encode())
+                    return
+            except faults.FaultError as e:
+                self._send(500, json.dumps({"error": str(e)}).encode())
+                return
             if not await server.ensure_assign_ready():
                 self._send(503, json.dumps(
                     {"error": "not the leader / not ready"}).encode())

@@ -21,6 +21,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import time
 from typing import Optional
 
@@ -29,13 +30,27 @@ from aiohttp import web
 
 from .. import observe
 from ..filer import manifest as manifest_mod
+from ..filer.assign_lease import AsyncAssignLeasePool
 from ..filer.chunks import FileChunk, etag as chunks_etag, read_plan, total_size
 from ..filer.entry import Entry, new_directory, new_file
 from ..filer.filer import Filer, _norm
 from ..filer.stores import create_store
+from ..filer.upload_window import UploadWindow
 from ..utils import metrics as metrics_mod
 
 log = logging.getLogger("filer.server")
+
+
+class _StaleAssignment(RuntimeError):
+    """A chunk POST bounced with 404/409: the assigned volume is gone or
+    sealed read-only — the lease that minted the fid is stale."""
+
+
+# upload outcomes that poison the fid lease on that volume: the volume
+# answered "wrong target" (stale assignment) or never answered at all
+# (conn refused / timeout — the breaker-open analog for this async path)
+_LEASE_POISON = (_StaleAssignment, aiohttp.ClientError,
+                 asyncio.TimeoutError, OSError)
 
 
 async def _healthz(request: web.Request) -> web.Response:
@@ -110,6 +125,18 @@ class FilerServer:
         # volume-server read (the filer reader's singleflight)
         self._fetch_flight = AsyncSingleflight("filer.fetch",
                                                metrics=self.metrics)
+        # write tier: pipelined chunk uploads ride a bounded in-flight
+        # window; chunk fids come from a bulk-assignment lease pool so
+        # steady-state uploads cost zero master round trips
+        self.upload_concurrency = max(1, int(os.environ.get(
+            "WEED_FILER_UPLOAD_CONCURRENCY", "") or 4))
+        # first lease covers two windows; adaptive doubling takes over
+        # from there (steady-state multi-chunk PUTs stay >90% hits)
+        lease_start = int(os.environ.get("WEED_ASSIGN_LEASE_START", "")
+                          or 2 * self.upload_concurrency)
+        self._assign_pool = AsyncAssignLeasePool(self._assign_fetch,
+                                                 metrics=self.metrics,
+                                                 start_count=lease_start)
         self.notifier = notifier
         if notifier is not None:
             self.filer.meta_log.subscribe(notifier.notify)
@@ -263,13 +290,31 @@ class FilerServer:
         """Proxy a volume assignment to the master, applying the filer's
         default collection/replication policy (AssignVolume RPC,
         weed/server/filer_grpc_server.go) — lets mount/webdav clients talk
-        only to the filer."""
+        only to the filer. ?count=N passes bulk assignment through so
+        mount clients can run their own fid lease (count=1 is served from
+        the filer's lease pool — no master round trip); ?direct=true
+        skips the filer's pool too — the retry path after a failed
+        upload must get a genuinely fresh master assignment, not another
+        fid off the same (possibly stale) lease."""
         q = request.query
         try:
-            a = await self._assign(
-                q.get("collection", self.default_collection),
-                q.get("replication", self.default_replication),
-                q.get("ttl", ""))
+            count = int(q.get("count", 1) or 1)
+        except ValueError:
+            return web.json_response({"error": "invalid count"}, status=400)
+        if count < 1:
+            return web.json_response({"error": "invalid count"}, status=400)
+        try:
+            collection = q.get("collection", self.default_collection)
+            replication = q.get("replication", self.default_replication)
+            ttl = q.get("ttl", "")
+            if count == 1 and q.get("direct") == "true":
+                params = {k: v for k, v in (("collection", collection),
+                                            ("replication", replication),
+                                            ("ttl", ttl)) if v}
+                a = await self._assign_fetch(params, 1)
+            else:
+                a = await self._assign(collection, replication, ttl,
+                                       count=count)
         except web.HTTPError as e:
             return web.json_response({"error": e.text}, status=500)
         return web.json_response(a)
@@ -422,7 +467,6 @@ class FilerServer:
         location deltas, so chunk reads stop polling /dir/lookup
         (wdclient/masterclient.go:95-151). Stream loss redials the next
         master and picks up a fresh snapshot."""
-        import json as json_mod
         while True:
             try:
                 async with self._session.get(
@@ -430,7 +474,7 @@ class FilerServer:
                         timeout=aiohttp.ClientTimeout(total=None,
                                                       sock_read=3600)) as r:
                     async for line in r.content:
-                        msg = json_mod.loads(line)
+                        msg = json.loads(line)
                         if msg.get("type") == "snapshot":
                             self._vid_cache.clear()
                             for vid, locs in \
@@ -589,23 +633,37 @@ class FilerServer:
             self._vid_cache.put(vid, urls)
         return urls
 
-    async def _assign(self, collection: str, replication: str,
-                      ttl: str) -> dict:
-        params = {"collection": collection, "replication": replication,
-                  "ttl": ttl}
-        body = await self._master_get(
-            "/dir/assign", {k: v for k, v in params.items() if v})
+    async def _assign_fetch(self, params: dict, count: int) -> dict:
+        """One real master assignment (the lease pool's refill hook and
+        the direct path); rides the HA-rotating _master_get."""
+        p = dict(params)
+        if count > 1:
+            p["count"] = str(count)
+        body = await self._master_get("/dir/assign", p)
         if "error" in body:
             raise web.HTTPInternalServerError(text=body["error"])
         return body
 
+    async def _assign(self, collection: str, replication: str,
+                      ttl: str, count: int = 1) -> dict:
+        """Leased assignment: served from the per-(collection,
+        replication, ttl) fid lease when one is live, refilled via
+        /dir/assign?count=N otherwise. count>1 always goes to the master
+        (the caller wants a batch of its own)."""
+        if count > 1:
+            params = {k: v for k, v in (("collection", collection),
+                                        ("replication", replication),
+                                        ("ttl", ttl)) if v}
+            return await self._assign_fetch(params, count)
+        return await self._assign_pool.get(collection, replication, ttl)
+
     async def _upload_chunk(self, data: bytes, collection: str,
                             replication: str, ttl: str,
                             offset: int, name_hint: str = "",
-                            mime_hint: str = "") -> FileChunk:
+                            mime_hint: str = "",
+                            attempted: Optional[list] = None) -> FileChunk:
         with observe.span("filer.upload_chunk",
                           tags={"bytes": len(data)}):
-            a = await self._assign(collection, replication, ttl)
             cipher_key = ""
             payload = data
             if self.cipher:
@@ -617,39 +675,75 @@ class FilerServer:
                     await asyncio.get_event_loop().run_in_executor(
                         None, cipher_mod.encrypt, data)
                 cipher_key = cipher_mod.key_to_str(key)
-            form = aiohttp.FormData()
-            # name/mime hints let the volume server's compression decision
-            # table see the real content type (chunks themselves are
-            # opaque)
-            form.add_field("file", payload,
-                           filename=name_hint or "chunk",
-                           content_type=(mime_hint if not cipher_key
-                                         else "")
-                           or "application/octet-stream")
-            url = f"http://{a['url']}/{a['fid']}"
-            params = []
-            if cipher_key:
-                # ciphertext is incompressible, must round-trip bit-exact
-                params.append("compress=false")
-            if ttl:
-                params.append(f"ttl={ttl}")
-            if params:
-                url += "?" + "&".join(params)
-            headers = {}
-            if a.get("auth"):
-                # carry the master-signed per-fid write token to the
-                # volume server (weed/security/jwt.go)
-                headers["Authorization"] = f"BEARER {a['auth']}"
-            async with self._session.post(url, data=form,
-                                          headers=headers) as r:
-                if r.status >= 300:
-                    raise web.HTTPBadGateway(
-                        text=f"chunk upload to {a['url']}: {r.status}")
-                body = await r.json()
-            return FileChunk(fid=a["fid"], offset=offset, size=len(data),
-                             mtime=time.time_ns(),
-                             etag=body.get("eTag", ""),
-                             cipher_key=cipher_key)
+            last: Optional[Exception] = None
+            for attempt in range(2):
+                a = await self._assign(collection, replication, ttl)
+                rec = FileChunk(fid=a["fid"], offset=offset,
+                                size=len(data))
+                if attempted is not None:
+                    # recorded BEFORE the POST: a failure anywhere past
+                    # this point must delete the fid (never-landed fids
+                    # delete as a benign 404)
+                    attempted.append(rec)
+                try:
+                    body = await self._post_chunk(a, payload, cipher_key,
+                                                  ttl, name_hint, mime_hint)
+                except _LEASE_POISON as e:
+                    # the leased volume is gone/sealed/unreachable: drop
+                    # every lease on it and retry once against a fresh
+                    # assignment (a new fid, so the re-POST is safe).
+                    # The failed attempt may have LANDED (timeout after
+                    # persist): queue its delete now — if the whole PUT
+                    # later aborts, the second delete is a benign 404
+                    self._assign_pool.invalidate(a["fid"])
+                    self._queue_chunk_deletes([rec])
+                    last = e
+                    continue
+                return FileChunk(fid=a["fid"], offset=offset,
+                                 size=len(data), mtime=time.time_ns(),
+                                 etag=body.get("eTag", ""),
+                                 cipher_key=cipher_key)
+            raise web.HTTPBadGateway(text=f"chunk upload failed: {last}")
+
+    async def _post_chunk(self, a: dict, payload: bytes, cipher_key: str,
+                          ttl: str, name_hint: str,
+                          mime_hint: str) -> dict:
+        form = aiohttp.FormData()
+        # name/mime hints let the volume server's compression decision
+        # table see the real content type (chunks themselves are
+        # opaque)
+        form.add_field("file", payload,
+                       filename=name_hint or "chunk",
+                       content_type=(mime_hint if not cipher_key
+                                     else "")
+                       or "application/octet-stream")
+        url = f"http://{a['url']}/{a['fid']}"
+        params = []
+        if cipher_key:
+            # ciphertext is incompressible, must round-trip bit-exact
+            params.append("compress=false")
+        if ttl:
+            params.append(f"ttl={ttl}")
+        if params:
+            url += "?" + "&".join(params)
+        headers = {}
+        if a.get("auth"):
+            # carry the master-signed per-fid write token to the
+            # volume server (weed/security/jwt.go)
+            headers["Authorization"] = f"BEARER {a['auth']}"
+        async with self._session.post(url, data=form,
+                                      headers=headers) as r:
+            if r.status in (401, 404, 409):
+                # volume deleted / sealed read-only under the lease, or
+                # the lease's pre-signed write token outlived the jwt
+                # expiry (default 10s — the same order as the lease TTL):
+                # all three mean "this assignment is stale", retry fresh
+                raise _StaleAssignment(
+                    f"chunk upload to {a['url']}: {r.status}")
+            if r.status >= 300:
+                raise web.HTTPBadGateway(
+                    text=f"chunk upload to {a['url']}: {r.status}")
+            return await r.json()
 
     async def _cache_get(self, fid: str):
         """Chunk-cache lookup that keeps disk-tier file I/O (and the
@@ -873,40 +967,65 @@ class FilerServer:
                 mime = part.headers["Content-Type"]
             reader = part
         chunks: list[FileChunk] = []
+        # every fid we ever asked a volume server to store — the failure
+        # path deletes ALL of them (a never-landed fid deletes as a
+        # benign 404), so a mid-stream abort leaves zero orphans
+        attempted: list[FileChunk] = []
         offset = 0
+        name_hint = path.rsplit("/", 1)[-1]
         old_entry = await asyncio.get_event_loop().run_in_executor(
             None, self.filer.find_entry, path)
+
+        async def upload(index: int, data: bytes, at: int) -> FileChunk:
+            return await self._upload_chunk(
+                data, collection, replication, ttl, at,
+                name_hint=name_hint, mime_hint=mime, attempted=attempted)
+
+        # pipelined upload: the body keeps streaming into the next chunk
+        # while up to WEED_FILER_UPLOAD_CONCURRENCY previous chunks
+        # encrypt (executor) and POST concurrently; completions may land
+        # out of order, the offset sort below restores the logical list
+        window = UploadWindow(upload, self.upload_concurrency,
+                              metrics=self.metrics)
         try:
-            while True:
-                # accumulate a full chunk: both aiohttp readers return
-                # whatever is buffered, not the requested size
-                buf = bytearray()
-                while len(buf) < self.chunk_size:
-                    want = self.chunk_size - len(buf)
-                    more = (await reader.read_chunk(want) if reader is not None
-                            else await request.content.read(want))
-                    if not more:
+            with observe.span("filer.upload.window") as sp:
+                while True:
+                    # accumulate a full chunk: both aiohttp readers return
+                    # whatever is buffered, not the requested size
+                    buf = bytearray()
+                    while len(buf) < self.chunk_size:
+                        want = self.chunk_size - len(buf)
+                        more = (await reader.read_chunk(want)
+                                if reader is not None
+                                else await request.content.read(want))
+                        if not more:
+                            break
+                        buf += more
+                    if not buf:
                         break
-                    buf += more
-                data = bytes(buf)
-                if not data:
-                    break
-                chunks.append(await self._upload_chunk(
-                    bytes(data), collection, replication, ttl, offset,
-                    name_hint=path.rsplit("/", 1)[-1], mime_hint=mime))
-                offset += len(data)
-        except web.HTTPError:
-            # clean up whatever we uploaded
-            self._queue_chunk_deletes(chunks)
+                    # one immutable copy of the 8 MB buffer, passed
+                    # through to FormData as-is
+                    await window.submit(bytes(buf), offset)
+                    offset += len(buf)
+                chunks = await window.drain()
+                chunks.sort(key=lambda c: c.offset)
+                sp.tags["chunks"] = len(chunks)
+                sp.tags["stall_ms"] = round(window.stall_s * 1000, 1)
+            if len(chunks) > self.manifest_batch:
+                # super-large file: fold chunk groups into manifest blobs
+                # (filechunk_manifest.go:41-120)
+                async def save_manifest(blob: bytes, at: int) -> FileChunk:
+                    return await self._upload_chunk(
+                        blob, collection, replication, ttl, at,
+                        attempted=attempted)
+                chunks = await manifest_mod.maybe_manifestize(
+                    chunks, save_manifest, self.manifest_batch)
+        except BaseException:
+            # cancel the in-flight window, then clean up every chunk that
+            # did (or might have) landed
+            await window.abort()
+            self._queue_chunk_deletes(attempted)
             raise
-        if len(chunks) > self.manifest_batch:
-            # super-large file: fold chunk groups into manifest blobs
-            # (filechunk_manifest.go:41-120)
-            async def save_manifest(blob: bytes, at: int) -> FileChunk:
-                return await self._upload_chunk(blob, collection,
-                                                replication, ttl, at)
-            chunks = await manifest_mod.maybe_manifestize(
-                chunks, save_manifest, self.manifest_batch)
         entry = new_file(_norm(path), chunks, mime=mime,
                          collection=collection, replication=replication)
         if request.query.get("ttl"):

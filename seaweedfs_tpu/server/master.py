@@ -17,6 +17,8 @@ versions of the gRPC admin RPCs (weed/pb/master.proto:10-34):
 from __future__ import annotations
 
 import asyncio
+import json
+import os
 import logging
 import time
 from typing import Optional
@@ -34,6 +36,10 @@ from ..topology.topology import Topology
 from ..utils import metrics as metrics_mod
 
 log = logging.getLogger("master")
+
+# ceiling on /dir/assign?count=N (weed caps bulk assignment too): the
+# auths list signs one jwt per derivative fid, so N must stay bounded
+MAX_ASSIGN_COUNT = 10000
 
 # routes every master answers itself; everything else is proxied to the
 # Raft leader by followers (proxyToLeader, weed/server/master_server.go:156)
@@ -299,8 +305,7 @@ class MasterServer:
         Rate-limited so unknown clients can't turn the master into a DNS
         query loop, and resolved off-loop so a slow resolver never stalls
         raft heartbeats."""
-        import time as time_mod
-        now = time_mod.monotonic()
+        now = time.monotonic()
         if now - self._peer_resolve_ts < 2.0:
             return False
         self._peer_resolve_ts = now
@@ -370,6 +375,12 @@ class MasterServer:
         """Assign a write target (dirAssignHandler,
         weed/server/master_server_handlers.go:96-150)."""
         self.metrics.count("assign")
+        try:
+            if await faults.fire_async("master.assign"):
+                return web.json_response({"error": "injected drop"},
+                                         status=503)
+        except faults.FaultError as e:
+            return web.json_response({"error": str(e)}, status=500)
         if not await self.ensure_assign_ready():
             return web.json_response(
                 {"error": "not the leader / not ready"}, status=503)
@@ -409,6 +420,11 @@ class MasterServer:
             # a negative count would roll the sequencer backwards and
             # re-mint keys already handed to other clients
             return ({"error": "invalid count"}, 400)
+        if count > MAX_ASSIGN_COUNT:
+            # unbounded count is a one-request DoS: O(count) jwt signing
+            # on the event loop plus a burned sequencer range; lease
+            # pools cap themselves far below this
+            return ({"error": f"count exceeds {MAX_ASSIGN_COUNT}"}, 400)
         replication = replication or self.default_replication
         picked = self.topology.pick_for_write(collection, replication, ttl)
         if picked is None:
@@ -456,6 +472,15 @@ class MasterServer:
         auth = self.guard.sign_write(str(fid))
         if auth:
             resp["auth"] = auth
+            if count > 1:
+                # bulk assignment hands out derivative fids fid_1..fid_{N-1}
+                # (key+delta, same cookie); the volume server verifies each
+                # against its canonical form, so every derivative needs its
+                # own signed token
+                resp["auths"] = [auth] + [
+                    self.guard.sign_write(
+                        str(FileId(vid, key + d, fid.cookie)))
+                    for d in range(1, count)]
         return resp, 200
 
     async def dir_lookup(self, request: web.Request) -> web.Response:
@@ -1130,7 +1155,6 @@ class MasterServer:
         """Long-lived JSON-lines stream of vid-location deltas. Followers
         redirect to the leader (they receive no heartbeats); clients keep
         a vid cache fed by this stream instead of polling /dir/lookup."""
-        import json as json_mod
         if not self.raft.is_leader:
             leader = self.raft.leader_id
             if not leader or leader == self.raft.id:
@@ -1145,10 +1169,10 @@ class MasterServer:
         self._watchers.add(q)
         try:
             await resp.write(
-                json_mod.dumps(self._location_snapshot()).encode() + b"\n")
+                json.dumps(self._location_snapshot()).encode() + b"\n")
             while True:
                 msg = await q.get()
-                await resp.write(json_mod.dumps(msg).encode() + b"\n")
+                await resp.write(json.dumps(msg).encode() + b"\n")
                 if msg.get("type") == "resync":
                     # overflow: the broadcaster already unsubscribed us;
                     # end the stream so the client redials for a snapshot
@@ -1164,9 +1188,8 @@ class MasterServer:
         """Lease the cluster-exclusive admin lock (shared by HTTP + gRPC).
         Renew by presenting the previous token; a stale holder's lease
         expires after admin_lease_seconds (LeaseAdminToken semantics)."""
-        import time as time_mod
         name = name or "admin"
-        now = time_mod.time()
+        now = time.time()
         held = self._admin_locks.get(name)
         if held and held[2] > now and held[0] != previous_token:
             return ({"error": f"lock {name} held by {held[1]}",
@@ -1267,8 +1290,7 @@ async def run_master(host: str, port: int, tls=None,
     server/fastpath.py) with the aiohttp app on an internal loopback
     port; fastpath=False (or env SEAWEEDFS_NO_FASTPATH) serves aiohttp
     directly."""
-    import os as _os
-    if _os.environ.get("SEAWEEDFS_NO_FASTPATH"):
+    if os.environ.get("SEAWEEDFS_NO_FASTPATH"):
         fastpath = False
     server = MasterServer(tls=tls, url=kwargs.pop("url", f"{host}:{port}"),
                           **kwargs)

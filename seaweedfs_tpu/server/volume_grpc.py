@@ -16,6 +16,7 @@ import asyncio
 import json
 import logging
 import os
+import shutil
 
 import grpc
 
@@ -293,13 +294,12 @@ class VolumeGrpcServicer:
     async def VolumeSyncStatus(self, request: pb.VolumeRef, context):
         """Tail offset + compaction revision for incremental sync
         (VolumeSyncStatus, volume_grpc_sync.go)."""
-        import os as _os
         v = self.store.find_volume(request.volume_id)
         if v is None:
             return pb.VolumeSyncStatusResponse(error="volume not found")
         idx_path = v.base_file_name() + ".idx"
-        idx_size = (_os.path.getsize(idx_path)
-                    if _os.path.exists(idx_path) else 0)
+        idx_size = (os.path.getsize(idx_path)
+                    if os.path.exists(idx_path) else 0)
         return pb.VolumeSyncStatusResponse(
             volume_id=request.volume_id,
             collection=v.collection,
@@ -481,7 +481,6 @@ class VolumeGrpcServicer:
 
     # --- server-level ---
     async def VolumeServerStatus(self, request, context):
-        import shutil
         disks = []
         vol_count = 0
         ec_count = 0

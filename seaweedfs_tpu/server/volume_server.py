@@ -28,8 +28,12 @@ then POSTs the same body to every replica; all must ack.
 from __future__ import annotations
 
 import asyncio
+import email.parser
 import logging
+import os
 import socket
+import time
+import uuid
 from typing import Optional
 
 import aiohttp
@@ -216,10 +220,9 @@ class VolumeServer:
         # EC scrubber: low-priority digest verify of local shards
         # (WEED_EC_SCRUB_INTERVAL seconds; 0 disables)
         if scrub_interval_seconds is None:
-            import os as _os
             try:
                 scrub_interval_seconds = float(
-                    _os.environ.get("WEED_EC_SCRUB_INTERVAL", "3600"))
+                    os.environ.get("WEED_EC_SCRUB_INTERVAL", "3600"))
             except ValueError:
                 scrub_interval_seconds = 3600.0
         self.scrub_interval_seconds = scrub_interval_seconds
@@ -646,8 +649,7 @@ class VolumeServer:
     _REPAIR_MAX_INFLIGHT = 8
 
     def _repair_permitted(self, fid_str: str) -> bool:
-        import time as time_mod
-        now = time_mod.monotonic()
+        now = time.monotonic()
         if len(self._repair_neg) > 4096:
             self._repair_neg = {k: v for k, v in self._repair_neg.items()
                                 if now - v < self._REPAIR_NEG_TTL}
@@ -671,7 +673,6 @@ class VolumeServer:
             self._repair_inflight -= 1
 
     async def _read_repair_inner(self, fid: FileId, NeedleCls):
-        import time as time_mod
 
         from ..utils.retry import BreakerOpen, shared_breaker
         breaker = shared_breaker()
@@ -713,7 +714,7 @@ class VolumeServer:
                     breaker.record_failure(url)
                 log.warning("read repair of %s from %s failed: %s",
                             fid, url, e)
-        self._repair_neg[str(fid)] = time_mod.monotonic()
+        self._repair_neg[str(fid)] = time.monotonic()
         return None
 
     async def admin_needle_raw(self, request: web.Request) -> web.Response:
@@ -785,7 +786,6 @@ class VolumeServer:
             if part is None:
                 # irregular shape (multi-part, escaped quoting, base64
                 # parts): full mime parse of the buffered body
-                import email.parser
                 msg = email.parser.BytesParser().parsebytes(
                     b"Content-Type: " + raw_ct.encode("utf-8", "replace")
                     + b"\r\n\r\n" + body)
@@ -823,8 +823,7 @@ class VolumeServer:
         if already_gzipped and compression.is_gzipped(n.data):
             n.set_flag(FLAG_IS_COMPRESSED)
         elif request.query.get("compress") != "false":
-            import os as _os
-            ext = _os.path.splitext(filename)[1] if filename else ""
+            ext = os.path.splitext(filename)[1] if filename else ""
             payload, compressed = compression.maybe_compress(
                 n.data, ext, ctype)
             if compressed:
@@ -836,9 +835,8 @@ class VolumeServer:
         if ttl_s:
             n.set_flag(FLAG_HAS_TTL)
             n.ttl = t.TTL.parse(ttl_s)
-        import time as _time
         n.set_flag(FLAG_HAS_LAST_MODIFIED)
-        n.last_modified = int(_time.time())
+        n.last_modified = int(time.time())
 
         with self.metrics.timed("write"), \
                 observe.span("volume.write", tags={"fid": str(fid)}):
@@ -878,14 +876,13 @@ class VolumeServer:
         if not replicas:
             return True
 
-        import uuid as uuid_mod
 
         def body_for_replica() -> tuple[bytes, str]:
             # raw multipart so name/mime survive on the replica and its
             # needle bytes match the primary's; already-compressed payloads
             # carry Content-Encoding so the replica sets the compressed
             # flag instead of re-compressing/mis-flagging
-            boundary = uuid_mod.uuid4().hex
+            boundary = uuid.uuid4().hex
             name = (n.name.decode("utf-8", "replace")
                     if n.has(FLAG_HAS_NAME) else "file")
             ctype = (n.mime.decode("utf-8", "replace")
@@ -931,9 +928,8 @@ class VolumeServer:
         # short-TTL cache: the replicated-write fan-out otherwise pays a
         # master lookup per request (getWritableRemoteReplications caches
         # the same way, weed/topology/store_replicate.go:163)
-        import time as time_mod
         cached = self._replica_cache.get(vid)
-        if cached and time_mod.monotonic() - cached[1] < 10.0:
+        if cached and time.monotonic() - cached[1] < 10.0:
             return cached[0]
         try:
             async with self._session.get(
@@ -944,7 +940,7 @@ class VolumeServer:
                 body = await r.json()
                 urls = [loc["url"] for loc in body.get("locations", [])
                         if loc["url"] != self.url]
-                self._replica_cache[vid] = (urls, time_mod.monotonic())
+                self._replica_cache[vid] = (urls, time.monotonic())
                 return urls
         except Exception:
             return []
@@ -1224,7 +1220,6 @@ class VolumeServer:
         shard_ids = [int(s) for s in body["shard_ids"]]
         source = body["source"]
         copy_ecx = body.get("copy_ecx_file", False)
-        import os
         from .. import ec as ec_mod
         loc = self.store.locations[0]
         prefix = f"{collection}_" if collection else ""
@@ -1307,9 +1302,8 @@ class VolumeServer:
                          force: bool = False) -> list[str]:
         """Tiered-TTL cache of vid -> shard -> holder urls."""
         import json as _json
-        import time as time_mod
         import urllib.request
-        now = time_mod.monotonic()
+        now = time.monotonic()
         cached = self._shard_loc_cache.get(vid)
         if cached is not None and not force:
             shards, fetched = cached
@@ -1341,7 +1335,6 @@ class VolumeServer:
 
         def fetch_grpc(url: str, shard_id: int, offset: int,
                        size: int) -> Optional[bytes]:
-            import time as _time
 
             import grpc as grpc_mod
 
@@ -1349,7 +1342,7 @@ class VolumeServer:
             from ..pb.rpc import VolumeServerStub, grpc_address
             # peers whose +10000 gRPC port is closed/filtered go HTTP-first
             # for a while instead of paying the deadline on every shard
-            if _time.time() < self._peer_grpc_dead.get(url, 0):
+            if time.time() < self._peer_grpc_dead.get(url, 0):
                 return None
             try:
                 # channels are thread-safe and reconnect internally; one
@@ -1378,7 +1371,7 @@ class VolumeServer:
             except grpc_mod.RpcError as e:
                 if e.code() in (grpc_mod.StatusCode.UNAVAILABLE,
                                 grpc_mod.StatusCode.DEADLINE_EXCEEDED):
-                    self._peer_grpc_dead[url] = _time.time() + 60.0
+                    self._peer_grpc_dead[url] = time.time() + 60.0
                 return None
 
         def fetch(url: str, shard_id: int, offset: int,
@@ -1494,7 +1487,6 @@ class VolumeServer:
     async def admin_file_copy(self, request: web.Request) -> web.StreamResponse:
         """Stream a volume/shard file to a pulling peer (CopyFile,
         weed/server/volume_grpc_copy.go:24-281)."""
-        import os
         q = request.query
         vid = int(q["volume_id"])
         collection = q.get("collection", "")
@@ -1558,7 +1550,6 @@ class VolumeServer:
     async def admin_volume_copy(self, request: web.Request) -> web.Response:
         """Pull a whole volume (.dat + .idx) from a source server and mount
         it (VolumeCopy pull model, weed/server/volume_grpc_copy.go:24-151)."""
-        import os
         body = await request.json()
         vid = int(body["volume_id"])
         collection = body.get("collection", "")
@@ -1704,8 +1695,7 @@ async def run_volume_server(host: str, port: int, store: Store,
     (server/fastpath.py) with the aiohttp app on an internal loopback
     port for everything it proxies; fastpath=False (or env
     SEAWEEDFS_NO_FASTPATH) serves aiohttp directly on the public port."""
-    import os as _os
-    if _os.environ.get("SEAWEEDFS_NO_FASTPATH"):
+    if os.environ.get("SEAWEEDFS_NO_FASTPATH"):
         fastpath = False
     server = VolumeServer(store, master_url, url=f"{host}:{port}", **kwargs)
     runner = web.AppRunner(server.app, access_log=None)

@@ -1,0 +1,210 @@
+"""Bulk fid assignment (master) + the AssignLease pool (client/filer).
+
+Covers the previously untested ``count`` parse at the master's
+/dir/assign (satellite: master.py:378 had no coverage): N usable fids
+per assignment in the reference's derivative form (fid, fid_1, ...),
+correct sequencer advancement, and rejection of count<=0 — plus the
+lease pool's hit/miss accounting, adaptive sizing, TTL expiry and
+invalidation semantics the write tier depends on.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cluster_util import Cluster
+from seaweedfs_tpu.filer.assign_lease import (AssignLeasePool,
+                                              AsyncAssignLeasePool)
+from seaweedfs_tpu.storage.file_id import FileId, derive_fid
+from seaweedfs_tpu.utils import metrics as metrics_mod
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(n_volume_servers=2, pulse=0.15)
+    yield c
+    c.shutdown()
+
+
+# --- master /dir/assign?count=N ---
+
+def test_bulk_assign_returns_usable_derivative_fids(cluster):
+    out = cluster.client.assign(count=5)
+    assert out["count"] == 5
+    base = FileId.parse(out["fid"])
+    payloads = {}
+    for d in range(5):
+        fid = derive_fid(out["fid"], d)
+        # the derivative parses to key+delta with the shared cookie
+        parsed = FileId.parse(fid)
+        assert parsed.key == base.key + d
+        assert parsed.cookie == base.cookie
+        data = f"bulk-chunk-{d}".encode() * 50
+        cluster.client.upload_blob(out["url"], fid, data)
+        payloads[fid] = data
+    for fid, data in payloads.items():
+        assert cluster.client.download(fid) == data
+
+
+def test_bulk_assign_advances_sequencer_past_batch(cluster):
+    a = cluster.client.assign(count=7)
+    b = cluster.client.assign(count=1)
+    # the whole reserved range [key, key+7) must never be re-minted
+    assert FileId.parse(b["fid"]).key >= FileId.parse(a["fid"]).key + 7
+
+
+def test_bulk_assign_caps_count(cluster):
+    """Unbounded count would sign O(count) jwts on the loop and burn a
+    huge sequencer range — the master rejects past MAX_ASSIGN_COUNT."""
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            f"http://{cluster.master_url.split(',')[0]}"
+            f"/dir/assign?count=100000000", timeout=10)
+    assert ei.value.code == 400
+    assert "count exceeds" in json.load(ei.value)["error"]
+
+
+@pytest.mark.parametrize("count", ["0", "-3", "abc"])
+def test_bulk_assign_rejects_bad_count(cluster, count):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            f"http://{cluster.master_url.split(',')[0]}"
+            f"/dir/assign?count={count}", timeout=10)
+    assert ei.value.code == 400
+    assert "invalid count" in json.load(ei.value)["error"]
+
+
+# --- lease pool unit behavior (no cluster) ---
+
+def _fake_fetch_factory(vid_box=None, auths=False):
+    """fetch(params, count) stub minting deterministic fids; counts
+    calls."""
+    state = {"calls": 0, "key": 16}
+
+    def fetch(params, count):
+        state["calls"] += 1
+        vid = (vid_box or [7])[0]
+        key = state["key"]
+        state["key"] += count
+        resp = {"fid": f"{vid},{key:x}000000ff", "url": "vs:1",
+                "publicUrl": "vs:1", "count": count, "replicas": []}
+        if auths:
+            resp["auth"] = f"tok-{key:x}-0"
+            resp["auths"] = [f"tok-{key:x}-{d}" for d in range(count)]
+        return resp
+
+    return fetch, state
+
+
+def test_lease_pool_hits_after_one_miss():
+    reg = metrics_mod.Registry("t1")
+    fetch, state = _fake_fetch_factory()
+    pool = AssignLeasePool(fetch, metrics=reg, start_count=8, ttl=30.0,
+                           enabled=True)
+    fids = [pool.get()["fid"] for _ in range(8)]
+    assert len(set(fids)) == 8
+    assert state["calls"] == 1
+    assert reg.value("assign_lease_miss") == 1
+    assert reg.value("assign_lease_hit") == 7
+    # canonical resolved derivatives: consecutive keys, shared cookie
+    parsed = [FileId.parse(f) for f in fids]
+    assert [p.key for p in parsed] == \
+        [parsed[0].key + d for d in range(8)]
+    assert len({p.cookie for p in parsed}) == 1
+    assert all("_" not in f for f in fids)
+
+
+def test_lease_pool_keys_are_isolated():
+    fetch, state = _fake_fetch_factory()
+    pool = AssignLeasePool(fetch, start_count=4, ttl=30.0, enabled=True)
+    a = pool.get(collection="a")
+    b = pool.get(collection="b")
+    assert a["fid"] != b["fid"]
+    assert state["calls"] == 2
+    # each key serves from its own lease afterwards
+    pool.get(collection="a")
+    pool.get(collection="b")
+    assert state["calls"] == 2
+
+
+def test_lease_pool_grows_on_drain_and_shrinks_on_expiry():
+    fetch, state = _fake_fetch_factory()
+    pool = AssignLeasePool(fetch, start_count=4, max_count=64, ttl=0.15,
+                           enabled=True)
+    for _ in range(4):
+        pool.get()
+    # drained before TTL -> next refill asks for double
+    pool.get()
+    assert state["calls"] == 2
+    assert int(pool.core._leases[("", "", "")].count) == 8
+    # let it expire mostly unused -> the following lease halves
+    time.sleep(0.2)
+    pool.get()
+    assert int(pool.core._leases[("", "", "")].count) == 4
+
+
+def test_lease_pool_ttl_expiry_refetches():
+    fetch, state = _fake_fetch_factory()
+    pool = AssignLeasePool(fetch, start_count=4, ttl=0.05, enabled=True)
+    first = pool.get()["fid"]
+    time.sleep(0.08)
+    second = pool.get()["fid"]
+    assert state["calls"] == 2
+    assert first.split(",")[1].split("_")[0] != \
+        second.split(",")[1].split("_")[0]
+
+
+def test_lease_pool_invalidate_drops_volume():
+    reg = metrics_mod.Registry("t2")
+    vid_box = [9]
+    fetch, state = _fake_fetch_factory(vid_box=vid_box)
+    pool = AssignLeasePool(fetch, metrics=reg, start_count=8, ttl=30.0,
+                           enabled=True)
+    a = pool.get()
+    vid_box[0] = 10  # the "replacement" volume after invalidation
+    assert pool.invalidate(a["fid"]) == 1
+    b = pool.get()
+    assert b["fid"].startswith("10,")
+    assert state["calls"] == 2
+    assert reg.value("assign_lease_invalidate") == 1
+
+
+def test_lease_pool_hands_out_per_derivative_auths():
+    fetch, _ = _fake_fetch_factory(auths=True)
+    pool = AssignLeasePool(fetch, start_count=4, ttl=30.0, enabled=True)
+    got = [pool.get() for _ in range(4)]
+    for d, a in enumerate(got):
+        assert a["auth"].endswith(f"-{d}")
+
+
+def test_lease_pool_disabled_is_passthrough():
+    fetch, state = _fake_fetch_factory()
+    pool = AssignLeasePool(fetch, start_count=8, enabled=False)
+    pool.get()
+    pool.get()
+    assert state["calls"] == 2
+
+
+def test_async_lease_pool_coalesces_concurrent_misses():
+    """N concurrent first-chunk assigns must produce ONE master round
+    trip (the refill runs under the pool mutex)."""
+    import asyncio
+
+    async def main():
+        calls = {"n": 0}
+
+        async def fetch(params, count):
+            calls["n"] += 1
+            await asyncio.sleep(0.01)
+            return {"fid": "3,10000000aa", "url": "vs:1", "count": count}
+
+        pool = AsyncAssignLeasePool(fetch, start_count=16, ttl=30.0,
+                                    enabled=True)
+        fids = await asyncio.gather(*[pool.get() for _ in range(8)])
+        assert calls["n"] == 1
+        assert len({a["fid"] for a in fids}) == 8
+
+    asyncio.run(main())

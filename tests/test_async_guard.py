@@ -15,6 +15,7 @@ is exactly the run_in_executor pattern (the sync fn runs off-loop).
 
 import ast
 import os
+import sys
 
 import seaweedfs_tpu
 
@@ -97,6 +98,73 @@ def test_no_blocking_calls_in_async_bodies():
                         f"{hit[0]}.{hit[1]}() on the event loop — use "
                         "run_in_executor")
     assert not violations, "\n".join(violations)
+
+
+def _stdlib_imports_in_async_bodies(tree: ast.Module):
+    """(lineno, fn_name, module) for every stdlib import lexically inside
+    an ``async def`` body (not descending into nested defs). Stdlib
+    modules are never optional deps and never circular, so a
+    function-local import there is pure per-request overhead — the
+    pattern PR 1 (push_loop) and the write-tier hoist removed. Package
+    and third-party imports stay exempt: those are deliberate lazy loads
+    (optional grpc, circular-import breaks)."""
+    stdlib = sys.stdlib_module_names
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.AsyncFunctionDef):
+            continue
+        stack = list(node.body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if isinstance(n, ast.Import):
+                for a in n.names:
+                    if a.name.split(".")[0] in stdlib:
+                        yield n.lineno, node.name, a.name
+            elif isinstance(n, ast.ImportFrom) and n.level == 0 and \
+                    n.module and n.module.split(".")[0] in stdlib:
+                yield n.lineno, node.name, n.module
+            else:
+                stack.extend(ast.iter_child_nodes(n))
+
+
+def test_no_function_local_stdlib_imports_in_async_handlers():
+    """Request handlers must not re-import stdlib modules per call:
+    `import uuid`/`os`/`time` inside the volume server's _write/_replicate
+    showed up in write-path profiles (dict lookups + import-lock traffic
+    on every request). The hoist is free — this keeps it permanent."""
+    violations = []
+    for path in _guarded_files():
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        for lineno, fn, mod in _stdlib_imports_in_async_bodies(tree):
+            rel = os.path.relpath(path, PKG_ROOT)
+            violations.append(
+                f"{rel}:{lineno} async def {fn} imports {mod} per call "
+                "— hoist it to module level")
+    assert not violations, "\n".join(violations)
+
+
+def test_import_guard_walker_catches_violations():
+    """The import walker must flag stdlib imports in async bodies, and
+    must NOT flag module-level imports, package-relative imports, or
+    imports inside nested sync defs (executor bodies)."""
+    src = (
+        "import os\n"
+        "async def bad():\n"
+        "    import uuid\n"
+        "    from time import sleep\n"
+        "async def good(loop):\n"
+        "    from ..utils import cipher\n"
+        "    from aiohttp import web\n"
+        "    def _sync():\n"
+        "        import json\n"
+        "    await loop.run_in_executor(None, _sync)\n"
+    )
+    hits = sorted(m for _, _, m in
+                  _stdlib_imports_in_async_bodies(ast.parse(src)))
+    assert hits == ["time", "uuid"]
 
 
 def test_guard_walker_catches_violations():
