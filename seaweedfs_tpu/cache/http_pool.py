@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import http.client
 import threading
+import time
 import urllib.parse
 from typing import Optional
 
@@ -49,10 +50,17 @@ class PoolResponse:
 
 class HttpPool:
     def __init__(self, max_idle_per_host: int = 8,
-                 timeout: float = 30.0, metrics=None, breaker=None):
+                 timeout: float = 30.0, metrics=None, breaker=None,
+                 shed_retries: int = 1):
         self.max_idle_per_host = max_idle_per_host
         self.default_timeout = timeout
         self.metrics = metrics
+        # how many times one request() call backs off and re-sends after
+        # a shed (X-Seaweed-Shed) 429/503 — the cooperative-client half
+        # of the overload plane; a shed answer means the server refused
+        # the request BEFORE doing any work, so even non-idempotent
+        # methods are safe to re-send
+        self.shed_retries = max(0, shed_retries)
         # per-host circuit breaker (utils/retry.py): a peer that failed
         # failure_threshold dials in a row fails fast — BreakerOpen is a
         # ConnectionError, so replica/master rotation handles it like any
@@ -104,7 +112,12 @@ class HttpPool:
                 headers: Optional[dict] = None,
                 timeout: Optional[float] = None) -> PoolResponse:
         """One full request/response. `url` may carry or omit the
-        http:// scheme; HTTP error statuses are returned, not raised."""
+        http:// scheme; HTTP error statuses are returned, not raised.
+
+        A shed 429/503 (``X-Seaweed-Shed: 1``) is honored, not fought:
+        sleep the server's ``Retry-After`` (bounded by the remaining
+        deadline budget) and re-send, up to ``shed_retries`` times.  A
+        still-shed response after that is returned to the caller."""
         if "://" not in url:
             url = "http://" + url
         parts = urllib.parse.urlsplit(url)
@@ -112,11 +125,43 @@ class HttpPool:
         path = parts.path or "/"
         if parts.query:
             path += "?" + parts.query
-        timeout = self.default_timeout if timeout is None else timeout
+        base_timeout = self.default_timeout if timeout is None else timeout
+        from ..utils import retry as retry_mod
+        shed_left = self.shed_retries
+        while True:
+            resp = self._request_once(method, host, port, path, body,
+                                      headers, base_timeout)
+            if shed_left <= 0 or not retry_mod.is_shed(resp.status,
+                                                       resp.headers):
+                return resp
+            delay = retry_mod.parse_retry_after(
+                resp.headers.get("retry-after"))
+            delay = min(delay if delay is not None else 0.25, 5.0)
+            left = retry_mod.remaining_budget()
+            if left is not None and left <= delay:
+                # not enough budget to be polite: hand the shed up so
+                # the caller's own policy (rotation, error) decides
+                return resp
+            if base_timeout is not None and delay >= base_timeout:
+                # same when the caller's own per-request timeout is
+                # tighter than the server's requested pause: a caller
+                # expecting a verdict in 0.5s must not block 5s here
+                return resp
+            self._count("shed_backoff")
+            shed_left -= 1
+            time.sleep(delay)
+
+    def _request_once(self, method: str, host: str, port: int, path: str,
+                      body: Optional[bytes],
+                      headers: Optional[dict],
+                      timeout: Optional[float]) -> PoolResponse:
         hdrs = dict(headers or {})
-        from .. import faults, observe
+        from .. import faults, observe, overload
         from ..utils import retry as retry_mod
         observe.inject(hdrs)
+        # the ambient priority class rides along like the trace id, so
+        # background daemons' fetches shed first at the receiver
+        overload.inject(hdrs)
         # propagate the caller's remaining deadline budget and never wait
         # on the socket longer than it (utils/retry.py); raises
         # DeadlineExceeded when the budget is already gone

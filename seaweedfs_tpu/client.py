@@ -19,7 +19,8 @@ from typing import Optional
 from .cache.http_pool import shared_pool
 from .cache.ttl import TTLCache
 from .filer.assign_lease import AssignLeasePool
-from .utils.retry import RetryPolicy
+from .utils.retry import (RETRYABLE_STATUSES, RetryPolicy, is_shed,
+                          parse_retry_after)
 
 
 class ClientError(RuntimeError):
@@ -85,14 +86,40 @@ class Client:
         replies (covering the follower whose leader just died). Backoff
         between full rotations follows the unified RetryPolicy (jittered
         exponential) instead of a fixed sleep; a master whose breaker is
-        open fails fast inside the pool and rotation moves on."""
+        open fails fast inside the pool and rotation moves on.
+
+        Shed responses (429/503 + X-Seaweed-Shed, the admission plane's
+        back-off request) are different from a dead/leaderless master:
+        the host is alive, so never let them count toward breaker
+        failure accounting (the pool records a completed exchange as
+        success).  The pool itself already paid one polite Retry-After
+        re-send (shed_retries=1); a STILL-shedding master means real
+        pressure there, so with HA peers available rotate to an idle
+        one immediately — only a single-master deployment waits out
+        Retry-After in place (there is nowhere else to go)."""
         last: Optional[Exception] = None
         attempts = max(2 * len(self.masters), 2)
         for attempt in range(attempts):
             try:
                 url = f"http://{self.master}{path_qs}"
                 r = self._pool.request("GET", url, timeout=timeout)
-                if r.status in (502, 503, 504):
+                if r.status in RETRYABLE_STATUSES:
+                    if is_shed(r.status, r.headers):
+                        last = ClientError(
+                            f"master {self.master}: shed HTTP {r.status}")
+                        if len(self.masters) > 1:
+                            # no extra sleep: the pool's shed retry
+                            # already honored one Retry-After
+                            self._master_i = (self._master_i + 1) \
+                                % len(self.masters)
+                            continue
+                        if attempt < attempts - 1:
+                            delay = parse_retry_after(
+                                r.headers.get("retry-after"))
+                            time.sleep(min(
+                                delay if delay is not None
+                                else self._retry.backoff(attempt), 5.0))
+                        continue  # single master: overloaded, not dead
                     raise ClientError(
                         f"master {self.master}: HTTP {r.status}")
                 try:

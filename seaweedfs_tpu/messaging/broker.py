@@ -39,6 +39,7 @@ from typing import Optional
 import aiohttp
 from aiohttp import web
 
+from .. import overload
 from ..utils.log_buffer import LogBuffer, LogEntry
 
 log = logging.getLogger("broker")
@@ -164,21 +165,32 @@ class BrokerServer:
         self.app = self._build_app()
 
     def _build_app(self) -> web.Application:
-        app = web.Application(client_max_size=64 * 1024 * 1024)
+        # the broker is a serving surface like the other five: meter
+        # publish through the admission plane (a pub/sub client storm
+        # must shed predictably, not collapse the process). Subscribe
+        # streams hold their request open for hours — counting them
+        # against a concurrency cap would wedge the class exactly like
+        # the filer's /__meta__ streams would; the broker has no user
+        # catch-all, so the route prefix can't shadow user data.
+        self.admission = overload.AdmissionController(
+            "broker", system_paths=frozenset({"/healthz"}),
+            system_prefixes=("/subscribe/",))
+        app = web.Application(
+            client_max_size=64 * 1024 * 1024,
+            middlewares=[overload.admission_middleware(self.admission)])
         app.router.add_post(
             "/publish/{ns}/{topic}/{partition:\\d+}", self.publish)
         app.router.add_get(
             "/subscribe/{ns}/{topic}/{partition:\\d+}", self.subscribe)
         app.router.add_get("/topics", self.topics)
-        app.router.add_get("/healthz", self._healthz)
+        app.router.add_get("/healthz",
+                           overload.healthz_handler(self.admission))
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         return app
 
-    async def _healthz(self, request: web.Request) -> web.Response:
-        return web.json_response({"ok": True})
-
     async def _on_startup(self, app) -> None:
+        await self.admission.start()
         self._session = aiohttp.ClientSession(
             # connect/inactivity bounds, no total cap: publish
             # fan-out must not hang on a dead peer, long streams ok
@@ -195,6 +207,7 @@ class BrokerServer:
             self._poll_task = asyncio.create_task(self._poll_brokers_loop())
 
     async def _on_cleanup(self, app) -> None:
+        self.admission.stop()
         if self._grpc_server is not None:
             await self._grpc_server.stop(grace=0.5)
         for task in (self._register_task, self._poll_task):

@@ -422,10 +422,13 @@ def client_trace_config():
         hv = header_value()
         if hv and TRACE_HEADER not in params.headers:
             params.headers[TRACE_HEADER] = hv
-        # the deadline budget rides every outbound aiohttp request the
-        # same way the trace id does
+        # the deadline budget and the priority class ride every outbound
+        # aiohttp request the same way the trace id does (the repair
+        # daemon/scrubber bind bg priority; receivers shed it first)
         from ..utils import retry as _retry
         _retry.inject_deadline(params.headers)
+        from .. import overload as _overload
+        _overload.inject(params.headers)
 
     tc.on_request_start.append(on_request_start)
     return tc

@@ -30,7 +30,7 @@ import aiohttp
 from aiohttp import web
 
 from . import auth as auth_mod
-from .. import observe
+from .. import observe, overload
 from ..utils import metrics as metrics_mod
 
 log = logging.getLogger("s3")
@@ -80,44 +80,64 @@ class S3Server:
             self.iam = auth_mod.Iam([])
         self.metrics = metrics_mod.Registry("s3")
         self._session: Optional[aiohttp.ClientSession] = None
+        # overload plane: per-tenant buckets key off the SigV4 access
+        # key id here (overload.tenant_from_request), so one hot tenant
+        # answers 429 while the others keep their capacity. Gateway
+        # system set = only the reserved ops routes — a BUCKET named
+        # "status" or "heartbeat" is user traffic and is metered.
+        # tenant keys arrive UNVERIFIED (admission runs before SigV4),
+        # so only charge buckets for access keys the identity registry
+        # actually knows: unknown keys can't churn the bounded
+        # TenantBuckets LRU and meter under the global bucket instead.
+        # That is the whole guarantee — a spoofed KNOWN access key id
+        # (AKIDs are not secrets; they ride in presigned URLs and logs)
+        # still drains that tenant's bucket pre-auth, so per-tenant
+        # limits are a fairness ceiling, not an auth-grade quota (see
+        # README "Sizing per-tenant buckets")
+        self.admission = overload.AdmissionController(
+            "s3", metrics=self.metrics,
+            system_paths=(overload.GATEWAY_SYSTEM_PATHS
+                          | overload.faults_admin_paths()),
+            tenant_validator=lambda k: (self.iam.enabled
+                                        and self.iam.lookup(k) is not None))
         self.app = self._build_app()
 
     def _build_app(self) -> web.Application:
         app = web.Application(
             client_max_size=5 * 1024 * 1024 * 1024,
-            middlewares=[observe.trace_middleware("s3", self.url)])
+            middlewares=[observe.trace_middleware("s3", self.url),
+                         overload.admission_middleware(self.admission)])
         # ops surface registered before the catch-alls (exact routes win
         # over the {bucket} patterns; these names are reserved like the
         # reference's /status endpoints)
         self._trace_handler = observe.trace_handler()
         from ..utils.profiling import profile_handler
         self._profile_handler = profile_handler()
-        # reserved for ALL methods: a GET-only route would let
-        # PUT /metrics fall through to the {bucket} catch-all and mint a
-        # bucket the gateway can never read back
+        # registered via overload.reserve_ops (all other methods 405):
+        # a GET-only route would let PUT /metrics fall through to the
+        # {bucket} catch-all and mint a bucket the gateway can never
+        # read back; S3 keeps its XML error shape via `reserved`
         from .. import faults
-        for path, handler in (("/healthz", self.healthz),
-                              ("/metrics", self.metrics_handler),
-                              ("/debug/trace", self.trace_handler),
-                              ("/debug/profile", self.profile_handler)):
-            app.router.add_get(path, handler)
-            app.router.add_route("*", path, self._reserved)
+        for path, handler in (
+                ("/healthz", overload.healthz_handler(self.admission)),
+                ("/metrics", self.metrics_handler),
+                ("/debug/trace", self.trace_handler),
+                ("/debug/profile", self.profile_handler)):
+            overload.reserve_ops(app, path, handler,
+                                 reserved=self._reserved)
         if faults.admin_enabled():
             # opt-in only (WEED_FAULTS_ADMIN=1): this route sits OUTSIDE
             # the SigV4 auth that fences every other mutating S3 route
             _faults_handler = faults.admin_handler()
-            app.router.add_get("/admin/faults", _faults_handler)
-            app.router.add_post("/admin/faults", _faults_handler)
-            app.router.add_route("*", "/admin/faults", self._reserved)
+            overload.reserve_ops(app, "/admin/faults", _faults_handler,
+                                 post_handler=_faults_handler,
+                                 reserved=self._reserved)
         app.router.add_route("*", "/", self.dispatch_root)
         app.router.add_route("*", "/{bucket}", self.dispatch_bucket)
         app.router.add_route("*", "/{bucket}/{key:.*}", self.dispatch_object)
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         return app
-
-    async def healthz(self, request: web.Request) -> web.Response:
-        return web.json_response({"ok": True})
 
     async def _reserved(self, request: web.Request) -> web.Response:
         return _error("MethodNotAllowed",
@@ -147,6 +167,7 @@ class S3Server:
         return await self._profile_handler(request)
 
     async def _on_startup(self, app) -> None:
+        await self.admission.start()
         self._session = aiohttp.ClientSession(
             # inactivity-bounded, no total cap (large object streams)
             timeout=aiohttp.ClientTimeout(total=None, sock_connect=10,
@@ -154,6 +175,7 @@ class S3Server:
             trace_configs=[observe.client_trace_config()])
 
     async def _on_cleanup(self, app) -> None:
+        self.admission.stop()
         if self._session:
             await self._session.close()
 

@@ -26,7 +26,7 @@ import os
 import time
 from typing import Optional
 
-from .. import faults, observe
+from .. import faults, observe, overload
 from ..security.guard import token_from_request
 from ..storage.file_id import FileId
 from ..storage.needle import (FLAG_HAS_LAST_MODIFIED, FLAG_HAS_MIME,
@@ -45,6 +45,12 @@ _PROXY_PREFIX = ("/admin/", "/debug/")
 
 _E404 = json.dumps({"error": "not found"}).encode()
 _E400 = json.dumps({"error": "missing file id"}).encode()
+
+# _admission_gate answered a shed response itself; no ticket to release
+_SHED = object()
+# _read_request answered the request inline (403/shed on a body-less
+# request): nothing to dispatch, keep serving the connection
+_HANDLED = object()
 
 
 def _parse_query(q: str) -> dict:
@@ -132,6 +138,8 @@ class FastVolumeProtocol(asyncio.Protocol):
                 req = await self._read_request()
                 if req is None:
                     return
+                if req is _HANDLED:
+                    continue
                 await self._dispatch_traced(*req)
         except (ConnectionResetError, asyncio.CancelledError):
             pass
@@ -141,13 +149,17 @@ class FastVolumeProtocol(asyncio.Protocol):
                 self.transport.close()
 
     async def _dispatch_traced(self, method: str, path: str, query: str,
-                               headers: dict, body: bytes,
-                               raw: bytes) -> None:
+                               headers: dict, body: bytes, raw: bytes,
+                               ticket=None, ptok=None) -> None:
         """Root span for the raw-socket data plane: join the trace from
         the X-Seaweed-Trace header when present, mint one otherwise.
         Proxied requests re-enter the aiohttp app whose middleware span
         parents under this one (the header is rewritten in
-        _mark_internal to point at the ambient span)."""
+        _mark_internal to point at the ambient span).
+
+        Whitelist + admission already ran in _read_request (BEFORE the
+        body was buffered); this owns releasing the admission ticket and
+        the bg ambient-priority binding when the request completes."""
         tid, parent = observe.parse_header(
             headers.get(b"x-seaweed-trace", b"").decode("latin-1"))
         ctx = observe.TraceCtx(tid or observe.new_id(), parent,
@@ -157,8 +169,14 @@ class FastVolumeProtocol(asyncio.Protocol):
         self._proxied = False
         try:
             with sp:
-                await self._dispatch(method, path, query, headers, body,
-                                     raw)
+                try:
+                    await self._dispatch(method, path, query, headers,
+                                         body, raw)
+                finally:
+                    if ptok is not None:
+                        overload.reset_priority(ptok)
+                    if ticket is not None:
+                        ticket.release()
         finally:
             # proxied requests re-enter the aiohttp app, whose middleware
             # applies the proper slow-log rules (streams exempt); logging
@@ -166,6 +184,33 @@ class FastVolumeProtocol(asyncio.Protocol):
             # (/cluster/watch, tails) as latency
             if not self._proxied:
                 observe.maybe_log_slow(sp)
+
+    async def _admission_gate(self, path: str, query: str, headers: dict):
+        """Admission hook for the raw-socket listener: classify, meter,
+        and bound exactly like the aiohttp admission middleware does.
+        Returns (ticket, priority_token) once admitted — ticket may be
+        None when the server has no controller — or (_SHED, None) after
+        answering a shed response on the wire."""
+        ctl = getattr(self.server, "admission", None)
+        if ctl is None:
+            return None, None
+        cls = overload.classify(
+            headers.get(b"x-seaweed-priority", b"").decode("latin-1"),
+            path, ctl.system_paths, ctl.system_prefixes)
+        tenant = ""
+        if ctl.tenant_buckets is not None and "collection" in query:
+            tenant = _parse_query(query).get("collection", "")
+        try:
+            ticket = await ctl.admit(cls, tenant)
+        except overload.ShedError as e:
+            self._send(e.status,
+                       json.dumps({"error":
+                                   f"overloaded: {e.reason}"}).encode(),
+                       extra=e.raw_headers())
+            return _SHED, None
+        ptok = (overload.set_priority(overload.CLASS_BG)
+                if cls == overload.CLASS_BG else None)
+        return ticket, ptok
 
     # matches the aiohttp app's client_max_size in volume_server.py
     MAX_BODY = 256 * 1024 * 1024
@@ -197,12 +242,22 @@ class FastVolumeProtocol(asyncio.Protocol):
             # Admission runs FIRST — the proxied request carries the
             # whitelist-bypassing internal token, so an unchecked tunnel
             # would let any client evade a configured IP whitelist.
-            path = target.decode("latin-1").partition("?")[0]
+            target_s = target.decode("latin-1")
+            path, _, query = target_s.partition("?")
             if not await self._admit(path):
                 self._send(403, json.dumps({"error": "ip not allowed"}
                                            ).encode())
                 self.transport.close()
                 return None
+            # tunneled requests never come back through _dispatch_traced;
+            # admission happens in the aiohttp middleware instead: the
+            # X-Swfs-Tunnel marker tells it to meter despite the internal
+            # token (which only bypasses the whitelist re-check).  That
+            # keeps the bounding REQUEST-scoped — admitting here would
+            # either pin a concurrency slot for the whole connection
+            # (idle keep-alive chunked clients wedge the class) or
+            # release it immediately (any client dodges the caps by
+            # adding Transfer-Encoding: chunked).
             self.buf = b""
             await self._proxy_tunnel(head + b"\r\n\r\n" + rest)
             return None
@@ -220,18 +275,55 @@ class FastVolumeProtocol(asyncio.Protocol):
                                        ).encode())
             self.transport.close()
             return None
-        parts = [rest]
-        got = len(rest)
-        while got < length:
-            chunk = await self._recv()
-            parts.append(chunk)
-            got += len(chunk)
-        rest = b"".join(parts)
-        body, self.buf = rest[:length], rest[length:]
         target_s = target.decode("latin-1")
         path, _, query = target_s.partition("?")
+
+        def answered():
+            # request refused inline: with an unread body still on the
+            # wire the framing is unrecoverable — close (under overload
+            # that is also the cheapest outcome); a body-less request
+            # keeps the connection, preserving pipelined bytes
+            if length:
+                self.transport.close()
+                return None
+            self.buf = rest
+            return _HANDLED
+
+        # whitelist + admission run BEFORE the body is buffered: the
+        # overload plane exists to stop the buffer-then-collapse mode,
+        # so a request that will be shed must be refused while its body
+        # is still on the wire — a storm of concurrent 100MB POSTs must
+        # cost ~0 bytes of heap, not buffer every body and shed after.
+        # Whitelist first (an off-whitelist flood burns a cheap 403, not
+        # admission tokens/queue slots — mirrors the aiohttp middleware
+        # order guard_mw -> admission on master/volume).
+        if not await self._admit(path):
+            self._send(403, json.dumps({"error": "ip not allowed"}
+                                       ).encode())
+            return answered()
+        ticket, ptok = await self._admission_gate(path, query, headers)
+        if ticket is _SHED:
+            return answered()
+        try:
+            parts = [rest]
+            got = len(rest)
+            while got < length:
+                chunk = await self._recv()
+                parts.append(chunk)
+                got += len(chunk)
+        except (ConnectionResetError, asyncio.CancelledError):
+            # client vanished mid-body while holding an admission slot:
+            # the ticket must not leak or the class bleeds capacity
+            if ptok is not None:
+                overload.reset_priority(ptok)
+            if ticket is not None:
+                ticket.release()
+            raise
+        rest = b"".join(parts)
+        body, self.buf = rest[:length], rest[length:]
         raw = head + b"\r\n\r\n" + body
-        return (method.decode("latin-1"), path, query, headers, body, raw)
+        return (method.decode("latin-1"), path, query, headers, body,
+                raw, ticket, ptok)
 
     # --- response helpers ---
     def _send(self, status: int, body: bytes, ctype: str = "application/json",
@@ -240,6 +332,7 @@ class FastVolumeProtocol(asyncio.Protocol):
                   400: "Bad Request", 401: "Unauthorized", 403: "Forbidden",
                   404: "Not Found", 405: "Method Not Allowed",
                   409: "Conflict", 413: "Payload Too Large",
+                  429: "Too Many Requests",
                   500: "Internal Server Error",
                   503: "Service Unavailable"}.get(status, "X")
         head = (f"HTTP/1.1 {status} {reason}\r\n"
@@ -257,10 +350,8 @@ class FastVolumeProtocol(asyncio.Protocol):
     # --- dispatch ---
     async def _dispatch(self, method: str, path: str, query: str,
                         headers: dict, body: bytes, raw: bytes) -> None:
+        # whitelist already checked in _read_request (before admission)
         guard = self.server.guard
-        if not await self._admit(path):
-            self._send(403, json.dumps({"error": "ip not allowed"}).encode())
-            return
         if path in _PROXY_EXACT or path.startswith(_PROXY_PREFIX):
             await self._proxy(raw)
             return
@@ -323,6 +414,25 @@ class FastVolumeProtocol(asyncio.Protocol):
             return                  # the read on the aiohttp side
         if n is None:  # big needle, contended lock, or remote backend
             await self._proxy(raw)
+            return
+        # same named fault point as the aiohttp read handler — chaos and
+        # overload drills against deployed clusters must reach the
+        # inline fast path too (delay faults here are how the overload
+        # bench makes service time, and so capacity, deterministic).
+        # Fired only once the read is committed to be served INLINE:
+        # every proxy fallback above reaches the aiohttp handler, which
+        # fires the point itself — firing before the proxy decision
+        # would double-charge delays and compound drop probabilities on
+        # exactly the shapes that traverse both paths.
+        try:
+            if await faults.fire_async("volume.read"):
+                server.metrics.count("read")
+                self._send(404, json.dumps({"error": "injected drop"}
+                                           ).encode())
+                return
+        except faults.FaultError as e:
+            server.metrics.count("read")
+            self._send(500, json.dumps({"error": str(e)}).encode())
             return
         server.metrics.count("read")
         etag = f'"{n.etag()}"'
@@ -457,24 +567,50 @@ class FastVolumeProtocol(asyncio.Protocol):
             return
         self._send(200, json.dumps({"size": size}).encode())
 
-    def _mark_internal(self, raw: bytes) -> bytes:
+    def _mark_internal(self, raw: bytes, tunnel: bool = False) -> list:
         """Insert the per-process internal token + the real peer IP after
         the request line so the aiohttp app can (a) skip its IP-whitelist
         re-check — it would otherwise see 127.0.0.1 and 403 every proxied
-        request under a whitelist — and (b) log the true client."""
-        line, _, rest = raw.partition(b"\r\n")
+        request under a whitelist — and (b) log the true client.
+        ``tunnel`` adds X-Swfs-Tunnel: the request was NOT admitted at
+        this listener and the admission middleware must meter it.
+
+        Client-supplied copies of the X-Swfs-* headers are stripped
+        first: a spoofed X-Swfs-Tunnel on a proxied (already-admitted)
+        request would make the middleware meter it a second time —
+        with fg slots held at the listener, a handful of such requests
+        deadlock the class into queue-timeout sheds — and a spoofed
+        X-Swfs-Peer would forge the logged client identity.
+
+        Returns buffers to write in order: the rebuilt head, then the
+        body region untouched (as a memoryview — a proxied 256 MB PUT
+        must not pay full-buffer copies just to rewrite headers)."""
+        hdr_end = raw.find(b"\r\n\r\n")
+        if hdr_end < 0:
+            hdr_end = len(raw)
+        line_end = raw.find(b"\r\n")
+        line = raw[:line_end]
+        head = raw[line_end + 2:hdr_end]
+        kept = [ln for ln in head.split(b"\r\n")
+                if ln and not ln.lower().startswith(
+                    (b"x-swfs-internal:", b"x-swfs-tunnel:",
+                     b"x-swfs-peer:"))]
         tok = self.server._internal_token.encode()
-        extra = b""
+        extra = b"X-Swfs-Tunnel: 1\r\n" if tunnel else b""
         hv = observe.header_value()
         if hv:
             # parent the aiohttp-side span under the fastpath span; the
             # injected header is first so it wins over the client's copy
             # further down the head (headers.get returns the first)
-            extra = (b"X-Seaweed-Trace: " + hv.encode("latin-1")
-                     + b"\r\n")
-        return (line + b"\r\nX-Swfs-Internal: " + tok
-                + b"\r\nX-Swfs-Peer: " + self.peer_ip.encode("latin-1")
-                + b"\r\n" + extra + rest)
+            extra += (b"X-Seaweed-Trace: " + hv.encode("latin-1")
+                      + b"\r\n")
+        new_head = (line + b"\r\nX-Swfs-Internal: " + tok
+                    + b"\r\nX-Swfs-Peer: "
+                    + self.peer_ip.encode("latin-1") + b"\r\n" + extra
+                    + b"".join(h + b"\r\n" for h in kept) + b"\r\n")
+        body = memoryview(raw)[hdr_end + 4:] \
+            if hdr_end + 4 <= len(raw) else b""
+        return [new_head, body]
 
     async def _proxy_tunnel(self, initial: bytes) -> None:
         """Bidirectional relay for requests we cannot frame (chunked,
@@ -484,7 +620,8 @@ class FastVolumeProtocol(asyncio.Protocol):
         self._proxied = True
         reader, writer = await asyncio.open_connection(
             "127.0.0.1", self.internal_port)
-        writer.write(self._mark_internal(initial))
+        for part in self._mark_internal(initial, tunnel=True):
+            writer.write(part)
         await writer.drain()
 
         async def pump_up() -> None:
@@ -522,7 +659,8 @@ class FastVolumeProtocol(asyncio.Protocol):
         reader, writer = await asyncio.open_connection(
             "127.0.0.1", self.internal_port)
         try:
-            writer.write(self._mark_internal(raw))
+            for part in self._mark_internal(raw):
+                writer.write(part)
             await writer.drain()
             head = b""
             while b"\r\n\r\n" not in head:
@@ -607,10 +745,8 @@ class FastMasterProtocol(FastVolumeProtocol):
 
     async def _dispatch(self, method: str, path: str, query: str,
                         headers: dict, body: bytes, raw: bytes) -> None:
+        # whitelist already checked in _read_request (before admission)
         server = self.server
-        if not await self._admit(path):
-            self._send(403, json.dumps({"error": "ip not allowed"}).encode())
-            return
         if path not in ("/dir/assign", "/dir/lookup"):
             await self._proxy(raw)
             return

@@ -28,7 +28,7 @@ from typing import Optional
 import aiohttp
 from aiohttp import web
 
-from .. import observe
+from .. import observe, overload
 from ..filer import manifest as manifest_mod
 from ..filer.assign_lease import AsyncAssignLeasePool
 from ..filer.chunks import FileChunk, etag as chunks_etag, read_plan, total_size
@@ -37,6 +37,7 @@ from ..filer.filer import Filer, _norm
 from ..filer.stores import create_store
 from ..filer.upload_window import UploadWindow
 from ..utils import metrics as metrics_mod
+from ..utils.retry import RETRYABLE_STATUSES, is_shed, parse_retry_after
 
 log = logging.getLogger("filer.server")
 
@@ -51,10 +52,6 @@ class _StaleAssignment(RuntimeError):
 # (conn refused / timeout — the breaker-open analog for this async path)
 _LEASE_POISON = (_StaleAssignment, aiohttp.ClientError,
                  asyncio.TimeoutError, OSError)
-
-
-async def _healthz(request: web.Request) -> web.Response:
-    return web.json_response({"ok": True})
 
 
 def _parse_signatures(request: web.Request) -> tuple[int, ...]:
@@ -150,26 +147,44 @@ class FilerServer:
         from ..cache import TTLCache
         self._vid_cache = TTLCache(ttl=60.0, metrics=self.metrics,
                                    name="vid")
+        # overload plane: classify/meter/bound every request; background
+        # traffic (repair, scrub, replication) sheds before user
+        # traffic. The filer-specific system set keeps user FILES named
+        # like another server's control plane (/heartbeat, /status)
+        # metered like any other path.
+        self.admission = overload.AdmissionController(
+            "filer", metrics=self.metrics,
+            system_paths=(overload.FILER_SYSTEM_PATHS
+                          | overload.faults_admin_paths()))
         self.app = self._build_app()
 
     def _build_app(self) -> web.Application:
+        # explicit client_max_size consistent with the autochunk PUT path
+        # (aiohttp's silent 1 MiB default would cap non-streamed bodies);
+        # admission sits just inside tracing so shed requests still
+        # record a span
         app = web.Application(
             client_max_size=1024 * 1024 * 1024,
-            middlewares=[observe.trace_middleware("filer", self.url)])
-        app.router.add_get("/healthz", _healthz)
-        app.router.add_get("/metrics", self.metrics_handler)
+            middlewares=[observe.trace_middleware("filer", self.url),
+                         overload.admission_middleware(self.admission)])
+        # ops routes go through overload.reserve_ops: reserved for ALL
+        # methods, or `PUT /healthz` falls through to the path catch-all
+        # as a never-metered system-classified file write
+        overload.reserve_ops(app, "/healthz",
+                             overload.healthz_handler(self.admission))
+        overload.reserve_ops(app, "/metrics", self.metrics_handler)
         from .. import faults
         if faults.admin_enabled():
             # opt-in only (WEED_FAULTS_ADMIN=1): the filer app installs
             # no guard middleware, so this endpoint would otherwise be
             # an unauthenticated process-wide fault switch
             _faults_handler = faults.admin_handler()
-            app.router.add_get("/admin/faults", _faults_handler)
-            app.router.add_post("/admin/faults", _faults_handler)
+            overload.reserve_ops(app, "/admin/faults", _faults_handler,
+                                 post_handler=_faults_handler)
         from ..utils.profiling import profile_handler
-        app.router.add_get("/debug/profile", profile_handler())
-        app.router.add_get("/debug/trace", observe.trace_handler())
-        app.router.add_get("/ui", self.status_ui)
+        overload.reserve_ops(app, "/debug/profile", profile_handler())
+        overload.reserve_ops(app, "/debug/trace", observe.trace_handler())
+        overload.reserve_ops(app, "/ui", self.status_ui)
         # entry-level meta API: the JSON face of the reference's filer gRPC
         # (weed/pb/filer.proto LookupDirectoryEntry/ListEntries/CreateEntry/
         # UpdateEntry/DeleteEntry/AtomicRenameEntry) — used by gateways (S3)
@@ -179,8 +194,11 @@ class FilerServer:
         app.router.add_post("/__meta__/update_entry", self.meta_update)
         app.router.add_post("/__meta__/delete", self.meta_delete)
         app.router.add_post("/__meta__/rename", self.meta_rename)
-        app.router.add_get("/__meta__/events", self.meta_events)
-        app.router.add_get("/__meta__/subscribe", self.meta_subscribe)
+        # the two admission-exempt meta streams are reserved for all
+        # methods too (same fallthrough-to-catch-all bypass as above)
+        overload.reserve_ops(app, "/__meta__/events", self.meta_events)
+        overload.reserve_ops(app, "/__meta__/subscribe",
+                             self.meta_subscribe)
         app.router.add_get("/__meta__/info", self.meta_info)
         app.router.add_get("/__meta__/brokers", self.meta_brokers)
         app.router.add_get("/__meta__/assign", self.meta_assign)
@@ -443,6 +461,7 @@ class FilerServer:
             host = (self.url.rsplit(":", 1)[0] if self.url else "127.0.0.1")
             self._grpc_server = await serve_filer_grpc(
                 self, host, self.grpc_port, tls=self.tls)
+        await self.admission.start()
         self._delete_task = asyncio.create_task(self._deletion_worker())
         self._watch_task = asyncio.create_task(self._watch_master())
         for peer in self.peers:
@@ -450,6 +469,7 @@ class FilerServer:
                 asyncio.create_task(self._aggregate_from_peer(peer)))
 
     async def _on_cleanup(self, app) -> None:
+        self.admission.stop()
         if self._grpc_server is not None:
             await self._grpc_server.stop(grace=0.5)
         if self._delete_task:
@@ -553,6 +573,9 @@ class FilerServer:
         return data
 
     async def _deletion_worker(self) -> None:
+        # chunk-deletion storms are background by definition: their
+        # volume-server DELETEs shed before user traffic under overload
+        overload.set_priority(overload.CLASS_BG)
         while True:
             chunk: FileChunk = await self._delete_queue.get()
             try:
@@ -602,14 +625,47 @@ class FilerServer:
 
     async def _master_get(self, path: str, params: dict) -> dict:
         """GET against the current master, rotating through the HA list on
-        connection failure or 502/503/504 (leaderless follower)."""
+        connection failure or 502/503/504 (leaderless follower).
+
+        Shed replies (429/503 + X-Seaweed-Shed) are the admission
+        plane's back-off request, not a dead master: with HA peers
+        rotate to an idle one immediately, but a single-master
+        deployment waits out Retry-After in place instead of raising —
+        re-hammering (or failing the caller's PUT outright) is the
+        retry-storm shape the overload plane exists to prevent."""
         last: Optional[Exception] = None
-        for _ in range(max(2 * len(self.masters), 2)):
+        attempts = max(2 * len(self.masters), 2)
+        for attempt in range(attempts):
             try:
                 async with self._session.get(
                         f"http://{self.master_url}{path}",
                         params=params) as r:
-                    if r.status in (502, 503, 504):
+                    if r.status in RETRYABLE_STATUSES:
+                        if is_shed(r.status, r.headers):
+                            last = aiohttp.ClientError(
+                                f"master {self.master_url}: shed "
+                                f"HTTP {r.status}")
+                            delay = parse_retry_after(
+                                r.headers.get("Retry-After"))
+                            if len(self.masters) > 1:
+                                self._master_i = (self._master_i + 1) \
+                                    % len(self.masters)
+                                if (attempt + 1) % len(self.masters) == 0:
+                                    # a full rotation met nothing but
+                                    # shed: the whole ring is overloaded,
+                                    # so pause for Retry-After before the
+                                    # next lap instead of re-hammering
+                                    # every peer at wire speed (this
+                                    # session has no pool-level shed
+                                    # retry to pace the attempts)
+                                    await asyncio.sleep(min(
+                                        delay if delay is not None
+                                        else 0.5, 5.0))
+                            elif attempt < attempts - 1:
+                                await asyncio.sleep(min(
+                                    delay if delay is not None else 0.5,
+                                    5.0))
+                            continue
                         raise aiohttp.ClientError(
                             f"master {self.master_url}: HTTP {r.status}")
                     return await r.json()

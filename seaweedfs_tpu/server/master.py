@@ -26,7 +26,7 @@ from typing import Optional
 import aiohttp
 from aiohttp import web
 
-from .. import faults, observe
+from .. import faults, observe, overload
 from ..cluster.raft import RaftNode, _endpoint_ips
 from ..security.guard import Guard
 from ..storage.file_id import FileId, new_cookie
@@ -51,10 +51,6 @@ _LOCAL_PATHS = ("/healthz", "/metrics", "/cluster/status", "/cluster/watch",
                 # fault injection is per-PROCESS state: proxying it to the
                 # leader would arm the fault on the wrong node
                 "/admin/faults")
-
-
-async def _healthz(request: "web.Request") -> "web.Response":
-    return web.json_response({"ok": True})
 
 
 class MasterServer:
@@ -151,6 +147,11 @@ class MasterServer:
         import secrets as _secrets
         self._internal_token = _secrets.token_hex(16)
         self._fast_srv = None
+        # overload plane: heartbeat/raft are classified system (never
+        # shed); repair-daemon traffic is bg and sheds first
+        self.admission = overload.AdmissionController(
+            "master", metrics=self.metrics,
+            system_paths=overload.MASTER_SYSTEM_PATHS)
         self.app = self._build_app()
 
     def _raft_apply(self, cmd: dict) -> None:
@@ -217,11 +218,20 @@ class MasterServer:
 
         # tracing is outermost so denied/proxied requests still record a
         # span (the fastpath listener rewrites the header so proxied
-        # requests parent under its span, server/fastpath.py)
+        # requests parent under its span, server/fastpath.py); the
+        # whitelist guard runs BEFORE admission — an off-whitelist
+        # flood must burn a cheap 403, not drain admission tokens and
+        # queue slots (shedding whitelisted traffic with zero real
+        # overload); requests proxied from the fastpath listener were
+        # already admitted there (internal token)
         app = web.Application(
             client_max_size=64 * 1024 * 1024,
             middlewares=[observe.trace_middleware("master", self.url),
-                         guard_mw, leader_proxy_mw])
+                         guard_mw,
+                         overload.admission_middleware(
+                             self.admission,
+                             internal_token=lambda: self._internal_token),
+                         leader_proxy_mw])
         app.router.add_get("/dir/assign", self.dir_assign)
         app.router.add_get("/dir/lookup", self.dir_lookup)
         app.router.add_get("/dir/status", self.dir_status)
@@ -243,7 +253,8 @@ class MasterServer:
         app.router.add_get("/admin/faults", _faults_handler)
         app.router.add_post("/admin/faults", _faults_handler)
         app.router.add_get("/metrics", self.metrics_handler)
-        app.router.add_get("/healthz", _healthz)
+        app.router.add_get("/healthz",
+                           overload.healthz_handler(self.admission))
         from ..utils.profiling import profile_handler
         app.router.add_get("/debug/profile", profile_handler())
         app.router.add_get("/debug/trace", observe.trace_handler())
@@ -253,6 +264,7 @@ class MasterServer:
         return app
 
     async def _on_startup(self, app) -> None:
+        await self.admission.start()
         await self.raft.start()
         if self.vacuum_interval_seconds > 0:
             self._vacuum_task = asyncio.create_task(self._vacuum_loop())
@@ -266,6 +278,7 @@ class MasterServer:
                 self, host or "0.0.0.0", self.grpc_port, tls=self.tls)
 
     async def _on_cleanup(self, app) -> None:
+        self.admission.stop()
         if getattr(self, "_fast_srv", None) is not None:
             self._fast_srv.close()
             await self._fast_srv.wait_closed()
@@ -713,6 +726,9 @@ class MasterServer:
     async def _vacuum_loop(self) -> None:
         """Periodic vacuum scan (weed/topology/topology_vacuum.go:17-171,
         kicked every 15min from topology_event_handling.go:12)."""
+        # vacuum fan-out is background traffic: the volume servers it
+        # hits shed it first under overload
+        overload.set_priority(overload.CLASS_BG)
         while True:
             await asyncio.sleep(self.vacuum_interval_seconds)
             try:
@@ -791,6 +807,11 @@ class MasterServer:
     #     weed/server/master_server.go:187-257) ---
 
     async def _maintenance_loop(self) -> None:
+        # repair/prune traffic is background: every admin call the
+        # daemon (and the repair tasks it spawns, which inherit this
+        # context) fans out carries X-Seaweed-Priority: bg and sheds
+        # before foreground traffic on the receiving volume servers
+        overload.set_priority(overload.CLASS_BG)
         while True:
             await asyncio.sleep(self.maintenance_interval_seconds)
             try:
@@ -899,6 +920,9 @@ class MasterServer:
 
     async def _run_repair(self, key, fn, *args) -> None:
         kind, vid = key
+        # explicit stamp (repairs can also be launched from admin/test
+        # paths that are not under the bg-tagged maintenance loop)
+        overload.set_priority(overload.CLASS_BG)
         try:
             async with self._repair_sem:
                 self.metrics.count("repairs_started",

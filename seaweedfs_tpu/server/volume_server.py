@@ -39,7 +39,7 @@ from typing import Optional
 import aiohttp
 from aiohttp import web
 
-from .. import faults, observe
+from .. import faults, observe, overload
 from ..storage.file_id import FileId
 from ..utils import compression, fast_multipart
 from ..storage.needle import (FLAG_IS_COMPRESSED,
@@ -53,10 +53,6 @@ from ..security.guard import Guard, token_from_request
 from ..utils import metrics as metrics_mod
 
 log = logging.getLogger("volume")
-
-
-async def _healthz(request: "web.Request") -> "web.Response":
-    return web.json_response({"ok": True})
 
 
 def _resize_image(data: bytes, mime: str, width: int, height: int,
@@ -233,6 +229,11 @@ class VolumeServer:
         import secrets as _secrets
         self._internal_token = _secrets.token_hex(16)
         self._fast_srv = None
+        # overload plane: repair/scrub/vacuum traffic (tagged bg by its
+        # originators) sheds before the user data plane
+        self.admission = overload.AdmissionController(
+            "volume", metrics=self.metrics,
+            system_paths=overload.VOLUME_SYSTEM_PATHS)
         self.app = self._build_app()
         # the EC read path fetches missing shards from peers through this
         store._remote_shard_reader = self._make_shard_reader
@@ -255,11 +256,19 @@ class VolumeServer:
                                              status=403)
             return await handler(request)
 
-        # tracing outermost: denied requests still record a span
+        # tracing outermost: denied requests still record a span; the
+        # whitelist guard BEFORE admission — an off-whitelist flood
+        # must burn a cheap 403, not drain admission tokens and queue
+        # slots (shedding whitelisted traffic and locking out bg
+        # repair with zero real overload); requests proxied from the
+        # fastpath were admitted there already (internal token)
         app = web.Application(
             client_max_size=256 * 1024 * 1024,
             middlewares=[observe.trace_middleware("volume", self.url),
-                         guard_mw])
+                         guard_mw,
+                         overload.admission_middleware(
+                             self.admission,
+                             internal_token=lambda: self._internal_token)])
         app.router.add_post("/admin/assign_volume", self.admin_assign_volume)
         app.router.add_post("/admin/vacuum", self.admin_vacuum)
         app.router.add_get("/admin/vacuum/check", self.admin_vacuum_check)
@@ -300,7 +309,8 @@ class VolumeServer:
         app.router.add_post("/admin/query", self.admin_query)
         app.router.add_get("/status", self.status)
         app.router.add_get("/metrics", self.metrics_handler)
-        app.router.add_get("/healthz", _healthz)
+        app.router.add_get("/healthz",
+                           overload.healthz_handler(self.admission))
         from ..utils.profiling import profile_handler
         app.router.add_get("/debug/profile", profile_handler())
         app.router.add_get("/debug/trace", observe.trace_handler())
@@ -319,6 +329,7 @@ class VolumeServer:
             timeout=aiohttp.ClientTimeout(total=None, sock_connect=10,
                                           sock_read=60),
             trace_configs=[observe.client_trace_config()])
+        await self.admission.start()
         self._batcher = WriteBatcher(self.store)
         self._hb_task = asyncio.create_task(self._heartbeat_loop())
         if self.scrub_interval_seconds > 0:
@@ -330,6 +341,7 @@ class VolumeServer:
                 self, host, self.grpc_port, tls=self.tls)
 
     async def _on_cleanup(self, app) -> None:
+        self.admission.stop()
         if getattr(self, "_fast_srv", None) is not None:
             self._fast_srv.close()
             await self._fast_srv.wait_closed()
@@ -1426,37 +1438,44 @@ class VolumeServer:
         from ..ec.pipeline import read_stamped_digests, shard_file_digest
         loop = asyncio.get_event_loop()
         bad_by_vid: dict[int, list[int]] = {}
-        with observe.span("volume.scrub"):
-            for loc in self.store.locations:
-                for vid, ev in list(loc.ec_volumes.items()):
-                    base = ev.base_file_name()
-                    stamped = read_stamped_digests(base)
-                    if not stamped:
-                        continue
-                    bad: list[int] = []
-                    for sid in ev.shard_ids():
-                        want = stamped.get(sid)
-                        if want is None:
+        # scrub is background by definition: its report POST (and any
+        # repair traffic it triggers) tags X-Seaweed-Priority: bg and
+        # sheds first under overload
+        _ptok = overload.set_priority(overload.CLASS_BG)
+        try:
+            with observe.span("volume.scrub"):
+                for loc in self.store.locations:
+                    for vid, ev in list(loc.ec_volumes.items()):
+                        base = ev.base_file_name()
+                        stamped = read_stamped_digests(base)
+                        if not stamped:
                             continue
-                        try:
-                            got = await loop.run_in_executor(
-                                None, lambda s=sid: int(
-                                    shard_file_digest(base, [s])[0]))
-                        except OSError:
-                            continue  # shard unmounted/moved mid-scan
-                        self.metrics.count("scrub_shards_checked")
-                        if got != want:
-                            bad.append(sid)
-                            self.metrics.count("scrub_shards_bad")
-                            log.warning(
-                                "scrub: shard %d of volume %d digest "
-                                "mismatch (%d != %d)", sid, vid, got,
-                                want)
-                        await asyncio.sleep(throttle_seconds)
-                    if bad:
-                        bad_by_vid[vid] = bad
-        for vid, bad in bad_by_vid.items():
-            await self._report_bad_shards(vid, bad)
+                        bad: list[int] = []
+                        for sid in ev.shard_ids():
+                            want = stamped.get(sid)
+                            if want is None:
+                                continue
+                            try:
+                                got = await loop.run_in_executor(
+                                    None, lambda s=sid: int(
+                                        shard_file_digest(base, [s])[0]))
+                            except OSError:
+                                continue  # shard unmounted/moved mid-scan
+                            self.metrics.count("scrub_shards_checked")
+                            if got != want:
+                                bad.append(sid)
+                                self.metrics.count("scrub_shards_bad")
+                                log.warning(
+                                    "scrub: shard %d of volume %d digest "
+                                    "mismatch (%d != %d)", sid, vid, got,
+                                    want)
+                            await asyncio.sleep(throttle_seconds)
+                        if bad:
+                            bad_by_vid[vid] = bad
+            for vid, bad in bad_by_vid.items():
+                await self._report_bad_shards(vid, bad)
+        finally:
+            overload.reset_priority(_ptok)
         return bad_by_vid
 
     async def _report_bad_shards(self, vid: int, bad: list[int]) -> None:

@@ -18,7 +18,7 @@ from xml.sax.saxutils import escape
 import aiohttp
 from aiohttp import web
 
-from .. import observe
+from .. import observe, overload
 from ..utils import metrics as metrics_mod
 
 log = logging.getLogger("webdav")
@@ -156,42 +156,42 @@ class WebDavServer:
         self._session: Optional[aiohttp.ClientSession] = None
         self.locks = LockManager()
         self.metrics = metrics_mod.Registry("webdav")
+        # gateway system set: only the reserved ops routes — user files
+        # named like control-plane paths stay metered
+        self.admission = overload.AdmissionController(
+            "webdav", metrics=self.metrics,
+            system_paths=(overload.GATEWAY_SYSTEM_PATHS
+                          | overload.faults_admin_paths()))
         self.app = self._build_app()
 
     def _build_app(self) -> web.Application:
         app = web.Application(
             client_max_size=1024 * 1024 * 1024,
-            middlewares=[observe.trace_middleware("webdav", self.url)])
-        # ops surface before the catch-all (exact routes win); reserved
-        # for ALL methods so a PUT can't create a file that GET then
-        # shadows. Like the rest of the webdav protocol surface, these
-        # carry no auth — deploy this gateway on trusted networks only.
+            middlewares=[observe.trace_middleware("webdav", self.url),
+                         overload.admission_middleware(self.admission)])
+        # ops surface before the catch-all (exact routes win), via
+        # overload.reserve_ops so every other method answers 405 and a
+        # PUT can't create a file that GET then shadows. Like the rest
+        # of the webdav protocol surface, these carry no auth — deploy
+        # this gateway on trusted networks only.
         from .. import faults
         from ..utils.profiling import profile_handler
-        for path, handler in (("/healthz", self.healthz),
-                              ("/metrics", self.metrics_handler),
-                              ("/debug/trace", observe.trace_handler()),
-                              ("/debug/profile", profile_handler())):
-            app.router.add_get(path, handler)
-            app.router.add_route("*", path, self._reserved)
+        for path, handler in (
+                ("/healthz", overload.healthz_handler(self.admission)),
+                ("/metrics", self.metrics_handler),
+                ("/debug/trace", observe.trace_handler()),
+                ("/debug/profile", profile_handler())):
+            overload.reserve_ops(app, path, handler)
         if faults.admin_enabled():
             # opt-in only (WEED_FAULTS_ADMIN=1): the webdav surface
             # carries no auth at all
             _faults_handler = faults.admin_handler()
-            app.router.add_get("/admin/faults", _faults_handler)
-            app.router.add_post("/admin/faults", _faults_handler)
-            app.router.add_route("*", "/admin/faults", self._reserved)
+            overload.reserve_ops(app, "/admin/faults", _faults_handler,
+                                 post_handler=_faults_handler)
         app.router.add_route("*", "/{path:.*}", self.dispatch)
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         return app
-
-    async def healthz(self, request: web.Request) -> web.Response:
-        return web.json_response({"ok": True})
-
-    async def _reserved(self, request: web.Request) -> web.Response:
-        return web.json_response(
-            {"error": "reserved operational endpoint"}, status=405)
 
     async def metrics_handler(self, request: web.Request) -> web.Response:
         return web.Response(text=(self.metrics.render()
@@ -199,6 +199,7 @@ class WebDavServer:
                             content_type="text/plain")
 
     async def _on_startup(self, app) -> None:
+        await self.admission.start()
         self._session = aiohttp.ClientSession(
             # inactivity-bounded, no total cap (large file streams)
             timeout=aiohttp.ClientTimeout(total=None, sock_connect=10,
@@ -206,6 +207,7 @@ class WebDavServer:
             trace_configs=[observe.client_trace_config()])
 
     async def _on_cleanup(self, app) -> None:
+        self.admission.stop()
         if self._session:
             await self._session.close()
 

@@ -36,6 +36,46 @@ from typing import Optional
 
 DEADLINE_HEADER = "X-Seaweed-Deadline"
 
+# statuses worth retrying after a pause: transient overload (429/503 —
+# the admission plane's shed answers), bad gateway / gateway timeout
+# from a proxy mid-failover
+RETRYABLE_STATUSES = frozenset({429, 502, 503, 504})
+
+# cap on how long a client will honor a server-sent Retry-After: a
+# buggy or hostile header must not park a retry loop for an hour
+MAX_RETRY_AFTER_S = 30.0
+
+
+def parse_retry_after(value) -> Optional[float]:
+    """Retry-After header -> seconds (delta-seconds or HTTP-date form),
+    clamped to [0, MAX_RETRY_AFTER_S]; None when absent/unparseable."""
+    if not value:
+        return None
+    try:
+        return min(max(0.0, float(value)), MAX_RETRY_AFTER_S)
+    except (TypeError, ValueError):
+        pass
+    try:
+        from email.utils import parsedate_to_datetime
+        dt = parsedate_to_datetime(value)
+        return min(max(0.0, dt.timestamp() - time.time()),
+                   MAX_RETRY_AFTER_S)
+    except (TypeError, ValueError):
+        return None
+
+
+def is_shed(status: int, headers) -> bool:
+    """True when a response is the overload plane's shed answer
+    (``X-Seaweed-Shed: 1`` on a 429/503): the host is ALIVE and asked us
+    to back off — it must not be charged as a circuit-breaker failure,
+    or a load spike trips every breaker and becomes a capacity
+    collapse."""
+    if status not in (429, 503) or headers is None:
+        return False
+    v = headers.get("x-seaweed-shed", "") or headers.get(
+        "X-Seaweed-Shed", "")
+    return str(v).strip() == "1"
+
 _deadline: contextvars.ContextVar[float] = contextvars.ContextVar(
     "sw_deadline", default=0.0)
 

@@ -167,6 +167,114 @@ def test_import_guard_walker_catches_violations():
     assert hits == ["time", "uuid"]
 
 
+# --- serving-surface construction guards (overload plane) ---
+
+# the HTTP serving surfaces: every one of them must meter traffic
+# through the admission middleware — PR 5 proved that a surface missed
+# once stays missed until an incident finds it
+SERVING_SURFACES = (
+    os.path.join("server", "master.py"),
+    os.path.join("server", "volume_server.py"),
+    os.path.join("server", "filer_server.py"),
+    os.path.join("server", "webdav_server.py"),
+    os.path.join("s3", "s3_server.py"),
+    os.path.join("messaging", "broker.py"),
+)
+
+
+def _application_calls(tree: ast.Module):
+    """Every `web.Application(...)` / `aiohttp.web.Application(...)`
+    construction in the module."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "Application":
+            yield node
+
+
+def _package_files():
+    for dirpath, dirnames, filenames in os.walk(PKG_ROOT):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def test_every_web_application_sets_client_max_size():
+    """aiohttp's silent 1 MiB default body cap bites exactly once per
+    forgotten surface (the filer's autochunk PUT path sized its bound
+    deliberately; a new app construction without one would cap bodies
+    by accident). Every Application() in the package must state its
+    client_max_size explicitly."""
+    violations = []
+    for path in _package_files():
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        for call in _application_calls(tree):
+            if not any(kw.arg == "client_max_size"
+                       for kw in call.keywords):
+                rel = os.path.relpath(path, PKG_ROOT)
+                violations.append(
+                    f"{rel}:{call.lineno} web.Application() without an "
+                    "explicit client_max_size (aiohttp's silent 1 MiB "
+                    "default caps non-streamed bodies)")
+    assert not violations, "\n".join(violations)
+
+
+def test_every_server_app_installs_admission_middleware():
+    """No unguarded serving surface: every server app construction must
+    include the overload admission middleware in its middlewares list
+    (the fastpath listeners hook admission explicitly in
+    server/fastpath.py — they bypass aiohttp middleware).  The surface
+    list itself is checked for completeness: a file that grows a
+    web.Application() without being added here fails, so the guard
+    can't silently certify a surface it never looked at."""
+    violations = []
+    for path in _package_files():
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        rel = os.path.relpath(path, PKG_ROOT)
+        if rel not in SERVING_SURFACES and any(_application_calls(tree)):
+            violations.append(
+                f"{rel}: constructs a web.Application but is not listed "
+                "in SERVING_SURFACES — an unmetered HTTP surface")
+    for rel in SERVING_SURFACES:
+        path = os.path.join(PKG_ROOT, rel)
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        calls = list(_application_calls(tree))
+        assert calls, f"{rel}: no web.Application() found"
+        for call in calls:
+            mw = next((kw.value for kw in call.keywords
+                       if kw.arg == "middlewares"), None)
+            if mw is None or "admission_middleware" not in ast.dump(mw):
+                violations.append(
+                    f"{rel}:{call.lineno} web.Application() does not "
+                    "install overload.admission_middleware — an "
+                    "unguarded serving surface accepts unbounded load")
+    assert not violations, "\n".join(violations)
+
+
+def test_application_guard_walker_catches_violations():
+    """The Application walker must flag a missing client_max_size /
+    admission middleware and accept the compliant shape."""
+    good = ast.parse(
+        "app = web.Application(client_max_size=1,\n"
+        "    middlewares=[trace, overload.admission_middleware(c)])\n")
+    bad = ast.parse("app = web.Application(middlewares=[trace])\n")
+    g = list(_application_calls(good))
+    b = list(_application_calls(bad))
+    assert len(g) == 1 and len(b) == 1
+    assert any(kw.arg == "client_max_size" for kw in g[0].keywords)
+    assert not any(kw.arg == "client_max_size" for kw in b[0].keywords)
+    mw = next(kw.value for kw in g[0].keywords
+              if kw.arg == "middlewares")
+    assert "admission_middleware" in ast.dump(mw)
+    mw = next(kw.value for kw in b[0].keywords
+              if kw.arg == "middlewares")
+    assert "admission_middleware" not in ast.dump(mw)
+
+
 def test_guard_walker_catches_violations():
     """The walker itself must detect the patterns it guards against —
     direct calls, aliased modules and from-imports — and must NOT flag

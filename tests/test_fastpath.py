@@ -275,3 +275,152 @@ def test_whitelist_passes_through_proxy(tmp_path):
         assert status == 201
     finally:
         s.stop()
+
+
+def test_fastpath_admission_hook_sheds(tmp_path, monkeypatch):
+    """The raw-socket listener bypasses aiohttp middleware, so the
+    overload plane hooks it explicitly: with a 1-slot foreground pipe
+    and no queue, a second concurrent read sheds 503 with the shed
+    marker + Retry-After, bg-tagged reads shed under that pressure, and
+    the inline fast read fires the volume.read fault point (which is
+    what makes this test's service time controllable at all)."""
+    import time
+
+    from seaweedfs_tpu import faults
+
+    monkeypatch.setenv("WEED_ADMISSION_FG_CONCURRENCY", "1")
+    monkeypatch.setenv("WEED_ADMISSION_FG_QUEUE", "0")
+    monkeypatch.setenv("WEED_ADMISSION_LAG_SAMPLE_MS", "100")
+    monkeypatch.setenv("WEED_ADMISSION_RETRY_AFTER_S", "1")
+    srv = _Srv(str(tmp_path))
+    try:
+        payload = b"shed me" * 10
+        body, ct = _multipart(payload)
+        status, _, _ = _req(srv.port, "POST", f"/{FID}", body,
+                            {"Content-Type": ct})
+        assert status == 201
+
+        # unfaulted read works and is admitted
+        status, _, got = _req(srv.port, "GET", f"/{FID}")
+        assert status == 200 and got == payload
+
+        # make the inline fast read slow via the fault plane (the hook
+        # added alongside admission: fastpath fires volume.read too)
+        faults.set_fault("volume.read", "delay", ms=600)
+        t = threading.Thread(target=_req,
+                             args=(srv.port, "GET", f"/{FID}"))
+        t.start()
+        time.sleep(0.2)  # the slow read owns the single fg slot
+        status, hdrs, _ = _req(srv.port, "GET", f"/{FID}")
+        assert status == 503
+        assert hdrs.get("x-seaweed-shed") == "1"
+        assert int(hdrs.get("retry-after", "0")) >= 1
+        # background is locked out while fg is under pressure
+        status, hdrs, _ = _req(srv.port, "GET", f"/{FID}",
+                               headers={"X-Seaweed-Priority": "bg"})
+        assert status == 503 and hdrs.get("x-seaweed-shed") == "1"
+        t.join(10)
+        faults.clear()
+        # pressure gone (one sampler window): everything flows again
+        time.sleep(0.15)
+        status, _, got = _req(srv.port, "GET", f"/{FID}",
+                              headers={"X-Seaweed-Priority": "bg"})
+        assert status == 200 and got == payload
+    finally:
+        faults.clear()
+        srv.stop()
+
+
+def test_fastpath_sheds_before_buffering_body(tmp_path, monkeypatch):
+    """Admission runs from the HEADERS, before the body is buffered: a
+    write that will be shed must be refused while its body is still on
+    the wire, or a storm of declared-large POSTs buffers gigabytes of
+    bodies that were never going to be admitted (the memory-collapse
+    mode the overload plane exists to stop).  The shed answer arrives
+    with none of the body sent, and the connection closes (an unread
+    body makes the framing unrecoverable)."""
+    import time
+
+    from seaweedfs_tpu import faults
+
+    monkeypatch.setenv("WEED_ADMISSION_FG_CONCURRENCY", "1")
+    monkeypatch.setenv("WEED_ADMISSION_FG_QUEUE", "0")
+    monkeypatch.setenv("WEED_ADMISSION_LAG_SAMPLE_MS", "2000")
+    srv = _Srv(str(tmp_path))
+    try:
+        payload = b"hold the slot"
+        body, ct = _multipart(payload)
+        status, _, _ = _req(srv.port, "POST", f"/{FID}", body,
+                            {"Content-Type": ct})
+        assert status == 201
+        faults.set_fault("volume.read", "delay", ms=800)
+        t = threading.Thread(target=_req,
+                             args=(srv.port, "GET", f"/{FID}"))
+        t.start()
+        time.sleep(0.2)  # the slow read owns the single fg slot
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        try:
+            s.sendall(f"POST /{FID} HTTP/1.1\r\nHost: x\r\n"
+                      f"Content-Length: 10000000\r\n"
+                      f"Content-Type: multipart/form-data; boundary=q"
+                      f"\r\n\r\n".encode())  # headers only — no body
+            s.settimeout(3.0)
+            t0 = time.monotonic()
+            data = s.recv(65536)
+            took = time.monotonic() - t0
+            line = data.split(b"\r\n", 1)[0]
+            assert b"503" in line, data
+            assert b"x-seaweed-shed: 1" in data.lower(), data
+            # answered from the headers alone, not after a body wait
+            assert took < 1.0, took
+            # unread body in flight -> server closes the connection
+            assert s.recv(4096) == b""
+        finally:
+            s.close()
+        t.join(10)
+    finally:
+        faults.clear()
+        srv.stop()
+
+
+def test_mark_internal_strips_spoofed_swfs_headers():
+    """A client-sent X-Swfs-Tunnel on a proxied (already-admitted)
+    request would make the aiohttp middleware meter it a SECOND time —
+    with fg slots held at the listener that deadlocks the class into
+    queue-timeout sheds. All client copies of the internal headers are
+    stripped before the listener injects its own."""
+    import types
+    from seaweedfs_tpu.server.fastpath import FastVolumeProtocol
+
+    p = FastVolumeProtocol.__new__(FastVolumeProtocol)
+    p.server = types.SimpleNamespace(_internal_token="tok123")
+    p.peer_ip = "10.0.0.9"
+    raw = (b"GET /1,abc HTTP/1.1\r\n"
+           b"Host: x\r\n"
+           b"X-Swfs-Tunnel: 1\r\n"
+           b"X-Swfs-Internal: guessed\r\n"
+           b"X-Swfs-Peer: 8.8.8.8\r\n"
+           b"Accept: */*\r\n"
+           b"\r\nBODY")
+    parts = p._mark_internal(raw)
+    # the body rides as an uncopied view into the original buffer (a
+    # proxied 256 MB PUT must not pay full-buffer copies here)
+    assert isinstance(parts[-1], memoryview)
+    assert parts[-1].obj is raw
+    marked = b"".join(bytes(x) for x in parts)
+    head = marked.split(b"\r\n\r\n", 1)[0]
+    # exactly one copy of each injected header, ours
+    assert head.count(b"X-Swfs-Internal:") == 1
+    assert b"X-Swfs-Internal: tok123" in head
+    assert head.count(b"X-Swfs-Peer:") == 1
+    assert b"X-Swfs-Peer: 10.0.0.9" in head
+    assert b"X-Swfs-Tunnel" not in head       # spoofed marker gone
+    assert b"guessed" not in head and b"8.8.8.8" not in head
+    assert b"Host: x" in head and b"Accept: */*" in head
+    assert marked.endswith(b"\r\n\r\nBODY")
+    # the real tunnel path still marks itself
+    marked = b"".join(bytes(x)
+                      for x in p._mark_internal(raw, tunnel=True))
+    head = marked.split(b"\r\n\r\n", 1)[0]
+    assert head.count(b"X-Swfs-Tunnel:") == 1
+    assert b"X-Swfs-Tunnel: 1" in head

@@ -1,0 +1,685 @@
+"""Overload plane in isolation: token buckets, the event-loop lag
+sampler, the admission controller's shed rules, the aiohttp middleware,
+and the cooperative client side (Retry-After honored, shed responses
+exempt from breaker accounting)."""
+
+import asyncio
+import http.server
+import json
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu import overload
+from seaweedfs_tpu.overload import (AdmissionController, LoopLagSampler,
+                                    ShedError, TenantBuckets, TokenBucket)
+from seaweedfs_tpu.utils import retry as retry_mod
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# --- token buckets ---
+
+def test_bucket_burst_capacity():
+    clk = FakeClock()
+    b = TokenBucket(rate=10.0, burst=5.0, clock=clk)
+    assert [b.try_acquire() for _ in range(5)] == [True] * 5
+    assert not b.try_acquire()  # burst exhausted, no time has passed
+
+
+def test_bucket_monotonic_refill():
+    clk = FakeClock()
+    b = TokenBucket(rate=10.0, burst=5.0, clock=clk)
+    for _ in range(5):
+        assert b.try_acquire()
+    clk.advance(0.25)  # 2.5 tokens back
+    assert b.try_acquire() and b.try_acquire()
+    assert not b.try_acquire()
+    # refill never exceeds burst
+    clk.advance(1000.0)
+    assert abs(b.tokens() - 5.0) < 1e-9
+    # a clock that goes nowhere (or backwards) mints no free tokens
+    for _ in range(5):
+        b.try_acquire()
+    clk.t -= 50.0
+    assert not b.try_acquire()
+
+
+def test_bucket_no_refill_drift_under_concurrent_acquires():
+    """N threads hammering try_acquire must never beat the arithmetic
+    bound burst + rate*elapsed: if two threads both credited the same
+    elapsed interval (refill drift), the total would exceed it."""
+    rate, burst = 200.0, 20.0
+    b = TokenBucket(rate=rate, burst=burst)
+    admitted = []
+    stop = time.monotonic() + 0.5
+    start = time.monotonic()
+
+    def worker():
+        n = 0
+        while time.monotonic() < stop:
+            if b.try_acquire():
+                n += 1
+        admitted.append(n)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - start
+    total = sum(admitted)
+    assert total <= burst + rate * elapsed + 1.0, \
+        f"refill drift: {total} > {burst} + {rate}*{elapsed:.3f}"
+    # and the bucket wasn't starved either (loose floor: CI is noisy)
+    assert total >= burst + rate * 0.5 * 0.5
+
+
+def test_tenant_buckets_isolated_and_bounded():
+    clk = FakeClock()
+    tb = TenantBuckets(rate=1.0, burst=2.0, max_tenants=3, clock=clk)
+    assert tb.try_acquire("a") and tb.try_acquire("a")
+    assert not tb.try_acquire("a")   # tenant a exhausted
+    assert tb.try_acquire("b")       # tenant b unaffected
+    assert tb.try_acquire("")        # untenanted is not metered here
+    for t in ("c", "d", "e"):
+        tb.try_acquire(t)
+    assert len(tb) <= 3              # bounded: client-chosen keys can't
+    #                                  grow server memory unboundedly
+
+
+# --- loop lag sampler ---
+
+def test_lag_sampler_detects_injected_stall():
+    async def main():
+        # a 100ms stall shows up as lag in [stall - interval, stall]:
+        # the pending wakeup was scheduled at most one interval before
+        # the stall ended — small interval => tight bound
+        s = LoopLagSampler(interval=0.02, window=20)
+        await s.start()
+        await asyncio.sleep(0.08)            # a few clean samples
+        clean = s.recent_max()
+        time.sleep(0.1)                       # stall the loop itself
+        await asyncio.sleep(0.05)             # let the late sample land
+        stalled = s.recent_max()
+        s.stop()
+        assert stalled >= 0.07, f"stall not detected: {stalled}"
+        assert stalled > clean
+    asyncio.run(main())
+
+
+# --- admission controller ---
+
+def _controller(**kw) -> AdmissionController:
+    kw.setdefault("env", {})  # isolate from WEED_ADMISSION_* in the env
+    return AdmissionController("test", **kw)
+
+
+def test_bg_sheds_while_fg_waiting_and_recovers():
+    clk = FakeClock()
+
+    async def main():
+        c = _controller(fg_concurrency=1, fg_queue=8, bg_concurrency=8,
+                        queue_timeout=5.0, time_fn=clk)
+        first = await c.admit(overload.CLASS_FG)
+        waiter = asyncio.ensure_future(c.admit(overload.CLASS_FG))
+        await asyncio.sleep(0.01)  # park the second fg in the queue
+        assert c.classes[overload.CLASS_FG].waiting == 1
+        with pytest.raises(ShedError) as ei:
+            await c.admit(overload.CLASS_BG)
+        assert ei.value.status == 503
+        assert ei.value.headers()["X-Seaweed-Shed"] == "1"
+        assert int(ei.value.headers()["Retry-After"]) >= 1
+        # fg itself keeps flowing: release hands the slot to the waiter
+        first.release()
+        second = await waiter
+        assert c.classes[overload.CLASS_FG].inflight == 1
+        second.release()
+        # queue drained + one sampler window later: bg flows again
+        clk.advance(c.window + 0.001)
+        (await c.admit(overload.CLASS_BG)).release()
+    asyncio.run(main())
+
+
+def test_fg_shed_locks_bg_out_for_one_window():
+    clk = FakeClock()
+
+    async def main():
+        c = _controller(fg_concurrency=1, fg_queue=0,
+                        queue_timeout=0.05, time_fn=clk)
+        t = await c.admit(overload.CLASS_FG)
+        with pytest.raises(ShedError) as ei:
+            await c.admit(overload.CLASS_FG)   # queue_depth=0: shed now
+        assert ei.value.reason == "queue full"
+        t.release()
+        # no fg waiting anymore, but the shed was within the window
+        with pytest.raises(ShedError) as ei:
+            await c.admit(overload.CLASS_BG)
+        assert ei.value.reason == "foreground pressure"
+        clk.advance(c.window + 0.001)
+        (await c.admit(overload.CLASS_BG)).release()
+    asyncio.run(main())
+
+
+def test_queue_timeout_sheds():
+    async def main():
+        c = _controller(fg_concurrency=1, fg_queue=4, queue_timeout=0.05)
+        t = await c.admit(overload.CLASS_FG)
+        t0 = time.monotonic()
+        with pytest.raises(ShedError) as ei:
+            await c.admit(overload.CLASS_FG)
+        assert ei.value.reason == "queue timeout"
+        assert time.monotonic() - t0 < 2.0
+        assert c.classes[overload.CLASS_FG].waiting == 0  # no leak
+        t.release()
+        (await c.admit(overload.CLASS_FG)).release()
+    asyncio.run(main())
+
+
+def test_tenant_bucket_answers_429():
+    clk = FakeClock()
+
+    async def main():
+        c = _controller(tenant_rps=1.0, tenant_burst=2.0, time_fn=clk)
+        for _ in range(2):
+            (await c.admit(overload.CLASS_FG, tenant="hog")).release()
+        with pytest.raises(ShedError) as ei:
+            await c.admit(overload.CLASS_FG, tenant="hog")
+        assert ei.value.status == 429
+        # other tenants and untenanted traffic unaffected
+        (await c.admit(overload.CLASS_FG, tenant="quiet")).release()
+        (await c.admit(overload.CLASS_FG)).release()
+    asyncio.run(main())
+
+
+def test_tenant_shed_is_not_node_pressure():
+    """A hog tenant exhausting its OWN bucket on an idle node must not
+    lock out background traffic nor flip the /healthz shedding flag —
+    that would drain a healthy node and starve cluster self-healing."""
+    clk = FakeClock()
+
+    async def main():
+        c = _controller(tenant_rps=1.0, tenant_burst=1.0, time_fn=clk)
+        (await c.admit(overload.CLASS_FG, tenant="hog")).release()
+        with pytest.raises(ShedError) as ei:
+            await c.admit(overload.CLASS_FG, tenant="hog")
+        assert ei.value.status == 429
+        # no fg pressure: bg still admitted, healthz stays calm
+        (await c.admit(overload.CLASS_BG)).release()
+        assert c.health()["shedding"] is False
+    asyncio.run(main())
+
+
+def test_global_bucket_answers_503():
+    clk = FakeClock()
+
+    async def main():
+        c = _controller(global_rps=1.0, global_burst=1.0, time_fn=clk)
+        (await c.admit(overload.CLASS_FG)).release()
+        with pytest.raises(ShedError) as ei:
+            await c.admit(overload.CLASS_FG)
+        assert ei.value.status == 503
+    asyncio.run(main())
+
+
+def test_system_class_never_shed():
+    clk = FakeClock()
+
+    async def main():
+        c = _controller(fg_concurrency=1, fg_queue=0, queue_timeout=0.01,
+                        global_rps=1.0, global_burst=1.0, time_fn=clk)
+        t = await c.admit(overload.CLASS_FG)   # spends the global token
+        with pytest.raises(ShedError):
+            await c.admit(overload.CLASS_FG)
+        # control plane sails through caps, buckets and fg pressure
+        (await c.admit(overload.CLASS_SYSTEM)).release()
+        t.release()
+    asyncio.run(main())
+
+
+def test_health_reports_shedding_state():
+    clk = FakeClock()
+
+    async def main():
+        c = _controller(fg_concurrency=1, fg_queue=0,
+                        queue_timeout=0.01, time_fn=clk)
+        assert c.health()["shedding"] is False
+        t = await c.admit(overload.CLASS_FG)
+        with pytest.raises(ShedError):
+            await c.admit(overload.CLASS_FG)
+        h = c.health()
+        assert h["shedding"] is True
+        assert h["classes"][overload.CLASS_FG]["shed_recent"] is True
+        clk.advance(c.window + 0.001)
+        assert c.health()["shedding"] is False  # one window later
+        t.release()
+    asyncio.run(main())
+
+
+def test_tenant_validator_sends_unknown_keys_to_global_bucket():
+    """Admission runs before request auth, so tenant keys arrive
+    unverified: a spoofed Credential=VICTIMKEY from an unauthenticated
+    client must not drain the victim's bucket (nor churn the bounded
+    TenantBuckets LRU with random keys)."""
+    clk = FakeClock()
+
+    async def main():
+        c = _controller(tenant_rps=1.0, tenant_burst=1.0, time_fn=clk,
+                        tenant_validator=lambda k: k == "real")
+        # spoofed keys never touch a tenant bucket: admit freely, and
+        # no bucket is ever minted for them (no LRU churn)
+        for _ in range(5):
+            (await c.admit(overload.CLASS_FG, tenant="spoofed")).release()
+        assert "spoofed" not in c.tenant_buckets._buckets
+        # the real tenant's bucket still meters the real tenant
+        (await c.admit(overload.CLASS_FG, tenant="real")).release()
+        with pytest.raises(ShedError) as ei:
+            await c.admit(overload.CLASS_FG, tenant="real")
+        assert ei.value.status == 429
+    asyncio.run(main())
+
+
+def test_health_shedding_ignores_bg_only_pressure():
+    """A repair fan-in overflowing the bg caps on an otherwise idle
+    node must not flip the drain signal: the LB keys on it, and
+    draining a node whose foreground path is perfectly healthy turns
+    a background backlog into lost serving capacity."""
+    clk = FakeClock()
+
+    async def main():
+        c = _controller(bg_concurrency=1, bg_queue=0,
+                        queue_timeout=0.01, time_fn=clk)
+        t = await c.admit(overload.CLASS_BG)
+        with pytest.raises(ShedError):
+            await c.admit(overload.CLASS_BG)   # bg queue full -> shed
+        h = c.health()
+        assert h["classes"][overload.CLASS_BG]["shed_recent"] is True
+        assert h["shedding"] is False          # fg path is healthy
+        t.release()
+    asyncio.run(main())
+
+
+# --- classification / propagation helpers ---
+
+def test_classify_and_priority_context():
+    assert overload.classify("", "/some/file") == overload.CLASS_FG
+    assert overload.classify("bg", "/some/file") == overload.CLASS_BG
+    assert overload.classify("background", "/x") == overload.CLASS_BG
+    assert overload.classify("weird", "/x") == overload.CLASS_FG
+    # path wins: control plane stays system even when tagged bg
+    assert overload.classify("bg", "/heartbeat") == overload.CLASS_SYSTEM
+    assert overload.classify("", "/debug/trace") == overload.CLASS_SYSTEM
+    # EXACT ops routes only: an arbitrary /debug/<x> path resolves to
+    # user data on the catch-all surfaces and must be metered, and
+    # /admin/faults is only a registered route on master/volume (the
+    # gateways add it via faults_admin_paths when WEED_FAULTS_ADMIN=1)
+    assert overload.classify("", "/debug/anything") == overload.CLASS_FG
+    assert overload.classify(
+        "", "/admin/faults",
+        overload.GATEWAY_SYSTEM_PATHS) == overload.CLASS_FG
+    assert overload.classify(
+        "", "/admin/faults",
+        overload.VOLUME_SYSTEM_PATHS) == overload.CLASS_SYSTEM
+    import os as _os
+    _prev = _os.environ.pop("WEED_FAULTS_ADMIN", None)
+    try:
+        assert overload.faults_admin_paths() == frozenset()
+        _os.environ["WEED_FAULTS_ADMIN"] = "1"
+        assert overload.faults_admin_paths() == frozenset(
+            {"/admin/faults"})
+    finally:
+        if _prev is None:
+            _os.environ.pop("WEED_FAULTS_ADMIN", None)
+        else:
+            _os.environ["WEED_FAULTS_ADMIN"] = _prev
+    headers = {}
+    overload.inject(headers)
+    assert headers == {}  # untagged = foreground: no header noise
+    with overload.priority(overload.CLASS_BG):
+        overload.inject(headers)
+    assert headers[overload.PRIORITY_HEADER] == overload.CLASS_BG
+    assert overload.current_priority() == ""  # reset on exit
+
+
+def test_tenant_from_request_variants():
+    class Req:
+        def __init__(self, query=None, headers=None):
+            self.query = query or {}
+            self.headers = headers or {}
+
+    assert overload.tenant_from_request(Req({"collection": "c1"})) == "c1"
+    sig4 = ("AWS4-HMAC-SHA256 Credential=AKIDEXAMPLE/20260803/us-east-1/"
+            "s3/aws4_request, SignedHeaders=host, Signature=abc")
+    assert overload.tenant_from_request(
+        Req(headers={"Authorization": sig4})) == "AKIDEXAMPLE"
+    assert overload.tenant_from_request(
+        Req(headers={"Authorization": "AWS AKV2KEY:sig"})) == "AKV2KEY"
+    assert overload.tenant_from_request(Req()) == ""
+
+
+# --- aiohttp middleware ---
+
+def test_middleware_sheds_marks_and_skips_internal():
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer
+
+    clk = FakeClock()
+
+    async def main():
+        c = _controller(fg_concurrency=1, fg_queue=0, queue_timeout=0.01,
+                        time_fn=clk)
+        seen_priority = []
+
+        async def handler(request):
+            seen_priority.append(overload.current_priority())
+            if request.query.get("hold"):
+                await asyncio.sleep(0.6)
+            return web.json_response({"ok": True})
+
+        app = web.Application(middlewares=[overload.admission_middleware(
+            c, internal_token=lambda: "sekrit")])
+        app.router.add_get("/healthz", overload.healthz_handler(c))
+        app.router.add_route("*", "/{p:.*}", handler)
+        async with TestClient(TestServer(app)) as client:
+            r = await client.get("/file1")
+            assert r.status == 200
+            hold = asyncio.ensure_future(client.get("/file2?hold=1"))
+            await asyncio.sleep(0.1)  # the held request owns the slot
+            r = await client.get("/file3")
+            assert r.status == 503
+            assert r.headers["X-Seaweed-Shed"] == "1"
+            assert "Retry-After" in r.headers
+            # fg shed within the window -> bg locked out
+            r = await client.get(
+                "/file4", headers={overload.PRIORITY_HEADER: "bg"})
+            assert r.status == 503
+            # internal-token requests were admitted at the fastpath
+            # listener: the middleware must not double-meter them
+            r = await client.get("/file5",
+                                 headers={"X-Swfs-Internal": "sekrit"})
+            assert r.status == 200
+            # ... but a bg-tagged proxied request must still rebind the
+            # ambient priority (the fastpath task's contextvar doesn't
+            # cross the loopback hop) so nested fetches present as bg
+            r = await client.get(
+                "/file5b", headers={"X-Swfs-Internal": "sekrit",
+                                    overload.PRIORITY_HEADER: "bg"})
+            assert r.status == 200
+            assert seen_priority[-1] == overload.CLASS_BG
+            # tunneled requests (chunked/Expect framing) carry the token
+            # only to skip the whitelist re-check — they were NOT
+            # admitted at the listener and must be metered here, or any
+            # client dodges the caps via Transfer-Encoding: chunked
+            r = await client.get(
+                "/file5c", headers={"X-Swfs-Internal": "sekrit",
+                                    "X-Swfs-Tunnel": "1"})
+            assert r.status == 503
+            assert r.headers["X-Seaweed-Shed"] == "1"
+            # healthz reports the shedding, and is itself never shed
+            r = await client.get("/healthz")
+            assert r.status == 200
+            payload = await r.json()
+            assert payload["admission"]["shedding"] is True
+            assert (await hold).status == 200
+            # bg handlers observe the bg ambient priority (propagation)
+            clk.advance(c.window + 1.0)
+            r = await client.get(
+                "/file6", headers={overload.PRIORITY_HEADER: "bg"})
+            assert r.status == 200
+            assert seen_priority[-1] == overload.CLASS_BG
+    asyncio.run(main())
+
+
+# --- cooperative client side ---
+
+def test_parse_retry_after_and_is_shed():
+    assert retry_mod.parse_retry_after("2") == 2.0
+    assert retry_mod.parse_retry_after("1.5") == 1.5
+    assert retry_mod.parse_retry_after("-3") == 0.0
+    assert retry_mod.parse_retry_after("10000") == \
+        retry_mod.MAX_RETRY_AFTER_S
+    assert retry_mod.parse_retry_after("") is None
+    assert retry_mod.parse_retry_after("garbage") is None
+    future = time.time() + 4
+    from email.utils import formatdate
+    got = retry_mod.parse_retry_after(formatdate(future, usegmt=True))
+    assert got is not None and 0.0 <= got <= 5.0
+    assert retry_mod.is_shed(503, {"x-seaweed-shed": "1"})
+    assert retry_mod.is_shed(429, {"X-Seaweed-Shed": "1"})
+    assert not retry_mod.is_shed(503, {})
+    assert not retry_mod.is_shed(200, {"x-seaweed-shed": "1"})
+    assert not retry_mod.is_shed(500, {"x-seaweed-shed": "1"})
+
+
+class _ShedOnceHandler(http.server.BaseHTTPRequestHandler):
+    """First request sheds (503 + marker + Retry-After: 0), later ones
+    succeed — the shape of a server riding out a load spike."""
+    shed_count = 0
+
+    def do_GET(self):
+        cls = type(self)
+        if cls.shed_count < 1:
+            cls.shed_count += 1
+            body = b'{"error": "overloaded"}'
+            self.send_response(503)
+            self.send_header("Retry-After", "0")
+            self.send_header("X-Seaweed-Shed", "1")
+        else:
+            body = b'{"ok": true}'
+            self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+def test_http_pool_honors_retry_after_without_breaker_failure():
+    from seaweedfs_tpu.cache.http_pool import HttpPool
+    from seaweedfs_tpu.utils.retry import CircuitBreaker
+
+    _ShedOnceHandler.shed_count = 0
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                          _ShedOnceHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    host, port = srv.server_address
+    try:
+        # threshold 1: a single recorded failure would open the breaker
+        breaker = CircuitBreaker(failure_threshold=1)
+        pool = HttpPool(breaker=breaker, shed_retries=1)
+        r = pool.request("GET", f"http://{host}:{port}/x")
+        # the pool backed off per Retry-After and re-sent: caller never
+        # sees the shed
+        assert r.status == 200
+        assert not breaker.is_open(f"{host}:{port}")
+        # a shed response with retries disabled surfaces, but still
+        # never charges the breaker
+        _ShedOnceHandler.shed_count = 0
+        pool2 = HttpPool(breaker=breaker, shed_retries=0)
+        r = pool2.request("GET", f"http://{host}:{port}/y")
+        assert r.status == 503
+        assert r.headers.get("x-seaweed-shed") == "1"
+        assert not breaker.is_open(f"{host}:{port}")
+        pool.close()
+        pool2.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+class _AlwaysShedHandler(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):
+        body = b'{"error": "overloaded"}'
+        self.send_response(503)
+        self.send_header("Retry-After", "3")
+        self.send_header("X-Seaweed-Shed", "1")
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+def test_http_pool_shed_backoff_capped_by_call_timeout():
+    """A caller budgeting 0.2s for the whole call must get the shed
+    verdict back, not block on the server's 3s Retry-After."""
+    from seaweedfs_tpu.cache.http_pool import HttpPool
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                          _AlwaysShedHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    host, port = srv.server_address
+    try:
+        pool = HttpPool(shed_retries=1)
+        t0 = time.monotonic()
+        r = pool.request("GET", f"http://{host}:{port}/x", timeout=0.2)
+        assert r.status == 503
+        assert time.monotonic() - t0 < 1.0
+        pool.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_client_rotates_off_shedding_master_with_ha_peers():
+    """One overloaded master in an HA list: the client moves to an idle
+    peer instead of stacking Retry-After waits on the hot node (the
+    pool already paid one polite re-send). Single-master deployments
+    keep the in-place wait — pinned by the in-place branch staying on
+    masters[0]."""
+    from seaweedfs_tpu.client import Client
+
+    class FakeResp:
+        def __init__(self, status, headers=None, body=b"{}"):
+            self.status = status
+            self.headers = headers or {}
+            self._body = body
+
+        def json(self):
+            return json.loads(self._body)
+
+    class FakePool:
+        def __init__(self):
+            self.urls = []
+
+        def request(self, method, url, **kw):
+            self.urls.append(url)
+            if "m1:1" in url:
+                return FakeResp(503, {"x-seaweed-shed": "1",
+                                      "retry-after": "3"})
+            return FakeResp(200, body=b'{"ok": true}')
+
+    c = Client("m1:1,m2:2")
+    c._pool = FakePool()
+    t0 = time.monotonic()
+    assert c._master_get("/dir/status") == {"ok": True}
+    # rotated after ONE shed answer, with no Retry-After sleep stacked
+    assert [u for u in c._pool.urls] == ["http://m1:1/dir/status",
+                                         "http://m2:2/dir/status"]
+    assert time.monotonic() - t0 < 1.0
+    assert c.master == "m2:2"
+
+
+def test_filer_master_get_honors_shed_retry_after():
+    """The filer's async _master_get mirrors client.py: a shed master
+    (503 + X-Seaweed-Shed) is overloaded, not dead — single-master
+    waits out Retry-After in place and succeeds on the retry instead
+    of raising; with HA peers it rotates immediately (no stacked
+    sleep)."""
+    from seaweedfs_tpu.server.filer_server import FilerServer
+
+    class FakeResp:
+        def __init__(self, status, headers=None, body=b"{}"):
+            self.status = status
+            self.headers = headers or {}
+            self._body = body
+
+        async def json(self):
+            return json.loads(self._body)
+
+        async def __aenter__(self):
+            return self
+
+        async def __aexit__(self, *exc):
+            return False
+
+    class FakeSession:
+        def __init__(self, shed_hosts):
+            self.urls = []
+            self._shed = shed_hosts
+
+        def get(self, url, params=None):
+            self.urls.append(url)
+            if any(h in url for h in self._shed):
+                return FakeResp(503, {"X-Seaweed-Shed": "1",
+                                      "Retry-After": "0.2"})
+            return FakeResp(200, body=b'{"ok": true}')
+
+    def bare(masters, shed_hosts):
+        f = FilerServer.__new__(FilerServer)
+        f.masters = masters
+        f._master_i = 0
+        f._session = FakeSession(shed_hosts)
+        return f
+
+    async def single_master():
+        # sheds on the first answer, then admits: the in-place
+        # Retry-After wait must ride it out rather than raise
+        f = bare(["m1:1"], ["m1:1"])
+        orig_get = f._session.get
+
+        def get(url, params=None):
+            if len(f._session.urls) >= 1:
+                f._session._shed = ()
+            return orig_get(url, params)
+        f._session.get = get
+        t0 = time.monotonic()
+        out = await f._master_get("/dir/assign", {})
+        assert out == {"ok": True}
+        assert time.monotonic() - t0 >= 0.2  # honored Retry-After
+        assert len(f._session.urls) == 2
+
+    async def ha_rotates():
+        f = bare(["m1:1", "m2:2"], ["m1:1"])
+        t0 = time.monotonic()
+        out = await f._master_get("/dir/assign", {})
+        assert out == {"ok": True}
+        assert time.monotonic() - t0 < 0.15  # no Retry-After stacked
+        assert [u.split("/")[2] for u in f._session.urls] == \
+            ["m1:1", "m2:2"]
+        assert f.master_url == "m2:2"
+
+    asyncio.run(single_master())
+    asyncio.run(ha_rotates())
+
+
+def test_admission_wait_records_span():
+    from seaweedfs_tpu import observe
+
+    async def main():
+        observe.reset()
+        c = _controller(fg_concurrency=1, fg_queue=4, queue_timeout=5.0)
+        t = await c.admit(overload.CLASS_FG)
+        waiter = asyncio.ensure_future(c.admit(overload.CLASS_FG))
+        await asyncio.sleep(0.02)
+        t.release()
+        (await waiter).release()
+        names = [s["name"] for s in observe.spans()]
+        assert "admission.wait" in names
+    asyncio.run(main())

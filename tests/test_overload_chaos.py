@@ -1,0 +1,228 @@
+"""Chaos e2e for the overload plane, driven through the PR 4 fault
+plane: a delay fault on volume reads piles requests up in the filer's
+foreground queue; while that pressure lasts, background-tagged traffic
+(the priority class the repair daemon and scrubber stamp) is shed with
+503 + Retry-After + X-Seaweed-Shed while EVERY foreground read keeps
+flowing; shed responses never open a circuit breaker; and once the
+fault clears and the queue drains, shedding stops within one sampler
+window."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cluster_util import Cluster
+from seaweedfs_tpu import faults, overload
+from seaweedfs_tpu.cache.http_pool import HttpPool
+from seaweedfs_tpu.utils import retry as retry_mod
+
+# one sampler window is the overload plane's hysteresis clock (ms)
+WINDOW_MS = 200.0
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(n_volume_servers=2, default_replication="000")
+    yield c
+    faults.clear()
+    c.shutdown()
+
+
+@pytest.fixture()
+def overloaded_filer(cluster, monkeypatch):
+    """A filer whose admission plane has a deliberately tiny foreground
+    pipe (2 in flight) and a deep queue, so a volume-side delay turns
+    concurrent reads into visible foreground pressure."""
+    monkeypatch.setenv("WEED_ADMISSION_FG_CONCURRENCY", "2")
+    monkeypatch.setenv("WEED_ADMISSION_FG_QUEUE", "64")
+    monkeypatch.setenv("WEED_ADMISSION_QUEUE_TIMEOUT_MS", "20000")
+    monkeypatch.setenv("WEED_ADMISSION_LAG_SAMPLE_MS", str(WINDOW_MS))
+    monkeypatch.setenv("WEED_ADMISSION_RETRY_AFTER_S", "1")
+    fs = cluster.add_filer(chunk_size=16 * 1024)
+    yield fs
+    faults.clear()
+
+
+def _put(filer_url: str, path: str, data: bytes) -> None:
+    req = urllib.request.Request(f"http://{filer_url}{path}", data=data,
+                                 method="PUT")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.status in (200, 201)
+
+
+def _get(filer_url: str, path: str, headers=None):
+    """(status, body, headers) without raising on 4xx/5xx."""
+    req = urllib.request.Request(f"http://{filer_url}{path}",
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def _metric(filer_url: str, needle: str) -> float:
+    with urllib.request.urlopen(f"http://{filer_url}/metrics",
+                                timeout=10) as r:
+        text = r.read().decode()
+    for line in text.splitlines():
+        if line.startswith(needle.split("{")[0]) and needle in line:
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+def _healthz(filer_url: str) -> dict:
+    with urllib.request.urlopen(f"http://{filer_url}/healthz",
+                                timeout=10) as r:
+        return json.load(r)
+
+
+def test_overload_sheds_background_first_and_recovers(cluster,
+                                                      overloaded_filer):
+    filer_url = overloaded_filer.url
+    # the breaker counter lives in the process-wide shared registry and
+    # other suites open breakers on purpose: assert no NEW opens here
+    breaker_opened_before = _metric(
+        filer_url, 'seaweedfs_tpu_cluster_breaker_opened_total')
+    n_files = 14
+    payloads = {}
+    for i in range(n_files):
+        data = (f"file-{i}-".encode() * 100)[:1200]
+        payloads[f"/overload/f{i}"] = data
+        _put(filer_url, f"/overload/f{i}", data)
+
+    # volume reads answer slowly from here on: the filer's 2-slot
+    # foreground pipe backs up and the queue becomes real pressure
+    faults.set_fault("volume.read", "delay", ms=400)
+
+    fg_results: list = []
+    fg_lock = threading.Lock()
+
+    def fg_reader(path: str, data: bytes) -> None:
+        status, body, _ = _get(filer_url, path)
+        with fg_lock:
+            fg_results.append((path, status, body == data))
+
+    threads = [threading.Thread(target=fg_reader, args=(p, d))
+               for p, d in payloads.items()]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)  # fg queue is now occupied (2 serving, rest waiting)
+
+    # background-tagged reads — the class the repair daemon / scrubber
+    # stamp — must be refused while foreground waits, marked shed, and
+    # must NOT charge the circuit breaker (threshold 1 would open on a
+    # single recorded failure)
+    breaker = retry_mod.CircuitBreaker(failure_threshold=1)
+    pool = HttpPool(breaker=breaker, shed_retries=0)
+    host = filer_url
+    bg_shed = 0
+    for i in range(4):
+        r = pool.request(
+            "GET", f"http://{filer_url}/overload/f{i}",
+            headers={overload.PRIORITY_HEADER: "bg"}, timeout=10)
+        if r.status == 503:
+            assert r.headers.get("x-seaweed-shed") == "1"
+            assert "retry-after" in r.headers
+            bg_shed += 1
+        time.sleep(0.05)
+    assert bg_shed == 4, "bg reads admitted while fg queued"
+    assert not breaker.is_open(host), \
+        "shed responses must not open the circuit breaker"
+    assert _healthz(filer_url)["admission"]["shedding"] is True
+
+    # the fault clears; the queued foreground reads drain fast
+    faults.clear()
+    for t in threads:
+        t.join(timeout=60)
+    assert all(status == 200 and ok
+               for _, status, ok in fg_results), fg_results
+    assert len(fg_results) == n_files  # every fg read kept flowing
+
+    # shedding stops within one sampler window of the pressure ending
+    drained = time.monotonic()
+    deadline = drained + (WINDOW_MS / 1000.0) + 0.8
+    while time.monotonic() < deadline:
+        if not _healthz(filer_url)["admission"]["shedding"]:
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError("shedding state did not clear within a "
+                             "sampler window of the fault clearing")
+
+    # background flows again
+    status, body, _ = _get(filer_url, "/overload/f0",
+                           headers={overload.PRIORITY_HEADER: "bg"})
+    assert status == 200 and body == payloads["/overload/f0"]
+
+    # /metrics agrees: bg was shed, fg never was, breaker never opened
+    assert _metric(filer_url,
+                   'seaweedfs_tpu_filer_admission_shed_total'
+                   '{cls="bg"}') >= 4
+    assert _metric(filer_url,
+                   'seaweedfs_tpu_filer_admission_shed_total'
+                   '{cls="fg"}') == 0
+    assert _metric(filer_url,
+                   'seaweedfs_tpu_cluster_breaker_opened_total') \
+        == breaker_opened_before
+    assert _metric(filer_url,
+                   'seaweedfs_tpu_filer_admission_admitted_total'
+                   '{cls="fg"}') >= n_files
+    pool.close()
+
+
+def test_shed_tagged_repair_traffic_end_to_end(cluster, overloaded_filer):
+    """The ambient-priority propagation path: a caller that binds
+    CLASS_BG (as the repair daemon and scrubber do) gets the header
+    injected by the pooled client automatically and sheds under
+    foreground pressure without any explicit header."""
+    filer_url = overloaded_filer.url
+    _put(filer_url, "/overload/amb", b"ambient" * 100)
+
+    # 800ms delay + singleflight: the 6 readers coalesce onto one slow
+    # volume fetch, holding the 2-slot fg pipe (and its queue) busy for
+    # a comfortably long pressure window
+    faults.set_fault("volume.read", "delay", ms=800)
+    blockers = [threading.Thread(
+        target=_get, args=(filer_url, "/overload/amb"))
+        for _ in range(6)]
+    for t in blockers:
+        t.start()
+    time.sleep(0.25)
+    pool = HttpPool(shed_retries=0)
+    try:
+        with overload.priority(overload.CLASS_BG):
+            r = pool.request("GET",
+                             f"http://{filer_url}/overload/amb",
+                             timeout=10)
+        assert r.status == 503
+        assert r.headers.get("x-seaweed-shed") == "1"
+    finally:
+        faults.clear()
+        for t in blockers:
+            t.join(timeout=30)
+        pool.close()
+
+
+def test_reserved_ops_paths_reject_writes(cluster, overloaded_filer):
+    """The filer's admission-exempt ops routes are reserved for ALL
+    methods: a PUT to /healthz must answer 405 at the reserved route,
+    not fall through aiohttp's method-mismatch resolution into the
+    path catch-all as a system-classified (never metered) file write."""
+    filer_url = overloaded_filer.url
+    for path in ("/healthz", "/metrics", "/debug/trace", "/ui",
+                 "/__meta__/subscribe"):
+        req = urllib.request.Request(f"http://{filer_url}{path}",
+                                     data=b"not-a-file", method="PUT")
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                raise AssertionError(f"PUT {path} accepted: {r.status}")
+        except urllib.error.HTTPError as e:
+            assert e.code == 405, (path, e.code)
+    # and no file was created behind the shadowing GET route
+    status, _, _ = _get(filer_url, "/healthz?metadata=true")
+    assert status == 200  # the ops handler, not an entry listing
