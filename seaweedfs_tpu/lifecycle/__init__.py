@@ -1,0 +1,58 @@
+"""Lifecycle plane: heat-driven hot->warm tiering, TTL expiry, S3 rules.
+
+The EC tier (sealed volumes Reed-Solomon-encoded RS(10,4) into 14
+rack-spread shards) was fast, self-healing, and load-safe — but nothing
+ever *decided* to use it: volumes only went warm when an operator typed
+`ec.encode`.  This package is that decision-maker, in three layers:
+
+1. **Heat tracking** (heat.py): volume servers sample their existing
+   read/write paths (fastpath listener included) into per-volume access
+   stats — counts, last access, decayed-EWMA read rate — and report
+   only the CHANGED entries in each heartbeat; the master topology keeps
+   the cluster heat view, exported via /metrics, `GET /vol/heat`, and
+   the `volume.heat` shell command.
+
+2. **Policy + daemon** (policy.py, daemon.py): a leader-only daemon on
+   the master — sibling of the repair daemon, sharing its concurrency
+   semaphore, backoff bookkeeping, and the overload plane's CLASS_BG
+   priority so lifecycle work is shed first under load — evaluates
+   declarative rules every WEED_LIFECYCLE_INTERVAL: full+idle volumes
+   seal, vacuum, and EC-encode through the governed feed; hot EC
+   volumes optionally decode back; TTL'd volumes/collections expire
+   whole volumes at once.  Every transition emits `lifecycle.*` spans
+   and `lifecycle_transitions{kind,outcome}` metrics, and is resumable:
+   a crash mid-encode leaves either the original volume or the full
+   shard set, never neither, and the daemon converges on retry.
+
+3. **S3 surface** (s3_rules.py + s3/s3_server.py):
+   Put/Get/DeleteBucketLifecycleConfiguration with Expiration and
+   Transition(StorageClass=WARM) rules stored on the filer and enforced
+   by the same daemon.
+
+Every background loop here binds overload.CLASS_BG and sleeps on
+``jittered(interval)`` — tests/test_async_guard.py fails the build on
+any lifecycle loop that is unshedable or fires in fleet lockstep.
+"""
+
+from __future__ import annotations
+
+import random
+
+from .heat import HALFLIFE, HeatTracker, VolumeHeat, decayed_rate
+from .policy import (LifecycleConfig, Transition, parse_duration,
+                     plan_transitions)
+
+
+def jittered(seconds: float, spread: float = 0.2) -> float:
+    """An interval with +/-(spread/2) relative jitter: a fleet of masters
+    (or a master and its volume servers) must not fire lifecycle scans in
+    lockstep against the same volume servers."""
+    lo = 1.0 - spread / 2.0
+    return max(seconds, 0.01) * (lo + spread * random.random())
+
+
+__all__ = [
+    "HALFLIFE", "HeatTracker", "VolumeHeat", "decayed_rate",
+    "LifecycleConfig", "Transition", "parse_duration",
+    "plan_transitions", "jittered",
+]

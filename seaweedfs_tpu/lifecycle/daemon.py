@@ -1,0 +1,541 @@
+"""Leader-only lifecycle daemon: executes what policy.py plans.
+
+Runs on the master as a sibling of the PR 4 repair daemon and shares its
+discipline end to end:
+
+* leader-only — a follower's stale topology must never seal or delete a
+  volume, and two masters must never both drive one transition;
+* the SAME concurrency semaphore as the repair planner
+  (master._repair_sem), so lifecycle encodes and deficit rebuilds
+  compete for one bounded budget instead of stampeding volume servers;
+* the SAME per-key exponential-backoff bookkeeping
+  (master._repair_backoff), so a transition that keeps failing retries
+  at 2^n * interval, capped;
+* overload CLASS_BG priority bound for the daemon loop and re-stamped in
+  every transition task, so every admin call it fans out carries
+  X-Seaweed-Priority: bg and is shed FIRST under load (PR 6).
+
+Transitions are crash-safe by ordering, not by journal: the original
+volume is deleted only after every one of the 14 shards is verified
+mounted on its target (a /status read-back, not a trusted response), so
+a crash at ANY point leaves either the original volume or a complete
+shard set — never neither — and the next pass converges (shards already
+live -> just retire the original; shards incomplete -> re-encode).
+Named fault points (`lifecycle.warm`, `lifecycle.encode`,
+`lifecycle.unec`, `lifecycle.expire`) let the chaos suite kill a
+transition at the worst moment and prove exactly that.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from collections import deque
+from dataclasses import asdict
+from typing import Optional
+
+import aiohttp
+
+from .. import faults, observe, overload
+from ..storage.file_id import FileId
+from . import s3_rules
+from .policy import LifecycleConfig, Transition, plan_transitions
+from . import jittered
+
+log = logging.getLogger("lifecycle")
+
+
+class LifecycleDaemon:
+    def __init__(self, master, cfg: Optional[LifecycleConfig] = None):
+        self.master = master
+        self.cfg = cfg or LifecycleConfig.from_env()
+        # key -> monotonic start time of the in-flight transition
+        self._inflight: dict[tuple, float] = {}
+        self._tasks: set = set()
+        self.recent: deque = deque(maxlen=64)
+        self.last_pass = 0.0
+        self.passes = 0
+        # vid -> reason, fed by S3 Transition rules: these volumes go
+        # warm on the next pass regardless of idleness
+        self.warm_requested: dict[int, str] = {}
+
+    # --- loop ---
+
+    async def run_loop(self) -> None:
+        # lifecycle work is background by definition: every admin call
+        # the daemon (and its transition tasks) fans out carries
+        # X-Seaweed-Priority: bg and sheds before user traffic
+        overload.set_priority(overload.CLASS_BG)
+        while True:
+            await asyncio.sleep(jittered(self.cfg.interval))
+            try:
+                await self.pass_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.warning("lifecycle pass failed: %s", e)
+
+    def stop(self) -> None:
+        for task in list(self._tasks):
+            task.cancel()
+
+    # --- one evaluation pass ---
+
+    async def pass_once(self) -> dict:
+        master = self.master
+        if not master.raft.is_leader or not await master.raft.ensure_ready():
+            return {"skipped": "not leader"}
+        now = time.time()
+        self.last_pass = now
+        self.passes += 1
+        s3 = {}
+        if self.cfg.filer:
+            try:
+                s3 = await self._s3_pass(now)
+            except Exception as e:
+                log.warning("lifecycle s3 pass failed: %s", e)
+                s3 = {"error": str(e)}
+        heat = master.topology.heat_view(now)
+        plan = plan_transitions(master.topology, heat, self.cfg, now,
+                                self.warm_requested)
+        launched = []
+        for tr in plan:
+            if not self._due(tr.key):
+                continue
+            self._launch(tr)
+            launched.append({"kind": tr.kind, "volume": tr.vid,
+                             "reason": tr.reason})
+        self.export_gauges(heat)
+        return {"planned": len(plan), "launched": launched, "s3": s3}
+
+    def _due(self, key: tuple) -> bool:
+        if key in self._inflight:
+            return False
+        back = self.master._repair_backoff.get(key)
+        if back is not None and time.monotonic() < back[1]:
+            return False
+        return True
+
+    def _launch(self, tr: Transition) -> None:
+        self._inflight[tr.key] = time.monotonic()
+        task = asyncio.create_task(self._run_transition(tr))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def _run_transition(self, tr: Transition) -> None:
+        # explicit stamp: transitions can also be launched from the
+        # /lifecycle/run admin path, outside the bg-tagged loop context
+        overload.set_priority(overload.CLASS_BG)
+        key = tr.key
+        fn = {"warm": self._warm, "unec": self._unec,
+              "expire": self._expire}[tr.kind]
+        try:
+            async with self.master._repair_sem:
+                with observe.span(f"lifecycle.{tr.kind}",
+                                  tags={"vid": tr.vid,
+                                        "reason": tr.reason}):
+                    await fn(tr)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            failures = self.master._repair_backoff.get(key, (0, 0.0))[0] + 1
+            delay = min(self.cfg.interval * (2 ** failures), 300.0)
+            self.master._repair_backoff[key] = (failures,
+                                                time.monotonic() + delay)
+            self._record(tr.kind, tr.vid, "failed", error=str(e))
+            log.warning("lifecycle %s of volume %d failed (attempt %d, "
+                        "next in %.1fs): %s", tr.kind, tr.vid, failures,
+                        delay, e)
+        else:
+            self.master._repair_backoff.pop(key, None)
+            if tr.kind == "warm":
+                self.warm_requested.pop(tr.vid, None)
+            self._record(tr.kind, tr.vid, "ok", reason=tr.reason)
+            log.info("lifecycle %s of volume %d done (%s)",
+                     tr.kind, tr.vid, tr.reason)
+        finally:
+            self._inflight.pop(key, None)
+
+    def _record(self, kind: str, vid, outcome: str, reason: str = "",
+                error: str = "") -> None:
+        self.master.metrics.count("lifecycle_transitions",
+                                  labels={"kind": kind,
+                                          "outcome": outcome})
+        entry = {"kind": kind, "volume": vid, "outcome": outcome,
+                 "at": time.time()}
+        if reason:
+            entry["reason"] = reason
+        if error:
+            entry["error"] = error
+        self.recent.appendleft(entry)
+
+    # --- plumbing ---
+
+    def _check_leader(self) -> None:
+        if not self.master.raft.is_leader:
+            raise RuntimeError("lost leadership mid-transition")
+
+    async def _get_json(self, url: str, path: str,
+                        timeout: float = 30.0) -> dict:
+        async with self.master._maint_http().get(
+                f"http://{url}{path}",
+                timeout=aiohttp.ClientTimeout(total=timeout)) as r:
+            out = await r.json()
+            if r.status != 200:
+                raise RuntimeError(f"{url}{path}: "
+                                   f"{out.get('error', r.status)}")
+            return out
+
+    # --- hot -> warm: seal, vacuum, ec.encode through the governed feed ---
+
+    async def _warm(self, tr: Transition) -> None:
+        master = self.master
+        vid, collection = tr.vid, tr.collection
+        if await faults.fire_async("lifecycle.warm"):
+            raise RuntimeError("injected drop at lifecycle.warm")
+        total = master.ec_total_shards
+        # resumable finish: a prior attempt (or crash) already produced
+        # the full shard set — only the original is left to retire.
+        # The topology view can be STALE (an un-EC that just deleted
+        # every shard file still lists them until heartbeats land), so
+        # nothing is destroyed on its word alone: re-verify by reading
+        # each holder's /status back, and back off if they disagree.
+        shards = master.topology.lookup_ec_shards(vid)
+        if len(shards) >= total:
+            shard_holders = {n.url for nodes in shards.values()
+                             for n in nodes}
+            mounted = await self._mounted_shards(vid, shard_holders)
+            if len(mounted) < total:
+                raise RuntimeError(
+                    f"volume {vid}: topology lists a full shard set but "
+                    f"only {sorted(mounted)} verified mounted — stale "
+                    f"view, retrying after the next heartbeats")
+            await self._finish_warm(vid, tr.holders)
+            return
+        holders = tr.holders
+        if not holders:
+            raise RuntimeError(f"volume {vid} has no holders")
+        # 1. seal every replica (the volume stops taking writes NOW;
+        #    heartbeats move it out of the writable set)
+        for url in holders:
+            self._check_leader()
+            await master._admin_post(url, "volume/readonly",
+                                     {"volume_id": vid,
+                                      "read_only": True})
+        source = holders[0]
+        # 2. vacuum when compaction would actually shrink the .dat —
+        #    encoding tombstoned bytes into 14 shards wastes the tier
+        try:
+            garbage = (await self._get_json(
+                source, f"/admin/vacuum/check?volume_id={vid}")
+            )["garbage_level"]
+        except Exception:
+            garbage = 0.0
+        if garbage > 0.01:
+            await master._admin_post(source, "vacuum",
+                                     {"volume_id": vid}, timeout=600.0)
+        # 3. encode on the source through the governed EC feed
+        #    (store.ec_generate -> ec/pipeline.stream_encode)
+        self._check_leader()
+        await master._admin_post(source, "ec/generate",
+                                 {"volume_id": vid}, timeout=900.0)
+        # 4. spread with the same balanced plan the ec.encode shell uses
+        from ..shell.ec_commands import collect_ec_nodes, plan_shard_spread
+        nodes = collect_ec_nodes(master.topology.to_dict())
+        plan = plan_shard_spread(nodes, total, source)
+        for target, sids in plan.items():
+            self._check_leader()
+            if target != source:
+                await master._admin_post(
+                    target, "ec/copy",
+                    {"volume_id": vid, "collection": collection,
+                     "shard_ids": sids, "source": source,
+                     "copy_ecx_file": True}, timeout=600.0)
+            await master._admin_post(
+                target, "ec/mount",
+                {"volume_id": vid, "collection": collection,
+                 "shard_ids": sids})
+        # verify 14/14 by reading each target's /status back — mount
+        # responses alone don't distinguish "already mounted" from
+        # "shard file missing"; nothing is destroyed on trust
+        mounted = await self._mounted_shards(vid, plan)
+        if len(mounted) < total:
+            raise RuntimeError(
+                f"volume {vid}: only shards {sorted(mounted)} mounted "
+                f"({len(mounted)}/{total}); keeping the original")
+        # 5. retire the original everywhere + surplus shard files at
+        #    the source (generate left all 14 there; it mounted only
+        #    its assigned ones)
+        await self._finish_warm(vid, holders)
+        surplus = [s for s in range(total) if s not in plan.get(source, [])]
+        if surplus:
+            await master._admin_post(
+                source, "ec/delete_shards",
+                {"volume_id": vid, "collection": collection,
+                 "shard_ids": surplus})
+
+    async def _mounted_shards(self, vid: int, targets) -> set:
+        """Shard ids ACTUALLY mounted for `vid`, by reading each
+        target's /status back — the only evidence the daemon trusts
+        before destroying anything."""
+        mounted: set[int] = set()
+        for target in targets:
+            st = await self._get_json(target, "/status")
+            for s in st.get("ec_shards", []):
+                if s.get("id") == vid:
+                    mounted.update(s.get("shard_ids", []))
+        return mounted
+
+    async def _finish_warm(self, vid: int, holders: list) -> None:
+        """The last step of a warm transition — shared by the fresh path
+        and the crash-resume path so BOTH cross the same chaos hook: the
+        worst crash point is 'full shard set live, original not yet
+        retired'; both copies exist there and a retry converges."""
+        if await faults.fire_async("lifecycle.encode"):
+            raise RuntimeError("injected drop at lifecycle.encode")
+        for url in holders:
+            self._check_leader()
+            await self.master._admin_post(url, "volume/delete",
+                                          {"volume_id": vid})
+
+    # --- warm -> hot: un-EC a reconstruct-hot volume (ec.decode flow) ---
+
+    async def _unec(self, tr: Transition) -> None:
+        master = self.master
+        vid, collection = tr.vid, tr.collection
+        if await faults.fire_async("lifecycle.unec"):
+            raise RuntimeError("injected drop at lifecycle.unec")
+        shards = master.topology.lookup_ec_shards(vid)
+        if not shards:
+            raise RuntimeError(f"no shards for volume {vid}")
+        total = master.ec_total_shards
+        holder_count: dict[str, int] = {}
+        for nodes in shards.values():
+            for n in nodes:
+                holder_count[n.url] = holder_count.get(n.url, 0) + 1
+        target = max(holder_count, key=holder_count.get)
+        need = [sid for sid, nodes in sorted(shards.items())
+                if target not in {n.url for n in nodes}]
+        for sid in need:
+            self._check_leader()
+            await master._admin_post(
+                target, "ec/copy",
+                {"volume_id": vid, "collection": collection,
+                 "shard_ids": [sid], "source": shards[sid][0].url},
+                timeout=600.0)
+        self._check_leader()
+        await master._admin_post(target, "ec/to_volume",
+                                 {"volume_id": vid,
+                                  "collection": collection},
+                                 timeout=900.0)
+        # the decoded volume is live on the target: drop shard files
+        # everywhere (the target's copies were consumed by the decode)
+        urls = {n.url for nodes in shards.values() for n in nodes}
+        urls.add(target)
+        for url in sorted(urls):
+            await master._admin_post(
+                url, "ec/delete_shards",
+                {"volume_id": vid, "collection": collection,
+                 "shard_ids": list(range(total))})
+
+    # --- TTL expiry: whole volumes at once, every holder ---
+
+    async def _expire(self, tr: Transition) -> None:
+        master = self.master
+        if await faults.fire_async("lifecycle.expire"):
+            raise RuntimeError("injected drop at lifecycle.expire")
+        for url in tr.holders:
+            self._check_leader()
+            await master._admin_post(url, "volume/delete",
+                                     {"volume_id": tr.vid})
+        # an expired collection that was EC-encoded loses its shards too
+        shards = master.topology.lookup_ec_shards(tr.vid)
+        urls = {n.url for nodes in shards.values() for n in nodes}
+        for url in sorted(urls):
+            await master._admin_post(
+                url, "ec/delete_shards",
+                {"volume_id": tr.vid, "collection": tr.collection,
+                 "shard_ids": list(range(master.ec_total_shards))})
+
+    # --- S3 bucket rules: Expiration + Transition(WARM), via the filer ---
+
+    async def _filer_get(self, path: str, params: dict) -> tuple[int, dict]:
+        async with self.master._maint_http().get(
+                f"http://{self.cfg.filer}{path}", params=params,
+                timeout=aiohttp.ClientTimeout(total=60)) as r:
+            return r.status, await r.json()
+
+    async def _filer_post(self, path: str, body: dict) -> tuple[int, dict]:
+        async with self.master._maint_http().post(
+                f"http://{self.cfg.filer}{path}", json=body,
+                timeout=aiohttp.ClientTimeout(total=60)) as r:
+            return r.status, await r.json()
+
+    async def _s3_pass(self, now: float) -> dict:
+        stats = {"expired": 0, "transitioned": 0, "scanned": 0}
+        # paginate the bucket listing itself — a rule on bucket #1001
+        # must be enforced exactly like one on bucket #1
+        start = ""
+        while True:
+            status, body = await self._filer_get(
+                "/__meta__/list", {"dir": "/buckets", "start": start,
+                                   "limit": "512"})
+            if status != 200:
+                return stats
+            entries = body.get("entries", [])
+            for bucket_entry in entries:
+                name = bucket_entry["path"].rsplit("/", 1)[-1]
+                if name.startswith("."):
+                    continue
+                raw = (bucket_entry.get("extended") or {}).get(
+                    s3_rules.BUCKET_ATTR)
+                if not raw:
+                    continue
+                rules = [r for r in s3_rules.rules_from_json(raw)
+                         if r.get("status") == "Enabled"]
+                if not rules:
+                    continue
+                with observe.span("lifecycle.s3", tags={"bucket": name}):
+                    await self._apply_bucket_rules(
+                        name, bucket_entry["path"], rules, now, stats)
+            if len(entries) < 512:
+                return stats
+            start = entries[-1]["path"].rsplit("/", 1)[-1]
+
+    async def _apply_bucket_rules(self, bucket: str, base: str,
+                                  rules: list, now: float,
+                                  stats: dict) -> None:
+
+        async def walk(dir_path: str, key_prefix: str) -> None:
+            start = ""
+            while True:
+                if stats["scanned"] >= self.cfg.scan_limit:
+                    # bounded pass: what's left ages into the next one
+                    log.info("lifecycle s3 scan limit %d hit in %s",
+                             self.cfg.scan_limit, bucket)
+                    return
+                status, body = await self._filer_get(
+                    "/__meta__/list", {"dir": dir_path, "start": start,
+                                       "limit": "512"})
+                entries = body.get("entries", []) if status == 200 else []
+                for e in entries:
+                    name = e["path"].rsplit("/", 1)[-1]
+                    if bool(e.get("attr", {}).get("mode", 0) & 0o40000):
+                        await walk(e["path"], key_prefix + name + "/")
+                        continue
+                    stats["scanned"] += 1
+                    await self._apply_object_rules(
+                        bucket, key_prefix + name, e, rules, now, stats)
+                if len(entries) < 512:
+                    return
+                start = entries[-1]["path"].rsplit("/", 1)[-1]
+
+        await walk(base, "")
+
+    async def _apply_object_rules(self, bucket: str, key: str, entry: dict,
+                                  rules: list, now: float,
+                                  stats: dict) -> None:
+        mtime = float(entry.get("attr", {}).get("mtime", 0) or 0)
+        age = now - mtime if mtime else 0.0
+        for rule in rules:
+            prefix = rule.get("prefix") or ""
+            if prefix and not key.startswith(prefix):
+                continue
+            exp = rule.get("expire_days")
+            if exp is not None and age >= exp * self.cfg.day_seconds:
+                await self._filer_post("/__meta__/delete",
+                                       {"path": entry["path"]})
+                self._record("s3_expire", f"{bucket}/{key}", "ok")
+                stats["expired"] += 1
+                return  # the entry is gone; no further rules apply
+            tdays = rule.get("transition_days")
+            ext = entry.get("extended") or {}
+            if (tdays is not None
+                    and age >= tdays * self.cfg.day_seconds
+                    and ext.get(s3_rules.STORAGE_CLASS_ATTR)
+                    != s3_rules.WARM_CLASS):
+                ext[s3_rules.STORAGE_CLASS_ATTR] = s3_rules.WARM_CLASS
+                entry["extended"] = ext
+                await self._filer_post("/__meta__/update_entry",
+                                       {"entry": entry})
+                # nudge the volumes holding this object's chunks into
+                # the hot->warm transition on the next pass (the warm
+                # tier is volume-grained: the whole volume moves)
+                for c in entry.get("chunks", []):
+                    try:
+                        vid = FileId.parse(c["fid"]).volume_id
+                    except (KeyError, ValueError):
+                        continue
+                    self.warm_requested.setdefault(
+                        vid, f"s3 transition {bucket}/{prefix or '*'}")
+                self._record("s3_transition", f"{bucket}/{key}", "ok")
+                stats["transitioned"] += 1
+
+    # --- observability ---
+
+    def export_gauges(self, heat_view: Optional[dict] = None) -> None:
+        m = self.master.metrics
+        m.gauge("lifecycle_inflight", len(self._inflight))
+        m.gauge("lifecycle_warm_requested", len(self.warm_requested))
+        if heat_view is None:
+            heat_view = self.master.topology.heat_view()
+        top = sorted(heat_view.items(),
+                     key=lambda kv: kv[1].get("read_rate", 0.0),
+                     reverse=True)[:self.cfg.heat_export_top]
+        for vid, h in top:
+            m.gauge("volume_heat_read_rate", h.get("read_rate", 0.0),
+                    labels={"volume": str(vid)})
+            m.gauge("volume_heat_reads", h.get("reads", 0),
+                    labels={"volume": str(vid)})
+
+    def status(self) -> dict:
+        now = time.monotonic()
+        return {
+            "enabled": self.cfg.enabled,
+            "is_leader": self.master.raft.is_leader,
+            "last_pass": self.last_pass,
+            "passes": self.passes,
+            "pending": [{"kind": k, "volume": v,
+                         "for_s": round(now - t0, 1)}
+                        for (k, v), t0 in sorted(self._inflight.items(),
+                                                 key=lambda kv: str(kv[0]))],
+            "recent": list(self.recent),
+            "warm_requested": {str(v): r
+                               for v, r in self.warm_requested.items()},
+            "config": {k: v for k, v in asdict(self.cfg).items()
+                       if k != "force_enabled"},
+        }
+
+    def heat_status(self) -> dict:
+        master = self.master
+        now = time.time()
+        heat = master.topology.heat_view(now)
+        vols: dict[int, dict] = {}
+        for node in master.topology.nodes.values():
+            for vid, vi in node.volumes.items():
+                rec = vols.setdefault(vid, {
+                    "volume": vid, "collection": vi.collection,
+                    "state": "hot", "ttl": vi.ttl, "size": vi.size,
+                    "read_only": vi.read_only, "holders": []})
+                rec["holders"].append(node.url)
+            for vid, si in node.ec_shards.items():
+                rec = vols.setdefault(vid, {
+                    "volume": vid, "collection": si.collection,
+                    "state": "warm", "ttl": "", "size": 0,
+                    "read_only": True, "holders": []})
+                if rec["state"] == "hot":
+                    rec["state"] = "transitioning"
+                if node.url not in rec["holders"]:
+                    rec["holders"].append(node.url)
+        for vid, rec in vols.items():
+            h = heat.get(vid, {})
+            rec.update({
+                "reads": h.get("reads", 0),
+                "writes": h.get("writes", 0),
+                "read_rate": h.get("read_rate", 0.0),
+                "idle_s": round(now - max(h.get("last_access", 0.0),
+                                          h.get("first_seen", now)), 1),
+            })
+        return {"now": now,
+                "volumes": [vols[v] for v in sorted(vols)]}

@@ -430,6 +430,17 @@ class S3Server:
         denied = self._check_auth(request, action, bucket)
         if denied is not None:
             return denied
+        if "lifecycle" in request.query:
+            # Put/Get/DeleteBucketLifecycleConfiguration: the rules
+            # live on the bucket entry; the master's lifecycle daemon
+            # enforces them (Expiration + Transition StorageClass=WARM)
+            if request.method == "PUT":
+                return await self.put_bucket_lifecycle(request, bucket)
+            if request.method == "GET":
+                return await self.get_bucket_lifecycle(bucket)
+            if request.method == "DELETE":
+                return await self.delete_bucket_lifecycle(bucket)
+            return _error("MethodNotAllowed", request.method, 405)
         if request.method == "PUT":
             return await self.put_bucket(bucket)
         if request.method == "DELETE":
@@ -547,6 +558,59 @@ class S3Server:
         status, _ = await self._meta_get(
             "lookup", {"path": f"{BUCKETS_DIR}/{bucket}"})
         return web.Response(status=200 if status == 200 else 404)
+
+    # --- bucket lifecycle configuration (s3api_bucket_handlers.go's
+    #     lifecycle trio; rules parsed/serialized in
+    #     seaweedfs_tpu/lifecycle/s3_rules.py, enforced by the master's
+    #     lifecycle daemon through the filer) ---
+
+    async def put_bucket_lifecycle(self, request: web.Request,
+                                   bucket: str) -> web.Response:
+        from ..lifecycle import s3_rules
+        self.metrics.count("put_bucket_lifecycle")
+        body = await request.read()
+        try:
+            rules = s3_rules.parse_lifecycle_xml(body)
+        except s3_rules.LifecycleXmlError as e:
+            return _error("MalformedXML", str(e), 400)
+        status, entry = await self._meta_get(
+            "lookup", {"path": f"{BUCKETS_DIR}/{bucket}"})
+        if status != 200:
+            return _error("NoSuchBucket", bucket, 404)
+        extended = entry.get("extended") or {}
+        extended[s3_rules.BUCKET_ATTR] = s3_rules.rules_to_json(rules)
+        entry["extended"] = extended
+        status, out = await self._meta("update_entry", {"entry": entry})
+        if status != 200:
+            return _error("InternalError", str(out.get("error")), 500)
+        return web.Response(status=200)
+
+    async def get_bucket_lifecycle(self, bucket: str) -> web.Response:
+        from ..lifecycle import s3_rules
+        status, entry = await self._meta_get(
+            "lookup", {"path": f"{BUCKETS_DIR}/{bucket}"})
+        if status != 200:
+            return _error("NoSuchBucket", bucket, 404)
+        raw = (entry.get("extended") or {}).get(s3_rules.BUCKET_ATTR, "")
+        rules = s3_rules.rules_from_json(raw)
+        if not rules:
+            return _error("NoSuchLifecycleConfiguration",
+                          "no lifecycle configuration", 404)
+        return web.Response(body=s3_rules.rules_to_xml(rules),
+                            content_type="application/xml")
+
+    async def delete_bucket_lifecycle(self, bucket: str) -> web.Response:
+        from ..lifecycle import s3_rules
+        status, entry = await self._meta_get(
+            "lookup", {"path": f"{BUCKETS_DIR}/{bucket}"})
+        if status != 200:
+            return _error("NoSuchBucket", bucket, 404)
+        extended = entry.get("extended") or {}
+        if s3_rules.BUCKET_ATTR in extended:
+            extended.pop(s3_rules.BUCKET_ATTR, None)
+            entry["extended"] = extended
+            await self._meta("update_entry", {"entry": entry})
+        return web.Response(status=204)
 
     # --- objects ---
     async def put_object(self, request: web.Request, bucket: str,
@@ -707,7 +771,11 @@ class S3Server:
                 entry["attr"].get("mtime", 0))
             ET.SubElement(c, "ETag").text = f'"{_entry_etag(entry)}"'
             ET.SubElement(c, "Size").text = str(_entry_size(entry))
-            ET.SubElement(c, "StorageClass").text = "STANDARD"
+            # transitioned objects surface their warm placement (the
+            # lifecycle daemon stamps x-amz-storage-class on Transition)
+            ET.SubElement(c, "StorageClass").text = (
+                (entry.get("extended") or {}).get(
+                    "x-amz-storage-class") or "STANDARD")
         for p in sorted(common_prefixes):
             cp = ET.SubElement(root, "CommonPrefixes")
             ET.SubElement(cp, "Prefix").text = enc(p)

@@ -435,6 +435,9 @@ class FastVolumeProtocol(asyncio.Protocol):
             self._send(500, json.dumps({"error": str(e)}).encode())
             return
         server.metrics.count("read")
+        # lifecycle heat: the inline fast shape must feed the same
+        # tracker as the aiohttp handler or hot volumes look cold
+        server.heat.record_read(fid.volume_id)
         etag = f'"{n.etag()}"'
         if headers.get(b"if-none-match", b"").decode("latin-1") == etag:
             self._send(304, b"")
@@ -540,6 +543,7 @@ class FastVolumeProtocol(asyncio.Protocol):
             except Exception as e:
                 self._send(409, json.dumps({"error": str(e)}).encode())
                 return
+        server.heat.record_write(fid.volume_id)
         self._send(201, json.dumps({
             "name": (n.name or b"").decode("utf-8", "replace"),
             "size": len(n.data), "eTag": n.etag(),
@@ -565,6 +569,7 @@ class FastVolumeProtocol(asyncio.Protocol):
             self._send(404, json.dumps({"error": "volume not found"}
                                        ).encode())
             return
+        server.heat.record_write(fid.volume_id)
         self._send(200, json.dumps({"size": size}).encode())
 
     def _mark_internal(self, raw: bytes, tunnel: bool = False) -> list:

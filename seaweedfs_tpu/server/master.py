@@ -28,6 +28,8 @@ from aiohttp import web
 
 from .. import faults, observe, overload
 from ..cluster.raft import RaftNode, _endpoint_ips
+from ..lifecycle.daemon import LifecycleDaemon
+from ..lifecycle.policy import LifecycleConfig
 from ..security.guard import Guard
 from ..storage.file_id import FileId, new_cookie
 from ..storage.superblock import ReplicaPlacement
@@ -70,7 +72,8 @@ class MasterServer:
                  sequencer=None,
                  maintenance_interval_seconds: Optional[float] = None,
                  repair_concurrency: int = 2,
-                 ec_total_shards: int = 14):
+                 ec_total_shards: int = 14,
+                 lifecycle_config: Optional[LifecycleConfig] = None):
         self.topology = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds)
@@ -152,6 +155,16 @@ class MasterServer:
         self.admission = overload.AdmissionController(
             "master", metrics=self.metrics,
             system_paths=overload.MASTER_SYSTEM_PATHS)
+        # lifecycle plane: a leader-only policy daemon (sibling of the
+        # repair daemon — shares _repair_sem, _repair_backoff, and the
+        # bg priority class) that turns access heat into hot->warm EC
+        # transitions, TTL expiry, and S3 lifecycle enforcement. The
+        # loop only runs when some rule is configured (lifecycle/
+        # policy.py LifecycleConfig.enabled), so rule-less clusters
+        # behave exactly as before.
+        self.lifecycle = LifecycleDaemon(
+            self, lifecycle_config or LifecycleConfig.from_env())
+        self._lifecycle_task: Optional[asyncio.Task] = None
         self.app = self._build_app()
 
     def _raft_apply(self, cmd: dict) -> None:
@@ -249,6 +262,10 @@ class MasterServer:
         app.router.add_post("/cluster/raft/vote", self.raft_vote)
         app.router.add_post("/cluster/raft/append", self.raft_append)
         app.router.add_post("/ec/scrub_report", self.ec_scrub_report)
+        app.router.add_get("/vol/heat", self.vol_heat)
+        app.router.add_post("/vol/heat/report", self.vol_heat_report)
+        app.router.add_get("/lifecycle/status", self.lifecycle_status)
+        app.router.add_post("/lifecycle/run", self.lifecycle_run)
         _faults_handler = faults.admin_handler()
         app.router.add_get("/admin/faults", _faults_handler)
         app.router.add_post("/admin/faults", _faults_handler)
@@ -270,6 +287,9 @@ class MasterServer:
             self._vacuum_task = asyncio.create_task(self._vacuum_loop())
         if self.maintenance_interval_seconds > 0:
             self._maint_task = asyncio.create_task(self._maintenance_loop())
+        if self.lifecycle.cfg.enabled:
+            self._lifecycle_task = asyncio.create_task(
+                self.lifecycle.run_loop())
         if self.grpc_port:
             from .master_grpc import serve_master_grpc
             host = (self.url.rsplit(":", 1)[0] if ":" in self.url
@@ -287,6 +307,9 @@ class MasterServer:
             self._vacuum_task.cancel()
         if self._maint_task:
             self._maint_task.cancel()
+        if self._lifecycle_task:
+            self._lifecycle_task.cancel()
+        self.lifecycle.stop()
         for task in list(self._repair_tasks):
             task.cancel()
         if self._grpc_server is not None:
@@ -1079,6 +1102,46 @@ class MasterServer:
                         url, sorted(bad), vid)
         return web.json_response({"ok": True})
 
+    # --- lifecycle plane (heat view + daemon state) ---
+
+    async def vol_heat(self, request: web.Request) -> web.Response:
+        """Cluster heat view: per-volume access stats + lifecycle state
+        (the `volume.heat` shell command's backend)."""
+        out = self.lifecycle.heat_status()
+        vid = request.query.get("volumeId", "")
+        if vid:
+            try:
+                want = int(vid)
+            except ValueError:
+                return web.json_response({"error": "invalid volumeId"},
+                                         status=400)
+            out["volumes"] = [v for v in out["volumes"]
+                              if v["volume"] == want]
+        return web.json_response(out)
+
+    async def vol_heat_report(self, request: web.Request) -> web.Response:
+        """Heat deltas from a volume server whose heartbeats ride the
+        gRPC stream — the pb schema has no heat field, so those nodes
+        side-channel the deltas here instead of losing them."""
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": "bad json"}, status=400)
+        ok = self.topology.merge_heat(body.get("node_id", ""),
+                                      body.get("heat") or [])
+        return web.json_response({"ok": ok})
+
+    async def lifecycle_status(self, request: web.Request) -> web.Response:
+        """Daemon state: pending/recent transitions with outcomes (the
+        `lifecycle.status` shell command's backend)."""
+        return web.json_response(self.lifecycle.status())
+
+    async def lifecycle_run(self, request: web.Request) -> web.Response:
+        """Trigger one evaluation pass now (operators / tests) — the
+        same pass the timer loop runs."""
+        out = await self.lifecycle.pass_once()
+        return web.json_response({"ok": True, **out})
+
     async def ec_lookup(self, request: web.Request) -> web.Response:
         """LookupEcVolume (weed/server/master_grpc_server_volume.go:148)."""
         try:
@@ -1256,6 +1319,9 @@ class MasterServer:
         })
 
     async def metrics_handler(self, request: web.Request) -> web.Response:
+        # refresh the cluster-heat gauges at scrape time so the heat
+        # view is exported even when the lifecycle daemon is disabled
+        self.lifecycle.export_gauges()
         return web.Response(text=(self.metrics.render()
                           + metrics_mod.render_shared()),
                             content_type="text/plain")

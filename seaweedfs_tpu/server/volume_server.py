@@ -40,6 +40,7 @@ import aiohttp
 from aiohttp import web
 
 from .. import faults, observe, overload
+from ..lifecycle.heat import HeatTracker
 from ..storage.file_id import FileId
 from ..utils import compression, fast_multipart
 from ..storage.needle import (FLAG_IS_COMPRESSED,
@@ -223,6 +224,18 @@ class VolumeServer:
                 scrub_interval_seconds = 3600.0
         self.scrub_interval_seconds = scrub_interval_seconds
         self._scrub_task: Optional[asyncio.Task] = None
+        # per-volume access heat (lifecycle plane): O(1) sampling on the
+        # read/write paths — both this app's handlers and the fastpath
+        # listener's inline shapes — drained as deltas into heartbeats.
+        # WEED_LIFECYCLE_HEAT_HALFLIFE shrinks the EWMA window so tests
+        # (and aggressive un-EC policies) see rate changes quickly.
+        try:
+            halflife = float(
+                os.environ.get("WEED_LIFECYCLE_HEAT_HALFLIFE", "0") or 0)
+        except ValueError:
+            halflife = 0.0
+        self.heat = HeatTracker(halflife=halflife) if halflife > 0 \
+            else HeatTracker()
         # per-process secret marking requests proxied from the fastpath
         # listener (server/fastpath.py): they arrive from 127.0.0.1 but
         # were already whitelist-checked against the REAL peer IP
@@ -392,7 +405,7 @@ class VolumeServer:
         if low != was_low:
             log.warning("low disk space: %s", low)
 
-    def _hb_payload(self) -> dict:
+    def _hb_payload(self, include_heat: bool = True) -> dict:
         payload = self.store.heartbeat()
         payload.update({
             "node_id": self.url,
@@ -401,7 +414,38 @@ class VolumeServer:
             "data_center": self.data_center,
             "rack": self.rack,
         })
+        if include_heat:
+            # changed-volumes-only deltas: an idle node's heartbeat
+            # carries no heat entries at all (payload stays O(changed));
+            # draining also prunes tracker state for departed volumes
+            held = ({v["id"] for v in payload["volumes"]}
+                    | {s["id"] for s in payload["ec_shards"]})
+            deltas = self.heat.deltas(known_vids=held)
+            if deltas:
+                payload["heat"] = deltas
         return payload
+
+    async def _report_heat(self) -> None:
+        """Deliver heat deltas over HTTP for nodes whose heartbeats
+        ride the gRPC stream (no pb heat field). Failures requeue the
+        drained window and never break the stream — heat is advisory,
+        the heartbeat is not."""
+        deltas = self.heat.deltas()
+        if not deltas:
+            return
+        try:
+            async with self._session.post(
+                    f"http://{self.master_url}/vol/heat/report",
+                    json={"node_id": self.url, "heat": deltas},
+                    timeout=aiohttp.ClientTimeout(total=5)) as r:
+                if r.status != 200:
+                    raise RuntimeError(f"status {r.status}")
+        except asyncio.CancelledError:
+            self.heat.requeue(deltas)
+            raise
+        except Exception as e:
+            self.heat.requeue(deltas)
+            log.debug("heat report to %s failed: %s", self.master_url, e)
 
     async def _grpc_heartbeat_stream(self) -> None:
         """Hold the bidi gRPC heartbeat stream
@@ -419,7 +463,12 @@ class VolumeServer:
         async def beats():
             while not stop.is_set():
                 await self._periodic_maintenance()
-                yield heartbeat_to_pb(self._hb_payload())
+                # the pb schema has no heat field: don't drain deltas
+                # into a beat that can't carry them — side-channel them
+                # to /vol/heat/report right after, so gRPC-heartbeat
+                # clusters still feed the lifecycle heat view
+                yield heartbeat_to_pb(self._hb_payload(include_heat=False))
+                await self._report_heat()
                 try:
                     await asyncio.wait_for(stop.wait(), self.pulse_seconds)
                 except asyncio.TimeoutError:
@@ -484,6 +533,18 @@ class VolumeServer:
     async def send_heartbeat(self) -> None:
         payload = self._hb_payload()
         self._update_volume_gauges(payload)
+        try:
+            await self._send_heartbeat(payload)
+        except BaseException:
+            # the heat deltas were drained into this payload; a failed
+            # delivery must not lose the window's access records (a
+            # lost last_access makes an active volume look idle to the
+            # warm rule one window early)
+            if payload.get("heat"):
+                self.heat.requeue(payload["heat"])
+            raise
+
+    async def _send_heartbeat(self, payload: dict) -> None:
         async with self._session.post(
                 f"http://{self.master_url}/heartbeat", json=payload,
                 timeout=aiohttp.ClientTimeout(total=10)) as r:
@@ -584,6 +645,9 @@ class VolumeServer:
                                              status=404)
             except NeedleDeleted:
                 return web.json_response({"error": "deleted"}, status=404)
+        # lifecycle heat: one dict update per served read (EC reads —
+        # the warm tier's un-EC signal — land here too)
+        self.heat.record_read(fid.volume_id)
         etag = f'"{n.etag()}"'
         if request.headers.get("If-None-Match") == etag:
             return web.Response(status=304)
@@ -862,6 +926,7 @@ class VolumeServer:
                 return web.json_response({"error": str(e)}, status=409)
             except ValueError as e:
                 return web.json_response({"error": str(e)}, status=409)
+        self.heat.record_write(fid.volume_id)
 
         if request.query.get("type") != "replicate":
             with observe.span("volume.replicate", tags={"fid": str(fid)}):
@@ -966,6 +1031,7 @@ class VolumeServer:
                 self.store.ec_blob_delete(fid.volume_id, fid.key)
             except KeyError:
                 return web.json_response({"error": "not found"}, status=404)
+            self.heat.record_write(fid.volume_id)
             if request.query.get("type") != "replicate":
                 await self._propagate_ec_delete(fid)
             return web.json_response({"size": 0})
@@ -976,6 +1042,7 @@ class VolumeServer:
         except KeyError:
             return web.json_response({"error": "volume not found"},
                                      status=404)
+        self.heat.record_write(fid.volume_id)
         if request.query.get("type") != "replicate":
             replicas = await self._replica_urls(fid.volume_id)
             for url in replicas:

@@ -54,6 +54,35 @@ def volume_list(env: CommandEnv, argv: list[str]):
     return env.client.dir_status()
 
 
+# --- volume.heat / lifecycle.status (the lifecycle plane's shell
+#     surface: seaweedfs_tpu/lifecycle/) ---
+
+@command("volume.heat",
+         "per-volume access heat + lifecycle state "
+         "(volume.heat [-volumeId N])")
+def volume_heat(env: CommandEnv, argv: list[str]):
+    p = parser("volume.heat")
+    p.add_argument("-volumeId", type=int, default=0)
+    args = p.parse_args(argv)
+    qs = f"?volumeId={args.volumeId}" if args.volumeId else ""
+    return env.client._master_get(f"/vol/heat{qs}")
+
+
+@command("lifecycle.status",
+         "lifecycle daemon state: rules, pending and recent transitions "
+         "with outcomes (lifecycle.status)")
+def lifecycle_status(env: CommandEnv, argv: list[str]):
+    return env.client._master_get("/lifecycle/status")
+
+
+@command("lifecycle.run",
+         "run one lifecycle evaluation pass now (lifecycle.run)",
+         destructive=True)
+def lifecycle_run(env: CommandEnv, argv: list[str]):
+    from .commands import _post_json
+    return _post_json(f"http://{env.client.master}/lifecycle/run", {})
+
+
 # --- volume.balance ---
 
 def plan_volume_balance(nodes: list[dict],

@@ -541,6 +541,9 @@ class Store:
                         v.super_block.replica_placement),
                     "ttl": str(v.super_block.ttl),
                     "version": v.version,
+                    # newest write (unix s): the master lifecycle
+                    # daemon's TTL expiry reference
+                    "last_modified": v.last_modified_ts,
                 })
             for vid, ev in loc.ec_volumes.items():
                 ec_shards.append({

@@ -15,6 +15,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..lifecycle.heat import VolumeHeat
 from ..storage.superblock import ReplicaPlacement
 
 
@@ -30,6 +31,8 @@ class VolumeInfo:
     replica_placement: str = "000"
     ttl: str = ""
     version: int = 3
+    # unix seconds of the newest write, for master-side TTL expiry
+    last_modified: int = 0
 
     @classmethod
     def from_dict(cls, d: dict) -> "VolumeInfo":
@@ -59,6 +62,10 @@ class DataNode:
         self.max_volume_count = max_volume_count
         self.volumes: dict[int, VolumeInfo] = {}
         self.ec_shards: dict[int, EcShardInfo] = {}
+        # per-volume access heat, merged from heartbeat deltas
+        # (lifecycle/heat.py); first_seen anchors idleness for volumes
+        # that have never been accessed since this master booted
+        self.heat: dict[int, VolumeHeat] = {}
         self.last_seen = time.time()
 
     def free_slots(self) -> int:
@@ -179,6 +186,15 @@ class Topology:
             self.max_volume_id = max(self.max_volume_id, si.id)
 
         after = set(node.volumes) | set(node.ec_shards)
+        # heat bookkeeping: every held volume has a record (first_seen
+        # anchors idleness); deltas arrive only for changed volumes, so
+        # the merge is O(changed); records of departed volumes go
+        for vid in after:
+            if vid not in node.heat:
+                node.heat[vid] = VolumeHeat()
+        for vid in [v for v in node.heat if v not in after]:
+            node.heat.pop(vid, None)
+        self.merge_heat(node.url, payload.get("heat", []))
         return {"url": node.url, "public_url": node.public_url,
                 "new_vids": sorted(after - before),
                 "deleted_vids": sorted(before - after)}
@@ -241,6 +257,47 @@ class Topology:
                 continue
             for sid in info.shard_ids:
                 out.setdefault(sid, []).append(node)
+        return out
+
+    # --- heat (lifecycle plane) ---
+    def merge_heat(self, url: str, entries: list) -> bool:
+        """Fold heat deltas into a node's records. Also the side
+        channel for gRPC-heartbeat nodes (the pb schema carries no
+        heat field, so they POST deltas to /vol/heat/report instead).
+        Unknown nodes/volumes are ignored — the next full heartbeat
+        establishes them."""
+        node = self.nodes.get(url)
+        if node is None:
+            return False
+        now = time.time()
+        for entry in entries:
+            vh = node.heat.get(entry.get("id"))
+            if vh is not None:
+                vh.merge(entry, now)
+        return True
+
+    def heat_view(self, now: Optional[float] = None) -> dict[int, dict]:
+        """Cluster-wide per-volume heat, aggregated across holders:
+        counts sum (each replica saw distinct requests), last_access is
+        the max, read_rate sums (load spreads over replicas), first_seen
+        is the earliest sighting."""
+        now = now if now is not None else time.time()
+        out: dict[int, dict] = {}
+        for node in self.nodes.values():
+            for vid, vh in node.heat.items():
+                d = vh.to_dict(now)
+                agg = out.get(vid)
+                if agg is None:
+                    out[vid] = d
+                else:
+                    agg["reads"] += d["reads"]
+                    agg["writes"] += d["writes"]
+                    agg["last_access"] = max(agg["last_access"],
+                                             d["last_access"])
+                    agg["read_rate"] = round(agg["read_rate"]
+                                             + d["read_rate"], 6)
+                    agg["first_seen"] = min(agg["first_seen"],
+                                            d["first_seen"])
         return out
 
     # --- write assignment ---

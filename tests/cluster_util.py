@@ -55,7 +55,8 @@ class Cluster:
                  max_volumes: int = 16,
                  pulse: float = 0.15,
                  n_masters: int = 1,
-                 master_grpc_port: int = 0):
+                 master_grpc_port: int = 0,
+                 master_kwargs: dict | None = None):
         self.geometry = geometry
         self.coder_name = coder_name
         self.default_replication = default_replication
@@ -64,6 +65,7 @@ class Cluster:
         self.n = n_volume_servers
         self.n_masters = n_masters
         self.master_grpc_port = master_grpc_port
+        self.master_kwargs = master_kwargs or {}
 
         self.loop = asyncio.new_event_loop()
         self.thread = threading.Thread(target=self._loop_main, daemon=True)
@@ -114,7 +116,8 @@ class Cluster:
                 peers=master_urls if self.n_masters > 1 else None,
                 election_timeout=(0.15, 0.3),
                 raft_heartbeat=0.05,
-                grpc_port=self.master_grpc_port if i == 0 else 0)
+                grpc_port=self.master_grpc_port if i == 0 else 0,
+                **self.master_kwargs)
             runner = self.serve(m.app, port)
             self.masters.append(m)
             self._master_runners.append(runner)

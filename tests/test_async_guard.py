@@ -275,6 +275,122 @@ def test_application_guard_walker_catches_violations():
     assert "admission_middleware" not in ast.dump(mw)
 
 
+# --- lifecycle daemon-loop guards (lifecycle plane) ---
+
+def _lifecycle_files():
+    d = os.path.join(PKG_ROOT, "lifecycle")
+    for name in sorted(os.listdir(d)):
+        if name.endswith(".py"):
+            yield os.path.join(d, name)
+
+
+def _is_bg_priority_call(node: ast.Call) -> bool:
+    """overload.set_priority(overload.CLASS_BG) / overload.priority(...)
+    (or the bare-name variants after a from-import)."""
+    f = node.func
+    name = f.attr if isinstance(f, ast.Attribute) else \
+        (f.id if isinstance(f, ast.Name) else "")
+    if name not in ("set_priority", "priority"):
+        return False
+    for arg in node.args:
+        if isinstance(arg, ast.Attribute) and arg.attr == "CLASS_BG":
+            return True
+        if isinstance(arg, ast.Name) and arg.id == "CLASS_BG":
+            return True
+    return False
+
+
+def _daemon_loop_violations(tree: ast.Module):
+    """(lineno, fn, problem) for every async daemon loop (an ``async
+    def`` containing ``while True``) that is unshedable (no CLASS_BG
+    binding) or lockstep (an asyncio.sleep whose argument is not a
+    jittered(...) interval)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.AsyncFunctionDef):
+            continue
+        calls = [n for n in ast.walk(node) if isinstance(n, ast.Call)]
+        has_sleep = any(isinstance(c.func, ast.Attribute)
+                        and c.func.attr == "sleep"
+                        and isinstance(c.func.value, ast.Name)
+                        and c.func.value.id == "asyncio" for c in calls)
+        has_forever = any(isinstance(n, ast.While) and
+                          isinstance(n.test, ast.Constant) and
+                          n.test.value is True
+                          for n in ast.walk(node))
+        # a daemon loop is a *_loop-named coroutine, or a while-True
+        # that paces itself with asyncio.sleep; bounded pagination
+        # loops (no sleep) are request-scoped work, not daemons
+        if not (node.name.endswith("_loop")
+                or (has_forever and has_sleep)):
+            continue
+        if not any(_is_bg_priority_call(c) for c in calls):
+            yield (node.lineno, node.name,
+                   "daemon loop without overload CLASS_BG binding — "
+                   "its fan-out can never be shed")
+        for c in calls:
+            f = c.func
+            is_sleep = (isinstance(f, ast.Attribute) and f.attr == "sleep"
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id == "asyncio")
+            if not is_sleep:
+                continue
+            arg = c.args[0] if c.args else None
+            ok = (isinstance(arg, ast.Call) and
+                  ((isinstance(arg.func, ast.Name)
+                    and arg.func.id == "jittered") or
+                   (isinstance(arg.func, ast.Attribute)
+                    and arg.func.attr == "jittered")))
+            if not ok:
+                yield (c.lineno, node.name,
+                       "asyncio.sleep without jittered(interval) — a "
+                       "fleet of masters would scan in lockstep")
+
+
+def test_lifecycle_daemon_loops_are_shedable_and_jittered():
+    """Satellite guard: every daemon loop under lifecycle/ must bind
+    overload.priority(CLASS_BG) and sleep on an explicit jittered
+    interval — no unshedable or lockstep background loops, permanently."""
+    violations = []
+    found_any_loop = False
+    for path in _lifecycle_files():
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        rel = os.path.relpath(path, PKG_ROOT)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.AsyncFunctionDef) and any(
+                    isinstance(n, ast.While) for n in ast.walk(node)):
+                found_any_loop = True
+        for lineno, fn, problem in _daemon_loop_violations(tree):
+            violations.append(f"{rel}:{lineno} async def {fn}: {problem}")
+    assert found_any_loop, \
+        "lifecycle/ lost its daemon loop — the guard guards nothing"
+    assert not violations, "\n".join(violations)
+
+
+def test_lifecycle_loop_guard_walker_catches_violations():
+    """The loop walker must flag a bg-less loop and a constant-interval
+    sleep, and accept the compliant daemon shape."""
+    bad = ast.parse(
+        "async def loop():\n"
+        "    while True:\n"
+        "        await asyncio.sleep(60)\n")
+    hits = list(_daemon_loop_violations(bad))
+    assert len(hits) == 2, hits  # unshedable AND lockstep
+    good = ast.parse(
+        "async def loop(self):\n"
+        "    overload.set_priority(overload.CLASS_BG)\n"
+        "    while True:\n"
+        "        await asyncio.sleep(jittered(self.cfg.interval))\n")
+    assert list(_daemon_loop_violations(good)) == []
+    # bare-name variants after from-imports count too
+    good2 = ast.parse(
+        "async def loop(self):\n"
+        "    with priority(CLASS_BG):\n"
+        "        while True:\n"
+        "            await asyncio.sleep(lifecycle.jittered(3.0))\n")
+    assert list(_daemon_loop_violations(good2)) == []
+
+
 def test_guard_walker_catches_violations():
     """The walker itself must detect the patterns it guards against —
     direct calls, aliased modules and from-imports — and must NOT flag
