@@ -1610,6 +1610,34 @@ def phase_lifecycle(work: str, budget_s: float = 240.0,
     return out
 
 
+def phase_lint(work: str = "", budget_s: float = 60.0) -> dict:
+    """weedlint smoke: the full-tree static-analysis gate must stay
+    cheap enough to live inside the tier-1 pytest run. Runs the exact
+    CI invocation (scripts/lint.sh's command line) in a subprocess and
+    records wall time; acceptance is clean exit AND < 10s."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    cmd = [sys.executable, "-m", "seaweedfs_tpu.analysis",
+           "--baseline", ".weedlint-baseline.json",
+           "seaweedfs_tpu/", "tests/"]
+    t0 = time.perf_counter()
+    p = subprocess.run(cmd, cwd=repo, capture_output=True, text=True,
+                       timeout=budget_s)
+    wall = time.perf_counter() - t0
+    tail = (p.stdout.strip().splitlines() or [""])[-1]
+    out = {
+        "lint_wall_s": round(wall, 2),
+        "clean": p.returncode == 0,
+        "files": int(tail.split(" files")[0].rsplit(" ", 1)[-1])
+        if " files" in tail else None,
+        "summary": tail[:200],
+        "accept": {"clean_exit": p.returncode == 0,
+                   "under_10s": wall < 10.0},
+    }
+    if p.returncode != 0:
+        out["error"] = (p.stdout + p.stderr)[-1500:]
+    return out
+
+
 # ------------------------------------------------------------ orchestration
 
 def _run_phase(name: str, work: str, timeout_s: float) -> dict:
@@ -1801,6 +1829,15 @@ def main() -> None:
         _checkpoint(detail)
 
         try:
+            lint = phase_lint(work)
+            _log(f"lint: {lint.get('lint_wall_s')}s over "
+                 f"{lint.get('files')} files, clean={lint.get('clean')}")
+        except Exception as e:
+            lint = {"error": str(e)}
+        detail["lint"] = lint
+        _checkpoint(detail)
+
+        try:
             needle_map = bench_needle_map(work)
         except Exception as e:
             needle_map = {"error": str(e)}
@@ -1872,6 +1909,7 @@ def main() -> None:
                     lifecycle.get("time_to_warm_all_s"),
                 "lifecycle_hot_p50_ratio":
                     lifecycle.get("hot_p50_ratio"),
+                "lint_wall_s": lint.get("lint_wall_s"),
                 "detail_file": "BENCH_DETAIL.json",
             },
         }))
@@ -1893,6 +1931,7 @@ if __name__ == "__main__":
               "largefile": phase_largefile,
               "overload": lambda w: phase_overload(w, budget_s=budget),
               "lifecycle": lambda w: phase_lifecycle(w, budget_s=budget),
+              "lint": lambda w: phase_lint(w, budget_s=budget),
               }[name]
         print(json.dumps(fn(work)))
     else:

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# weedlint CI gate: fails on any new finding or stale baseline entry.
+#
+#   scripts/lint.sh              # the CI mode (no fixes, no rewrite)
+#   scripts/lint.sh --rules http-timeout,task-leak   # subset
+#
+# To grandfather an existing finding (new rule landing on old code):
+#   python -m seaweedfs_tpu.analysis --baseline .weedlint-baseline.json \
+#       --write-baseline seaweedfs_tpu/ tests/
+# To suppress one deliberate site, comment the line:
+#   ... # weedlint: disable=<rule>
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m seaweedfs_tpu.analysis \
+    --baseline .weedlint-baseline.json "$@" seaweedfs_tpu/ tests/

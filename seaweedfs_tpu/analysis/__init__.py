@@ -1,0 +1,25 @@
+"""weedlint: the storage plane's static-analysis engine.
+
+``python -m seaweedfs_tpu.analysis --baseline .weedlint-baseline.json
+seaweedfs_tpu/ tests/`` is the CI gate; tests/test_weedlint.py iterates
+the registry so every rule is tier-1-enforced and self-tested against
+its seeded-violation fixture. See README "Static analysis" for the rule
+catalog, suppression syntax and baseline workflow.
+"""
+
+from .engine import (  # noqa: F401
+    Baseline, Diagnostic, Module, Report, Rule, load_module, register,
+    registry, run,
+)
+
+
+def check_source(rule: Rule, source: str, relpath: str = "") -> list:
+    """Run one rule against an in-memory source string (fixture tests,
+    editor integrations). Suppression comments apply; baseline does
+    not."""
+    mod = load_module(path=relpath or rule.fixture_relpath,
+                      relpath=relpath or rule.fixture_relpath,
+                      source=source)
+    diags = list(rule.check_module(mod))
+    diags.extend(rule.check_project([mod]))
+    return [d for d in diags if not mod.suppressed(d)]

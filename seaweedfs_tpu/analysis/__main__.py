@@ -1,0 +1,132 @@
+"""weedlint CLI.
+
+    python -m seaweedfs_tpu.analysis [options] PATH [PATH...]
+
+Exit codes: 0 clean (no unsuppressed, un-baselined findings and no
+stale baseline entries), 1 findings, 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+from . import Baseline, registry, run
+
+
+def _repo_root() -> str:
+    """The directory containing the seaweedfs_tpu package: relpaths
+    (and therefore baseline fingerprints) anchor here so invocation cwd
+    doesn't matter."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m seaweedfs_tpu.analysis",
+        description="weedlint: static analysis for the async storage "
+                    "plane")
+    ap.add_argument("paths", nargs="*", metavar="PATH",
+                    help="files or directories to analyze")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="JSON baseline of grandfathered findings; new "
+                         "findings and stale entries both fail")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite --baseline with the current finding "
+                         "set (exits 0)")
+    ap.add_argument("--rules", metavar="R1,R2",
+                    help="comma-separated subset of rules to run")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--show-baselined", action="store_true",
+                    help="also print findings matched by the baseline")
+    ap.add_argument("--root", default="",
+                    help="repo root for relative paths (default: the "
+                         "package parent)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        rules = registry()
+        width = max(len(n) for n in rules)
+        for name in sorted(rules):
+            print(f"{name:<{width}}  {rules[name].rationale}")
+        return 0
+
+    if not args.paths:
+        ap.error("no paths given (try: seaweedfs_tpu/ tests/)")
+    if args.write_baseline and not args.baseline:
+        ap.error("--write-baseline requires --baseline")
+
+    root = os.path.abspath(args.root) if args.root else _repo_root()
+    rule_names = ([r.strip() for r in args.rules.split(",") if r.strip()]
+                  if args.rules else None)
+    baseline = Baseline.load(args.baseline) if args.baseline else None
+
+    t0 = time.perf_counter()
+    try:
+        report = run(root, args.paths, rule_names=rule_names,
+                     baseline=baseline)
+    except ValueError as e:
+        print(f"weedlint: {e}", file=sys.stderr)
+        return 2
+    wall = time.perf_counter() - t0
+
+    if report.files_checked == 0:
+        # a typo'd path (or wrong cwd) must not read as a passing gate
+        print(f"weedlint: no .py files found under "
+              f"{' '.join(args.paths)} — nothing was linted",
+              file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        findings = report.new + report.baselined
+        broken = [d for d in findings if d.rule == "parse-error"]
+        if broken:
+            # a syntax-broken file can never be grandfathered
+            for d in sorted(broken, key=lambda d: d.path):
+                print(d.render(), file=sys.stderr)
+            print("weedlint: refusing to write a baseline over "
+                  f"{len(broken)} parse error(s)", file=sys.stderr)
+            return 1
+        merged = Baseline.from_findings(findings)
+        # a partial rewrite (--rules subset, one directory) must only
+        # replace entries it actually re-judged: everything outside
+        # this run's rule/path scope is preserved verbatim, or a
+        # routine subset run would silently erase the rest of the
+        # grandfather list and fail the next full CI pass
+        preserved = 0
+        if baseline is not None:
+            for fp, entry in baseline.entries.items():
+                if fp in merged.entries:
+                    continue
+                if entry.get("rule") not in report.rules_run or \
+                        not report.covers(entry.get("path", "")):
+                    merged.entries[fp] = entry
+                    preserved += 1
+        merged.write(args.baseline)
+        print(f"weedlint: wrote {len(merged.entries)} entries to "
+              f"{args.baseline}"
+              + (f" ({preserved} out-of-scope preserved)"
+                 if preserved else ""))
+        return 0
+
+    out = report.render(show_baselined=args.show_baselined)
+    if out:
+        print(out)
+    status = "clean" if report.clean else (
+        f"{len(report.new)} finding(s)"
+        + (f", {len(report.stale_baseline)} stale baseline entr"
+           f"{'y' if len(report.stale_baseline) == 1 else 'ies'}"
+           if report.stale_baseline else ""))
+    print(f"weedlint: {report.files_checked} files, "
+          f"{len(report.suppressed)} suppressed, "
+          f"{len(report.baselined)} baselined, {status} "
+          f"({wall:.2f}s)")
+    return 0 if report.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

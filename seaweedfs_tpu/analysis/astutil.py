@@ -1,0 +1,97 @@
+"""Shared AST plumbing for weedlint rules: dotted-name resolution,
+import-alias maps, and body walks that respect nested-def boundaries
+(the run_in_executor pattern makes "lexically inside this coroutine,
+excluding nested defs" the scope almost every async rule wants)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Tuple
+
+FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+NESTED_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def attr_path(node) -> Tuple[str, ...]:
+    """Name/Attribute chain -> ('urllib', 'request', 'urlopen');
+    () when the expression isn't a plain dotted name."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return ()
+
+
+def dotted(node) -> str:
+    return ".".join(attr_path(node))
+
+
+def import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """alias -> canonical dotted prefix, covering ``import a.b as c``
+    and ``from a import b [as c]`` (absolute imports only)."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module and \
+                node.level == 0:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def resolve_call_path(node: ast.Call,
+                      aliases: Dict[str, str]) -> Tuple[str, ...]:
+    """The callee's canonical dotted path after alias expansion, e.g.
+    ``ur.urlopen`` -> ('urllib', 'request', 'urlopen') under
+    ``import urllib.request as ur``."""
+    path = attr_path(node.func)
+    if not path:
+        return ()
+    head = aliases.get(path[0])
+    if head is not None:
+        path = tuple(head.split(".")) + path[1:]
+    return path
+
+
+def walk_body(node, *, into_nested_defs: bool = False) -> Iterator[ast.AST]:
+    """Walk every node lexically inside ``node``'s body. By default does
+    NOT descend into nested function definitions or lambdas: a sync def
+    nested in a coroutine is an executor body, off-loop by design."""
+    stack = list(getattr(node, "body", []))
+    for extra in ("orelse", "finalbody", "handlers"):
+        stack.extend(getattr(node, extra, []))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not into_nested_defs and isinstance(n, NESTED_SCOPES):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def enclosing_class_map(tree: ast.Module) -> Dict[ast.AST, str]:
+    """function/With node -> name of the nearest enclosing ClassDef
+    ('' at module level). Cheap parent walk, computed once per module."""
+    out: Dict[ast.AST, str] = {}
+
+    def visit(node, cls: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                visit(child, child.name)
+            else:
+                out[child] = cls
+                visit(child, cls)
+
+    visit(tree, "")
+    return out
+
+
+def const_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None

@@ -1,0 +1,463 @@
+"""weedlint engine: pluggable AST static analysis for the async storage
+plane.
+
+Every invariant the serving/EC/lifecycle planes fought for — no blocking
+calls on the event loop, every outbound hop bounded and trace-carrying,
+every daemon shedable and cancellable — is invisible to pytest but
+trivial for a tree walk. This engine gives those walks one home: a rule
+registry, file/line-precise diagnostics, inline suppressions, and a
+checked-in baseline for grandfathered findings, so a new invariant is a
+~50-line Rule subclass instead of a new one-off test file.
+
+Vocabulary:
+
+  * Rule        — one named invariant; checks a module tree (and/or the
+                  whole project for cross-file invariants) and yields
+                  Diagnostics. Ships its own seeded-violation fixture so
+                  the registry is self-testing.
+  * Diagnostic  — (rule, path, line, message) with a content-addressed
+                  fingerprint that survives unrelated line drift.
+  * suppression — ``# weedlint: disable=<rule>[,<rule>...]`` on the
+                  flagged line (or alone on the line above); ``*``
+                  disables all rules; ``disable-file=`` scopes to the
+                  whole file.
+  * baseline    — JSON map of grandfathered fingerprints. New findings
+                  fail; a baseline entry that no longer matches anything
+                  fails too (stale entries must not linger).
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence
+
+__all__ = [
+    "Diagnostic", "Module", "Rule", "Report", "Baseline",
+    "register", "registry", "load_module", "run",
+]
+
+
+# ------------------------------------------------------------ diagnostics
+
+@dataclass(frozen=True)
+class Diagnostic:
+    rule: str
+    path: str          # repo-root-relative, posix separators
+    line: int
+    message: str
+    line_text: str = ""   # stripped source of the flagged line
+    occurrence: int = 0   # index among identical (rule,path,line_text)
+
+    @property
+    def fingerprint(self) -> str:
+        """Content-addressed id: stable when unrelated edits shift line
+        numbers, invalidated when the flagged line itself changes (a
+        changed line is a new finding — re-judge it, don't grandfather
+        it silently)."""
+        h = hashlib.sha1()
+        h.update(f"{self.rule}|{self.path}|{self.line_text}|"
+                 f"{self.occurrence}".encode())
+        return h.hexdigest()[:16]
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+# ------------------------------------------------------------ modules
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*weedlint:\s*disable(?P<file>-file)?\s*=\s*"
+    r"(?P<rules>[\w\-*]+(?:\s*,\s*[\w\-*]+)*)")
+
+
+@dataclass
+class Module:
+    path: str          # absolute
+    relpath: str       # repo-root-relative, posix
+    source: str
+    tree: ast.Module
+    lines: List[str] = field(default_factory=list)
+    # lineno -> set of rule names suppressed there ("*" = all)
+    line_suppressions: Dict[int, set] = field(default_factory=dict)
+    file_suppressions: set = field(default_factory=set)
+    _walk_cache: Optional[List[ast.AST]] = field(default=None,
+                                                 repr=False)
+    _alias_cache: Optional[Dict[str, str]] = field(default=None,
+                                                   repr=False)
+
+    def walk(self) -> List[ast.AST]:
+        """Every AST node, computed once — fifteen rules re-walking a
+        231-file tree is the difference between a 2s and a 7s gate."""
+        if self._walk_cache is None:
+            self._walk_cache = list(ast.walk(self.tree))
+        return self._walk_cache
+
+    def aliases(self) -> Dict[str, str]:
+        if self._alias_cache is None:
+            from .astutil import import_aliases
+            self._alias_cache = import_aliases(self.tree)
+        return self._alias_cache
+
+    def suppressed(self, diag: Diagnostic) -> bool:
+        for names in (self.file_suppressions,
+                      self.line_suppressions.get(diag.line, ())):
+            if "*" in names or diag.rule in names:
+                return True
+        return False
+
+    def line_at(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+def _statement_spans(tree: ast.Module) -> List[tuple]:
+    """Line ranges a suppression comment may expand over: full spans of
+    SIMPLE statements, but only the header of compound ones (a comment
+    trailing a multi-line ``with``/``if`` header reaches the header's
+    first line without silencing the whole body)."""
+    spans = []
+    for node in ast.walk(tree):
+        # excepthandlers aren't stmts but carry diagnostics (cancelled-
+        # swallow anchors at the except line) — their headers count too
+        if not isinstance(node, (ast.stmt, ast.excepthandler)) or \
+                not getattr(node, "end_lineno", None):
+            continue
+        body = getattr(node, "body", None)
+        if body and isinstance(body, list) and body \
+                and hasattr(body[0], "lineno"):
+            spans.append((node.lineno,
+                          max(node.lineno, body[0].lineno - 1)))
+        else:
+            spans.append((node.lineno, node.end_lineno))
+    return spans
+
+
+def _innermost_span(lineno: int, spans: List[tuple]) -> tuple:
+    best = None
+    for a, b in spans:
+        if a <= lineno <= b and (best is None
+                                 or (b - a) < (best[1] - best[0])):
+            best = (a, b)
+    return best or (lineno, lineno)
+
+
+def _parse_suppressions(mod: Module) -> None:
+    spans = None
+    for i, raw in enumerate(mod.lines, start=1):
+        m = _SUPPRESS_RE.search(raw)
+        if not m:
+            continue
+        names = {r.strip() for r in m.group("rules").split(",") if r.strip()}
+        if m.group("file"):
+            mod.file_suppressions |= names
+            continue
+        if spans is None:
+            spans = _statement_spans(mod.tree)
+
+        def mark(lineno: int) -> None:
+            # suppress the WHOLE logical statement containing the
+            # comment: a trailing comment on the last line of a
+            # multi-line call must reach the diagnostic anchored at
+            # the call's first line
+            a, b = _innermost_span(lineno, spans)
+            for ln in range(a, b + 1):
+                mod.line_suppressions.setdefault(ln, set()).update(names)
+
+        mark(i)
+        if raw.lstrip().startswith("#"):
+            # standalone comment line: also covers the next statement
+            mark(i + 1)
+
+
+def load_module(path: str, relpath: str,
+                source: Optional[str] = None) -> Module:
+    if source is None:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    tree = ast.parse(source, filename=path)
+    mod = Module(path=path, relpath=relpath.replace(os.sep, "/"),
+                 source=source, tree=tree, lines=source.splitlines())
+    _parse_suppressions(mod)
+    return mod
+
+
+# ------------------------------------------------------------ rules
+
+_REGISTRY: Dict[str, "Rule"] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and enroll a Rule. Import order is
+    registration order; names must be unique."""
+    inst = cls()
+    if inst.name in _REGISTRY:
+        raise ValueError(f"duplicate weedlint rule name: {inst.name}")
+    _REGISTRY[inst.name] = inst
+    return cls
+
+
+def registry() -> Dict[str, "Rule"]:
+    """name -> Rule for every registered rule (rules self-register on
+    import of seaweedfs_tpu.analysis.rules)."""
+    from . import rules  # noqa: F401  (import side effect: registration)
+    return dict(_REGISTRY)
+
+
+class Rule:
+    """One named invariant.
+
+    Subclasses set ``name``/``rationale``/``fixture`` and override
+    ``check_module`` (per-file walks) and/or ``check_project``
+    (cross-file invariants, called once with every in-scope module).
+
+    ``scope`` entries are repo-root-relative posix prefixes; an entry
+    ending in "/" matches the subtree, otherwise the exact file.
+    ``fixture`` is a seeded-violation source string the rule MUST flag
+    and ``clean_fixture`` (optional) one it must NOT — the registry
+    self-test in tests/test_weedlint.py iterates these, so a rule
+    without a firing fixture cannot ship.
+    """
+
+    name: str = ""
+    rationale: str = ""
+    scope: Sequence[str] = ("seaweedfs_tpu/",)
+    fixture: str = ""
+    clean_fixture: str = ""
+    # relpath the fixture pretends to live at (some scopes are per-dir)
+    fixture_relpath: str = "seaweedfs_tpu/server/_fixture.py"
+
+    def applies_to(self, relpath: str) -> bool:
+        for entry in self.scope:
+            if entry.endswith("/"):
+                if relpath.startswith(entry):
+                    return True
+            elif relpath == entry:
+                return True
+        return False
+
+    def check_module(self, mod: Module) -> Iterator[Diagnostic]:
+        return iter(())
+
+    def check_project(self, mods: List[Module]) -> Iterator[Diagnostic]:
+        return iter(())
+
+    # -- helpers for subclasses ------------------------------------
+
+    def diag(self, mod: Module, line: int, message: str) -> Diagnostic:
+        return Diagnostic(rule=self.name, path=mod.relpath, line=line,
+                          message=message, line_text=mod.line_at(line))
+
+
+def _number_occurrences(diags: List[Diagnostic]) -> List[Diagnostic]:
+    """Assign occurrence indexes so identical lines (e.g. two equal
+    calls in one file) fingerprint distinctly."""
+    seen: Dict[tuple, int] = {}
+    out = []
+    for d in diags:
+        key = (d.rule, d.path, d.line_text)
+        n = seen.get(key, 0)
+        seen[key] = n + 1
+        out.append(Diagnostic(rule=d.rule, path=d.path, line=d.line,
+                              message=d.message, line_text=d.line_text,
+                              occurrence=n))
+    return out
+
+
+# ------------------------------------------------------------ baseline
+
+class Baseline:
+    """Checked-in grandfather list. Matching is by fingerprint only;
+    line/message are carried for human diffing and refreshed on write."""
+
+    def __init__(self, entries: Optional[Dict[str, dict]] = None,
+                 path: str = ""):
+        self.entries = entries or {}
+        self.path = path
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls(path=path)
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        if data.get("version") != 1:
+            raise ValueError(f"{path}: unsupported baseline version "
+                             f"{data.get('version')!r}")
+        return cls({e["fp"]: e for e in data.get("entries", [])},
+                   path=path)
+
+    @classmethod
+    def from_findings(cls, findings: Iterable[Diagnostic],
+                      path: str = "") -> "Baseline":
+        return cls({d.fingerprint: {
+            "fp": d.fingerprint, "rule": d.rule, "path": d.path,
+            "line": d.line, "message": d.message} for d in findings},
+            path=path)
+
+    def write(self, path: Optional[str] = None) -> None:
+        path = path or self.path
+        entries = sorted(self.entries.values(),
+                         key=lambda e: (e["rule"], e["path"], e["line"]))
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump({"version": 1, "entries": entries}, f, indent=1,
+                      sort_keys=True)
+            f.write("\n")
+
+    def __contains__(self, diag: Diagnostic) -> bool:
+        return diag.fingerprint in self.entries
+
+
+# ------------------------------------------------------------ runner
+
+@dataclass
+class Report:
+    new: List[Diagnostic] = field(default_factory=list)
+    baselined: List[Diagnostic] = field(default_factory=list)
+    suppressed: List[Diagnostic] = field(default_factory=list)
+    stale_baseline: List[dict] = field(default_factory=list)
+    files_checked: int = 0
+    # what this run actually looked at — partial runs (one file, one
+    # --rules subset) must neither report out-of-scope baseline entries
+    # stale nor let --write-baseline erase them
+    rules_run: set = field(default_factory=set)
+    analyzed_files: set = field(default_factory=set)
+    analyzed_dirs: List[str] = field(default_factory=list)
+
+    def covers(self, relpath: str) -> bool:
+        """Was this (possibly deleted) path within the run's scope?"""
+        if relpath in self.analyzed_files:
+            return True
+        return any(relpath.startswith(d) for d in self.analyzed_dirs)
+
+    @property
+    def clean(self) -> bool:
+        return not self.new and not self.stale_baseline
+
+    def render(self, show_baselined: bool = False) -> str:
+        out = []
+        for d in sorted(self.new, key=lambda d: (d.path, d.line, d.rule)):
+            out.append(d.render())
+        if show_baselined:
+            for d in sorted(self.baselined,
+                            key=lambda d: (d.path, d.line, d.rule)):
+                out.append(f"{d.render()}  (baselined)")
+        for e in sorted(self.stale_baseline,
+                        key=lambda e: (e["rule"], e["path"], e["line"])):
+            out.append(
+                f"{e['path']}:{e['line']}: [{e['rule']}] STALE baseline "
+                f"entry {e['fp']} no longer matches any finding — the "
+                f"violation was fixed or the line changed; remove the "
+                f"entry (or --write-baseline) so it cannot mask a "
+                f"future regression")
+        return "\n".join(out)
+
+
+def _iter_py_files(paths: Sequence[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in ("__pycache__", ".git"))
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+def collect_modules(root: str, paths: Sequence[str]
+                    ) -> tuple[List[Module], List[Diagnostic]]:
+    """Parse every .py under paths. Unparseable files become findings
+    (rule ``parse-error``) rather than crashing the run — a syntax error
+    in the tree is itself the worst lint finding there is."""
+    mods: List[Module] = []
+    errors: List[Diagnostic] = []
+    seen = set()
+    for path in _iter_py_files(paths):
+        apath = os.path.abspath(path)
+        if apath in seen:
+            continue
+        seen.add(apath)
+        rel = os.path.relpath(apath, root).replace(os.sep, "/")
+        try:
+            mods.append(load_module(apath, rel))
+        except SyntaxError as e:
+            errors.append(Diagnostic(
+                rule="parse-error", path=rel, line=e.lineno or 1,
+                message=f"does not parse: {e.msg}"))
+    return mods, errors
+
+
+def run(root: str, paths: Sequence[str],
+        rule_names: Optional[Sequence[str]] = None,
+        baseline: Optional[Baseline] = None) -> Report:
+    """Analyze paths (files or directories) against the registry.
+
+    root anchors relpaths (and therefore fingerprints): pass the repo
+    root so baselines are stable regardless of invocation cwd.
+    """
+    rules = registry()
+    if rule_names:
+        unknown = [r for r in rule_names if r not in rules]
+        if unknown:
+            raise ValueError(f"unknown rule(s): {', '.join(unknown)}; "
+                             f"known: {', '.join(sorted(rules))}")
+        rules = {k: v for k, v in rules.items() if k in rule_names}
+
+    mods, parse_errors = collect_modules(root, paths)
+    # unparseable files still count as checked — they produced findings
+    report = Report(files_checked=len(mods) + len(parse_errors),
+                    rules_run=set(rules))
+    report.analyzed_files = {m.relpath for m in mods}
+    for p in paths:
+        ap = os.path.abspath(p)
+        if os.path.isdir(ap):
+            rel = os.path.relpath(ap, root).replace(os.sep, "/")
+            report.analyzed_dirs.append("" if rel == "." else rel + "/")
+    raw: List[Diagnostic] = list(parse_errors)
+    by_path = {m.relpath: m for m in mods}
+
+    for rule in rules.values():
+        in_scope = [m for m in mods if rule.applies_to(m.relpath)]
+        for m in in_scope:
+            raw.extend(rule.check_module(m))
+        raw.extend(rule.check_project(in_scope))
+
+    matched_fps = set()
+    for d in _number_occurrences(raw):
+        mod = by_path.get(d.path)
+        if mod is not None and mod.suppressed(d):
+            report.suppressed.append(d)
+            continue
+        # parse errors are never baselineable: a file that stops
+        # parsing is the one finding that must always fail, and its
+        # empty line_text would otherwise grandfather EVERY future
+        # syntax error in that file under one fingerprint
+        if baseline is not None and d.rule != "parse-error" \
+                and d in baseline:
+            matched_fps.add(d.fingerprint)
+            report.baselined.append(d)
+            continue
+        report.new.append(d)
+
+    if baseline is not None:
+        # stale detection is scoped to files and rules actually analyzed
+        # this run: linting one file (or --rules one-rule) must not
+        # declare the rest of the baseline stale. Scope is covers(), not
+        # mere existence — an entry for a DELETED file under an analyzed
+        # directory is stale too, or it would linger forever and silently
+        # re-grandfather the violation if the file ever came back
+        for fp, entry in baseline.entries.items():
+            rule_active = (entry.get("rule") in rules
+                           or entry.get("rule") == "parse-error")
+            if fp not in matched_fps and rule_active \
+                    and report.covers(entry.get("path", "")):
+                report.stale_baseline.append(entry)
+    return report

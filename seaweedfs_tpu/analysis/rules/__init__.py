@@ -1,0 +1,15 @@
+"""weedlint rule modules. Importing this package registers every rule;
+the import list below IS the rule catalog load order (stable so
+--list-rules and the README table stay in one order)."""
+
+from . import async_hygiene      # noqa: F401  async-blocking-call, async-stdlib-import
+from . import http_timeout       # noqa: F401  http-timeout
+from . import app_construction   # noqa: F401  app-client-max-size, app-admission-middleware
+from . import daemon_loops       # noqa: F401  daemon-loop-shedable
+from . import bare_print         # noqa: F401  bare-print
+from . import locks              # noqa: F401  lock-held-await, lock-ordering
+from . import task_leak          # noqa: F401  task-leak
+from . import cancellation       # noqa: F401  cancelled-swallow
+from . import resources          # noqa: F401  resource-leak
+from . import propagation        # noqa: F401  ctx-propagation
+from . import registries         # noqa: F401  fault-point-registry, metric-label-registry

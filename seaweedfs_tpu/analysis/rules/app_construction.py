@@ -1,0 +1,97 @@
+"""Serving-surface construction guards: explicit body caps and no
+unmetered HTTP surface. Ported from tests/test_async_guard.py's
+overload-plane checks."""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Rule, register
+
+# every file allowed to construct a web.Application; each must meter it
+# through the overload admission middleware (fastpath listeners hook
+# admission explicitly — they bypass aiohttp middleware entirely)
+SERVING_SURFACES = (
+    "seaweedfs_tpu/server/master.py",
+    "seaweedfs_tpu/server/volume_server.py",
+    "seaweedfs_tpu/server/filer_server.py",
+    "seaweedfs_tpu/server/webdav_server.py",
+    "seaweedfs_tpu/s3/s3_server.py",
+    "seaweedfs_tpu/messaging/broker.py",
+)
+
+
+def _application_calls(tree: ast.Module):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "Application":
+            yield node
+
+
+@register
+class AppClientMaxSize(Rule):
+    name = "app-client-max-size"
+    rationale = ("aiohttp's silent 1 MiB default body cap bites exactly "
+                 "once per forgotten surface; every Application() must "
+                 "state its client_max_size")
+    scope = ("seaweedfs_tpu/",)
+    fixture = "app = web.Application(middlewares=[trace])\n"
+    clean_fixture = ("app = web.Application(client_max_size=1,\n"
+                     "    middlewares=[overload.admission_middleware(c)])\n")
+
+    def check_module(self, mod):
+        for call in _application_calls(mod.tree):
+            if not any(kw.arg == "client_max_size"
+                       for kw in call.keywords):
+                yield self.diag(
+                    mod, call.lineno,
+                    "web.Application() without an explicit "
+                    "client_max_size (aiohttp's silent 1 MiB default "
+                    "caps non-streamed bodies)")
+
+
+@register
+class AppAdmissionMiddleware(Rule):
+    name = "app-admission-middleware"
+    rationale = ("an unguarded serving surface accepts unbounded load; "
+                 "the surface list itself is completeness-checked so a "
+                 "new Application() can't dodge the guard")
+    scope = ("seaweedfs_tpu/",)
+    # fixture pretends to live OUTSIDE the surface list -> flagged as an
+    # unlisted surface
+    fixture_relpath = "seaweedfs_tpu/server/_fixture.py"
+    fixture = "app = web.Application(middlewares=[trace])\n"
+    clean_fixture = "def helper():\n    return 1\n"  # no HTTP surface
+
+    def check_project(self, mods):
+        by_path = {m.relpath: m for m in mods}
+        for mod in mods:
+            if mod.relpath in SERVING_SURFACES:
+                continue
+            for call in _application_calls(mod.tree):
+                yield self.diag(
+                    mod, call.lineno,
+                    "constructs a web.Application but is not listed in "
+                    "SERVING_SURFACES (analysis/rules/app_construction"
+                    ".py) — an unmetered HTTP surface")
+        for rel in SERVING_SURFACES:
+            mod = by_path.get(rel)
+            if mod is None:
+                continue  # not part of this run's path set
+            calls = list(_application_calls(mod.tree))
+            if not calls:
+                yield self.diag(
+                    mod, 1,
+                    "listed in SERVING_SURFACES but constructs no "
+                    "web.Application — stale surface list")
+                continue
+            for call in calls:
+                mw = next((kw.value for kw in call.keywords
+                           if kw.arg == "middlewares"), None)
+                if mw is None or "admission_middleware" not in ast.dump(mw):
+                    yield self.diag(
+                        mod, call.lineno,
+                        "web.Application() does not install "
+                        "overload.admission_middleware — an unguarded "
+                        "serving surface accepts unbounded load")

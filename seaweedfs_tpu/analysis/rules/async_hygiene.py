@@ -1,0 +1,118 @@
+"""Event-loop hygiene: nothing blocking and nothing re-imported inside
+``async def`` bodies. Ported from tests/test_async_guard.py."""
+
+from __future__ import annotations
+
+import ast
+import sys
+
+from ..astutil import resolve_call_path, walk_body
+from ..engine import Rule, register
+
+# (module, attr) pairs that block the calling thread — and therefore the
+# whole event loop — for unbounded time
+BLOCKING = {
+    ("os", "fsync"): "use run_in_executor",
+    ("os", "fdatasync"): "use run_in_executor",
+    ("time", "sleep"): "use asyncio.sleep (or run_in_executor)",
+    ("subprocess", "run"): "use asyncio.create_subprocess_exec",
+    ("subprocess", "check_output"): "use asyncio.create_subprocess_exec",
+    ("subprocess", "check_call"): "use asyncio.create_subprocess_exec",
+}
+
+
+@register
+class AsyncBlockingCall(Rule):
+    name = "async-blocking-call"
+    rationale = ("a single synchronous fsync/sleep/subprocess inside a "
+                 "coroutine stalls every in-flight request on that "
+                 "server's event loop")
+    # package-wide: a blocking call on any event loop is a bug, not just
+    # on the serving planes the original guard covered
+    scope = ("seaweedfs_tpu/",)
+    fixture = (
+        "import os\n"
+        "import time as t\n"
+        "from time import sleep as zzz\n"
+        "async def bad1(fd):\n"
+        "    os.fsync(fd)\n"
+        "async def bad2():\n"
+        "    t.sleep(1)\n"
+        "async def bad3():\n"
+        "    zzz(2)\n"
+    )
+    clean_fixture = (
+        "import os\n"
+        "async def good(loop, fd):\n"
+        "    def _sync():\n"
+        "        os.fsync(fd)\n"  # nested sync def = executor body
+        "    await loop.run_in_executor(None, _sync)\n"
+        "def sync_path(fd):\n"
+        "    os.fsync(fd)\n"      # sync functions may block freely
+    )
+
+    def check_module(self, mod):
+        aliases = mod.aliases()
+        for node in mod.walk():
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            for n in walk_body(node):
+                if not isinstance(n, ast.Call):
+                    continue
+                path = resolve_call_path(n, aliases)
+                if len(path) == 2 and tuple(path) in BLOCKING:
+                    yield self.diag(
+                        mod, n.lineno,
+                        f"async def {node.name} calls "
+                        f"{path[0]}.{path[1]}() on the event loop — "
+                        f"{BLOCKING[tuple(path)]}")
+
+
+@register
+class AsyncStdlibImport(Rule):
+    name = "async-stdlib-import"
+    rationale = ("a function-local stdlib import inside a request "
+                 "handler is pure per-call overhead (import-lock "
+                 "traffic showed up in write-path profiles); package/"
+                 "third-party lazy loads stay exempt")
+    # the hot serving planes only: elsewhere a local stdlib import is a
+    # style nit, here it is measured per-request cost
+    scope = ("seaweedfs_tpu/server/", "seaweedfs_tpu/ec/pipeline.py",
+             "seaweedfs_tpu/s3/", "seaweedfs_tpu/overload/",
+             "seaweedfs_tpu/filer/")
+    fixture = (
+        "async def bad():\n"
+        "    import uuid\n"
+        "    from time import sleep\n"
+    )
+    clean_fixture = (
+        "import os\n"
+        "async def good(loop):\n"
+        "    from ..utils import cipher\n"   # package-relative: exempt
+        "    from aiohttp import web\n"      # third-party: exempt
+        "    def _sync():\n"
+        "        import json\n"              # executor body: exempt
+        "    await loop.run_in_executor(None, _sync)\n"
+    )
+
+    def check_module(self, mod):
+        stdlib = sys.stdlib_module_names
+        for node in mod.walk():
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            for n in walk_body(node):
+                if isinstance(n, ast.Import):
+                    for a in n.names:
+                        if a.name.split(".")[0] in stdlib:
+                            yield self.diag(
+                                mod, n.lineno,
+                                f"async def {node.name} imports "
+                                f"{a.name} per call — hoist it to "
+                                f"module level")
+                elif isinstance(n, ast.ImportFrom) and n.level == 0 \
+                        and n.module \
+                        and n.module.split(".")[0] in stdlib:
+                    yield self.diag(
+                        mod, n.lineno,
+                        f"async def {node.name} imports {n.module} per "
+                        f"call — hoist it to module level")

@@ -1,0 +1,41 @@
+"""Diagnostics go through glog, not print(). Ported from
+tests/test_http_surface.py's lint-style check."""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Rule, register
+
+# files whose prints ARE their output contract
+_EXEMPT = (
+    "seaweedfs_tpu/cli.py",
+    "seaweedfs_tpu/analysis/__main__.py",
+)
+
+
+@register
+class BarePrint(Rule):
+    name = "bare-print"
+    rationale = ("diagnostics must go through glog (utils/glog.py) so "
+                 "they carry severity/timestamps and obey -v levels; "
+                 "cli.py and the lint CLI are exempt (their prints are "
+                 "the output contract)")
+    scope = ("seaweedfs_tpu/",)
+    fixture = "def f():\n    print('debug')\n"
+    clean_fixture = ("import logging\n"
+                     "log = logging.getLogger(__name__)\n"
+                     "def f():\n    log.info('debug')\n")
+
+    def applies_to(self, relpath: str) -> bool:
+        return super().applies_to(relpath) and relpath not in _EXEMPT
+
+    def check_module(self, mod):
+        for node in mod.walk():
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id == "print":
+                yield self.diag(
+                    mod, node.lineno,
+                    "bare print() — route diagnostics through glog "
+                    "(utils/glog.py)")

@@ -1,0 +1,162 @@
+"""Cancellation must terminate daemons. A loop whose broad except
+handler swallows CancelledError and keeps looping is a daemon that
+``cancel()`` cannot stop — shutdown hangs, tests leak event loops."""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import attr_path, walk_body
+from ..engine import Rule, register
+
+
+def _handler_names(handler: ast.ExceptHandler):
+    """Dotted names the handler catches; [''] for a bare ``except:``."""
+    t = handler.type
+    if t is None:
+        return [""]
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    return [".".join(attr_path(e)) for e in elts]
+
+
+def _catches_cancellation(handler: ast.ExceptHandler) -> bool:
+    # on py3.8+ CancelledError derives from BaseException, so
+    # ``except Exception`` does NOT swallow it — only these do
+    for name in _handler_names(handler):
+        if name == "" or name.endswith("BaseException") or \
+                name.endswith("CancelledError"):
+            return True
+    return False
+
+
+def _exits(handler: ast.ExceptHandler) -> bool:
+    """True when the handler re-raises or leaves the enclosing loop —
+    i.e. a cancellation that lands here still terminates the daemon.
+    A ``break`` nested inside a loop WITHIN the handler only exits
+    that inner loop, so it does not count."""
+
+    def scan(nodes, loop_depth: int) -> bool:
+        for n in nodes:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            # raise and return escape the daemon loop from any depth
+            if isinstance(n, (ast.Raise, ast.Return)):
+                return True
+            if isinstance(n, ast.Break) and loop_depth == 0:
+                return True
+            depth = loop_depth + (1 if isinstance(
+                n, (ast.While, ast.For, ast.AsyncFor)) else 0)
+            if scan(ast.iter_child_nodes(n), depth):
+                return True
+        return False
+
+    return scan(handler.body, 0)
+
+
+@register
+class CancelledSwallow(Rule):
+    name = "cancelled-swallow"
+    rationale = ("a loop whose except swallows CancelledError (bare/"
+                 "BaseException/CancelledError with no raise/return/"
+                 "break) is a daemon cancel() cannot stop — shutdown "
+                 "hangs until SIGKILL")
+    scope = ("seaweedfs_tpu/",)
+    fixture = (
+        "async def bad_loop(self):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            await self._pass()\n"
+        "        except (ConnectionError, asyncio.CancelledError):\n"
+        "            pass\n"
+        "        await asyncio.sleep(1)\n"
+        "async def bare(self):\n"
+        "    try:\n"
+        "        await self._pass()\n"
+        "    except:\n"
+        "        pass\n"
+        "async def nested_break(self):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            await self._pass()\n"
+        "        except BaseException:\n"
+        "            for x in self.items:\n"
+        "                break\n"       # exits the for, NOT the daemon
+    )
+    clean_fixture = (
+        "async def good_loop(self):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            await self._pass()\n"
+        "        except asyncio.CancelledError:\n"
+        "            raise\n"
+        "        except Exception:\n"   # does not catch CancelledError
+        "            log.warning('pass failed')\n"
+        "        await asyncio.sleep(1)\n"
+        "async def good_return(self):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            await self._pass()\n"
+        "        except asyncio.CancelledError:\n"
+        "            return\n"
+        "async def good_reraise_first(self):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            await self._pass()\n"
+        "        except asyncio.CancelledError:\n"
+        "            raise\n"
+        "        except BaseException as e:\n"   # unreachable for
+        "            log.warning('pass: %s', e)\n"  # cancellation
+    )
+
+    def check_module(self, mod):
+        for fn in mod.walk():
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            yield from self._check_fn(mod, fn)
+
+    def _check_fn(self, mod, fn):
+        def visit(node, in_loop: bool):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                loop_now = in_loop or isinstance(
+                    child, (ast.While, ast.For, ast.AsyncFor))
+                if isinstance(child, ast.Try):
+                    shielded = False
+                    for h in child.handlers:
+                        if not _catches_cancellation(h):
+                            continue
+                        if shielded:
+                            # an earlier handler already consumed
+                            # cancellation: this one can never see it
+                            # (the re-raise-first idiom stays clean)
+                            continue
+                        # only the FIRST cancellation-catching handler
+                        # is judged; whatever it does, later ones are
+                        # unreachable for CancelledError
+                        shielded = True
+                        if _exits(h):
+                            continue
+                        names = [n or "<bare>"
+                                 for n in _handler_names(h)]
+                        if in_loop:
+                            yield self.diag(
+                                mod, h.lineno,
+                                f"async def {fn.name}: except "
+                                f"{'/'.join(names)} inside a loop "
+                                f"swallows CancelledError and keeps "
+                                f"looping — this daemon cannot be "
+                                f"cancelled; re-raise (or return/"
+                                f"break)")
+                        elif h.type is None:
+                            yield self.diag(
+                                mod, h.lineno,
+                                f"async def {fn.name}: bare except "
+                                f"swallows CancelledError (and every "
+                                f"error) — catch specific exceptions "
+                                f"or re-raise")
+                yield from visit(child, loop_now)
+
+        yield from visit(fn, False)

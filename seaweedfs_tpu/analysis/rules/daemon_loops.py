@@ -1,0 +1,113 @@
+"""Background daemon loops must be shedable (CLASS_BG) and de-
+synchronized (jittered sleep). Ported from tests/test_async_guard.py's
+lifecycle-loop guard."""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import walk_body
+from ..engine import Rule, register
+
+
+def _is_bg_priority_call(node: ast.Call) -> bool:
+    """overload.set_priority(overload.CLASS_BG) / overload.priority(...)
+    (or the bare-name variants after a from-import)."""
+    f = node.func
+    name = f.attr if isinstance(f, ast.Attribute) else \
+        (f.id if isinstance(f, ast.Name) else "")
+    if name not in ("set_priority", "priority"):
+        return False
+    for arg in node.args:
+        if isinstance(arg, ast.Attribute) and arg.attr == "CLASS_BG":
+            return True
+        if isinstance(arg, ast.Name) and arg.id == "CLASS_BG":
+            return True
+    return False
+
+
+def _daemon_loop_violations(node: ast.AsyncFunctionDef):
+    calls = [n for n in ast.walk(node) if isinstance(n, ast.Call)]
+    has_sleep = any(isinstance(c.func, ast.Attribute)
+                    and c.func.attr == "sleep"
+                    and isinstance(c.func.value, ast.Name)
+                    and c.func.value.id == "asyncio" for c in calls)
+    has_forever = any(isinstance(n, ast.While) and
+                      isinstance(n.test, ast.Constant) and
+                      n.test.value is True
+                      for n in ast.walk(node))
+    # a daemon loop is a *_loop-named coroutine, or a while-True that
+    # paces itself with asyncio.sleep; bounded pagination loops (no
+    # sleep) are request-scoped work, not daemons
+    if not (node.name.endswith("_loop") or (has_forever and has_sleep)):
+        return
+    if not any(_is_bg_priority_call(c) for c in calls):
+        yield (node.lineno,
+               f"async def {node.name}: daemon loop without overload "
+               f"CLASS_BG binding — its fan-out can never be shed")
+    for c in calls:
+        f = c.func
+        is_sleep = (isinstance(f, ast.Attribute) and f.attr == "sleep"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "asyncio")
+        if not is_sleep:
+            continue
+        arg = c.args[0] if c.args else None
+        ok = (isinstance(arg, ast.Call) and
+              ((isinstance(arg.func, ast.Name)
+                and arg.func.id == "jittered") or
+               (isinstance(arg.func, ast.Attribute)
+                and arg.func.attr == "jittered")))
+        if not ok:
+            yield (c.lineno,
+                   f"async def {node.name}: asyncio.sleep without "
+                   f"jittered(interval) — a fleet of masters would "
+                   f"scan in lockstep")
+
+
+@register
+class DaemonLoopShedable(Rule):
+    name = "daemon-loop-shedable"
+    rationale = ("every lifecycle daemon loop must bind CLASS_BG (so "
+                 "its fan-out sheds before foreground traffic) and "
+                 "sleep on a jittered interval (no fleet-wide lockstep "
+                 "scans)")
+    scope = ("seaweedfs_tpu/lifecycle/",)
+    fixture_relpath = "seaweedfs_tpu/lifecycle/_fixture.py"
+    fixture = (
+        "async def scan_loop():\n"
+        "    while True:\n"
+        "        await asyncio.sleep(60)\n"
+    )
+    clean_fixture = (
+        "async def scan_loop(self):\n"
+        "    overload.set_priority(overload.CLASS_BG)\n"
+        "    while True:\n"
+        "        await asyncio.sleep(jittered(self.cfg.interval))\n"
+        "async def other_loop(self):\n"
+        "    with priority(CLASS_BG):\n"
+        "        while True:\n"
+        "            await asyncio.sleep(lifecycle.jittered(3.0))\n"
+    )
+
+    def check_module(self, mod):
+        for node in mod.walk():
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            for lineno, problem in _daemon_loop_violations(node):
+                yield self.diag(mod, lineno, problem)
+
+    def check_project(self, mods):
+        # the guard must be guarding something: if lifecycle/ lost its
+        # daemon loop entirely, fail loudly instead of certifying air
+        for mod in mods:
+            for node in mod.walk():
+                if isinstance(node, ast.AsyncFunctionDef) and any(
+                        isinstance(n, ast.While)
+                        for n in walk_body(node)):
+                    return
+        for mod in mods:
+            if mod.relpath.endswith("/daemon.py"):
+                yield self.diag(
+                    mod, 1, "lifecycle/ contains no async daemon loop "
+                    "— the daemon-loop guard guards nothing")

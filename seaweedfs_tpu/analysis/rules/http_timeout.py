@@ -1,0 +1,66 @@
+"""Every intra-cluster call site must be time-bounded. Ported from
+tests/test_timeout_guard.py."""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import resolve_call_path
+from ..engine import Rule, register
+
+_GUARDED = {
+    ("urllib", "request", "urlopen"): "urllib.request.urlopen",
+    ("aiohttp", "ClientSession"): "aiohttp.ClientSession",
+    ("http", "client", "HTTPConnection"): "http.client.HTTPConnection",
+    ("http", "client", "HTTPSConnection"): "http.client.HTTPSConnection",
+}
+
+
+@register
+class HttpTimeout(Rule):
+    name = "http-timeout"
+    rationale = ("a urlopen/ClientSession/HTTPConnection without "
+                 "timeout= hangs forever on a wedged peer — self-"
+                 "healing depends on failures surfacing")
+    scope = ("seaweedfs_tpu/",)
+    fixture = (
+        "import urllib.request\n"
+        "import aiohttp\n"
+        "import http.client\n"
+        "from aiohttp import ClientSession\n"
+        "def bad1(u):\n"
+        "    return urllib.request.urlopen(u)\n"
+        "def bad2():\n"
+        "    return aiohttp.ClientSession()\n"
+        "def bad3(h):\n"
+        "    return http.client.HTTPConnection(h)\n"
+        "def bad4():\n"
+        "    return ClientSession()\n"
+    )
+    clean_fixture = (
+        "import urllib.request\n"
+        "import aiohttp\n"
+        "import http.client\n"
+        "def good1(u):\n"
+        "    return urllib.request.urlopen(u, timeout=5)\n"
+        "def good2():\n"
+        "    return aiohttp.ClientSession(timeout=object())\n"
+        "def good3(h, kw):\n"
+        "    return http.client.HTTPConnection(h, **kw)\n"
+    )
+
+    def check_module(self, mod):
+        aliases = mod.aliases()
+        for node in mod.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            path = resolve_call_path(node, aliases)
+            label = _GUARDED.get(path)
+            if label is None:
+                continue
+            kwargs = {k.arg for k in node.keywords}
+            if "timeout" not in kwargs and None not in kwargs:  # **kw exempt
+                yield self.diag(
+                    mod, node.lineno,
+                    f"{label}() without an explicit timeout= — a wedged "
+                    f"peer hangs this call site forever")

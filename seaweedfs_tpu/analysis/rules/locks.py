@@ -1,0 +1,188 @@
+"""Race detectors for the breaker/cache/lease-pool state mutexes:
+holding a thread lock across a suspension point, and cross-file lock
+acquisition-order cycles."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from ..astutil import dotted, enclosing_class_map, walk_body
+from ..engine import Rule, register
+
+_LOCKISH = ("lock", "mutex")
+
+
+def _lock_name(expr) -> str:
+    """Normalized-ish dotted name when the expression looks like a lock
+    ('' otherwise). A name is lock-ish when its last segment mentions
+    lock/mutex — matches this codebase's naming (_lock, _shared_lock,
+    klock, _vacuum_lock...)."""
+    d = dotted(expr)
+    if not d:
+        return ""
+    last = d.rsplit(".", 1)[-1].lower()
+    if any(s in last for s in _LOCKISH):
+        return d
+    return ""
+
+
+def _with_lock_items(node) -> List[Tuple[str, ast.AST]]:
+    out = []
+    for item in node.items:
+        name = _lock_name(item.context_expr)
+        if name:
+            out.append((name, item.context_expr))
+    return out
+
+
+@register
+class LockHeldAwait(Rule):
+    name = "lock-held-await"
+    rationale = ("awaiting while holding a threading lock parks the "
+                 "mutex across a suspension point: every thread (and "
+                 "any coroutine sharing the lock) blocks for the full "
+                 "await — the never-held-across-network rule the lease "
+                 "pool fought for")
+    scope = ("seaweedfs_tpu/",)
+    fixture = (
+        "async def bad(self, session):\n"
+        "    with self._lock:\n"
+        "        await session.get('http://peer/refill')\n"
+    )
+    clean_fixture = (
+        "async def good(self, session):\n"
+        "    with self._lock:\n"
+        "        state = dict(self._cache)\n"
+        "    await session.get('http://peer/refill')\n"
+        "async def also_good(self):\n"
+        "    async with self._alock:\n"   # asyncio locks may span awaits
+        "        await self._refresh()\n"
+    )
+
+    def check_module(self, mod):
+        for fn in mod.walk():
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for node in walk_body(fn):
+                if not isinstance(node, ast.With):
+                    continue
+                locks = _with_lock_items(node)
+                if not locks:
+                    continue
+                for inner in walk_body(node):
+                    if isinstance(inner, (ast.Await, ast.AsyncFor,
+                                          ast.AsyncWith)):
+                        yield self.diag(
+                            mod, node.lineno,
+                            f"async def {fn.name} awaits at line "
+                            f"{inner.lineno} while holding "
+                            f"{locks[0][0]} (sync with) — a thread "
+                            f"mutex held across a suspension point; "
+                            f"copy state out, release, then await")
+                        break
+
+
+@register
+class LockOrdering(Rule):
+    name = "lock-ordering"
+    rationale = ("two code paths that nest the same pair of locks in "
+                 "opposite orders deadlock under load; acquisition "
+                 "edges are collected tree-wide and cycles rejected")
+    scope = ("seaweedfs_tpu/",)
+    fixture = (
+        "class A:\n"
+        "    def one(self):\n"
+        "        with self._map_lock:\n"
+        "            with self._flush_lock:\n"
+        "                pass\n"
+        "    def two(self):\n"
+        "        with self._flush_lock:\n"
+        "            with self._map_lock:\n"
+        "                pass\n"
+    )
+    clean_fixture = (
+        "class A:\n"
+        "    def one(self):\n"
+        "        with self._map_lock:\n"
+        "            with self._flush_lock:\n"
+        "                pass\n"
+        "    def two(self):\n"
+        "        with self._map_lock:\n"
+        "            with self._flush_lock:\n"
+        "                pass\n"
+    )
+
+    def _edges(self, mod) -> List[Tuple[str, str, int]]:
+        """(outer_lock, inner_lock, lineno) for every lexically nested
+        acquisition. Lock ids are class-qualified so A._lock and
+        B._lock stay distinct across files."""
+        classes = enclosing_class_map(mod.tree)
+        edges: List[Tuple[str, str, int]] = []
+
+        def qualify(name: str, node) -> str:
+            # module-prefixed class qualification: two unrelated classes
+            # both named Store in different files must NOT merge their
+            # lock ids, or their unrelated nestings could fabricate a
+            # deadlock cycle that cannot happen
+            cls = classes.get(node, "")
+            if name.startswith("self."):
+                owner = f"{mod.relpath}:{cls}" if cls else mod.relpath
+                return f"{owner}.{name[5:]}"
+            return f"{mod.relpath}:{name}"
+
+        def visit(node, held: List[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    visit(child, [])   # fresh hold-set per function
+                    continue
+                acquired = []
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    for name, expr in _with_lock_items(child):
+                        q = qualify(name, child)
+                        for h in held:
+                            if h != q:
+                                edges.append((h, q, child.lineno))
+                        acquired.append(q)
+                visit(child, held + acquired)
+
+        visit(mod.tree, [])
+        return edges
+
+    def check_project(self, mods):
+        graph: Dict[str, set] = {}
+        sites: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        for mod in mods:
+            for a, b, line in self._edges(mod):
+                graph.setdefault(a, set()).add(b)
+                sites.setdefault((a, b), (mod.relpath, line))
+        def reaches(src: str, dst: str) -> bool:
+            seen, stack = set(), [src]
+            while stack:
+                n = stack.pop()
+                if n == dst:
+                    return True
+                if n in seen:
+                    continue
+                seen.add(n)
+                stack.extend(graph.get(n, ()))
+            return False
+
+        # an edge participates in a cycle when its head reaches back to
+        # its tail; every such edge is a diagnostic (the graphs here are
+        # tiny, BFS per edge is fine)
+        by_path = {m.relpath: m for m in mods}
+        for a in sorted(graph):
+            for b in sorted(graph[a]):
+                if not reaches(b, a):
+                    continue
+                path, line = sites[(a, b)]
+                mod = by_path.get(path)
+                if mod is None:
+                    continue
+                yield self.diag(
+                    mod, line,
+                    f"lock-order cycle: {a} -> {b} acquired here, but "
+                    f"another path acquires {b} before {a} — opposite "
+                    f"nesting orders deadlock under load")

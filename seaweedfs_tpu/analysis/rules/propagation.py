@@ -1,0 +1,153 @@
+"""Context propagation: the trace id and overload priority live in
+contextvars, which do NOT cross executor/thread hops or plain aiohttp
+sessions by themselves. Every hop must use the blessed bridges:
+``observe.run_with`` for threads/executors, ``observe.
+client_trace_config()`` for outbound sessions (it injects both the
+``X-Seaweed-Trace`` and priority headers)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from ..astutil import resolve_call_path, walk_body
+from ..engine import Rule, register
+
+# observe.span() reads the AMBIENT contextvar; observe.stage()/
+# record_span() take an explicit ctx argument and are hop-safe
+_SPAN_EMITTERS = ("span",)
+
+
+def _emits_spans(fn) -> bool:
+    """Does this (nested) def call observe.span directly? Such a
+    function reads the ambient trace context."""
+    for n in walk_body(fn):
+        if isinstance(n, ast.Call) and \
+                isinstance(n.func, ast.Attribute) and \
+                n.func.attr in _SPAN_EMITTERS and \
+                isinstance(n.func.value, ast.Name) and \
+                n.func.value.id == "observe":
+            return True
+    return False
+
+
+@register
+class CtxPropagation(Rule):
+    name = "ctx-propagation"
+    rationale = ("contextvars don't cross executor/thread hops or "
+                 "plain sessions: span-emitting work shipped to an "
+                 "executor must go through observe.run_with, and "
+                 "every intra-cluster ClientSession must install "
+                 "observe.client_trace_config() so trace id + "
+                 "overload priority ride every outbound request")
+    scope = ("seaweedfs_tpu/",)
+    # observe/ implements the bridges; its own sessions are exempt
+    _exempt = ("seaweedfs_tpu/observe/",)
+    fixture = (
+        "import aiohttp\n"
+        "async def bad(self):\n"
+        "    self._session = aiohttp.ClientSession(timeout=T)\n"
+        "async def bad2(self, loop):\n"
+        "    def work():\n"
+        "        with observe.span('ec.read'):\n"
+        "            return 1\n"
+        "    await loop.run_in_executor(None, work)\n"
+        "async def bad3(self):\n"
+        "    self._s = aiohttp.ClientSession(trace_configs=[])\n"
+    )
+    clean_fixture = (
+        "import aiohttp\n"
+        "async def good(self):\n"
+        "    self._session = aiohttp.ClientSession(\n"
+        "        timeout=T,\n"
+        "        trace_configs=[observe.client_trace_config()])\n"
+        "async def good2(self, loop):\n"
+        "    ctx = observe.capture()\n"
+        "    def work():\n"
+        "        with observe.span('ec.read'):\n"
+        "            return 1\n"
+        "    await loop.run_in_executor(\n"
+        "        None, lambda: observe.run_with(ctx, work))\n"
+        "async def good3(self, loop):\n"
+        "    def plain():\n"
+        "        return 1\n"           # no spans: no context needed
+        "    await loop.run_in_executor(None, plain)\n"
+    )
+
+    def applies_to(self, relpath: str) -> bool:
+        if any(relpath.startswith(e) for e in self._exempt):
+            return False
+        return super().applies_to(relpath)
+
+    def check_module(self, mod):
+        aliases = mod.aliases()
+        yield from self._check_sessions(mod, aliases)
+        yield from self._check_executor_hops(mod)
+
+    def _check_sessions(self, mod, aliases):
+        for node in mod.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            if resolve_call_path(node, aliases) != \
+                    ("aiohttp", "ClientSession"):
+                continue
+            ok = False
+            for kw in node.keywords:
+                if kw.arg is None:     # **kwargs: can't judge
+                    ok = True
+                elif kw.arg == "trace_configs" and \
+                        "client_trace_config" in ast.dump(kw.value):
+                    # the kwarg must actually install the blessed
+                    # config — trace_configs=[] still drops the headers
+                    ok = True
+            if not ok:
+                yield self.diag(
+                    mod, node.lineno,
+                    "aiohttp.ClientSession() without trace_configs=["
+                    "observe.client_trace_config()] — requests through "
+                    "this session drop the trace id and overload "
+                    "priority at the process boundary")
+
+    def _check_executor_hops(self, mod):
+        # only TOP-LEVEL functions: each owns its whole nested subtree
+        # (span_fns may be defined in an outer def and handed off in an
+        # inner one), and visiting nested defs again would report the
+        # same hand-off twice
+        fdefs = (ast.FunctionDef, ast.AsyncFunctionDef)
+        nested = set()
+        for f in mod.walk():
+            if isinstance(f, fdefs):
+                nested.update(id(sub) for sub in ast.walk(f)
+                              if sub is not f and isinstance(sub, fdefs))
+        for fn in mod.walk():
+            if not isinstance(fn, fdefs) or id(fn) in nested:
+                continue
+            span_fns: Set[str] = {
+                child.name for child in ast.walk(fn)
+                if isinstance(child, ast.FunctionDef) and child is not fn
+                and _emits_spans(child)}
+            if not span_fns:
+                continue
+            for n in walk_body(fn, into_nested_defs=True):
+                if not (isinstance(n, ast.Call) and
+                        isinstance(n.func, ast.Attribute) and
+                        n.func.attr in ("run_in_executor", "submit")):
+                    continue
+                for arg in n.args:
+                    if isinstance(arg, ast.Name) and arg.id in span_fns:
+                        yield self.diag(
+                            mod, n.lineno,
+                            f"span-emitting '{arg.id}' handed raw to "
+                            f"{n.func.attr} — run_in_executor does not "
+                            f"copy contextvars, so its spans lose the "
+                            f"request's trace id; wrap as lambda: "
+                            f"observe.run_with(observe.capture(), "
+                            f"{arg.id})")
+                for kw in n.keywords:
+                    if isinstance(kw.value, ast.Name) and \
+                            kw.value.id in span_fns:
+                        yield self.diag(
+                            mod, n.lineno,
+                            f"span-emitting '{kw.value.id}' handed raw "
+                            f"to {n.func.attr} — wrap with "
+                            f"observe.run_with")

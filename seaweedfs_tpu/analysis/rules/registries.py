@@ -1,0 +1,233 @@
+"""Cross-file registry consistency: fault points and metric families.
+
+Both registries fail silently when they drift — an unregistered fault
+point quietly no-ops a chaos drill, and a metric name reused with a
+different label set (or kind) splits one logical family into colliding
+exposition groups."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from ..astutil import attr_path, const_str
+from ..engine import Rule, register
+
+_FAULTS_MODULE = "seaweedfs_tpu/faults/__init__.py"
+_FIRE_CALLS = ("fire", "fire_async", "corrupt", "set_fault")
+
+
+def _known_points(faults_mod=None) -> frozenset:
+    """The declared point set of the tree being ANALYZED: parsed from
+    its faults module's KNOWN_POINTS literal when that file is in the
+    run (so --root on a branch checkout judges against the branch's
+    declarations), falling back to the running package's set for
+    single-module fixture runs."""
+    if faults_mod is not None:
+        for node in ast.walk(faults_mod.tree):
+            if not (isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "KNOWN_POINTS"
+                    for t in node.targets)):
+                continue
+            call = node.value
+            if isinstance(call, ast.Call) and call.args and \
+                    isinstance(call.args[0], (ast.Set, ast.List,
+                                              ast.Tuple)):
+                points = [const_str(e) for e in call.args[0].elts]
+                if all(p is not None for p in points):
+                    return frozenset(points)
+    from ...faults import KNOWN_POINTS
+    return KNOWN_POINTS
+
+
+def _fire_sites(mod) -> List[Tuple[str, int, str]]:
+    """(point, lineno, call) for every faults.fire/fire_async/corrupt/
+    set_fault with a literal point name in the module."""
+    out = []
+    for node in mod.walk():
+        if not isinstance(node, ast.Call):
+            continue
+        path = attr_path(node.func)
+        if not path or path[-1] not in _FIRE_CALLS:
+            continue
+        # require the faults module as receiver (faults.fire) or a
+        # bare from-import (fire_async) — but NOT arbitrary .corrupt()
+        if len(path) > 1 and path[-2] != "faults":
+            continue
+        if len(path) == 1 and path[0] == "corrupt":
+            continue  # bare corrupt() is too generic to claim
+        point = const_str(node.args[0]) if node.args else None
+        if point is not None and not point.endswith("*"):
+            out.append((point, node.lineno, path[-1]))
+    return out
+
+
+@register
+class FaultPointRegistry(Rule):
+    name = "fault-point-registry"
+    rationale = ("faults.KNOWN_POINTS and the fire()/fire_async() call "
+                 "sites must agree: an undeclared point is a typo that "
+                 "no-ops a chaos drill, a declared point nothing fires "
+                 "is dead chaos surface")
+    scope = ("seaweedfs_tpu/",)
+    fixture = (
+        "from . import faults\n"
+        "async def write(self):\n"
+        "    await faults.fire_async('volume.wrlte')\n"  # typo
+    )
+    clean_fixture = (
+        "from . import faults\n"
+        "async def write(self):\n"
+        "    await faults.fire_async('volume.write')\n"
+    )
+
+    def check_project(self, mods):
+        faults_mod = next((m for m in mods
+                           if m.relpath == _FAULTS_MODULE), None)
+        known = _known_points(faults_mod)
+        fired = {}
+        for mod in mods:
+            for point, lineno, call in _fire_sites(mod):
+                fired.setdefault(point, []).append((mod, lineno, call))
+        for point, sites in sorted(fired.items()):
+            if point in known:
+                continue
+            for mod, lineno, call in sites:
+                yield self.diag(
+                    mod, lineno,
+                    f"{call}({point!r}) names an undeclared fault "
+                    f"point — typo, or add it to faults.KNOWN_POINTS "
+                    f"so drills can arm it with confidence")
+        # coverage direction only when the whole plane was analyzed
+        servers_in_run = any(
+            m.relpath.startswith("seaweedfs_tpu/server/") for m in mods)
+        if faults_mod is None or not servers_in_run:
+            return
+        decl_line = 1
+        for node in ast.walk(faults_mod.tree):
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "KNOWN_POINTS"
+                    for t in node.targets):
+                decl_line = node.lineno
+        for point in sorted(known - set(fired)):
+            yield self.diag(
+                faults_mod, decl_line,
+                f"declared fault point {point!r} is never fired "
+                f"anywhere in the package — dead chaos surface that "
+                f"drills believe in but nothing honors")
+
+
+@register
+class MetricLabelRegistry(Rule):
+    name = "metric-label-registry"
+    rationale = ("one metric name must mean one family: call sites "
+                 "that disagree on label keys split the family, and "
+                 "two names whose rendered samples collide (gauge "
+                 "'x_count' vs histogram 'x') corrupt the exposition")
+    scope = ("seaweedfs_tpu/",)
+    fixture = (
+        "def f(self):\n"
+        "    self.metrics.count('reqs', labels={'cls': 'fg'})\n"
+        "def g(self):\n"
+        "    self.metrics.count('reqs')\n"   # same family, no labels
+        "def h(self):\n"
+        "    self.metrics.gauge('lat_count', 3)\n"
+        "    self.metrics.observe('lat', 0.1)\n"  # renders lat_count too
+    )
+    clean_fixture = (
+        "def f(self):\n"
+        "    self.metrics.count('reqs', labels={'cls': 'fg'})\n"
+        "def g(self):\n"
+        "    self.metrics.count('reqs', labels={'cls': 'bg'})\n"
+        "def h(self):\n"
+        "    self.metrics.count('read')\n"   # renders read_total:
+        "    with self.metrics.timed('read'):\n"  # no collision with
+        "        pass\n"                          # read_bucket/sum/count
+    )
+
+    _KINDS = {"count": "counter", "gauge": "gauge",
+              "observe": "histogram", "timed": "histogram"}
+
+    @staticmethod
+    def _rendered(name: str, kind: str) -> frozenset:
+        """Sample names utils/metrics.py emits for a family — counters
+        get _total, histograms explode to _bucket/_sum/_count."""
+        if kind == "counter":
+            return frozenset({f"{name}_total"})
+        if kind == "histogram":
+            return frozenset({f"{name}_bucket", f"{name}_sum",
+                              f"{name}_count"})
+        return frozenset({name})
+
+    def _sites(self, mod):
+        for node in mod.walk():
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Attribute) or \
+                    node.func.attr not in self._KINDS:
+                continue
+            recv = node.func.value
+            recv_path = attr_path(recv)
+            is_registry = (recv_path and recv_path[-1] == "metrics") or (
+                isinstance(recv, ast.Call) and
+                isinstance(recv.func, (ast.Name, ast.Attribute)) and
+                (attr_path(recv.func) or ("",))[-1] == "shared")
+            if not is_registry:
+                continue
+            name = const_str(node.args[0]) if node.args else None
+            if name is None:
+                continue
+            labels = next((kw.value for kw in node.keywords
+                           if kw.arg == "labels"), None)
+            if labels is None:
+                keyset: frozenset = frozenset()
+            elif isinstance(labels, ast.Dict) and all(
+                    const_str(k) is not None for k in labels.keys):
+                keyset = frozenset(const_str(k) for k in labels.keys)
+            else:
+                continue  # dynamic labels: can't judge statically
+            yield (name, self._KINDS[node.func.attr], keyset,
+                   node.lineno)
+
+    def check_project(self, mods):
+        families: Dict[tuple, Dict[frozenset, list]] = {}
+        for mod in mods:
+            for name, kind, keyset, lineno in self._sites(mod):
+                families.setdefault((name, kind), {}).setdefault(
+                    keyset, []).append((mod, lineno))
+
+        # 1) label-keyset drift within one (name, kind) family
+        for (name, kind), variants in sorted(families.items()):
+            if len(variants) == 1:
+                continue
+            ranked = sorted(variants.items(),
+                            key=lambda kv: (-len(kv[1]), sorted(kv[0])))
+            canon_keys = ranked[0][0]
+            for keys, sites in ranked[1:]:
+                for mod, lineno in sites:
+                    yield self.diag(
+                        mod, lineno,
+                        f"metric {name!r} recorded with label keys "
+                        f"{sorted(keys)} but the rest of the family "
+                        f"uses {sorted(canon_keys)} — mixed label sets "
+                        f"split one family into colliding exposition "
+                        f"groups")
+
+        # 2) rendered-sample collisions across different families
+        # (counter 'x' renders x_total so it coexists with histogram
+        # 'x'; gauge 'x_count' vs histogram 'x' does NOT)
+        rendered: Dict[str, tuple] = {}
+        for (name, kind) in sorted(families):
+            for sample in sorted(self._rendered(name, kind)):
+                prev = rendered.get(sample)
+                if prev is not None and prev[:2] != (name, kind):
+                    mod, lineno = next(
+                        (m, ln) for v in families[(name, kind)].values()
+                        for m, ln in v)
+                    yield self.diag(
+                        mod, lineno,
+                        f"metric {name!r} ({kind}) renders sample "
+                        f"{sample!r}, colliding with metric "
+                        f"{prev[0]!r} ({prev[1]}) — the exposition "
+                        f"merges two meanings under one sample name")
+                else:
+                    rendered[sample] = (name, kind)

@@ -1,0 +1,196 @@
+"""Unclosed resources: files, mmaps, sockets and aiohttp sessions must
+be closed on EVERY path — ``with``/``async with``, or a close under
+``finally``. A close only on the happy path leaks the fd/session the
+first time the code between open and close raises."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from ..astutil import FUNC_DEFS, resolve_call_path, walk_body
+from ..engine import Rule, register
+
+_CONSTRUCTORS = {
+    ("open",): "open",
+    ("os", "fdopen"): "os.fdopen",
+    ("mmap", "mmap"): "mmap.mmap",
+    ("socket", "socket"): "socket.socket",
+    ("aiohttp", "ClientSession"): "aiohttp.ClientSession",
+}
+
+# raw-handle constructors additionally tracked in comprehensions: a
+# failure mid-comprehension leaks every handle already produced (the
+# list doesn't exist yet, so no cleanup path can reach them)
+_COMPREHENSION_CONSTRUCTORS = dict(_CONSTRUCTORS)
+_COMPREHENSION_CONSTRUCTORS[("os", "open")] = "os.open"
+
+
+def _resource_label(call: ast.Call, aliases) -> str:
+    path = resolve_call_path(call, aliases)
+    return _CONSTRUCTORS.get(path, "")
+
+
+@register
+class ResourceLeak(Rule):
+    name = "resource-leak"
+    rationale = ("a file/mmap/socket/ClientSession closed only on the "
+                 "happy path leaks the first time anything between "
+                 "open and close raises; use with/async with or a "
+                 "finally")
+    scope = ("seaweedfs_tpu/",)
+    fixture = (
+        "import os\n"
+        "def bad(p):\n"
+        "    fh = open(p)\n"
+        "    data = fh.read()\n"       # raises -> fh leaks
+        "    fh.close()\n"
+        "    return data\n"
+        "def bad2(p):\n"
+        "    open(p)\n"                # opened and dropped
+        "def bad3(self, paths):\n"
+        "    self._fds = [os.open(p, os.O_RDONLY) for p in paths]\n"
+    )
+    clean_fixture = (
+        "def good(p):\n"
+        "    with open(p) as fh:\n"
+        "        return fh.read()\n"
+        "def good2(p):\n"
+        "    fh = open(p)\n"
+        "    try:\n"
+        "        return fh.read()\n"
+        "    finally:\n"
+        "        fh.close()\n"
+        "def good3(self, p):\n"
+        "    self._f = open(p)\n"      # lifecycle-managed elsewhere
+        "def good4(p):\n"
+        "    fh = open(p)\n"
+        "    return fh\n"              # ownership transferred out
+        "def good5(p, sink):\n"
+        "    fh = open(p)\n"
+        "    sink.adopt(fh)\n"         # ownership transferred
+    )
+
+    def check_module(self, mod):
+        aliases = mod.aliases()
+        # the module body is a scope too (module-level opens), and each
+        # function is visited exactly once — _check_scope never crosses
+        # into nested defs, so nothing is reported twice
+        yield from self._check_scope(mod, mod.tree, aliases)
+        for fn in mod.walk():
+            if not isinstance(fn, FUNC_DEFS):
+                continue
+            yield from self._check_scope(mod, fn, aliases)
+
+    def _check_scope(self, mod, fn, aliases) -> Iterator:
+        with_ctx_calls = set()
+        for node in walk_body(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    with_ctx_calls.add(id(item.context_expr))
+
+        # collect finally-block subtrees once: a close is error-safe
+        # only if it runs under one
+        finally_nodes = set()
+        for node in walk_body(fn):
+            if isinstance(node, ast.Try):
+                for stmt in node.finalbody:
+                    for n in ast.walk(stmt):
+                        finally_nodes.add(id(n))
+
+        # a resource constructor as a comprehension element: a failure
+        # mid-comprehension leaks every handle already opened, and no
+        # caller can ever close them (the container never materialized).
+        # Stays within THIS scope — a comprehension inside a nested def
+        # is reported when that def's own scope is visited
+        for node in walk_body(fn):
+            if not isinstance(node, (ast.ListComp, ast.SetComp,
+                                     ast.GeneratorExp, ast.DictComp)):
+                continue
+            elts = ([node.key, node.value]
+                    if isinstance(node, ast.DictComp) else [node.elt])
+            for elt in elts:
+                for sub in ast.walk(elt):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    path = resolve_call_path(sub, aliases)
+                    label = _COMPREHENSION_CONSTRUCTORS.get(path, "")
+                    if label:
+                        yield self.diag(
+                            mod, sub.lineno,
+                            f"{label}(...) inside a comprehension — if "
+                            f"a later element raises, every handle "
+                            f"already opened leaks with no reference "
+                            f"to close; open in a loop with "
+                            f"try/except cleanup")
+
+        for node in walk_body(fn):
+            if isinstance(node, ast.Expr) and \
+                    isinstance(node.value, ast.Call):
+                label = _resource_label(node.value, aliases)
+                if label and id(node.value) not in with_ctx_calls:
+                    yield self.diag(
+                        mod, node.lineno,
+                        f"{label}(...) opened and immediately dropped "
+                        f"— the handle can never be closed")
+            elif isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    len(node.targets) == 1:
+                label = _resource_label(node.value, aliases)
+                if not label:
+                    continue
+                target = node.targets[0]
+                if not isinstance(target, ast.Name):
+                    continue  # self.x / container slot: managed elsewhere
+                yield from self._check_local(mod, fn, node, target.id,
+                                             label, finally_nodes)
+
+    def _check_local(self, mod, fn, assign, name: str, label: str,
+                     finally_nodes) -> Iterator:
+        closes: List[ast.AST] = []
+        transferred = False
+        in_with = False
+        for n in ast.walk(fn):
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    ce = item.context_expr
+                    if isinstance(ce, ast.Name) and ce.id == name:
+                        in_with = True
+            elif isinstance(n, ast.Call):
+                f = n.func
+                if isinstance(f, ast.Attribute) and \
+                        f.attr in ("close", "detach", "release") and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id == name:
+                    closes.append(n)
+                # bare handle passed to another call: ownership moves
+                for arg in list(n.args) + [k.value for k in n.keywords]:
+                    if isinstance(arg, ast.Name) and arg.id == name:
+                        transferred = True
+            elif isinstance(n, (ast.Return, ast.Yield, ast.YieldFrom)) \
+                    and isinstance(getattr(n, "value", None), ast.Name) \
+                    and n.value.id == name:
+                transferred = True
+            elif isinstance(n, ast.Assign):
+                # stored into an attribute/subscript/tuple: managed
+                # beyond this scope
+                if isinstance(n.value, ast.Name) and n.value.id == name:
+                    transferred = True
+            elif isinstance(n, ast.Await) and \
+                    isinstance(n.value, ast.Name) and \
+                    n.value.id == name:
+                transferred = True
+        if in_with or transferred:
+            return
+        if not closes:
+            yield self.diag(
+                mod, assign.lineno,
+                f"{label}(...) assigned to '{name}' but never closed "
+                f"in this scope — use with, or close in a finally")
+        elif not any(id(c) in finally_nodes for c in closes):
+            yield self.diag(
+                mod, assign.lineno,
+                f"{label}(...) assigned to '{name}' is closed only on "
+                f"the happy path — an exception before "
+                f"{name}.close() (line {closes[0].lineno}) leaks it; "
+                f"use with, or move the close into a finally")

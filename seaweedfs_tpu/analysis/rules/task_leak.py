@@ -1,0 +1,86 @@
+"""Fire-and-forget tasks: an asyncio task (or executor future) whose
+reference is dropped is collectable mid-flight, its exception vanishes,
+and shutdown can never cancel it."""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import FUNC_DEFS, walk_body
+from ..engine import Rule, register
+
+_SPAWNERS = ("create_task", "ensure_future", "run_in_executor")
+
+
+def _spawner(call: ast.Call) -> str:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr in _SPAWNERS:
+        return f.attr
+    if isinstance(f, ast.Name) and f.id in ("create_task",
+                                            "ensure_future"):
+        return f.id
+    return ""
+
+
+@register
+class TaskLeak(Rule):
+    name = "task-leak"
+    rationale = ("a create_task/ensure_future/run_in_executor result "
+                 "that nobody holds is GC-collectable mid-flight and "
+                 "swallows its exception; keep a reference (and an "
+                 "error path) or await it")
+    scope = ("seaweedfs_tpu/",)
+    fixture = (
+        "async def bad(self):\n"
+        "    asyncio.create_task(self._worker())\n"
+        "async def bad2(self, loop):\n"
+        "    t = loop.create_task(self._worker())\n"
+    )
+    clean_fixture = (
+        "async def good(self):\n"
+        "    self._task = asyncio.create_task(self._worker())\n"
+        "async def good2(self):\n"
+        "    t = asyncio.create_task(self._worker())\n"
+        "    self._tasks.add(t)\n"
+        "    t.add_done_callback(self._tasks.discard)\n"
+        "async def good3(self, loop):\n"
+        "    await loop.run_in_executor(None, self._sync)\n"
+        "async def good4(self):\n"
+        "    self._tasks.append(asyncio.create_task(self._worker()))\n"
+    )
+
+    def check_module(self, mod):
+        for fn in mod.walk():
+            if not isinstance(fn, FUNC_DEFS):
+                continue
+            yield from self._check_scope(mod, fn)
+
+    def _check_scope(self, mod, fn):
+        # names loaded anywhere in the function (incl. nested defs:
+        # closures legitimately capture task handles)
+        loads = {n.id for n in ast.walk(fn)
+                 if isinstance(n, ast.Name)
+                 and isinstance(n.ctx, ast.Load)}
+        for node in walk_body(fn):
+            if isinstance(node, ast.Expr) and \
+                    isinstance(node.value, ast.Call):
+                kind = _spawner(node.value)
+                if kind:
+                    yield self.diag(
+                        mod, node.lineno,
+                        f"{kind}(...) result discarded — the task is "
+                        f"GC-collectable mid-flight and its exception "
+                        f"vanishes; hold a reference and add an error "
+                        f"callback (or await it)")
+            elif isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                kind = _spawner(node.value)
+                if kind and node.targets[0].id not in loads:
+                    yield self.diag(
+                        mod, node.lineno,
+                        f"{kind}(...) assigned to "
+                        f"'{node.targets[0].id}' which is never used — "
+                        f"a write-only reference still loses the "
+                        f"exception and cancellation path")

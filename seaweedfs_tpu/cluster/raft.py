@@ -119,8 +119,14 @@ class RaftNode:
     # --- lifecycle ---
     async def start(self) -> None:
         import aiohttp
+
+        from .. import observe
+        # raft append/vote fan-out carries the ambient trace + priority
+        # headers like every other intra-cluster hop, so a slow commit
+        # shows its peer legs in cluster.trace
         self._session = aiohttp.ClientSession(
-            timeout=aiohttp.ClientTimeout(total=2.0))
+            timeout=aiohttp.ClientTimeout(total=2.0),
+            trace_configs=[observe.client_trace_config()])
         if not self.peers:
             self._become_leader()
         else:

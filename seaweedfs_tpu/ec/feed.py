@@ -314,8 +314,21 @@ class ShardFeed(_FeedBase):
         if use_mmap is None:
             use_mmap = use_mmap_default()
         self.shard_size = os.path.getsize(paths[0])
-        self._fds = [os.open(p, os.O_RDONLY) for p in paths]
-        self._sizes = [os.path.getsize(p) for p in paths]
+        # all-or-nothing open: a failure on survivor 7 of 10 (EMFILE, a
+        # shard deleted mid-plan) must close the fds already opened —
+        # __init__ raising means close() can never be called on us
+        self._fds: list[int] = []
+        try:
+            for p in paths:
+                self._fds.append(os.open(p, os.O_RDONLY))
+            self._sizes = [os.path.getsize(p) for p in paths]
+        except BaseException:
+            for fd in self._fds:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+            raise
         self._paths = list(paths)
         self._mms: list[Optional[mmap.mmap]] = [None] * self.k
         self._views: list[Optional[np.ndarray]] = [None] * self.k

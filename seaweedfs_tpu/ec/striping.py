@@ -41,6 +41,25 @@ def clamp_batch(batch_size: int, block_size: int) -> int:
     return b
 
 
+def _open_all(paths: list, mode: str) -> list:
+    """Open every path or none: a failure mid-way (EMFILE, ENOSPC, a
+    permission wall on shard 7 of 14) closes the handles already opened
+    before re-raising — the bare comprehension this replaces leaked
+    them with no reference left to close."""
+    files: list = []
+    try:
+        for p in paths:
+            files.append(open(p, mode))
+    except BaseException:
+        for f in files:
+            try:
+                f.close()
+            except OSError:
+                pass
+        raise
+    return files
+
+
 def stripe_segments(dat_size: int, g: Geometry,
                     batch_size: int) -> Iterator[tuple[list[int], int]]:
     """(k strided .dat offsets, width) per stripe batch, in shard-file
@@ -88,8 +107,8 @@ def write_ec_files(base_file_name: str, coder: ErasureCoder,
     g = geometry
     assert coder.k == g.data_shards and coder.m == g.parity_shards
     dat_size = os.path.getsize(base_file_name + ".dat")
-    outputs = [open(base_file_name + to_ext(i), "wb")
-               for i in range(g.total_shards)]
+    outputs = _open_all([base_file_name + to_ext(i)
+                         for i in range(g.total_shards)], "wb")
     try:
         with open(base_file_name + ".dat", "rb") as dat:
             remaining = dat_size
@@ -204,8 +223,15 @@ def rebuild_ec_files(base_file_name: str, coder: ErasureCoder,
         raise ValueError(
             f"need {g.data_shards} shards to rebuild, have {len(present)}")
 
-    inputs = {i: open(base_file_name + to_ext(i), "rb") for i in present}
-    outputs = {i: open(base_file_name + to_ext(i), "wb") for i in missing}
+    inputs = dict(zip(present, _open_all(
+        [base_file_name + to_ext(i) for i in present], "rb")))
+    try:
+        outputs = dict(zip(missing, _open_all(
+            [base_file_name + to_ext(i) for i in missing], "wb")))
+    except BaseException:
+        for f in inputs.values():
+            f.close()
+        raise
     try:
         shard_size = os.path.getsize(base_file_name + to_ext(present[0]))
         offset = 0
@@ -270,8 +296,8 @@ def write_dat_file(base_file_name: str, dat_size: int,
     """Reassemble .dat from data shards .ec00..ec09 by de-interleaving rows
     (WriteDatFile, ec_decoder.go:154-195)."""
     g = geometry
-    inputs = [open(base_file_name + to_ext(i), "rb")
-              for i in range(g.data_shards)]
+    inputs = _open_all([base_file_name + to_ext(i)
+                        for i in range(g.data_shards)], "rb")
     try:
         with open(base_file_name + ".dat", "wb") as dat:
             remaining = dat_size

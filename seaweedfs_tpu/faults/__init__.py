@@ -85,6 +85,26 @@ class Fault:
         return d
 
 
+# the declared fault-point registry: every point compiled into a hot
+# path is named here, and weedlint's fault-point-registry rule holds
+# the two sides together — a point fired in code but missing here is a
+# typo waiting to no-op a chaos drill (PR 5's silently no-oping fast
+# paths), and a point declared here that nothing fires is dead chaos
+# surface that tests believe in but nothing honors
+KNOWN_POINTS = frozenset({
+    "volume.read",          # volume server read path (incl. fastpath)
+    "volume.write",         # volume server write path (incl. fastpath)
+    "volume.replicate",     # replica fan-out
+    "master.assign",        # fid assignment (incl. fastpath listener)
+    "ec.shard_read",        # EC shard interval reads
+    "http_pool.request",    # pooled intra-cluster HTTP request
+    "http_pool.response",   # pooled response payload (corrupt target)
+    "lifecycle.warm",       # hot->warm transition
+    "lifecycle.unec",       # warm->hot un-EC transition
+    "lifecycle.expire",     # TTL whole-volume expiry
+    "lifecycle.encode",     # lifecycle-driven ec encode step
+})
+
 _lock = threading.Lock()
 _faults: list[Fault] = []
 _env_loaded = False

@@ -39,7 +39,7 @@ from typing import Optional
 import aiohttp
 from aiohttp import web
 
-from .. import overload
+from .. import observe, overload
 from ..utils.log_buffer import LogBuffer, LogEntry
 
 log = logging.getLogger("broker")
@@ -195,7 +195,10 @@ class BrokerServer:
             # connect/inactivity bounds, no total cap: publish
             # fan-out must not hang on a dead peer, long streams ok
             timeout=aiohttp.ClientTimeout(total=None, sock_connect=10,
-                                          sock_read=60))
+                                          sock_read=60),
+            # peer fan-out and filer segment flushes join the ambient
+            # trace like every other intra-cluster hop
+            trace_configs=[observe.client_trace_config()])
         if self.grpc_port:
             from .broker_grpc import serve_messaging_grpc
             host = (self.advertise_url.rsplit(":", 1)[0]

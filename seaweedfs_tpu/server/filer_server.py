@@ -36,7 +36,7 @@ from ..filer.entry import Entry, new_directory, new_file
 from ..filer.filer import Filer, _norm
 from ..filer.stores import create_store
 from ..filer.upload_window import UploadWindow
-from ..utils import metrics as metrics_mod
+from ..utils import glog, metrics as metrics_mod
 from ..utils.retry import RETRYABLE_STATUSES, is_shed, parse_retry_after
 
 log = logging.getLogger("filer.server")
@@ -816,8 +816,13 @@ class FilerServer:
         if self.chunk_cache._disk is None:
             self.chunk_cache.put(fid, data)
         else:
-            asyncio.get_event_loop().run_in_executor(
-                None, self.chunk_cache.put, fid, data)
+            # deliberately not awaited (the response must not wait on
+            # the disk tier), but never fire-and-forget: a full disk
+            # must show up in the log, not vanish with the future
+            glog.watch_future(
+                asyncio.get_event_loop().run_in_executor(
+                    None, self.chunk_cache.put, fid, data),
+                f"chunk-cache disk put {fid}")
 
     async def _fetch_view(self, fid: str, offset_in_chunk: int,
                           size: int, cipher_key: str = "",

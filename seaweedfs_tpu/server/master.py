@@ -35,7 +35,7 @@ from ..storage.file_id import FileId, new_cookie
 from ..storage.superblock import ReplicaPlacement
 from ..topology.sequence import MemorySequencer
 from ..topology.topology import Topology
-from ..utils import metrics as metrics_mod
+from ..utils import glog, metrics as metrics_mod
 
 log = logging.getLogger("master")
 
@@ -1182,8 +1182,13 @@ class MasterServer:
         )
         seen_key = body.get("max_file_key", 0)
         if getattr(self.sequencer, "blocking", False):
-            asyncio.get_event_loop().run_in_executor(
-                None, self.sequencer.set_max, seen_key)
+            # off-loop (blocking sequencers fsync), but a failed
+            # set_max silently regressing the sequencer would hand out
+            # duplicate fids later — the error must reach the log
+            glog.watch_future(
+                asyncio.get_event_loop().run_in_executor(
+                    None, self.sequencer.set_max, seen_key),
+                f"sequencer set_max({seen_key})")
         else:
             self.sequencer.set_max(seen_key)
         self._broadcast_location(event)

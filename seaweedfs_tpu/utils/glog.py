@@ -119,3 +119,22 @@ def fatal(msg: str, *args) -> None:
     _ensure()
     _logger.critical(msg, *args, stacklevel=2)
     raise SystemExit(255)
+
+
+def watch_future(fut, what: str):
+    """The blessed error path for a deliberately fire-and-forget future
+    (asyncio or concurrent.futures): retrieves the exception in a done
+    callback — so a failed background write is logged with context
+    instead of surfacing as asyncio's anonymous 'exception was never
+    retrieved' at GC time — and returns the future so the caller can
+    keep the reference weedlint's task-leak rule requires."""
+    def _done(f):
+        try:
+            exc = f.exception()
+        except BaseException:       # cancelled: nothing to report
+            return
+        if exc is not None:
+            error("background %s failed: %s", what, exc)
+
+    fut.add_done_callback(_done)
+    return fut

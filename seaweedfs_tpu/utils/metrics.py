@@ -96,7 +96,10 @@ class Registry:
         isn't hammered in lockstep by every server in the cluster."""
         import aiohttp
         failures = 0
-        async with aiohttp.ClientSession(
+        # the push gateway lives OUTSIDE the trace domain: no request
+        # context exists in this daemon and the gateway would only see
+        # (and store) meaningless per-push trace ids
+        async with aiohttp.ClientSession(  # weedlint: disable=ctx-propagation
                 timeout=aiohttp.ClientTimeout(total=30)) as session:
             while True:
                 try:

@@ -1,15 +1,13 @@
 """Guards for the servers' operational HTTP surface: every server class
 must register /metrics + /healthz (plus /debug/trace) and render them
-without error — refactors of _build_app can't silently drop them. Also a
-lint-style check that no module under seaweedfs_tpu/ uses bare print()
-instead of glog.
+without error — refactors of _build_app can't silently drop them.
+
+(The no-bare-print lint that used to live here is now weedlint's
+``bare-print`` rule, enforced by tests/test_weedlint.py.)
 """
 
-import io
 import json
-import pathlib
 import time
-import tokenize
 import urllib.request
 
 import pytest
@@ -79,24 +77,3 @@ def test_all_servers_serve_ops_surface(cluster, filer, gateways):
         assert "cumulative" in body, name
 
 
-def test_no_bare_print_under_package():
-    """Diagnostics must go through glog (utils/glog.py), not print() —
-    cli.py is exempt: its prints ARE the command-line output contract."""
-    pkg = pathlib.Path(__file__).resolve().parent.parent / "seaweedfs_tpu"
-    allowed = {pkg / "cli.py"}
-    offenders = []
-    for path in sorted(pkg.rglob("*.py")):
-        if path in allowed:
-            continue
-        toks = list(tokenize.generate_tokens(
-            io.StringIO(path.read_text()).readline))
-        for i, tok in enumerate(toks):
-            if tok.type == tokenize.NAME and tok.string == "print":
-                nxt = next((t for t in toks[i + 1:]
-                            if t.type not in (tokenize.NL,
-                                              tokenize.NEWLINE,
-                                              tokenize.COMMENT)), None)
-                if nxt is not None and nxt.string == "(":
-                    offenders.append(f"{path.relative_to(pkg)}:"
-                                     f"{tok.start[0]}")
-    assert not offenders, f"bare print() calls: {offenders}"
