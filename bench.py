@@ -133,13 +133,28 @@ def _load_partial(work: str, name: str) -> dict:
 
 
 def phase_encode(work: str) -> dict:
-    """Config 1/2: the staged-window encode sink, fresh process."""
+    """Config 1/2: the staged-window encode sink, fresh process.
+
+    Round 10: the steady state is measured over R full DISK->chip
+    re-feeds through the parallel host-feed tier (reader pool prefaults
+    pages concurrently, a stager pool keeps several H2D puts in flight)
+    with window dispatches pipelined across volumes — the multi-volume
+    encode-queue regime the production pipeline now runs
+    (pipeline.stream_encode_many). All re-feeds happen BEFORE the first
+    device->host read: one D2H flips this tunnel ~100x degraded."""
     import jax
 
     from seaweedfs_tpu import ec
     from seaweedfs_tpu.ec import pipeline
 
-    out: dict = {"backend": jax.default_backend()}
+    # force real parallelism even where cpu_count reports 1: the stages
+    # being overlapped are IO-bound (disk faults, tunnel copies), so
+    # extra threads add outstanding IOs, not CPU contention
+    READERS = max(2, min(4, os.cpu_count() or 1))
+    STAGERS = max(2, min(4, os.cpu_count() or 1))
+
+    out: dict = {"backend": jax.default_backend(),
+                 "feed": {"readers": READERS, "stagers": STAGERS}}
     out["link"] = measure_link()
 
     base = os.path.join(work, "1")
@@ -166,32 +181,61 @@ def phase_encode(work: str) -> dict:
         return orig(staged, acc)
 
     coder.encode_digest_window_async = capture
-    digest = pipeline.stream_encode_device_sink(
+    # materialize=False: the cold digest's 16-byte D2H would flip the
+    # tunnel degraded BEFORE the steady-state re-feeds below — hold the
+    # on-device acc and verify it with the other digests after the loop
+    acc_cold = pipeline.stream_encode_device_sink(
         base, coder, batch_size=BATCH_W, window_bytes=2 * VOL_BYTES,
-        stats=stats)
+        stats=stats, stagers=STAGERS, readers=READERS,
+        materialize=False)
+    block = getattr(acc_cold, "block_until_ready", None)
+    if block is not None:
+        block()
     cold_total = time.perf_counter() - t0
     out["ledger"] = stats
     out["cold_pass_s"] = round(cold_total, 2)  # includes program load
     _phase_checkpoint(work, "encode", out)
 
-    # ground truth from an independent host implementation — computed
-    # AFTER the timed staging so its full-volume read + host encode
-    # (~2s of cache/CPU churn) cannot perturb the measurement
+    # ground truth from an independent host implementation (HOST coder:
+    # no device work, no D2H) — computed AFTER the timed staging so its
+    # full-volume read + host encode (~2s of cache/CPU churn) cannot
+    # perturb the measurement
     t0 = time.perf_counter()
     want = pipeline.stream_encode_device_sink(
         base, _host_coder(), batch_size=BATCH_W, window_bytes=2 * VOL_BYTES)
     out["host_digest_s"] = round(time.perf_counter() - t0, 2)
-    if digest.tolist() != want.tolist():
-        raise AssertionError(f"sink digest {digest} != host {want}")
 
-    # steady state: the program is loaded, data staged — re-execute,
-    # PIPELINED. This is config 2's regime (1000 volumes reuse one
-    # program): volume N+1's dispatch issues while N executes, and the
-    # 16-byte digest materialize overlaps later volumes' compute. A
-    # single dispatch+block instead measures the tunnel's per-sync
-    # round-trip (~0.09-0.13s block + ~0.07s 16B D2H) — round 4's
-    # "9.7 GB/s in-window kernel" was exactly that artifact; the same
-    # executable sustains 36-41 GB/s once dispatches chain.
+    # steady state, round 10: R full disk -> host -> HBM -> kernel
+    # re-feeds back-to-back through the parallel feed tier with
+    # materialization deferred (multi-volume window batching: volume
+    # N+1's reads/stages overlap volume N's window execution). Runs
+    # BEFORE any D2H so the tunnel stays healthy for every rep; digests
+    # verify after the loop.
+    R2 = 3
+    rep_stats: list = []
+    accs: list = []
+    t0 = time.perf_counter()
+    for _ in range(R2):
+        st: dict = {}
+        accs.append(pipeline.stream_encode_device_sink(
+            base, coder, batch_size=BATCH_W, window_bytes=2 * VOL_BYTES,
+            stats=st, materialize=False, stagers=STAGERS,
+            readers=READERS))
+        rep_stats.append(st)
+    block = getattr(accs[-1], "block_until_ready", None)
+    if block is not None:
+        block()  # device executes in dispatch order
+    refeed_wall = time.perf_counter() - t0
+    per_volume_s = refeed_wall / R2
+    out["steady_state_volume_s"] = round(per_volume_s, 3)
+    out["steady_state_reps"] = R2
+    out["value_gbps"] = round(VOL_BYTES / per_volume_s / 1e9, 2)
+    out["refeed_ledgers"] = rep_stats
+    _phase_checkpoint(work, "encode", out)
+
+    # in-window execution rate: the program is loaded, data staged —
+    # re-execute, PIPELINED (config 2's program-reuse regime). A single
+    # dispatch+block instead measures the tunnel's per-sync round-trip.
     R = 5
     acc_r = None
     t0 = time.perf_counter()
@@ -201,12 +245,23 @@ def phase_encode(work: str) -> dict:
     exec_s = (time.perf_counter() - t0) / R
     out["exec_steady_s"] = round(exec_s, 4)
     out["exec_steady_reps"] = R
+    # --- first D2H below: the tunnel may degrade from here on; every
+    # rate above is already measured and checkpointed ---
+    d_cold = np.asarray(coder.materialize(acc_cold), dtype=np.uint32)
+    if d_cold.tolist() != want.tolist():
+        raise AssertionError(f"sink digest {d_cold} != host {want}")
     # after R chained windows over the same data the wrapping digest is
     # R * want mod 2^32 — a correctness check on the pipelined loop
     d2 = np.asarray(coder.materialize(acc_r), dtype=np.uint32)
     want_r = (want.astype(np.uint64) * R & 0xFFFFFFFF).astype(np.uint32)
     if d2.tolist() != want_r.tolist():
         raise AssertionError("pipelined steady digest mismatch")
+    # every re-feed's digest must equal the host digest (fresh acc per
+    # rep): the steady-state loop provably performed the full encode
+    for a in accs:
+        d = np.asarray(coder.materialize(a), dtype=np.uint32)
+        if d.tolist() != want.tolist():
+            raise AssertionError(f"re-feed digest {d} != host {want}")
     # per-rep sync cost, reported for transparency (latency, not rate)
     t0 = time.perf_counter()
     acc1 = orig(saved["staged"])
@@ -215,16 +270,18 @@ def phase_encode(work: str) -> dict:
     if d1.tolist() != want.tolist():
         raise AssertionError("steady-state digest mismatch")
 
-    stage_wall = stats["read_wait_s"] + stats["stage_s"]
-    per_volume_s = stage_wall + exec_s
-    out["steady_state_volume_s"] = round(per_volume_s, 3)
-    out["value_gbps"] = round(VOL_BYTES / per_volume_s / 1e9, 2)
     # measured feed-stage breakdown, one number per pipeline stage, so
     # future rounds see which stage binds without re-deriving it from the
-    # ledger (write is None here: the device sink writes no shard files)
+    # ledger (write is None here: the device sink writes no shard files);
+    # medians over the steady-state re-feeds
+    def med(key: str) -> float:
+        vals = sorted(s.get(key) or 0.0 for s in rep_stats)
+        return vals[len(vals) // 2]
+
+    read_s, h2d_s = med("read_wait_s"), med("stage_s")
     out["feed_stages_s"] = {
-        "read": stats.get("read_wait_s"),
-        "h2d": stats.get("stage_s"),
+        "read": round(read_s, 3),
+        "h2d": round(h2d_s, 3),
         "kernel": round(exec_s, 4),
         "write": None,
     }
@@ -232,24 +289,25 @@ def phase_encode(work: str) -> dict:
 
     # arithmetic bound from measured parts: the pipeline cannot beat its
     # slowest stage; on a healthy host H2D is not the binding stage
-    stage_gbps = stats.get("stage_gbps") or 0.0
+    stage_gbps = (VOL_BYTES / h2d_s / 1e9) if h2d_s > 1e-3 else None
     kernel_gbps = VOL_BYTES / exec_s / 1e9
-    disk_gbps = (VOL_BYTES / stats["read_wait_s"] / 1e9
-                 if stats["read_wait_s"] > 1e-3 else None)
+    disk_gbps = (VOL_BYTES / read_s / 1e9) if read_s > 1e-3 else None
     out["component_rates_gbps"] = {
         "disk_read": round(disk_gbps, 2) if disk_gbps else None,
-        "h2d_stage": round(stage_gbps, 2),
+        "h2d_stage": round(stage_gbps, 2) if stage_gbps else None,
         "kernel_window": round(kernel_gbps, 2),
     }
     # chip-side capability (the BASELINE north star is GB/s/CHIP): the
     # window executable — H2D-fed compute incl. the digest reduction —
     # measured with pipelined dispatches. Host-side stages are reported
-    # separately: the disk feed is this 1-core container's page-cache
-    # memcpy ceiling (a host property — real TPU hosts feed from many
-    # cores), and H2D here is the tunnel, not a PCIe/DMA link.
+    # separately: the reader pool + stager pool now overlap disk reads
+    # with the H2D copies (the old 1-core serial feed is gone), and H2D
+    # here is the tunnel, not a PCIe/DMA link.
     out["chip_encode_gbps"] = round(kernel_gbps, 2)
-    healthy = {"disk_read (1-core host feed)": disk_gbps,
-               "kernel_window (chip)": kernel_gbps}
+    healthy = {
+        f"disk_read (reader pool x{READERS})": disk_gbps,
+        "kernel_window (chip)": kernel_gbps,
+    }
     healthy = {k: v for k, v in healthy.items() if v}
     if healthy:
         binding = min(healthy, key=healthy.get)
@@ -312,10 +370,16 @@ def phase_rebuild(work: str, budget_s: float = 580.0) -> dict:
     def ckpt() -> None:
         _phase_checkpoint(work, "rebuild", out)
 
+    # checkpoint from second zero: a wedge ANYWHERE (BENCH_r05 recorded
+    # only {"error": ...} because the phase died before its first
+    # checkpoint) must still leave a partial record for the driver
+    ckpt()
     base = os.path.join(work, "1")
     want = pipeline.shard_file_digest(base, VICTIMS)
 
     shard_size = os.path.getsize(base + ec.to_ext(0))
+    out["shard_size"] = shard_size
+    ckpt()
 
     # jax (XLA bitplane) coder here: its rec-window program is the one
     # round 4 proved completes through this tunnel. The pallas rec
@@ -327,32 +391,42 @@ def phase_rebuild(work: str, budget_s: float = 580.0) -> dict:
 
     present = [i for i in range(14) if i not in VICTIMS]
     survivors = tuple(present[:10])
+    READERS = max(2, min(4, os.cpu_count() or 1))
     src = feed_mod.ShardFeed([base + ec.to_ext(i) for i in survivors],
-                             BATCH_W, pooled=False)
+                             BATCH_W, pooled=False, readers=READERS)
 
     def read_batches() -> list:
         """7 x [k, 16MB] batches per volume — the round-4-proven window
         shape for the XLA rec program (a single [k, shard_size] batch
         would blow HBM: the bitplane formulation materializes ~25x the
-        input in intermediates). Zero-copy feed: mmap'd page-cache
-        assembly, no per-row pread/bytes churn (ec/feed.py)."""
+        input in intermediates). Parallel feed: the reader pool splits
+        each batch's survivor-row reads across threads (ec/feed.py)."""
         return list(src.batches(BATCH_W, pad_final=True))
 
     # --- stage N volumes (healthy link: nothing has compiled yet).
     # A reader thread keeps one volume of host batches ahead, so disk
     # reads overlap device staging (pread + device transfer both release
     # the GIL); the steady per-volume cost is max(read, stage), as in
-    # the production pipeline's reader/stager split. ---
+    # the production pipeline's reader/stager split.
+    # Budget discipline (round 10): N scales down on a tight budget,
+    # each staged volume checkpoints IMMEDIATELY, and staging stops
+    # early (keeping >= 2 volumes) if a degraded tunnel burns the
+    # clock — BENCH_r05's 650s timeout died inside this loop with
+    # nothing checkpointed at all. ---
     import queue as queue_mod
     import threading
 
-    N_BATCHED = 6  # 6 x 1.12GB staged concurrently fits a v5e's HBM
+    # 6 x 1.12GB staged concurrently fits a v5e's HBM
+    N_BATCHED = 6 if left() > 300 else 3
     _warm_stage((10, BATCH_W))
     read_q: "queue_mod.Queue" = queue_mod.Queue(maxsize=2)
     read_meter = {"s": 0.0}
+    stop_reading = threading.Event()
 
     def reader_main() -> None:
         for _ in range(N_BATCHED):
+            if stop_reading.is_set():
+                break
             tr = time.perf_counter()
             hb = read_batches()
             read_meter["s"] += time.perf_counter() - tr
@@ -374,17 +448,46 @@ def phase_rebuild(work: str, budget_s: float = 580.0) -> dict:
                 block()
             sv.append(h)
         staged_vols.append(sv)
+        out["ledger"] = {
+            "n_volumes_staged": len(staged_vols),
+            "read_s": round(read_meter["s"], 2),
+            "stage_all_s": round(time.perf_counter() - t0, 2),
+        }
+        ckpt()
+        if len(staged_vols) >= 2 and left() < 150:
+            # a degraded tunnel is eating the budget: stop staging and
+            # measure with what we have (the numbers matter more than N)
+            stop_reading.set()
+            out.setdefault("skipped", []).append(
+                f"staging volumes {len(staged_vols) + 1}..{N_BATCHED} "
+                "(budget)")
+    n_staged = len(staged_vols)
     stage_all_s = time.perf_counter() - t0
-    stage_per_volume_s = stage_all_s / N_BATCHED
+    stage_per_volume_s = stage_all_s / max(n_staged, 1)
     out["ledger"] = {
-        "n_volumes_staged": N_BATCHED,
+        "n_volumes_staged": n_staged,
         "read_s": round(read_meter["s"], 2),
         "stage_all_s": round(stage_all_s, 2),
         "stage_per_volume_s": round(stage_per_volume_s, 3),
         "stage_gbps": round(
-            N_BATCHED * 10 * shard_size / stage_all_s / 1e9, 2),
+            n_staged * 10 * shard_size / stage_all_s / 1e9, 2),
     }
     src.close()
+    ckpt()
+
+    # --- AOT-warm the rec window program, checkpointed as its own step:
+    # the dynamic-matrix window executable is the SAME program
+    # phase_encode compiled into the shared persistent cache, so this is
+    # normally a disk-cache hit measured in seconds — and when it ISN'T
+    # (cold cache, wedge-prone remote compile), the phase dies in a step
+    # whose absence from the partial record names the culprit ---
+    try:
+        t0 = time.perf_counter()
+        coder.warm_rec_digest_window(survivors, tuple(VICTIMS),
+                                     len(staged_vols[0]), (10, BATCH_W))
+        out["rec_warm_s"] = round(time.perf_counter() - t0, 2)
+    except Exception as e:  # advisory: dispatch compiles lazily instead
+        out["rec_warm_error"] = str(e)[:300]
     ckpt()
 
     # --- first dispatch: one window through the SHARED dynamic-matrix
@@ -408,7 +511,8 @@ def phase_rebuild(work: str, budget_s: float = 580.0) -> dict:
         accs.append(coder.rec_digest_window_async(
             survivors, tuple(VICTIMS), sv))
     accs[-1].block_until_ready()  # TPU executes in dispatch order
-    exec_s = (time.perf_counter() - t0) / (N_BATCHED - 1)
+    exec_s = ((time.perf_counter() - t0) / (n_staged - 1)
+              if n_staged > 1 else cold_exec_s)
     out["exec_steady_s"] = round(exec_s, 4)
 
     p50 = stage_per_volume_s + exec_s
@@ -465,13 +569,13 @@ def phase_rebuild(work: str, budget_s: float = 580.0) -> dict:
     # --- BASELINE config 3 batch summary + amortization curve ---
     load_s = max(cold_exec_s - exec_s, 0.0)
     batch = {
-        str(N_BATCHED): {
+        str(n_staged): {
             "wall_s": round(stage_all_s + cold_exec_s
-                            + exec_s * (N_BATCHED - 1), 2),
-            "per_volume_s": round(p50 + load_s / N_BATCHED, 3),
+                            + exec_s * (n_staged - 1), 2),
+            "per_volume_s": round(p50 + load_s / n_staged, 3),
             "gbps_aggregate": round(
-                10 * shard_size * N_BATCHED
-                / (stage_all_s + cold_exec_s + exec_s * (N_BATCHED - 1))
+                10 * shard_size * n_staged
+                / (stage_all_s + cold_exec_s + exec_s * (n_staged - 1))
                 / 1e9, 2),
         },
         "amortization_model": {
@@ -577,15 +681,26 @@ def phase_kernel(budget_s: float = 390.0) -> dict:
             "are 33-37 GB/s")
     last = max(45.0, time.perf_counter() - t0)
 
-    # 2) geometry sweep — every cell before any optional extra
+    # 2) geometry sweep — every cell before any optional extra. A cell
+    # that can't run records WHY as a string ("skipped: ..."/"error: ...")
+    # instead of a bare null, so trajectory diffs across rounds stay
+    # machine-comparable (BENCH_r05 recorded "131072": null with no way
+    # to tell budget-skip from compile failure).
     sweep: dict = {}
     for (k, m) in ((20, 4), (12, 4), (6, 3)):
         if left() < last * 1.2:
-            sweep[f"{k},{m}"] = None
+            sweep[f"{k},{m}"] = (f"skipped: budget ({left():.0f}s left, "
+                                 f"cell needs ~{last * 1.2:.0f}s)")
             continue
         t0 = time.perf_counter()
         nn = n - n % (16384 * 8)
-        g, _, _ = bench_kernel(k, m, nn, reps)
+        try:
+            g, _, _ = bench_kernel(k, m, nn, reps)
+        except Exception as e:
+            sweep[f"{k},{m}"] = (f"error: {type(e).__name__}: "
+                                 f"{str(e)[:160]}")
+            last = max(45.0, time.perf_counter() - t0)
+            continue
         last = max(45.0, time.perf_counter() - t0)
         sweep[f"{k},{m}"] = round(g, 2)
     out["sweep_kernel_gbps"] = sweep
@@ -596,10 +711,16 @@ def phase_kernel(budget_s: float = 390.0) -> dict:
         if tl in tiles:
             continue
         if left() < last * 1.2:
-            tiles[tl] = None
+            tiles[tl] = (f"skipped: budget ({left():.0f}s left, "
+                         f"cell needs ~{last * 1.2:.0f}s)")
             continue
         t0 = time.perf_counter()
-        g, _, _ = bench_kernel(10, 4, n, reps, tile=tl)
+        try:
+            g, _, _ = bench_kernel(10, 4, n, reps, tile=tl)
+        except Exception as e:
+            tiles[tl] = f"error: {type(e).__name__}: {str(e)[:160]}"
+            last = max(45.0, time.perf_counter() - t0)
+            continue
         last = max(45.0, time.perf_counter() - t0)
         tiles[tl] = round(g, 2)
     out["tile_sweep_gbps"] = tiles

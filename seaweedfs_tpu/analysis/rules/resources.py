@@ -40,6 +40,7 @@ class ResourceLeak(Rule):
                  "finally")
     scope = ("seaweedfs_tpu/",)
     fixture = (
+        "import mmap\n"
         "import os\n"
         "def bad(p):\n"
         "    fh = open(p)\n"
@@ -50,8 +51,16 @@ class ResourceLeak(Rule):
         "    open(p)\n"                # opened and dropped
         "def bad3(self, paths):\n"
         "    self._fds = [os.open(p, os.O_RDONLY) for p in paths]\n"
+        # the reader-pool shape: a worker that maps its source then runs
+        # fill jobs — any job raising leaks the map (happy-path close)
+        "def bad4(fd, jobs):\n"
+        "    mm = mmap.mmap(fd, 0, mmap.MAP_SHARED, mmap.PROT_READ)\n"
+        "    for job in jobs:\n"
+        "        job.fill(memoryview(mm))\n"
+        "    mm.close()\n"
     )
     clean_fixture = (
+        "import os\n"
         "def good(p):\n"
         "    with open(p) as fh:\n"
         "        return fh.read()\n"
@@ -69,6 +78,20 @@ class ResourceLeak(Rule):
         "def good5(p, sink):\n"
         "    fh = open(p)\n"
         "    sink.adopt(fh)\n"         # ownership transferred
+        # the reader pool's all-or-nothing fd open (ec/feed.py
+        # ShardFeed/_DirectReader): append-in-loop with BaseException
+        # cleanup is the sanctioned multi-open shape — no comprehension,
+        # every already-opened fd closed before the raise propagates
+        "def good6(self, paths):\n"
+        "    fds = []\n"
+        "    try:\n"
+        "        for p in paths:\n"
+        "            fds.append(os.open(p, os.O_RDONLY))\n"
+        "    except BaseException:\n"
+        "        for fd in fds:\n"
+        "            os.close(fd)\n"
+        "        raise\n"
+        "    self._fds = fds\n"
     )
 
     def check_module(self, mod):

@@ -1,6 +1,7 @@
 from .coder import ErasureCoder, JaxCoder, NumpyCoder, get_coder, register_coder
 from .ec_volume import EcShard, EcVolume, rebuild_ecx_file
-from .geometry import DEFAULT, Geometry, to_ext
+from .geometry import (DEFAULT, MAX_TOTAL_SHARDS, Geometry, GeometryPolicy,
+                       parse_geometry, to_ext)
 from .locate import Interval, locate_data
 from .striping import (find_dat_file_size, iterate_ecj_file, iterate_ecx_file,
                        rebuild_ec_files, write_dat_file, write_ec_files,
@@ -9,7 +10,8 @@ from .striping import (find_dat_file_size, iterate_ecj_file, iterate_ecx_file,
 __all__ = [
     "ErasureCoder", "JaxCoder", "NumpyCoder", "get_coder", "register_coder",
     "EcShard", "EcVolume", "rebuild_ecx_file",
-    "DEFAULT", "Geometry", "to_ext",
+    "DEFAULT", "MAX_TOTAL_SHARDS", "Geometry", "GeometryPolicy",
+    "parse_geometry", "to_ext",
     "Interval", "locate_data",
     "find_dat_file_size", "iterate_ecj_file", "iterate_ecx_file",
     "rebuild_ec_files", "write_dat_file", "write_ec_files",

@@ -4,11 +4,30 @@ The reference hard-codes RS(10,4) with 1GB large / 1MB small blocks
 (weed/storage/erasure_coding/ec_encoder.go:17-23); here geometry is a value
 so the variable-geometry sweep (BASELINE config 4) and the shrunk-geometry
 test trick (reference ec_test.go:16-19) are first-class.
+
+Round 10 adds the per-collection geometry POLICY: ``WEED_EC_GEOMETRY``
+maps collections to RS(k,m), e.g.::
+
+    WEED_EC_GEOMETRY="default=10+4,archive=20+4,media=12+4"
+
+Wider geometries pay: the bitplane kernel's expand/repack cost amortizes
+over k, so RS(20,4) clears 60+ GB/s where RS(10,4) caps near 52 (kernel
+sweep, BENCH_r05) — at a durability profile archival collections happily
+take (any 4 of 24 lost). The policy is validated by the master at
+startup (a bad spec must kill the process, not mis-stripe a volume) and
+plumbed assign -> encode plan -> the per-volume ``.ecm`` sidecar ->
+rebuild, so a REBUILD never consults the policy at all: the geometry a
+volume was encoded under travels with its shards.
 """
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
+
+# ShardBits is a uint32 holdings bitmask (ec/shard_bits.py) and the
+# repair planner counts live shards through it: k+m must fit 32 bits
+MAX_TOTAL_SHARDS = 32
 
 
 @dataclass(frozen=True)
@@ -41,3 +60,87 @@ DEFAULT = Geometry()
 def to_ext(shard_id: int) -> str:
     """Shard file extension: .ec00 ... .ec13 (ec_encoder.go ToExt)."""
     return f".ec{shard_id:02d}"
+
+
+def parse_geometry(spec: str) -> Geometry:
+    """'k+m' (or 'k,m') -> Geometry with the default block sizes.
+    Raises ValueError on anything a cluster must refuse to run with."""
+    s = spec.strip().replace(",", "+")
+    parts = s.split("+")
+    if len(parts) != 2:
+        raise ValueError(f"bad EC geometry {spec!r} (want 'k+m')")
+    try:
+        k, m = int(parts[0]), int(parts[1])
+    except ValueError:
+        raise ValueError(f"bad EC geometry {spec!r} (want 'k+m')")
+    if k < 1 or m < 1:
+        raise ValueError(
+            f"EC geometry {spec!r}: k and m must both be >= 1")
+    if k + m > MAX_TOTAL_SHARDS:
+        raise ValueError(
+            f"EC geometry {spec!r}: k+m = {k + m} exceeds "
+            f"{MAX_TOTAL_SHARDS} (ShardBits is a uint32 bitmask)")
+    return Geometry(data_shards=k, parity_shards=m)
+
+
+class GeometryPolicy:
+    """Per-collection RS(k,m) mapping with a default. Immutable after
+    parse; lookups never fail (unknown collections get the default)."""
+
+    def __init__(self, per_collection: "dict[str, Geometry] | None" = None,
+                 default: Geometry = DEFAULT):
+        self.default = default
+        self.per_collection = dict(per_collection or {})
+
+    @classmethod
+    def parse(cls, spec: str) -> "GeometryPolicy":
+        """'default=10+4,archive=20+4' (a bare 'k+m' sets the default).
+        Raises ValueError — callers validate at startup, loudly."""
+        default = DEFAULT
+        mapping: dict[str, Geometry] = {}
+        for entry in (spec or "").split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            if "=" in entry:
+                name, _, geo = entry.partition("=")
+                name = name.strip()
+            else:
+                name, geo = "default", entry
+            g = parse_geometry(geo)
+            if name in ("default", "*", ""):
+                default = g
+            elif name in mapping:
+                raise ValueError(
+                    f"EC geometry policy names collection {name!r} twice")
+            else:
+                mapping[name] = g
+        return cls(mapping, default)
+
+    @classmethod
+    def from_env(cls) -> "GeometryPolicy":
+        return cls.parse(os.environ.get("WEED_EC_GEOMETRY", ""))
+
+    def for_collection(self, collection: str = "") -> Geometry:
+        return self.per_collection.get(collection or "", self.default)
+
+    def to_dict(self) -> dict:
+        """{'default': 'k+m', collections...} — the wire form the master
+        serves in /dir/status and the shell planners read back."""
+        out = {"default":
+               f"{self.default.data_shards}+{self.default.parity_shards}"}
+        for name, g in sorted(self.per_collection.items()):
+            out[name] = f"{g.data_shards}+{g.parity_shards}"
+        return out
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "GeometryPolicy":
+        default = DEFAULT
+        mapping: dict[str, Geometry] = {}
+        for name, geo in (d or {}).items():
+            g = parse_geometry(str(geo))
+            if name == "default":
+                default = g
+            else:
+                mapping[name] = g
+        return cls(mapping, default)

@@ -32,7 +32,10 @@ Env knobs (all optional):
   WEED_EC_BATCH_MIN/MAX         batch bounds          (1 MiB / 64 MiB)
   WEED_EC_DEPTH_MIN/MAX         depth bounds          (2 / 8)
   WEED_EC_HOST_BUDGET_MB        pooled staging budget (512 MiB)
+  WEED_EC_READERS               starting reader-pool width (cores, <=4)
+  WEED_EC_READERS_MIN/MAX       reader bounds         (1 / min(8, cores))
   WEED_EC_MMAP=0                force the preadv feed (see ec/feed.py)
+  WEED_EC_ODIRECT=1             page-cache-bypassing reads (ec/feed.py)
 """
 
 from __future__ import annotations
@@ -43,6 +46,7 @@ from typing import NamedTuple
 
 from .. import observe
 from ..utils import metrics as metrics_mod
+from . import feed as feed_mod
 
 MB = 1024 * 1024
 
@@ -59,6 +63,7 @@ class OperatingPoint(NamedTuple):
     batch_size: int
     depth: int        # read + materialize queue depth
     write_depth: int  # per-shard-file writer queue depth
+    readers: int = 1  # feed reader-pool width (ec/feed.py)
 
 
 # per-batch read time below this is dispatch/syscall-overhead-dominated:
@@ -80,11 +85,16 @@ class FeedGovernor:
         self.depth_min = _env_int("WEED_EC_DEPTH_MIN", 2)
         self.depth_max = _env_int("WEED_EC_DEPTH_MAX", 8)
         self.budget = _env_int("WEED_EC_HOST_BUDGET_MB", 512) * MB
+        self.readers_min = _env_int("WEED_EC_READERS_MIN", 1)
+        self.readers_max = _env_int(
+            "WEED_EC_READERS_MAX", max(1, min(8, os.cpu_count() or 1)))
         self._batch = min(max(_env_int("WEED_EC_BATCH_BYTES", 8 * MB),
                               self.batch_min), self.batch_max)
         self._depth = min(max(_env_int("WEED_EC_DEPTH", 4),
                               self.depth_min), self.depth_max)
         self._write_depth = self._depth
+        self._readers = min(max(feed_mod.reader_count_default(),
+                                self.readers_min), self.readers_max)
         self.metrics = metrics_mod.shared("ec")
         self.stage_gbps: dict[str, float] = {}
         self.runs = 0
@@ -104,7 +114,8 @@ class FeedGovernor:
                     depth -= 1
                 else:
                     break
-            op = OperatingPoint(batch, depth, self._write_depth)
+            op = OperatingPoint(batch, depth, self._write_depth,
+                                self._readers)
             self._export(op)
             return op
 
@@ -145,7 +156,7 @@ class FeedGovernor:
             if self.enabled:
                 self._retune(stages, op)
             self._export(OperatingPoint(self._batch, self._depth,
-                                        self._write_depth))
+                                        self._write_depth, self._readers))
 
     def _retune(self, stages: dict[str, tuple[int, float]],
                 op: OperatingPoint) -> None:
@@ -162,8 +173,14 @@ class FeedGovernor:
                 # reads finish faster than their fixed per-batch costs:
                 # wider batches amortize syscalls/dispatches
                 self._batch = min(op.batch_size * 2, self.batch_max)
+            elif share > _BIND_FRACTION and op.readers < self.readers_max:
+                # genuinely read-bound: widen the reader pool FIRST —
+                # parallel preads/page-faults add disk bandwidth, while
+                # deeper prefetch only smooths bursts
+                self._readers = min(max(op.readers * 2, 2),
+                                    self.readers_max)
             elif share > _BIND_FRACTION and op.depth < self.depth_max:
-                # genuinely read-bound: deeper prefetch smooths bursts
+                # reader pool maxed: deeper prefetch smooths bursts
                 self._depth = min(op.depth + 1, self.depth_max)
         elif slowest in ("kernel", "dispatch"):
             if share > _BIND_FRACTION and op.depth < self.depth_max:
@@ -189,6 +206,7 @@ class FeedGovernor:
                            labels={"queue": "materialize"})
         self.metrics.gauge("feed_queue_depth", op.write_depth,
                            labels={"queue": "write"})
+        self.metrics.gauge("feed_reader_threads", op.readers)
         self.metrics.gauge("feed_governor_enabled", 1.0 if self.enabled
                            else 0.0)
         self.metrics.gauge("feed_runs", self.runs)

@@ -70,7 +70,17 @@ def _resolve_op(batch_size: Optional[int], depth: Optional[int],
         return governor.get().plan(nbytes, k), True
     b = batch_size if batch_size is not None else DEFAULT_BATCH_SIZE
     d = depth if depth is not None else DEFAULT_DEPTH
-    return governor.OperatingPoint(b, d, d), False
+    return governor.OperatingPoint(b, d, d,
+                                   feed_mod.reader_count_default()), False
+
+
+def stager_count_default() -> int:
+    """WEED_EC_STAGERS: concurrent device_put threads for the staged-
+    window sink (device_put releases the GIL, so stagers overlap the
+    H2D copies with the reader pool's page faults instead of
+    serializing fault -> copy -> fault). Same env rule as the reader
+    pool: positive = clamped, unset/0 = one per core up to 4."""
+    return feed_mod.env_thread_count("WEED_EC_STAGERS", 16)
 
 
 class _FanOut:
@@ -300,20 +310,28 @@ def _run_pipeline(batches: Iterator[np.ndarray], dispatch, consume,
 def stream_encode(base_file_name: str, coder: ErasureCoder,
                   geometry: Geometry = DEFAULT,
                   batch_size: Optional[int] = None,
-                  depth: Optional[int] = None) -> None:
+                  depth: Optional[int] = None,
+                  _op: "governor.OperatingPoint | None" = None) -> None:
     """Encode <base>.dat into shard files with the overlapped pipeline.
 
     Byte-identical output to striping.write_ec_files (WriteEcFiles,
     ec_encoder.go:57) — only the schedule differs. batch_size/depth
     default to the adaptive governor's operating point; passing them
-    explicitly pins the schedule and skips retuning.
+    explicitly pins the schedule and skips retuning. _op pins a full
+    operating point (stream_encode_many shares one across a window and
+    does the window-level finish_run itself).
     """
     g = geometry
     assert coder.k == g.data_shards and coder.m == g.parity_shards
     dat_size = os.path.getsize(base_file_name + ".dat")
-    op, governed = _resolve_op(batch_size, depth, dat_size, g.data_shards)
+    if _op is not None:
+        op, governed = _op, False
+    else:
+        op, governed = _resolve_op(batch_size, depth, dat_size,
+                                   g.data_shards)
     src = feed_mod.open_feed(base_file_name + ".dat", g.data_shards,
-                             op.batch_size, pool_buffers=op.depth + 2)
+                             op.batch_size, pool_buffers=op.depth + 2,
+                             readers=op.readers)
     fan = _FanOut([base_file_name + to_ext(i) for i in range(g.total_shards)],
                   op.write_depth)
     # per-stage spans share the caller's trace (volume server passes its
@@ -346,10 +364,38 @@ def stream_encode(base_file_name: str, coder: ErasureCoder,
     if fan.errors:
         raise fan.errors[0]
     from .striping import write_layout_marker
-    write_layout_marker(base_file_name, dat_size)
+    write_layout_marker(base_file_name, dat_size, g)
     if governed:
         governor.get().finish_run(tctx.trace_id, op, dat_size,
                                   g.data_shards)
+
+
+def stream_encode_many(base_file_names: Sequence[str], coder: ErasureCoder,
+                       geometry: Geometry = DEFAULT,
+                       batch_size: Optional[int] = None,
+                       depth: Optional[int] = None) -> int:
+    """Encode N volumes back-to-back through ONE governed operating
+    point — the encode-queue regime (lifecycle daemon batches, `ec.encode`
+    multi-volume plans). The operating point is planned once for the
+    whole window, so every volume feeds the same [k, B] batch shape and
+    the coder's jit cache serves ONE executable for all of them (no
+    per-volume recompiles, no per-volume program loads); the governor
+    retunes once from the window's aggregate read/h2d/kernel/write
+    spans. Returns the number of volumes encoded."""
+    g = geometry
+    bases = [b for b in base_file_names]
+    if not bases:
+        return 0
+    total = sum(os.path.getsize(b + ".dat") for b in bases)
+    op, governed = _resolve_op(batch_size, depth, total, g.data_shards)
+    tctx = observe.ensure_ctx("ec")
+    for base in bases:
+        with observe.stage("ec.volume", tctx, tags={"base": base}):
+            observe.run_with(tctx, stream_encode, base, coder, g,
+                             _op=op)
+    if governed:
+        governor.get().finish_run(tctx.trace_id, op, total, g.data_shards)
+    return len(bases)
 
 
 # staged window default: bounded so a >HBM volume streams in windows; one
@@ -359,7 +405,8 @@ DEFAULT_WINDOW_BYTES = 2 * 1024 * 1024 * 1024
 
 def _windowed_digest_sink(batches: Iterator[np.ndarray], dispatch_window,
                           stage, depth: int, window_bytes: int,
-                          stats: dict | None) -> object:
+                          stats: dict | None,
+                          stagers: Optional[int] = None) -> object:
     """The latency-aware sink schedule (round 4).
 
     Round 3 interleaved one digest dispatch per batch with the H2D puts;
@@ -368,7 +415,11 @@ def _windowed_digest_sink(batches: Iterator[np.ndarray], dispatch_window,
     ran at per-op latency (0.02 GB/s), not link bandwidth. This schedule:
 
       reader thread -> host batches (bounded queue, disk overlaps staging)
-      main thread   -> stage_async each batch (H2D only, healthy link)
+      stager pool   -> stage_async each batch (H2D only, healthy link);
+                       `stagers` > 1 keeps several device_puts in flight
+                       (each releases the GIL) so the H2D copies overlap
+                       the reader pool's page faults instead of
+                       serializing fault -> copy -> fault on one thread
       window full   -> ONE multi-batch digest executable per window
 
     Within a window no kernel runs between transfers, and launch latency
@@ -376,12 +427,14 @@ def _windowed_digest_sink(batches: Iterator[np.ndarray], dispatch_window,
     overlaps window N's (async) kernels — the schedule costs nothing.
 
     Fills `stats` (when given) with a measured components ledger:
-    read-wait, stage seconds/bytes, dispatch and materialize-wait seconds,
+    read-wait, stage seconds/bytes (plus the overlapped staging WALL
+    span when stagers > 1), dispatch and materialize-wait seconds,
     batch/window counts — enough to compute each phase's rate and bound
     the pipeline arithmetically.
     """
     import time
 
+    stagers = stagers if stagers is not None else stager_count_default()
     read_q: queue.Queue = queue.Queue(maxsize=depth)
     errors: list[BaseException] = []
     tctx = observe.ensure_ctx("ec")
@@ -399,20 +452,42 @@ def _windowed_digest_sink(batches: Iterator[np.ndarray], dispatch_window,
     reader.start()
 
     acc = None
-    staged: list = []
+    staged: list = []   # handles, or futures of handles (stagers > 1)
     staged_bytes = 0
     t_read = t_stage = t_dispatch = 0.0
+    stage_span = [None, None]  # wall [first submit, last complete]
     n_batches = n_windows = 0
     total_bytes = 0
 
+    executor = None
+    if stagers > 1:
+        from concurrent.futures import ThreadPoolExecutor
+        executor = ThreadPoolExecutor(max_workers=stagers,
+                                      thread_name_prefix="ec-stager")
+
+    def do_stage(b):
+        h = stage(b)
+        block = getattr(h, "block_until_ready", None)
+        if block is not None:
+            block()
+        stage_span[1] = time.perf_counter()
+        return h
+
+    def resolve(staged_items: list) -> list:
+        return [h.result() if hasattr(h, "result") else h
+                for h in staged_items]
+
     def flush_window() -> None:
-        nonlocal acc, staged, staged_bytes, n_windows, t_dispatch
+        nonlocal acc, staged, staged_bytes, n_windows, t_dispatch, t_stage
         if not staged:
             return
         t0 = time.perf_counter()
+        handles = resolve(staged)
+        t_stage += time.perf_counter() - t0
+        t0 = time.perf_counter()
         with observe.stage("ec.dispatch_window", tctx,
-                           tags={"batches": len(staged)}):
-            acc = dispatch_window(staged, acc)
+                           tags={"batches": len(handles)}):
+            acc = dispatch_window(handles, acc)
         t_dispatch += time.perf_counter() - t0
         n_windows += 1
         staged = []
@@ -428,12 +503,13 @@ def _windowed_digest_sink(batches: Iterator[np.ndarray], dispatch_window,
                 drained = True
                 break
             t0 = time.perf_counter()
-            h = stage(batch)
-            block = getattr(h, "block_until_ready", None)
-            if block is not None:
-                block()
+            if stage_span[0] is None:
+                stage_span[0] = t0
+            if executor is not None:
+                staged.append(executor.submit(do_stage, batch))
+            else:
+                staged.append(do_stage(batch))
             t_stage += time.perf_counter() - t0
-            staged.append(h)
             staged_bytes += batch.nbytes
             total_bytes += batch.nbytes
             n_batches += 1
@@ -444,15 +520,27 @@ def _windowed_digest_sink(batches: Iterator[np.ndarray], dispatch_window,
         while not drained and read_q.get() is not _SENTINEL:
             pass  # unblock a reader stuck on a full queue after an error
         reader.join()
+        if executor is not None:
+            executor.shutdown(wait=True)
     if errors:
         raise errors[0]
     if stats is not None:
+        stage_wall = (round(stage_span[1] - stage_span[0], 3)
+                      if stage_span[0] is not None
+                      and stage_span[1] is not None else 0.0)
+        # the effective staging time: with one stager the main thread's
+        # blocked time IS the wall; with a pool the wall span covers the
+        # overlapped copies (blocked time alone would under-report)
+        stage_eff = t_stage if executor is None else (stage_wall
+                                                      or t_stage)
         stats.update({
             "staged_bytes": total_bytes, "n_batches": n_batches,
             "n_windows": n_windows, "read_wait_s": round(t_read, 3),
-            "stage_s": round(t_stage, 3),
-            "stage_gbps": (round(total_bytes / t_stage / 1e9, 3)
-                           if t_stage > 1e-9 else None),
+            "stage_s": round(stage_eff, 3),
+            "stage_blocked_s": round(t_stage, 3),
+            "stagers": stagers,
+            "stage_gbps": (round(total_bytes / stage_eff / 1e9, 3)
+                           if stage_eff > 1e-9 else None),
             "dispatch_s": round(t_dispatch, 3),
         })
     return acc
@@ -464,7 +552,9 @@ def stream_encode_device_sink(base_file_name: str, coder: ErasureCoder,
                               depth: int = DEFAULT_DEPTH,
                               window_bytes: int = DEFAULT_WINDOW_BYTES,
                               stats: dict | None = None,
-                              materialize: bool = True) -> np.ndarray:
+                              materialize: bool = True,
+                              stagers: Optional[int] = None,
+                              readers: Optional[int] = None) -> np.ndarray:
     """stream_encode with the parity landing in an on-device sink.
 
     Runs the same reader schedule as stream_encode but stages batches onto
@@ -489,16 +579,18 @@ def stream_encode_device_sink(base_file_name: str, coder: ErasureCoder,
     dat_size = os.path.getsize(base_file_name + ".dat")
     # unpooled feed: a whole window of batches stays referenced until its
     # single dispatch, so buffers are fresh (zero-copy mmap views where
-    # the stripe allows — those reference no buffer at all)
+    # the stripe allows — those reference no buffer at all; the reader
+    # pool prefaults their pages so the stagers' gathers never stall
+    # single-threaded on disk)
     src = feed_mod.open_feed(base_file_name + ".dat", g.data_shards,
-                             batch_size, pooled=False)
+                             batch_size, pooled=False, readers=readers)
     t_all = time.perf_counter()
     try:
         acc = _windowed_digest_sink(
             src.batches(stripe_segments(dat_size, g, batch_size),
                         pad_final=True),
             coder.encode_digest_window_async, coder.stage_async,
-            depth, window_bytes, stats)
+            depth, window_bytes, stats, stagers=stagers)
     finally:
         src.close()
     if acc is None:
@@ -530,7 +622,9 @@ def stream_rebuild_device_sink(base_file_name: str, coder: ErasureCoder,
                                depth: int = DEFAULT_DEPTH,
                                window_bytes: int = DEFAULT_WINDOW_BYTES,
                                stats: dict | None = None,
-                               materialize: bool = True) -> np.ndarray:
+                               materialize: bool = True,
+                               stagers: Optional[int] = None,
+                               readers: Optional[int] = None) -> np.ndarray:
     """stream_rebuild with the reconstructed shards landing in an on-device
     digest sink (BASELINE config 3's link-independent measurement).
 
@@ -555,7 +649,7 @@ def stream_rebuild_device_sink(base_file_name: str, coder: ErasureCoder,
     survivors_ids = tuple(present[:g.data_shards])
     src = feed_mod.ShardFeed(
         [base_file_name + to_ext(i) for i in survivors_ids],
-        batch_size, pooled=False)
+        batch_size, pooled=False, readers=readers)
     shard_size = src.shard_size
     t_all = time.perf_counter()
 
@@ -566,7 +660,8 @@ def stream_rebuild_device_sink(base_file_name: str, coder: ErasureCoder,
     try:
         acc = _windowed_digest_sink(
             src.batches(batch_size, pad_final=True), dispatch_window,
-            coder.stage_async, depth, window_bytes, stats)
+            coder.stage_async, depth, window_bytes, stats,
+            stagers=stagers)
     finally:
         src.close()
     if acc is None:
@@ -689,7 +784,7 @@ def stream_rebuild(base_file_name: str, coder: ErasureCoder,
                                g.data_shards * shard_size, g.data_shards)
     src = feed_mod.ShardFeed(
         [base_file_name + to_ext(i) for i in survivors_ids],
-        op.batch_size, pool_buffers=op.depth + 2)
+        op.batch_size, pool_buffers=op.depth + 2, readers=op.readers)
     fan = _FanOut([base_file_name + to_ext(i) for i in missing],
                   op.write_depth)
     tctx = observe.ensure_ctx("ec")

@@ -136,24 +136,60 @@ def write_ec_files(base_file_name: str, coder: ErasureCoder,
     finally:
         for f in outputs:
             f.close()
-    write_layout_marker(base_file_name, dat_size)
+    write_layout_marker(base_file_name, dat_size, g)
 
 
 LAYOUT_VERSION = 2  # padded-final-large-row tail rule (see write_ec_files)
 
 
-def write_layout_marker(base_file_name: str, dat_size: int) -> None:
-    """Record the striping layout version in a .ecm sidecar so a shard
-    set encoded under the PRE-round-3 tail rule (small rows where the new
-    rule pads a large row) is detected at mount instead of silently
-    misaddressing. The marker is a sidecar — shard bytes stay bit-exact
-    vs the reference's own fixture."""
+def write_layout_marker(base_file_name: str, dat_size: int,
+                        geometry: Optional[Geometry] = None) -> None:
+    """Record the striping layout version — and, round 10 on, the RS
+    geometry the shards were encoded under — in a .ecm sidecar so a
+    shard set encoded under the PRE-round-3 tail rule (small rows where
+    the new rule pads a large row) is detected at mount instead of
+    silently misaddressing, and so rebuild/mount/decode never have to
+    consult the (mutable) cluster geometry policy: the geometry travels
+    with the shards. The marker is a sidecar — shard bytes stay
+    bit-exact vs the reference's own fixture."""
     import json as json_mod
+    meta: dict = {"layout_version": LAYOUT_VERSION, "dat_size": dat_size}
+    if geometry is not None:
+        meta["geometry"] = {
+            "data_shards": geometry.data_shards,
+            "parity_shards": geometry.parity_shards,
+            "large_block_size": geometry.large_block_size,
+            "small_block_size": geometry.small_block_size,
+        }
     tmp = base_file_name + ".ecm.tmp"
     with open(tmp, "w") as f:
-        json_mod.dump({"layout_version": LAYOUT_VERSION,
-                       "dat_size": dat_size}, f)
+        json_mod.dump(meta, f)
     os.replace(tmp, base_file_name + ".ecm")
+
+
+def read_marker_geometry(base_file_name: str) -> Optional[Geometry]:
+    """The RS geometry stamped into the .ecm sidecar, or None (pre-
+    round-10 markers, missing sidecar). Rebuild, mount and decode
+    prefer this over any policy: the record of what the bytes ARE."""
+    import json as json_mod
+    try:
+        with open(base_file_name + ".ecm") as f:
+            meta = json_mod.load(f)
+    except (OSError, ValueError):
+        return None
+    g = meta.get("geometry")
+    if not isinstance(g, dict):
+        return None
+    try:
+        return Geometry(
+            data_shards=int(g["data_shards"]),
+            parity_shards=int(g["parity_shards"]),
+            large_block_size=int(g.get("large_block_size",
+                                       DEFAULT.large_block_size)),
+            small_block_size=int(g.get("small_block_size",
+                                       DEFAULT.small_block_size)))
+    except (KeyError, ValueError, AssertionError):
+        return None
 
 
 def check_layout_marker(base_file_name: str, shard_size: int,

@@ -97,6 +97,8 @@ KNOWN_POINTS = frozenset({
     "volume.replicate",     # replica fan-out
     "master.assign",        # fid assignment (incl. fastpath listener)
     "ec.shard_read",        # EC shard interval reads
+    "ec.feed.read",         # EC feed stripe/survivor reads (ec/feed.py)
+    "ec.feed.stall",        # EC feed staging-buffer waits (ec/feed.py)
     "http_pool.request",    # pooled intra-cluster HTTP request
     "http_pool.response",   # pooled response payload (corrupt target)
     "lifecycle.warm",       # hot->warm transition

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from collections import deque
 from dataclasses import asdict
@@ -46,6 +47,67 @@ from . import jittered
 log = logging.getLogger("lifecycle")
 
 
+class _EncodeBatcher:
+    """Queue-aware encode batching: warm transitions that reach their
+    encode step while others are in flight coalesce into ONE
+    multi-volume ``ec/generate`` POST per source server, so the volume
+    server streams the whole window through a single governed [k, B]
+    executable back-to-back (store.ec_generate_many ->
+    pipeline.stream_encode_many) instead of paying a program load per
+    volume. Window size bounds via WEED_EC_ENCODE_WINDOW (default 8);
+    a short linger lets near-simultaneous transitions land in one
+    window without delaying a lone volume meaningfully."""
+
+    def __init__(self, daemon: "LifecycleDaemon", linger: float = 0.5):
+        self.daemon = daemon
+        self.linger = linger
+        try:
+            self.max_window = max(
+                1, int(os.environ.get("WEED_EC_ENCODE_WINDOW", "8")))
+        except ValueError:
+            self.max_window = 8
+        # source url -> [(vid, future)] awaiting the next window
+        self._waiting: dict[str, list] = {}
+
+    async def encode(self, source: str, vid: int) -> None:
+        fut = asyncio.get_event_loop().create_future()
+        batch = self._waiting.setdefault(source, [])
+        batch.append((vid, fut))
+        if len(batch) >= self.max_window:
+            self._waiting.pop(source, None)
+            await self._post(source, batch)
+        elif len(batch) == 1:
+            task = asyncio.create_task(self._flush_after(source, batch))
+            self.daemon._tasks.add(task)
+            task.add_done_callback(self.daemon._tasks.discard)
+        await fut
+
+    async def _flush_after(self, source: str, batch: list) -> None:
+        await asyncio.sleep(self.linger)
+        # flush only OUR batch: if a full window already flushed it (and
+        # a newer batch is forming under the same source), this stale
+        # linger must not fire the newer batch early
+        if self._waiting.get(source) is batch:
+            self._waiting.pop(source, None)
+            await self._post(source, batch)
+
+    async def _post(self, source: str, batch: list) -> None:
+        vids = [vid for vid, _ in batch]
+        body = ({"volume_id": vids[0]} if len(vids) == 1
+                else {"volume_ids": vids})
+        try:
+            await self.daemon.master._admin_post(
+                source, "ec/generate", body, timeout=900.0 * len(vids))
+        except Exception as e:
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+        else:
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_result(None)
+
+
 class LifecycleDaemon:
     def __init__(self, master, cfg: Optional[LifecycleConfig] = None):
         self.master = master
@@ -59,6 +121,9 @@ class LifecycleDaemon:
         # vid -> reason, fed by S3 Transition rules: these volumes go
         # warm on the next pass regardless of idleness
         self.warm_requested: dict[int, str] = {}
+        # coalesces concurrent warm transitions' encode steps into
+        # multi-volume windows per source (one governed executable)
+        self._encode_batcher = _EncodeBatcher(self)
 
     # --- loop ---
 
@@ -194,7 +259,7 @@ class LifecycleDaemon:
         vid, collection = tr.vid, tr.collection
         if await faults.fire_async("lifecycle.warm"):
             raise RuntimeError("injected drop at lifecycle.warm")
-        total = master.ec_total_shards
+        total = master.ec_total_shards_for(collection)
         # resumable finish: a prior attempt (or crash) already produced
         # the full shard set — only the original is left to retire.
         # The topology view can be STALE (an un-EC that just deleted
@@ -235,11 +300,12 @@ class LifecycleDaemon:
         if garbage > 0.01:
             await master._admin_post(source, "vacuum",
                                      {"volume_id": vid}, timeout=600.0)
-        # 3. encode on the source through the governed EC feed
-        #    (store.ec_generate -> ec/pipeline.stream_encode)
+        # 3. encode on the source through the governed EC feed — via the
+        #    encode batcher, so a burst of warm transitions sharing a
+        #    source streams as ONE multi-volume window through a single
+        #    governed executable (store.ec_generate_many)
         self._check_leader()
-        await master._admin_post(source, "ec/generate",
-                                 {"volume_id": vid}, timeout=900.0)
+        await self._encode_batcher.encode(source, vid)
         # 4. spread with the same balanced plan the ec.encode shell uses
         from ..shell.ec_commands import collect_ec_nodes, plan_shard_spread
         nodes = collect_ec_nodes(master.topology.to_dict())
@@ -309,7 +375,7 @@ class LifecycleDaemon:
         shards = master.topology.lookup_ec_shards(vid)
         if not shards:
             raise RuntimeError(f"no shards for volume {vid}")
-        total = master.ec_total_shards
+        total = master.ec_total_shards_for(collection)
         holder_count: dict[str, int] = {}
         for nodes in shards.values():
             for n in nodes:
@@ -356,7 +422,8 @@ class LifecycleDaemon:
             await master._admin_post(
                 url, "ec/delete_shards",
                 {"volume_id": tr.vid, "collection": tr.collection,
-                 "shard_ids": list(range(master.ec_total_shards))})
+                 "shard_ids": list(range(
+                     master.ec_total_shards_for(tr.collection)))})
 
     # --- S3 bucket rules: Expiration + Transition(WARM), via the filer ---
 

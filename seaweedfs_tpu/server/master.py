@@ -28,6 +28,7 @@ from aiohttp import web
 
 from .. import faults, observe, overload
 from ..cluster.raft import RaftNode, _endpoint_ips
+from ..ec.geometry import GeometryPolicy
 from ..lifecycle.daemon import LifecycleDaemon
 from ..lifecycle.policy import LifecycleConfig
 from ..security.guard import Guard
@@ -73,6 +74,7 @@ class MasterServer:
                  maintenance_interval_seconds: Optional[float] = None,
                  repair_concurrency: int = 2,
                  ec_total_shards: int = 14,
+                 ec_geometry_policy: Optional[GeometryPolicy] = None,
                  lifecycle_config: Optional[LifecycleConfig] = None):
         self.topology = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
@@ -107,6 +109,12 @@ class MasterServer:
             else max(pulse_seconds, 0.05))
         self.repair_concurrency = repair_concurrency
         self.ec_total_shards = ec_total_shards
+        # per-collection RS(k,m) policy, MASTER-VALIDATED: parsing
+        # WEED_EC_GEOMETRY happens here at construction, so a bad spec
+        # kills the master at startup instead of mis-striping the first
+        # volume an encode plan touches. The policy is served in
+        # /dir/status (shell planners) and echoed on /dir/assign.
+        self.ec_policy = ec_geometry_policy or GeometryPolicy.from_env()
         # pruning always runs with the daemon; the repair planner can be
         # paused (operators during planned maintenance, tests driving
         # the manual ec.rebuild path)
@@ -496,12 +504,17 @@ class MasterServer:
                 return {"error": "lost leadership during assign"}, 503
         fid = FileId(vid, key, new_cookie())
         node = nodes[0]
+        g = self.ec_policy.for_collection(collection)
         resp = {
             "fid": str(fid),
             "url": node.url,
             "publicUrl": node.public_url,
             "count": count,
             "replicas": [n.url for n in nodes[1:]],
+            # the RS(k,m) this collection's volumes will seal into —
+            # informational plumbing so clients/filers can surface the
+            # durability profile a write lands under
+            "ecGeometry": f"{g.data_shards}+{g.parity_shards}",
         }
         # per-fid write token signed by the master, verified by the volume
         # server (weed/security/jwt.go; master_server_handlers.go:146)
@@ -573,7 +586,9 @@ class MasterServer:
         return web.json_response(resp)
 
     async def dir_status(self, request: web.Request) -> web.Response:
-        return web.json_response(self.topology.to_dict())
+        d = self.topology.to_dict()
+        d["ec_geometry"] = self.ec_policy.to_dict()
+        return web.json_response(d)
 
     async def vol_grow(self, request: web.Request) -> web.Response:
         q = request.query
@@ -895,6 +910,21 @@ class MasterServer:
         seen.pop(vid, None)
         return True
 
+    def ec_total_shards_for(self, collection: str = "") -> int:
+        """Full shard count for a collection: its policy geometry when
+        one is declared, then an explicitly-configured policy DEFAULT
+        (WEED_EC_GEOMETRY="20+4" must steer repair/lifecycle too, or
+        the daemon would verify 14/24 shards as complete and retire
+        originals into unreadable volumes), else the legacy
+        ec_total_shards knob (which shrunk-cluster tests still steer)."""
+        g = self.ec_policy.per_collection.get(collection or "")
+        if g is not None:
+            return g.total_shards
+        from ..ec.geometry import DEFAULT as _DEFAULT_GEOMETRY
+        if self.ec_policy.default != _DEFAULT_GEOMETRY:
+            return self.ec_policy.default.total_shards
+        return self.ec_total_shards
+
     async def _repair_pass(self) -> None:
         # EC volumes below full shard count (scrub-flagged copies don't
         # count as live)
@@ -907,7 +937,7 @@ class MasterServer:
                 self._ec_deficit_seen.pop(vid, None)
         for vid, collection in ec_vids.items():
             live = self._live_ec_shards(vid)
-            if len(live) >= self.ec_total_shards:
+            if len(live) >= self.ec_total_shards_for(collection):
                 self._ec_deficit_seen.pop(vid, None)
                 self._repair_backoff.pop(("ec", vid), None)
                 continue
@@ -1019,7 +1049,7 @@ class MasterServer:
             self._scrub_bad.pop(vid, None)
         nodes = collect_ec_nodes(self.topology.to_dict())
         rebuilder, missing, copy_plan = plan_rebuild(
-            nodes, vid, self.ec_total_shards)
+            nodes, vid, self.ec_total_shards_for(collection))
         if not missing:
             return True
         copied: list[int] = []

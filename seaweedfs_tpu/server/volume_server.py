@@ -1246,16 +1246,35 @@ class VolumeServer:
         return web.json_response({"ok": True, **out})
 
     async def admin_ec_generate(self, request: web.Request) -> web.Response:
+        """One volume (volume_id) or a WINDOW (volume_ids): the batched
+        form streams every volume through one governed executable
+        back-to-back (store.ec_generate_many), which is how the
+        lifecycle daemon's encode queue amortizes compiles + program
+        loads across a whole batch of sealed volumes."""
         body = await request.json()
-        vid = int(body["volume_id"])
+        vids = ([int(v) for v in body["volume_ids"]]
+                if "volume_ids" in body else [int(body["volume_id"])])
+        if not vids:
+            return web.json_response({"error": "empty volume_ids"},
+                                     status=400)
         tctx = observe.capture()
         try:
-            shards = await asyncio.get_event_loop().run_in_executor(
-                None, lambda: observe.run_with(
-                    tctx, self.store.ec_generate, vid))
+            if len(vids) == 1:
+                shards = await asyncio.get_event_loop().run_in_executor(
+                    None, lambda: observe.run_with(
+                        tctx, self.store.ec_generate, vids[0]))
+                per_volume = {str(vids[0]): shards}
+            else:
+                per_volume_raw = await asyncio.get_event_loop() \
+                    .run_in_executor(
+                        None, lambda: observe.run_with(
+                            tctx, self.store.ec_generate_many, vids))
+                per_volume = {str(k): v for k, v in per_volume_raw.items()}
+                shards = per_volume.get(str(vids[0]), [])
         except KeyError as e:
             return web.json_response({"error": str(e)}, status=404)
-        return web.json_response({"ok": True, "shards": shards})
+        return web.json_response({"ok": True, "shards": shards,
+                                  "volumes": per_volume})
 
     async def admin_ec_mount(self, request: web.Request) -> web.Response:
         body = await request.json()

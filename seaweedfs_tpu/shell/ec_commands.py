@@ -13,7 +13,7 @@ import logging
 from dataclasses import dataclass, field
 
 from ..client import Client, ClientError
-from ..ec.geometry import DEFAULT, Geometry
+from ..ec.geometry import DEFAULT, Geometry, GeometryPolicy
 
 log = logging.getLogger("shell.ec")
 
@@ -116,61 +116,125 @@ def plan_balance(nodes: list[EcNode],
 
 
 class EcCommands:
-    """Executors driving the cluster through the admin HTTP API."""
+    """Executors driving the cluster through the admin HTTP API.
+
+    Geometry resolution: an explicit non-default `geometry` pins every
+    plan (shrunk-geometry tests); otherwise plans follow the MASTER's
+    per-collection policy (WEED_EC_GEOMETRY, served in /dir/status) —
+    the plumbing that lets an `archive` collection ride RS(20,4) while
+    `media` stays RS(10,4), each plan sized to its own shard count."""
 
     def __init__(self, client: Client, geometry: Geometry = DEFAULT):
         self.client = client
         self.g = geometry
+        self._policy: "GeometryPolicy | None" = None
 
-    def _topology_nodes(self) -> list[EcNode]:
-        return collect_ec_nodes(self.client.dir_status())
+    def geometry_for(self, collection: str = "",
+                     status: "dict | None" = None) -> Geometry:
+        """status: an already-fetched /dir/status document, so callers
+        that need both the topology and the policy pay ONE round trip."""
+        if self.g is not None and self.g != DEFAULT:
+            return self.g  # explicit pin wins
+        if self._policy is None:
+            if status is None:
+                try:
+                    status = self.client.dir_status()
+                except ClientError:
+                    # transient fetch failure: answer the default but do
+                    # NOT cache — the next command (often holding a
+                    # fresh status) must still learn the real policy
+                    return GeometryPolicy().for_collection(collection)
+            try:
+                self._policy = GeometryPolicy.from_dict(
+                    status.get("ec_geometry") or {})
+            except ValueError:
+                # the master SPOKE but the document is malformed: cache
+                # the default (re-fetching the same garbage won't help)
+                self._policy = GeometryPolicy()
+        return self._policy.for_collection(collection)
+
+    def _topology_nodes(self,
+                        status: "dict | None" = None) -> list[EcNode]:
+        return collect_ec_nodes(status if status is not None
+                                else self.client.dir_status())
 
     def encode(self, vid: int, collection: str = "",
                apply: bool = True) -> dict:
         """ec.encode one volume (doEcEncode, command_ec_encode.go:92-158):
         mark readonly -> generate on source -> spread -> mount -> delete
         original."""
-        locations = self.client.lookup(vid)
-        source = locations[0]
-        nodes = self._topology_nodes()
-        plan = plan_shard_spread(nodes, self.g.total_shards, source)
-        if not apply:
-            return {"source": source, "plan": plan}
+        return self.encode_many([vid], collection, apply=apply)
 
-        for url in locations:
-            self.client.volume_admin(url, "volume/readonly",
-                                     {"volume_id": vid, "read_only": True})
-        self.client.volume_admin(source, "ec/generate",
-                                 {"volume_id": vid})
-        for target, sids in plan.items():
-            if target != source:
+    def encode_many(self, vids: list[int], collection: str = "",
+                    apply: bool = True) -> dict:
+        """ec.encode a WINDOW of volumes: every volume sharing a source
+        is generated in ONE multi-volume `ec/generate` call, so the
+        volume server streams the batch through a single governed
+        executable back-to-back (the encode-queue regime) — then each
+        volume spreads/mounts/retires individually."""
+        status = self.client.dir_status()
+        g = self.geometry_for(collection, status=status)
+        locations = {vid: self.client.lookup(vid) for vid in vids}
+        sources: dict[str, list[int]] = {}
+        for vid in vids:
+            sources.setdefault(locations[vid][0], []).append(vid)
+        nodes = self._topology_nodes(status)
+        plans = {vid: plan_shard_spread(nodes, g.total_shards,
+                                        locations[vid][0])
+                 for vid in vids}
+        if not apply:
+            if len(vids) == 1:
+                return {"source": locations[vids[0]][0],
+                        "plan": plans[vids[0]]}
+            return {"sources": sources, "plans": plans,
+                    "geometry": f"{g.data_shards}+{g.parity_shards}"}
+
+        for vid in vids:
+            for url in locations[vid]:
+                self.client.volume_admin(url, "volume/readonly",
+                                         {"volume_id": vid,
+                                          "read_only": True})
+        for source, svids in sources.items():
+            self.client.volume_admin(
+                source, "ec/generate",
+                {"volume_id": svids[0]} if len(svids) == 1
+                else {"volume_ids": svids})
+        for vid in vids:
+            source = locations[vid][0]
+            plan = plans[vid]
+            for target, sids in plan.items():
+                if target != source:
+                    self.client.volume_admin(
+                        target, "ec/copy",
+                        {"volume_id": vid, "collection": collection,
+                         "shard_ids": sids, "source": source,
+                         "copy_ecx_file": True})
                 self.client.volume_admin(
-                    target, "ec/copy",
+                    target, "ec/mount",
                     {"volume_id": vid, "collection": collection,
-                     "shard_ids": sids, "source": source,
-                     "copy_ecx_file": True})
-            self.client.volume_admin(
-                target, "ec/mount",
-                {"volume_id": vid, "collection": collection,
-                 "shard_ids": sids})
-        # delete the original volume everywhere + surplus shards at source
-        for url in locations:
-            self.client.volume_admin(url, "volume/delete",
-                                     {"volume_id": vid})
-        surplus = [s for s in range(self.g.total_shards)
-                   if s not in plan.get(source, [])]
-        if surplus:
-            self.client.volume_admin(
-                source, "ec/delete_shards",
-                {"volume_id": vid, "collection": collection,
-                 "shard_ids": surplus})
-        return {"source": source, "plan": plan}
+                     "shard_ids": sids})
+            # delete the original everywhere + surplus shards at source
+            for url in locations[vid]:
+                self.client.volume_admin(url, "volume/delete",
+                                         {"volume_id": vid})
+            surplus = [s for s in range(g.total_shards)
+                       if s not in plan.get(source, [])]
+            if surplus:
+                self.client.volume_admin(
+                    source, "ec/delete_shards",
+                    {"volume_id": vid, "collection": collection,
+                     "shard_ids": surplus})
+        if len(vids) == 1:
+            return {"source": locations[vids[0]][0],
+                    "plan": plans[vids[0]]}
+        return {"sources": sources, "plans": plans}
 
     def rebuild(self, vid: int, collection: str = "",
                 apply: bool = True) -> dict:
-        nodes = self._topology_nodes()
+        status = self.client.dir_status()
         rebuilder, missing, copy_plan = plan_rebuild(
-            nodes, vid, self.g.total_shards)
+            self._topology_nodes(status), vid,
+            self.geometry_for(collection, status=status).total_shards)
         if not missing:
             return {"rebuilt": [], "rebuilder": None}
         if not apply:
@@ -201,8 +265,10 @@ class EcCommands:
                 "copied": copied}
 
     def balance(self, collection: str = "", apply: bool = True) -> list:
-        nodes = self._topology_nodes()
-        moves = plan_balance(nodes, self.g.total_shards)
+        status = self.client.dir_status()
+        moves = plan_balance(
+            self._topology_nodes(status),
+            self.geometry_for(collection, status=status).total_shards)
         if not apply:
             return moves
         for vid, sid, src, dst in moves:
@@ -235,8 +301,9 @@ class EcCommands:
                 holder_count[u] = holder_count.get(u, 0) + 1
         if not holder_count:
             raise ClientError(f"no ec shards for volume {vid}")
+        g = self.geometry_for(collection)
         target = max(holder_count, key=holder_count.get)
-        need = [sid for sid in range(self.g.total_shards)
+        need = [sid for sid in range(g.total_shards)
                 if sid in shards and target not in shards[sid]]
         if not apply:
             return {"target": target, "copy": need}
@@ -261,5 +328,5 @@ class EcCommands:
         self.client.volume_admin(
             target, "ec/delete_shards",
             {"volume_id": vid, "collection": collection,
-             "shard_ids": list(range(self.g.total_shards))})
+             "shard_ids": list(range(g.total_shards))})
         return {"target": target, "copied": need}

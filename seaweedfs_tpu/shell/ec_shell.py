@@ -12,16 +12,21 @@ def _ec(env: CommandEnv) -> EcCommands:
 
 
 @command("ec.encode",
-         "erasure-code a volume (ec.encode -volumeId N [-collection c] "
-         "[-dryRun])", destructive=True)
+         "erasure-code volumes (ec.encode -volumeId N[,N2,...] "
+         "[-collection c] [-dryRun]) — a comma list encodes the whole "
+         "window back-to-back through one governed executable",
+         destructive=True)
 def ec_encode(env: CommandEnv, argv: list[str]):
     p = parser("ec.encode")
-    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-volumeId", required=True)
     p.add_argument("-collection", default="")
     p.add_argument("-dryRun", action="store_true")
     args = p.parse_args(argv)
-    return _ec(env).encode(args.volumeId, args.collection,
-                           apply=not args.dryRun)
+    vids = [int(v) for v in str(args.volumeId).split(",") if v]
+    ec = _ec(env)
+    if len(vids) == 1:
+        return ec.encode(vids[0], args.collection, apply=not args.dryRun)
+    return ec.encode_many(vids, args.collection, apply=not args.dryRun)
 
 
 @command("ec.rebuild",

@@ -38,7 +38,11 @@ class DiskLocation:
         self.low_space = False
 
     def load_existing(self, coder_factory,
-                      geometry: ec_mod.Geometry) -> None:
+                      geometry) -> None:
+        """geometry: a Geometry (every EC volume assumed that shape) or
+        a resolver callable (base_path, collection) -> Geometry — the
+        store passes its marker-or-policy resolver so a mixed-geometry
+        disk (RS(10,4) media next to RS(20,4) archive) loads right."""
         # tiered volumes have no local .dat — discover via .vif sidecars too
         names = {os.path.basename(p)[:-4]
                  for p in glob.glob(os.path.join(self.directory, "*.dat"))}
@@ -68,8 +72,13 @@ class DiskLocation:
             if vid is None or vid in self.volumes:
                 continue
             try:
-                ev = EcVolume(self.directory, collection, vid, geometry,
-                              coder=coder_factory())
+                if callable(geometry):
+                    g = geometry(os.path.join(self.directory, name),
+                                 collection)
+                else:
+                    g = geometry
+                ev = EcVolume(self.directory, collection, vid, g,
+                              coder=coder_factory(g))
                 for sid in range(ev.g.total_shards):
                     if os.path.exists(ev.base_file_name() + ec_mod.to_ext(sid)):
                         ev.add_shard(sid)
@@ -107,20 +116,28 @@ class Store:
                  geometry: ec_mod.Geometry = ec_mod.DEFAULT,
                  needle_map_kind: str = "memory",
                  min_free_space_percent: float = 1.0,
-                 preallocate: int = 0):
-        self.geometry = geometry
+                 preallocate: int = 0,
+                 geometry_policy: "ec_mod.GeometryPolicy | None" = None):
+        # per-collection RS(k,m): explicit policy > WEED_EC_GEOMETRY env;
+        # an explicit non-default `geometry` arg overrides the default
+        # entry (back-compat for tests constructing shrunk geometries)
+        policy = geometry_policy or ec_mod.GeometryPolicy.from_env()
+        if geometry != ec_mod.DEFAULT:
+            policy = ec_mod.GeometryPolicy(policy.per_collection, geometry)
+        self.geometry_policy = policy
+        self.geometry = policy.default
         self.coder_name = coder_name
         self.needle_map_kind = needle_map_kind
         self.min_free_space_percent = min_free_space_percent
         self.preallocate = preallocate
         self.low_disk_space = False
-        self._coder: Optional[ErasureCoder] = None
+        self._coders: dict[tuple[int, int], ErasureCoder] = {}
         counts = max_volume_counts or [8] * len(directories)
         self.locations = [DiskLocation(d, c, needle_map_kind)
                           for d, c in zip(directories, counts)]
         self._lock = threading.RLock()
         for loc in self.locations:
-            loc.load_existing(self.coder, self.geometry)
+            loc.load_existing(self.coder, self._resolve_geometry)
 
     def check_free_space(self) -> bool:
         """Min-free-space watchdog (disk_location.go:304 + statfs,
@@ -151,12 +168,29 @@ class Store:
         self.low_disk_space = low_any
         return low_any
 
-    def coder(self) -> ErasureCoder:
-        if self._coder is None:
-            self._coder = ec_mod.get_coder(
-                self.coder_name, self.geometry.data_shards,
-                self.geometry.parity_shards)
-        return self._coder
+    def coder(self, geometry: Optional[ec_mod.Geometry] = None
+              ) -> ErasureCoder:
+        g = geometry or self.geometry
+        key = (g.data_shards, g.parity_shards)
+        c = self._coders.get(key)
+        if c is None:
+            c = self._coders[key] = ec_mod.get_coder(
+                self.coder_name, g.data_shards, g.parity_shards)
+        return c
+
+    def geometry_for(self, collection: str = "") -> ec_mod.Geometry:
+        """The policy geometry NEW encodes of this collection use."""
+        return self.geometry_policy.for_collection(collection)
+
+    def _resolve_geometry(self, base: str,
+                          collection: str = "") -> ec_mod.Geometry:
+        """The geometry an EXISTING shard set was encoded under: the
+        .ecm sidecar's stamped record when present (authoritative — a
+        policy change must never re-shape bytes already on disk),
+        otherwise the collection policy."""
+        from ..ec.striping import read_marker_geometry
+        return (read_marker_geometry(base)
+                or self.geometry_for(collection))
 
     # --- volume management ---
     def find_volume(self, vid: int) -> Optional[Volume]:
@@ -408,22 +442,69 @@ class Store:
 
     # --- EC lifecycle (VolumeEcShardsGenerate etc.,
     #     weed/server/volume_grpc_erasure_coding.go) ---
-    def ec_generate(self, vid: int) -> list[int]:
+    def _ec_seal(self, vid: int):
+        """Seal a volume for encoding; returns (volume, base, geometry)."""
         v = self.find_volume(vid)
         if v is None:
             raise KeyError(f"volume {vid} not found")
         v.read_only = True
         v.sync()
-        base = v.base_file_name()
-        # streaming pipeline: overlapped disk read / H2D / kernel / shard
-        # write-back (ec/pipeline.py) — byte-identical to the synchronous
-        # write_ec_files layout
-        ec_pipeline.stream_encode(base, self.coder(), self.geometry)
+        return v, v.base_file_name(), self.geometry_for(v.collection)
+
+    def _ec_finish_generate(self, v, base: str,
+                            g: ec_mod.Geometry) -> list[int]:
         ec_mod.write_sorted_ecx_from_idx(base, offset_size=v.offset_size)
         # record per-shard digests into the .ecm while the bytes are
         # known-good — the EC scrubber's bit-rot reference
-        ec_pipeline.stamp_shard_digests(base, self.geometry)
-        return list(range(self.geometry.total_shards))
+        ec_pipeline.stamp_shard_digests(base, g)
+        return list(range(g.total_shards))
+
+    def ec_generate(self, vid: int) -> list[int]:
+        v, base, g = self._ec_seal(vid)
+        # streaming pipeline: overlapped disk read / H2D / kernel / shard
+        # write-back (ec/pipeline.py) — byte-identical to the synchronous
+        # write_ec_files layout; geometry follows the collection policy
+        # and is stamped into the .ecm for rebuild/mount/decode
+        ec_pipeline.stream_encode(base, self.coder(g), g)
+        return self._ec_finish_generate(v, base, g)
+
+    def ec_generate_many(self, vids: list[int]) -> dict[int, list[int]]:
+        """Encode a WINDOW of volumes back-to-back: all volumes of one
+        geometry stream through a single governed operating point (and
+        therefore one compiled [k, B] executable — see
+        pipeline.stream_encode_many), which is how the lifecycle
+        daemon's encode queue amortizes program loads across a batch
+        instead of paying one per volume."""
+        # validate the whole window BEFORE sealing anything: one missing
+        # vid must fail the batch cleanly, not leave the other volumes
+        # sealed read-only with no shards to show for it
+        absent = [vid for vid in vids if self.find_volume(vid) is None]
+        if absent:
+            raise KeyError(f"volume(s) {absent} not found")
+        by_geometry: dict[ec_mod.Geometry, list] = {}
+        sealed: list = []
+        for vid in vids:
+            was_read_only = self.find_volume(vid).read_only
+            v, base, g = self._ec_seal(vid)
+            by_geometry.setdefault(g, []).append((vid, v, base))
+            sealed.append((v, base, was_read_only))
+        out: dict[int, list[int]] = {}
+        try:
+            for g, items in by_geometry.items():
+                ec_pipeline.stream_encode_many(
+                    [base for _, _, base in items], self.coder(g), g)
+                for vid, v, base in items:
+                    out[vid] = self._ec_finish_generate(v, base, g)
+        except BaseException:
+            # a mid-window failure must not leave the REST of the batch
+            # sealed with nothing to show for it: lift seals we applied
+            # on volumes whose encode never completed (stream_encode
+            # writes the .ecm marker only at the end of each volume)
+            for v, base, was_read_only in sealed:
+                if not was_read_only and not os.path.exists(base + ".ecm"):
+                    v.read_only = False
+            raise
+        return out
 
     def ec_mount(self, vid: int, collection: str,
                  shard_ids: list[int]) -> list[int]:
@@ -431,8 +512,12 @@ class Store:
             ev = self.find_ec_volume(vid)
             if ev is None:
                 loc = self._location_with_ec_files(vid, collection)
-                ev = EcVolume(loc.directory, collection, vid, self.geometry,
-                              coder=self.coder())
+                prefix = f"{collection}_" if collection else ""
+                g = self._resolve_geometry(
+                    os.path.join(loc.directory, f"{prefix}{vid}"),
+                    collection)
+                ev = EcVolume(loc.directory, collection, vid, g,
+                              coder=self.coder(g))
                 loc.ec_volumes[vid] = ev
             mounted = [sid for sid in shard_ids if ev.add_shard(sid)]
             return mounted
@@ -471,15 +556,18 @@ class Store:
         loc = self._location_with_ec_files(vid, collection)
         prefix = f"{collection}_" if collection else ""
         base = os.path.join(loc.directory, f"{prefix}{vid}")
-        rebuilt = ec_pipeline.stream_rebuild(base, self.coder(),
-                                             self.geometry)
+        # geometry from the .ecm record, NOT the live policy: rebuilding
+        # a RS(20,4) archive volume under a since-changed default would
+        # reconstruct garbage
+        g = self._resolve_geometry(base, collection)
+        rebuilt = ec_pipeline.stream_rebuild(base, self.coder(g), g)
         ev = self.find_ec_volume(vid)
         ec_mod.rebuild_ecx_file(
             base, offset_size=(ev.offset_size if ev is not None
                                else t.OFFSET_SIZE))
         # merge-only stamp: freshly reconstructed shards get their digest
         # recorded; already-stamped ids keep the encode-time value
-        ec_pipeline.stamp_shard_digests(base, self.geometry)
+        ec_pipeline.stamp_shard_digests(base, g)
         return rebuilt
 
     def ec_blob_delete(self, vid: int, needle_id: int) -> None:
@@ -510,7 +598,8 @@ class Store:
             w = ev0.offset_size if ev0 is not None else t.OFFSET_SIZE
             dat_size = ec_mod.find_dat_file_size(base, t.CURRENT_VERSION,
                                                  offset_size=w)
-            ec_mod.write_dat_file(base, dat_size, self.geometry)
+            ec_mod.write_dat_file(base, dat_size,
+                                  self._resolve_geometry(base, collection))
             ec_mod.write_idx_file_from_ec_index(base, offset_size=w)
             ev = loc.ec_volumes.pop(vid, None)
             if ev is not None:

@@ -198,3 +198,141 @@ def test_stream_rebuild_uses_feed_and_matches(tmp_path, monkeypatch):
         assert sorted(rebuilt) == victims
         for i in range(14):
             assert _sha(base + ec.to_ext(i)) == golden[i], (use_mmap, i)
+
+
+# ----------------------------------------------------- reader pool / O_DIRECT
+
+@pytest.mark.parametrize("size", [99_001, 30_553, 7])
+@pytest.mark.parametrize("mode", ["readers", "odirect", "odirect+readers"])
+def test_pipeline_parallel_feed_matches_striping(tmp_path, size, mode,
+                                                 monkeypatch):
+    """The reader pool (WEED_EC_READERS > 1) and the O_DIRECT path must
+    be byte-identical to the synchronous reference-shaped writer at odd
+    sizes (unaligned tails, EOF zero-fill, padded final rows) — the only
+    acceptable difference is speed."""
+    if "readers" in mode:
+        monkeypatch.setenv("WEED_EC_READERS", "3")
+    if "odirect" in mode:
+        monkeypatch.setenv("WEED_EC_ODIRECT", "1")
+    coder = ec.get_coder("numpy", 10, 4)
+    base_a = _write_dat(tmp_path, "a_1", size, seed=size % 89)
+    ec.write_ec_files(base_a, coder, GEO, buffer_size=100)
+    base_b = _write_dat(tmp_path, "b_1", size, seed=size % 89)
+    pipeline.stream_encode(base_b, coder, GEO, batch_size=1000)
+    for i in range(14):
+        assert _sha(base_a + ec.to_ext(i)) == _sha(base_b + ec.to_ext(i)), \
+            (size, mode, i)
+
+
+@pytest.mark.parametrize("feed_cls", [feed_mod.MmapFeed,
+                                      feed_mod.PreadvFeed])
+def test_reader_pool_agrees_with_serial(tmp_path, feed_cls):
+    """readers=1 (serial path) and readers=N (pool) must produce the
+    SAME ordered batch sequence for the same segments."""
+    size = 123_457
+    base = _write_dat(tmp_path, "1", size, seed=21)
+    for batch in (64, 1000, 1 << 16):
+        got = []
+        for readers in (1, 4):
+            f = feed_cls(base + ".dat", GEO.data_shards, batch,
+                         pool_buffers=3, readers=readers)
+            out = []
+            for b in f.batches(stripe_segments(size, GEO, batch)):
+                out.append(b.copy())
+                f.recycle(b)
+            f.close()
+            got.append(out)
+        assert len(got[0]) == len(got[1]), batch
+        for a, b in zip(*got):
+            assert a.shape == b.shape and np.array_equal(a, b), batch
+
+
+def test_shard_feed_reader_pool_agrees_with_serial(tmp_path):
+    size = 77_803
+    base = _write_dat(tmp_path, "1", size, seed=23)
+    coder = ec.get_coder("numpy", 10, 4)
+    pipeline.stream_encode(base, coder, GEO, batch_size=1000)
+    paths = [base + ec.to_ext(i) for i in range(10)]
+    got = []
+    for readers in (1, 3):
+        f = feed_mod.ShardFeed(paths, 512, pool_buffers=3,
+                               readers=readers)
+        out = []
+        for b in f.batches(512, pad_final=True):
+            out.append(b.copy())
+            f.recycle(b)
+        f.close()
+        got.append(out)
+    assert len(got[0]) == len(got[1])
+    for a, b in zip(*got):
+        assert np.array_equal(a, b)
+
+
+def test_odirect_falls_back_gracefully(tmp_path, monkeypatch):
+    """On filesystems that refuse O_DIRECT (EINVAL at open) the feed
+    must degrade to buffered reads with identical bytes, not fail."""
+    monkeypatch.setenv("WEED_EC_ODIRECT", "1")
+    size = 50_001
+    base = _write_dat(tmp_path, "1", size, seed=29)
+    f = feed_mod.open_feed(base + ".dat", GEO.data_shards, 1000,
+                           readers=2)
+    assert isinstance(f, feed_mod.PreadvFeed)  # odirect forces pread path
+    ref = feed_mod.MmapFeed(base + ".dat", GEO.data_shards, 1000,
+                            pool_buffers=3, readers=1)
+    got_a, got_b = [], []
+    for b in f.batches(stripe_segments(size, GEO, 1000)):
+        got_a.append(b.copy())
+        f.recycle(b)
+    for b in ref.batches(stripe_segments(size, GEO, 1000)):
+        got_b.append(b.copy())
+        ref.recycle(b)
+    f.close()
+    ref.close()
+    assert len(got_a) == len(got_b)
+    for a, b in zip(got_a, got_b):
+        assert np.array_equal(a, b)
+
+
+def test_odirect_staging_buffers_are_page_aligned():
+    pool = feed_mod.BufferPool(10, 8192, count=2, aligned=True)
+    for _ in range(2):
+        buf = pool.acquire()
+        assert buf.ctypes.data % feed_mod._ALIGN == 0
+        assert buf.shape == (10, 8192)
+
+
+def test_mid_read_close_unblocks_pool_threads_without_leaks(tmp_path):
+    """close() mid-iteration must wake a consumer starved for staging
+    buffers, terminate every reader-pool thread, and leave no staging
+    buffer lent beyond the batches the consumer still legitimately
+    holds (in-flight lookahead buffers recycle on the way out)."""
+    import threading
+    size = 256 * 1024
+    base = _write_dat(tmp_path, "1", size, seed=31)
+    f = feed_mod.PreadvFeed(base + ".dat", 10, 1024, pool_buffers=2,
+                            readers=3)
+    it = f.batches(stripe_segments(size, GEO, 1024))
+    held = [next(it), next(it)]  # drain the pool, never recycle
+    threads = list(f._rpool._threads)
+    assert threads and all(th.is_alive() for th in threads)
+
+    raised = threading.Event()
+
+    def puller():
+        try:
+            next(it)
+        except RuntimeError:
+            raised.set()
+
+    th = threading.Thread(target=puller, daemon=True)
+    th.start()
+    th.join(0.3)
+    assert th.is_alive(), "puller should be blocked awaiting a buffer"
+    f.close()
+    assert raised.wait(2.0), "close() left the consumer wedged"
+    th.join(2.0)
+    for worker in threads:
+        worker.join(2.0)
+        assert not worker.is_alive(), "close() leaked a pool thread"
+    # the only buffers still lent are the two the consumer holds
+    assert len(f._lent) <= len(held), "close() leaked staging buffers"

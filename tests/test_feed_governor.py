@@ -86,6 +86,39 @@ def test_bounds_are_hard(monkeypatch):
     assert op.depth <= 4
 
 
+def test_read_bound_widens_reader_pool(monkeypatch):
+    """Genuinely read-bound (slow per batch, dominant share) grows the
+    reader pool before deepening the prefetch queue: parallel preads
+    add disk bandwidth, depth only smooths bursts."""
+    monkeypatch.setenv("WEED_EC_READERS", "1")
+    monkeypatch.setenv("WEED_EC_READERS_MAX", "8")
+    gov = governor.FeedGovernor()
+    start = gov.plan(1 << 30, 10)
+    assert start.readers == 1
+    # slow reads: 5s over 8 batches = 0.625s/batch, share > 0.5
+    _fake_run(gov, read_s=5.0, dispatch_s=0.1, kernel_s=0.1, write_s=0.1)
+    op = gov.plan(1 << 30, 10)
+    assert op.readers == 2
+    assert op.depth == start.depth  # depth untouched while readers grow
+    for _ in range(2):              # 2 -> 4 -> 8
+        _fake_run(gov, read_s=5.0, dispatch_s=0.1, kernel_s=0.1,
+                  write_s=0.1)
+    op = gov.plan(1 << 30, 10)
+    assert op.readers == 8  # clamped at WEED_EC_READERS_MAX
+    assert op.depth == start.depth
+    # reader pool maxed: NOW depth deepens
+    _fake_run(gov, read_s=5.0, dispatch_s=0.1, kernel_s=0.1, write_s=0.1)
+    assert gov.plan(1 << 30, 10).depth == start.depth + 1
+
+
+def test_reader_count_exported_to_metrics(monkeypatch):
+    monkeypatch.setenv("WEED_EC_READERS", "3")
+    gov = governor.FeedGovernor()
+    gov.plan(1 << 30, 10)
+    text = metrics_mod.render_shared()
+    assert "seaweedfs_tpu_ec_feed_reader_threads 3" in text
+
+
 def test_disabled_governor_never_retunes(monkeypatch):
     monkeypatch.setenv("WEED_EC_GOVERNOR", "0")
     gov = governor.FeedGovernor()

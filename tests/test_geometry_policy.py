@@ -1,0 +1,257 @@
+"""Per-collection RS(k,m) geometry policy (WEED_EC_GEOMETRY).
+
+The policy is master-validated at startup, plumbed through assign ->
+encode plan -> the per-volume .ecm sidecar -> rebuild. Two invariants
+matter most:
+
+* a bad spec must REFUSE to run (a silently mis-parsed geometry would
+  stripe volumes wrong), and
+* the geometry a volume was ENCODED under travels with its shards in
+  the .ecm — rebuild/mount/decode never consult the live policy, so a
+  policy change can never re-shape bytes already on disk.
+"""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu import ec
+from seaweedfs_tpu.ec import pipeline
+from seaweedfs_tpu.ec.geometry import GeometryPolicy, parse_geometry
+from seaweedfs_tpu.ec.striping import read_marker_geometry
+
+MB = 1024 * 1024
+
+
+# ------------------------------------------------------------------ parsing
+
+def test_parse_geometry_accepts_k_plus_m():
+    g = parse_geometry("20+4")
+    assert (g.data_shards, g.parity_shards) == (20, 4)
+    g = parse_geometry("12,4")
+    assert (g.data_shards, g.parity_shards) == (12, 4)
+
+
+@pytest.mark.parametrize("bad", [
+    "0+4",        # k < 1
+    "10+0",       # m < 1
+    "30+4",       # k+m > 32 (ShardBits is a uint32)
+    "ten+four",   # not numbers
+    "10",         # missing m
+    "10+4+2",     # too many parts
+])
+def test_parse_geometry_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_geometry(bad)
+
+
+def test_policy_parse_and_lookup():
+    p = GeometryPolicy.parse("default=10+4,archive=20+4,media=12+4")
+    assert p.for_collection("archive").total_shards == 24
+    assert p.for_collection("media").data_shards == 12
+    assert p.for_collection("") == ec.DEFAULT
+    assert p.for_collection("unknown") == ec.DEFAULT
+
+
+def test_policy_bare_spec_sets_default():
+    p = GeometryPolicy.parse("12+4")
+    assert p.default.data_shards == 12
+    assert p.for_collection("anything").data_shards == 12
+
+
+def test_policy_rejects_duplicates_and_bad_entries():
+    with pytest.raises(ValueError):
+        GeometryPolicy.parse("a=10+4,a=12+4")
+    with pytest.raises(ValueError):
+        GeometryPolicy.parse("a=33+4")
+
+
+def test_policy_dict_roundtrip():
+    p = GeometryPolicy.parse("default=12+4,archive=20+4")
+    d = p.to_dict()
+    assert d == {"default": "12+4", "archive": "20+4"}
+    q = GeometryPolicy.from_dict(d)
+    assert q.default == p.default
+    assert q.per_collection == p.per_collection
+
+
+def test_policy_from_env(monkeypatch):
+    monkeypatch.setenv("WEED_EC_GEOMETRY", "archive=20+4")
+    p = GeometryPolicy.from_env()
+    assert p.for_collection("archive").total_shards == 24
+    monkeypatch.setenv("WEED_EC_GEOMETRY", "archive=99+4")
+    with pytest.raises(ValueError):
+        GeometryPolicy.from_env()
+
+
+def test_master_validates_policy_at_startup(monkeypatch):
+    from seaweedfs_tpu.server.master import MasterServer
+    monkeypatch.setenv("WEED_EC_GEOMETRY", "archive=20+4")
+    m = MasterServer(url="127.0.0.1:9")
+    assert m.ec_total_shards_for("archive") == 24
+    assert m.ec_total_shards_for("") == 14  # legacy knob still rules
+    assert m.ec_policy.to_dict()["archive"] == "20+4"
+    # a broken spec kills the master AT CONSTRUCTION, not at encode time
+    monkeypatch.setenv("WEED_EC_GEOMETRY", "archive=broken")
+    with pytest.raises(ValueError):
+        MasterServer(url="127.0.0.1:9")
+
+
+# ----------------------------------------------------- wide-geometry encode
+
+WIDE = ec.Geometry(data_shards=20, parity_shards=4,
+                   large_block_size=10000, small_block_size=100)
+
+
+def _write_dat(tmp_path, name: str, size: int, seed: int) -> str:
+    rng = np.random.default_rng(seed)
+    base = os.path.join(str(tmp_path), name)
+    with open(base + ".dat", "wb") as f:
+        f.write(rng.integers(0, 256, size, dtype=np.uint8).tobytes())
+    return base
+
+
+def _sha(path: str) -> str:
+    return hashlib.sha256(open(path, "rb").read()).hexdigest()
+
+
+def test_wide_geometry_pipeline_matches_striping(tmp_path):
+    """RS(20,4) through the streaming pipeline is byte-identical to the
+    reference-shaped synchronous writer — the wide-geometry formulation
+    is a pure policy choice, not a different layout."""
+    size = 61_007
+    coder = ec.get_coder("numpy", 20, 4)
+    base_a = _write_dat(tmp_path, "a_1", size, seed=3)
+    ec.write_ec_files(base_a, coder, WIDE, buffer_size=100)
+    base_b = _write_dat(tmp_path, "b_1", size, seed=3)
+    pipeline.stream_encode(base_b, coder, WIDE, batch_size=1000)
+    for i in range(24):
+        assert _sha(base_a + ec.to_ext(i)) == _sha(base_b + ec.to_ext(i))
+
+
+def test_marker_records_geometry_and_rebuild_uses_it(tmp_path):
+    """The .ecm records the encode geometry; a wide-geometry rebuild
+    reconstructs byte-identical shards from any k survivors."""
+    size = 47_501
+    base = _write_dat(tmp_path, "1", size, seed=5)
+    coder = ec.get_coder("numpy", 20, 4)
+    pipeline.stream_encode(base, coder, WIDE, batch_size=1000)
+    g = read_marker_geometry(base)
+    assert g is not None
+    assert (g.data_shards, g.parity_shards) == (20, 4)
+    assert g.large_block_size == 10000
+    golden = {i: _sha(base + ec.to_ext(i)) for i in range(24)}
+    victims = [0, 5, 21, 23]
+    for v in victims:
+        os.remove(base + ec.to_ext(v))
+    rebuilt = pipeline.stream_rebuild(base, coder, WIDE, batch_size=512)
+    assert sorted(rebuilt) == victims
+    for i in range(24):
+        assert _sha(base + ec.to_ext(i)) == golden[i]
+
+
+def test_marker_geometry_absent_for_legacy_markers(tmp_path):
+    import json
+    base = os.path.join(str(tmp_path), "1")
+    with open(base + ".ecm", "w") as f:
+        json.dump({"layout_version": 2, "dat_size": 100}, f)
+    assert read_marker_geometry(base) is None
+
+
+# ------------------------------------------------- store-level policy plumb
+
+def test_store_encodes_per_collection_and_rebuilds_from_marker(tmp_path):
+    """A store with WEED_EC_GEOMETRY=archive=4+2 seals archive volumes
+    into 6 shards; rebuild resolves the geometry from the .ecm even
+    after the policy changes (bytes on disk never re-shape)."""
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.store import Store
+
+    policy = GeometryPolicy.parse("archive=4+2")
+    store = Store([str(tmp_path)], coder_name="numpy",
+                  geometry_policy=policy)
+    assert store.geometry_for("archive").total_shards == 6
+    assert store.geometry_for("").total_shards == 14
+
+    vid = 7
+    store.add_volume(vid, collection="archive")
+    for i in range(4):
+        n = Needle(id=i + 1, cookie=1, data=os.urandom(2000) * 3)
+        store.write_needle(vid, n)
+    shards = store.ec_generate(vid)
+    assert shards == list(range(6))
+    base = store.find_volume(vid).base_file_name()
+    for sid in range(6):
+        assert os.path.exists(base + ec.to_ext(sid))
+    assert not os.path.exists(base + ec.to_ext(6))
+    g = read_marker_geometry(base)
+    assert (g.data_shards, g.parity_shards) == (4, 2)
+
+    golden = {i: _sha(base + ec.to_ext(i)) for i in range(6)}
+    os.remove(base + ec.to_ext(1))
+    os.remove(base + ec.to_ext(5))
+    # rebuild under a DIFFERENT live policy: the marker must win
+    store.geometry_policy = GeometryPolicy.parse("archive=10+4")
+    rebuilt = store.ec_rebuild(vid, "archive")
+    assert sorted(rebuilt) == [1, 5]
+    for i in range(6):
+        assert _sha(base + ec.to_ext(i)) == golden[i]
+
+
+def test_store_generate_many_matches_single(tmp_path):
+    """A windowed ec_generate_many (one governed executable back-to-back)
+    produces byte-identical shards to per-volume ec_generate."""
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.store import Store
+
+    import shutil
+
+    vol_dir = tmp_path / "vols"
+    vol_dir.mkdir()
+    policy = GeometryPolicy.parse("arc=4+2")
+    store = Store([str(vol_dir)], coder_name="numpy",
+                  geometry_policy=policy)
+    for vid in (3, 4):
+        store.add_volume(vid, collection="arc")
+        for i in range(3):
+            n = Needle(id=i + 1, cookie=1,
+                       data=(bytes([vid, i]) * 1500))
+            store.write_needle(vid, n)
+    # snapshot each .dat, encode the window, then verify every volume
+    # against the reference-shaped writer over its own snapshot
+    refs = {}
+    for vid in (3, 4):
+        v = store.find_volume(vid)
+        v.sync()
+        ref = str(tmp_path / f"ref_{vid}")
+        shutil.copyfile(v.base_file_name() + ".dat", ref + ".dat")
+        refs[vid] = ref
+    out = store.ec_generate_many([3, 4])
+    assert set(out) == {3, 4}
+    assert out[3] == list(range(6))
+    g = store.geometry_for("arc")
+    coder = ec.get_coder("numpy", 4, 2)
+    for vid in (3, 4):
+        ec.write_ec_files(refs[vid], coder, g)
+        base = store.find_volume(vid).base_file_name()
+        for sid in range(6):
+            assert _sha(base + ec.to_ext(sid)) == \
+                _sha(refs[vid] + ec.to_ext(sid)), (vid, sid)
+
+
+def test_ec_commands_geometry_for_reads_master_policy():
+    from seaweedfs_tpu.shell.ec_commands import EcCommands
+
+    class FakeClient:
+        def dir_status(self):
+            return {"nodes": [], "ec_geometry": {"default": "10+4",
+                                                 "archive": "20+4"}}
+
+    cmds = EcCommands(FakeClient())
+    assert cmds.geometry_for("archive").total_shards == 24
+    assert cmds.geometry_for("media").total_shards == 14
+    # an explicit non-default geometry pins every plan (test clusters)
+    pinned = EcCommands(FakeClient(), WIDE)
+    assert pinned.geometry_for("anything") is WIDE

@@ -638,3 +638,83 @@ def test_s3_lifecycle_rules_end_to_end():
         assert _s3_req(port, "GET", "/b1?lifecycle")[0] == 404
     finally:
         c.shutdown()
+
+
+def test_encode_batcher_coalesces_same_source():
+    """Concurrent warm transitions sharing a source must encode as ONE
+    multi-volume ec/generate window; distinct sources stay separate."""
+    import asyncio
+
+    from seaweedfs_tpu.lifecycle.daemon import _EncodeBatcher
+
+    calls = []
+
+    class FakeMaster:
+        async def _admin_post(self, url, path, body, timeout=None):
+            calls.append((url, path, body))
+
+    class FakeDaemon:
+        master = FakeMaster()
+        _tasks: set = set()
+
+    async def run():
+        b = _EncodeBatcher(FakeDaemon(), linger=0.05)
+        await asyncio.gather(b.encode("v1:8080", 1),
+                             b.encode("v1:8080", 2),
+                             b.encode("v2:8080", 3))
+
+    asyncio.run(run())
+    v1 = [c for c in calls if c[0] == "v1:8080"]
+    assert len(v1) == 1, calls
+    assert sorted(v1[0][2]["volume_ids"]) == [1, 2]
+    v2 = [c for c in calls if c[0] == "v2:8080"]
+    assert len(v2) == 1 and v2[0][2] == {"volume_id": 3}
+
+
+def test_encode_batcher_window_cap_flushes_immediately(monkeypatch):
+    import asyncio
+
+    from seaweedfs_tpu.lifecycle import daemon as daemon_mod
+
+    monkeypatch.setenv("WEED_EC_ENCODE_WINDOW", "2")
+    calls = []
+
+    class FakeMaster:
+        async def _admin_post(self, url, path, body, timeout=None):
+            calls.append(body)
+
+    class FakeDaemon:
+        master = FakeMaster()
+        _tasks: set = set()
+
+    async def run():
+        b = daemon_mod._EncodeBatcher(FakeDaemon(), linger=5.0)
+        # linger is far longer than the test: only the window cap can
+        # flush, proving a full window never waits out the linger
+        await asyncio.wait_for(
+            asyncio.gather(b.encode("v1:8080", 1), b.encode("v1:8080", 2)),
+            timeout=2.0)
+
+    asyncio.run(run())
+    assert calls and sorted(calls[0]["volume_ids"]) == [1, 2]
+
+
+def test_encode_batcher_propagates_failure():
+    import asyncio
+
+    from seaweedfs_tpu.lifecycle.daemon import _EncodeBatcher
+
+    class FakeMaster:
+        async def _admin_post(self, url, path, body, timeout=None):
+            raise RuntimeError("generate blew up")
+
+    class FakeDaemon:
+        master = FakeMaster()
+        _tasks: set = set()
+
+    async def run():
+        b = _EncodeBatcher(FakeDaemon(), linger=0.05)
+        with pytest.raises(RuntimeError, match="generate blew up"):
+            await b.encode("v1:8080", 1)
+
+    asyncio.run(run())
