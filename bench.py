@@ -1242,6 +1242,126 @@ def phase_degraded(work: str, budget_s: float = 240.0,
     return out
 
 
+def _reader_storm(vport: int, fids: list, n_fg: int, n_bg: int,
+                  seconds: float, breaker=None) -> dict:
+    """Closed-loop reader storm against the volume fastpath
+    (shared by phase_overload and phase_georepl — the georepl
+    acceptance measures replication lag under exactly the
+    overload phase's 3x-offered saturation shape).
+
+    fg workers ride raw keep-alive connections and never honor
+    Retry-After (they ARE the overload); bg workers go through
+    HttpPool so shed answers exercise the breaker-exemption
+    path."""
+    import http.client as http_client
+    import random as random_mod
+    import threading
+
+    from seaweedfs_tpu.cache.http_pool import HttpPool
+    results: list = []
+    lock = threading.Lock()
+    stop_at = time.perf_counter() + seconds
+    pool = HttpPool(breaker=breaker, shed_retries=0) \
+        if n_bg else None
+
+    def fg_worker(seed: int) -> None:
+        r = random_mod.Random(seed)
+        conn = None
+        codes: dict = {}
+        lat: list = []
+        while time.perf_counter() < stop_at:
+            fid = fids[r.randrange(len(fids))]
+            t0 = time.perf_counter()
+            try:
+                if conn is None:
+                    conn = http_client.HTTPConnection(
+                        "127.0.0.1", vport, timeout=10)
+                conn.request("GET", f"/{fid}")
+                resp = conn.getresponse()
+                resp.read()
+                code = resp.status
+                if resp.will_close:
+                    conn.close()
+                    conn = None
+            except Exception:
+                if conn is not None:
+                    conn.close()
+                conn = None
+                continue
+            codes[code] = codes.get(code, 0) + 1
+            if code == 200:
+                lat.append(time.perf_counter() - t0)
+            else:
+                # hold the offered rate instead of amplifying
+                # it: an instantly-answered 503 re-sent in a
+                # tight loop would turn "3x offered" into an
+                # unbounded retry storm whose client threads
+                # also starve the single-core server of CPU —
+                # exactly the anti-pattern Retry-After exists
+                # to prevent
+                time.sleep(0.05)
+        if conn is not None:
+            conn.close()
+        with lock:
+            results.append(("fg", codes, lat))
+
+    def bg_worker(seed: int) -> None:
+        r = random_mod.Random(seed)
+        codes: dict = {}
+        while time.perf_counter() < stop_at:
+            fid = fids[r.randrange(len(fids))]
+            try:
+                resp = pool.request(
+                    "GET", f"http://127.0.0.1:{vport}/{fid}",
+                    headers={"X-Seaweed-Priority": "bg"},
+                    timeout=10)
+                codes[resp.status] = codes.get(resp.status,
+                                               0) + 1
+            except Exception:
+                continue
+            time.sleep(0.01)  # repair-ish pacing, still pushy
+        with lock:
+            results.append(("bg", codes, {}))
+
+    threads = [threading.Thread(target=fg_worker, args=(i,))
+               for i in range(n_fg)]
+    threads += [threading.Thread(target=bg_worker,
+                                 args=(1000 + i,))
+                for i in range(n_bg)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if pool is not None:
+        pool.close()
+    fg_codes: dict = {}
+    bg_codes: dict = {}
+    fg_lat: list = []
+    for cls, codes, lat in results:
+        tgt = fg_codes if cls == "fg" else bg_codes
+        for k, v in codes.items():
+            tgt[k] = tgt.get(k, 0) + v
+        fg_lat.extend(lat)
+    fg_lat.sort()
+
+    def pctl(q: float) -> float:
+        if not fg_lat:
+            return 0.0
+        return round(fg_lat[min(len(fg_lat) - 1,
+                                int(len(fg_lat) * q))] * 1e3, 3)
+
+    return {
+        "goodput_req_s": round(fg_codes.get(200, 0) / seconds,
+                               1),
+        "fg_codes": {str(k): v for k, v in
+                     sorted(fg_codes.items())},
+        "bg_codes": {str(k): v for k, v in
+                     sorted(bg_codes.items())},
+        "p50_ms": pctl(0.50),
+        "p99_ms": pctl(0.99),
+    }
+
+
 def phase_overload(work: str, budget_s: float = 150.0) -> dict:
     """Admitted goodput and p99 at >=2x offered saturation — the
     overload plane's headline numbers. A combined server boots with a
@@ -1255,10 +1375,8 @@ def phase_overload(work: str, budget_s: float = 150.0) -> dict:
     while fg is being shed (server-side inversion counter AND
     client-side observation), and no circuit breaker opened by shed
     responses (bg riders use a threshold-1 breaker)."""
-    import http.client as http_client
     import random as random_mod
     import socket
-    import threading
     import urllib.request
 
     started = time.perf_counter()
@@ -1269,7 +1387,6 @@ def phase_overload(work: str, budget_s: float = 150.0) -> dict:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from seaweedfs_tpu.client import Client
-    from seaweedfs_tpu.cache.http_pool import HttpPool
     from seaweedfs_tpu.utils.retry import CircuitBreaker
 
     import seaweedfs_tpu
@@ -1331,123 +1448,15 @@ def phase_overload(work: str, budget_s: float = 150.0) -> dict:
             headers={"Content-Type": "application/json"})
         urllib.request.urlopen(req, timeout=10).close()
 
-        def storm(n_fg: int, n_bg: int, seconds: float,
-                  breaker=None) -> dict:
-            """Closed-loop reader storm against the volume fastpath.
-            fg workers ride raw keep-alive connections and never honor
-            Retry-After (they ARE the overload); bg workers go through
-            HttpPool so shed answers exercise the breaker-exemption
-            path."""
-            results: list = []
-            lock = threading.Lock()
-            stop_at = time.perf_counter() + seconds
-            pool = HttpPool(breaker=breaker, shed_retries=0) \
-                if n_bg else None
-
-            def fg_worker(seed: int) -> None:
-                r = random_mod.Random(seed)
-                conn = None
-                codes: dict = {}
-                lat: list = []
-                while time.perf_counter() < stop_at:
-                    fid = fids[r.randrange(len(fids))]
-                    t0 = time.perf_counter()
-                    try:
-                        if conn is None:
-                            conn = http_client.HTTPConnection(
-                                "127.0.0.1", vport, timeout=10)
-                        conn.request("GET", f"/{fid}")
-                        resp = conn.getresponse()
-                        resp.read()
-                        code = resp.status
-                        if resp.will_close:
-                            conn.close()
-                            conn = None
-                    except Exception:
-                        if conn is not None:
-                            conn.close()
-                        conn = None
-                        continue
-                    codes[code] = codes.get(code, 0) + 1
-                    if code == 200:
-                        lat.append(time.perf_counter() - t0)
-                    else:
-                        # hold the offered rate instead of amplifying
-                        # it: an instantly-answered 503 re-sent in a
-                        # tight loop would turn "3x offered" into an
-                        # unbounded retry storm whose client threads
-                        # also starve the single-core server of CPU —
-                        # exactly the anti-pattern Retry-After exists
-                        # to prevent
-                        time.sleep(0.05)
-                if conn is not None:
-                    conn.close()
-                with lock:
-                    results.append(("fg", codes, lat))
-
-            def bg_worker(seed: int) -> None:
-                r = random_mod.Random(seed)
-                codes: dict = {}
-                while time.perf_counter() < stop_at:
-                    fid = fids[r.randrange(len(fids))]
-                    try:
-                        resp = pool.request(
-                            "GET", f"http://127.0.0.1:{vport}/{fid}",
-                            headers={"X-Seaweed-Priority": "bg"},
-                            timeout=10)
-                        codes[resp.status] = codes.get(resp.status,
-                                                       0) + 1
-                    except Exception:
-                        continue
-                    time.sleep(0.01)  # repair-ish pacing, still pushy
-                with lock:
-                    results.append(("bg", codes, {}))
-
-            threads = [threading.Thread(target=fg_worker, args=(i,))
-                       for i in range(n_fg)]
-            threads += [threading.Thread(target=bg_worker,
-                                         args=(1000 + i,))
-                        for i in range(n_bg)]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
-            if pool is not None:
-                pool.close()
-            fg_codes: dict = {}
-            bg_codes: dict = {}
-            fg_lat: list = []
-            for cls, codes, lat in results:
-                tgt = fg_codes if cls == "fg" else bg_codes
-                for k, v in codes.items():
-                    tgt[k] = tgt.get(k, 0) + v
-                fg_lat.extend(lat)
-            fg_lat.sort()
-
-            def pctl(q: float) -> float:
-                if not fg_lat:
-                    return 0.0
-                return round(fg_lat[min(len(fg_lat) - 1,
-                                        int(len(fg_lat) * q))] * 1e3, 3)
-
-            return {
-                "goodput_req_s": round(fg_codes.get(200, 0) / seconds,
-                                       1),
-                "fg_codes": {str(k): v for k, v in
-                             sorted(fg_codes.items())},
-                "bg_codes": {str(k): v for k, v in
-                             sorted(bg_codes.items())},
-                "p50_ms": pctl(0.50),
-                "p99_ms": pctl(0.99),
-            }
-
-        peak = storm(8, 0, min(4.0, max(left() - 30, 2.0)))
+        peak = _reader_storm(vport, fids, 8, 0,
+                             min(4.0, max(left() - 30, 2.0)))
         out["peak"] = peak
         _phase_checkpoint(work, "overload", out)
 
         breaker = CircuitBreaker(failure_threshold=1)
-        over = storm(24, 4, min(5.0, max(left() - 15, 2.0)),
-                     breaker=breaker)
+        over = _reader_storm(vport, fids, 24, 4,
+                             min(5.0, max(left() - 15, 2.0)),
+                             breaker=breaker)
         out["overload"] = over
         out["offered_factor"] = 3.0  # 24 closed-loop readers vs 8
         peak_good = max(peak["goodput_req_s"], 1e-6)
@@ -1505,6 +1514,256 @@ def phase_overload(work: str, budget_s: float = 150.0) -> dict:
         except subprocess.TimeoutExpired:
             proc.kill()
         logf.close()
+        time.sleep(0.5)
+    return out
+
+
+
+def phase_georepl(work: str, budget_s: float = 240.0) -> dict:
+    """Cluster-to-cluster replication lag: steady-state vs under the
+    overload storm.  Two combined servers (master+volume+filer) boot as
+    separate clusters; the primary's geo daemon replicates bucket "geo"
+    to the replica per a PutBucketReplication-shaped rule.  Lag is
+    measured end-to-end with PROBE objects: write through the primary
+    filer, poll the replica filer until the bytes are visible — no
+    trust in internal gauges.  The storm phase replays phase_overload's
+    3x-offered saturation (_reader_storm, 24 closed-loop fg readers
+    against the primary volume fastpath with a 20ms injected service
+    time) while probes keep flowing.  Acceptance: storm-phase median
+    lag <= 2x steady-state median (floored at 0.25s — sub-100ms medians
+    make the ratio noise), zero priority inversions at the primary
+    (replication traffic is CLASS_BG and must shed first, never
+    displace fg), zero poisoned events."""
+    import socket
+    import threading
+    import urllib.request
+
+    started = time.perf_counter()
+
+    def left() -> float:
+        return budget_s - (time.perf_counter() - started)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from seaweedfs_tpu.client import Client
+
+    import seaweedfs_tpu
+    pkg_root = os.path.dirname(os.path.dirname(seaweedfs_tpu.__file__))
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    pm, pv, pf = free_port(), free_port(), free_port()
+    rm, rv, rf = free_port(), free_port(), free_port()
+    base_env = dict(os.environ, JAX_PLATFORMS="cpu",
+                    SEAWEEDFS_FORCE_CPU="1")
+    base_env["PYTHONPATH"] = pkg_root + os.pathsep + \
+        base_env.get("PYTHONPATH", "")
+    # the primary gets the small fg pipe + the geo daemon; the replica
+    # is a plain cluster
+    prim_env = dict(base_env,
+                    WEED_GEO_FILER=f"127.0.0.1:{pf}",
+                    WEED_GEO_INTERVAL="0.5",
+                    WEED_ADMISSION_FG_CONCURRENCY="8",
+                    WEED_ADMISSION_FG_QUEUE="8",
+                    WEED_ADMISSION_QUEUE_TIMEOUT_MS="2000",
+                    WEED_ADMISSION_BG_CONCURRENCY="4",
+                    WEED_ADMISSION_RETRY_AFTER_S="1")
+
+    def boot(tag: str, env: dict, mport: int, vport: int,
+             fport: int):
+        data_dir = os.path.join(work, f"georepl_{tag}")
+        os.makedirs(data_dir, exist_ok=True)
+        logf = open(os.path.join(work, f"georepl_{tag}.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu.cli", "server",
+             "-ip", "127.0.0.1", "-master_port", str(mport),
+             "-port", str(vport), "-dir", data_dir,
+             "-filer", "-filer_port", str(fport),
+             "-filer_db", os.path.join(data_dir, "filer.db")],
+            cwd=data_dir, env=env, stdout=logf, stderr=logf)
+        return proc, logf
+
+    def wait_up(mport: int, fport: int) -> None:
+        deadline = time.time() + 60
+        while True:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{mport}/dir/assign",
+                        timeout=2) as r:
+                    if "fid" in json.loads(r.read()):
+                        with urllib.request.urlopen(
+                                f"http://127.0.0.1:{fport}/healthz",
+                                timeout=2):
+                            return
+            except Exception:
+                pass
+            if time.time() > deadline:
+                raise RuntimeError("georepl cluster failed to start")
+            time.sleep(0.3)
+
+    def http(method: str, url: str, body=None, headers=None):
+        req = urllib.request.Request(url, data=body, method=method,
+                                     headers=headers or {})
+        with urllib.request.urlopen(req, timeout=20) as r:
+            return r.status, r.read()
+
+    def filer_put(fport: int, path: str, data: bytes) -> None:
+        http("PUT", f"http://127.0.0.1:{fport}{path}", data,
+             {"Content-Type": "application/octet-stream"})
+
+    def replica_has(path: str, want: bytes) -> bool:
+        try:
+            return http("GET",
+                        f"http://127.0.0.1:{rf}{path}")[1] == want
+        except Exception:
+            return False
+
+    out: dict = {}
+    prim, prim_log = boot("primary", prim_env, pm, pv, pf)
+    repl, repl_log = boot("replica", base_env, rm, rv, rf)
+    try:
+        wait_up(pm, pf)
+        wait_up(rm, rf)
+        # bucket on both sides + the replication rule on the primary's
+        # bucket entry (the JSON the S3 PutBucketReplication route
+        # stores; set via the meta API so the phase needs no gateway)
+        for fport in (pf, rf):
+            http("POST",
+                 f"http://127.0.0.1:{fport}/buckets/geo?op=mkdir")
+        rule = [{"id": "bench", "status": "Enabled", "prefix": "",
+                 "dest_bucket": "geo",
+                 "endpoint": f"127.0.0.1:{rf}"}]
+        entry = {"path": "/buckets/geo",
+                 "attr": {"mode": 0o40770, "mtime": time.time(),
+                          "crtime": time.time()},
+                 "chunks": [],
+                 "extended": {"seaweed-replication":
+                              json.dumps(rule, sort_keys=True)}}
+        http("POST", f"http://127.0.0.1:{pf}/__meta__/create_entry",
+             json.dumps({"entry": entry}).encode(),
+             {"Content-Type": "application/json"})
+        http("POST", f"http://127.0.0.1:{pm}/geo/run",
+             json.dumps({}).encode(),
+             {"Content-Type": "application/json"})
+
+        rng = __import__("random").Random(17)
+        blob = bytes(rng.getrandbits(8) for _ in range(4096))
+
+        def probe_lag(tag: str, n: int, spacing: float) -> list:
+            """Replication lag per probe: time from the primary WRITE
+            COMPLETING to the bytes being readable on the replica.  A
+            shed PUT (the storm saturates the fg pipe; the filer
+            answers 502/503) is retried like any cooperative client
+            would — that admission wait is the overload plane's number
+            (phase_overload p99), not geo lag, so the lag clock starts
+            when the write lands."""
+            lags = []
+            for i in range(n):
+                path = f"/buckets/geo/{tag}{i:03d}"
+                t_first = time.perf_counter()
+                put_ok = False
+                while True:
+                    try:
+                        filer_put(pf, path, blob)
+                        put_ok = True
+                        break
+                    except Exception:
+                        if time.perf_counter() - t_first > 30:
+                            break
+                        time.sleep(0.1)
+                if not put_ok:
+                    lags.append(30.0)  # the WRITE never landed
+                    continue
+                t0 = time.perf_counter()
+                while not replica_has(path, blob):
+                    if time.perf_counter() - t0 > 30:
+                        lags.append(30.0)  # loudly saturated, not lost
+                        break
+                    time.sleep(0.02)
+                else:
+                    lags.append(time.perf_counter() - t0)
+                time.sleep(spacing)
+            return lags
+
+        def med(xs: list) -> float:
+            ys = sorted(xs)
+            return ys[len(ys) // 2] if ys else 0.0
+
+        # steady state
+        steady = probe_lag("s", 10, 0.2)
+        out["steady_lag_s"] = {
+            "median": round(med(steady), 3),
+            "max": round(max(steady), 3),
+            "samples": [round(x, 3) for x in steady]}
+        _phase_checkpoint(work, "georepl", out)
+
+        # the overload storm: 20ms injected volume.read service time +
+        # 24 closed-loop fg readers = phase_overload's 3x-offered shape
+        client = Client(f"127.0.0.1:{pm}")
+        fids = [client.upload(blob[:1024]) for _ in range(32)]
+        http("POST", f"http://127.0.0.1:{pv}/admin/faults",
+             json.dumps({"set": [{"point": "volume.read",
+                                  "action": "delay",
+                                  "ms": 20}]}).encode(),
+             {"Content-Type": "application/json"})
+        storm_secs = min(10.0, max(left() - 40, 4.0))
+        storm_out: dict = {}
+
+        def run_storm() -> None:
+            storm_out.update(_reader_storm(pv, fids, 24, 0,
+                                           storm_secs))
+
+        storm_thread = threading.Thread(target=run_storm)
+        storm_thread.start()
+        time.sleep(0.3)  # let the storm form before probing
+        stormy = probe_lag("o", 8, 0.1)
+        storm_thread.join()
+        http("POST", f"http://127.0.0.1:{pv}/admin/faults",
+             json.dumps({"clear": "*"}).encode(),
+             {"Content-Type": "application/json"})
+        out["storm"] = storm_out
+        out["storm_lag_s"] = {
+            "median": round(med(stormy), 3),
+            "max": round(max(stormy), 3),
+            "samples": [round(x, 3) for x in stormy]}
+        _phase_checkpoint(work, "georepl", out)
+
+        # evidence: inversions + geo job state
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{pv}/metrics", timeout=10) as r:
+            vol_metrics = r.read().decode()
+        inversions = 0.0
+        for line in vol_metrics.splitlines():
+            if line.startswith("admission_inversion_total"):
+                inversions = float(line.rsplit(" ", 1)[1])
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{pm}/geo/status", timeout=10) as r:
+            geo_status = json.loads(r.read())
+        job = (geo_status.get("jobs") or {}).get("geo", {})
+        out["geo_job"] = {k: job.get(k) for k in
+                          ("applied", "skipped", "poisoned", "state",
+                           "lag_s")}
+        out["inversions"] = inversions
+        steady_floor = max(out["steady_lag_s"]["median"], 0.25)
+        out["lag_ratio"] = round(
+            out["storm_lag_s"]["median"] / steady_floor, 3)
+        out["acceptance"] = {
+            "storm_lag_le_2x_steady": out["lag_ratio"] <= 2.0,
+            "zero_inversions": inversions == 0,
+            "zero_poisoned": (job.get("poisoned") or 0) == 0,
+        }
+        _phase_checkpoint(work, "georepl", out)
+    finally:
+        for proc, logf in ((prim, prim_log), (repl, repl_log)):
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+            logf.close()
         time.sleep(0.5)
     return out
 
@@ -1949,6 +2208,20 @@ def main() -> None:
         detail["lifecycle"] = lifecycle
         _checkpoint(detail)
 
+        georepl: dict = {"error": "skipped (budget)"}
+        if left() > 90:
+            try:
+                georepl = phase_georepl(
+                    work, budget_s=min(240.0, left() - 30.0))
+                _log(f"georepl: steady lag "
+                     f"{(georepl.get('steady_lag_s') or {}).get('median')}s, "
+                     f"storm ratio {georepl.get('lag_ratio')}")
+            except Exception as e:
+                georepl = {"error": str(e),
+                           **_load_partial(work, "georepl")}
+        detail["georepl"] = georepl
+        _checkpoint(detail)
+
         try:
             lint = phase_lint(work)
             _log(f"lint: {lint.get('lint_wall_s')}s over "
@@ -2030,6 +2303,9 @@ def main() -> None:
                     lifecycle.get("time_to_warm_all_s"),
                 "lifecycle_hot_p50_ratio":
                     lifecycle.get("hot_p50_ratio"),
+                "georepl_steady_lag_s":
+                    (georepl.get("steady_lag_s") or {}).get("median"),
+                "georepl_lag_ratio": georepl.get("lag_ratio"),
                 "lint_wall_s": lint.get("lint_wall_s"),
                 "detail_file": "BENCH_DETAIL.json",
             },
@@ -2052,6 +2328,7 @@ if __name__ == "__main__":
               "largefile": phase_largefile,
               "overload": lambda w: phase_overload(w, budget_s=budget),
               "lifecycle": lambda w: phase_lifecycle(w, budget_s=budget),
+              "georepl": lambda w: phase_georepl(w, budget_s=budget),
               "lint": lambda w: phase_lint(w, budget_s=budget),
               }[name]
         print(json.dumps(fn(work)))

@@ -68,11 +68,11 @@ def _daemon_loop_violations(node: ast.AsyncFunctionDef):
 @register
 class DaemonLoopShedable(Rule):
     name = "daemon-loop-shedable"
-    rationale = ("every lifecycle daemon loop must bind CLASS_BG (so "
-                 "its fan-out sheds before foreground traffic) and "
+    rationale = ("every lifecycle/geo daemon loop must bind CLASS_BG "
+                 "(so its fan-out sheds before foreground traffic) and "
                  "sleep on a jittered interval (no fleet-wide lockstep "
                  "scans)")
-    scope = ("seaweedfs_tpu/lifecycle/",)
+    scope = ("seaweedfs_tpu/lifecycle/", "seaweedfs_tpu/geo/")
     fixture_relpath = "seaweedfs_tpu/lifecycle/_fixture.py"
     fixture = (
         "async def scan_loop():\n"
@@ -98,16 +98,23 @@ class DaemonLoopShedable(Rule):
                 yield self.diag(mod, lineno, problem)
 
     def check_project(self, mods):
-        # the guard must be guarding something: if lifecycle/ lost its
-        # daemon loop entirely, fail loudly instead of certifying air
-        for mod in mods:
-            for node in mod.walk():
-                if isinstance(node, ast.AsyncFunctionDef) and any(
-                        isinstance(n, ast.While)
-                        for n in walk_body(node)):
-                    return
-        for mod in mods:
-            if mod.relpath.endswith("/daemon.py"):
+        # the guard must be guarding something — PER PLANE: each scoped
+        # directory that ships a daemon.py must still contain an async
+        # daemon loop, or the guard certifies air for that plane while
+        # the other plane's loop keeps it green
+        for prefix in self.scope:
+            plane = [m for m in mods if m.relpath.startswith(prefix)]
+            daemon_mod = next((m for m in plane
+                               if m.relpath.endswith("/daemon.py")),
+                              None)
+            if daemon_mod is None:
+                continue
+            has_loop = any(
+                isinstance(node, ast.AsyncFunctionDef) and any(
+                    isinstance(n, ast.While) for n in walk_body(node))
+                for mod in plane for node in mod.walk())
+            if not has_loop:
                 yield self.diag(
-                    mod, 1, "lifecycle/ contains no async daemon loop "
-                    "— the daemon-loop guard guards nothing")
+                    daemon_mod, 1,
+                    f"{prefix} contains no async daemon loop — the "
+                    f"daemon-loop guard guards nothing there")

@@ -74,11 +74,19 @@ class FaultPointRegistry(Rule):
         "from . import faults\n"
         "async def write(self):\n"
         "    await faults.fire_async('volume.wrlte')\n"  # typo
+        "async def geo_apply(self):\n"
+        "    await faults.fire_async('geo.aply')\n"      # typo
+        "def geo_stream(self):\n"
+        "    faults.fire('geo.straem')\n"                # typo
     )
     clean_fixture = (
         "from . import faults\n"
         "async def write(self):\n"
         "    await faults.fire_async('volume.write')\n"
+        "async def geo_apply(self):\n"
+        "    await faults.fire_async('geo.apply')\n"
+        "def geo_stream(self):\n"
+        "    faults.fire('geo.stream')\n"
     )
 
     def check_project(self, mods):

@@ -48,6 +48,35 @@ class PoolResponse:
         return json.loads(self.data)
 
 
+class StreamResponse:
+    """A live streaming response from :meth:`HttpPool.stream` — iterate
+    for raw lines, close when done (a context manager for both)."""
+
+    def __init__(self, conn, resp):
+        self._conn = conn
+        self.resp = resp
+        self.status = resp.status
+        self.headers = {k.lower(): v for k, v in resp.getheaders()}
+
+    def __iter__(self):
+        return iter(self.resp)
+
+    def readline(self) -> bytes:
+        return self.resp.readline()
+
+    def close(self) -> None:
+        try:
+            self.resp.close()
+        finally:
+            self._conn.close()
+
+    def __enter__(self) -> "StreamResponse":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
 class HttpPool:
     def __init__(self, max_idle_per_host: int = 8,
                  timeout: float = 30.0, metrics=None, breaker=None,
@@ -234,6 +263,70 @@ class HttpPool:
         if breaker is not None:
             breaker.record_failure(hostkey)
         raise last
+
+    def stream(self, method: str, url: str,
+               headers: Optional[dict] = None,
+               connect_timeout: float = 10.0,
+               read_timeout: float = 300.0) -> "StreamResponse":
+        """A streaming request (watch/subscribe/tail): the response
+        body is consumed incrementally by the caller, line by line.
+
+        Unlike :meth:`request`, the connection is DEDICATED — it never
+        joins the pool (a half-read stream would poison it) and the
+        caller must ``close()`` (or exhaust) the response.  What the
+        caller does get is the rest of the intra-cluster client
+        discipline that bare ``urllib.request.urlopen(url,
+        timeout=None)`` lacked: trace/priority/deadline header
+        injection, breaker gating + failure accounting for the host,
+        the http_pool.request fault point, and a BOUNDED socket — the
+        dial pays ``connect_timeout`` and each read at most
+        ``read_timeout`` of idle, so a wedged peer surfaces as an
+        exception the caller's reconnect loop handles instead of a
+        socket parked forever."""
+        if "://" not in url:
+            url = "http://" + url
+        parts = urllib.parse.urlsplit(url)
+        host, port = parts.hostname or "", parts.port or 80
+        path = parts.path or "/"
+        if parts.query:
+            path += "?" + parts.query
+        hdrs = dict(headers or {})
+        from .. import faults, observe, overload
+        from ..utils import retry as retry_mod
+        observe.inject(hdrs)
+        overload.inject(hdrs)
+        retry_mod.inject_deadline(hdrs)
+        hostkey = f"{host}:{port}"
+        breaker = self.breaker
+        if breaker is not None:
+            breaker.check(hostkey)
+        try:
+            dropped = faults.fire("http_pool.request")
+        except faults.FaultError:
+            if breaker is not None:
+                breaker.record_failure(hostkey)
+            raise
+        if dropped:
+            if breaker is not None:
+                breaker.record_failure(hostkey)
+            raise ConnectionResetError(f"injected drop for {hostkey}")
+        conn = http.client.HTTPConnection(
+            host, port, timeout=retry_mod.cap_timeout(connect_timeout))
+        try:
+            conn.request(method, path, headers=hdrs)
+            resp = conn.getresponse()
+        except Exception as e:
+            conn.close()
+            if breaker is not None and isinstance(
+                    e, (OSError, http.client.HTTPException)):
+                breaker.record_failure(hostkey)
+            raise
+        if breaker is not None:
+            breaker.record_success(hostkey)
+        # connected: reads are idle-bounded from here on
+        if conn.sock is not None:
+            conn.sock.settimeout(read_timeout)
+        return StreamResponse(conn, resp)
 
     def close(self) -> None:
         with self._lock:

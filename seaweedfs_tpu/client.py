@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import os
 import time
 import urllib.error
 import urllib.parse
@@ -25,6 +26,15 @@ from .utils.retry import (RETRYABLE_STATUSES, RetryPolicy, is_shed,
 
 class ClientError(RuntimeError):
     pass
+
+
+class ClientUnreachable(ClientError):
+    """The whole primary cluster is unreachable (every master failed,
+    or connection-class errors on every replica) — the condition geo
+    read failover answers.  An authoritative negative answer (HTTP 404,
+    'volume not found') from a HEALTHY cluster is a plain ClientError
+    and must never fail over: serving deleted data from the replica
+    would resurrect it."""
 
 
 # connection errors worth a replica/master rotation (the pool already
@@ -54,12 +64,24 @@ def _post_json(url: str, body: dict, timeout: float = 300.0) -> dict:
 
 
 class Client:
-    def __init__(self, master_url: str, guard=None):
+    def __init__(self, master_url: str, guard=None,
+                 replica_masters: str = ""):
         # comma-separated HA master list; requests fail over to the next
         # master when one is unreachable or leaderless (the reference
         # client follows KeepConnected leader hints, wdclient/masterclient.go)
         self.masters = [m.strip().rstrip("/")
                         for m in master_url.split(",") if m.strip()]
+        # geo read failover: a second CLUSTER's master list (not more HA
+        # peers of this one). When every primary master/replica is
+        # unreachable or breaker-open, download() serves from the
+        # replica cluster instead, marked stale (last_read_stale).
+        self._replica_masters = (replica_masters or os.environ.get(
+            "WEED_GEO_REPLICA_MASTERS", ""))
+        self._replica_client: Optional["Client"] = None
+        # True when the most recent download() was answered by the
+        # replica cluster — bounded-lag eventual data, not read-your-
+        # writes (the geo plane's stale-ok marker, client-side)
+        self.last_read_stale = False
         self._master_i = 0
         self.guard = guard  # security Guard for signing delete jwts
         # TTL'd vid -> locations cache (wdclient vid_map): GETs stop
@@ -136,7 +158,7 @@ class Client:
                             attempt // len(self.masters)))
                 else:
                     raise
-        raise ClientError(f"all masters failed: {last}")
+        raise ClientUnreachable(f"all masters failed: {last}")
 
     def _write_auth_header(self, fid: str) -> dict:
         """Write jwt signed with the shared key, for DELETEs — the
@@ -331,7 +353,38 @@ class Client:
             raise ClientError(out.get("error", f"{fid} not found"))
         return urls, out.get("auth", "")
 
+    def _replica(self) -> Optional["Client"]:
+        if not self._replica_masters:
+            return None
+        if self._replica_client is None:
+            # the replica client gets no replica of its own: failover
+            # is one hop, never a ring
+            self._replica_client = Client(self._replica_masters,
+                                          guard=self.guard)
+        return self._replica_client
+
     def download(self, fid: str) -> bytes:
+        """Read a blob; when the primary cluster is unreachable (every
+        master/replica down or circuit-breaker-open — BreakerOpen fails
+        fast inside the pool) and a replica cluster is configured, the
+        read is served from there and ``last_read_stale`` is set: the
+        geo plane's active/passive failover, correct up to the
+        replication lag."""
+        self.last_read_stale = False
+        try:
+            return self._download_local(fid)
+        except (ClientUnreachable, *_CONN_ERRORS):
+            # unreachability only — a 404/not-found from a healthy
+            # primary is authoritative and must not resurrect deleted
+            # data from the replica
+            replica = self._replica()
+            if replica is None:
+                raise
+            data = replica.download(fid)
+            self.last_read_stale = True
+            return data
+
+    def _download_local(self, fid: str) -> bytes:
         vid = int(fid.split(",")[0])
         last_err: Optional[Exception] = None
         auth = ""
@@ -358,6 +411,10 @@ class Client:
                 urls, auth = self.lookup_with_auth(fid)
                 continue
             break
+        if isinstance(last_err, _CONN_ERRORS):
+            # every replica refused the dial: unreachable, not a
+            # negative answer
+            raise ClientUnreachable(f"download {fid} failed: {last_err}")
         raise ClientError(f"download {fid} failed: {last_err}")
 
     def delete(self, fid: str) -> None:

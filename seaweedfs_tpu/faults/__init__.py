@@ -105,6 +105,11 @@ KNOWN_POINTS = frozenset({
     "lifecycle.unec",       # warm->hot un-EC transition
     "lifecycle.expire",     # TTL whole-volume expiry
     "lifecycle.encode",     # lifecycle-driven ec encode step
+    "geo.apply",            # cross-cluster event apply (geo/ + sync
+                            # replicator) — error = sink failure,
+                            # drop = event lost mid-flight
+    "geo.stream",           # the /__meta__/subscribe tail a replicator
+                            # rides — error/drop = stream torn down
 })
 
 _lock = threading.Lock()

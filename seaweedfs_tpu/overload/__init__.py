@@ -89,7 +89,7 @@ MASTER_SYSTEM_PATHS = OPS_PATHS | {
     "/cluster/status", "/cluster/watch", "/cluster/lock",
     "/cluster/unlock", "/cluster/raft/vote", "/cluster/raft/append",
     "/ec/scrub_report", "/vol/heat", "/vol/heat/report",
-    "/lifecycle/status", "/lifecycle/run",
+    "/lifecycle/status", "/lifecycle/run", "/geo/status", "/geo/run",
 }
 # volume fids always contain "," so these can't collide with data paths
 VOLUME_SYSTEM_PATHS = OPS_PATHS | {"/admin/faults", "/ui", "/status",

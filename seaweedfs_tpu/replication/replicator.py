@@ -12,12 +12,15 @@ import json
 import os
 import time
 import urllib.parse
-import urllib.request
 from typing import Iterator, Optional
 
+from .. import faults
+from ..cache.http_pool import shared_pool
 from ..filer.entry import Entry
 from ..filer.filer import MetaEvent
 from ..utils import glog
+from ..utils import metrics as metrics_mod
+from ..utils.retry import RetryPolicy
 from .sink import ReplicationSink
 
 
@@ -71,14 +74,24 @@ class Replicator:
         helpers in the reference; we read through the filer's HTTP API so
         chunk/manifest resolution stays server-side). Chunkless entries
         (empty files, metadata-only events off a queue) have no body to
-        fetch."""
+        fetch.  Rides the shared pool, so the fetch gets keep-alive,
+        breaker protection, deadline budgets, and trace/priority
+        propagation like every other intra-cluster client."""
         if not entry.chunks:
             return b""
         url = f"http://{self.source}" + urllib.parse.quote(entry.full_path)
-        with urllib.request.urlopen(url, timeout=300) as r:
-            return r.read()
+        r = shared_pool().request("GET", url, timeout=300)
+        if r.status != 200:
+            raise RuntimeError(f"source fetch {entry.full_path}: "
+                               f"HTTP {r.status}")
+        return r.data
 
     def apply(self, event: MetaEvent) -> None:
+        if faults.fire("geo.apply"):
+            # injected drop: the event vanished mid-apply — surface as
+            # a failure so the offset/poison machinery sees it, never a
+            # silent skip
+            raise faults.FaultError("injected drop at geo.apply")
         old, new = event.old_entry, event.new_entry
         if new is not None and not new.full_path.startswith(self.prefix):
             new = None
@@ -96,11 +109,27 @@ class Replicator:
         else:
             self.sink.delete_entry(old, sigs)
 
+    # reconnect backoff for a lost subscribe stream: jittered
+    # exponential up to ~15s, reset by any successfully-delivered
+    # event — a dead source filer is probed politely instead of at a
+    # flat 1 Hz forever, and a fleet of replicators never redials in
+    # lockstep
+    RECONNECT_POLICY = RetryPolicy(max_attempts=1, base_delay=0.5,
+                                   max_delay=15.0, jitter=0.5)
+
     # --- event sources ---
     def subscribe_events(self, since: int = 0,
                          reconnect: bool = True,
                          exclude_sig: int = 0) -> Iterator[MetaEvent]:
-        """Live ndjson stream from the source filer's /__meta__/subscribe."""
+        """Live ndjson stream from the source filer's /__meta__/subscribe.
+
+        Rides the shared pool's streaming face (cache/http_pool.stream):
+        breaker-gated, trace/priority/deadline-propagating, and BOUNDED
+        — the dial and each idle read have socket timeouts, so a wedged
+        filer surfaces as a reconnect instead of a socket parked
+        forever (this used to be the only unbounded intra-cluster
+        socket in the tree)."""
+        failures = 0
         while True:
             params = {"since": str(since)}
             if exclude_sig:
@@ -108,20 +137,31 @@ class Replicator:
             url = (f"http://{self.source}/__meta__/subscribe?"
                    + urllib.parse.urlencode(params))
             try:
-                with urllib.request.urlopen(url, timeout=None) as r:
+                if faults.fire("geo.stream"):
+                    raise ConnectionResetError(
+                        "injected drop at geo.stream")
+                with shared_pool().stream("GET", url) as r:
+                    if r.status != 200:
+                        # urlopen raised HTTPError here; the pooled
+                        # stream hands back the status — an error body
+                        # must never be iterated as ndjson
+                        raise RuntimeError(f"subscribe: HTTP {r.status}")
                     for line in r:
                         line = line.strip()
                         if not line:
                             continue
                         e = MetaEvent.from_dict(json.loads(line))
                         since = e.tsns
+                        failures = 0
                         yield e
             except Exception as ex:
                 if not reconnect:
                     return
-                glog.warning("subscribe to %s lost: %s (retrying)",
-                             self.source, ex)
-                time.sleep(1.0)
+                delay = self.RECONNECT_POLICY.backoff(min(failures, 5))
+                failures += 1
+                glog.warning("subscribe to %s lost: %s (retrying in "
+                             "%.1fs)", self.source, ex, delay)
+                time.sleep(delay)
 
     def run(self, since: int = 0, max_events: Optional[int] = None,
             stop_check=None, exclude_sig: int = 0) -> int:
@@ -200,13 +240,20 @@ def run_from_queue(replicator: "Replicator", inp,
 
 def consume_spool_file(path: str) -> Iterator[MetaEvent]:
     """Read a FileQueue spool file (the queue-consumer side of
-    weed/replication/sub/ for the local 'file' queue)."""
+    weed/replication/sub/ for the local 'file' queue).  A corrupt line
+    is SKIPPED LOUDLY — glog.error + a replication_corrupt_events
+    count — never swallowed: a torn spool write that silently dropped
+    mutations would surface as replica divergence weeks later (same
+    fix shape as the PR 2 kafka-input change)."""
     with open(path, encoding="utf-8") as f:
-        for line in f:
+        for lineno, line in enumerate(f, start=1):
             line = line.strip()
             if not line:
                 continue
             try:
                 yield MetaEvent.from_dict(json.loads(line))
-            except Exception:
-                continue
+            except Exception as e:
+                metrics_mod.shared("replication").count(
+                    "replication_corrupt_events")
+                glog.error("spool %s line %d: corrupt event (%s) — "
+                           "SKIPPING one mutation", path, lineno, e)

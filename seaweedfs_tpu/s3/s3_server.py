@@ -20,6 +20,7 @@ import hashlib
 import hmac
 import json
 import logging
+import os
 import time
 import urllib.parse
 import uuid
@@ -31,7 +32,10 @@ from aiohttp import web
 
 from . import auth as auth_mod
 from .. import observe, overload
+from ..geo import rules as geo_rules
+from ..geo import versioning as geo_versioning
 from ..utils import metrics as metrics_mod
+from ..utils import retry as retry_mod
 
 log = logging.getLogger("s3")
 
@@ -58,8 +62,22 @@ class S3Server:
     def __init__(self, filer_url: str,
                  access_key: str = "", secret_key: str = "",
                  iam: Optional["auth_mod.Iam"] = None,
-                 url: str = ""):
+                 url: str = "",
+                 replica_filer_url: str = ""):
         self.filer_url = filer_url
+        # replica-cluster read failover (geo plane): when the primary
+        # filer's circuit breaker is open (or a fetch fails live), GETs
+        # are served from the replica cluster's filer instead, marked
+        # X-Seaweed-Stale-Ok — bounded-lag eventual data beats an error
+        # for DR reads. Writes never fail over (the replica is a
+        # follower; split-brain writes are how you lose data).
+        self.replica_filer_url = (replica_filer_url
+                                  or os.environ.get(
+                                      "WEED_GEO_REPLICA_FILER", ""))
+        # per-host breaker shared with the rest of the PR 4 retry
+        # plane: evidence of a dead filer collected here protects every
+        # other caller in this process, and vice versa
+        self._filer_breaker = retry_mod.shared_breaker()
         # own advertised host:port — the trace-span instance label, so a
         # merged multi-gateway trace gets one Perfetto lane per gateway
         self.url = url
@@ -398,9 +416,10 @@ class S3Server:
                 f"http://{self.filer_url}/__meta__/{op}", json=body) as r:
             return r.status, await r.json()
 
-    async def _meta_get(self, op: str, params: dict) -> tuple[int, dict]:
+    async def _meta_get(self, op: str, params: dict,
+                        filer: str = "") -> tuple[int, dict]:
         async with self._session.get(
-                f"http://{self.filer_url}/__meta__/{op}",
+                f"http://{filer or self.filer_url}/__meta__/{op}",
                 params=params) as r:
             return r.status, await r.json()
 
@@ -441,6 +460,27 @@ class S3Server:
             if request.method == "DELETE":
                 return await self.delete_bucket_lifecycle(bucket)
             return _error("MethodNotAllowed", request.method, 405)
+        if "versioning" in request.query:
+            # Put/GetBucketVersioning: state rides the bucket entry's
+            # extended attributes (geo/versioning.py layout)
+            if request.method == "PUT":
+                return await self.put_bucket_versioning(request, bucket)
+            if request.method == "GET":
+                return await self.get_bucket_versioning(bucket)
+            return _error("MethodNotAllowed", request.method, 405)
+        if "replication" in request.query:
+            # Put/Get/DeleteBucketReplication: the rules live on the
+            # bucket entry; the master's geo daemon enforces them
+            # (one BucketReplicator job per enabled rule)
+            if request.method == "PUT":
+                return await self.put_bucket_replication(request, bucket)
+            if request.method == "GET":
+                return await self.get_bucket_replication(bucket)
+            if request.method == "DELETE":
+                return await self.delete_bucket_replication(bucket)
+            return _error("MethodNotAllowed", request.method, 405)
+        if "versions" in request.query and request.method == "GET":
+            return await self.list_object_versions(request, bucket)
         if request.method == "PUT":
             return await self.put_bucket(bucket)
         if request.method == "DELETE":
@@ -492,7 +532,8 @@ class S3Server:
         if request.method in ("GET", "HEAD"):
             return await self.get_object(request, bucket, key)
         if request.method == "DELETE":
-            return await self.delete_object(bucket, key)
+            return await self.delete_object(
+                bucket, key, version_id=q.get("versionId", ""))
         return _error("MethodNotAllowed", request.method, 405)
 
     # --- request payloads (streaming chunked SigV4) ---
@@ -573,25 +614,15 @@ class S3Server:
             rules = s3_rules.parse_lifecycle_xml(body)
         except s3_rules.LifecycleXmlError as e:
             return _error("MalformedXML", str(e), 400)
-        status, entry = await self._meta_get(
-            "lookup", {"path": f"{BUCKETS_DIR}/{bucket}"})
-        if status != 200:
-            return _error("NoSuchBucket", bucket, 404)
-        extended = entry.get("extended") or {}
-        extended[s3_rules.BUCKET_ATTR] = s3_rules.rules_to_json(rules)
-        entry["extended"] = extended
-        status, out = await self._meta("update_entry", {"entry": entry})
-        if status != 200:
-            return _error("InternalError", str(out.get("error")), 500)
-        return web.Response(status=200)
+        err = await self._set_bucket_attr(
+            bucket, s3_rules.BUCKET_ATTR, s3_rules.rules_to_json(rules))
+        return err or web.Response(status=200)
 
     async def get_bucket_lifecycle(self, bucket: str) -> web.Response:
         from ..lifecycle import s3_rules
-        status, entry = await self._meta_get(
-            "lookup", {"path": f"{BUCKETS_DIR}/{bucket}"})
-        if status != 200:
+        raw = await self._bucket_attr(bucket, s3_rules.BUCKET_ATTR)
+        if raw is None:
             return _error("NoSuchBucket", bucket, 404)
-        raw = (entry.get("extended") or {}).get(s3_rules.BUCKET_ATTR, "")
         rules = s3_rules.rules_from_json(raw)
         if not rules:
             return _error("NoSuchLifecycleConfiguration",
@@ -601,24 +632,142 @@ class S3Server:
 
     async def delete_bucket_lifecycle(self, bucket: str) -> web.Response:
         from ..lifecycle import s3_rules
+        err = await self._set_bucket_attr(bucket, s3_rules.BUCKET_ATTR,
+                                          None)
+        return err or web.Response(status=204)
+
+    # --- bucket attribute plumbing (versioning + replication state both
+    #     ride the bucket directory entry's extended attributes, exactly
+    #     like lifecycle rules and object tags) ---
+
+    async def _set_bucket_attr(self, bucket: str, attr: str,
+                               value: Optional[str]) -> Optional[web.Response]:
+        """Set (or with None, clear) one extended attribute on the
+        bucket entry; returns an error response or None."""
         status, entry = await self._meta_get(
             "lookup", {"path": f"{BUCKETS_DIR}/{bucket}"})
         if status != 200:
             return _error("NoSuchBucket", bucket, 404)
         extended = entry.get("extended") or {}
-        if s3_rules.BUCKET_ATTR in extended:
-            extended.pop(s3_rules.BUCKET_ATTR, None)
-            entry["extended"] = extended
-            await self._meta("update_entry", {"entry": entry})
-        return web.Response(status=204)
+        if value is None:
+            if attr not in extended:
+                return None
+            extended.pop(attr, None)
+        else:
+            extended[attr] = value
+        entry["extended"] = extended
+        status, out = await self._meta("update_entry", {"entry": entry})
+        if status != 200:
+            return _error("InternalError", str(out.get("error")), 500)
+        return None
+
+    async def _bucket_attr(self, bucket: str,
+                           attr: str) -> Optional[str]:
+        """One extended attribute off the bucket entry: None = no such
+        bucket, "" = bucket exists but the attribute is unset."""
+        status, entry = await self._meta_get(
+            "lookup", {"path": f"{BUCKETS_DIR}/{bucket}"})
+        if status != 200:
+            return None
+        return (entry.get("extended") or {}).get(attr, "")
+
+    # --- bucket versioning (s3api_bucket_handlers.go's versioning
+    #     pair; semantics in geo/versioning.py) ---
+
+    async def put_bucket_versioning(self, request: web.Request,
+                                    bucket: str) -> web.Response:
+        self.metrics.count("put_bucket_versioning")
+        body = await request.read()
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError as e:
+            return _error("MalformedXML", str(e), 400)
+        ns = root.tag.split("}")[0] + "}" if root.tag.startswith("{") else ""
+        st = root.find(f"{ns}Status")
+        if st is None or st.text not in ("Enabled", "Suspended"):
+            return _error("MalformedXML",
+                          "Status must be Enabled or Suspended", 400)
+        err = await self._set_bucket_attr(
+            bucket, geo_versioning.VERSIONING_ATTR, st.text)
+        return err or web.Response(status=200)
+
+    async def get_bucket_versioning(self, bucket: str) -> web.Response:
+        state = await self._bucket_attr(bucket,
+                                        geo_versioning.VERSIONING_ATTR)
+        if state is None:
+            return _error("NoSuchBucket", bucket, 404)
+        root = ET.Element("VersioningConfiguration", xmlns=XMLNS)
+        if state:
+            ET.SubElement(root, "Status").text = state
+        return _xml(root)
+
+    async def _versioning_enabled(self, bucket: str) -> bool:
+        state = await self._bucket_attr(bucket,
+                                        geo_versioning.VERSIONING_ATTR)
+        return state == "Enabled"
+
+    # --- bucket replication (PutBucketReplication subset; rules in
+    #     geo/rules.py, enforced by the master's geo daemon) ---
+
+    async def put_bucket_replication(self, request: web.Request,
+                                     bucket: str) -> web.Response:
+        self.metrics.count("put_bucket_replication")
+        body = await request.read()
+        try:
+            rules = geo_rules.parse_replication_xml(body)
+        except geo_rules.ReplicationXmlError as e:
+            return _error("MalformedXML", str(e), 400)
+        err = await self._set_bucket_attr(
+            bucket, geo_rules.BUCKET_ATTR,
+            geo_rules.rules_to_json(rules))
+        return err or web.Response(status=200)
+
+    async def get_bucket_replication(self, bucket: str) -> web.Response:
+        raw = await self._bucket_attr(bucket, geo_rules.BUCKET_ATTR)
+        if raw is None:
+            return _error("NoSuchBucket", bucket, 404)
+        rules = geo_rules.rules_from_json(raw)
+        if not rules:
+            return _error("ReplicationConfigurationNotFoundError",
+                          "no replication configuration", 404)
+        return web.Response(body=geo_rules.rules_to_xml(rules),
+                            content_type="application/xml")
+
+    async def delete_bucket_replication(self, bucket: str) -> web.Response:
+        # a failed filer update must NOT read as "rule removed": the
+        # geo daemon would keep replicating what the operator stopped
+        err = await self._set_bucket_attr(bucket, geo_rules.BUCKET_ATTR,
+                                          None)
+        return err or web.Response(status=204)
 
     # --- objects ---
     async def put_object(self, request: web.Request, bucket: str,
                          key: str) -> web.Response:
         self.metrics.count("put_object")
-        if (await self.head_bucket(bucket)).status != 200:
+        # one bucket-entry lookup answers both existence and
+        # versioning state — no second round trip on the write path
+        status, bentry = await self._meta_get(
+            "lookup", {"path": f"{BUCKETS_DIR}/{bucket}"})
+        if status != 200:
             return _error("NoSuchBucket", bucket, 404)
         path = self._obj_path(bucket, key)
+        versioned = (bentry.get("extended") or {}).get(
+            geo_versioning.VERSIONING_ATTR) == "Enabled"
+        version_id = ""
+        extra_q = ""
+        old = None
+        if versioned:
+            # capture the current version now; it is ARCHIVED only
+            # after the overwrite lands (archiving first would leave a
+            # phantom duplicate version when the body PUT fails).
+            # free_old_chunks=false keeps the replaced chunk list
+            # alive across the overwrite so the archive can adopt it.
+            version_id = geo_versioning.new_version_id()
+            status, cur = await self._meta_get("lookup", {"path": path})
+            if status == 200 and \
+                    not cur.get("attr", {}).get("mode", 0) & 0o40000:
+                old = cur
+            extra_q = "?free_old_chunks=false"
         headers = {"Content-Type": request.content_type
                    or "application/octet-stream"}
         try:
@@ -626,7 +775,8 @@ class S3Server:
         except auth_mod.ChunkedSigV4Error as e:
             return _error("SignatureDoesNotMatch", str(e), 403)
         async with self._session.put(
-                f"http://{self.filer_url}{urllib.parse.quote(path)}",
+                f"http://{self.filer_url}{urllib.parse.quote(path)}"
+                + extra_q,
                 data=payload, headers=headers) as r:
             if r.status >= 300:
                 return _error("InternalError", f"filer: {r.status}", 500)
@@ -634,17 +784,164 @@ class S3Server:
             tags = dict(urllib.parse.parse_qsl(
                 request.headers["x-amz-tagging"]))
             await self._save_tags(path, tags)
+        entry = None
+        if versioned:
+            if old is not None:
+                await self._archive_version(path, old)
+            entry = await self._stamp_version(path, version_id)
+        if entry is None:
+            status, entry = await self._meta_get("lookup",
+                                                 {"path": path})
+            if status != 200:
+                entry = {}
+        resp_headers = {"ETag": f'"{_entry_etag(entry)}"'}
+        if version_id:
+            resp_headers["x-amz-version-id"] = version_id
+        return web.Response(status=200, headers=resp_headers)
+
+    # --- versioning internals (layout in geo/versioning.py:
+    #     current version at the object path, noncurrent versions as
+    #     sibling entries under <path>.versions/<version id>) ---
+
+    async def _archive_version(self, path: str, old: dict) -> None:
+        """Preserve the current entry as a noncurrent version: a new
+        entry under <path>.versions/ SHARING the old chunk list — a
+        metadata copy, no data movement."""
+        old_vid = geo_versioning.entry_version_id(old)
+        archived = dict(old)
+        archived["path"] = \
+            f"{geo_versioning.versions_dir(path)}/{old_vid}"
+        ext = dict(old.get("extended") or {})
+        ext[geo_versioning.VERSION_ID_ATTR] = old_vid
+        archived["extended"] = ext
+        # free_old_chunks=False: re-archiving the same "null" version
+        # after repeated unversioned-era overwrites must never free the
+        # chunks the fresh archive copy just adopted
+        await self._meta("create_entry", {"entry": archived,
+                                          "free_old_chunks": False})
+
+    async def _stamp_version(self, path: str,
+                             version_id: str) -> Optional[dict]:
+        """Stamp the version id onto the entry at `path`; returns the
+        stamped entry so callers don't pay another lookup."""
         status, entry = await self._meta_get("lookup", {"path": path})
-        et = _entry_etag(entry) if status == 200 else ""
-        return web.Response(status=200, headers={"ETag": f'"{et}"'})
+        if status != 200:
+            return None
+        ext = entry.get("extended") or {}
+        ext[geo_versioning.VERSION_ID_ATTR] = version_id
+        entry["extended"] = ext
+        await self._meta("update_entry", {"entry": entry})
+        return entry
+
+    async def _versioned_overwrite_state(
+            self, bucket: str, path: str
+    ) -> tuple[bool, Optional[dict], str]:
+        """(versioning enabled, current entry to archive or None, new
+        version id) — the shared preamble of every write that can
+        replace a versioned object (PutObject, CopyObject,
+        CompleteMultipartUpload, DeleteObject's marker path)."""
+        if not await self._versioning_enabled(bucket):
+            return False, None, ""
+        status, cur = await self._meta_get("lookup", {"path": path})
+        old = (cur if status == 200
+               and not cur.get("attr", {}).get("mode", 0) & 0o40000
+               else None)
+        return True, old, geo_versioning.new_version_id()
+
+    async def _versions_of(self, bucket: str, key: str) -> list[dict]:
+        """Noncurrent version entries for a key, newest first (version
+        ids are fixed-width time-ordered hex, so name order IS age
+        order; "null" sorts before every timestamped id = oldest).
+        Paginated: truncating at one store page would silently drop
+        the NEWEST versions of a hot key and promote a stale one on
+        delete."""
+        vdir = geo_versioning.versions_dir(self._obj_path(bucket, key))
+        entries: list[dict] = []
+        start = ""
+        while True:
+            status, listing = await self._meta_get(
+                "list", {"dir": vdir, "start": start, "limit": "1000"})
+            if status != 200:
+                break
+            page = listing.get("entries", [])
+            entries.extend(
+                e for e in page
+                if not e.get("attr", {}).get("mode", 0) & 0o40000)
+            if len(page) < 1000:
+                break
+            start = page[-1]["path"].rsplit("/", 1)[-1]
+
+        def age_key(e: dict) -> str:
+            name = e["path"].rsplit("/", 1)[-1]
+            # "null" (pre-versioning) is the OLDEST version, but 'n'
+            # sorts after every hex digit — map it below them
+            return "" if name == geo_versioning.NULL_VERSION else name
+
+        entries.sort(key=age_key, reverse=True)
+        return entries
 
     async def get_object(self, request: web.Request, bucket: str,
                          key: str) -> web.StreamResponse:
         self.metrics.count("get_object")
+        want_vid = request.query.get("versionId", "")
+        # primary first; the replica cluster's filer only when the
+        # primary is breaker-open or fails live (geo read failover)
+        filers = [self.filer_url]
+        if self.replica_filer_url:
+            filers.append(self.replica_filer_url)
+        last_err = ""
+        for i, filer in enumerate(filers):
+            stale_ok = i > 0
+            try:
+                self._filer_breaker.check(filer)
+            except retry_mod.BreakerOpen:
+                last_err = f"breaker open for {filer}"
+                continue
+            try:
+                resp = await self._get_object_from(
+                    filer, request, bucket, key, want_vid, stale_ok)
+                self._filer_breaker.record_success(filer)
+                if stale_ok:
+                    self.metrics.count("geo_failover_reads")
+                return resp
+            except (aiohttp.ClientError, asyncio.TimeoutError,
+                    OSError) as e:
+                self._filer_breaker.record_failure(filer)
+                last_err = str(e)
+                if request.get("geo_prepared"):
+                    # the response already started streaming to the
+                    # client: a second prepare() is impossible — let
+                    # the truncation surface as a disconnect instead
+                    # of a corrupt double response
+                    raise
+        return _error("ServiceUnavailable",
+                      f"no filer reachable: {last_err}", 503)
+
+    async def _get_object_from(self, filer: str, request: web.Request,
+                               bucket: str, key: str, want_vid: str,
+                               stale_ok: bool) -> web.StreamResponse:
         path = self._obj_path(bucket, key)
         # keys never address directories: GETting a prefix entry must be
         # NoSuchKey, not the filer's JSON listing
-        status, entry = await self._meta_get("lookup", {"path": path})
+        status, entry = await self._meta_get("lookup", {"path": path},
+                                             filer=filer)
+        if want_vid:
+            current = geo_versioning.entry_version_id(entry) \
+                if status == 200 else ""
+            if current != want_vid:
+                # a noncurrent version: its sibling entry
+                path = (f"{geo_versioning.versions_dir(path)}"
+                        f"/{want_vid}")
+                status, entry = await self._meta_get(
+                    "lookup", {"path": path}, filer=filer)
+                if status != 200:
+                    return _error("NoSuchVersion", want_vid, 404)
+                if geo_versioning.is_delete_marker(entry):
+                    # AWS answers 405 for a GET aimed at a delete marker
+                    return web.Response(
+                        status=405,
+                        headers={"x-amz-delete-marker": "true",
+                                 "x-amz-version-id": want_vid})
         if status != 200 or entry.get("attr", {}).get("mode", 0) & 0o40000:
             return _error("NoSuchKey", key, 404)
         headers = {}
@@ -652,7 +949,7 @@ class S3Server:
             headers["Range"] = request.headers["Range"]
         async with self._session.request(
                 request.method,
-                f"http://{self.filer_url}{urllib.parse.quote(path)}",
+                f"http://{filer}{urllib.parse.quote(path)}",
                 headers=headers) as r:
             if r.status == 404:
                 return _error("NoSuchKey", key, 404)
@@ -661,6 +958,14 @@ class S3Server:
                       "Content-Range", "Accept-Ranges"):
                 if h in r.headers:
                     resp.headers[h] = r.headers[h]
+            vid = geo_versioning.entry_version_id(entry)
+            if vid != geo_versioning.NULL_VERSION:
+                resp.headers["x-amz-version-id"] = vid
+            if stale_ok:
+                # served from the replica cluster: correct up to the
+                # replication lag, flagged so the caller knows
+                resp.headers["X-Seaweed-Stale-Ok"] = "1"
+            request["geo_prepared"] = True  # failover boundary
             await resp.prepare(request)
             if request.method != "HEAD":
                 async for chunk in r.content.iter_chunked(1 << 20):
@@ -668,11 +973,95 @@ class S3Server:
             await resp.write_eof()
             return resp
 
-    async def delete_object(self, bucket: str, key: str) -> web.Response:
+    async def delete_object(self, bucket: str, key: str,
+                            version_id: str = "") -> web.Response:
         self.metrics.count("delete_object")
-        await self._meta("delete", {"path": self._obj_path(bucket, key),
-                                    "recursive": True})
+        path = self._obj_path(bucket, key)
+        if version_id:
+            return await self._delete_version(bucket, key, version_id)
+        if await self._versioning_enabled(bucket):
+            # versioned delete: archive the current version, then lay
+            # down a delete marker — nothing is freed
+            status, old = await self._meta_get("lookup", {"path": path})
+            if status == 200 and \
+                    not old.get("attr", {}).get("mode", 0) & 0o40000:
+                await self._archive_version(path, old)
+                await self._meta("delete", {"path": path,
+                                            "free_chunks": False})
+            marker_vid = geo_versioning.new_version_id()
+            marker = {
+                "path": (f"{geo_versioning.versions_dir(path)}"
+                         f"/{marker_vid}"),
+                "attr": {"mode": 0o600, "mtime": time.time(),
+                         "crtime": time.time()},
+                "chunks": [],
+                "extended": {
+                    geo_versioning.VERSION_ID_ATTR: marker_vid,
+                    geo_versioning.DELETE_MARKER_ATTR: "true"},
+            }
+            await self._meta("create_entry", {"entry": marker})
+            return web.Response(status=204, headers={
+                "x-amz-delete-marker": "true",
+                "x-amz-version-id": marker_vid})
+        await self._meta("delete", {"path": path, "recursive": True})
         return web.Response(status=204)
+
+    async def _delete_version(self, bucket: str, key: str,
+                              version_id: str) -> web.Response:
+        """DELETE ?versionId= — permanently removes that one version;
+        deleting the CURRENT version promotes the newest remaining
+        noncurrent version back to the object path (AWS semantics)."""
+        path = self._obj_path(bucket, key)
+        status, main = await self._meta_get("lookup", {"path": path})
+        headers = {"x-amz-version-id": version_id}
+        if status == 200 and \
+                geo_versioning.entry_version_id(main) == version_id:
+            # an archived sibling under the SAME version id can share
+            # the chunk list (a versioned PUT that archived the old
+            # entry but whose overwrite never landed): freeing here
+            # would corrupt the copy about to be promoted
+            twin, _ = await self._meta_get(
+                "lookup",
+                {"path": f"{geo_versioning.versions_dir(path)}"
+                         f"/{version_id}"})
+            await self._meta("delete", {"path": path,
+                                        "free_chunks": twin != 200})
+            promoted = await self._versions_of(bucket, key)
+            if promoted and not geo_versioning.is_delete_marker(
+                    promoted[0]):
+                newest = promoted[0]
+                restored = dict(newest)
+                restored["path"] = path
+                await self._meta("create_entry",
+                                 {"entry": restored,
+                                  "free_old_chunks": False})
+                await self._meta("delete", {"path": newest["path"],
+                                            "free_chunks": False})
+            return web.Response(status=204, headers=headers)
+        vpath = f"{geo_versioning.versions_dir(path)}/{version_id}"
+        status, entry = await self._meta_get("lookup", {"path": vpath})
+        if status == 200:
+            if geo_versioning.is_delete_marker(entry):
+                headers["x-amz-delete-marker"] = "true"
+                # removing the newest delete marker un-deletes the key:
+                # promote the newest remaining real version back
+                await self._meta("delete", {"path": vpath})
+                remaining = await self._versions_of(bucket, key)
+                main_missing = (await self._meta_get(
+                    "lookup", {"path": path}))[0] != 200
+                if main_missing and remaining and \
+                        not geo_versioning.is_delete_marker(remaining[0]):
+                    newest = remaining[0]
+                    restored = dict(newest)
+                    restored["path"] = path
+                    await self._meta("create_entry",
+                                     {"entry": restored,
+                                      "free_old_chunks": False})
+                    await self._meta("delete", {"path": newest["path"],
+                                                "free_chunks": False})
+            else:
+                await self._meta("delete", {"path": vpath})
+        return web.Response(status=204, headers=headers)
 
     async def copy_object(self, request: web.Request, bucket: str,
                           key: str) -> web.Response:
@@ -685,6 +1074,11 @@ class S3Server:
         # full data copy through the filer: source and destination must not
         # share chunks or deleting one would free the other's blobs
         dst_path = self._obj_path(bucket, key)
+        # a copy ONTO a versioned key is an overwrite like any PUT:
+        # archive the current version, keep its chunks, stamp the new id
+        versioned, old, version_id = \
+            await self._versioned_overwrite_state(bucket, dst_path)
+        extra_q = "?free_old_chunks=false" if versioned else ""
         mime = entry.get("attr", {}).get("mime") or "application/octet-stream"
         async with self._session.get(
                 f"http://{self.filer_url}{urllib.parse.quote(src_path)}"
@@ -692,17 +1086,27 @@ class S3Server:
             if src_resp.status != 200:
                 return _error("NoSuchKey", src, 404)
             async with self._session.put(
-                    f"http://{self.filer_url}{urllib.parse.quote(dst_path)}",
+                    f"http://{self.filer_url}"
+                    f"{urllib.parse.quote(dst_path)}{extra_q}",
                     data=src_resp.content,
                     headers={"Content-Type": mime}) as r:
                 if r.status >= 300:
                     return _error("InternalError", "copy failed", 500)
-        status, new_entry = await self._meta_get("lookup",
-                                                 {"path": dst_path})
+        new_entry = None
+        if versioned:
+            if old is not None:
+                await self._archive_version(dst_path, old)
+            new_entry = await self._stamp_version(dst_path, version_id)
+        if new_entry is None:
+            status, new_entry = await self._meta_get("lookup",
+                                                     {"path": dst_path})
         root = ET.Element("CopyObjectResult", xmlns=XMLNS)
         ET.SubElement(root, "ETag").text = f'"{_entry_etag(new_entry)}"'
         ET.SubElement(root, "LastModified").text = _iso(time.time())
-        return _xml(root)
+        resp = _xml(root)
+        if version_id:
+            resp.headers["x-amz-version-id"] = version_id
+        return resp
 
     async def bulk_delete(self, request: web.Request,
                           bucket: str) -> web.Response:
@@ -714,11 +1118,22 @@ class S3Server:
             ns = root.tag.split("}")[0] + "}"
         for obj in root.findall(f"{ns}Object"):
             key = obj.find(f"{ns}Key").text
-            await self._meta("delete",
-                             {"path": self._obj_path(bucket, key),
-                              "recursive": True})
+            vid_el = obj.find(f"{ns}VersionId")
+            # route through delete_object so versioned buckets get the
+            # archive + delete-marker semantics on the batch path too —
+            # a raw meta delete here would free the current version's
+            # chunks with no marker laid
+            resp = await self.delete_object(
+                bucket, key,
+                version_id=(vid_el.text or "")
+                if vid_el is not None else "")
             d = ET.SubElement(deleted, "Deleted")
             ET.SubElement(d, "Key").text = key
+            marker = resp.headers.get("x-amz-delete-marker", "")
+            if marker:
+                ET.SubElement(d, "DeleteMarker").text = marker
+                ET.SubElement(d, "DeleteMarkerVersionId").text = \
+                    resp.headers.get("x-amz-version-id", "")
         return _xml(deleted)
 
     # --- listing ---
@@ -821,6 +1236,11 @@ class S3Server:
         async def emit(eff: str, is_dir: bool, e: dict) -> bool:
             """One child in effective-key order; False = stop the walk."""
             if is_dir:
+                if eff.endswith(geo_versioning.VERSIONS_SUFFIX + "/"):
+                    # noncurrent-version sibling directories are
+                    # versioning plumbing, not keys (ListObjectVersions
+                    # walks them; plain listings must not)
+                    return True
                 # prune: incompatible with the prefix, or the whole
                 # subtree sorts at/below the marker
                 if prefix and not (eff.startswith(prefix)
@@ -925,6 +1345,98 @@ class S3Server:
         # re-emitted on the next page
         next_marker = state["last"] if state["truncated"] else ""
         return contents, common, state["truncated"], next_marker
+
+    # --- ListObjectVersions (GET /{bucket}?versions) ---
+
+    async def list_object_versions(self, request: web.Request,
+                                   bucket: str) -> web.Response:
+        """Every version of every key: the current version (the object
+        entry itself) plus the sibling ``<key>.versions/`` entries,
+        newest first per key — delete markers as <DeleteMarker>.
+        Supports prefix and max-keys; one page (no key-marker
+        pagination in this subset)."""
+        if (await self.head_bucket(bucket)).status != 200:
+            return _error("NoSuchBucket", bucket, 404)
+        q = request.query
+        prefix = q.get("prefix", "")
+        max_keys = int(q.get("max-keys", 1000))
+        base = f"{BUCKETS_DIR}/{bucket}"
+        # key -> [(entry, is_current)]
+        found: dict[str, list[tuple[dict, bool]]] = {}
+        truncated = {"v": False}
+
+        def total() -> int:
+            return sum(len(v) for v in found.values())
+
+        async def walk(dir_path: str, key_prefix: str) -> None:
+            start = ""
+            while True:
+                status, body = await self._meta_get(
+                    "list", {"dir": dir_path, "start": start,
+                             "limit": "1024"})
+                entries = body.get("entries", []) if status == 200 else []
+                for e in entries:
+                    if truncated["v"]:
+                        return
+                    name = e["path"].rsplit("/", 1)[-1]
+                    is_dir = bool(e["attr"].get("mode", 0) & 0o40000)
+                    if is_dir and name.endswith(
+                            geo_versioning.VERSIONS_SUFFIX):
+                        key = key_prefix + name[:-len(
+                            geo_versioning.VERSIONS_SUFFIX)]
+                        if prefix and not key.startswith(prefix):
+                            continue
+                        for v in await self._versions_of(bucket, key):
+                            if total() >= max_keys:
+                                truncated["v"] = True
+                                break
+                            found.setdefault(key, []).append((v, False))
+                        continue
+                    if is_dir:
+                        await walk(e["path"], key_prefix + name + "/")
+                        continue
+                    key = key_prefix + name
+                    if prefix and not key.startswith(prefix):
+                        continue
+                    if total() >= max_keys:
+                        truncated["v"] = True
+                        return
+                    found.setdefault(key, []).append((e, True))
+                if len(entries) < 1024:
+                    return
+                start = entries[-1]["path"].rsplit("/", 1)[-1]
+
+        await walk(base, "")
+        root = ET.Element("ListVersionsResult", xmlns=XMLNS)
+        ET.SubElement(root, "Name").text = bucket
+        ET.SubElement(root, "Prefix").text = prefix
+        ET.SubElement(root, "MaxKeys").text = str(max_keys)
+        ET.SubElement(root, "IsTruncated").text = \
+            "true" if truncated["v"] else "false"
+        for key in sorted(found):
+            versions = found[key]
+            # current first (it is by construction the newest), then
+            # the noncurrent ones — _versions_of already yields those
+            # newest-first
+            versions.sort(key=lambda ve: ve[1], reverse=True)
+            for idx, (entry, is_current) in enumerate(versions):
+                vid = geo_versioning.entry_version_id(entry)
+                tag = ("DeleteMarker"
+                       if geo_versioning.is_delete_marker(entry)
+                       else "Version")
+                el = ET.SubElement(root, tag)
+                ET.SubElement(el, "Key").text = key
+                ET.SubElement(el, "VersionId").text = vid
+                ET.SubElement(el, "IsLatest").text = \
+                    "true" if idx == 0 else "false"
+                ET.SubElement(el, "LastModified").text = _iso(
+                    entry["attr"].get("mtime", 0))
+                if tag == "Version":
+                    ET.SubElement(el, "ETag").text = \
+                        f'"{_entry_etag(entry)}"'
+                    ET.SubElement(el, "Size").text = \
+                        str(_entry_size(entry))
+        return _xml(root)
 
     # --- tagging (s3api_object_tagging_handlers.go; tags live in the
     #     entry's extended attributes) ---
@@ -1122,15 +1634,28 @@ class S3Server:
                     all_chunks.append({**c, "offset": offset + c["offset"]})
             offset += _entry_size(p)
         final_path = self._obj_path(bucket, key)
-        status, _ = await self._meta("create_entry", {"entry": {
+        # a multipart complete ONTO a versioned key is an overwrite:
+        # the replaced entry's chunks must survive (the archive adopts
+        # them) and the new entry carries its version id from birth
+        versioned, old, version_id = \
+            await self._versioned_overwrite_state(bucket, final_path)
+        entry = {
             "path": final_path,
             "attr": {"mode": 0o100660, "mtime": time.time(),
                      "crtime": time.time(),
                      "mime": "application/octet-stream"},
             "chunks": all_chunks,
-        }})
+        }
+        if version_id:
+            entry["extended"] = {
+                geo_versioning.VERSION_ID_ATTR: version_id}
+        status, _ = await self._meta(
+            "create_entry",
+            {"entry": entry, "free_old_chunks": not versioned})
         if status != 200:
             return _error("InternalError", "complete failed", 500)
+        if versioned and old is not None:
+            await self._archive_version(final_path, old)
         # drop the upload dir but keep the chunks (they now belong to the key)
         await self._meta("delete", {"path": f"{UPLOADS_DIR}/{upload_id}",
                                     "recursive": True,
@@ -1139,7 +1664,10 @@ class S3Server:
         ET.SubElement(root, "Bucket").text = bucket
         ET.SubElement(root, "Key").text = key
         ET.SubElement(root, "ETag").text = f'"{hashlib.md5(upload_id.encode()).hexdigest()}-{len(parts)}"'
-        return _xml(root)
+        resp = _xml(root)
+        if version_id:
+            resp.headers["x-amz-version-id"] = version_id
+        return resp
 
     async def abort_multipart(self, request: web.Request, bucket: str,
                               key: str) -> web.Response:

@@ -118,6 +118,17 @@ class FilerServer:
         # the volume server round trip entirely
         from ..cache import AsyncSingleflight, TieredChunkCache
         self.chunk_cache = TieredChunkCache.from_env(metrics=self.metrics)
+        # write-through population: a freshly-written chunk is the
+        # likeliest next read (read-your-writes, and the geo
+        # replicator's source fetch follows every write within its
+        # replication lag) — serving it from cache keeps those reads
+        # off the volume servers entirely, which also means replication
+        # keeps flowing when the volume tier is saturated by a
+        # foreground storm. WEED_CHUNK_CACHE_WRITE_THROUGH=0 for
+        # write-heavy workloads where upload churn would evict the hot
+        # read set.
+        self.cache_write_through = os.environ.get(
+            "WEED_CHUNK_CACHE_WRITE_THROUGH", "1") not in ("0", "false")
         # N concurrent fetches of one cold chunk collapse into one
         # volume-server read (the filer reader's singleflight)
         self._fetch_flight = AsyncSingleflight("filer.fetch",
@@ -231,12 +242,16 @@ class FilerServer:
     async def meta_create(self, request: web.Request) -> web.Response:
         body = await request.json()
         entry = Entry.from_json(json.dumps(body["entry"]))
+        # filer ids that already processed this mutation (loop
+        # prevention for filer.sync and the geo replication plane)
+        sigs = tuple(int(s) for s in body.get("signatures") or ())
         old = await asyncio.get_event_loop().run_in_executor(
             None, self.filer.find_entry, entry.full_path)
         try:
             await asyncio.get_event_loop().run_in_executor(
                 None, lambda: self.filer.create_entry(
-                    entry, o_excl=body.get("o_excl", False)))
+                    entry, o_excl=body.get("o_excl", False),
+                    signatures=sigs))
         except FileExistsError:
             return web.json_response({"error": "exists"}, status=409)
         except (IsADirectoryError, NotADirectoryError) as e:
@@ -252,20 +267,24 @@ class FilerServer:
     async def meta_update(self, request: web.Request) -> web.Response:
         body = await request.json()
         entry = Entry.from_json(json.dumps(body["entry"]))
+        sigs = tuple(int(s) for s in body.get("signatures") or ())
         try:
             await asyncio.get_event_loop().run_in_executor(
-                None, self.filer.update_entry, entry)
+                None, lambda: self.filer.update_entry(entry,
+                                                      signatures=sigs))
         except FileNotFoundError:
             return web.json_response({"error": "not found"}, status=404)
         return web.json_response({"ok": True})
 
     async def meta_delete(self, request: web.Request) -> web.Response:
         body = await request.json()
+        sigs = tuple(int(s) for s in body.get("signatures") or ())
         try:
             await asyncio.get_event_loop().run_in_executor(
                 None, lambda: self.filer.delete_entry(
                     body["path"], recursive=body.get("recursive", False),
-                    free_chunks=body.get("free_chunks", True)))
+                    free_chunks=body.get("free_chunks", True),
+                    signatures=sigs))
         except FileNotFoundError:
             return web.json_response({"error": "not found"}, status=404)
         except OSError as e:
@@ -755,6 +774,10 @@ class FilerServer:
                     self._queue_chunk_deletes([rec])
                     last = e
                     continue
+                if self.cache_write_through and \
+                        0 < len(data) <= self.chunk_cache.max_chunk_bytes:
+                    # plaintext, like the read path's cipher handling
+                    self._cache_put(a["fid"], data)
                 return FileChunk(fid=a["fid"], offset=offset,
                                  size=len(data), mtime=time.time_ns(),
                                  etag=body.get("eTag", ""),
@@ -1095,8 +1118,13 @@ class FilerServer:
         sigs = _parse_signatures(request)
         await asyncio.get_event_loop().run_in_executor(
             None, lambda: self.filer.create_entry(entry, signatures=sigs))
-        self._queue_chunk_deletes(
-            self.filer.freeable_replaced_chunks(old_entry))
+        if request.query.get("free_old_chunks") != "false":
+            # ?free_old_chunks=false keeps the replaced entry's chunks
+            # alive: the S3 versioning path archives the old entry's
+            # chunk list as a sibling version entry BEFORE overwriting,
+            # so freeing here would tear the bytes out from under it
+            self._queue_chunk_deletes(
+                self.filer.freeable_replaced_chunks(old_entry))
         return web.json_response(
             {"name": entry.name, "size": offset,
              "chunks": len(chunks)}, status=201)

@@ -29,6 +29,8 @@ from aiohttp import web
 from .. import faults, observe, overload
 from ..cluster.raft import RaftNode, _endpoint_ips
 from ..ec.geometry import GeometryPolicy
+from ..geo import GeoConfig
+from ..geo.daemon import GeoDaemon
 from ..lifecycle.daemon import LifecycleDaemon
 from ..lifecycle.policy import LifecycleConfig
 from ..security.guard import Guard
@@ -75,7 +77,8 @@ class MasterServer:
                  repair_concurrency: int = 2,
                  ec_total_shards: int = 14,
                  ec_geometry_policy: Optional[GeometryPolicy] = None,
-                 lifecycle_config: Optional[LifecycleConfig] = None):
+                 lifecycle_config: Optional[LifecycleConfig] = None,
+                 geo_config: Optional[GeoConfig] = None):
         self.topology = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds)
@@ -173,6 +176,12 @@ class MasterServer:
         self.lifecycle = LifecycleDaemon(
             self, lifecycle_config or LifecycleConfig.from_env())
         self._lifecycle_task: Optional[asyncio.Task] = None
+        # geo plane: a leader-only daemon (same sibling discipline) that
+        # owns per-bucket cluster-to-cluster replication jobs, driven by
+        # PutBucketReplication rules on the filer. Runs only when a
+        # source filer is configured (WEED_GEO_FILER / geo_config).
+        self.geo = GeoDaemon(self, geo_config or GeoConfig.from_env())
+        self._geo_task: Optional[asyncio.Task] = None
         self.app = self._build_app()
 
     def _raft_apply(self, cmd: dict) -> None:
@@ -274,6 +283,8 @@ class MasterServer:
         app.router.add_post("/vol/heat/report", self.vol_heat_report)
         app.router.add_get("/lifecycle/status", self.lifecycle_status)
         app.router.add_post("/lifecycle/run", self.lifecycle_run)
+        app.router.add_get("/geo/status", self.geo_status)
+        app.router.add_post("/geo/run", self.geo_run)
         _faults_handler = faults.admin_handler()
         app.router.add_get("/admin/faults", _faults_handler)
         app.router.add_post("/admin/faults", _faults_handler)
@@ -298,6 +309,8 @@ class MasterServer:
         if self.lifecycle.cfg.enabled:
             self._lifecycle_task = asyncio.create_task(
                 self.lifecycle.run_loop())
+        if self.geo.cfg.enabled:
+            self._geo_task = asyncio.create_task(self.geo.run_loop())
         if self.grpc_port:
             from .master_grpc import serve_master_grpc
             host = (self.url.rsplit(":", 1)[0] if ":" in self.url
@@ -318,6 +331,9 @@ class MasterServer:
         if self._lifecycle_task:
             self._lifecycle_task.cancel()
         self.lifecycle.stop()
+        if self._geo_task:
+            self._geo_task.cancel()
+        await self.geo.aclose()
         for task in list(self._repair_tasks):
             task.cancel()
         if self._grpc_server is not None:
@@ -1170,6 +1186,22 @@ class MasterServer:
         """Trigger one evaluation pass now (operators / tests) — the
         same pass the timer loop runs."""
         out = await self.lifecycle.pass_once()
+        return web.json_response({"ok": True, **out})
+
+    # --- geo plane (cluster-to-cluster replication daemon state) ---
+
+    async def geo_status(self, request: web.Request) -> web.Response:
+        """Per-bucket replication job state: offsets, lag, applied/
+        skipped/poisoned counts (the `geo.status` shell command's
+        backend)."""
+        return web.json_response(self.geo.status())
+
+    async def geo_run(self, request: web.Request) -> web.Response:
+        """Trigger one rule-scan/reconcile pass now (operators / tests /
+        the `geo.sync` shell command) — the same pass the timer loop
+        runs; a fresh rule starts its job (and backfill) immediately."""
+        with overload.priority(overload.CLASS_BG):
+            out = await self.geo.pass_once()
         return web.json_response({"ok": True, **out})
 
     async def ec_lookup(self, request: web.Request) -> web.Response:

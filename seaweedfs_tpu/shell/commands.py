@@ -137,6 +137,7 @@ def _register_all() -> None:
     (the reference does the same via init() imports, shell/commands.go:42)."""
     from . import bucket_commands  # noqa: F401
     from . import fs_commands  # noqa: F401
+    from . import geo_commands  # noqa: F401
     from . import lock_commands  # noqa: F401
     from . import trace_commands  # noqa: F401
     from . import volume_commands  # noqa: F401

@@ -181,13 +181,17 @@ class Cluster:
 
     def add_filer(self, store_name: str = "memory",
                   chunk_size: int = 16 * 1024,
-                  with_grpc: bool = False):
+                  with_grpc: bool = False,
+                  store_kwargs: dict | None = None,
+                  port: int = 0):
         from aiohttp import web
 
         from seaweedfs_tpu.server.filer_server import FilerServer
 
-        port = free_port_with_grpc_twin() if with_grpc else free_port()
+        if not port:
+            port = free_port_with_grpc_twin() if with_grpc else free_port()
         fs = FilerServer(self.master_url, store_name=store_name,
+                         store_kwargs=store_kwargs,
                          chunk_size=chunk_size,
                          url=f"127.0.0.1:{port}",
                          grpc_port=port + 10000 if with_grpc else 0)

@@ -370,7 +370,9 @@ def test_warm_get_skips_volume_fetch(cluster):
     assert fs.metrics.value("chunk_cache_hit",
                             labels={"tier": "memory"}) >= 2
 
-    # counters surface in /metrics exposition
+    # counters surface in /metrics exposition (write-through means no
+    # organic miss happened yet — make one so the family exists)
+    assert fs.chunk_cache.get("999,nosuchchunk") is None
     with urllib.request.urlopen(f"http://{fs.url}/metrics",
                                 timeout=10) as r:
         text = r.read().decode()
@@ -397,6 +399,10 @@ def test_concurrent_cold_reads_issue_one_backend_fetch(cluster):
     urllib.request.urlopen(
         urllib.request.Request(f"http://{fs.url}/sf/one.bin",
                                data=body, method="PUT"), timeout=10).read()
+    # the write path populated the cache (write-through); this test is
+    # about COLD-read coalescing, so manufacture coldness explicitly
+    from seaweedfs_tpu.cache import TieredChunkCache
+    fs.chunk_cache = TieredChunkCache.from_env(metrics=fs.metrics)
 
     fetches = []
     real = fs._fetch_raw

@@ -42,6 +42,10 @@ def overloaded_filer(cluster, monkeypatch):
     monkeypatch.setenv("WEED_ADMISSION_QUEUE_TIMEOUT_MS", "20000")
     monkeypatch.setenv("WEED_ADMISSION_LAG_SAMPLE_MS", str(WINDOW_MS))
     monkeypatch.setenv("WEED_ADMISSION_RETRY_AFTER_S", "1")
+    # these drills need reads to actually REACH the faulted volume —
+    # write-through caching would serve the just-written files from
+    # the filer's chunk cache and no fg pressure would ever form
+    monkeypatch.setenv("WEED_CHUNK_CACHE_WRITE_THROUGH", "0")
     fs = cluster.add_filer(chunk_size=16 * 1024)
     yield fs
     faults.clear()

@@ -122,3 +122,168 @@ def _only_meta(inp):
         def ack(self):
             inp.ack()
     return W()
+
+
+# --- replicator offset durability + poison semantics (live subscribe
+#     stream against a real filer; the geo plane's satellite coverage
+#     for the SYNC replicator) ---
+
+
+@pytest.fixture(scope="module")
+def live_filer(cluster):
+    return cluster.add_filer()
+
+
+def _filer_put(filer_url: str, path: str, data: bytes) -> None:
+    import urllib.request
+    req = urllib.request.Request(
+        f"http://{filer_url}{path}", data=data, method="PUT",
+        headers={"Content-Type": "application/octet-stream"})
+    urllib.request.urlopen(req, timeout=30).close()
+
+
+def _filer_mkdir(filer_url: str, path: str) -> None:
+    import urllib.request
+    req = urllib.request.Request(
+        f"http://{filer_url}{path}?op=mkdir", method="POST")
+    urllib.request.urlopen(req, timeout=30).close()
+
+
+class CountingSink:
+    """LocalSink wrapper counting applies per path — the evidence for
+    'zero re-applied, zero lost'."""
+
+    def __init__(self, directory: str):
+        from seaweedfs_tpu.replication.sink import LocalSink
+        self.inner = LocalSink(directory)
+        self.creates: dict = {}
+        self.deletes: dict = {}
+
+    def create_entry(self, entry, fetch_data, signatures=()):
+        self.creates[entry.full_path] = \
+            self.creates.get(entry.full_path, 0) + 1
+        return self.inner.create_entry(entry, fetch_data, signatures)
+
+    def update_entry(self, old, new, fetch_data, signatures=()):
+        return self.create_entry(new, fetch_data, signatures)
+
+    def delete_entry(self, entry, signatures=()):
+        self.deletes[entry.full_path] = \
+            self.deletes.get(entry.full_path, 0) + 1
+        return self.inner.delete_entry(entry, signatures)
+
+
+def test_replicator_offset_durable_across_restart(live_filer, tmp_path):
+    """Kill the replicator between runs: the second instance resumes
+    from the persisted offset — zero re-applied, zero lost."""
+    from seaweedfs_tpu.replication.replicator import Replicator
+
+    filer = live_filer.url
+    offset_path = str(tmp_path / "offset.json")
+    payload = {f"/r1/f{i}": f"durable {i}".encode() for i in range(10)}
+    _filer_mkdir(filer, "/r1")
+    for p, data in payload.items():
+        _filer_put(filer, p, data)
+
+    # instance 1: consume the mkdir + first 5 files, then "die"
+    sink1 = CountingSink(str(tmp_path / "sink"))
+    r1 = Replicator(filer, sink1, "/r1", offset_path=offset_path)
+    assert r1.run(max_events=6) == 6
+    del r1  # no handover — the offset file is the only shared state
+
+    # instance 2: resumes from the durable offset
+    sink2 = CountingSink(str(tmp_path / "sink"))
+    r2 = Replicator(filer, sink2, "/r1", offset_path=offset_path)
+    assert r2.run(max_events=5) == 5
+
+    creates: dict = {}
+    for s in (sink1, sink2):
+        for p, n in s.creates.items():
+            creates[p] = creates.get(p, 0) + n
+    # zero lost: every file applied; zero re-applied: exactly once
+    for p in payload:
+        assert creates.get(p) == 1, (p, creates)
+    for p, data in payload.items():
+        with open(str(tmp_path / "sink") + p, "rb") as f:
+            assert f.read() == data
+
+    # a third instance sees nothing new (offset is at the tail)
+    sink3 = CountingSink(str(tmp_path / "sink"))
+    r3 = Replicator(filer, sink3, "/r1", offset_path=offset_path)
+    _filer_put(filer, "/r1/late", b"only this one")
+    assert r3.run(max_events=1) == 1
+    assert list(sink3.creates) == ["/r1/late"]
+
+
+def test_replicator_poison_event_exact_retries(live_filer, tmp_path):
+    """A persistently-failing event is attempted exactly
+    MAX_EVENT_RETRIES times, skipped loudly, and the stream moves on."""
+    import threading
+
+    from seaweedfs_tpu import faults
+    from seaweedfs_tpu.replication.replicator import Replicator
+
+    filer = live_filer.url
+    sink = CountingSink(str(tmp_path / "psink"))
+    r = Replicator(filer, sink, "/r2",
+                   offset_path=str(tmp_path / "poffset.json"))
+    stop = [False]
+    out = {}
+
+    def run():
+        out["applied"] = r.run(stop_check=lambda: stop[0])
+
+    _filer_mkdir(filer, "/r2")
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    deadline = time.time() + 20
+    while not os.path.isdir(str(tmp_path / "psink") + "/r2"):
+        assert time.time() < deadline, "mkdir never applied"
+        time.sleep(0.05)
+
+    # the fault budget IS the retry ceiling: if the replicator tried a
+    # 4th time it would succeed — the test would see /r2/poisoned in
+    # the sink and fail
+    assert Replicator.MAX_EVENT_RETRIES == 3
+    faults.set_fault("geo.apply", "error",
+                     count=Replicator.MAX_EVENT_RETRIES)
+    try:
+        _filer_put(filer, "/r2/poisoned", b"never lands")
+        _filer_put(filer, "/r2/after", b"lands fine")
+        deadline = time.time() + 20
+        while "/r2/after" not in sink.creates:
+            assert time.time() < deadline, "stream wedged behind poison"
+            time.sleep(0.05)
+        # exactly MAX_EVENT_RETRIES attempts, then a loud skip
+        fired = [f for f in faults.active()
+                 if f["point"] == "geo.apply"][0]["fired"]
+        assert fired == Replicator.MAX_EVENT_RETRIES
+        assert "/r2/poisoned" not in sink.creates
+        assert sink.creates.get("/r2/after") == 1
+    finally:
+        faults.clear("geo.apply")
+        stop[0] = True
+        _filer_put(filer, "/r2/wake", b"unblock the stop_check")
+        t.join(timeout=10)
+        assert not t.is_alive()
+
+
+def test_corrupt_spool_line_skipped_loudly(tmp_path):
+    """consume_spool_file: a corrupt JSON line is skipped with a
+    replication_corrupt_events count, never silently swallowed."""
+    from seaweedfs_tpu.replication.replicator import consume_spool_file
+    from seaweedfs_tpu.utils import metrics as metrics_mod
+
+    spool = tmp_path / "events-0001.ndjson"
+    good = _event("/s/ok", 5)
+    lines = [json.dumps(good.to_dict()),
+             '{"tsns": 6, "directory": "/s", CORRUPT',
+             json.dumps(_event("/s/ok2", 7).to_dict())]
+    spool.write_text("\n".join(lines) + "\n", encoding="utf-8")
+
+    reg = metrics_mod.shared("replication")
+    before = reg._counters.get("replication_corrupt_events", 0)
+    got = [e.new_entry.full_path for e in consume_spool_file(str(spool))]
+    assert got == ["/s/ok", "/s/ok2"]
+    assert reg._counters.get("replication_corrupt_events", 0) \
+        == before + 1
