@@ -639,9 +639,15 @@ def bench_kernel(k: int, m: int, n: int, reps: int, tile=None, rounds=1):
     return med, spread, single_launch_s
 
 
-def phase_kernel(budget_s: float = 390.0) -> dict:
+def phase_kernel(work: str = "", budget_s: float = 390.0) -> dict:
     """Pinned kernel + RS(k,m) sweep (config 4) + tile sweep, ordered so
-    every config reports at least one number before optional extras."""
+    every config reports at least one number before optional extras.
+
+    Every sweep/tile cell is pre-filled with a "skipped: not reached"
+    reason and the record checkpoints after each cell, so a phase that
+    times out mid-sweep leaves reason strings in <work>/kernel_partial
+    .json instead of nulling cells it never got to (BENCH_r05 recorded
+    a bare null at tile 131072 exactly this way)."""
     import jax
 
     from seaweedfs_tpu.ops import rs_pallas
@@ -651,6 +657,10 @@ def phase_kernel(budget_s: float = 390.0) -> dict:
     reps = 10 if on_tpu else 3
     started = time.perf_counter()
     out: dict = {"backend": jax.default_backend()}
+
+    def ckpt() -> None:
+        if work:
+            _phase_checkpoint(work, "kernel", out)
 
     def left() -> float:
         return budget_s - (time.perf_counter() - started)
@@ -680,17 +690,29 @@ def phase_kernel(budget_s: float = 390.0) -> dict:
             "healthy-session measurements of the same pinned config "
             "are 33-37 GB/s")
     last = max(45.0, time.perf_counter() - t0)
+    ckpt()
 
     # 2) geometry sweep — every cell before any optional extra. A cell
     # that can't run records WHY as a string ("skipped: ..."/"error: ...")
-    # instead of a bare null, so trajectory diffs across rounds stay
-    # machine-comparable (BENCH_r05 recorded "131072": null with no way
-    # to tell budget-skip from compile failure).
-    sweep: dict = {}
+    # instead of a bare null, and the dicts start fully populated with
+    # "skipped: not reached" so even a phase KILLED mid-cell leaves a
+    # reason string, never a null (BENCH_r05 recorded "131072": null —
+    # the per-cell strings existed but only materialized for cells the
+    # loop actually visited before the phase timed out).
+    not_reached = "skipped: not reached (phase timed out or died earlier)"
+    sweep: dict = {f"{k},{m}": not_reached
+                   for (k, m) in ((20, 4), (12, 4), (6, 3))}
+    tiles: dict = {tl: not_reached
+                   for tl in dict.fromkeys(
+                       (rs_pallas.DEFAULT_TILE, 65536, 131072))}
+    out["sweep_kernel_gbps"] = sweep
+    out["tile_sweep_gbps"] = tiles
+    ckpt()
     for (k, m) in ((20, 4), (12, 4), (6, 3)):
         if left() < last * 1.2:
             sweep[f"{k},{m}"] = (f"skipped: budget ({left():.0f}s left, "
                                  f"cell needs ~{last * 1.2:.0f}s)")
+            ckpt()
             continue
         t0 = time.perf_counter()
         nn = n - n % (16384 * 8)
@@ -700,19 +722,18 @@ def phase_kernel(budget_s: float = 390.0) -> dict:
             sweep[f"{k},{m}"] = (f"error: {type(e).__name__}: "
                                  f"{str(e)[:160]}")
             last = max(45.0, time.perf_counter() - t0)
+            ckpt()
             continue
         last = max(45.0, time.perf_counter() - t0)
         sweep[f"{k},{m}"] = round(g, 2)
-    out["sweep_kernel_gbps"] = sweep
+        ckpt()
 
     # 3) tile sweep (DEFAULT_TILE reuses the step-1 compile)
-    tiles: dict = {}
-    for tl in (rs_pallas.DEFAULT_TILE, 65536, 131072):
-        if tl in tiles:
-            continue
+    for tl in list(tiles):
         if left() < last * 1.2:
             tiles[tl] = (f"skipped: budget ({left():.0f}s left, "
                          f"cell needs ~{last * 1.2:.0f}s)")
+            ckpt()
             continue
         t0 = time.perf_counter()
         try:
@@ -720,10 +741,11 @@ def phase_kernel(budget_s: float = 390.0) -> dict:
         except Exception as e:
             tiles[tl] = f"error: {type(e).__name__}: {str(e)[:160]}"
             last = max(45.0, time.perf_counter() - t0)
+            ckpt()
             continue
         last = max(45.0, time.perf_counter() - t0)
         tiles[tl] = round(g, 2)
-    out["tile_sweep_gbps"] = tiles
+        ckpt()
 
     # arithmetic context for the kernel number
     ops_per_s = 128 * 4 * out["kernel"]["gbps"] * 1e9
@@ -1990,6 +2012,266 @@ def phase_lifecycle(work: str, budget_s: float = 240.0,
     return out
 
 
+def phase_multichip(work: str, budget_s: float = 240.0) -> dict:
+    """Mesh-sharded encode/rebuild fabric on the 8-device virtual CPU
+    mesh (the MULTICHIP dryrun substrate, now through the PRODUCTION
+    MeshCoder + pipeline instead of the kernel demo).
+
+    What each number means — and what the substrate can and cannot
+    show:
+
+      * aggregate_wall_gbps[n]: real wall-clock aggregate of the mesh
+        path at mesh size n, weak-scaled workload (n * per-chip bytes).
+        Virtual CPU devices SHARE the host's cores (one XLA device
+        already saturates the machine), so this curve is flat-ish here
+        by construction; on ICI-attached chips each device is its own
+        silicon and the wall curve IS the projection below.
+      * per_chip_slice_gbps[n]: measured single-device rate at exactly
+        the per-chip slice width mesh size n deals each device.
+      * fabric_overhead[n]: mesh-executable wall over n * single-device
+        slice wall — the work the fabric ADDS (padding, resharding,
+        collectives, dispatch serialization). ~1.0 means the shard_map
+        program does per-chip work and nothing else.
+      * aggregate_projected_gbps[n] = n * per_chip_slice_gbps[n]
+        / fabric_overhead[n]: the aggregate on hardware where chips
+        don't share cores. Valid exactly when collective_free holds —
+        which is asserted from the compiled HLO, not assumed.
+
+    Plus: shard byte-identity vs the single-chip striping layout at
+    RS(10,4) AND RS(20,4) (odd batch width → padded shard_map path),
+    and a simulated rack-loss rebuild storm (6 volumes) drained through
+    the master's WEED_EC_ENCODE_WORKERS pool vs serial dispatch.
+    """
+    # must land BEFORE the first jax import in this process
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import hashlib
+
+    import jax
+
+    from seaweedfs_tpu import ec
+    from seaweedfs_tpu.ec import pipeline
+    from seaweedfs_tpu.ec.coder import JaxCoder
+    from seaweedfs_tpu.parallel import mesh_coder
+
+    started = time.perf_counter()
+    out: dict = {"backend": jax.default_backend(),
+                 "devices": len(jax.devices())}
+    _phase_checkpoint(work, "multichip", out)
+
+    def left() -> float:
+        return budget_s - (time.perf_counter() - started)
+
+    # --- scaling curve: weak-scaled encode over mesh sizes 1/2/4/8 ---
+    k, m = 10, 4
+    per_chip_w = MB  # per-chip slice: [10, 1MB]
+    reps = 3
+    rng = np.random.default_rng(11)
+    curve: dict = {}
+    single = JaxCoder(k, m)
+    for n in (1, 2, 4, 8):
+        if left() < 30:
+            curve[str(n)] = f"skipped: budget ({left():.0f}s left)"
+            continue
+        coder = mesh_coder.coder(k, m, n_devices=n)
+        data = rng.integers(0, 256, (k, n * per_chip_w), dtype=np.uint8)
+        slice_data = data[:, :per_chip_w]
+        # mesh wall (includes per-chip staging)
+        h = coder.encode_async(data)  # compile + warm
+        np.asarray(getattr(h, "arr", h))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            h = coder.encode_async(data)
+        np.asarray(getattr(h, "arr", h))
+        t_mesh = (time.perf_counter() - t0) / reps
+        # single-device slice wall (the per-chip work at this mesh size)
+        hs = single.encode_async(slice_data)
+        np.asarray(hs)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            hs = single.encode_async(slice_data)
+        np.asarray(hs)
+        t_dev = (time.perf_counter() - t0) / reps
+        per_chip_gbps = k * per_chip_w / t_dev / 1e9
+        overhead = t_mesh / (n * t_dev) if n > 1 else t_mesh / t_dev
+        projected = n * per_chip_gbps / max(overhead, 1e-9)
+        curve[str(n)] = {
+            "aggregate_wall_gbps": round(k * n * per_chip_w / t_mesh / 1e9,
+                                         3),
+            "per_chip_slice_gbps": round(per_chip_gbps, 3),
+            "fabric_overhead": round(overhead, 3),
+            "aggregate_projected_gbps": round(projected, 3),
+        }
+        out["scaling"] = curve
+        _phase_checkpoint(work, "multichip", out)
+    mesh8 = mesh_coder.coder(k, m, n_devices=min(8, len(jax.devices())))
+    out["collective_free"] = bool(
+        getattr(mesh8, "encode_is_collective_free", lambda: True)())
+    _phase_checkpoint(work, "multichip", out)
+
+    # --- byte-identity: mesh pipeline vs single-chip striping layout ---
+    def _identity(geometry: "ec.Geometry", seed: int) -> bool:
+        kk, mm = geometry.data_shards, geometry.parity_shards
+        size = 61_007
+        r = np.random.default_rng(seed)
+        payload = r.integers(0, 256, size, dtype=np.uint8).tobytes()
+        ref = os.path.join(work, f"mc_ref_{kk}_{mm}_1")
+        mesh_base = os.path.join(work, f"mc_mesh_{kk}_{mm}_1")
+        for base in (ref, mesh_base):
+            with open(base + ".dat", "wb") as f:
+                f.write(payload)
+        ec.write_ec_files(ref, _host_coder_km(kk, mm), geometry,
+                          buffer_size=100)
+        # odd batch width: not divisible by the mesh -> padded path
+        pipeline.stream_encode(mesh_base,
+                               mesh_coder.coder(kk, mm,
+                                                n_devices=min(
+                                                    8, len(jax.devices()))),
+                               geometry, batch_size=999)
+        for i in range(geometry.total_shards):
+            a = hashlib.sha256(
+                open(ref + ec.to_ext(i), "rb").read()).hexdigest()
+            b = hashlib.sha256(
+                open(mesh_base + ec.to_ext(i), "rb").read()).hexdigest()
+            if a != b:
+                return False
+        return True
+
+    def _host_coder_km(kk: int, mm: int):
+        try:
+            return ec.get_coder("cpp", kk, mm)
+        except Exception:
+            return ec.get_coder("numpy", kk, mm)
+
+    ident: dict = {}
+    for label, g in (("10+4", ec.Geometry(10, 4, large_block_size=10000,
+                                          small_block_size=100)),
+                     ("20+4", ec.Geometry(20, 4, large_block_size=10000,
+                                          small_block_size=100))):
+        if left() < 30:
+            ident[label] = f"skipped: budget ({left():.0f}s left)"
+            continue
+        try:
+            ident[label] = bool(_identity(g, seed=len(label)))
+        except Exception as e:
+            ident[label] = f"error: {type(e).__name__}: {str(e)[:160]}"
+        out["byte_identity"] = ident
+        _phase_checkpoint(work, "multichip", out)
+
+    # --- rebuild storm: worker pool vs serial dispatch ---
+    if left() > 30:
+        try:
+            out["rebuild_storm"] = _multichip_storm()
+        except Exception as e:
+            out["rebuild_storm"] = {"error":
+                                    f"{type(e).__name__}: {str(e)[:300]}"}
+    else:
+        out["rebuild_storm"] = f"skipped: budget ({left():.0f}s left)"
+    _phase_checkpoint(work, "multichip", out)
+
+    c = {n: v for n, v in curve.items() if isinstance(v, dict)}
+    proj = {n: v["aggregate_projected_gbps"] for n, v in c.items()}
+    storm = out.get("rebuild_storm")
+    out["accept"] = {
+        "collective_free": out.get("collective_free") is True,
+        "scaling_1_to_2_ge_1p7": bool(
+            proj.get("1") and proj.get("2")
+            and proj["2"] / proj["1"] >= 1.7),
+        "scaling_monotone_to_8": bool(
+            len(proj) == 4
+            and all(proj[str(2 * i)] >= 0.95 * proj[str(i)]
+                    for i in (1, 2, 4))),
+        "byte_identity_both_geometries": all(
+            v is True for v in ident.values()) and len(ident) == 2,
+        "storm_drain_under_0p6x_serial": bool(
+            isinstance(storm, dict)
+            and (storm.get("drain_ratio") or 9.9) < 0.6),
+    }
+    return out
+
+
+def _multichip_storm(volumes: int = 6, rpc_s: float = 0.2) -> dict:
+    """Rack-loss rebuild storm through the REAL master repair plumbing
+    (planner, 2-pass deficit confirmation, semaphore pool, per-worker
+    logs): 6 EC volumes short of shards, every rebuild RPC stubbed to a
+    fixed service time (the master's wall time IS dispatch wait — the
+    rebuild compute runs on the volume servers). Measures drain wall
+    with the WEED_EC_ENCODE_WORKERS pool vs serial dispatch."""
+    import asyncio
+
+    from seaweedfs_tpu.cluster import raft as raft_mod
+    from seaweedfs_tpu.server.master import MasterServer
+
+    total = 14
+
+    def build_master(workers: int) -> "MasterServer":
+        master = MasterServer(repair_concurrency=workers,
+                              maintenance_interval_seconds=3600.0)
+        master.raft.role = raft_mod.LEADER
+        # rack r2 died taking shards {3, 7, 11} of every volume with it
+        # (11 survivors >= k=10, so each volume is rebuildable); racks
+        # r0/r1 hold the survivors, r2's replacement node sits empty
+        lost = {3, 7, 11}
+        holdings = {0: [s for s in range(total)
+                        if s not in lost and s % 2 == 0],
+                    1: [s for s in range(total)
+                        if s not in lost and s % 2 == 1],
+                    2: []}
+        for i in range(3):
+            payload = {"volumes": [], "ec_shards": [
+                {"id": vid, "collection": "",
+                 "shard_ids": list(holdings[i])}
+                for vid in range(1, volumes + 1)] if holdings[i] else []}
+            master.topology.register_heartbeat(
+                f"n{i}", f"127.0.0.1:{18080 + i}", "", "dc1", f"r{i}",
+                100, payload)
+
+        calls: list = []
+
+        async def fake_admin_post(url, op, body, timeout=60.0):
+            calls.append((url, op))
+            await asyncio.sleep(rpc_s)
+            if op == "ec/rebuild":
+                return {"rebuilt": []}
+            return {"ok": True}
+
+        master._admin_post = fake_admin_post
+        master._storm_calls = calls
+        return master
+
+    async def drain(workers: int) -> float:
+        master = build_master(workers)
+        await master._repair_pass()   # pass 1: deficit seen
+        t0 = time.perf_counter()
+        await master._repair_pass()   # pass 2: confirmed -> launch
+        while master._repair_tasks:
+            await asyncio.gather(*list(master._repair_tasks),
+                                 return_exceptions=True)
+        wall = time.perf_counter() - t0
+        rebuilds = sum(1 for _, op in master._storm_calls
+                       if op == "ec/rebuild")
+        assert rebuilds == volumes, (rebuilds, volumes)
+        return wall
+
+    env_workers = os.environ.get("WEED_EC_ENCODE_WORKERS", "")
+    try:
+        pool = max(2, int(env_workers)) if env_workers else 4
+    except ValueError:
+        pool = 4
+    serial_wall = asyncio.run(drain(1))
+    pool_wall = asyncio.run(drain(pool))
+    return {
+        "volumes": volumes, "rebuild_rpc_s": rpc_s, "workers": pool,
+        "serial_drain_s": round(serial_wall, 3),
+        "pool_drain_s": round(pool_wall, 3),
+        "drain_ratio": round(pool_wall / serial_wall, 3)
+        if serial_wall > 1e-9 else None,
+    }
+
+
 def phase_lint(work: str = "", budget_s: float = 60.0) -> dict:
     """weedlint smoke: the full-tree static-analysis gate must stay
     cheap enough to live inside the tier-1 pytest run. Runs the exact
@@ -2222,6 +2504,21 @@ def main() -> None:
         detail["georepl"] = georepl
         _checkpoint(detail)
 
+        # multichip runs in its own subprocess because it must pin
+        # JAX_PLATFORMS=cpu + the 8-virtual-device flag BEFORE jax
+        # initializes (the phase body sets both; a TPU-attached parent
+        # env would otherwise grab the tunnel)
+        multichip: dict = {"error": "skipped (budget)"}
+        if left() > 90:
+            multichip = _run_phase("multichip", work, min(260.0, left()))
+            sc = multichip.get("scaling") or {}
+            _log(f"multichip: projected "
+                 f"{[(n, (v.get('aggregate_projected_gbps') if isinstance(v, dict) else v)) for n, v in sorted(sc.items())]}, "
+                 f"storm ratio "
+                 f"{(multichip.get('rebuild_storm') or {}).get('drain_ratio') if isinstance(multichip.get('rebuild_storm'), dict) else None}")
+        detail["multichip"] = multichip
+        _checkpoint(detail)
+
         try:
             lint = phase_lint(work)
             _log(f"lint: {lint.get('lint_wall_s')}s over "
@@ -2306,6 +2603,12 @@ def main() -> None:
                 "georepl_steady_lag_s":
                     (georepl.get("steady_lag_s") or {}).get("median"),
                 "georepl_lag_ratio": georepl.get("lag_ratio"),
+                "multichip_scaling": multichip.get("scaling"),
+                "multichip_storm_drain_ratio":
+                    (multichip.get("rebuild_storm") or {}).get(
+                        "drain_ratio")
+                    if isinstance(multichip.get("rebuild_storm"), dict)
+                    else None,
                 "lint_wall_s": lint.get("lint_wall_s"),
                 "detail_file": "BENCH_DETAIL.json",
             },
@@ -2323,7 +2626,9 @@ if __name__ == "__main__":
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         fn = {"encode": phase_encode,
               "rebuild": lambda w: phase_rebuild(w, budget_s=budget),
-              "kernel": lambda w: phase_kernel(), "fused": phase_fused,
+              "kernel": lambda w: phase_kernel(w, budget_s=budget),
+              "fused": phase_fused,
+              "multichip": lambda w: phase_multichip(w, budget_s=budget),
               "degraded": lambda w: phase_degraded(w, budget_s=budget),
               "largefile": phase_largefile,
               "overload": lambda w: phase_overload(w, budget_s=budget),

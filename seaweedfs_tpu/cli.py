@@ -668,9 +668,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="seconds between maintenance-daemon passes "
                         "(prune + repair planner; default: pulse, "
                         "0 disables the daemon)")
-    m.add_argument("-repair_concurrency", type=int, default=2,
+    m.add_argument("-repair_concurrency", type=int, default=None,
                    help="max concurrent repairs (re-replication / "
-                        "auto ec.rebuild) the daemon drives")
+                        "auto ec.rebuild / lifecycle encodes) the "
+                        "daemon drives; default WEED_EC_ENCODE_WORKERS "
+                        "or 2")
     m.set_defaults(fn=cmd_master)
 
     v = sub.add_parser("volume", help="run a volume server")

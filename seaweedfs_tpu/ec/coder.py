@@ -332,9 +332,9 @@ class JaxCoder(ErasureCoder):
         import jax.numpy as jnp
         fn = getattr(self, "_digest_fn", None)
         if fn is None:
-            fn = self._digest_fn = _fused_digest(
-                lambda d: rs_jax.encode_parity(d, self.m,
-                                               method=self.method))
+            # via the _encode_fn hook so subclasses' kernel choice
+            # (MeshCoder's pallas/lut methods) holds on this path too
+            fn = self._digest_fn = _fused_digest(self._encode_fn())
         if acc is None:
             acc = jnp.zeros(self.m, dtype=jnp.uint32)
         return fn(jax.device_put(np.asarray(data, dtype=np.uint8)), acc)
@@ -638,11 +638,21 @@ def register_coder(name: str, factory) -> None:
     _REGISTRY[name] = factory
 
 
+def _mesh_factory(data_shards: int, parity_shards: int) -> ErasureCoder:
+    """Mesh-or-single factory (parallel/mesh_coder.py): a MeshCoder over
+    WEED_EC_MESH_DEVICES (default: every local device), degenerating to
+    the plain JaxCoder on a 1-chip host. Imported lazily — the parallel
+    package must not load for processes that never pick this backend."""
+    from ..parallel import mesh_coder as mesh_mod
+    return mesh_mod.coder(data_shards, parity_shards)
+
+
 register_coder("numpy", NumpyCoder)
 register_coder("jax", JaxCoder)
 register_coder("jax_lut", lambda k, m: JaxCoder(k, m, method="lut"))
 register_coder("pallas", PallasCoder)
 register_coder("cpp", CppCoder)
+register_coder("mesh", _mesh_factory)
 
 
 def get_coder(name: str, data_shards: int, parity_shards: int) -> ErasureCoder:

@@ -64,6 +64,7 @@ class OperatingPoint(NamedTuple):
     depth: int        # read + materialize queue depth
     write_depth: int  # per-shard-file writer queue depth
     readers: int = 1  # feed reader-pool width (ec/feed.py)
+    chips: int = 1    # device-mesh width (parallel/mesh_coder.py)
 
 
 # per-batch read time below this is dispatch/syscall-overhead-dominated:
@@ -101,21 +102,32 @@ class FeedGovernor:
 
     # --- planning ---
 
-    def plan(self, nbytes: int, k: int) -> OperatingPoint:
+    def plan(self, nbytes: int, k: int, chips: int = 1) -> OperatingPoint:
         """The operating point for the next run, memory-clamped.  The
         pooled staging footprint is (depth + 2) buffers of k * batch
-        bytes (depth queued + one assembling + one in flight)."""
+        bytes (depth queued + one assembling + one in flight). `chips`
+        is the coder's mesh width (parallel/mesh_coder.py): each batch's
+        column axis splits across that many devices, so the batch is
+        clamped no smaller than one reasonable slice per chip."""
         with self._lock:
             batch, depth = self._batch, self._depth
+            # a mesh run's effective batch floor scales with the mesh:
+            # below chips * batch_min each chip's slice is narrower than
+            # the single-chip minimum and per-dispatch overhead dominates
+            floor = min(max(self.batch_min, self.batch_min * max(chips, 1)),
+                        self.batch_max)
+            batch = max(batch, floor)
             while (depth + 2) * k * batch > self.budget:
-                if batch > self.batch_min:
+                if batch > floor:
+                    batch = max(batch // 2, floor)
+                elif batch > self.batch_min:
                     batch = max(batch // 2, self.batch_min)
                 elif depth > self.depth_min:
                     depth -= 1
                 else:
                     break
             op = OperatingPoint(batch, depth, self._write_depth,
-                                self._readers)
+                                self._readers, max(chips, 1))
             self._export(op)
             return op
 
@@ -156,7 +168,8 @@ class FeedGovernor:
             if self.enabled:
                 self._retune(stages, op)
             self._export(OperatingPoint(self._batch, self._depth,
-                                        self._write_depth, self._readers))
+                                        self._write_depth, self._readers,
+                                        op.chips))
 
     def _retune(self, stages: dict[str, tuple[int, float]],
                 op: OperatingPoint) -> None:
@@ -183,7 +196,15 @@ class FeedGovernor:
                 # reader pool maxed: deeper prefetch smooths bursts
                 self._depth = min(op.depth + 1, self.depth_max)
         elif slowest in ("kernel", "dispatch"):
-            if share > _BIND_FRACTION and op.depth < self.depth_max:
+            if (share > _BIND_FRACTION and op.chips > 1
+                    and op.batch_size < self.batch_max):
+                # mesh runs: each chip sees batch/chips columns, so the
+                # batch must scale WITH the mesh before queues deepen —
+                # a wider batch restores full per-chip slices (amortizing
+                # per-dispatch overhead across the fabric), while deeper
+                # queues only buffer more undersized dispatches
+                self._batch = min(op.batch_size * 2, self.batch_max)
+            elif share > _BIND_FRACTION and op.depth < self.depth_max:
                 # the chip is the slow stage: keep more host batches
                 # queued so it never waits on the feed
                 self._depth = min(op.depth + 1, self.depth_max)
@@ -207,6 +228,7 @@ class FeedGovernor:
         self.metrics.gauge("feed_queue_depth", op.write_depth,
                            labels={"queue": "write"})
         self.metrics.gauge("feed_reader_threads", op.readers)
+        self.metrics.gauge("feed_mesh_devices", op.chips)
         self.metrics.gauge("feed_governor_enabled", 1.0 if self.enabled
                            else 0.0)
         self.metrics.gauge("feed_runs", self.runs)

@@ -59,19 +59,29 @@ _SENTINEL = None
 
 
 def _resolve_op(batch_size: Optional[int], depth: Optional[int],
-                nbytes: int, k: int) -> tuple["governor.OperatingPoint",
-                                              bool]:
+                nbytes: int, k: int,
+                chips: int = 1) -> tuple["governor.OperatingPoint",
+                                         bool]:
     """(operating point, governed?) — explicit args pin the plan and opt
     the run out of the governor entirely: no retuning from this run's
     shapes AND no export of a plan the run isn't using (tests and
     benches must neither steer nor misreport the process-global
-    operating point)."""
+    operating point). `chips` is the coder's mesh width — the governor
+    scales the batch with it before deepening queues."""
     if batch_size is None and depth is None:
-        return governor.get().plan(nbytes, k), True
+        return governor.get().plan(nbytes, k, chips=chips), True
     b = batch_size if batch_size is not None else DEFAULT_BATCH_SIZE
     d = depth if depth is not None else DEFAULT_DEPTH
     return governor.OperatingPoint(b, d, d,
-                                   feed_mod.reader_count_default()), False
+                                   feed_mod.reader_count_default(),
+                                   max(chips, 1)), False
+
+
+def coder_chips(coder: ErasureCoder) -> int:
+    """The device-mesh width a coder spreads each batch over (1 for
+    every single-chip backend; parallel/mesh_coder.MeshCoder exports
+    mesh_devices)."""
+    return int(getattr(coder, "mesh_devices", 1) or 1)
 
 
 def stager_count_default() -> int:
@@ -276,7 +286,15 @@ def _run_pipeline(batches: Iterator[np.ndarray], dispatch, consume,
                         tags={"batch": batch_i}))
                 stack.enter_context(
                     trace_annotation("ec_pipeline_dispatch"))
-                handle = dispatch(batch)
+                try:
+                    handle = dispatch(batch)
+                except BaseException:
+                    # the in-flight batch is nobody else's to recycle:
+                    # the drain below only sees batches still QUEUED, so
+                    # a dispatch that dies here would strand this one's
+                    # pooled staging buffer lent forever
+                    _recycle(batch)
+                    raise
             batch_i += 1
             # kick the device->host copy off immediately so it overlaps the
             # next batch's H2D + kernel instead of starting at materialize
@@ -328,7 +346,7 @@ def stream_encode(base_file_name: str, coder: ErasureCoder,
         op, governed = _op, False
     else:
         op, governed = _resolve_op(batch_size, depth, dat_size,
-                                   g.data_shards)
+                                   g.data_shards, coder_chips(coder))
     src = feed_mod.open_feed(base_file_name + ".dat", g.data_shards,
                              op.batch_size, pool_buffers=op.depth + 2,
                              readers=op.readers)
@@ -387,7 +405,8 @@ def stream_encode_many(base_file_names: Sequence[str], coder: ErasureCoder,
     if not bases:
         return 0
     total = sum(os.path.getsize(b + ".dat") for b in bases)
-    op, governed = _resolve_op(batch_size, depth, total, g.data_shards)
+    op, governed = _resolve_op(batch_size, depth, total, g.data_shards,
+                               coder_chips(coder))
     tctx = observe.ensure_ctx("ec")
     for base in bases:
         with observe.stage("ec.volume", tctx, tags={"base": base}):
@@ -781,7 +800,8 @@ def stream_rebuild(base_file_name: str, coder: ErasureCoder,
 
     shard_size = os.path.getsize(base_file_name + to_ext(survivors_ids[0]))
     op, governed = _resolve_op(batch_size, depth,
-                               g.data_shards * shard_size, g.data_shards)
+                               g.data_shards * shard_size, g.data_shards,
+                               coder_chips(coder))
     src = feed_mod.ShardFeed(
         [base_file_name + to_ext(i) for i in survivors_ids],
         op.batch_size, pool_buffers=op.depth + 2, readers=op.readers)

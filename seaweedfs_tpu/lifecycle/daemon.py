@@ -197,10 +197,21 @@ class LifecycleDaemon:
               "expire": self._expire}[tr.kind]
         try:
             async with self.master._repair_sem:
-                with observe.span(f"lifecycle.{tr.kind}",
-                                  tags={"vid": tr.vid,
-                                        "reason": tr.reason}):
-                    await fn(tr)
+                # same numbered worker pool as the repair daemon
+                # (WEED_EC_ENCODE_WORKERS): a storm of warm transitions
+                # and a rebuild storm drain through one visible budget
+                worker = self.master._checkout_worker()
+                log.info("encode worker %d: lifecycle %s of volume %s "
+                         "(trace %s)", worker, tr.kind, tr.vid,
+                         observe.ensure_ctx("master").trace_id)
+                try:
+                    with observe.span(f"lifecycle.{tr.kind}",
+                                      tags={"vid": tr.vid,
+                                            "reason": tr.reason,
+                                            "worker": worker}):
+                        await fn(tr)
+                finally:
+                    self.master._checkin_worker(worker)
         except asyncio.CancelledError:
             raise
         except Exception as e:

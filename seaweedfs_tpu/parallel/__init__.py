@@ -1,0 +1,8 @@
+"""Multi-chip EC fabric: the shard_map kernel surface (sharded.py) and
+the production MeshCoder (mesh_coder.py). mesh_coder imports jax lazily;
+sharded.py imports it at module load — servers that never encode should
+import through mesh_coder only."""
+
+from .mesh_coder import MeshCoder, coder, mesh_device_count, mesh_status
+
+__all__ = ["MeshCoder", "coder", "mesh_device_count", "mesh_status"]

@@ -1,0 +1,367 @@
+"""MeshCoder — the production ErasureCoder over a jax.sharding.Mesh.
+
+`parallel/sharded.py` proved the kernel shape (MULTICHIP_r05: the encode
+HLO is collective-free, linear weak scaling over an 8-device mesh); this
+module is the production face: an `ErasureCoder` the streaming pipeline
+(ec/pipeline.py), the store's `ec_generate`/`ec_rebuild`, and the
+device-sink bench paths drive unchanged, with every [k, B] batch's B axis
+sharded over the mesh so ONE governed host feed saturates N chips.
+
+Sharding shape (the pipeline's batches are [k, B] — k shard rows of a
+B-byte stripe batch):
+
+- encode: columns are independent under RS (parity[:, j] depends only on
+  data[:, j]), so the batch axis shards as P(None, "batch") and each chip
+  runs the same GF kernel on its B/n column slice. No collectives — the
+  property the MULTICHIP dryruns verify — so aggregate throughput is
+  n * per-chip throughput on ICI-attached chips.
+- rebuild: survivor rows land row-sharded P("batch", None) (the natural
+  layout when shards stream in per-chip), are all_gather'd over ICI so
+  every chip holds all k survivor rows, and each chip reconstructs the
+  missing rows for its own column slice — the ICI analog of the
+  reference's parallel shard fetch (weed/storage/store_ec.go:322-376).
+
+Batch widths not divisible by the mesh size zero-pad to the next multiple
+(GF parity of zero columns is zero, so padding never changes real bytes;
+materialize slices the pad off). Output is byte-identical to the
+single-chip JaxCoder and to striping.write_ec_files at every geometry —
+tests/test_mesh_coder.py proves it at odd widths and RS(20,4).
+
+Staging is per-chip: `stage_async` splits a host batch into per-device
+column slices and device_puts each one separately (transfers overlap;
+the pipeline's stager pool calls this from several threads), emitting an
+`ec.stage.chip` span and per-chip byte/second counters into the shared
+"ec" metrics registry next to the governor's gauges.
+
+`WEED_EC_MESH_DEVICES` selects the mesh: unset/"0"/"1" means no mesh
+(production paths keep the proven single-chip JaxCoder), "all" takes
+every local device, N clamps to what the host has. A 1-device request
+degenerates to a plain JaxCoder — `coder()` never returns a MeshCoder
+wrapping one chip.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .. import observe
+from ..ec.coder import JaxCoder
+from ..ops import gf256, rs_jax
+from ..utils import metrics as metrics_mod
+from ..utils.jax_compat import shard_map_compat
+
+
+def mesh_device_count() -> int:
+    """Devices WEED_EC_MESH_DEVICES asks for: 0 = mesh disabled (the
+    default — virtual CPU test meshes must not silently reroute every
+    production encode), "all" = every local device, N clamps to the
+    host. Values <= 1 read as disabled: a 1-chip mesh IS the JaxCoder
+    path."""
+    raw = os.environ.get("WEED_EC_MESH_DEVICES", "").strip().lower()
+    if not raw or raw in ("0", "1", "no", "false"):
+        return 0
+    import jax
+    have = len(jax.devices())
+    if raw == "all":
+        return have if have > 1 else 0
+    try:
+        n = int(raw)
+    except ValueError:
+        return 0
+    n = min(n, have)
+    return n if n > 1 else 0
+
+
+def coder(data_shards: int, parity_shards: int,
+          n_devices: Optional[int] = None,
+          method: str = "bitplane"):
+    """The mesh-or-single factory: a MeshCoder over n_devices (default:
+    WEED_EC_MESH_DEVICES, then all local devices) when that resolves to
+    more than one chip, else the proven single-chip backend for
+    `method` (JaxCoder, or PallasCoder for method="pallas")."""
+    if n_devices is None:
+        if os.environ.get("WEED_EC_MESH_DEVICES", "").strip():
+            n_devices = mesh_device_count() or 1
+        else:
+            import jax
+            n_devices = len(jax.devices())
+    if n_devices <= 1:
+        if method == "pallas":
+            from ..ec.coder import PallasCoder
+            return PallasCoder(data_shards, parity_shards)
+        return JaxCoder(data_shards, parity_shards, method=method)
+    return MeshCoder(data_shards, parity_shards, n_devices=n_devices,
+                     method=method)
+
+
+class _MeshHandle:
+    """In-flight sharded result + the valid (pre-padding) width."""
+
+    __slots__ = ("arr", "width")
+
+    def __init__(self, arr, width: int):
+        self.arr = arr
+        self.width = width
+
+    def copy_to_host_async(self) -> None:
+        start = getattr(self.arr, "copy_to_host_async", None)
+        if start is not None:
+            start()
+
+
+class MeshCoder(JaxCoder):
+    """ErasureCoder over a jax.sharding.Mesh (axis "batch" = the stripe
+    batch's column axis). See the module docstring for the sharding
+    shape; everything the JaxCoder exposes (digest windows, staged
+    sinks, reconstruct) works here, mesh-sharded where it counts."""
+
+    def __init__(self, data_shards: int, parity_shards: int,
+                 n_devices: Optional[int] = None,
+                 method: str = "bitplane"):
+        if method not in ("bitplane", "lut", "pallas"):
+            raise ValueError(f"unknown mesh coder method {method!r}")
+        super().__init__(data_shards, parity_shards, method=method)
+        import jax
+        from jax.sharding import Mesh
+        devs = jax.devices()
+        n = n_devices or len(devs)
+        if n < 2:
+            raise ValueError("MeshCoder needs >= 2 devices; use JaxCoder "
+                             "(or parallel.mesh_coder.coder) for one chip")
+        if len(devs) < n:
+            raise ValueError(f"need {n} devices, have {len(devs)}")
+        self.mesh = Mesh(np.array(devs[:n]), ("batch",))
+        self.mesh_devices = n
+        self._devices = list(devs[:n])
+        self._enc_sharded = None
+        self._rec_sharded: dict = {}
+        self._lock = threading.Lock()
+        metrics_mod.shared("ec").gauge("feed_mesh_devices", n)
+
+    # --- staging: per-chip sub-batches ---
+
+    def _pad_cols(self, arr: np.ndarray) -> np.ndarray:
+        pad = (-arr.shape[-1]) % self.mesh_devices
+        if pad:
+            width = [(0, 0)] * (arr.ndim - 1) + [(0, pad)]
+            arr = np.pad(arr, width)
+        return arr
+
+    def _col_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh, P(None, "batch"))
+
+    def _stage_cols(self, arr: np.ndarray):
+        """device_put one per-chip column slice per device and assemble
+        the sharded array — transfers overlap (device_put is async), and
+        each chip's H2D is visible as its own ec.stage.chip span plus
+        feed_chip_staged_bytes / feed_chip_stage_seconds counters."""
+        import jax
+        n = self.mesh_devices
+        cols = arr.shape[1] // n
+        ctx = observe.ensure_ctx("ec")
+        reg = metrics_mod.shared("ec")
+        shards = []
+        for i, dev in enumerate(self._devices):
+            start_us = int(time.time() * 1e6)
+            t0 = time.perf_counter()
+            piece = np.ascontiguousarray(arr[:, i * cols:(i + 1) * cols])
+            shards.append(jax.device_put(piece, dev))
+            dur = time.perf_counter() - t0
+            observe.record_span("ec.stage.chip", ctx, start_us,
+                                int(dur * 1e6),
+                                tags={"chip": i, "bytes": piece.nbytes})
+            reg.count("feed_chip_staged_bytes", value=piece.nbytes,
+                      labels={"chip": str(i)})
+            reg.count("feed_chip_stage_seconds", value=round(dur, 6),
+                      labels={"chip": str(i)})
+        return jax.make_array_from_single_device_arrays(
+            arr.shape, self._col_sharding(), shards)
+
+    def stage_async(self, data: np.ndarray):
+        arr = self._pad_cols(np.asarray(data, dtype=np.uint8))
+        return self._stage_cols(arr)
+
+    # --- encode: shard_map over the batch axis, collective-free ---
+
+    def _apply_matrix_fn(self, matrix: np.ndarray):
+        """The per-chip GF kernel for this coder's method — pallas keeps
+        the hand-tiled TPU kernel inside the shard_map step (the demo's
+        _apply_fn shape), bitplane/lut ride the rs_jax formulations."""
+        if self.method == "pallas":
+            from ..ops import rs_pallas
+            return rs_pallas.gf_apply_pallas(matrix)
+        if self.method == "bitplane":
+            return rs_jax.gf_apply_bitplane(matrix)
+        return rs_jax.gf_apply_lut(matrix)
+
+    # inherited digest windows route through these two hooks, so the
+    # mesh's pallas/lut choice holds there too instead of silently
+    # remapping to another formulation
+    def _encode_fn(self):
+        if self.method == "pallas":
+            return self._apply_matrix_fn(
+                gf256.parity_matrix(self.k, self.m))
+        return super()._encode_fn()
+
+    def _rec_apply(self, present, missing):
+        if self.method == "pallas":
+            return self._apply_matrix_fn(gf256.reconstruction_matrix(
+                self.k, self.m, tuple(present), tuple(missing)))
+        return super()._rec_apply(present, missing)
+
+    def _enc_fn(self):
+        with self._lock:
+            if self._enc_sharded is None:
+                import jax
+                from jax.sharding import PartitionSpec as P
+                apply_fn = self._apply_matrix_fn(
+                    gf256.parity_matrix(self.k, self.m))
+                step = shard_map_compat(apply_fn, self.mesh,
+                                        P(None, "batch"),
+                                        P(None, "batch"))
+                self._enc_sharded = jax.jit(step)
+            return self._enc_sharded
+
+    def encode_async(self, data: np.ndarray):
+        width = int(data.shape[1])
+        arr = self._pad_cols(np.asarray(data, dtype=np.uint8))
+        return _MeshHandle(self._enc_fn()(self._stage_cols(arr)), width)
+
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        return self.materialize(self.encode_async(data))
+
+    def materialize(self, handle) -> np.ndarray:
+        if isinstance(handle, _MeshHandle):
+            out = np.asarray(handle.arr)
+            return out[..., :handle.width]
+        return super().materialize(handle)
+
+    def encode_hlo_text(self, width: Optional[int] = None) -> str:
+        """Compiled HLO of the sharded encode at `width` (default: one
+        tile per chip) — what the multichip bench and tests inspect for
+        the collective-free property."""
+        import jax
+        import jax.numpy as jnp
+        w = width or 1024 * self.mesh_devices
+        sds = jax.ShapeDtypeStruct((self.k, w), jnp.uint8)
+        return self._enc_fn().lower(sds).compile().as_text()
+
+    def encode_is_collective_free(self,
+                                  width: Optional[int] = None) -> bool:
+        text = self.encode_hlo_text(width).lower()
+        return not any(tok in text for tok in
+                       ("all-reduce", "all-gather", "collective-permute",
+                        "all-to-all"))
+
+    # --- rebuild: row-sharded survivors, all_gather over ICI ---
+
+    def _rec_fn(self, present: tuple, missing: tuple):
+        key = (present, missing)
+        with self._lock:
+            fn = self._rec_sharded.get(key)
+            if fn is None:
+                import jax
+                import jax.numpy as jnp
+                from jax.sharding import PartitionSpec as P
+                rec = gf256.reconstruction_matrix(self.k, self.m, present,
+                                                  missing)
+                apply_fn = self._apply_matrix_fn(rec)
+                n_dev = self.mesh_devices
+                k = self.k
+
+                def step(survivors):  # [k_pad/n, B] rows on each chip
+                    full = jax.lax.all_gather(survivors, "batch", axis=0,
+                                              tiled=True)[:k]
+                    cols = full.shape[1] // n_dev
+                    idx = jax.lax.axis_index("batch")
+                    local = jax.lax.dynamic_slice(
+                        full, (0, idx * cols), (k, cols))
+                    return apply_fn(local)
+
+                fn = jax.jit(shard_map_compat(
+                    step, self.mesh, P("batch", None), P(None, "batch")))
+                self._rec_sharded[key] = fn
+            return fn
+
+    def _stage_rows(self, arr: np.ndarray):
+        """Row-shard [k_pad, B] survivors over the mesh (pad rows to a
+        mesh multiple; the all_gather drops the pad)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        n = self.mesh_devices
+        pad = (-arr.shape[0]) % n
+        if pad:
+            arr = np.pad(arr, ((0, pad), (0, 0)))
+        rows = arr.shape[0] // n
+        shards = [jax.device_put(
+            np.ascontiguousarray(arr[i * rows:(i + 1) * rows]), dev)
+            for i, dev in enumerate(self._devices)]
+        return jax.make_array_from_single_device_arrays(
+            arr.shape, NamedSharding(self.mesh, P("batch", None)), shards)
+
+    def rec_apply_async(self, present, missing):
+        present, missing = tuple(present), tuple(missing)
+        fn = self._rec_fn(present, missing)
+
+        def run(survivors: np.ndarray):
+            width = int(survivors.shape[1])
+            arr = self._pad_cols(np.asarray(survivors, dtype=np.uint8))
+            return _MeshHandle(fn(self._stage_rows(arr)), width)
+
+        return run
+
+    # --- window sinks ---
+    # The inherited JaxCoder window executables work unchanged: staged
+    # batches arrive column-sharded from stage_async and GSPMD partitions
+    # the dynamic-matrix digest program along the batch axis (the final
+    # [m] digest sum is the only cross-chip reduction, 4*m bytes). AOT
+    # warming is a tunneled-link optimization whose unsharded abstract
+    # shapes would compile a single-device program the sharded call
+    # could not reuse — on a mesh the compile happens at first dispatch.
+
+    def warm_encode_digest_window(self, n_batches: int,
+                                  shape: tuple) -> None:
+        return None
+
+    def warm_rec_digest_window(self, present, missing, n_batches: int,
+                               shape: tuple) -> None:
+        return None
+
+
+def mesh_status() -> dict:
+    """Per-process mesh/EC-feed status for /admin/ec/mesh_status and the
+    ec.mesh.status shell command: the configured mesh, the devices jax
+    actually sees (enumerated only when the operator opted into a mesh
+    or one is already live — a status probe on a mesh-less server must
+    not pay jax backend init), and the per-chip staging + governor
+    state from the shared "ec" registry."""
+    reg = metrics_mod.shared("ec")
+    feed = reg.snapshot(prefix="feed_")
+    chips: dict[str, dict] = {}
+    for key, value in sorted(feed.items()):
+        if key.startswith("feed_chip_") and '{chip="' in key:
+            name, _, rest = key.partition("{")
+            chip = rest.split('"')[1]
+            field = name[len("feed_chip_"):]
+            chips.setdefault(chip, {})[field] = value
+    out = {
+        "requested": os.environ.get("WEED_EC_MESH_DEVICES", ""),
+        "mesh_devices": int(feed.get("feed_mesh_devices", 0) or 0),
+        "chips": chips,
+        "feed": {k: v for k, v in feed.items()
+                 if not k.startswith("feed_chip_")},
+    }
+    if out["mesh_devices"] > 0 or out["requested"].strip():
+        import jax
+        out["devices"] = [{"id": d.id, "platform": d.platform}
+                          for d in jax.devices()]
+        out["backend"] = jax.default_backend()
+    else:
+        out["devices"] = None  # no mesh configured: skip backend init
+    return out

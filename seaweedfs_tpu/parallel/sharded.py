@@ -1,4 +1,4 @@
-"""Multi-chip EC engine: pjit/shard_map over a device mesh.
+"""Multi-chip EC kernels: pjit/shard_map over a device mesh.
 
 The reference scales encode/rebuild by spreading work across volume servers
 over gRPC (weed/shell/command_ec_encode.go:160-263, parallel shard fetch in
@@ -16,6 +16,10 @@ jax.sharding.Mesh:
   parallel goroutine fetch from 10 peer nodes.
 
 Everything is jit-compiled once per (geometry, mesh) and uses static shapes.
+This module is the [B, k, n]-batched kernel surface (and the shape the
+MULTICHIP dryruns measure); the production EC plane drives the same
+shard_map machinery through parallel/mesh_coder.py's MeshCoder, which
+implements the ErasureCoder interface over the pipeline's [k, B] batches.
 """
 
 from __future__ import annotations
@@ -28,24 +32,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import gf256, rs_jax, rs_pallas
-
-
-def _shard_map(step, mesh, in_specs, out_specs):
-    """jax.shard_map across jax versions: 0.4.x carries it only under
-    jax.experimental with the check_rep spelling; the top-level API
-    first kept check_rep, then renamed it to check_vma. Replication
-    checks are off either way — pallas_call outputs carry no vma/rep
-    metadata."""
-    if hasattr(jax, "shard_map"):
-        try:
-            return jax.shard_map(step, mesh=mesh, in_specs=in_specs,
-                                 out_specs=out_specs, check_vma=False)
-        except TypeError:  # top-level but pre-rename: check_rep era
-            return jax.shard_map(step, mesh=mesh, in_specs=in_specs,
-                                 out_specs=out_specs, check_rep=False)
-    from jax.experimental.shard_map import shard_map as sm
-    return sm(step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-              check_rep=False)
+from ..utils.jax_compat import shard_map_compat
 
 
 def make_mesh(n_devices: int | None = None,
@@ -63,9 +50,14 @@ def _apply_fn(matrix: np.ndarray, use_pallas: bool):
     return rs_jax.gf_apply_bitplane(matrix)
 
 
+# The compiled-fn caches key on the Mesh itself (hashable by device ids +
+# axis names), so the lru_cache IS the registry: bounded at maxsize
+# entries, evicted LRU, nothing module-global pinning every mesh ever
+# built. (The previous _MESHES dict grew monotonically and kept evicted
+# entries' meshes alive forever.)
+
 @functools.lru_cache(maxsize=32)
-def _sharded_encode_fn(k: int, m: int, mesh_key, use_pallas: bool):
-    mesh = _MESHES[mesh_key]
+def _sharded_encode_fn(k: int, m: int, mesh: Mesh, use_pallas: bool):
     pm = gf256.parity_matrix(k, m)
     apply_fn = _apply_fn(pm, use_pallas)
 
@@ -76,18 +68,9 @@ def _sharded_encode_fn(k: int, m: int, mesh_key, use_pallas: bool):
         parity = apply_fn(flat)
         return jnp.transpose(parity.reshape(-1, b, n), (1, 0, 2))
 
-    shard_step = _shard_map(step, mesh, P("batch", None, None),
-                            P("batch", None, None))
+    shard_step = shard_map_compat(step, mesh, P("batch", None, None),
+                                  P("batch", None, None))
     return jax.jit(shard_step)
-
-
-_MESHES: dict = {}
-
-
-def _mesh_key(mesh: Mesh):
-    key = (tuple(d.id for d in mesh.devices.flat), mesh.axis_names)
-    _MESHES[key] = mesh
-    return key
 
 
 def sharded_encode(mesh: Mesh, data, parity_shards: int = 4,
@@ -101,7 +84,7 @@ def sharded_encode(mesh: Mesh, data, parity_shards: int = 4,
         use_pallas = jax.default_backend() == "tpu"
     b, k, n = data.shape
     assert b % mesh.devices.size == 0, (b, mesh.devices.size)
-    fn = _sharded_encode_fn(k, parity_shards, _mesh_key(mesh), use_pallas)
+    fn = _sharded_encode_fn(k, parity_shards, mesh, use_pallas)
     spec = NamedSharding(mesh, P("batch", None, None))
     data = jax.device_put(data, spec)
     return fn(data)
@@ -109,10 +92,9 @@ def sharded_encode(mesh: Mesh, data, parity_shards: int = 4,
 
 @functools.lru_cache(maxsize=32)
 def _sharded_rebuild_fn(k: int, m: int, present: tuple[int, ...],
-                        missing: tuple[int, ...], mesh_key,
+                        missing: tuple[int, ...], mesh: Mesh,
                         use_pallas: bool):
     """Survivor shards sharded over chips; all_gather + GF matmul rebuild."""
-    mesh = _MESHES[mesh_key]
     rec = gf256.reconstruction_matrix(k, m, present, missing)
     apply_fn = _apply_fn(rec, use_pallas)
     n_dev = mesh.devices.size
@@ -128,8 +110,8 @@ def _sharded_rebuild_fn(k: int, m: int, present: tuple[int, ...],
         local = jax.lax.dynamic_slice(full, (0, idx * cols), (k, cols))
         return apply_fn(local)
 
-    shard_step = _shard_map(step, mesh, P("batch", None),
-                            P(None, "batch"))
+    shard_step = shard_map_compat(step, mesh, P("batch", None),
+                                  P(None, "batch"))
     return jax.jit(shard_step)
 
 
@@ -156,8 +138,7 @@ def sharded_rebuild(mesh: Mesh, shards: list, k: int, m: int,
     pad_cols = (-n) % n_dev  # each chip rebuilds an equal column slice
     if pad_rows or pad_cols:
         survivors = np.pad(survivors, ((0, pad_rows), (0, pad_cols)))
-    fn = _sharded_rebuild_fn(k, m, basis, missing, _mesh_key(mesh),
-                             use_pallas)
+    fn = _sharded_rebuild_fn(k, m, basis, missing, mesh, use_pallas)
     spec = NamedSharding(mesh, P("batch", None))
     out = fn(jax.device_put(jnp.asarray(survivors), spec))
     result = list(shards)

@@ -74,7 +74,7 @@ class MasterServer:
                  tls=None,
                  sequencer=None,
                  maintenance_interval_seconds: Optional[float] = None,
-                 repair_concurrency: int = 2,
+                 repair_concurrency: Optional[int] = None,
                  ec_total_shards: int = 14,
                  ec_geometry_policy: Optional[GeometryPolicy] = None,
                  lifecycle_config: Optional[LifecycleConfig] = None,
@@ -110,7 +110,22 @@ class MasterServer:
             maintenance_interval_seconds
             if maintenance_interval_seconds is not None
             else max(pulse_seconds, 0.05))
-        self.repair_concurrency = repair_concurrency
+        # WEED_EC_ENCODE_WORKERS sizes the encode/rebuild worker pool:
+        # the semaphore below bounds how many repair-daemon rebuilds AND
+        # lifecycle encode-batcher transitions run at once, so a
+        # rack-loss rebuild storm (or a burst of warm transitions) drains
+        # across N volume servers in parallel instead of serially. The
+        # env is a DEFAULT, not an override: an explicit
+        # repair_concurrency argument (cli -repair_concurrency, tests,
+        # the bench's serial baseline) always wins over ambient env.
+        if repair_concurrency is None:
+            try:
+                env_workers = int(
+                    os.environ.get("WEED_EC_ENCODE_WORKERS", ""))
+            except ValueError:
+                env_workers = 0
+            repair_concurrency = env_workers if env_workers > 0 else 2
+        self.repair_concurrency = max(1, repair_concurrency)
         self.ec_total_shards = ec_total_shards
         # per-collection RS(k,m) policy, MASTER-VALIDATED: parsing
         # WEED_EC_GEOMETRY happens here at construction, so a bad spec
@@ -124,7 +139,13 @@ class MasterServer:
         self.repair_enabled = True
         self._maint_task: Optional[asyncio.Task] = None
         self._maint_session: Optional[aiohttp.ClientSession] = None
-        self._repair_sem = asyncio.Semaphore(max(1, repair_concurrency))
+        self._repair_sem = asyncio.Semaphore(self.repair_concurrency)
+        # worker-id free list: repairs and lifecycle transitions check a
+        # numbered worker slot out while they hold the semaphore, purely
+        # for observability — per-worker assignment logs + the
+        # repair_workers_busy gauge make a rebuild storm's parallelism
+        # visible instead of folklore (event-loop-only access, no lock)
+        self._repair_worker_free = list(range(self.repair_concurrency))
         self._repairs_inflight: set = set()     # (kind, vid) keys
         self._repair_tasks: set = set()         # live asyncio.Tasks
         # per-volume failure backoff: key -> (failures, next_attempt_mono)
@@ -994,11 +1015,20 @@ class MasterServer:
         overload.set_priority(overload.CLASS_BG)
         try:
             async with self._repair_sem:
-                self.metrics.count("repairs_started",
-                                   labels={"kind": kind})
-                with observe.span(f"master.repair.{kind}",
-                                  tags={"vid": vid}):
-                    ok = await fn(*args)
+                worker = self._checkout_worker()
+                tctx = observe.ensure_ctx("master")
+                log.info("repair worker %d: %s repair of volume %s "
+                         "dispatched (trace %s)", worker, kind, vid,
+                         tctx.trace_id)
+                try:
+                    self.metrics.count("repairs_started",
+                                       labels={"kind": kind})
+                    with observe.span(f"master.repair.{kind}",
+                                      tags={"vid": vid,
+                                            "worker": worker}):
+                        ok = await fn(*args)
+                finally:
+                    self._checkin_worker(worker)
             if not ok:
                 raise RuntimeError(f"{kind} repair of {vid} incomplete")
         except asyncio.CancelledError:
@@ -1019,6 +1049,26 @@ class MasterServer:
             log.info("%s repair of volume %d succeeded", kind, vid)
         finally:
             self._repairs_inflight.discard(key)
+
+    def _checkout_worker(self) -> int:
+        """Claim a numbered encode-worker slot (caller already holds
+        _repair_sem, so the free list can only be empty if a caller
+        bypassed the semaphore — tolerate it as slot -1 rather than
+        wedge a repair on bookkeeping). Event-loop-only access."""
+        worker = (self._repair_worker_free.pop()
+                  if self._repair_worker_free else -1)
+        self.metrics.gauge("repair_workers", self.repair_concurrency)
+        self.metrics.gauge(
+            "repair_workers_busy",
+            self.repair_concurrency - len(self._repair_worker_free))
+        return worker
+
+    def _checkin_worker(self, worker: int) -> None:
+        if worker >= 0:
+            self._repair_worker_free.append(worker)
+        self.metrics.gauge(
+            "repair_workers_busy",
+            self.repair_concurrency - len(self._repair_worker_free))
 
     def _maint_http(self) -> aiohttp.ClientSession:
         if self._maint_session is None or self._maint_session.closed:

@@ -312,6 +312,8 @@ class VolumeServer:
         app.router.add_post("/admin/ec/to_volume", self.admin_ec_to_volume)
         app.router.add_get("/admin/ec/shard_read", self.admin_ec_shard_read)
         app.router.add_post("/admin/ec/scrub", self.admin_ec_scrub)
+        app.router.add_get("/admin/ec/mesh_status",
+                           self.admin_ec_mesh_status)
         _faults_handler = faults.admin_handler()
         app.router.add_get("/admin/faults", _faults_handler)
         app.router.add_post("/admin/faults", _faults_handler)
@@ -1574,6 +1576,17 @@ class VolumeServer:
                 await r.read()
         except Exception as e:
             log.warning("scrub report for volume %d failed: %s", vid, e)
+
+    async def admin_ec_mesh_status(self,
+                                   request: web.Request) -> web.Response:
+        """This process's device-mesh view: configured WEED_EC_MESH_
+        DEVICES, live devices, and the per-chip staging counters +
+        governor gauges from the shared "ec" registry (the JSON twin of
+        what /metrics exposes, for the ec.mesh.status shell command)."""
+        from ..parallel.mesh_coder import mesh_status
+        return web.json_response(
+            await asyncio.get_event_loop().run_in_executor(
+                None, mesh_status))
 
     async def admin_ec_scrub(self, request: web.Request) -> web.Response:
         """Run one scrub pass now (operators / chaos tests)."""

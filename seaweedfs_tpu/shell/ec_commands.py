@@ -166,12 +166,18 @@ class EcCommands:
         return self.encode_many([vid], collection, apply=apply)
 
     def encode_many(self, vids: list[int], collection: str = "",
-                    apply: bool = True) -> dict:
+                    apply: bool = True, parallel: int = 1) -> dict:
         """ec.encode a WINDOW of volumes: every volume sharing a source
         is generated in ONE multi-volume `ec/generate` call, so the
         volume server streams the batch through a single governed
         executable back-to-back (the encode-queue regime) — then each
-        volume spreads/mounts/retires individually."""
+        volume spreads/mounts/retires individually.
+
+        `parallel` > 1 drives up to that many SOURCES concurrently
+        (each source's generate -> spread -> retire chain stays
+        strictly ordered; per-source windows already batch, so the only
+        safe parallel axis is across servers — the same axis the
+        master's WEED_EC_ENCODE_WORKERS pool fans rebuilds over)."""
         status = self.client.dir_status()
         g = self.geometry_for(collection, status=status)
         locations = {vid: self.client.lookup(vid) for vid in vids}
@@ -194,40 +200,60 @@ class EcCommands:
                 self.client.volume_admin(url, "volume/readonly",
                                          {"volume_id": vid,
                                           "read_only": True})
-        for source, svids in sources.items():
+
+        def run_source(source: str, svids: list[int]) -> None:
             self.client.volume_admin(
                 source, "ec/generate",
                 {"volume_id": svids[0]} if len(svids) == 1
                 else {"volume_ids": svids})
-        for vid in vids:
-            source = locations[vid][0]
-            plan = plans[vid]
-            for target, sids in plan.items():
-                if target != source:
+            for vid in svids:
+                plan = plans[vid]
+                for target, sids in plan.items():
+                    if target != source:
+                        self.client.volume_admin(
+                            target, "ec/copy",
+                            {"volume_id": vid, "collection": collection,
+                             "shard_ids": sids, "source": source,
+                             "copy_ecx_file": True})
                     self.client.volume_admin(
-                        target, "ec/copy",
+                        target, "ec/mount",
                         {"volume_id": vid, "collection": collection,
-                         "shard_ids": sids, "source": source,
-                         "copy_ecx_file": True})
-                self.client.volume_admin(
-                    target, "ec/mount",
-                    {"volume_id": vid, "collection": collection,
-                     "shard_ids": sids})
-            # delete the original everywhere + surplus shards at source
-            for url in locations[vid]:
-                self.client.volume_admin(url, "volume/delete",
-                                         {"volume_id": vid})
-            surplus = [s for s in range(g.total_shards)
-                       if s not in plan.get(source, [])]
-            if surplus:
-                self.client.volume_admin(
-                    source, "ec/delete_shards",
-                    {"volume_id": vid, "collection": collection,
-                     "shard_ids": surplus})
+                         "shard_ids": sids})
+                # delete the original everywhere + surplus at source
+                for url in locations[vid]:
+                    self.client.volume_admin(url, "volume/delete",
+                                             {"volume_id": vid})
+                surplus = [s for s in range(g.total_shards)
+                           if s not in plan.get(source, [])]
+                if surplus:
+                    self.client.volume_admin(
+                        source, "ec/delete_shards",
+                        {"volume_id": vid, "collection": collection,
+                         "shard_ids": surplus})
+
+        workers = max(1, min(int(parallel or 1), len(sources)))
+        if workers > 1:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(
+                    max_workers=workers,
+                    thread_name_prefix="ec-encode") as ex:
+                futures = [ex.submit(run_source, s, sv)
+                           for s, sv in sources.items()]
+                # surface the FIRST failure after every source settles:
+                # cancelling mid-flight sources would strand sealed
+                # volumes shards-less with no record of which
+                errors = [f.exception() for f in futures]
+            first = next((e for e in errors if e is not None), None)
+            if first is not None:
+                raise first
+        else:
+            for source, svids in sources.items():
+                run_source(source, svids)
         if len(vids) == 1:
             return {"source": locations[vids[0]][0],
                     "plan": plans[vids[0]]}
-        return {"sources": sources, "plans": plans}
+        return {"sources": sources, "plans": plans,
+                "parallel": workers}
 
     def rebuild(self, vid: int, collection: str = "",
                 apply: bool = True) -> dict:

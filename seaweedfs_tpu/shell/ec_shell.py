@@ -13,20 +13,23 @@ def _ec(env: CommandEnv) -> EcCommands:
 
 @command("ec.encode",
          "erasure-code volumes (ec.encode -volumeId N[,N2,...] "
-         "[-collection c] [-dryRun]) — a comma list encodes the whole "
-         "window back-to-back through one governed executable",
+         "[-collection c] [-parallel N] [-dryRun]) — a comma list "
+         "encodes the whole window back-to-back through one governed "
+         "executable; -parallel drives up to N source servers at once",
          destructive=True)
 def ec_encode(env: CommandEnv, argv: list[str]):
     p = parser("ec.encode")
     p.add_argument("-volumeId", required=True)
     p.add_argument("-collection", default="")
+    p.add_argument("-parallel", type=int, default=1)
     p.add_argument("-dryRun", action="store_true")
     args = p.parse_args(argv)
     vids = [int(v) for v in str(args.volumeId).split(",") if v]
     ec = _ec(env)
     if len(vids) == 1:
         return ec.encode(vids[0], args.collection, apply=not args.dryRun)
-    return ec.encode_many(vids, args.collection, apply=not args.dryRun)
+    return ec.encode_many(vids, args.collection, apply=not args.dryRun,
+                          parallel=args.parallel)
 
 
 @command("ec.rebuild",
@@ -51,6 +54,32 @@ def ec_balance(env: CommandEnv, argv: list[str]):
     p.add_argument("-dryRun", action="store_true")
     args = p.parse_args(argv)
     return _ec(env).balance(args.collection, apply=not args.dryRun)
+
+
+@command("ec.mesh.status",
+         "per-node device-mesh + EC-feed state: mesh size, per-chip "
+         "staged bytes/seconds, governor operating point "
+         "(ec.mesh.status [-node url])")
+def ec_mesh_status(env: CommandEnv, argv: list[str]):
+    from ..client import _get_json
+    p = parser("ec.mesh.status")
+    p.add_argument("-node", default="")
+    args = p.parse_args(argv)
+    urls = ([args.node] if args.node else
+            [nd["url"] for nd in
+             env.client.dir_status().get("nodes", [])])
+    out: dict = {"nodes": {}}
+    for url in urls:
+        try:
+            out["nodes"][url] = _get_json(
+                f"http://{url}/admin/ec/mesh_status")
+        except Exception as e:
+            # a down node is exactly when an operator runs this: record
+            # it and keep surveying the rest of the fleet (the pool
+            # raises raw OSError for refused connections, not
+            # ClientError)
+            out["nodes"][url] = {"error": f"{type(e).__name__}: {e}"}
+    return out
 
 
 @command("ec.decode",

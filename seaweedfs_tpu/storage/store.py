@@ -174,9 +174,49 @@ class Store:
         key = (g.data_shards, g.parity_shards)
         c = self._coders.get(key)
         if c is None:
-            c = self._coders[key] = ec_mod.get_coder(
+            c = ec_mod.get_coder(
                 self.coder_name, g.data_shards, g.parity_shards)
+            c = self._coders[key] = self._maybe_mesh(c, g)
         return c
+
+    def _maybe_mesh(self, c: ErasureCoder,
+                    g: ec_mod.Geometry) -> ErasureCoder:
+        """WEED_EC_MESH_DEVICES >= 2 lifts auto-selected device coders
+        onto the jax.sharding mesh (parallel/mesh_coder.py), so every
+        production encode/rebuild on this store shards its batch axis
+        across the chips — an auto-picked PallasCoder keeps its
+        hand-tiled kernel inside the shard_map step. Explicit backend
+        names (numpy/cpp/pallas — byte-exact references, kernel pins)
+        stay exactly what was asked for; "mesh" resolved through the
+        registry already."""
+        if self.coder_name not in ("auto", "jax", "jax_lut"):
+            return c
+        try:
+            from ..parallel import mesh_coder as mesh_mod
+            n = mesh_mod.mesh_device_count()
+            if n < 2:
+                return c
+            from ..ec.coder import PallasCoder
+            if isinstance(c, PallasCoder):
+                method = "pallas"
+            elif isinstance(c, ec_mod.JaxCoder):
+                method = c.method
+            else:
+                method = "bitplane"
+            return mesh_mod.MeshCoder(g.data_shards, g.parity_shards,
+                                      n_devices=n, method=method)
+        except Exception as e:
+            # a mesh that fails to build must never take encode offline
+            # (the single-chip coder is always a correct fallback) — but
+            # it must fail LOUDLY: the operator asked for a mesh, and a
+            # silent fallback would leave them believing N chips are
+            # encoding while one does
+            from ..utils import glog
+            glog.error("WEED_EC_MESH_DEVICES set but mesh coder "
+                       "construction failed (%s: %s) — falling back to "
+                       "the single-chip %s coder",
+                       type(e).__name__, e, type(c).__name__)
+            return c
 
     def geometry_for(self, collection: str = "") -> ec_mod.Geometry:
         """The policy geometry NEW encodes of this collection use."""

@@ -1,0 +1,29 @@
+"""Version-compat shims for the jax APIs the EC plane leans on.
+
+One home for the cross-version glue so production modules
+(parallel/mesh_coder.py, the sharded kernel demo) never reach into each
+other's internals for it. Everything here imports jax lazily-at-call —
+importing this module costs nothing in processes that never touch a
+device.
+"""
+
+from __future__ import annotations
+
+
+def shard_map_compat(step, mesh, in_specs, out_specs):
+    """jax.shard_map across jax versions: 0.4.x carries it only under
+    jax.experimental with the check_rep spelling; the top-level API
+    first kept check_rep, then renamed it to check_vma. Replication
+    checks are off either way — pallas_call outputs carry no vma/rep
+    metadata."""
+    import jax
+    if hasattr(jax, "shard_map"):
+        try:
+            return jax.shard_map(step, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False)
+        except TypeError:  # top-level but pre-rename: check_rep era
+            return jax.shard_map(step, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=False)
+    from jax.experimental.shard_map import shard_map as sm
+    return sm(step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              check_rep=False)

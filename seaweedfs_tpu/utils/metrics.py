@@ -130,6 +130,21 @@ class Registry:
                 return self._counters[key]
             return self._gauges.get(key, default)
 
+    def snapshot(self, prefix: str = "") -> dict[str, float]:
+        """Current counters + gauges as a flat {rendered_key: value}
+        dict, optionally filtered by family-name prefix — the JSON face
+        of the registry for admin status endpoints (ec.mesh.status)
+        that must not re-parse exposition text."""
+        with self._lock:
+            out: dict[str, float] = {}
+            for key, v in self._counters.items():
+                if key.startswith(prefix):
+                    out[key] = v
+            for key, v in self._gauges.items():
+                if key.startswith(prefix):
+                    out[key] = v
+            return out
+
     @staticmethod
     def _split(key: str) -> tuple[str, str]:
         """'read{a="b"}' -> ('read', '{a="b"}')."""

@@ -165,3 +165,49 @@ def test_governed_stream_encode_records_a_run(tmp_path):
     pipeline.stream_encode(base, coder, geo)  # governed defaults
     assert gov.runs == 1
     assert gov.metrics.value("feed_runs") == 1
+
+
+# ------------------------------------------------------ chips dimension
+
+def test_plan_chips_scales_batch_floor(monkeypatch):
+    """A mesh run's batch floors at chips * batch_min: below that each
+    chip's column slice is narrower than the single-chip minimum."""
+    monkeypatch.setenv("WEED_EC_BATCH_BYTES", str(1 * MB))
+    monkeypatch.setenv("WEED_EC_BATCH_MIN", str(1 * MB))
+    gov = governor.FeedGovernor()
+    assert gov.plan(1 << 30, 10, chips=1).batch_size == 1 * MB
+    op = gov.plan(1 << 30, 10, chips=8)
+    assert op.chips == 8
+    assert op.batch_size >= 8 * MB
+
+
+def test_kernel_bound_mesh_widens_batch_before_depth(monkeypatch):
+    """chips > 1 and kernel-bound: the batch scales WITH the mesh (full
+    per-chip slices) before any queue deepens."""
+    monkeypatch.setenv("WEED_EC_HOST_BUDGET_MB", "4096")
+    gov = governor.FeedGovernor()
+    ctx = observe.TraceCtx(observe.new_id(), "", "ec", "")
+    for name, secs in (("ec.read", 0.1), ("ec.dispatch", 0.1),
+                       ("ec.kernel", 5.0), ("ec.write", 0.1)):
+        for _ in range(8):
+            observe.record_span(name, ctx, 0, int(secs / 8 * 1e6))
+    op = gov.plan(100 * MB, 10, chips=4)
+    start_batch, start_depth = op.batch_size, op.depth
+    gov.finish_run(ctx.trace_id, op, 100 * MB, 10)
+    after = gov.plan(1 << 30, 10, chips=4)
+    assert after.batch_size == min(start_batch * 2, gov.batch_max)
+    assert after.depth == start_depth
+
+
+def test_chips_exported_to_metrics():
+    gov = governor.FeedGovernor()
+    gov.plan(1 << 30, 10, chips=4)
+    text = metrics_mod.render_shared()
+    assert "seaweedfs_tpu_ec_feed_mesh_devices 4" in text
+
+
+def test_single_chip_plan_unchanged_by_chips_default():
+    """chips defaults to 1 — the pre-mesh operating point is untouched
+    (the proven single-chip path stays byte-for-byte the same plan)."""
+    gov = governor.FeedGovernor()
+    assert gov.plan(1 << 30, 10) == gov.plan(1 << 30, 10, chips=1)
