@@ -2272,6 +2272,264 @@ def _multichip_storm(volumes: int = 6, rpc_s: float = 0.2) -> dict:
     }
 
 
+_RING_BENCH_REPLICAS = 1
+
+
+def _meta_noop() -> None:
+    """Pool warm-up target (spawn + interpreter start happen here, not
+    inside a timed row)."""
+
+
+def _meta_driver_shard(pkg_root: str, peers: list, ring_dict,
+                       op: str, n_dirs: int, indices: list,
+                       threads: int, n_create: int) -> int:
+    """One load-generator shard (its own PROCESS: a single GIL-bound
+    driver saturates below three filer loops' capacity, so the client
+    must scale out too).  Returns the shard's error count."""
+    import http.client
+    import threading as _threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    sys.path.insert(0, pkg_root)
+    ring = None
+    if ring_dict is not None:
+        from seaweedfs_tpu.metaring import DirectoryRing
+        ring = DirectoryRing.from_dict(ring_dict)
+    conns: dict = {}
+
+    def conn_for(peer: str):
+        key = (_threading.get_ident(), peer)
+        c = conns.get(key)
+        if c is None:
+            host, _, port = peer.rpartition(":")
+            c = http.client.HTTPConnection(host, int(port), timeout=20)
+            conns[key] = c
+        return c
+
+    def req(peer: str, method: str, path: str, body=None) -> int:
+        headers = {"Content-Type": "application/json"} if body else {}
+        for _ in range(2):
+            c = conn_for(peer)
+            try:
+                c.request(method, path, body=body, headers=headers)
+                r = c.getresponse()
+                r.read()
+                return r.status
+            except (http.client.HTTPException, OSError):
+                c.close()
+                conns.pop((_threading.get_ident(), peer), None)
+        return 599
+
+    def route(i: int) -> tuple:
+        d = f"/bench/d{i % n_dirs}"
+        if ring is None:
+            return peers[0], d
+        return ring.owner(d) or peers[0], d
+
+    errors = [0]
+
+    def one(i: int) -> None:
+        peer, d = route(i)
+        if op == "create":
+            entry = {"path": f"{d}/f{i}.txt",
+                     "attr": {"mtime": 1.0, "crtime": 1.0, "mode": 432,
+                              "uid": 0, "gid": 0, "mime": "",
+                              "ttl_sec": 0, "user_name": "",
+                              "group_names": [], "symlink_target": "",
+                              "md5": "", "replication": "",
+                              "collection": ""},
+                     "chunks": [], "extended": {}, "hard_link_id": ""}
+            if req(peer, "POST", "/__meta__/create_entry",
+                   json.dumps({"entry": entry}).encode()) != 200:
+                errors[0] += 1
+        elif op == "lookup":
+            # probe entries the create section actually placed: file
+            # j lives in d{j % n_dirs}, so the directory must derive
+            # from the FILE index or most probes are negative lookups
+            j = i % n_create
+            dj = f"/bench/d{j % n_dirs}"
+            pj = ring.owner(dj) if ring is not None else peers[0]
+            if req(pj or peers[0], "GET",
+                   f"/__meta__/lookup?path={dj}/f{j}.txt") != 200:
+                errors[0] += 1
+        else:
+            if req(peer, "GET",
+                   f"/__meta__/list?dir={d}&limit=128") != 200:
+                errors[0] += 1
+
+    with ThreadPoolExecutor(max_workers=threads) as pool:
+        list(pool.map(one, indices))
+    for c in conns.values():
+        c.close()
+    return errors[0]
+
+
+def phase_metadata(work: str, budget_s: float = 240.0) -> dict:
+    """Namespace-op throughput (metaring plane): create/lookup/list
+    req/s against the filer meta API, one filer vs a 3-peer
+    consistent-hash ring (each peer its own subprocess).  The driver is
+    ring-aware — it fetches /dir/ring from the master and routes every
+    op to the parent directory's owner, the smart-client shape
+    production gateways use — so the 3-peer row measures partition
+    scaling, not proxy-hop overhead.  Acceptance: 3-peer aggregate
+    >= 1.8x single-peer."""
+    global _RING_BENCH_REPLICAS
+    import multiprocessing as mp
+    import urllib.request
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from seaweedfs_tpu.metaring import DirectoryRing
+
+    pkg_root = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", SEAWEEDFS_FORCE_CPU="1")
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    deadline = time.time() + budget_s
+
+    # read-heavy mix (Haystack-shaped metadata traffic: reads dominate
+    # writes by a wide margin); the load generator is 4 PROCESSES x 8
+    # threads — one GIL-bound driver saturates below what three filer
+    # loops serve
+    N_CREATE, N_LOOKUP, N_LIST = 2000, 10000, 3000
+    N_DIRS, PROCS, THREADS = 192, 6, 8
+
+    def _wait_http(url: str, timeout: float = 30.0) -> None:
+        end = time.time() + timeout
+        while time.time() < end:
+            try:
+                with urllib.request.urlopen(url, timeout=2) as r:
+                    r.read()
+                    return
+            except Exception:
+                time.sleep(0.2)
+        raise RuntimeError(f"server at {url} failed to start")
+
+    def _drive(peers: list, ring: "DirectoryRing | None",
+               pool) -> dict:
+        ring_dict = ring.to_dict() if ring is not None else None
+        out: dict = {}
+        total_ops = 0
+        total_s = 0.0
+        for name, n in (("create", N_CREATE), ("lookup", N_LOOKUP),
+                        ("list", N_LIST)):
+            shards = [list(range(k, n, PROCS)) for k in range(PROCS)]
+            t0 = time.perf_counter()
+            errs = pool.starmap(_meta_driver_shard, [
+                (pkg_root, peers, ring_dict, name, N_DIRS, shard,
+                 THREADS, N_CREATE) for shard in shards])
+            dt = time.perf_counter() - t0
+            out[f"{name}_req_s"] = round(n / dt, 1)
+            out["errors"] = out.get("errors", 0) + sum(errs)
+            total_ops += n
+            total_s += dt
+        out["namespace_ops_s"] = round(total_ops / total_s, 1)
+        return out
+
+    def _boot(n_peers: int, base_port: int) -> tuple:
+        mport = base_port
+        peers = [f"127.0.0.1:{base_port + 1 + i}"
+                 for i in range(n_peers)]
+        procs = [subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu.cli", "master",
+             "-ip", "127.0.0.1", "-port", str(mport)],
+            env=dict(env, WEED_FILER_RING_PEERS=",".join(peers)
+                     if n_peers > 1 else ""),
+            cwd=work, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)]
+        _wait_http(f"http://127.0.0.1:{mport}/cluster/status")
+        for p in peers:
+            port = p.rsplit(":", 1)[1]
+            cmd = [sys.executable, "-m", "seaweedfs_tpu.cli", "filer",
+                   "-ip", "127.0.0.1", "-port", port,
+                   "-mserver", f"127.0.0.1:{mport}",
+                   "-store", "memory"]
+            if n_peers > 1:
+                cmd += ["-ring_peers", ",".join(peers)]
+            procs.append(subprocess.Popen(
+                cmd,
+                env=dict(env, WEED_FILER_RING_REPLICAS=str(
+                    _RING_BENCH_REPLICAS),
+                         WEED_FILER_RING_VNODES="256"),
+                cwd=work, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL))
+        for p in peers:
+            _wait_http(f"http://{p}/__meta__/info")
+        return procs, peers, mport
+
+    def _kill(procs) -> None:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        time.sleep(0.5)
+
+    out: dict = {"driver": {"processes": PROCS, "threads": THREADS},
+                 "ops": {"create": N_CREATE, "lookup": N_LOOKUP,
+                         "list": N_LIST},
+                 # the scaling rows run replicas=1 (pure partition
+                 # scaling — the DirectoryRing's own axis); the
+                 # replicated row below prices the durability knob
+                 "ring_replicas": _RING_BENCH_REPLICAS}
+    ctx = mp.get_context("spawn")
+    with ctx.Pool(PROCS) as client_pool:
+        # warm the pool (spawn + import cost must not land in a row)
+        client_pool.starmap(_meta_noop, [() for _ in range(PROCS)])
+        # both clusters stay up and the rows INTERLEAVE, median-of-3
+        # each: this shared host drifts on the tens-of-seconds scale,
+        # so back-to-back pass pairs see the same machine while
+        # separated rows would eat the drift as a phantom (anti-)speedup
+        procs_1, peers_1, _ = _boot(1, 21555)
+        procs_3, peers_3, _ = _boot(3, 22555)
+        try:
+            ring = DirectoryRing(peers=peers_3, vnodes=256,
+                                 replicas=_RING_BENCH_REPLICAS)
+            single_rows, ring_rows = [], []
+            for _ in range(3):
+                single_rows.append(_drive(peers_1, None, client_pool))
+                ring_rows.append(_drive(peers_3, ring, client_pool))
+                _phase_checkpoint(work, "metadata", out)
+                if time.time() > deadline - 30 and single_rows:
+                    break
+            out["single"] = sorted(
+                single_rows,
+                key=lambda r: r["namespace_ops_s"])[len(single_rows) // 2]
+            out["ring3"] = sorted(
+                ring_rows,
+                key=lambda r: r["namespace_ops_s"])[len(ring_rows) // 2]
+        finally:
+            _kill(procs_1 + procs_3)
+        _phase_checkpoint(work, "metadata", out)
+        # informational: the same ring at replicas=2 (synchronous
+        # successor mirrors on every write) — the price of the
+        # zero-loss-on-peer-kill contract, NOT an acceptance row
+        if time.time() < deadline - 45:
+            saved = _RING_BENCH_REPLICAS
+            _RING_BENCH_REPLICAS = 2
+            try:
+                procs_r, peers_r, _ = _boot(3, 23555)
+                try:
+                    ring_r = DirectoryRing(peers=peers_r, vnodes=256,
+                                           replicas=2)
+                    out["ring3_replicated"] = _drive(peers_r, ring_r,
+                                                     client_pool)
+                finally:
+                    _kill(procs_r)
+            except Exception as e:
+                out["ring3_replicated"] = {"error": str(e)}
+            finally:
+                _RING_BENCH_REPLICAS = saved
+    ratio = round(out["ring3"]["namespace_ops_s"]
+                  / max(out["single"]["namespace_ops_s"], 1), 3)
+    out["scaling_3p"] = ratio
+    out["accept"] = {"threex_vs_single_ge_1_8": ratio >= 1.8,
+                     "zero_errors": out["single"]["errors"] == 0
+                     and out["ring3"]["errors"] == 0}
+    _phase_checkpoint(work, "metadata", out)
+    return out
+
+
 def phase_lint(work: str = "", budget_s: float = 60.0) -> dict:
     """weedlint smoke: the full-tree static-analysis gate must stay
     cheap enough to live inside the tier-1 pytest run. Runs the exact
@@ -2519,6 +2777,20 @@ def main() -> None:
         detail["multichip"] = multichip
         _checkpoint(detail)
 
+        metadata: dict = {"error": "skipped (budget)"}
+        if left() > 90:
+            try:
+                metadata = phase_metadata(
+                    work, budget_s=min(240.0, left() - 30.0))
+                _log(f"metadata: single "
+                     f"{(metadata.get('single') or {}).get('namespace_ops_s')}"
+                     f" ops/s, 3-peer ring x{metadata.get('scaling_3p')}")
+            except Exception as e:
+                metadata = {"error": str(e),
+                            **_load_partial(work, "metadata")}
+        detail["metadata"] = metadata
+        _checkpoint(detail)
+
         try:
             lint = phase_lint(work)
             _log(f"lint: {lint.get('lint_wall_s')}s over "
@@ -2603,6 +2875,13 @@ def main() -> None:
                 "georepl_steady_lag_s":
                     (georepl.get("steady_lag_s") or {}).get("median"),
                 "georepl_lag_ratio": georepl.get("lag_ratio"),
+                "metadata_single_ops_s":
+                    (metadata.get("single") or {}).get(
+                        "namespace_ops_s"),
+                "metadata_ring3_ops_s":
+                    (metadata.get("ring3") or {}).get(
+                        "namespace_ops_s"),
+                "metadata_scaling_3p": metadata.get("scaling_3p"),
                 "multichip_scaling": multichip.get("scaling"),
                 "multichip_storm_drain_ratio":
                     (multichip.get("rebuild_storm") or {}).get(
@@ -2634,6 +2913,7 @@ if __name__ == "__main__":
               "overload": lambda w: phase_overload(w, budget_s=budget),
               "lifecycle": lambda w: phase_lifecycle(w, budget_s=budget),
               "georepl": lambda w: phase_georepl(w, budget_s=budget),
+              "metadata": lambda w: phase_metadata(w, budget_s=budget),
               "lint": lambda w: phase_lint(w, budget_s=budget),
               }[name]
         print(json.dumps(fn(work)))

@@ -68,11 +68,12 @@ def _daemon_loop_violations(node: ast.AsyncFunctionDef):
 @register
 class DaemonLoopShedable(Rule):
     name = "daemon-loop-shedable"
-    rationale = ("every lifecycle/geo daemon loop must bind CLASS_BG "
-                 "(so its fan-out sheds before foreground traffic) and "
-                 "sleep on a jittered interval (no fleet-wide lockstep "
-                 "scans)")
-    scope = ("seaweedfs_tpu/lifecycle/", "seaweedfs_tpu/geo/")
+    rationale = ("every lifecycle/geo/metaring daemon loop must bind "
+                 "CLASS_BG (so its fan-out sheds before foreground "
+                 "traffic) and sleep on a jittered interval (no "
+                 "fleet-wide lockstep scans)")
+    scope = ("seaweedfs_tpu/lifecycle/", "seaweedfs_tpu/geo/",
+             "seaweedfs_tpu/metaring/")
     fixture_relpath = "seaweedfs_tpu/lifecycle/_fixture.py"
     fixture = (
         "async def scan_loop():\n"

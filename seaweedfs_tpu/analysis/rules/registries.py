@@ -78,6 +78,8 @@ class FaultPointRegistry(Rule):
         "    await faults.fire_async('geo.aply')\n"      # typo
         "def geo_stream(self):\n"
         "    faults.fire('geo.straem')\n"                # typo
+        "async def ring_hop(self):\n"
+        "    await faults.fire_async('ring.proxi')\n"    # typo
     )
     clean_fixture = (
         "from . import faults\n"
@@ -87,6 +89,12 @@ class FaultPointRegistry(Rule):
         "    await faults.fire_async('geo.apply')\n"
         "def geo_stream(self):\n"
         "    faults.fire('geo.stream')\n"
+        "async def ring_hop(self):\n"
+        "    await faults.fire_async('ring.proxy')\n"
+        "async def ring_handoff(self):\n"
+        "    await faults.fire_async('ring.handoff')\n"
+        "def log_apply(self):\n"
+        "    faults.fire('master.log.apply')\n"
     )
 
     def check_project(self, mods):

@@ -139,9 +139,17 @@ class HttpPool:
     def request(self, method: str, url: str,
                 body: Optional[bytes] = None,
                 headers: Optional[dict] = None,
-                timeout: Optional[float] = None) -> PoolResponse:
+                timeout: Optional[float] = None,
+                idempotent: bool = False) -> PoolResponse:
         """One full request/response. `url` may carry or omit the
         http:// scheme; HTTP error statuses are returned, not raised.
+
+        ``idempotent=True`` lets a non-GET ride pooled keep-alive
+        connections (and the transparent stale-socket retry): the
+        caller asserts that re-executing the request is safe — the
+        metaring mirror/proxy upserts are exactly this shape, and
+        dialing a fresh TCP connection per mirrored create was the
+        dominant cost of ring writes.
 
         A shed 429/503 (``X-Seaweed-Shed: 1``) is honored, not fought:
         sleep the server's ``Retry-After`` (bounded by the remaining
@@ -159,7 +167,8 @@ class HttpPool:
         shed_left = self.shed_retries
         while True:
             resp = self._request_once(method, host, port, path, body,
-                                      headers, base_timeout)
+                                      headers, base_timeout,
+                                      idempotent=idempotent)
             if shed_left <= 0 or not retry_mod.is_shed(resp.status,
                                                        resp.headers):
                 return resp
@@ -183,7 +192,8 @@ class HttpPool:
     def _request_once(self, method: str, host: str, port: int, path: str,
                       body: Optional[bytes],
                       headers: Optional[dict],
-                      timeout: Optional[float]) -> PoolResponse:
+                      timeout: Optional[float],
+                      idempotent: bool = False) -> PoolResponse:
         hdrs = dict(headers or {})
         from .. import faults, observe, overload
         from ..utils import retry as retry_mod
@@ -215,7 +225,7 @@ class HttpPool:
                 breaker.record_failure(hostkey)
             raise ConnectionResetError(
                 f"injected drop for {hostkey}")
-        poolable = method.upper() in _POOLED_METHODS
+        poolable = idempotent or method.upper() in _POOLED_METHODS
         last: Optional[Exception] = None
         for attempt in range(2):
             if poolable:

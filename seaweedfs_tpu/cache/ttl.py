@@ -125,6 +125,17 @@ class TTLCache:
                 return default
             return value
 
+    def drop_paths(self, keys) -> None:
+        """Invalidate a batch of keys under ONE generation bump — the
+        cross-peer invalidation sweep (metaring) drops both sides of a
+        remote mutation atomically, so a read-through fill racing the
+        sweep is discarded by put_if_fresh regardless of which key it
+        was filling."""
+        with self._lock:
+            self.generation += 1
+            for k in keys:
+                self._data.pop(k, None)
+
     def drop_prefix(self, prefix: str) -> None:
         """Invalidate every string key under `prefix` (recursive
         directory delete: cached child entries must not outlive it)."""

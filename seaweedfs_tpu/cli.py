@@ -184,6 +184,13 @@ def cmd_filer(args) -> None:
         elif args.store in ("etcd", "elastic"):
             store_kwargs["servers"] = args.store_servers
     notifier = load_notifier(load_configuration("notification"))
+    ring_config = None
+    if args.ring_peers:
+        from .metaring import RingConfig
+        base = RingConfig.from_env()
+        ring_config = RingConfig(
+            peers=[p for p in args.ring_peers.split(",") if p],
+            vnodes=base.vnodes, replicas=base.replicas)
     _run_forever(run_filer(
         args.ip, args.port, args.mserver, store_name=args.store,
         store_kwargs=store_kwargs, chunk_size=args.chunk_size_mb * 1024 * 1024,
@@ -194,6 +201,7 @@ def cmd_filer(args) -> None:
         notifier=notifier, guard=_load_guard(), tls=_load_tls(),
         cipher=args.encrypt_volume_data,
         url=f"{args.ip}:{args.port}",
+        ring_config=ring_config,
         grpc_port=(args.port + 10000 if args.grpc_port < 0
                    else args.grpc_port)))
 
@@ -754,6 +762,10 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("-peers", default="",
                    help="comma-separated peer filer host:port for "
                         "active-active metadata sync")
+    f.add_argument("-ring_peers", default="",
+                   help="comma-separated filer host:port members of the"
+                        " metadata scale-out ring (partitioned"
+                        " namespace; see also WEED_FILER_RING_*)")
     f.add_argument("-grpc_port", type=int, default=-1,
                    help="gRPC meta-plane port (default HTTP+10000; "
                         "0 disables)")

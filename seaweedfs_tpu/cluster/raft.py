@@ -105,6 +105,12 @@ class RaftNode:
         self.match_index: dict[str, int] = {}
 
         self._session = None
+        # apply-result capture for propose_apply: index -> apply_fn
+        # return value, kept only for indices a local proposer is
+        # waiting on (bounded by in-flight proposals — entries nobody
+        # registered for are never stored)
+        self._result_wanted: set[int] = set()
+        self._apply_results: dict[int, object] = {}
         # all durable writes ride this one thread, keeping them ordered
         # while the event loop (raft heartbeats) never waits on fsync
         import concurrent.futures
@@ -375,7 +381,9 @@ class RaftNode:
         while self.last_applied < self.commit_index:
             self.last_applied += 1
             try:
-                self.apply_fn(self._entry(self.last_applied)["cmd"])
+                ret = self.apply_fn(self._entry(self.last_applied)["cmd"])
+                if self.last_applied in self._result_wanted:
+                    self._apply_results[self.last_applied] = ret
             except Exception as e:
                 log.error("apply failed at %d: %s", self.last_applied, e)
         self._maybe_compact()
@@ -412,25 +420,45 @@ class RaftNode:
     async def propose(self, cmd: dict, timeout: float = 5.0) -> bool:
         """Append cmd to the replicated log; resolves True once committed
         at this node's term (False if leadership was lost)."""
+        ok, _ = await self.propose_apply(cmd, timeout, want_result=False)
+        return ok
+
+    async def propose_apply(self, cmd: dict, timeout: float = 5.0,
+                            want_result: bool = True
+                            ) -> tuple[bool, object]:
+        """propose() that also hands back what apply_fn returned for
+        THIS command — how the master's metadata log serves assign
+        batches: the apply computes the batch's first key from the
+        replicated next_key, and the leader must read its own command's
+        result, not re-derive it from mutable state a concurrent
+        proposal may have advanced."""
         if self.role != LEADER:
-            return False
+            return False, None
         self.log.append({"term": self.term, "cmd": cmd})
         # capture the index BEFORE awaiting: a concurrent propose can
         # append during the fsync and _last_index() would then name the
         # wrong entry for this command's commit waiter
         index = self._last_index()
-        await self._flush_state()
-        if not self.peers:
-            self.commit_index = index
-            self._apply_committed()
-            return True
-        fut: asyncio.Future = asyncio.get_event_loop().create_future()
-        self._commit_waiters.append((index, self.term, fut))
-        await self._replicate_round()
+        if want_result:
+            self._result_wanted.add(index)
         try:
-            return await asyncio.wait_for(fut, timeout)
-        except asyncio.TimeoutError:
-            return False
+            await self._flush_state()
+            if not self.peers:
+                self.commit_index = index
+                self._apply_committed()
+                return True, self._apply_results.pop(index, None)
+            fut: asyncio.Future = asyncio.get_event_loop().create_future()
+            self._commit_waiters.append((index, self.term, fut))
+            await self._replicate_round()
+            try:
+                ok = await asyncio.wait_for(fut, timeout)
+            except asyncio.TimeoutError:
+                ok = False
+            return ok, (self._apply_results.pop(index, None)
+                        if ok else None)
+        finally:
+            self._result_wanted.discard(index)
+            self._apply_results.pop(index, None)
 
     # --- RPC handlers (wired into the master app) ---
     async def handle_vote(self, req: dict) -> dict:

@@ -110,6 +110,16 @@ KNOWN_POINTS = frozenset({
                             # drop = event lost mid-flight
     "geo.stream",           # the /__meta__/subscribe tail a replicator
                             # rides — error/drop = stream torn down
+    "ring.proxy",           # metaring owner-proxy/mirror hop between
+                            # filer peers — drop = peer vanished
+                            # mid-request (read fallback / mirror
+                            # degradation paths)
+    "ring.handoff",         # metaring partition handoff walker —
+                            # error/drop = coordinator died mid-move
+                            # (resume-from-watermark path)
+    "master.log.apply",     # master metadata-log apply (assign
+                            # batches, volume create/retire, geometry
+                            # stamps riding the raft plane)
 })
 
 _lock = threading.Lock()

@@ -51,6 +51,20 @@ class MetaEvent:
             "signatures": list(self.signatures),
         }
 
+    def wire(self) -> bytes:
+        """Compact ndjson wire form, serialized ONCE per event no
+        matter how many subscriber streams carry it — with a ring of N
+        peers tailing every peer's /__meta__/subscribe for cache
+        invalidation, per-subscriber re-serialization was O(N) loop
+        work on every mutation."""
+        cached = getattr(self, "_wire", None)
+        if cached is None:
+            import json as _json
+            cached = _json.dumps(self.to_dict(),
+                                 separators=(",", ":")).encode() + b"\n"
+            object.__setattr__(self, "_wire", cached)
+        return cached
+
     @classmethod
     def from_dict(cls, d: dict) -> "MetaEvent":
         import json as _json
@@ -182,12 +196,18 @@ class Filer:
     # --- CRUD ---
     def create_entry(self, entry: Entry,
                      o_excl: bool = False,
-                     signatures: tuple[int, ...] = ()) -> Entry:
+                     signatures: tuple[int, ...] = (),
+                     ensure_parents: bool = True) -> Entry:
         """Insert with parent auto-creation (Filer.CreateEntry,
         weed/filer/filer.go:119-186). signatures: ids of filers that
-        already processed this mutation (loop prevention in sync)."""
+        already processed this mutation (loop prevention in sync).
+        ensure_parents=False skips the ancestor auto-create: in ring
+        mode each ancestor's ENTRY belongs to a different partition
+        owner, so the metaring layer creates them through the ring —
+        auto-creating here would mis-place them on the leaf's owner."""
         with self._lock:
-            self._ensure_parents(entry.parent)
+            if ensure_parents:
+                self._ensure_parents(entry.parent)
             old = self.store.find_entry(entry.full_path)
             if old is not None:
                 if o_excl:
@@ -389,18 +409,41 @@ class Filer:
     def _notify(self, directory: str, old: Optional[Entry],
                 new: Optional[Entry], delete_chunks: bool = False,
                 signatures: tuple[int, ...] = ()) -> None:
+        moved_across = (old is not None and new is not None
+                        and old.full_path != new.full_path)
         if self._entry_cache is not None:
             # every mutation flows through here (including auto-created
             # parents and sync replays): drop both sides so the next
             # lookup reads through — negative entries included
             if old is not None:
                 self._entry_cache.pop(old.full_path)
+                if moved_across and old.is_directory:
+                    # a directory moved away: every cached descendant
+                    # under the OLD path is stale now — the per-child
+                    # notifies cover live children, the prefix sweep
+                    # covers cached negatives and raced fills
+                    self._entry_cache.drop_prefix(
+                        old.full_path.rstrip("/") + "/")
             if new is not None:
                 self._entry_cache.pop(new.full_path)
+        sigs = tuple(signatures) + (self.signature,)
         self.meta_log.append(MetaEvent(
             tsns=time.time_ns(), directory=directory,
             old_entry=old, new_entry=new, delete_chunks=delete_chunks,
-            signatures=tuple(signatures) + (self.signature,)))
+            signatures=sigs))
+        if moved_across and old.parent != new.parent:
+            # a cross-directory move's event carries directory=new
+            # parent only, so prefix-filtered subscribers watching the
+            # OLD parent (mount meta caches, geo replicators, the
+            # metaring cross-peer invalidation) would never learn the
+            # old path died.  Emit a metadata-only tombstone at the old
+            # parent; appliers that processed the rename above re-drop
+            # a path that is already gone (a benign no-op), and
+            # old-parent-scoped subscribers converge.
+            self.meta_log.append(MetaEvent(
+                tsns=time.time_ns(), directory=old.parent,
+                old_entry=old, new_entry=None, delete_chunks=False,
+                signatures=sigs))
 
     def apply_event(self, event: MetaEvent) -> bool:
         """Replay a peer filer's mutation into this store

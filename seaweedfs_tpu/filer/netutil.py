@@ -1,8 +1,31 @@
-"""Shared socket helpers for the wire-protocol filer stores and their
-in-repo fake servers (mongo OP_MSG, cassandra CQL) — one recv loop to
-maintain instead of a copy per client/handler."""
+"""Shared socket/stream helpers for the wire-protocol filer stores,
+their in-repo fake servers (mongo OP_MSG, cassandra CQL), and the
+ndjson meta-event streams — one recv/split loop to maintain instead of
+a copy per client/handler."""
 
 from __future__ import annotations
+
+
+async def iter_ndjson(content):
+    """Split an aiohttp streaming body into lines WITHOUT the built-in
+    line iterator: ``async for line in content`` raises
+    ValueError('Chunk too big') past ~2x the 64KB buffer, and a meta
+    event for a many-chunk entry easily exceeds that — a subscriber
+    would tear down, reconnect at the same offset, and be fed the same
+    oversized line forever.  Shared by the geo BucketReplicator and the
+    metaring PeerInvalidator."""
+    buf = bytearray()
+    async for chunk in content.iter_any():
+        buf += chunk
+        while True:
+            i = buf.find(b"\n")
+            if i < 0:
+                break
+            line = bytes(buf[:i])
+            del buf[:i + 1]
+            yield line
+    if buf:
+        yield bytes(buf)
 
 
 def read_exact(recv, n: int) -> bytes:

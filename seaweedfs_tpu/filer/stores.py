@@ -65,6 +65,15 @@ class FilerStore:
     def kv_get(self, key: str) -> Optional[bytes]:
         raise NotImplementedError
 
+    def iter_directories(self) -> Iterator[str]:
+        """Every directory path with at least one stored child, sorted —
+        the enumeration the metaring partition handoff walks (a ring
+        change must find owned directories WITHOUT a namespace-root
+        walk, which can't see subtrees whose parents live on peers).
+        Stores that can't enumerate don't support ring handoff."""
+        raise NotImplementedError(
+            f"store {self.name!r} cannot enumerate directories")
+
     def begin(self) -> None:  # transaction hooks (AtomicRenameEntry)
         pass
 
@@ -164,6 +173,12 @@ class MemoryStore(FilerStore):
 
     def kv_get(self, key: str) -> Optional[bytes]:
         return self._kv.get(key)
+
+    def iter_directories(self) -> Iterator[str]:
+        with self._lock:
+            dirs = sorted(d for d, names in self._dirs.items()
+                          if names and d)
+        return iter(dirs)
 
 
 # SQL family (abstract-SQL layer, filer/abstract_sql.py) and the embedded

@@ -32,6 +32,7 @@ import aiohttp
 
 from .. import faults, observe, overload
 from ..filer.filer import MetaEvent
+from ..filer.netutil import iter_ndjson as _netutil_iter_ndjson
 from ..lifecycle import jittered
 from ..utils import glog
 from . import OFFSET_DIR, GeoConfig
@@ -228,27 +229,13 @@ class BucketReplicator:
                         not isinstance(exc, asyncio.CancelledError):
                     raise exc
 
-    @staticmethod
-    async def _iter_ndjson(content):
-        """Split the stream into lines WITHOUT aiohttp's line iterator:
-        `async for line in content` raises ValueError('Chunk too big')
-        past ~2x the 64KB buffer, and a meta event for a many-chunk
-        entry easily exceeds that — the stream would tear down,
-        reconnect at the same offset, and redeliver the same oversized
-        line forever (a livelock the poison machinery never sees,
-        since it only counts APPLY failures)."""
-        buf = bytearray()
-        async for chunk in content.iter_any():
-            buf += chunk
-            while True:
-                i = buf.find(b"\n")
-                if i < 0:
-                    break
-                line = bytes(buf[:i])
-                del buf[:i + 1]
-                yield line
-        if buf:
-            yield bytes(buf)
+    # manual line split: aiohttp's line iterator raises
+    # ValueError('Chunk too big') past ~128KB, and a meta event for a
+    # many-chunk entry easily exceeds that — the stream would tear
+    # down, reconnect at the same offset, and redeliver the same
+    # oversized line forever (a livelock the poison machinery never
+    # sees, since it only counts APPLY failures)
+    _iter_ndjson = staticmethod(_netutil_iter_ndjson)
 
     async def _read_lines(self, session, r, sink: ClusterSink,
                           pool: ApplierPool) -> None:

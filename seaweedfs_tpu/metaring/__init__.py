@@ -1,0 +1,84 @@
+"""Metadata scale-out plane: partitioned filer ring + master metadata log.
+
+PAPER.md §L4 gives the filer pluggable metadata stores precisely so the
+namespace can outgrow one node; until this plane existed ours was still
+one process in front of one store, and the master replicated only a
+sequencer *ceiling*.  This package is the refactor ROADMAP item 3 names:
+no single process bounds namespace size, assign throughput, or
+availability.
+
+Two halves:
+
+* **DirectoryRing** (ring.py) — virtual-node consistent hashing keyed on
+  the PARENT directory, so one directory's children (and therefore one
+  path's create/overwrite/delete) always live on one owner peer, with a
+  configurable replica count mirrored to ring successors.  The ring
+  config is owned by the master (served at ``/dir/ring``, pushed over
+  the existing KeepConnected ``/cluster/watch`` stream) so every filer
+  and every client sees one consistent membership view.
+
+* **Filer-side routing** (router.py / coordinator.py / invalidation.py /
+  handoff.py) — every namespace op entering any peer is routed to its
+  owner; non-owner peers proxy over the pooled keep-alive HTTP client
+  (trace id, deadline and priority-class headers already ride it, and
+  the hop classifies as system at the receiver — it was admitted once
+  already).  Recursive ops (delete subtree, cross-partition rename) fan
+  out under a coordinator with per-directory ordering exactly like the
+  geo ApplierPool; the PR 2 entry-cache generations extend to
+  cross-peer invalidation (owners broadcast their ``/__meta__`` deltas,
+  peers sweep both parents by prefix); a ring change triggers a
+  background partition handoff (walk + upsert, CLASS_BG, resumable
+  low-watermark offsets exactly like the geo backfill).
+
+The master half (masterlog.py) replaces the ceiling-only sequencer sync
+with a compact replicated metadata log — assign batches, volume
+create/retire, EC geometry stamps — applied through the existing raft
+plane, so a freshly elected leader replays to the exact sequencer state
+instead of jumping past a high-water mark.
+
+Env knobs (all optional; the plane is off until peers are configured):
+
+  WEED_FILER_RING_PEERS     comma-separated filer host:port members
+  WEED_FILER_RING_VNODES    virtual nodes per peer (default 64)
+  WEED_FILER_RING_REPLICAS  entry copies per partition (default 2)
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass
+class RingConfig:
+    """Parsed WEED_FILER_RING_* knobs (explicit args win over env)."""
+
+    peers: list[str] = field(default_factory=list)
+    vnodes: int = 64
+    replicas: int = 2
+
+    @property
+    def enabled(self) -> bool:
+        return len(self.peers) > 0
+
+    @classmethod
+    def from_env(cls, env=os.environ) -> "RingConfig":
+        peers = [p.strip() for p in
+                 env.get("WEED_FILER_RING_PEERS", "").split(",")
+                 if p.strip()]
+
+        def num(key: str, default: int) -> int:
+            try:
+                return int(env.get(key, "") or default)
+            except ValueError:
+                return default
+
+        return cls(peers=peers,
+                   vnodes=max(1, num("WEED_FILER_RING_VNODES", 64)),
+                   replicas=max(1, num("WEED_FILER_RING_REPLICAS", 2)))
+
+
+from .ring import DirectoryRing  # noqa: E402
+from .masterlog import MasterMetaLog  # noqa: E402
+
+__all__ = ["RingConfig", "DirectoryRing", "MasterMetaLog"]

@@ -1,0 +1,178 @@
+"""FanoutCoordinator: recursive namespace ops across ring partitions.
+
+A recursive delete (or a cross-partition rename) touches directories
+owned by several peers: every directory's children live on that
+directory's owner, so the subtree walk itself must hop the ring.  The
+coordinator fans the per-directory work across a small worker pool with
+the one ordering that matters — operations for the SAME directory hash
+to the same worker and run FIFO (exactly the geo ApplierPool's
+discipline: one path's create/overwrite/delete can never land out of
+order, cross-directory ordering is deliberately relaxed).
+
+Structure ordering is enforced by the walk itself: children are
+scheduled (and drained) before their parent directory entry is removed,
+so a crash mid-delete leaves only complete subtrees missing — never an
+orphaned child under a deleted parent.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable
+
+from ..filer.entry import Entry
+
+log = logging.getLogger("metaring.coordinator")
+
+
+class FanoutCoordinator:
+    """Per-directory-ordered async fanout over ring ops.
+
+    ``ops`` is the FilerServer's ring-op facade: objects exposing
+    ``ring_list / ring_delete / ring_create / ring_find`` coroutines
+    that already handle owner routing + replica mirroring.
+    """
+
+    def __init__(self, ops, workers: int = 4):
+        self.ops = ops
+        self.workers = max(1, workers)
+
+    # --- per-directory-ordered execution ---
+
+    async def _run_grouped(self, jobs: list[tuple[str, Callable[[],
+                                                  Awaitable[None]]]]
+                           ) -> None:
+        """Run (directory, thunk) jobs: same directory -> same lane,
+        FIFO; distinct directories run concurrently across lanes."""
+        lanes: list[list[Callable[[], Awaitable[None]]]] = [
+            [] for _ in range(self.workers)]
+        for directory, thunk in jobs:
+            lanes[hash(directory) % self.workers].append(thunk)
+
+        async def drain(lane) -> None:
+            for thunk in lane:
+                await thunk()
+
+        results = await asyncio.gather(
+            *[drain(lane) for lane in lanes if lane],
+            return_exceptions=True)
+        for r in results:
+            if isinstance(r, BaseException):
+                raise r
+
+    # --- recursive delete across partitions ---
+
+    async def delete_subtree(self, path: str, free_chunks: bool = True,
+                             signatures: tuple = ()) -> int:
+        """Delete a directory subtree whose directories may be owned by
+        different peers.  Bottom-up: each directory's files are deleted
+        on its owner (freeing chunks there), subdirectories recurse
+        first, the directory entry itself goes last."""
+        deleted = await self._delete_children(path, free_chunks,
+                                              signatures)
+        # the directory ENTRY lives on the parent's owner
+        await self.ops.ring_delete(path, recursive=True,
+                                   free_chunks=free_chunks,
+                                   signatures=signatures)
+        return deleted + 1
+
+    async def _delete_children(self, dir_path: str, free_chunks: bool,
+                               signatures: tuple) -> int:
+        deleted = 0
+        while True:
+            # every processed page is deleted, so the NEXT page is
+            # always the new first page — re-list from the start rather
+            # than paginate past entries that no longer exist
+            batch = await self.ops.ring_list(dir_path, limit=1024)
+            if not batch:
+                break
+            jobs: list[tuple[str, Callable[[], Awaitable[None]]]] = []
+            subdirs: list[str] = []
+            for e in batch:
+                if e.is_directory:
+                    subdirs.append(e.full_path)
+                else:
+                    jobs.append((dir_path, self._delete_one(
+                        e.full_path, free_chunks, signatures)))
+            # subtrees drain fully before this page's files are counted
+            # done — children before parents, always
+            for sub in subdirs:
+                deleted += await self.delete_subtree(
+                    sub, free_chunks=free_chunks, signatures=signatures)
+            await self._run_grouped(jobs)
+            deleted += len(jobs)
+            if len(batch) < 1024:
+                break
+        return deleted
+
+    def _delete_one(self, path: str, free_chunks: bool,
+                    signatures: tuple):
+        async def run() -> None:
+            try:
+                await self.ops.ring_delete(path, recursive=False,
+                                           free_chunks=free_chunks,
+                                           signatures=signatures)
+            except FileNotFoundError:
+                pass  # a retried fanout page may have deleted it already
+        return run
+
+    # --- cross-partition rename ---
+
+    async def rename(self, old_path: str, new_path: str) -> int:
+        """Move old_path -> new_path across partitions: entries are
+        re-created at their new owners (same chunk list — bytes never
+        move), then the old side is removed metadata-only.  Create
+        strictly precedes delete per entry, so a crash leaves a
+        recoverable double-entry, never a lost one."""
+        entry = await self.ops.ring_find(old_path)
+        if entry is None:
+            raise FileNotFoundError(old_path)
+        moved = await self._move_entry(entry, new_path)
+        return moved
+
+    async def _move_entry(self, entry: Entry, new_path: str) -> int:
+        moved = 1
+        new_entry = Entry(full_path=new_path, attr=entry.attr,
+                          chunks=entry.chunks, extended=entry.extended,
+                          hard_link_id=entry.hard_link_id)
+        await self.ops.ring_create(new_entry, free_old_chunks=False)
+        if entry.is_directory:
+            start = ""
+            while True:
+                batch = await self.ops.ring_list(entry.full_path,
+                                                 start=start, limit=1024)
+                if not batch:
+                    break
+                dirs = [e for e in batch if e.is_directory]
+                files = [e for e in batch if not e.is_directory]
+                jobs = [(entry.full_path,
+                         self._move_file(e, f"{new_path}/{e.name}"))
+                        for e in files]
+                await self._run_grouped(jobs)
+                moved += len(files)
+                for e in dirs:
+                    moved += await self._move_entry(
+                        e, f"{new_path}/{e.name}")
+                if len(batch) < 1024:
+                    break
+                start = batch[-1].name
+        # old side: metadata only — the chunks now belong to the new path
+        await self.ops.ring_delete(entry.full_path, recursive=False,
+                                   free_chunks=False)
+        return moved
+
+    def _move_file(self, entry: Entry, new_path: str):
+        async def run() -> None:
+            new_entry = Entry(full_path=new_path, attr=entry.attr,
+                              chunks=entry.chunks,
+                              extended=entry.extended,
+                              hard_link_id=entry.hard_link_id)
+            await self.ops.ring_create(new_entry, free_old_chunks=False)
+            try:
+                await self.ops.ring_delete(entry.full_path,
+                                           recursive=False,
+                                           free_chunks=False)
+            except FileNotFoundError:
+                pass
+        return run

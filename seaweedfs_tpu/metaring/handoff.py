@@ -1,0 +1,194 @@
+"""HandoffRunner: background partition migration on ring changes.
+
+When a peer joins (or leaves) the ring, some directories' replica sets
+change; their entries must move to (or be re-mirrored onto) the new
+owners.  This runner walks the LOCAL store's directory enumeration
+(``FilerStore.iter_directories`` — a root walk can't see subtrees whose
+parents live on peers), pushes every entry of a no-longer-ours
+directory to its current replica set as replica-apply upserts, then
+drops the local copies metadata-only (the chunks moved with the entry
+records; bytes on volume servers never move).
+
+The discipline is the geo backfill's, exactly:
+
+* CLASS_BG — every push sheds before foreground traffic at the
+  receiving peer;
+* resumable low-watermark offsets — directories are walked in sorted
+  order and the last fully-moved directory is persisted in the store's
+  KV face under ``ring_handoff/v<version>``; a restarted coordinator
+  (or a crashed filer) resumes AFTER the watermark instead of
+  re-pushing from scratch (re-pushing is idempotent upsert anyway — the
+  watermark bounds the wasted work, not correctness);
+* the ``ring.handoff`` fault point makes the mid-flight crash a
+  one-line chaos drill instead of a monkeypatch.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+
+from .. import faults, overload
+from ..lifecycle import jittered
+from .ring import DirectoryRing
+
+log = logging.getLogger("metaring.handoff")
+
+
+class HandoffRunner:
+    def __init__(self, filer_server, router):
+        self.fs = filer_server
+        self.router = router
+        self.moved_entries = 0
+        self.moved_dirs = 0
+        self.last_error = ""
+        self.state = "idle"
+        self._task = None
+
+    # --- trigger (ring change / startup recovery) ---
+
+    def trigger(self, ring: DirectoryRing,
+                old_ring: DirectoryRing = None) -> None:
+        """Start (or restart) a handoff pass for the given ring view.
+        An already-running pass for an older view is cancelled — its
+        watermark persists, but the new membership decides ownership."""
+        if self._task is not None and not self._task.done():
+            self._task.cancel()
+        self._task = asyncio.create_task(self.run_once(ring, old_ring))
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    # --- one resumable pass ---
+
+    def _offset_key(self, version: int) -> str:
+        return f"ring_handoff/v{version}"
+
+    async def run_once(self, ring: DirectoryRing,
+                       old_ring: DirectoryRing = None) -> int:
+        """Re-home every locally-held directory whose replica set
+        changed: push its entries to the new members (a joiner that
+        became owner gets the data even when this peer stays on as
+        successor), and drop the local copies only when this peer left
+        the set entirely.  Returns moved directory count."""
+        overload.set_priority(overload.CLASS_BG)
+        self.state = "running"
+        self.last_error = ""
+        store = self.fs.filer.store
+        key = self._offset_key(ring.version)
+        loop = asyncio.get_event_loop()
+        raw = await loop.run_in_executor(None, store.kv_get, key)
+        watermark = ""
+        if raw:
+            try:
+                watermark = json.loads(raw.decode()).get("dir", "")
+            except ValueError:
+                watermark = ""
+        moved_dirs = 0
+        try:
+            dirs = sorted(await loop.run_in_executor(
+                None, lambda: list(store.iter_directories())))
+            for d in dirs:
+                if watermark and d <= watermark:
+                    continue
+                new_set = ring.owners(d)
+                stray = self.router.self_url not in new_set
+                if not stray:
+                    # we remain a replica: skip only when the before
+                    # view shows an unchanged membership (the diff is
+                    # an optimization, never a correctness gate)
+                    if old_ring is None \
+                            or old_ring.owners(d) == new_set:
+                        continue
+                # a STRAY (locally held, not ours under the new ring)
+                # always moves — even when the old-vs-new diff shows no
+                # change for this partition: an earlier cancelled pass
+                # (ring change during handoff, coordinator crash) may
+                # have left it behind, and skipping it would strand the
+                # data on a peer the ring never routes to again
+                await self._move_directory(d, ring, drop=stray)
+                moved_dirs += 1
+                self.moved_dirs += 1
+                # low-watermark: everything <= d is done for v<version>
+                await loop.run_in_executor(
+                    None, store.kv_put, key,
+                    json.dumps({"dir": d}).encode())
+                # jittered yield between directories: a fleet-wide ring
+                # change must not stampede the new owner in lockstep
+                await asyncio.sleep(jittered(0.01))
+            self.state = "done"
+        except asyncio.CancelledError:
+            self.state = "cancelled"
+            raise
+        except Exception as e:
+            self.state = "failed"
+            self.last_error = str(e)
+            log.warning("ring handoff (v%d) failed at %d dirs: %s",
+                        ring.version, moved_dirs, e)
+            raise
+        return moved_dirs
+
+    async def _move_directory(self, d: str, ring: DirectoryRing,
+                              drop: bool = True) -> None:
+        if await faults.fire_async("ring.handoff"):
+            raise ConnectionResetError(
+                f"injected ring.handoff drop at {d}")
+        store = self.fs.filer.store
+        loop = asyncio.get_event_loop()
+        start = ""
+        while True:
+            batch = await loop.run_in_executor(
+                None, lambda s=start: store.list_directory_entries(
+                    d, s, False, 512))
+            if not batch:
+                break
+            for e in batch:
+                # replica-apply upsert on every CURRENT replica of the
+                # directory — idempotent, so a resumed pass re-pushing
+                # the watermark directory is harmless
+                body = {"entry": json.loads(e.to_json()),
+                        "o_excl": False, "signatures": [],
+                        "free_old_chunks": False}
+                for peer in ring.owners(d):
+                    if peer == self.router.self_url:
+                        continue
+                    resp = await self.router._request(
+                        peer, "POST", "/__meta__/create_entry",
+                        body=body, replica=True, idempotent=True)
+                    if resp.status >= 400:
+                        raise RuntimeError(
+                            f"handoff upsert {e.full_path} -> {peer}: "
+                            f"HTTP {resp.status}")
+                self.moved_entries += 1
+            if len(batch) < 512:
+                break
+            start = batch[-1].name
+        if drop:
+            # local copies go metadata-only: the entries (and their
+            # chunk references) now live with the new replica set —
+            # freeing chunks here would tear bytes out from under the
+            # moved entries
+            await loop.run_in_executor(
+                None, lambda: _drop_local_children(store, d))
+
+    def status(self) -> dict:
+        return {"state": self.state, "moved_dirs": self.moved_dirs,
+                "moved_entries": self.moved_entries,
+                "last_error": self.last_error}
+
+
+def _drop_local_children(store, d: str) -> None:
+    """Remove the local copies of one handed-off directory's children
+    (entries only; never the subtree — deeper directories may still be
+    owned here and are judged one by one by the walk)."""
+    while True:
+        batch = store.list_directory_entries(d, "", False, 512)
+        if not batch:
+            return
+        for e in batch:
+            store.delete_entry(e.full_path)
+        if len(batch) < 512:
+            return

@@ -1,0 +1,164 @@
+"""PeerInvalidator: cross-peer entry-cache invalidation.
+
+The PR 2 entry cache made every filer's lookups read-through with
+generation-guarded fills; in a ring, a peer may also cache entries it
+PROXIED (reads of partitions it does not own), and those can go stale
+when the owner mutates them — the owner's own ``_notify`` only sweeps
+the owner's cache.
+
+This watcher extends the generation mechanism across peers: it tails
+every other ring member's ``/__meta__/subscribe`` stream (the same
+stream filer.sync and the geo replicator ride) and sweeps the LOCAL
+entry cache for every remote mutation — both the old and the new path,
+and for directory events both parents' subtrees by prefix.  Each sweep
+bumps the cache generation, so an in-flight read-through fill that
+raced the remote mutation is discarded by ``put_if_fresh`` exactly like
+a local one.
+
+No store writes happen here: partitions are partitioned.  The stream is
+cache-coherency traffic only, so a watcher outage degrades to TTL
+staleness (the PR 2 bound), never to wrong durable state.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+
+import aiohttp
+
+from .. import overload
+from ..filer.filer import MetaEvent
+from ..lifecycle import jittered
+
+log = logging.getLogger("metaring.invalidation")
+
+
+class PeerInvalidator:
+    def __init__(self, filer_server, peers_fn):
+        """``peers_fn`` returns the CURRENT remote ring members (ring
+        changes re-shape the watch set on the next reconnect)."""
+        self.fs = filer_server
+        self.peers_fn = peers_fn
+        self.swept = 0
+        self.events = 0
+        self._tasks: dict[str, asyncio.Task] = {}
+        # per-peer resume offset (memory-only: a restarted watcher
+        # re-sweeping history is idempotent cache hygiene, not loss)
+        self._since: dict[str, int] = {}
+
+    def start(self) -> None:
+        self.reconcile()
+
+    def reconcile(self) -> None:
+        """Start/stop per-peer watch tasks to match the current ring."""
+        want = set(self.peers_fn())
+        for peer in list(self._tasks):
+            if peer not in want:
+                self._tasks.pop(peer).cancel()
+        for peer in want:
+            if peer not in self._tasks or self._tasks[peer].done():
+                self._tasks[peer] = asyncio.create_task(
+                    self._watch_loop(peer))
+
+    def stop(self) -> None:
+        for t in self._tasks.values():
+            t.cancel()
+        self._tasks.clear()
+
+    async def _watch_loop(self, peer: str) -> None:
+        # coherency traffic is background: its reconnect probes shed
+        # first at an overloaded peer
+        overload.set_priority(overload.CLASS_BG)
+        while True:
+            try:
+                async with self.fs._session.get(
+                        f"http://{peer}/__meta__/subscribe",
+                        params={"since": str(self._since.get(peer, 0)),
+                                "prefix": "/"},
+                        timeout=aiohttp.ClientTimeout(
+                            total=None, sock_read=None)) as r:
+                    # manual ndjson split: aiohttp's line iterator
+                    # raises past ~128KB, and a many-chunk entry's
+                    # event exceeds that — with since= advancing only
+                    # on parsed lines, the oversized event would be
+                    # redelivered on every reconnect (livelock)
+                    from ..filer.netutil import iter_ndjson
+                    async for line in iter_ndjson(r.content):
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            d = json.loads(line)
+                            tsns = int(d.get("tsns", 0))
+                        except (ValueError, KeyError):
+                            continue
+                        self._since[peer] = max(
+                            self._since.get(peer, 0), tsns)
+                        self.apply_raw(d)
+            except asyncio.CancelledError:
+                return
+            except Exception as ex:
+                log.debug("invalidation watch of %s: %s (retrying)",
+                          peer, ex)
+            await asyncio.sleep(jittered(1.0))
+
+    def apply(self, event: MetaEvent) -> None:
+        """Sweep for one parsed MetaEvent (tests, in-process use)."""
+        self.apply_raw(event.to_dict())
+
+    @staticmethod
+    def _side(d: dict, key: str):
+        """(path, is_directory) of one event side without building an
+        Entry — full deserialization (double json per side) was
+        measurable loop work at N peers x every mutation."""
+        s = d.get(key)
+        if not s:
+            return None, False
+        import stat as _stat
+        mode = int((s.get("attr") or {}).get("mode", 0))
+        return s.get("path", ""), _stat.S_ISDIR(mode)
+
+    def apply_raw(self, d: dict) -> None:
+        """Sweep the local entry cache for one remote mutation (wire
+        dict form).  Both sides of a rename — old AND new parent
+        directories — are covered (the regression the `_notify` audit
+        fixed locally)."""
+        self.events += 1
+        if self.fs.filer.signature in (d.get("signatures") or ()):
+            # an echo of a mutation THIS peer originated or applied
+            # (the owner's signature rides every mirror): the local
+            # _notify already swept — re-sweeping would only churn the
+            # cache generation under our own write load
+            return
+        old_path, old_is_dir = self._side(d, "old")
+        new_path, new_is_dir = self._side(d, "new")
+        # a REMOTE directory delete/move must also drop the ring
+        # parent-existence cache (file events don't touch it)
+        dir_cache = getattr(self.fs, "_ring_dir_cache", None)
+        if dir_cache is not None and old_path and old_is_dir \
+                and new_path != old_path:
+            dir_cache.pop(old_path)
+            dir_cache.drop_prefix(old_path.rstrip("/") + "/")
+        cache = self.fs.filer._entry_cache
+        if cache is None:
+            return
+        paths = []
+        prefixes = []
+        for path, is_dir in ((old_path, old_is_dir),
+                             (new_path, new_is_dir)):
+            if not path:
+                continue
+            paths.append(path)
+            if is_dir:
+                prefixes.append(path.rstrip("/") + "/")
+        if paths:
+            cache.drop_paths(paths)
+            self.swept += len(paths)
+        for p in prefixes:
+            cache.drop_prefix(p)
+
+    def status(self) -> dict:
+        return {"peers": sorted(self._tasks),
+                "events": self.events, "swept": self.swept}

@@ -1,0 +1,113 @@
+"""MasterMetaLog: the master's replicated metadata state machine.
+
+Before this plane the raft log carried only two CEILINGS — MaxVolumeId
+and a needle-key high-water mark bumped once per 10k keys.  A freshly
+elected leader jumped its sequencer past the last committed ceiling:
+safe against duplicates, but it skipped up to a whole bound window of
+fids and left every other piece of assignment state (which volumes
+exist, under which collection/geometry) to be re-learned from
+heartbeats.
+
+This log makes the assignment plane itself replicated.  Commands:
+
+  {"assign_batch": {"count": N}}       mint N consecutive needle keys;
+                                       the APPLY computes the first key
+                                       from the replicated next_key, so
+                                       the leader reads its own result
+                                       back through the state machine
+  {"seq_floor": K}                     fold an externally observed key
+                                       (heartbeat max_file_key) in as a
+                                       floor — rare: only a cold start
+                                       against pre-existing volumes
+  {"volume_create": {...}}             volume registry entry (vid,
+                                       collection, replication, ttl)
+  {"volume_retire": {"vid": N}}        drop a registry entry
+  {"geometry_stamp": {...}}            the RS(k,m) a collection's
+                                       volumes seal into, as first used
+
+Killing the leader mid-``/dir/assign?count=N`` can therefore never
+re-issue or skip a fid: a batch that committed is in the log the new
+leader replays (next_key resumes exactly after it), and a batch that
+never committed consumed nothing.
+
+The log rides the EXISTING raft plane (cluster/raft.py): commands apply
+through the master's ``_raft_apply``, snapshots through
+capture/restore, and the leader obtains per-command results via
+``RaftNode.propose_apply``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class MasterMetaLog:
+    """Applied state of the metadata log — owned by the master, mutated
+    ONLY from raft apply (leader and follower take the same path)."""
+
+    def __init__(self):
+        self.next_key = 1                 # exact next needle key
+        self.assign_batches = 0           # applied batches (status view)
+        self.volumes: dict[int, dict] = {}   # vid -> registry record
+        self.geometry: dict[str, str] = {}   # collection -> "k+m"
+
+    # --- apply (one command, in raft log order) ---
+
+    def apply(self, cmd: dict) -> Optional[int]:
+        """Apply one replicated command; returns the first key of an
+        assign batch (None for every other kind).  Must stay
+        deterministic — every replica folds the same commands in the
+        same order into the same state."""
+        result = None
+        if "assign_batch" in cmd:
+            count = max(1, int(cmd["assign_batch"]["count"]))
+            result = self.next_key
+            self.next_key += count
+            self.assign_batches += 1
+        if "seq_floor" in cmd:
+            floor = int(cmd["seq_floor"])
+            if floor >= self.next_key:
+                self.next_key = floor + 1
+        if "volume_create" in cmd:
+            rec = dict(cmd["volume_create"])
+            vid = int(rec.pop("vid"))
+            self.volumes[vid] = rec
+        if "volume_retire" in cmd:
+            vr = cmd["volume_retire"]
+            vids = vr.get("vids", [vr["vid"]] if "vid" in vr else [])
+            for v in vids:
+                self.volumes.pop(int(v), None)
+        if "geometry_stamp" in cmd:
+            st = cmd["geometry_stamp"]
+            self.geometry[st.get("collection", "")] = st["geometry"]
+        return result
+
+    # --- snapshot (raft log compaction / follower catch-up) ---
+
+    def capture(self) -> dict:
+        return {"next_key": self.next_key,
+                "assign_batches": self.assign_batches,
+                "volumes": {str(v): dict(r)
+                            for v, r in self.volumes.items()},
+                "geometry": dict(self.geometry)}
+
+    def restore(self, state: dict) -> None:
+        self.next_key = max(self.next_key,
+                            int(state.get("next_key", 1)))
+        self.assign_batches = max(self.assign_batches,
+                                  int(state.get("assign_batches", 0)))
+        # the snapshot is the AUTHORITATIVE registry view: replace, do
+        # not merge — a lagging follower that applied volume_create
+        # before falling behind must also forget rows the leader
+        # retired before compacting, or replicas of the "deterministic"
+        # state machine stop converging
+        self.volumes = {int(v): dict(rec)
+                        for v, rec in (state.get("volumes")
+                                       or {}).items()}
+        self.geometry = dict(state.get("geometry") or {})
+
+    def status(self) -> dict:
+        return {"next_key": self.next_key,
+                "assign_batches": self.assign_batches,
+                "volumes": len(self.volumes),
+                "geometry_stamps": dict(self.geometry)}

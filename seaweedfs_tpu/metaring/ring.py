@@ -1,0 +1,121 @@
+"""DirectoryRing: virtual-node consistent hashing over filer peers.
+
+The partition key is the PARENT directory of a path — one directory's
+children always share an owner, so directory listings stay one-peer
+operations and a path's create/overwrite/delete serialize on one store
+(the same ordering argument the geo ApplierPool makes when it hashes
+events by directory).
+
+Hashing is md5-based and fully deterministic from (peer urls, vnode
+count), so every process that knows the membership computes the same
+ring — the master still serves /dir/ring as the authoritative view
+(version-numbered, pushed over KeepConnected) because membership
+CHANGES must be observed in one order by everyone.
+
+``owners(dir, n)`` returns the owner plus n-1 distinct successors —
+the replica set.  Writes land on the owner and mirror to successors, so
+losing a peer loses no acked entry: the ring drops the dead peer, the
+successor (which already holds the copies) becomes the owner, and the
+background handoff re-establishes the replica count.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+from typing import Optional
+
+
+def _hash(key: str) -> int:
+    return int.from_bytes(
+        hashlib.md5(key.encode("utf-8")).digest()[:8], "big")
+
+
+class DirectoryRing:
+    def __init__(self, peers: Optional[list[str]] = None,
+                 vnodes: int = 64, replicas: int = 2, version: int = 0):
+        self.vnodes = max(1, int(vnodes))
+        self.replicas = max(1, int(replicas))
+        self.version = version
+        self.peers: list[str] = []
+        self._points: list[int] = []       # sorted vnode hashes
+        self._owners: list[str] = []       # parallel peer urls
+        for p in peers or []:
+            self.add_peer(p, _bump=False)
+
+    # --- membership ---
+
+    def add_peer(self, peer: str, _bump: bool = True) -> bool:
+        if peer in self.peers:
+            return False
+        self.peers.append(peer)
+        self.peers.sort()
+        for i in range(self.vnodes):
+            h = _hash(f"{peer}#{i}")
+            at = bisect.bisect_left(self._points, h)
+            self._points.insert(at, h)
+            self._owners.insert(at, peer)
+        if _bump:
+            self.version += 1
+        return True
+
+    def remove_peer(self, peer: str) -> bool:
+        if peer not in self.peers:
+            return False
+        self.peers.remove(peer)
+        keep = [(h, o) for h, o in zip(self._points, self._owners)
+                if o != peer]
+        self._points = [h for h, _ in keep]
+        self._owners = [o for _, o in keep]
+        self.version += 1
+        return True
+
+    # --- placement ---
+
+    def owner(self, directory: str) -> Optional[str]:
+        owners = self.owners(directory, 1)
+        return owners[0] if owners else None
+
+    def owners(self, directory: str, n: int = 0) -> list[str]:
+        """Owner + distinct successors for a directory (replica set).
+        n=0 means the configured replica count, capped at membership."""
+        if not self._points:
+            return []
+        n = n or self.replicas
+        n = min(n, len(self.peers))
+        start = bisect.bisect(self._points, _hash(directory)) \
+            % len(self._points)
+        out: list[str] = []
+        for i in range(len(self._points)):
+            peer = self._owners[(start + i) % len(self._points)]
+            if peer not in out:
+                out.append(peer)
+                if len(out) >= n:
+                    break
+        return out
+
+    def is_replica(self, directory: str, peer: str) -> bool:
+        return peer in self.owners(directory)
+
+    # --- wire form (served at /dir/ring, pushed over /cluster/watch) ---
+
+    def to_dict(self) -> dict:
+        return {"version": self.version, "peers": list(self.peers),
+                "vnodes": self.vnodes, "replicas": self.replicas}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DirectoryRing":
+        return cls(peers=list(d.get("peers", [])),
+                   vnodes=int(d.get("vnodes", 64)),
+                   replicas=int(d.get("replicas", 2)),
+                   version=int(d.get("version", 0)))
+
+    def partition_counts(self, sample_dirs: list[str]) -> dict[str, int]:
+        """Owned-directory counts over a directory sample — the
+        `filer.ring.status` balance view."""
+        out = {p: 0 for p in self.peers}
+        for d in sample_dirs:
+            o = self.owner(d)
+            if o is not None:
+                out[o] += 1
+        return out

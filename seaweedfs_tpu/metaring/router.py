@@ -1,0 +1,264 @@
+"""RingRouter: owner-routed namespace ops for a ring-member filer.
+
+Every namespace op is keyed on the PARENT directory and executed on the
+ring owner of that directory; non-owners proxy over the pooled
+keep-alive HTTP client (cache/http_pool.py — trace id, deadline budget
+and priority-class headers already ride every pooled request), marked
+with the ring-hop header so the receiving peer classifies the hop as
+system (it was admitted once already at the edge) and does NOT route it
+again (loop prevention).
+
+Writes applied on the owner are mirrored synchronously to the ring
+successors with the replica header — that is the zero-loss story the
+chaos suite proves: losing the owner loses no acked entry, because the
+successor that already holds the copy becomes the owner when the ring
+drops the dead peer.  Reads fall back down the replica list when the
+owner is unreachable.
+
+The pooled client is synchronous by design (it is the shared
+intra-cluster client); the filer calls it through the default executor
+exactly like its own store reads, so proxy hops never block the event
+loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import urllib.parse
+from typing import Optional
+
+from .. import faults
+from ..cache.http_pool import HttpPool, shared_pool
+from ..filer.entry import Entry
+from .ring import DirectoryRing
+
+log = logging.getLogger("metaring.router")
+
+# marks a hop that was already admitted (and routed) at the edge peer:
+# the receiver executes locally and never re-routes — one hop maximum.
+# ONE definition — the admission plane owns the wire constant.
+from ..overload import RING_HOP_HEADER  # noqa: E402
+# marks a replica mirror: apply locally even though this peer is not
+# the owner, and do not mirror again
+RING_REPLICA_HEADER = "X-Seaweed-Ring-Replica"
+
+
+class RingProxyError(RuntimeError):
+    """The owner (and every fallback replica) refused or was
+    unreachable; carries the last HTTP status for the surface to map."""
+
+    def __init__(self, message: str, status: int = 502,
+                 body: Optional[dict] = None):
+        super().__init__(message)
+        self.status = status
+        self.body = body or {}
+
+
+class RingRouter:
+    def __init__(self, ring: DirectoryRing, self_url: str,
+                 pool: Optional[HttpPool] = None, metrics=None,
+                 timeout: float = 30.0):
+        self.ring = ring
+        self.self_url = self_url
+        self.pool = pool or shared_pool()
+        self.metrics = metrics
+        self.timeout = timeout
+        self.proxied = 0
+        self.mirrored = 0
+        self.mirror_failures = 0
+
+    # --- placement ---
+
+    def owners(self, directory: str) -> list[str]:
+        return self.ring.owners(directory)
+
+    def is_owner(self, directory: str) -> bool:
+        owners = self.ring.owners(directory)
+        return not owners or owners[0] == self.self_url
+
+    def is_replica(self, directory: str) -> bool:
+        owners = self.ring.owners(directory)
+        return not owners or self.self_url in owners
+
+    def mirror_targets(self, directory: str) -> list[str]:
+        return [p for p in self.ring.owners(directory)
+                if p != self.self_url]
+
+    # --- pooled request plumbing (executor-hosted) ---
+
+    def _count(self, name: str) -> None:
+        if self.metrics is not None:
+            self.metrics.count(name)
+
+    async def _request(self, peer: str, method: str, path: str,
+                       params: Optional[dict] = None,
+                       body: Optional[dict] = None,
+                       replica: bool = False,
+                       idempotent: bool = False):
+        """One ring hop to `peer` via the pooled client, off-loop.
+        ``idempotent`` lets upsert-shaped POSTs (create/update mirrors
+        and proxies) ride pooled keep-alive sockets — dialing a fresh
+        connection per mirrored create was the dominant ring-write
+        cost; a stale-socket re-send just re-applies the upsert."""
+        if await faults.fire_async("ring.proxy"):
+            raise ConnectionResetError(f"injected ring.proxy drop "
+                                       f"to {peer}")
+        url = f"{peer}{path}"
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        headers = {RING_HOP_HEADER: "1"}
+        if replica:
+            headers[RING_REPLICA_HEADER] = "1"
+        # trace id + priority class are contextvars, which do NOT cross
+        # the executor hop below — capture them into the headers here
+        # on the loop (HttpPool's own executor-side injects are no-ops
+        # for keys already present), or a CLASS_BG caller's handoff
+        # push would arrive untagged and dodge admission at the peer
+        from .. import observe, overload
+        observe.inject(headers)
+        overload.inject(headers)
+        data = None
+        if body is not None:
+            data = json.dumps(body).encode()
+            headers["Content-Type"] = "application/json"
+        self._count("ring_proxy_requests")
+        return await asyncio.get_event_loop().run_in_executor(
+            None, lambda: self.pool.request(method, url, body=data,
+                                            headers=headers,
+                                            timeout=self.timeout,
+                                            idempotent=idempotent))
+
+    async def call_owner(self, directory: str, method: str, path: str,
+                         params: Optional[dict] = None,
+                         body: Optional[dict] = None,
+                         read_fallback: bool = False,
+                         idempotent: bool = False) -> dict:
+        """Execute one meta op on the directory's owner; with
+        ``read_fallback`` walk down the replica list when the owner is
+        unreachable (reads stay available through a peer kill)."""
+        targets = [p for p in self.ring.owners(directory)
+                   if p != self.self_url]
+        if not targets:
+            raise RingProxyError(f"no ring owner for {directory}")
+        if not read_fallback:
+            targets = targets[:1]
+        last: Optional[Exception] = None
+        for peer in targets:
+            try:
+                resp = await self._request(peer, method, path,
+                                           params=params, body=body,
+                                           idempotent=idempotent)
+            except (ConnectionError, OSError, TimeoutError) as e:
+                last = e
+                continue
+            self.proxied += 1
+            try:
+                out = resp.json()
+            except ValueError:
+                out = {}
+            if resp.status >= 500:
+                last = RingProxyError(
+                    f"{peer}{path}: HTTP {resp.status}",
+                    status=resp.status, body=out)
+                continue
+            if resp.status >= 400:
+                raise RingProxyError(f"{peer}{path}: HTTP {resp.status}",
+                                     status=resp.status, body=out)
+            return out
+        raise RingProxyError(f"ring owner unreachable for {directory}: "
+                             f"{last}", body={"error": str(last)})
+
+    async def mirror(self, directory: str, path: str,
+                     body: dict, idempotent: bool = False) -> None:
+        """Mirror one applied mutation to the ring successors,
+        synchronously (the ack must imply the replica holds the copy) —
+        but a down successor degrades to a warning, not a failed user
+        op: the background handoff re-establishes the count when the
+        ring membership catches up with reality."""
+        targets = self.mirror_targets(directory)
+        if not targets:
+            return
+
+        async def one(peer: str) -> None:
+            try:
+                resp = await self._request(peer, "POST", path,
+                                           body=body, replica=True,
+                                           idempotent=idempotent)
+                if resp.status >= 400:
+                    raise RingProxyError(f"HTTP {resp.status}",
+                                         status=resp.status)
+                self.mirrored += 1
+                self._count("ring_mirrors")
+            except Exception as e:
+                self.mirror_failures += 1
+                self._count("ring_mirror_failures")
+                log.warning("ring mirror of %s to %s failed: %s",
+                            directory, peer, e)
+
+        await asyncio.gather(*[one(p) for p in targets])
+
+    # --- typed meta ops (the /__meta__ wire face) ---
+
+    async def find_entry(self, path: str) -> Optional[Entry]:
+        directory = path.rstrip("/").rsplit("/", 1)[0] or "/"
+        try:
+            out = await self.call_owner(directory, "GET",
+                                        "/__meta__/lookup",
+                                        params={"path": path},
+                                        read_fallback=True)
+        except RingProxyError as e:
+            if e.status == 404:
+                return None
+            raise
+        return Entry.from_json(json.dumps(out))
+
+    async def list_directory(self, dir_path: str, start: str = "",
+                             include_start: bool = False,
+                             limit: int = 1024,
+                             prefix: str = "") -> list[Entry]:
+        out = await self.call_owner(
+            dir_path, "GET", "/__meta__/list",
+            params={"dir": dir_path, "start": start,
+                    "include_start": "true" if include_start else "false",
+                    "limit": str(limit), "prefix": prefix},
+            read_fallback=True)
+        return [Entry.from_json(json.dumps(e))
+                for e in out.get("entries", [])]
+
+    async def create_entry(self, entry: Entry, o_excl: bool = False,
+                           signatures: tuple = (),
+                           free_old_chunks: bool = True) -> None:
+        await self.call_owner(
+            entry.parent, "POST", "/__meta__/create_entry",
+            body={"entry": json.loads(entry.to_json()),
+                  "o_excl": o_excl, "signatures": list(signatures),
+                  "free_old_chunks": free_old_chunks},
+            # an upsert re-sent over a stale pooled socket re-applies
+            # harmlessly (o_excl creates excepted — those must not
+            # double-send a conflict)
+            idempotent=not o_excl)
+
+    async def update_entry(self, entry: Entry,
+                           signatures: tuple = ()) -> None:
+        await self.call_owner(
+            entry.parent, "POST", "/__meta__/update_entry",
+            body={"entry": json.loads(entry.to_json()),
+                  "signatures": list(signatures)},
+            idempotent=True)
+
+    async def delete_entry(self, path: str, recursive: bool = False,
+                           free_chunks: bool = True,
+                           signatures: tuple = ()) -> None:
+        directory = path.rstrip("/").rsplit("/", 1)[0] or "/"
+        await self.call_owner(
+            directory, "POST", "/__meta__/delete",
+            body={"path": path, "recursive": recursive,
+                  "free_chunks": free_chunks,
+                  "signatures": list(signatures)})
+
+    def status(self) -> dict:
+        return {"self": self.self_url, "ring": self.ring.to_dict(),
+                "proxied": self.proxied, "mirrored": self.mirrored,
+                "mirror_failures": self.mirror_failures}

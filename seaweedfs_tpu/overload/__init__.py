@@ -48,6 +48,14 @@ import contextvars
 
 PRIORITY_HEADER = "X-Seaweed-Priority"
 SHED_HEADER = "X-Seaweed-Shed"
+# a metaring proxy/mirror hop between filer peers: the request was
+# already classified and admitted at the edge peer, so the receiving
+# peer classifies it system — metering it again would double-charge one
+# user request and could deadlock a full ring under per-class caps.
+# Honored only when the surface opts in AND the sender is a known ring
+# peer (admission_middleware's ring_hop predicate) — an external client
+# spoofing the header still meters as ordinary traffic.
+RING_HOP_HEADER = "X-Seaweed-Ring-Hop"
 
 CLASS_FG = "fg"
 CLASS_BG = "bg"
@@ -90,6 +98,7 @@ MASTER_SYSTEM_PATHS = OPS_PATHS | {
     "/cluster/unlock", "/cluster/raft/vote", "/cluster/raft/append",
     "/ec/scrub_report", "/vol/heat", "/vol/heat/report",
     "/lifecycle/status", "/lifecycle/run", "/geo/status", "/geo/run",
+    "/dir/ring", "/dir/ring/join", "/dir/ring/leave",
 }
 # volume fids always contain "," so these can't collide with data paths
 VOLUME_SYSTEM_PATHS = OPS_PATHS | {"/admin/faults", "/ui", "/status",
@@ -128,6 +137,12 @@ _priority: contextvars.ContextVar[str] = contextvars.ContextVar(
 def current_priority() -> str:
     """The ambient priority class ('' when unset = foreground)."""
     return _priority.get()
+
+
+def is_bg(header_value: str) -> bool:
+    """Whether a priority-header value names the background class."""
+    return bool(header_value) and \
+        header_value.strip().lower() in _BG_VALUES
 
 
 def set_priority(cls: str) -> contextvars.Token:
@@ -227,13 +242,14 @@ from .admission import (AdmissionController, ShedError,  # noqa: E402
                         admission_middleware, healthz_handler)
 
 __all__ = [
-    "PRIORITY_HEADER", "SHED_HEADER", "CLASS_FG", "CLASS_BG",
+    "PRIORITY_HEADER", "SHED_HEADER", "RING_HOP_HEADER",
+    "CLASS_FG", "CLASS_BG",
     "CLASS_SYSTEM", "SYSTEM_PATHS", "SYSTEM_PREFIXES",
     "OPS_PATHS", "OPS_PREFIXES", "MASTER_SYSTEM_PATHS",
     "VOLUME_SYSTEM_PATHS", "FILER_SYSTEM_PATHS",
     "GATEWAY_SYSTEM_PATHS", "faults_admin_paths",
     "current_priority", "set_priority", "reset_priority", "priority",
-    "inject", "classify", "tenant_from_request", "reserve_ops",
+    "inject", "classify", "is_bg", "tenant_from_request", "reserve_ops",
     "TokenBucket", "TenantBuckets", "LoopLagSampler",
     "AdmissionController", "ShedError", "admission_middleware",
     "healthz_handler",

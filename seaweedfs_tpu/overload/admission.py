@@ -409,7 +409,7 @@ def _shed_web_response(err: ShedError):
 
 
 def admission_middleware(controller: AdmissionController,
-                         internal_token=None):
+                         internal_token=None, ring_hop=None):
     """aiohttp middleware classifying, metering and bounding every
     request.  ``internal_token``: zero-arg callable returning the
     process's fastpath loopback secret — requests proxied from the
@@ -420,11 +420,23 @@ def admission_middleware(controller: AdmissionController,
     pre-admitted and meter here like any other request, so a client
     can't dodge the concurrency caps by adding Transfer-Encoding:
     chunked; metering request-scoped here (not connection-scoped at
-    the listener) also means an idle keep-alive tunnel pins no slot."""
+    the listener) also means an idle keep-alive tunnel pins no slot.
+
+    ``ring_hop``: predicate(request) -> bool identifying a metaring
+    proxy/mirror hop from a known ring peer — already admitted at the
+    edge peer, so it classifies system here (metering it again would
+    double-charge one user request; under per-class caps a full ring
+    of mutually-proxying peers could even deadlock).  The predicate
+    owns the spoof check (peer-IP match), not just the header."""
     from aiohttp import web
 
     @web.middleware
     async def admission_mw(request: web.Request, handler):
+        if ring_hop is not None and ring_hop(request):
+            # distinct family (not admission_admitted): operators need
+            # internal ring traffic separable from edge admissions
+            controller._count("admission_ring_hop", CLASS_SYSTEM)
+            return await handler(request)
         if internal_token is not None:
             tok = internal_token()
             if (tok and request.headers.get("X-Swfs-Internal") == tok

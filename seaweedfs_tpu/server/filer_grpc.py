@@ -94,40 +94,39 @@ class FilerGrpcServicer:
         path = request.directory.rstrip("/")
         if request.name:
             path = f"{path}/{request.name}"
-        entry = await _run(lambda: self.filer.find_entry(path or "/"))
+        try:
+            # ring-aware facade: owner-routed when the metaring is on,
+            # the plain local filer otherwise
+            entry = await self.fs.ring_find(path or "/")
+        except FileNotFoundError:
+            entry = None
         if entry is None:
             return pb.EntryResponse(error="not found")
         return pb.EntryResponse(entry=entry_to_pb(entry))
 
     async def ListEntries(self, request: pb.ListEntriesRequest, context):
-        entries = await _run(lambda: self.filer.list_directory(
+        entries = await self.fs.ring_list(
             request.directory, request.start_from_file_name,
             request.inclusive_start_from, request.limit or 1024,
-            request.prefix))
+            request.prefix)
         for e in entries:
             yield pb.EntryResponse(entry=entry_to_pb(e))
 
     async def CreateEntry(self, request: pb.EntryRequest, context):
         entry = entry_from_pb(request.entry)
-        old = await _run(lambda: self.filer.find_entry(entry.full_path))
         try:
-            await _run(lambda: self.filer.create_entry(
-                entry, o_excl=request.o_excl))
+            # the facade frees replaced chunks hard-link-aware on the
+            # owning peer (ring) or locally (ring off)
+            await self.fs.ring_create(entry, o_excl=request.o_excl)
         except FileExistsError:
             return _err("exists")
         except (IsADirectoryError, NotADirectoryError) as e:
             return _err(e)
-        # hard-link aware: replaced chunks stay if other links remain
-        new_fids = {c.fid for c in entry.chunks}
-        self.fs._queue_chunk_deletes(
-            [c for c in self.filer.freeable_replaced_chunks(old)
-             if c.fid not in new_fids])
         return _ok()
 
     async def UpdateEntry(self, request: pb.EntryRequest, context):
         try:
-            await _run(lambda: self.filer.update_entry(
-                entry_from_pb(request.entry)))
+            await self.fs.ring_update(entry_from_pb(request.entry))
             return _ok()
         except FileNotFoundError:
             return _err("not found")
@@ -144,8 +143,10 @@ class FilerGrpcServicer:
         holder[1] += 1
         try:
             async with holder[0]:
-                entry = await _run(
-                    lambda: self.filer.find_entry(request.path))
+                try:
+                    entry = await self.fs.ring_find(request.path)
+                except FileNotFoundError:
+                    entry = None
                 if entry is None:
                     return _err("not found")
                 offset = entry.size()
@@ -156,7 +157,7 @@ class FilerGrpcServicer:
                         is_chunk_manifest=c.is_chunk_manifest,
                         cipher_key=c.cipher_key))
                     offset += c.size
-                await _run(lambda: self.filer.update_entry(entry))
+                await self.fs.ring_update(entry)
         finally:
             holder[1] -= 1
             if holder[1] == 0:
@@ -165,9 +166,14 @@ class FilerGrpcServicer:
 
     async def DeleteEntry(self, request: pb.DeleteEntryRequest, context):
         try:
-            await _run(lambda: self.filer.delete_entry(
-                request.path, recursive=request.is_recursive,
-                free_chunks=request.is_delete_data))
+            if self.fs._ring_on():
+                await self.fs.ring_delete_entry_point(
+                    request.path, recursive=request.is_recursive,
+                    free_chunks=request.is_delete_data)
+            else:
+                await _run(lambda: self.filer.delete_entry(
+                    request.path, recursive=request.is_recursive,
+                    free_chunks=request.is_delete_data))
             return _ok()
         except FileNotFoundError as e:
             if request.ignore_recursive_error:
@@ -179,8 +185,12 @@ class FilerGrpcServicer:
     async def AtomicRenameEntry(self, request: pb.RenameEntryRequest,
                                 context):
         try:
-            await _run(lambda: self.filer.rename(request.old_path,
-                                                 request.new_path))
+            if self.fs._ring_on():
+                await self.fs.ring_coordinator.rename(request.old_path,
+                                                      request.new_path)
+            else:
+                await _run(lambda: self.filer.rename(request.old_path,
+                                                     request.new_path))
             return _ok()
         except FileNotFoundError as e:
             return _err(e)

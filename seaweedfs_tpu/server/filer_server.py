@@ -82,7 +82,8 @@ class FilerServer:
                  cipher: bool = False,
                  grpc_port: int = 0,
                  tls=None,
-                 url: str = ""):
+                 url: str = "",
+                 ring_config=None):
         # comma-separated HA master list; rotates on failure like the
         # Client/VolumeServer (wdclient/masterclient.go)
         self.masters = [m.strip() for m in master_url.split(",")
@@ -167,6 +168,50 @@ class FilerServer:
             "filer", metrics=self.metrics,
             system_paths=(overload.FILER_SYSTEM_PATHS
                           | overload.faults_admin_paths()))
+        # --- metadata scale-out ring (metaring/) ---
+        # off unless peers are configured; when on, every namespace op
+        # routes to the parent directory's ring owner, writes mirror to
+        # successors, remote mutations sweep the local entry cache, and
+        # ring changes trigger the background partition handoff
+        from ..metaring import RingConfig
+        self.ring_cfg = ring_config or RingConfig.from_env()
+        self.ring = None
+        self.ring_router = None
+        self.ring_coordinator = None
+        self.ring_invalidator = None
+        self.ring_handoff = None
+        self._ring_peer_ips: set = set()
+        if self.ring_cfg.enabled:
+            from ..cluster.raft import _endpoint_ips
+            from ..metaring import DirectoryRing
+            from ..metaring.coordinator import FanoutCoordinator
+            from ..metaring.invalidation import PeerInvalidator
+            from ..metaring.router import RingRouter
+            from ..metaring.handoff import HandoffRunner
+            self.ring = DirectoryRing(peers=self.ring_cfg.peers,
+                                      vnodes=self.ring_cfg.vnodes,
+                                      replicas=self.ring_cfg.replicas)
+            self.ring_router = RingRouter(self.ring, self.url,
+                                          metrics=self.metrics)
+            self.ring_coordinator = FanoutCoordinator(self)
+            self.ring_invalidator = PeerInvalidator(
+                self, lambda: [p for p in self.ring.peers
+                               if p != self.url])
+            self.ring_handoff = HandoffRunner(self, self.ring_router)
+            for p in self.ring_cfg.peers:
+                self._ring_peer_ips |= _endpoint_ips(p)[0]
+            # directory-EXISTENCE cache for ring parent checks: the
+            # entry cache's global generation churns on every file
+            # create, so parent lookups could never stay cached under
+            # write load (each create would pay a proxied probe).
+            # Directory lifecycle is orders slower than file churn —
+            # a dedicated TTL'd set, swept on directory events (local
+            # and cross-peer), restores O(1) parent checks.
+            from ..cache import TTLCache
+            self._ring_dir_cache = TTLCache(ttl=5.0, max_entries=8192,
+                                            metrics=self.metrics,
+                                            name="ringdir")
+            self.filer.meta_log.subscribe(self._ring_dir_event)
         self.app = self._build_app()
 
     def _build_app(self) -> web.Application:
@@ -177,7 +222,11 @@ class FilerServer:
         app = web.Application(
             client_max_size=1024 * 1024 * 1024,
             middlewares=[observe.trace_middleware("filer", self.url),
-                         overload.admission_middleware(self.admission)])
+                         overload.admission_middleware(
+                             self.admission,
+                             ring_hop=(self._is_ring_hop
+                                       if self.ring is not None
+                                       else None))])
         # ops routes go through overload.reserve_ops: reserved for ALL
         # methods, or `PUT /healthz` falls through to the path catch-all
         # as a never-metered system-classified file write
@@ -211,6 +260,7 @@ class FilerServer:
         overload.reserve_ops(app, "/__meta__/subscribe",
                              self.meta_subscribe)
         app.router.add_get("/__meta__/info", self.meta_info)
+        app.router.add_get("/__meta__/ring/status", self.meta_ring_status)
         app.router.add_get("/__meta__/brokers", self.meta_brokers)
         app.router.add_get("/__meta__/assign", self.meta_assign)
         app.router.add_get("/__meta__/lookup_volume", self.meta_lookup_volume)
@@ -221,21 +271,363 @@ class FilerServer:
         app.on_cleanup.append(self._on_cleanup)
         return app
 
+    # --- metaring facade: owner-routed namespace ops -------------------
+    #
+    # Every namespace mutation/lookup flows through these coroutines.
+    # Ring off: straight to the local Filer (existing behavior).  Ring
+    # on: the parent directory's owner executes (proxy hop via the
+    # pooled client when that is a peer), the owner mirrors to its ring
+    # successors, and proxied lookups populate the LOCAL entry cache
+    # under the PR 2 generation guard so the cross-peer invalidation
+    # sweep keeps them honest.
+
+    _MISS = object()
+
+    def _ring_on(self) -> bool:
+        return self.ring is not None
+
+    async def _exec(self, fn):
+        return await asyncio.get_event_loop().run_in_executor(None, fn)
+
+    def _ring_drop_cached(self, path: str, subtree: bool = False) -> None:
+        """Drop this peer's cached view of a path it just mutated
+        through a proxy hop (generation-bumping, so racing fills are
+        discarded too)."""
+        cache = self.filer._entry_cache
+        if cache is not None:
+            cache.pop(path)
+            if subtree:
+                cache.drop_prefix(path.rstrip("/") + "/")
+        if subtree:
+            self._ring_dir_cache.pop(path)
+            self._ring_dir_cache.drop_prefix(path.rstrip("/") + "/")
+
+    def _ring_dir_event(self, event) -> None:
+        """Local meta-log hook: a directory delete/move must drop the
+        ring parent-existence cache (file churn must NOT — that is the
+        cache's whole point)."""
+        old = event.old_entry
+        if old is not None and old.is_directory and (
+                event.new_entry is None
+                or event.new_entry.full_path != old.full_path):
+            self._ring_dir_cache.pop(old.full_path)
+            self._ring_dir_cache.drop_prefix(
+                old.full_path.rstrip("/") + "/")
+
+    async def _ring_ensure_parents(self, dir_path: str) -> None:
+        """Ring-aware parent auto-creation: each missing ancestor's
+        ENTRY is created on the ancestor's own partition owner (the
+        local Filer's _ensure_parents would mis-place it on whichever
+        peer handled the leaf create)."""
+        if dir_path in ("", "/"):
+            return
+        if self._ring_dir_cache.get(dir_path):
+            return
+        entry = await self.ring_find(dir_path)
+        if entry is not None:
+            if not entry.is_directory:
+                # parity with Filer._ensure_parents: creating under a
+                # FILE is a 409, ring or no ring
+                raise NotADirectoryError(dir_path)
+            self._ring_dir_cache.put(dir_path, True)
+            return
+        parent = dir_path.rsplit("/", 1)[0] or "/"
+        await self._ring_ensure_parents(parent)
+        from ..filer.entry import new_directory
+        try:
+            await self.ring_create(new_directory(dir_path),
+                                   ensure_parents=False)
+        except FileExistsError:
+            pass  # a racing create won — the directory exists
+        self._ring_dir_cache.put(dir_path, True)
+
+    async def ring_find(self, path: str):
+        from ..filer.filer import _norm
+        from ..metaring.router import RingProxyError
+        path = _norm(path)
+        if not self._ring_on():
+            return await self._exec(lambda: self.filer.find_entry(path))
+        directory = path.rsplit("/", 1)[0] or "/"
+        if self.ring_router.is_owner(directory):
+            return await self._exec(lambda: self.filer.find_entry(path))
+        if self.ring_router.is_replica(directory):
+            # replica fast path: the synchronous mirror keeps us
+            # current — EXCEPT right after a ring change made us a
+            # successor (the background handoff hasn't re-mirrored
+            # yet), so a local miss double-checks with the owner; an
+            # unreachable owner leaves the local verdict standing
+            # (read availability through a peer kill)
+            entry = await self._exec(
+                lambda: self.filer.find_entry(path))
+            if entry is not None:
+                return entry
+            try:
+                return await self._ring_map(
+                    self.ring_router.find_entry(path))
+            except (RingProxyError, FileNotFoundError):
+                return None
+        cache = self.filer._entry_cache
+        if cache is not None:
+            hit = cache.get(path, self._MISS)
+            if hit is not self._MISS:
+                return hit
+            gen = cache.generation
+        entry = await self._ring_map(
+            self.ring_router.find_entry(path))
+        if cache is not None:
+            # generation-guarded fill: a sweep from the owner's
+            # broadcast between read and fill discards this value
+            cache.put_if_fresh(path, entry, gen)
+        return entry
+
+    async def ring_list(self, dir_path: str, start: str = "",
+                        include_start: bool = False, limit: int = 1024,
+                        prefix: str = "") -> list:
+        from ..metaring.router import RingProxyError
+        if not self._ring_on() or self.ring_router.is_owner(dir_path):
+            return await self._exec(
+                lambda: self.filer.list_directory(
+                    dir_path, start, include_start, limit, prefix))
+        if self.ring_router.is_replica(dir_path):
+            out = await self._exec(
+                lambda: self.filer.list_directory(
+                    dir_path, start, include_start, limit, prefix))
+            if out:
+                return out
+            # empty local view may be the new-successor gap: ask the
+            # owner; if it's down, empty is the best available answer
+            try:
+                return await self._ring_map(
+                    self.ring_router.list_directory(
+                        dir_path, start, include_start, limit, prefix))
+            except (RingProxyError, FileNotFoundError):
+                return out
+        return await self._ring_map(self.ring_router.list_directory(
+            dir_path, start, include_start, limit, prefix))
+
+    async def ring_create(self, entry, o_excl: bool = False,
+                          signatures: tuple = (),
+                          free_old_chunks: bool = True,
+                          force_local: bool = False,
+                          mirror: bool = True,
+                          ensure_parents: bool = True) -> None:
+        if not self._ring_on():
+            await self._local_create(entry, o_excl, signatures,
+                                     free_old_chunks)
+            return
+        if ensure_parents:
+            await self._ring_ensure_parents(entry.parent)
+        directory = entry.parent
+        if not force_local and not self.ring_router.is_owner(directory):
+            await self._ring_map(self.ring_router.create_entry(
+                entry, o_excl=o_excl, signatures=signatures,
+                free_old_chunks=free_old_chunks))
+            # read-your-writes at the proxying edge: THIS peer may have
+            # cached a negative lookup moments ago (the PUT path's
+            # old-entry probe); the owner's broadcast sweep is async,
+            # so drop our copy NOW or our own client reads a stale 404
+            self._ring_drop_cached(entry.full_path)
+            return
+        def mirror_coro():
+            # the owner's signature rides the mirror: the replica's
+            # re-emitted event then carries it, so the owner's own
+            # invalidator recognizes the echo and skips a redundant
+            # generation-bumping sweep of its own write
+            return self.ring_router.mirror(
+                directory, "/__meta__/create_entry",
+                {"entry": json.loads(entry.to_json()), "o_excl": False,
+                 "signatures": list(signatures)
+                 + [self.filer.signature],
+                 "free_old_chunks": False}, idempotent=True)
+
+        if not mirror or not self.ring_router.mirror_targets(directory):
+            # no successors (replicas=1 or a one-peer ring): plain
+            # local apply — gather() would spin up two tasks per create
+            # for nothing
+            await self._local_create(entry, o_excl, signatures,
+                                     free_old_chunks)
+        elif o_excl:
+            # conflict-shaped create: the replica copy must not land
+            # before the owner's exclusivity verdict
+            await self._local_create(entry, o_excl, signatures,
+                                     free_old_chunks)
+            await mirror_coro()
+        else:
+            # owner apply and successor mirror overlap — the ack still
+            # waits on BOTH (the zero-loss contract), but the replica
+            # round trip no longer serializes behind the local store
+            await asyncio.gather(
+                self._local_create(entry, o_excl, signatures,
+                                   free_old_chunks),
+                mirror_coro())
+
+    async def ring_update(self, entry, signatures: tuple = (),
+                          force_local: bool = False,
+                          mirror: bool = True) -> None:
+        if not self._ring_on():
+            await self._exec(lambda: self.filer.update_entry(
+                entry, signatures=signatures))
+            return
+        directory = entry.parent
+        if not force_local and not self.ring_router.is_owner(directory):
+            await self._ring_map(self.ring_router.update_entry(
+                entry, signatures=signatures))
+            self._ring_drop_cached(entry.full_path)
+            return
+        local = self._exec(lambda: self.filer.update_entry(
+            entry, signatures=signatures))
+        if not mirror:
+            await local
+            return
+        await asyncio.gather(
+            local,
+            self.ring_router.mirror(
+                directory, "/__meta__/update_entry",
+                {"entry": json.loads(entry.to_json()),
+                 "signatures": list(signatures)
+                 + [self.filer.signature]}, idempotent=True))
+
+    async def ring_delete(self, path: str, recursive: bool = False,
+                          free_chunks: bool = True,
+                          signatures: tuple = (),
+                          force_local: bool = False,
+                          mirror: bool = True) -> None:
+        if not self._ring_on():
+            await self._exec(lambda: self.filer.delete_entry(
+                path, recursive=recursive, free_chunks=free_chunks,
+                signatures=signatures))
+            return
+        directory = path.rstrip("/").rsplit("/", 1)[0] or "/"
+        if not force_local and not self.ring_router.is_owner(directory):
+            await self._ring_map(self.ring_router.delete_entry(
+                path, recursive=recursive, free_chunks=free_chunks,
+                signatures=signatures))
+            self._ring_drop_cached(path, subtree=recursive)
+            return
+        await self._exec(lambda: self.filer.delete_entry(
+            path, recursive=recursive, free_chunks=free_chunks,
+            signatures=signatures))
+        if mirror:
+            await self.ring_router.mirror(
+                directory, "/__meta__/delete",
+                {"path": path, "recursive": recursive,
+                 # replicas never free chunks: the owner's deletion
+                 # queue owns the blob side, a mirror freeing too
+                 # would double-delete fids
+                 "free_chunks": False,
+                 "signatures": list(signatures)
+                 + [self.filer.signature]})
+
+    async def ring_delete_entry_point(self, path: str,
+                                      recursive: bool = False,
+                                      free_chunks: bool = True,
+                                      signatures: tuple = ()) -> None:
+        """Edge-originated delete in ring mode.  The emptiness check
+        must ask the DIRECTORY's owner (children live there), not the
+        parent's owner — and a populated subtree fans out under the
+        coordinator so every partition's share goes with it."""
+        from ..filer.filer import _norm
+        path = _norm(path)
+        entry = await self.ring_find(path)
+        if entry is None:
+            raise FileNotFoundError(path)
+        if entry.is_directory:
+            children = await self.ring_list(path, limit=2)
+            if children and not recursive:
+                raise OSError(f"directory {path} not empty")
+            if children:
+                await self.ring_coordinator.delete_subtree(
+                    path, free_chunks=free_chunks,
+                    signatures=signatures)
+                return
+        await self.ring_delete(path, recursive=recursive,
+                               free_chunks=free_chunks,
+                               signatures=signatures)
+
+    async def _ring_map(self, awaitable):
+        """Translate proxied HTTP verdicts back into the local
+        exception vocabulary the handlers (and coordinator) speak."""
+        from ..metaring.router import RingProxyError
+        try:
+            return await awaitable
+        except RingProxyError as e:
+            err = (e.body or {}).get("error", "")
+            if e.status == 404:
+                raise FileNotFoundError(err or "not found") from e
+            if e.status == 409:
+                if err == "exists":
+                    raise FileExistsError(err) from e
+                raise OSError(err or "conflict") from e
+            raise
+
+    async def _local_create(self, entry, o_excl: bool,
+                            signatures: tuple,
+                            free_old_chunks: bool) -> None:
+        # the pre-lookup exists only to free a replaced entry's chunks;
+        # replica mirrors and handoff upserts pass free_old_chunks=False
+        # and skip the extra store round trip entirely
+        old = await self._exec(
+            lambda: self.filer.find_entry(entry.full_path)) \
+            if free_old_chunks else None
+        await self._exec(lambda: self.filer.create_entry(
+            entry, o_excl=o_excl, signatures=signatures,
+            # ring mode: ancestors were created through the ring (each
+            # on its own partition owner) — never auto-create locally
+            ensure_parents=not self._ring_on()))
+        if free_old_chunks:
+            # hard-link aware: replaced chunks stay if other links remain
+            new_fids = {c.fid for c in entry.chunks}
+            self._queue_chunk_deletes(
+                [c for c in self.filer.freeable_replaced_chunks(old)
+                 if c.fid not in new_fids])
+
+    def _hop_flags(self, request: web.Request) -> tuple[bool, bool]:
+        from ..metaring.router import (RING_HOP_HEADER,
+                                       RING_REPLICA_HEADER)
+        return (request.headers.get(RING_HOP_HEADER) == "1",
+                request.headers.get(RING_REPLICA_HEADER) == "1")
+
+    def _is_ring_hop(self, request: web.Request) -> bool:
+        """Admission predicate: a hop-marked request from a known ring
+        peer was classified and admitted at the edge peer already.
+        BACKGROUND-tagged hops (handoff pushes, daemon-originated
+        proxies) are excluded — they were never admitted at any edge,
+        so they must meter (and shed) as bg here like any other
+        background traffic."""
+        return (request.headers.get(overload.RING_HOP_HEADER) == "1"
+                and (request.remote or "") in self._ring_peer_ips
+                and not overload.is_bg(
+                    request.headers.get(overload.PRIORITY_HEADER, "")))
+
     # --- meta API handlers ---
     async def meta_lookup(self, request: web.Request) -> web.Response:
-        entry = await asyncio.get_event_loop().run_in_executor(
-            None, self.filer.find_entry, request.query["path"])
+        hop, _ = self._hop_flags(request)
+        if self._ring_on() and not hop:
+            try:
+                entry = await self.ring_find(request.query["path"])
+            except FileNotFoundError:
+                entry = None
+        else:
+            entry = await self._exec(lambda: self.filer.find_entry(
+                request.query["path"]))
         if entry is None:
             return web.json_response({"error": "not found"}, status=404)
         return web.json_response(json.loads(entry.to_json()))
 
     async def meta_list(self, request: web.Request) -> web.Response:
         q = request.query
-        entries = await asyncio.get_event_loop().run_in_executor(
-            None, lambda: self.filer.list_directory(
+        hop, _ = self._hop_flags(request)
+        if self._ring_on() and not hop:
+            entries = await self.ring_list(
                 q["dir"], q.get("start", ""),
                 q.get("include_start") == "true",
-                int(q.get("limit", 1024)), q.get("prefix", "")))
+                int(q.get("limit", 1024)), q.get("prefix", ""))
+        else:
+            entries = await self._exec(
+                lambda: self.filer.list_directory(
+                    q["dir"], q.get("start", ""),
+                    q.get("include_start") == "true",
+                    int(q.get("limit", 1024)), q.get("prefix", "")))
         return web.json_response(
             {"entries": [json.loads(e.to_json()) for e in entries]})
 
@@ -245,33 +637,36 @@ class FilerServer:
         # filer ids that already processed this mutation (loop
         # prevention for filer.sync and the geo replication plane)
         sigs = tuple(int(s) for s in body.get("signatures") or ())
-        old = await asyncio.get_event_loop().run_in_executor(
-            None, self.filer.find_entry, entry.full_path)
+        hop, replica = self._hop_flags(request)
         try:
-            await asyncio.get_event_loop().run_in_executor(
-                None, lambda: self.filer.create_entry(
+            if self._ring_on():
+                await self.ring_create(
                     entry, o_excl=body.get("o_excl", False),
-                    signatures=sigs))
+                    signatures=sigs,
+                    free_old_chunks=body.get("free_old_chunks", True),
+                    force_local=hop, mirror=not replica,
+                    # the edge peer ensured ancestors before proxying
+                    ensure_parents=not (hop or replica))
+            else:
+                await self._local_create(
+                    entry, body.get("o_excl", False), sigs,
+                    body.get("free_old_chunks", True))
         except FileExistsError:
             return web.json_response({"error": "exists"}, status=409)
         except (IsADirectoryError, NotADirectoryError) as e:
             return web.json_response({"error": str(e)}, status=409)
-        if body.get("free_old_chunks", True):
-            # hard-link aware: replaced chunks stay if other links remain
-            new_fids = {c.fid for c in entry.chunks}
-            self._queue_chunk_deletes(
-                [c for c in self.filer.freeable_replaced_chunks(old)
-                 if c.fid not in new_fids])
         return web.json_response({"ok": True})
 
     async def meta_update(self, request: web.Request) -> web.Response:
         body = await request.json()
         entry = Entry.from_json(json.dumps(body["entry"]))
         sigs = tuple(int(s) for s in body.get("signatures") or ())
+        hop, replica = self._hop_flags(request)
         try:
-            await asyncio.get_event_loop().run_in_executor(
-                None, lambda: self.filer.update_entry(entry,
-                                                      signatures=sigs))
+            await self.ring_update(entry, signatures=sigs,
+                                   force_local=hop,
+                                   mirror=self._ring_on()
+                                   and not replica)
         except FileNotFoundError:
             return web.json_response({"error": "not found"}, status=404)
         return web.json_response({"ok": True})
@@ -279,12 +674,21 @@ class FilerServer:
     async def meta_delete(self, request: web.Request) -> web.Response:
         body = await request.json()
         sigs = tuple(int(s) for s in body.get("signatures") or ())
+        hop, replica = self._hop_flags(request)
         try:
-            await asyncio.get_event_loop().run_in_executor(
-                None, lambda: self.filer.delete_entry(
-                    body["path"], recursive=body.get("recursive", False),
+            if self._ring_on() and not hop:
+                await self.ring_delete_entry_point(
+                    body["path"],
+                    recursive=body.get("recursive", False),
                     free_chunks=body.get("free_chunks", True),
-                    signatures=sigs))
+                    signatures=sigs)
+            else:
+                await self.ring_delete(
+                    body["path"],
+                    recursive=body.get("recursive", False),
+                    free_chunks=body.get("free_chunks", True),
+                    signatures=sigs, force_local=hop,
+                    mirror=self._ring_on() and not replica)
         except FileNotFoundError:
             return web.json_response({"error": "not found"}, status=404)
         except OSError as e:
@@ -293,12 +697,47 @@ class FilerServer:
 
     async def meta_rename(self, request: web.Request) -> web.Response:
         body = await request.json()
+        hop, _ = self._hop_flags(request)
         try:
-            await asyncio.get_event_loop().run_in_executor(
-                None, lambda: self.filer.rename(body["from"], body["to"]))
+            if self._ring_on() and not hop:
+                await self.ring_coordinator.rename(body["from"],
+                                                   body["to"])
+            else:
+                await self._exec(lambda: self.filer.rename(
+                    body["from"], body["to"]))
         except FileNotFoundError:
             return web.json_response({"error": "not found"}, status=404)
         return web.json_response({"ok": True})
+
+    async def meta_ring_status(self, request: web.Request) -> web.Response:
+        """Per-peer ring state: membership view, proxy/mirror counters,
+        handoff progress, invalidation sweeps, local partition counts
+        (the `filer.ring.status` shell command's backend)."""
+        if not self._ring_on():
+            return web.json_response({"enabled": False})
+        loop = asyncio.get_event_loop()
+        try:
+            local_dirs = await loop.run_in_executor(
+                None,
+                lambda: list(self.filer.store.iter_directories()))
+        except NotImplementedError:
+            # store can't enumerate (also means no handoff support) —
+            # the rest of the status is still worth serving
+            local_dirs = None
+        owned = (sum(1 for d in local_dirs
+                     if self.ring_router.is_owner(d))
+                 if local_dirs is not None else None)
+        return web.json_response({
+            "enabled": True,
+            "self": self.url,
+            "ring": self.ring.to_dict(),
+            "router": self.ring_router.status(),
+            "handoff": self.ring_handoff.status(),
+            "invalidation": self.ring_invalidator.status(),
+            "local_dirs": (len(local_dirs)
+                           if local_dirs is not None else None),
+            "owned_dirs": owned,
+        })
 
     async def meta_events(self, request: web.Request) -> web.Response:
         """Poll-based metadata subscription (SubscribeMetadata analog)."""
@@ -403,33 +842,38 @@ class FilerServer:
                 return not (exclude_sig and exclude_sig in e.signatures)
 
             seen = set()
-            # replay: disk segment first, then the memory tail
+            # replay: disk segment first, then the memory tail (wire()
+            # serializes each event once across every subscriber)
             for e in self.filer.meta_log.read_persisted_since(since, prefix):
                 seen.add(e.tsns)
                 if admit(e):
-                    await resp.write(
-                        json.dumps(e.to_dict(), separators=(",", ":"))
-                        .encode() + b"\n")
+                    await resp.write(e.wire())
             for e in self.filer.meta_log.events_since(since, prefix):
                 if e.tsns in seen:
                     continue
                 seen.add(e.tsns)
                 if admit(e):
-                    await resp.write(
-                        json.dumps(e.to_dict(), separators=(",", ":"))
-                        .encode() + b"\n")
+                    await resp.write(e.wire())
             # live tail; `seen` stays (bounded by replay size) so events
             # that raced into both the replay and the queue never
-            # double-deliver
+            # double-deliver.  The queue drains greedily into ONE write
+            # per wakeup: under a write storm, per-event coroutine
+            # wakeups + socket writes were the metadata plane's largest
+            # per-mutation loop cost (ring invalidation tails multiply
+            # them by the peer count).
             while True:
-                e = await queue.get()
-                if e.tsns in seen:
-                    continue
-                if not e.directory.startswith(prefix) or not admit(e):
-                    continue
-                await resp.write(
-                    json.dumps(e.to_dict(), separators=(",", ":"))
-                    .encode() + b"\n")
+                batch = [await queue.get()]
+                while len(batch) < 256:
+                    try:
+                        batch.append(queue.get_nowait())
+                    except asyncio.QueueEmpty:
+                        break
+                payload = b"".join(
+                    e.wire() for e in batch
+                    if e.tsns not in seen
+                    and e.directory.startswith(prefix) and admit(e))
+                if payload:
+                    await resp.write(payload)
         except (ConnectionResetError, asyncio.CancelledError):
             pass
         finally:
@@ -486,6 +930,8 @@ class FilerServer:
         for peer in self.peers:
             self._aggregator_tasks.append(
                 asyncio.create_task(self._aggregate_from_peer(peer)))
+        if self.ring_invalidator is not None:
+            self.ring_invalidator.start()
 
     async def _on_cleanup(self, app) -> None:
         self.admission.stop()
@@ -497,6 +943,10 @@ class FilerServer:
             self._watch_task.cancel()
         for t in self._aggregator_tasks:
             t.cancel()
+        if self.ring_invalidator is not None:
+            self.ring_invalidator.stop()
+        if self.ring_handoff is not None:
+            self.ring_handoff.stop()
         if self._session:
             await self._session.close()
         self.filer.close()
@@ -521,13 +971,40 @@ class FilerServer:
                                 self._vid_cache.put(
                                     int(vid), [x["url"] for x in locs],
                                     pin=True)
+                            if msg.get("ring"):
+                                self._apply_ring_update(msg["ring"])
                         elif msg.get("type") == "update":
                             self._apply_location_update(msg)
+                        elif msg.get("type") == "ring":
+                            self._apply_ring_update(msg.get("ring") or {})
             except asyncio.CancelledError:
                 return
             except Exception:
                 self._master_i = (self._master_i + 1) % len(self.masters)
                 await asyncio.sleep(0.2)
+
+    def _apply_ring_update(self, ring_dict: dict) -> None:
+        """Adopt a master-pushed ring view: newer version wins (the
+        bootstrap env view is version 0, so the master's authoritative
+        membership always supersedes it once a join/leave happened).
+        A changed view re-shapes the invalidation watch set and kicks
+        the background partition handoff with the before/after pair."""
+        if self.ring is None or not ring_dict.get("peers"):
+            return
+        if ring_dict.get("version", 0) <= self.ring.version:
+            return
+        from ..cluster.raft import _endpoint_ips
+        from ..metaring import DirectoryRing
+        old = self.ring
+        new = DirectoryRing.from_dict(ring_dict)
+        self.ring = new
+        self.ring_router.ring = new
+        self._ring_peer_ips = set()
+        for p in new.peers:
+            self._ring_peer_ips |= _endpoint_ips(p)[0]
+        log.info("ring v%d adopted: %s", new.version, new.peers)
+        self.ring_invalidator.reconcile()
+        self.ring_handoff.trigger(new, old)
 
     def _apply_location_update(self, msg: dict) -> None:
         url = msg["url"]
@@ -942,8 +1419,10 @@ class FilerServer:
     async def handle_read(self, request: web.Request,
                           path: str) -> web.StreamResponse:
         self.metrics.count("read")
-        entry = await asyncio.get_event_loop().run_in_executor(
-            None, self.filer.find_entry, path)
+        try:
+            entry = await self.ring_find(path)
+        except FileNotFoundError:
+            entry = None
         if entry is None:
             return web.json_response({"error": "not found"}, status=404)
         if entry.is_directory:
@@ -1008,10 +1487,9 @@ class FilerServer:
                         path: str) -> web.Response:
         q = request.query
         limit = int(q.get("limit", 1024))
-        entries = await asyncio.get_event_loop().run_in_executor(
-            None, lambda: self.filer.list_directory(
-                path, q.get("lastFileName", ""), False, limit,
-                q.get("prefix", "")))
+        entries = await self.ring_list(
+            _norm(path), q.get("lastFileName", ""), False, limit,
+            q.get("prefix", ""))
         return web.json_response({
             "Path": _norm(path),
             "Entries": [{
@@ -1057,8 +1535,16 @@ class FilerServer:
         attempted: list[FileChunk] = []
         offset = 0
         name_hint = path.rsplit("/", 1)[-1]
-        old_entry = await asyncio.get_event_loop().run_in_executor(
-            None, self.filer.find_entry, path)
+        if self._ring_on():
+            # the OWNER's _local_create does the replaced-chunk lookup;
+            # probing here too would cost a proxied round trip per PUT
+            # for a value the ring branch below never reads
+            old_entry = None
+        else:
+            try:
+                old_entry = await self.ring_find(path)
+            except FileNotFoundError:
+                old_entry = None
 
         async def upload(index: int, data: bytes, at: int) -> FileChunk:
             return await self._upload_chunk(
@@ -1116,15 +1602,26 @@ class FilerServer:
             from ..storage.types import TTL
             entry.attr.ttl_sec = TTL.parse(ttl).minutes() * 60
         sigs = _parse_signatures(request)
-        await asyncio.get_event_loop().run_in_executor(
-            None, lambda: self.filer.create_entry(entry, signatures=sigs))
-        if request.query.get("free_old_chunks") != "false":
+        if self._ring_on():
             # ?free_old_chunks=false keeps the replaced entry's chunks
-            # alive: the S3 versioning path archives the old entry's
-            # chunk list as a sibling version entry BEFORE overwriting,
-            # so freeing here would tear the bytes out from under it
-            self._queue_chunk_deletes(
-                self.filer.freeable_replaced_chunks(old_entry))
+            # alive (S3 versioning archives them first); the owner's
+            # _local_create makes the hard-link-aware freeing call
+            await self.ring_create(
+                entry, signatures=sigs,
+                free_old_chunks=request.query.get("free_old_chunks")
+                != "false")
+        else:
+            await asyncio.get_event_loop().run_in_executor(
+                None,
+                lambda: self.filer.create_entry(entry, signatures=sigs))
+            if request.query.get("free_old_chunks") != "false":
+                # ?free_old_chunks=false keeps the replaced entry's
+                # chunks alive: the S3 versioning path archives the old
+                # entry's chunk list as a sibling version entry BEFORE
+                # overwriting, so freeing here would tear the bytes out
+                # from under it
+                self._queue_chunk_deletes(
+                    self.filer.freeable_replaced_chunks(old_entry))
         return web.json_response(
             {"name": entry.name, "size": offset,
              "chunks": len(chunks)}, status=201)
@@ -1133,16 +1630,23 @@ class FilerServer:
                            path: str) -> web.Response:
         entry = new_directory(_norm(path))
         sigs = _parse_signatures(request)
-        await asyncio.get_event_loop().run_in_executor(
-            None, lambda: self.filer.create_entry(entry, signatures=sigs))
+        await self.ring_create(entry, signatures=sigs)
         return web.json_response({"name": entry.full_path}, status=201)
 
     async def handle_rename(self, request: web.Request,
                             path: str) -> web.Response:
         to = request.query["mv.to"]
         try:
-            await asyncio.get_event_loop().run_in_executor(
-                None, self.filer.rename, path, to)
+            if self._ring_on():
+                # partitions may differ between the two parents (and
+                # for directories, between every moved subtree level):
+                # the coordinator re-creates entries at their new
+                # owners and removes the old side metadata-only
+                await self.ring_coordinator.rename(_norm(path),
+                                                   _norm(to))
+            else:
+                await asyncio.get_event_loop().run_in_executor(
+                    None, self.filer.rename, path, to)
         except FileNotFoundError:
             return web.json_response({"error": "not found"}, status=404)
         return web.json_response({"from": _norm(path), "to": _norm(to)})
@@ -1153,10 +1657,12 @@ class FilerServer:
         recursive = request.query.get("recursive") == "true"
         sigs = _parse_signatures(request)
         try:
-            await asyncio.get_event_loop().run_in_executor(
-                None, lambda: self.filer.delete_entry(path,
-                                                      recursive=recursive,
-                                                      signatures=sigs))
+            if self._ring_on():
+                await self.ring_delete_entry_point(
+                    path, recursive=recursive, signatures=sigs)
+            else:
+                await self.ring_delete(path, recursive=recursive,
+                                       signatures=sigs)
         except FileNotFoundError:
             return web.json_response({"error": "not found"}, status=404)
         except OSError as e:

@@ -33,10 +33,11 @@ from ..geo import GeoConfig
 from ..geo.daemon import GeoDaemon
 from ..lifecycle.daemon import LifecycleDaemon
 from ..lifecycle.policy import LifecycleConfig
+from ..metaring import DirectoryRing, MasterMetaLog, RingConfig
 from ..security.guard import Guard
 from ..storage.file_id import FileId, new_cookie
 from ..storage.superblock import ReplicaPlacement
-from ..topology.sequence import MemorySequencer
+from ..topology.sequence import LogSequencer
 from ..topology.topology import Topology
 from ..utils import glog, metrics as metrics_mod
 
@@ -78,14 +79,29 @@ class MasterServer:
                  ec_total_shards: int = 14,
                  ec_geometry_policy: Optional[GeometryPolicy] = None,
                  lifecycle_config: Optional[LifecycleConfig] = None,
-                 geo_config: Optional[GeoConfig] = None):
+                 geo_config: Optional[GeoConfig] = None,
+                 ring_config: Optional[RingConfig] = None):
         self.topology = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds)
-        # sequencer=None -> in-memory with the raft-replicated ceiling;
-        # an external KvSequencer (etcd_sequencer.go role) plugs in for
-        # raft-less multi-master deployments
-        self.sequencer = sequencer or MemorySequencer()
+        # the replicated metadata log (metaring/masterlog.py): assign
+        # batches, volume create/retire and EC geometry stamps ride the
+        # raft plane, so a freshly elected leader replays to the exact
+        # assignment state instead of jumping a sequencer ceiling
+        self.metalog = MasterMetaLog()
+        # sequencer=None -> the raft-replicated metadata log (the
+        # default); an external KvSequencer (etcd_sequencer.go role)
+        # plugs in for raft-less multi-master deployments and keeps the
+        # legacy ceiling sync below
+        self.sequencer = sequencer or LogSequencer(self.metalog)
+        # metadata scale-out ring: the authoritative filer-partition
+        # membership, served at /dir/ring and pushed over the
+        # KeepConnected stream; join/leave mutate it through raft so
+        # every master replica serves one view
+        rc = ring_config or RingConfig.from_env()
+        self.ring = DirectoryRing(peers=rc.peers, vnodes=rc.vnodes,
+                                  replicas=rc.replicas)
+        self._floor_inflight = False
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
         self.vacuum_interval_seconds = vacuum_interval_seconds
@@ -205,32 +221,69 @@ class MasterServer:
         self._geo_task: Optional[asyncio.Task] = None
         self.app = self._build_app()
 
-    def _raft_apply(self, cmd: dict) -> None:
-        """State machine: replicated MaxVolumeId
-        (weed/topology/cluster_commands.go:8-31) plus a needle-key
-        high-water mark so a new leader never re-mints file keys (the
-        reference recovers max_file_key from heartbeats; here followers
-        proxy heartbeats to the leader, so the bound rides the log).
+    _METALOG_CMDS = ("assign_batch", "seq_floor", "volume_create",
+                     "volume_retire", "geometry_stamp")
 
-        The bound is a CEILING only — it reaches the sequencer exclusively
-        through the post-ensure_ready sync in dir_assign, never here, so a
-        leader applying its own proposal does not leapfrog its sequencer."""
+    def _raft_apply(self, cmd: dict):
+        """State machine: replicated MaxVolumeId
+        (weed/topology/cluster_commands.go:8-31), the metadata log
+        (assign batches / volume registry / geometry stamps — exact
+        replay, metaring/masterlog.py), the filer-ring membership, and
+        the legacy needle-key ceiling (still applied so snapshots from
+        the ceiling era restore; KvSequencer deployments still sync it).
+
+        The legacy bound is a CEILING only — it reaches the sequencer
+        exclusively through the post-ensure_ready sync in dir_assign,
+        never here, so a leader applying its own proposal does not
+        leapfrog its sequencer."""
         if "max_volume_id" in cmd:
             self.topology.max_volume_id = max(self.topology.max_volume_id,
                                               cmd["max_volume_id"])
         if "max_file_key" in cmd:
             self._key_bound = max(self._key_bound, cmd["max_file_key"])
+            # ceiling-era log entries fold into the metadata log as a
+            # floor: keys below the old bound may have been handed out,
+            # so the replicated counter must start above it (same on
+            # every replica — this runs inside raft apply)
+            self.metalog.apply({"seq_floor": cmd["max_file_key"]})
+        result = None
+        if any(k in cmd for k in self._METALOG_CMDS):
+            # the chaos drill's injection site for "apply diverged":
+            # raft logs the failure and the entry is NOT re-applied —
+            # exactly the corruption class the drill exercises
+            faults.fire("master.log.apply")
+            result = self.metalog.apply(cmd)
+        if "ring_add" in cmd and self.ring.add_peer(cmd["ring_add"]):
+            self._broadcast_ring()
+        if "ring_remove" in cmd and \
+                self.ring.remove_peer(cmd["ring_remove"]):
+            self._broadcast_ring()
+        return result
 
     def _raft_capture(self) -> dict:
         """Snapshot the applied state machine for raft log compaction."""
         return {"max_volume_id": self.topology.max_volume_id,
-                "max_file_key": self._key_bound}
+                "max_file_key": self._key_bound,
+                "metalog": self.metalog.capture(),
+                "ring": self.ring.to_dict()}
 
     def _raft_restore(self, state: dict) -> None:
         self.topology.max_volume_id = max(self.topology.max_volume_id,
                                           state.get("max_volume_id", 0))
         self._key_bound = max(self._key_bound,
                               state.get("max_file_key", 0))
+        if state.get("metalog"):
+            self.metalog.restore(state["metalog"])
+        if self._key_bound:
+            # a ceiling-era snapshot (no metalog section) must not let
+            # the replicated counter re-mint below the old high-water
+            # mark — fold it in as a floor, deterministically, on every
+            # replica that restores this snapshot
+            self.metalog.apply({"seq_floor": self._key_bound})
+        ring = state.get("ring")
+        if ring and ring.get("version", 0) > self.ring.version:
+            self.ring = DirectoryRing.from_dict(ring)
+            self._broadcast_ring()
 
     def _build_app(self) -> web.Application:
         @web.middleware
@@ -286,6 +339,9 @@ class MasterServer:
         app.router.add_get("/dir/assign", self.dir_assign)
         app.router.add_get("/dir/lookup", self.dir_lookup)
         app.router.add_get("/dir/status", self.dir_status)
+        app.router.add_get("/dir/ring", self.dir_ring)
+        app.router.add_post("/dir/ring/join", self.ring_join)
+        app.router.add_post("/dir/ring/leave", self.ring_leave)
         app.router.add_get("/vol/grow", self.vol_grow)
         app.router.add_get("/vol/vacuum", self.vol_vacuum)
         app.router.add_get("/col/lookup/ec", self.ec_lookup)
@@ -481,15 +537,20 @@ class MasterServer:
     async def ensure_assign_ready(self) -> bool:
         """Leader-readiness barrier + once-per-term sequencer sync, shared
         by the HTTP and gRPC assign surfaces: all prior-term entries (key
-        bounds, volume ids) must be applied before minting anything, and a
-        freshly elected leader starts its sequencer above the last
-        committed ceiling. The sync runs once per term — set_max jumps the
-        counter past the ceiling, so per-request syncs would burn the
-        whole bound window each time."""
+        bounds, volume ids) must be applied before minting anything.
+
+        With the replicated metadata log (the LogSequencer default) the
+        barrier alone is the whole story: replaying the log IS the
+        sequencer state, exact to the last committed assign batch —
+        nothing to jump, nothing to skip.  Only the legacy external-KV
+        path still folds the ceiling in, once per term — set_max jumps
+        the counter past the ceiling, so per-request syncs would burn
+        the whole bound window each time."""
         if not await self.raft.ensure_ready():
             return False
         if self._seq_synced_term != self.raft.term:
-            self.sequencer.set_max(self._key_bound)
+            if not getattr(self.sequencer, "replicated", False):
+                self.sequencer.set_max(self._key_bound)
             self._seq_synced_term = self.raft.term
         return True
 
@@ -527,21 +588,40 @@ class MasterServer:
         if picked is None:
             return {"error": "no writable volumes"}, 500
         vid, nodes = picked
-        if getattr(self.sequencer, "blocking", False):
-            # KV-backed sequencers do socket round trips: never on the loop
-            key = await asyncio.get_event_loop().run_in_executor(
-                None, self.sequencer.next_file_id, count)
-        else:
-            key = self.sequencer.next_file_id(count)
-        # never hand out keys beyond the raft-committed ceiling: a failover
-        # before the bound advances could otherwise re-mint the same keys
-        if key + count > self._key_bound:
-            bound = key + count + self._key_bound_step
-            if not await self.raft.propose({"max_file_key": bound}):
+        g = self.ec_policy.for_collection(collection)
+        if getattr(self.sequencer, "replicated", False):
+            # the batch IS a raft log entry: its apply computes the
+            # first key from the replicated next_key, so a leader
+            # killed mid-assign can neither re-issue the batch (it
+            # committed — the new leader replays past it) nor skip
+            # keys (it didn't — nothing was consumed).  The geometry
+            # stamp rides the same entry the first time a collection
+            # assigns under a given RS(k,m) — one round, not two.
+            cmd: dict = {"assign_batch": {"count": count}}
+            geo_str = f"{g.data_shards}+{g.parity_shards}"
+            if self.metalog.geometry.get(collection or "") != geo_str:
+                cmd["geometry_stamp"] = {"collection": collection or "",
+                                         "geometry": geo_str}
+            ok, key = await self.raft.propose_apply(cmd)
+            if not ok or key is None:
                 return {"error": "lost leadership during assign"}, 503
+        else:
+            if getattr(self.sequencer, "blocking", False):
+                # KV-backed sequencers do socket round trips: never on
+                # the loop
+                key = await asyncio.get_event_loop().run_in_executor(
+                    None, self.sequencer.next_file_id, count)
+            else:
+                key = self.sequencer.next_file_id(count)
+            # never hand out keys beyond the raft-committed ceiling: a
+            # failover before the bound advances could otherwise
+            # re-mint the same keys
+            if key + count > self._key_bound:
+                bound = key + count + self._key_bound_step
+                if not await self.raft.propose({"max_file_key": bound}):
+                    return {"error": "lost leadership during assign"}, 503
         fid = FileId(vid, key, new_cookie())
         node = nodes[0]
-        g = self.ec_policy.for_collection(collection)
         resp = {
             "fid": str(fid),
             "url": node.url,
@@ -625,7 +705,59 @@ class MasterServer:
     async def dir_status(self, request: web.Request) -> web.Response:
         d = self.topology.to_dict()
         d["ec_geometry"] = self.ec_policy.to_dict()
+        d["metalog"] = self.metalog.status()
+        d["ring"] = self.ring.to_dict()
         return web.json_response(d)
+
+    # --- filer ring membership (metaring plane) ---
+
+    async def dir_ring(self, request: web.Request) -> web.Response:
+        """Authoritative filer-ring config (DirectoryRing wire form) —
+        filers bootstrap from here and stay current off the
+        KeepConnected push."""
+        return web.json_response(self.ring.to_dict())
+
+    async def ring_join(self, request: web.Request) -> web.Response:
+        """Add a filer peer to the ring.  Rides raft (followers serve
+        the same membership after failover) and is pushed to every
+        KeepConnected subscriber; the joining/departing peers run the
+        background partition handoff off that push."""
+        return await self._ring_change(request, "ring_add")
+
+    async def ring_leave(self, request: web.Request) -> web.Response:
+        return await self._ring_change(request, "ring_remove")
+
+    async def _ring_change(self, request: web.Request,
+                           op: str) -> web.Response:
+        try:
+            body = await request.json()
+            peer = body["peer"]
+        except (ValueError, KeyError):
+            return web.json_response({"error": "missing peer"},
+                                     status=400)
+        if not await self.raft.ensure_ready():
+            return web.json_response(
+                {"error": "not the leader / not ready"}, status=503)
+        if (op == "ring_add") == (peer in self.ring.peers):
+            # idempotent re-join / re-leave: answer the current view
+            return web.json_response({"ok": True, "unchanged": True,
+                                      "ring": self.ring.to_dict()})
+        if not await self.raft.propose({op: peer}):
+            return web.json_response(
+                {"error": "lost leadership during ring change"},
+                status=503)
+        return web.json_response({"ok": True,
+                                  "ring": self.ring.to_dict()})
+
+    def _broadcast_ring(self) -> None:
+        """Push the new ring view to every KeepConnected subscriber —
+        filers re-route (and start handoff) without polling /dir/ring."""
+        msg = {"type": "ring", "ring": self.ring.to_dict()}
+        for q in list(getattr(self, "_watchers", ())):
+            try:
+                q.put_nowait(msg)
+            except asyncio.QueueFull:
+                pass  # the location-delta overflow path resyncs them
 
     async def vol_grow(self, request: web.Request) -> web.Response:
         q = request.query
@@ -664,9 +796,17 @@ class MasterServer:
             if not nodes:
                 break
             # replicate the new MaxVolumeId through raft before allocating
-            # (MaxVolumeIdCommand, weed/topology/cluster_commands.go:8-31)
+            # (MaxVolumeIdCommand, weed/topology/cluster_commands.go:8-31);
+            # the metadata log's volume registry rides the same entry, so
+            # a replayed leader knows WHAT vid N is, not just that N ids
+            # were burned
             vid = self.topology.max_volume_id + 1
-            if not await self.raft.propose({"max_volume_id": vid}):
+            if not await self.raft.propose(
+                    {"max_volume_id": vid,
+                     "volume_create": {"vid": vid,
+                                       "collection": collection,
+                                       "replication": replication,
+                                       "ttl": ttl}}):
                 log.warning("lost leadership while growing volume %d", vid)
                 return None
             ok = True
@@ -759,6 +899,13 @@ class MasterServer:
         self.topology.layouts = {
             k: v for k, v in self.topology.layouts.items()
             if k[0] != name}
+        # retire the collection's volumes from the replicated registry
+        # (volume ids are never reused — only the registry rows go)
+        retired = [v for v, rec in self.metalog.volumes.items()
+                   if rec.get("collection", "") == name]
+        if retired and not await self.raft.propose(
+                {"volume_retire": {"vids": retired}}):
+            errors.append("volume_retire proposal lost leadership")
         return {"deleted": deleted, "errors": errors}
 
     async def col_delete(self, request: web.Request) -> web.Response:
@@ -1293,7 +1440,13 @@ class MasterServer:
             payload=body,
         )
         seen_key = body.get("max_file_key", 0)
-        if getattr(self.sequencer, "blocking", False):
+        if getattr(self.sequencer, "replicated", False):
+            # externally observed keys fold in as replicated FLOORS
+            # (cold start against pre-existing volumes) — mutating the
+            # applied log state outside raft apply would diverge
+            # replicas
+            self._maybe_propose_floor(seen_key)
+        elif getattr(self.sequencer, "blocking", False):
             # off-loop (blocking sequencers fsync), but a failed
             # set_max silently regressing the sequencer would hand out
             # duplicate fids later — the error must reach the log
@@ -1311,6 +1464,29 @@ class MasterServer:
             "volume_size_limit": self.topology.volume_size_limit,
             "leader": self.raft.leader_id or "",
         }
+
+    def _maybe_propose_floor(self, seen: int) -> None:
+        """Fold a heartbeat-observed needle key into the metadata log
+        as a {"seq_floor"} entry — only when it would actually advance
+        the replicated counter (a rare cold-start event, never the
+        steady-state heartbeat path), deduped so a burst of heartbeats
+        proposes one round, and watched so a failed propose reaches the
+        log instead of vanishing with the task."""
+        if not seen or seen < self.metalog.next_key \
+                or not self.raft.is_leader or self._floor_inflight:
+            return
+        self._floor_inflight = True
+
+        async def run() -> None:
+            try:
+                if not await self.raft.propose({"seq_floor": seen}):
+                    log.warning("seq_floor(%d) proposal lost leadership",
+                                seen)
+            finally:
+                self._floor_inflight = False
+
+        glog.watch_future(asyncio.ensure_future(run()),
+                          f"seq_floor({seen})")
 
     # --- KeepConnected push (weed/server/master_grpc_server.go:178-233,
     #     wdclient/masterclient.go) ---
@@ -1353,7 +1529,8 @@ class MasterServer:
                 if entry not in vols.setdefault(str(vid), []):
                     vols[str(vid)].append(entry)
         return {"type": "snapshot", "volumes": vols,
-                "leader": self.raft.leader_id or ""}
+                "leader": self.raft.leader_id or "",
+                "ring": self.ring.to_dict()}
 
     async def cluster_watch(self, request: web.Request) -> web.StreamResponse:
         """Long-lived JSON-lines stream of vid-location deltas. Followers

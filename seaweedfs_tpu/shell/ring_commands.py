@@ -1,0 +1,76 @@
+"""filer.ring.* admin commands — the metadata scale-out plane's shell
+surface.
+
+- filer.ring.status  the master's authoritative ring view plus every
+                     reachable peer's own state: proxy/mirror counters,
+                     per-peer partition (owned-directory) counts, and
+                     background handoff progress.
+- filer.ring.join    add a filer peer to the ring (master /dir/ring/join,
+                     raft-replicated, pushed over KeepConnected — the
+                     surviving peers start the partition handoff).
+- filer.ring.leave   remove a peer (planned leave or dead-peer removal).
+"""
+
+from __future__ import annotations
+
+import urllib.request
+
+from ..client import _post_json
+from .commands import CommandEnv, command, parser
+
+
+def _peer_status(peer: str) -> dict:
+    import json
+    try:
+        with urllib.request.urlopen(
+                f"http://{peer}/__meta__/ring/status", timeout=5) as r:
+            return json.load(r)
+    except Exception as e:
+        return {"error": str(e)}
+
+
+@command("filer.ring.status",
+         "show the metadata ring: membership, per-peer partition "
+         "counts and handoff progress (filer.ring.status [-peer url])")
+def filer_ring_status(env: CommandEnv, argv: list[str]):
+    p = parser("filer.ring.status")
+    p.add_argument("-peer", default="",
+                   help="restrict the per-peer section to one filer")
+    args = p.parse_args(argv)
+    ring = env.client._master_get("/dir/ring")
+    peers = [args.peer] if args.peer else ring.get("peers", [])
+    out = {"ring": ring, "peers": {}}
+    for peer in peers:
+        st = _peer_status(peer)
+        out["peers"][peer] = ({
+            "owned_dirs": st.get("owned_dirs"),
+            "local_dirs": st.get("local_dirs"),
+            "proxied": (st.get("router") or {}).get("proxied"),
+            "mirrored": (st.get("router") or {}).get("mirrored"),
+            "mirror_failures": (st.get("router")
+                                or {}).get("mirror_failures"),
+            "handoff": st.get("handoff"),
+        } if "error" not in st else st)
+    return out
+
+
+@command("filer.ring.join",
+         "add a filer peer to the metadata ring "
+         "(filer.ring.join -peer host:port)", destructive=True)
+def filer_ring_join(env: CommandEnv, argv: list[str]):
+    p = parser("filer.ring.join")
+    p.add_argument("-peer", required=True)
+    args = p.parse_args(argv)
+    return _post_json(f"http://{env.client.master}/dir/ring/join",
+                      {"peer": args.peer})
+
+
+@command("filer.ring.leave",
+         "remove a filer peer from the metadata ring "
+         "(filer.ring.leave -peer host:port)", destructive=True)
+def filer_ring_leave(env: CommandEnv, argv: list[str]):
+    p = parser("filer.ring.leave")
+    p.add_argument("-peer", required=True)
+    args = p.parse_args(argv)
+    return _post_json(f"http://{env.client.master}/dir/ring/leave",
+                      {"peer": args.peer})

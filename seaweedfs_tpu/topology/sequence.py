@@ -29,6 +29,40 @@ class MemorySequencer:
         return self._next
 
 
+class LogSequencer:
+    """Facade over the master's replicated metadata log
+    (metaring/masterlog.py) — the raft-backed default since the
+    metadata scale-out plane.  Minting does NOT happen here: an assign
+    batch is a raft log entry ({"assign_batch": {...}}) whose APPLY
+    computes the first key from the replicated next_key, so a freshly
+    elected leader replays to the exact counter instead of jumping a
+    ceiling.  This class only keeps the sequencer-shaped surface
+    (peek for status pages, set_max folded in as replicated floors by
+    the master's heartbeat path) so status/UI code and external-KV
+    deployments keep one protocol."""
+
+    blocking = False
+    replicated = True  # master routes minting through the raft log
+
+    def __init__(self, metalog):
+        self._log = metalog
+
+    def next_file_id(self, count: int = 1) -> int:
+        raise RuntimeError(
+            "LogSequencer mints through the raft metadata log "
+            "(assign_batch) — direct next_file_id would fork the "
+            "replicated counter")
+
+    def set_max(self, seen: int) -> None:
+        # floors ride the log too (master._maybe_propose_floor);
+        # mutating applied state outside raft apply would diverge
+        # replicas — tolerate the call, change nothing
+        return
+
+    def peek(self) -> int:
+        return self._log.next_key
+
+
 class KvSequencer:
     """External-KV-backed sequencer — role of the reference's
     EtcdSequencer (weed/sequence/etcd_sequencer.go): key ranges are

@@ -183,22 +183,44 @@ class Cluster:
                   chunk_size: int = 16 * 1024,
                   with_grpc: bool = False,
                   store_kwargs: dict | None = None,
-                  port: int = 0):
+                  port: int = 0,
+                  ring_peers: list[str] | None = None,
+                  ring_replicas: int = 2):
         from aiohttp import web
 
         from seaweedfs_tpu.server.filer_server import FilerServer
 
         if not port:
             port = free_port_with_grpc_twin() if with_grpc else free_port()
+        ring_config = None
+        if ring_peers:
+            from seaweedfs_tpu.metaring import RingConfig
+            ring_config = RingConfig(peers=list(ring_peers),
+                                     replicas=ring_replicas)
         fs = FilerServer(self.master_url, store_name=store_name,
                          store_kwargs=store_kwargs,
                          chunk_size=chunk_size,
                          url=f"127.0.0.1:{port}",
+                         ring_config=ring_config,
                          grpc_port=port + 10000 if with_grpc else 0)
 
-        self.runners.append(self.serve(fs.app, port))
+        runner = self.serve(fs.app, port)
+        self.runners.append(runner)
+        if not hasattr(self, "_filer_runners"):
+            self._filer_runners = {}
+        self._filer_runners[id(fs)] = runner
         fs.url = f"127.0.0.1:{port}"
         return fs
+
+    def stop_filer(self, fs) -> None:
+        """Kill one filer (chaos: the metaring peer-loss drills)."""
+        runner = self._filer_runners.pop(id(fs))
+
+        async def halt():
+            await runner.cleanup()
+
+        self.call(halt())
+        self.runners.remove(runner)
 
     def stop_volume_server(self, index: int) -> None:
         vs = self.volume_servers[index]

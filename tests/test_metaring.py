@@ -1,0 +1,552 @@
+"""Metadata scale-out plane chaos suite (metaring/).
+
+Proves the acceptance criteria of the partitioned-filer-ring +
+replicated-master-log plane:
+
+* namespace ops route to the parent directory's ring owner and mirror
+  to its successor — every peer serves every path;
+* killing a filer peer mid-traffic loses zero acked entries: ops
+  converge on the survivors once the ring drops the dead peer;
+* a ring-change partition handoff interrupted mid-move resumes from
+  its persisted low-watermark instead of restarting;
+* cross-peer cache invalidation is generation-counted: a remote
+  mutation sweeps the local proxied-entry cache without waiting out
+  the TTL;
+* killing the master leader mid-`/dir/assign?count=N` neither
+  re-issues nor skips a fid — the new leader REPLAYS the metadata log
+  to the exact next key (the ceiling-jump era would have skipped a
+  whole bound window);
+* `Filer._notify` covers both parents on cross-directory renames
+  (tombstone event at the old parent, prefix sweep of a moved
+  directory's cached subtree).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cluster_util import Cluster, free_port
+
+from seaweedfs_tpu import faults
+from seaweedfs_tpu.filer.entry import new_file
+from seaweedfs_tpu.metaring import DirectoryRing, RingConfig
+
+
+def _get(url: str, timeout: float = 10.0) -> dict:
+    with urllib.request.urlopen(f"http://{url}", timeout=timeout) as r:
+        return json.load(r)
+
+
+def _post(url: str, body: dict, timeout: float = 10.0) -> dict:
+    req = urllib.request.Request(
+        f"http://{url}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.load(r)
+
+
+def _meta_create(peer: str, path: str, extended: dict | None = None,
+                 timeout: float = 10.0) -> dict:
+    entry = new_file(path)
+    if extended:
+        entry.extended = dict(extended)
+    return _post(f"{peer}/__meta__/create_entry",
+                 {"entry": json.loads(entry.to_json())}, timeout=timeout)
+
+
+def _meta_lookup(peer: str, path: str, timeout: float = 10.0) -> dict:
+    from urllib.parse import quote
+    return _get(f"{peer}/__meta__/lookup?path={quote(path)}",
+                timeout=timeout)
+
+
+# --------------------------------------------------------------- ring unit
+
+def test_directory_ring_determinism_and_balance():
+    peers = [f"127.0.0.1:{9000 + i}" for i in range(3)]
+    a = DirectoryRing(peers, vnodes=64, replicas=2)
+    b = DirectoryRing(list(reversed(peers)), vnodes=64, replicas=2)
+    dirs = [f"/buckets/b{i}" for i in range(300)]
+    for d in dirs:
+        # same membership -> same placement, construction order moot
+        assert a.owners(d) == b.owners(d)
+        assert len(a.owners(d)) == 2
+        assert a.owners(d)[0] != a.owners(d)[1]
+    counts = a.partition_counts(dirs)
+    # virtual nodes keep the split from degenerating
+    assert all(c > 30 for c in counts.values()), counts
+
+
+def test_ring_remove_moves_only_lost_partitions():
+    peers = [f"p{i}:1" for i in range(4)]
+    ring = DirectoryRing(peers, vnodes=64, replicas=1)
+    dirs = [f"/d{i}" for i in range(200)]
+    before = {d: ring.owner(d) for d in dirs}
+    ring.remove_peer("p2:1")
+    for d in dirs:
+        if before[d] != "p2:1":
+            # consistent hashing: partitions of surviving peers stay put
+            assert ring.owner(d) == before[d]
+        else:
+            assert ring.owner(d) != "p2:1"
+
+
+# ------------------------------------------------------------ ring cluster
+
+@pytest.fixture(scope="module")
+def ring_cluster():
+    ports = [free_port() for _ in range(3)]
+    peer_urls = [f"127.0.0.1:{p}" for p in ports]
+    c = Cluster(n_volume_servers=1,
+                master_kwargs={"ring_config": RingConfig(
+                    peers=peer_urls, replicas=2)})
+    c.ring_peers = peer_urls
+    c.filers = [c.add_filer(port=p, ring_peers=peer_urls,
+                            ring_replicas=2) for p in ports]
+    # raise the entry-cache TTL so invalidation tests measure the
+    # cross-peer sweep, not TTL expiry
+    for f in c.filers:
+        if f.filer._entry_cache is not None:
+            f.filer._entry_cache.ttl = 300.0
+    yield c
+    c.shutdown()
+
+
+def test_ring_routes_and_replicates(ring_cluster):
+    c = ring_cluster
+    paths = [f"/ringdata/d{i % 5}/f{i}.txt" for i in range(20)]
+    for i, p in enumerate(paths):
+        edge = c.filers[i % 3].url           # any peer accepts the op
+        _meta_create(edge, p)
+    ring = c.filers[0].ring
+    for p in paths:
+        # served through every peer
+        for f in c.filers:
+            assert _meta_lookup(f.url, p)["path"] == p
+        # stored on exactly the replica set of the parent directory
+        directory = p.rsplit("/", 1)[0]
+        owners = ring.owners(directory)
+        for f in c.filers:
+            held = f.filer.store.find_entry(p) is not None
+            assert held == (f.url in owners), (p, f.url, owners)
+
+
+def test_ring_status_surfaces(ring_cluster):
+    c = ring_cluster
+    # per-peer backend of the `filer.ring.status` shell command
+    st = _get(f"{c.filers[0].url}/__meta__/ring/status")
+    assert st["enabled"] and st["self"] == c.filers[0].url
+    assert sorted(st["ring"]["peers"]) == sorted(c.ring_peers)
+    assert st["local_dirs"] >= 1 and st["owned_dirs"] <= st["local_dirs"]
+    # the shell command aggregates master ring + per-peer rows
+    from seaweedfs_tpu.client import Client
+    from seaweedfs_tpu.shell.commands import (COMMANDS, CommandEnv,
+                                              _register_all)
+    _register_all()
+    env = CommandEnv(Client(c.master_url))
+    out = COMMANDS["filer.ring.status"](env, [])
+    assert sorted(out["ring"]["peers"]) == sorted(c.ring_peers)
+    assert set(out["peers"]) == set(c.ring_peers)
+    for row in out["peers"].values():
+        assert "error" not in row
+
+
+def test_ring_proxy_classifies_system(ring_cluster):
+    c = ring_cluster
+    # proxy/mirror hops happened in the previous test; the receiving
+    # peers admitted them via the ring-hop system path (no fg metering
+    # of internal hops — and no admission bypass for spoofed headers,
+    # the predicate checks the sender is a ring peer)
+    total_hops = 0.0
+    for f in c.filers:
+        for line in f.metrics.render().splitlines():
+            if "admission_ring_hop_total" in line \
+                    and not line.startswith("#"):
+                total_hops += float(line.rsplit(" ", 1)[-1])
+    assert total_hops > 0
+
+
+def test_recursive_delete_spans_partitions(ring_cluster):
+    c = ring_cluster
+    paths = [f"/ringrm/sub{i % 4}/f{i}.txt" for i in range(12)]
+    for p in paths:
+        _meta_create(c.filers[0].url, p)
+    _post(f"{c.filers[1].url}/__meta__/delete",
+          {"path": "/ringrm", "recursive": True})
+    for p in paths + ["/ringrm"]:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _meta_lookup(c.filers[2].url, p)
+        assert ei.value.code == 404
+    # and no peer holds strays in its local store
+    for f in c.filers:
+        for p in paths:
+            assert f.filer.store.find_entry(p) is None
+
+
+def test_cross_partition_rename_converges(ring_cluster):
+    c = ring_cluster
+    for i in range(6):
+        _meta_create(c.filers[0].url, f"/ringmv/src/f{i}.txt")
+    _post(f"{c.filers[2].url}/__meta__/rename",
+          {"from": "/ringmv/src", "to": "/ringmv/dst"})
+    for i in range(6):
+        assert _meta_lookup(
+            c.filers[1].url,
+            f"/ringmv/dst/f{i}.txt")["path"] == f"/ringmv/dst/f{i}.txt"
+        with pytest.raises(urllib.error.HTTPError):
+            _meta_lookup(c.filers[1].url, f"/ringmv/src/f{i}.txt")
+
+
+def test_cross_peer_cache_invalidation_generation(ring_cluster):
+    c = ring_cluster
+    path = "/ringinv/hot.txt"
+    _meta_create(c.filers[0].url, path, extended={"v": "1"})
+    ring = c.filers[0].ring
+    directory = "/ringinv"
+    owners = ring.owners(directory)
+    observer = next(f for f in c.filers if f.url not in owners)
+    owner = next(f for f in c.filers if f.url == owners[0])
+    # observer proxies the lookup and caches the result
+    assert _meta_lookup(observer.url, path)["extended"]["v"] == "1"
+    cache = observer.filer._entry_cache
+    assert path in cache
+    gen_before = cache.generation
+    # owner mutates; its /__meta__ stream broadcast must sweep the
+    # observer's cache (generation bump), NOT wait out the 300s TTL
+    entry = new_file(path)
+    entry.extended = {"v": "2"}
+    _post(f"{owner.url}/__meta__/update_entry",
+          {"entry": json.loads(entry.to_json())})
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if path not in cache and cache.generation > gen_before:
+            break
+        time.sleep(0.05)
+    assert cache.generation > gen_before, "no cross-peer sweep arrived"
+    assert _meta_lookup(observer.url, path)["extended"]["v"] == "2"
+
+
+def test_proxied_write_drops_edge_negative_cache(ring_cluster):
+    """Read-your-writes at the proxying edge (found by the verify
+    drive): a peer that cached a NEGATIVE lookup for a path must serve
+    its own subsequent proxied create immediately — the owner's
+    broadcast sweep is asynchronous, so the edge drops its copy at
+    mutation time, not at sweep time."""
+    c = ring_cluster
+    path = "/ringryw/fresh.txt"
+    ring = c.filers[0].ring
+    owners = ring.owners("/ringryw")
+    edge = next(f for f in c.filers if f.url not in owners)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _meta_lookup(edge.url, path)     # caches the negative
+    assert ei.value.code == 404
+    _meta_create(edge.url, path)         # proxied to the owner
+    # NO sleep: the very next read through the same edge must see it
+    assert _meta_lookup(edge.url, path)["path"] == path
+
+
+def test_peer_kill_mid_traffic_zero_acked_loss(ring_cluster):
+    c = ring_cluster
+    victim = c.filers[2]
+    survivors = [c.filers[0], c.filers[1]]
+    acked: list[str] = []
+    failed: list[str] = []
+
+    def write(i: int, edge: str) -> None:
+        p = f"/ringchaos/d{i % 7}/f{i}.txt"
+        try:
+            _meta_create(edge, p, timeout=5.0)
+            acked.append(p)
+        except Exception:
+            failed.append(p)
+
+    for i in range(15):
+        write(i, c.filers[i % 3].url)
+    c.stop_filer(victim)                      # mid-traffic kill
+    for i in range(15, 30):
+        write(i, survivors[i % 2].url)        # ops keep flowing
+    # drop the dead peer from the ring (operator runbook step); the
+    # master pushes the new view over KeepConnected
+    out = _post(f"{c.master_url.split(',')[0]}/dir/ring/leave",
+                {"peer": victim.url})
+    assert out["ok"] and victim.url not in out["ring"]["peers"]
+    deadline = time.time() + 10
+    while time.time() < deadline and any(
+            victim.url in f.ring.peers for f in survivors):
+        time.sleep(0.05)
+    for f in survivors:
+        assert victim.url not in f.ring.peers
+    # retry anything that failed during the window — converges now
+    still_failing = []
+    for p in list(failed):
+        try:
+            _meta_create(survivors[0].url, p, timeout=5.0)
+            acked.append(p)
+        except Exception:
+            still_failing.append(p)
+    assert not still_failing
+    # ZERO acked entries lost: every acked path serves from survivors
+    for p in acked:
+        for f in survivors:
+            assert _meta_lookup(f.url, p)["path"] == p
+
+
+# ------------------------------------------------------- handoff resume
+
+@pytest.fixture()
+def pair_cluster():
+    ports = [free_port() for _ in range(2)]
+    peer_urls = [f"127.0.0.1:{p}" for p in ports]
+    c = Cluster(n_volume_servers=1,
+                master_kwargs={"ring_config": RingConfig(
+                    peers=peer_urls[:1], replicas=1)})
+    c.ring_peers = peer_urls
+    # replicas=1: a join genuinely MOVES partitions (drop at the old
+    # owner), so the resume-from-watermark path is exercised
+    c.filers = [c.add_filer(port=p, ring_peers=peer_urls[:1],
+                            ring_replicas=1) for p in ports[:1]]
+    yield c, peer_urls
+    faults.clear()
+    c.shutdown()
+
+
+def test_ring_change_handoff_resumes_after_restart(pair_cluster):
+    import asyncio as _asyncio
+
+    c, peer_urls = pair_cluster
+    a = c.filers[0]
+    n_dirs = 24
+    for i in range(n_dirs):
+        for j in range(3):
+            _meta_create(a.url, f"/ho/d{i:02d}/f{j}.txt")
+    b = c.add_filer(port=int(peer_urls[1].rsplit(":", 1)[1]),
+                    ring_peers=peer_urls, ring_replicas=1)
+    c.filers.append(b)
+    new_ring = DirectoryRing(peers=peer_urls, vnodes=64, replicas=1,
+                             version=2)
+    old_ring = DirectoryRing(peers=peer_urls[:1], vnodes=64,
+                             replicas=1, version=1)
+    # the same membership-change filter the runner applies, over the
+    # same enumeration it walks
+    all_dirs = sorted(a.filer.store.iter_directories())
+    moving = [d for d in all_dirs
+              if old_ring.owners(d) != new_ring.owners(d)]
+    assert len(moving) >= 6, "hash split left too little to move"
+
+    # 1) injected coordinator death on the very first move: the error
+    #    path surfaces (state=failed) and nothing is silently skipped
+    faults.set_fault("ring.handoff", "error", count=1)
+    with pytest.raises(Exception):
+        c.call(a.ring_handoff.run_once(new_ring, old_ring))
+    assert a.ring_handoff.state == "failed"
+    faults.clear()
+
+    # 2) coordinator killed mid-run (cancellation IS the restart drill):
+    #    the low-watermark persists in the store's KV face
+    from seaweedfs_tpu.metaring.handoff import HandoffRunner
+    runner1 = HandoffRunner(a, a.ring_router)
+    fut = _asyncio.run_coroutine_threadsafe(
+        runner1.run_once(new_ring, old_ring), c.loop)
+    deadline = time.time() + 20
+    while time.time() < deadline and runner1.moved_dirs < 2:
+        time.sleep(0.005)
+    fut.cancel()
+    deadline = time.time() + 5
+    while time.time() < deadline and not fut.done():
+        time.sleep(0.01)
+    moved_first = runner1.moved_dirs
+    assert 0 < moved_first < len(moving), \
+        f"kill window missed: {moved_first}/{len(moving)}"
+    raw = a.filer.store.kv_get("ring_handoff/v2")
+    watermark = json.loads(raw.decode())["dir"]
+
+    # 3) a FRESH runner (restarted coordinator) resumes after the
+    #    watermark instead of re-walking from scratch
+    runner2 = HandoffRunner(a, a.ring_router)
+    moved_second = c.call(runner2.run_once(new_ring, old_ring))
+    assert runner2.state == "done"
+    # exact low-watermark semantics: everything after the persisted
+    # watermark (and nothing before it) is re-walked
+    assert moved_second == len([d for d in moving if d > watermark])
+    assert moved_second < len(moving), "restarted from scratch"
+
+    # every partition that changed hands is fully served by the ring:
+    # entries live on their new owner, and A dropped what it lost
+    for d in moving:
+        if not d.startswith("/ho/d"):
+            continue
+        for j in range(3):
+            path = f"{d}/f{j}.txt"
+            assert b.filer.store.find_entry(path) is not None
+            assert a.filer.store.find_entry(path) is None
+        assert _meta_lookup(b.url, f"{d}/f0.txt")["path"] == f"{d}/f0.txt"
+
+
+def test_handoff_moves_strays_despite_unchanged_diff(pair_cluster):
+    """A cancelled earlier pass can leave data on a peer that is no
+    longer in a partition's replica set; a later pass whose old-vs-new
+    diff shows NO membership change for that partition must still move
+    it — the diff is an optimization, never a correctness gate."""
+    c, peer_urls = pair_cluster
+    a = c.filers[0]
+    for j in range(3):
+        _meta_create(a.url, f"/stray/f{j}.txt")
+    b = c.add_filer(port=int(peer_urls[1].rsplit(":", 1)[1]),
+                    ring_peers=peer_urls, ring_replicas=1)
+    c.filers.append(b)
+    # both views exclude A and agree — the pre-fix filter skipped this
+    old_v = DirectoryRing(peers=peer_urls[1:], vnodes=64, replicas=1,
+                          version=2)
+    new_v = DirectoryRing(peers=peer_urls[1:], vnodes=64, replicas=1,
+                          version=3)
+    from seaweedfs_tpu.metaring.handoff import HandoffRunner
+    moved = c.call(HandoffRunner(a, a.ring_router).run_once(new_v,
+                                                            old_v))
+    assert moved >= 1, "stray partitions were skipped by the diff"
+    for j in range(3):
+        assert b.filer.store.find_entry(f"/stray/f{j}.txt") is not None
+        assert a.filer.store.find_entry(f"/stray/f{j}.txt") is None
+
+
+# ---------------------------------------------- master log exact replay
+
+@pytest.fixture()
+def ha_cluster():
+    c = Cluster(n_volume_servers=2, n_masters=3)
+    yield c
+    c.shutdown()
+
+
+def _assign(url: str, count: int, timeout: float = 5.0) -> dict:
+    return _get(f"{url}/dir/assign?count={count}", timeout=timeout)
+
+
+def test_leader_kill_mid_bulk_assign_replays_exact(ha_cluster):
+    c = ha_cluster
+    from seaweedfs_tpu.storage.file_id import FileId
+
+    keys_seen: set[int] = set()
+    ranges: list[tuple[int, int]] = []
+
+    def assign_ok(url: str, count: int) -> None:
+        out = _assign(url, count)
+        key = FileId.parse(out["fid"]).key
+        for k in range(key, key + count):
+            assert k not in keys_seen, f"fid key {k} re-issued"
+            keys_seen.add(k)
+        ranges.append((key, count))
+
+    for i in range(10):
+        assign_ok(c.master_url.split(",")[0], 1 + i % 4)
+
+    leader = c.wait_for_leader()
+    committed_next = leader.metalog.next_key
+    assert committed_next == 1 + sum(n for _, n in ranges)
+
+    idx = c.masters.index(leader)
+    c.stop_master(idx)
+    survivors = [m for i, m in enumerate(c.masters) if i != idx]
+    deadline = time.time() + 10
+    new_leader = None
+    while time.time() < deadline and new_leader is None:
+        new_leader = next((m for m in survivors if m.raft.is_leader),
+                          None)
+        time.sleep(0.05)
+    assert new_leader is not None
+
+    # volume servers re-home their heartbeats before the next assign
+    # (an empty post-failover topology answers 500, not a minted key —
+    # and a failed pick consumes nothing from the log)
+    c.wait_heartbeats()
+    time.sleep(c.pulse * 3)
+
+    # EXACT replay: the new leader's next key equals the old leader's
+    # committed counter — no duplicate (the batches are in the log it
+    # replayed) and no skip (the ceiling-jump era burned a whole bound
+    # window here)
+    surviving_url = new_leader.url
+    out = None
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        try:
+            out = _assign(surviving_url, 5, timeout=10.0)
+            break
+        except urllib.error.HTTPError as e:
+            if e.code not in (500, 503):
+                raise
+            time.sleep(0.2)
+    assert out is not None, "assign never recovered after failover"
+    key = FileId.parse(out["fid"]).key
+    assert key == committed_next, (
+        f"first post-failover key {key} != committed next "
+        f"{committed_next} (skip or re-issue)")
+    for k in range(key, key + 5):
+        assert k not in keys_seen
+    assert new_leader.metalog.next_key == committed_next + 5
+
+
+def test_metalog_volume_registry_and_geometry_stamp(ha_cluster):
+    c = ha_cluster
+    leader = c.wait_for_leader()
+    _assign(leader.url, 1)
+    # growth rode the raft log: the registry knows the volume rows and
+    # the collection's stamped geometry — and followers replicate both
+    assert leader.metalog.volumes, "volume_create never logged"
+    rec = next(iter(leader.metalog.volumes.values()))
+    assert "replication" in rec and "collection" in rec
+    assert "" in leader.metalog.geometry
+    deadline = time.time() + 5
+    followers = [m for m in c.masters if m is not leader]
+    while time.time() < deadline:
+        # commit_index reaches followers on the next heartbeat round
+        if all(f.metalog.volumes
+               and f.metalog.next_key == leader.metalog.next_key
+               for f in followers):
+            break
+        time.sleep(0.05)
+    for f in followers:
+        assert f.metalog.volumes, f"follower {f.url} missed the log"
+        assert f.metalog.next_key == leader.metalog.next_key
+
+
+# ------------------------------------------------ _notify rename audit
+
+def test_notify_rename_covers_both_parents():
+    """Regression (satellite): a cross-directory move must (a) sweep
+    the cache for both paths (and a moved directory's cached subtree),
+    and (b) emit an event visible to OLD-parent-scoped subscribers."""
+    from seaweedfs_tpu.filer.filer import Filer
+    from seaweedfs_tpu.filer.stores import MemoryStore
+
+    f = Filer(MemoryStore(), entry_cache_ttl=300.0)
+    f.create_entry(new_file("/a/sub/x.txt"))
+    f.create_entry(new_file("/b/keep.txt"))
+    # warm the cache on both sides
+    assert f.find_entry("/a/sub/x.txt") is not None
+    assert f.find_entry("/b/keep.txt") is not None
+    cache = f._entry_cache
+    gen = cache.generation
+    f.rename("/a/sub", "/b/sub")
+    assert cache.generation > gen
+    assert "/a/sub/x.txt" not in cache
+    assert "/a/sub" not in cache
+    assert f.find_entry("/b/sub/x.txt") is not None
+    assert f.find_entry("/a/sub/x.txt") is None
+    # old-parent subscribers see the tombstone; new-parent subscribers
+    # see the move — BOTH prefixes converge
+    old_side = f.meta_log.events_since(0, prefix="/a")
+    assert any(e.old_entry is not None and e.new_entry is None
+               and e.old_entry.full_path == "/a/sub"
+               for e in old_side), \
+        "no tombstone at the old parent directory"
+    new_side = f.meta_log.events_since(0, prefix="/b")
+    assert any(e.new_entry is not None
+               and e.new_entry.full_path == "/b/sub"
+               for e in new_side)
+    # and the tombstone is metadata-only — no chunk freeing rode it
+    assert all(not e.delete_chunks for e in old_side)

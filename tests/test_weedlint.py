@@ -67,6 +67,22 @@ def test_every_rule_documents_itself():
         assert rule.scope, f"rule {name} has no scope"
 
 
+def test_metaring_scope_pinned():
+    """The metadata scale-out plane must stay inside the async-plane
+    guards: a future scope edit that drops seaweedfs_tpu/metaring/ from
+    any of these rules silently un-lints a whole serving plane."""
+    for name in ("daemon-loop-shedable", "fault-point-registry",
+                 "ctx-propagation", "async-blocking-call"):
+        rule = RULES[name]
+        assert rule.applies_to("seaweedfs_tpu/metaring/handoff.py"), \
+            f"rule {name} no longer covers seaweedfs_tpu/metaring/"
+    # and the daemon rule's explicit plane list is pinned verbatim —
+    # its per-plane "guards something" check keys off these prefixes
+    assert tuple(RULES["daemon-loop-shedable"].scope) == (
+        "seaweedfs_tpu/lifecycle/", "seaweedfs_tpu/geo/",
+        "seaweedfs_tpu/metaring/")
+
+
 # ------------------------------------------------------- tree enforcement
 
 @pytest.fixture(scope="module")
