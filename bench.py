@@ -2530,11 +2530,18 @@ def phase_metadata(work: str, budget_s: float = 240.0) -> dict:
     return out
 
 
+V2_RULES = ("blocking-call-transitive,lock-held-await-transitive,"
+            "deadline-propagation,resource-leak-interproc,lock-ordering")
+
+
 def phase_lint(work: str = "", budget_s: float = 60.0) -> dict:
     """weedlint smoke: the full-tree static-analysis gate must stay
-    cheap enough to live inside the tier-1 pytest run. Runs the exact
-    CI invocation (scripts/lint.sh's command line) in a subprocess and
-    records wall time; acceptance is clean exit AND < 10s."""
+    cheap enough to live inside the tier-1 pytest run — WITH the v2
+    call-graph pass included. Runs the exact CI invocation
+    (scripts/lint.sh's command line) in a subprocess and records wall
+    time (lint_wall_s), then the inter-procedural subset alone
+    (lint_v2_wall_s: call-graph build + summary closure cost);
+    acceptance is clean exits AND full run < 10s."""
     repo = os.path.dirname(os.path.abspath(__file__))
     cmd = [sys.executable, "-m", "seaweedfs_tpu.analysis",
            "--baseline", ".weedlint-baseline.json",
@@ -2544,17 +2551,29 @@ def phase_lint(work: str = "", budget_s: float = 60.0) -> dict:
                        timeout=budget_s)
     wall = time.perf_counter() - t0
     tail = (p.stdout.strip().splitlines() or [""])[-1]
+
+    cmd_v2 = [sys.executable, "-m", "seaweedfs_tpu.analysis",
+              "--rules", V2_RULES, "--baseline",
+              ".weedlint-baseline.json", "seaweedfs_tpu/", "tests/"]
+    t0 = time.perf_counter()
+    p2 = subprocess.run(cmd_v2, cwd=repo, capture_output=True,
+                        text=True, timeout=budget_s)
+    wall_v2 = time.perf_counter() - t0
+
     out = {
         "lint_wall_s": round(wall, 2),
-        "clean": p.returncode == 0,
+        "lint_v2_wall_s": round(wall_v2, 2),
+        "clean": p.returncode == 0 and p2.returncode == 0,
         "files": int(tail.split(" files")[0].rsplit(" ", 1)[-1])
         if " files" in tail else None,
         "summary": tail[:200],
         "accept": {"clean_exit": p.returncode == 0,
+                   "v2_clean_exit": p2.returncode == 0,
                    "under_10s": wall < 10.0},
     }
-    if p.returncode != 0:
-        out["error"] = (p.stdout + p.stderr)[-1500:]
+    if p.returncode != 0 or p2.returncode != 0:
+        out["error"] = (p.stdout + p.stderr + p2.stdout
+                        + p2.stderr)[-1500:]
     return out
 
 
@@ -2889,6 +2908,7 @@ def main() -> None:
                     if isinstance(multichip.get("rebuild_storm"), dict)
                     else None,
                 "lint_wall_s": lint.get("lint_wall_s"),
+                "lint_v2_wall_s": lint.get("lint_v2_wall_s"),
                 "detail_file": "BENCH_DETAIL.json",
             },
         }))

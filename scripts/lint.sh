@@ -1,14 +1,20 @@
 #!/usr/bin/env bash
 # weedlint CI gate: fails on any new finding or stale baseline entry.
+# Runs the FULL registry — v1 single-function rules AND the v2
+# inter-procedural rules (call-graph + effect summaries) — by default.
 #
 #   scripts/lint.sh              # the CI mode (no fixes, no rewrite)
 #   scripts/lint.sh --rules http-timeout,task-leak   # subset
+#   scripts/lint.sh --jobs 4     # process-pool parse, identical output
+#   scripts/lint.sh --format github   # ::error annotations for CI
 #
 # To grandfather an existing finding (new rule landing on old code):
 #   python -m seaweedfs_tpu.analysis --baseline .weedlint-baseline.json \
 #       --write-baseline seaweedfs_tpu/ tests/
 # To suppress one deliberate site, comment the line:
 #   ... # weedlint: disable=<rule>
+# weedsan (runtime) findings share the same fingerprints: the same
+# suppression/baseline workflow covers them.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec python -m seaweedfs_tpu.analysis \

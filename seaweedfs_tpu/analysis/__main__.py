@@ -46,6 +46,13 @@ def main(argv=None) -> int:
     ap.add_argument("--root", default="",
                     help="repo root for relative paths (default: the "
                          "package parent)")
+    ap.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="parse files on an N-process pool (findings "
+                         "and fingerprints are identical to -j1)")
+    ap.add_argument("--format", choices=("text", "github"),
+                    default="text",
+                    help="'github' emits ::error annotation lines for "
+                         "CI in addition to the summary")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -68,7 +75,7 @@ def main(argv=None) -> int:
     t0 = time.perf_counter()
     try:
         report = run(root, args.paths, rule_names=rule_names,
-                     baseline=baseline)
+                     baseline=baseline, jobs=max(1, args.jobs))
     except ValueError as e:
         print(f"weedlint: {e}", file=sys.stderr)
         return 2
@@ -113,6 +120,24 @@ def main(argv=None) -> int:
                  if preserved else ""))
         return 0
 
+    if args.format == "github":
+        # one workflow-command annotation per actionable line; GitHub
+        # reads these off stdout and pins them to the diff view
+
+        def esc(s: str) -> str:
+            return (s.replace("%", "%25").replace("\r", "%0D")
+                    .replace("\n", "%0A"))
+
+        for d in sorted(report.new,
+                        key=lambda d: (d.path, d.line, d.rule)):
+            print(f"::error file={esc(d.path)},line={d.line},"
+                  f"title=weedlint {esc(d.rule)}::{esc(d.message)}")
+        for e in sorted(report.stale_baseline,
+                        key=lambda e: (e["rule"], e["path"], e["line"])):
+            print(f"::error file={esc(e['path'])},line={e['line']},"
+                  f"title=weedlint stale-baseline::stale baseline "
+                  f"entry {e['fp']} ([{esc(e['rule'])}]) no longer "
+                  f"matches any finding — remove it")
     out = report.render(show_baselined=args.show_baselined)
     if out:
         print(out)

@@ -1,0 +1,655 @@
+"""weedlint v2: tree-wide call graph + per-function effect summaries.
+
+PR 8's fifteen rules are single-function AST matchers — a helper that
+calls ``os.fsync`` goes invisible the moment it's wrapped in one level
+of indirection. This module gives rules the inter-procedural layer:
+
+  * a qualified-name index of every function/method in the analyzed
+    tree (``relpath:func``, ``relpath:Class.method``, nested defs as
+    ``relpath:outer.<locals>.inner``);
+  * call-edge resolution good enough for a cohesive package — local
+    names, absolute AND relative imports, ``self.``/``cls.`` methods
+    through resolvable base classes;
+  * a :class:`FunctionSummary` of the effects rules care about: calls
+    a blocking primitive, acquires/releases which locks, awaits,
+    spawns tasks, makes raw outbound HTTP, launders the deadline
+    budget, returns an open resource, yields while holding a lock;
+  * memoized transitive closures over the summary graph (blocking
+    chains, summarized lock acquisitions, resource-returning factories)
+    so every rule pays for the graph once.
+
+Resolution is deliberately conservative: an edge exists only when the
+callee is a plain dotted name the index can pin to one definition.
+Unresolvable receivers (``obj.method()`` on a value of unknown type)
+produce no edge — inter-procedural rules must prefer silence over a
+fabricated chain.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .astutil import attr_path, const_str
+from .engine import Module
+
+__all__ = [
+    "BLOCKING_PRIMITIVES", "RESOURCE_CONSTRUCTORS", "CallGraph",
+    "CallSite", "FunctionSummary", "get",
+]
+
+# (module, attr) pairs that block the calling thread — shared with the
+# async_hygiene rule so intra- and inter-procedural views can't drift
+BLOCKING_PRIMITIVES = {
+    ("os", "fsync"): "use run_in_executor",
+    ("os", "fdatasync"): "use run_in_executor",
+    ("time", "sleep"): "use asyncio.sleep (or run_in_executor)",
+    ("subprocess", "run"): "use asyncio.create_subprocess_exec",
+    ("subprocess", "check_output"): "use asyncio.create_subprocess_exec",
+    ("subprocess", "check_call"): "use asyncio.create_subprocess_exec",
+}
+
+# close-needing constructors — shared with the resources rule
+RESOURCE_CONSTRUCTORS = {
+    ("open",): "open",
+    ("os", "fdopen"): "os.fdopen",
+    ("mmap", "mmap"): "mmap.mmap",
+    ("socket", "socket"): "socket.socket",
+    ("aiohttp", "ClientSession"): "aiohttp.ClientSession",
+}
+
+_LOCKISH = ("lock", "mutex")
+_SPAWNERS = ("create_task", "ensure_future", "run_in_executor")
+_DEADLINE_LAUNDERERS = ("inject_deadline", "cap_timeout")
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def module_dotted(relpath: str) -> str:
+    """'seaweedfs_tpu/ec/feed.py' -> 'seaweedfs_tpu.ec.feed';
+    package __init__ maps to the package itself."""
+    parts = relpath[:-3].split("/")  # drop .py
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+_LOOP_PROBES = ("ensure_future", "get_running_loop", "get_event_loop",
+                "create_task", "run_coroutine_threadsafe")
+
+
+def _probes_loop(try_node: ast.Try) -> bool:
+    """Does this try's body attempt event-loop access? If so, its
+    ``except RuntimeError`` handlers are the no-running-loop fallback
+    and execute off-loop by construction."""
+    for stmt in try_node.body:
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Call):
+                path = attr_path(n.func)
+                if path and path[-1] in _LOOP_PROBES:
+                    return True
+    return False
+
+
+def _catches_runtime_error(handler: ast.excepthandler) -> bool:
+    t = handler.type
+    names = []
+    if isinstance(t, (ast.Name, ast.Attribute)):
+        names = [attr_path(t)[-1:]]
+    elif isinstance(t, ast.Tuple):
+        names = [attr_path(e)[-1:] for e in t.elts]
+    return any(n == ("RuntimeError",) for n in names)
+
+
+def _lock_name(expr) -> str:
+    """Dotted name when the expression looks like a lock (same notion
+    the locks rule uses: last segment mentions lock/mutex)."""
+    path = attr_path(expr)
+    if not path:
+        return ""
+    last = path[-1].lower()
+    if any(s in last for s in _LOCKISH):
+        return ".".join(path)
+    return ""
+
+
+@dataclass(frozen=True)
+class CallSite:
+    lineno: int
+    name: str                       # display name of the callee expr
+    callees: Tuple[str, ...]        # resolved qnames ((), when unknown)
+    held_locks: Tuple[str, ...]     # lock ids lexically held here
+    # inside an ``except RuntimeError:`` whose try body probed the
+    # event loop (ensure_future/get_running_loop/...): that handler
+    # only runs when NO loop is running, so blocking there cannot
+    # stall one — the no-loop-fallback idiom must not taint chains
+    off_loop: bool = False
+
+
+@dataclass
+class FunctionSummary:
+    qname: str
+    mod: Module
+    node: ast.AST
+    is_async: bool
+    cls: str = ""
+    params: Tuple[str, ...] = ()
+    # --- direct effects, this function's own body only (nested defs
+    # and lambdas are deferred execution: their own summaries carry
+    # their own effects) ---
+    blocking: List[Tuple[str, int]] = field(default_factory=list)
+    acquires: List[Tuple[str, int]] = field(default_factory=list)
+    has_await: bool = False
+    spawns: List[int] = field(default_factory=list)
+    yields_holding: Tuple[str, ...] = ()
+    raw_outbound: List[int] = field(default_factory=list)
+    launders_deadline: bool = False
+    headers_delegated: bool = False   # raw outbound headers come from a param
+    returns_resource: str = ""        # constructor label returned directly
+    returns_calls: Tuple[str, ...] = ()  # qnames whose result is returned
+    calls: List[CallSite] = field(default_factory=list)
+
+
+class CallGraph:
+    """Index + summaries + memoized transitive queries over one module
+    set. Build once per run via :func:`get`."""
+
+    def __init__(self, mods: Sequence[Module]):
+        self.mods = list(mods)
+        self.functions: Dict[str, FunctionSummary] = {}
+        # python dotted module name -> Module
+        self.modules: Dict[str, Module] = {}
+        # (relpath, ClassName) -> {method -> qname}; plus base exprs
+        self._class_methods: Dict[Tuple[str, str], Dict[str, str]] = {}
+        self._class_bases: Dict[Tuple[str, str], List[Tuple[str, ...]]] = {}
+        self._imports: Dict[str, Dict[str, str]] = {}   # relpath -> alias map
+        # id(ast.Call) -> resolved callee qnames, so rules doing their
+        # own walks can look resolutions up without re-deriving context
+        self.call_resolutions: Dict[int, Tuple[str, ...]] = {}
+        # reverse edges: callee qname -> [(caller qname, lineno)]
+        self.callers: Dict[str, List[Tuple[str, int]]] = {}
+        # memo tables
+        self._blocking_chain_memo: Dict[str, Optional[Tuple]] = {}
+        self._acq_memo: Dict[str, Dict[str, Tuple]] = {}
+        self._resource_memo: Dict[str, str] = {}
+
+        for mod in self.mods:
+            self.modules[module_dotted(mod.relpath)] = mod
+        for mod in self.mods:
+            self._index_module(mod)
+        for mod in self.mods:
+            self._summarize_module(mod)
+        for s in self.functions.values():
+            for site in s.calls:
+                for callee in site.callees:
+                    self.callers.setdefault(callee, []).append(
+                        (s.qname, site.lineno))
+
+    # ------------------------------------------------------ indexing
+
+    def _index_module(self, mod: Module) -> None:
+        self._imports[mod.relpath] = self._module_imports(mod)
+
+        def index_scope(parent, prefix: str, cls: str) -> None:
+            for child in ast.iter_child_nodes(parent):
+                if isinstance(child, ast.ClassDef):
+                    key = (mod.relpath, child.name)
+                    self._class_methods.setdefault(key, {})
+                    self._class_bases[key] = [
+                        attr_path(b) for b in child.bases if attr_path(b)]
+                    index_scope(child, f"{child.name}.", child.name)
+                elif isinstance(child, _FUNC_DEFS):
+                    qname = f"{mod.relpath}:{prefix}{child.name}"
+                    self.functions[qname] = FunctionSummary(
+                        qname=qname, mod=mod, node=child,
+                        is_async=isinstance(child, ast.AsyncFunctionDef),
+                        cls=cls,
+                        params=tuple(
+                            a.arg for a in (child.args.posonlyargs
+                                            + child.args.args
+                                            + child.args.kwonlyargs)))
+                    if cls and prefix == f"{cls}.":
+                        self._class_methods[(mod.relpath, cls)][
+                            child.name] = qname
+                    # nested defs: indexable so local-name calls resolve
+                    index_scope(child, f"{prefix}{child.name}.<locals>.",
+                                cls)
+                else:
+                    index_scope(child, prefix, cls)
+
+        index_scope(mod.tree, "", "")
+
+    def _module_imports(self, mod: Module) -> Dict[str, str]:
+        """alias -> canonical dotted target, including RELATIVE imports
+        (astutil.import_aliases covers absolute only — most intra-
+        package edges here ride ``from ..utils import retry``)."""
+        pkg_parts = module_dotted(mod.relpath).split(".")
+        is_pkg = mod.relpath.endswith("/__init__.py")
+        aliases: Dict[str, str] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                if node.level == 0:
+                    base = node.module or ""
+                else:
+                    # level 1 = this module's package, each extra level
+                    # one package up
+                    drop = node.level - (1 if is_pkg else 0)
+                    kept = pkg_parts[:len(pkg_parts) - drop]
+                    if not kept:
+                        continue
+                    base = ".".join(kept)
+                    if node.module:
+                        base = f"{base}.{node.module}"
+                if not base:
+                    continue
+                for a in node.names:
+                    aliases[a.asname or a.name] = f"{base}.{a.name}"
+        return aliases
+
+    # ---------------------------------------------------- resolution
+
+    def _resolve_dotted(self, dotted: Tuple[str, ...]) -> Tuple[str, ...]:
+        """Canonical dotted path -> qnames. Handles module.func,
+        module.Class.method and package.module chains by longest-prefix
+        module match."""
+        for cut in range(len(dotted) - 1, 0, -1):
+            mod_name = ".".join(dotted[:cut])
+            mod = self.modules.get(mod_name)
+            if mod is None:
+                continue
+            rest = dotted[cut:]
+            if len(rest) == 1:
+                q = f"{mod.relpath}:{rest[0]}"
+                if q in self.functions:
+                    return (q,)
+                # re-export: the name may be an alias inside that module
+                target = self._imports.get(mod.relpath, {}).get(rest[0])
+                if target:
+                    return self._resolve_dotted(tuple(target.split(".")))
+            elif len(rest) == 2:
+                q = self._method_qname(mod.relpath, rest[0], rest[1])
+                if q:
+                    return (q,)
+            return ()
+        return ()
+
+    def _method_qname(self, relpath: str, cls: str, meth: str,
+                      _seen=None) -> Optional[str]:
+        """Class method lookup through resolvable base classes."""
+        _seen = _seen or set()
+        key = (relpath, cls)
+        if key in _seen:
+            return None
+        _seen.add(key)
+        methods = self._class_methods.get(key)
+        if methods is None:
+            return None
+        if meth in methods:
+            return methods[meth]
+        imports = self._imports.get(relpath, {})
+        for base in self._class_bases.get(key, ()):
+            # base may be a local class or an imported one
+            head = imports.get(base[0])
+            dotted = (tuple(head.split(".")) + base[1:]) if head else base
+            if len(dotted) == 1:
+                q = self._method_qname(relpath, dotted[0], meth, _seen)
+                if q:
+                    return q
+                continue
+            for cut in range(len(dotted) - 1, 0, -1):
+                m = self.modules.get(".".join(dotted[:cut]))
+                if m is not None and len(dotted) - cut == 1:
+                    q = self._method_qname(m.relpath, dotted[cut], meth,
+                                           _seen)
+                    if q:
+                        return q
+                    break
+        return None
+
+    def _resolve_call(self, mod: Module, call: ast.Call, cls: str,
+                      local_defs: Dict[str, str]) -> Tuple[str, ...]:
+        path = attr_path(call.func)
+        if not path:
+            return ()
+        if len(path) == 1:
+            name = path[0]
+            if name in local_defs:
+                return (local_defs[name],)
+            q = f"{mod.relpath}:{name}"
+            if q in self.functions:
+                return (q,)
+            target = self._imports.get(mod.relpath, {}).get(name)
+            if target:
+                return self._resolve_dotted(tuple(target.split(".")))
+            return ()
+        if path[0] in ("self", "cls") and cls:
+            if len(path) == 2:
+                q = self._method_qname(mod.relpath, cls, path[1])
+                return (q,) if q else ()
+            return ()
+        head = self._imports.get(mod.relpath, {}).get(path[0])
+        if head:
+            return self._resolve_dotted(tuple(head.split(".")) + path[1:])
+        if len(path) == 2 and (mod.relpath, path[0]) in \
+                self._class_methods:
+            # Class.method(...) on a class defined in this module
+            q = self._method_qname(mod.relpath, path[0], path[1])
+            return (q,) if q else ()
+        # anything else (obj.method() on an unknown receiver) produces
+        # no edge by design: silence over fabricated chains
+        return ()
+
+    # --------------------------------------------------- summarizing
+
+    def _summarize_module(self, mod: Module) -> None:
+        aliases = mod.aliases()
+
+        classes: Dict[ast.AST, str] = {}
+
+        def tag_classes(node, cls):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    tag_classes(child, child.name)
+                else:
+                    classes[child] = cls
+                    tag_classes(child, cls)
+
+        tag_classes(mod.tree, "")
+
+        for qname, summary in list(self.functions.items()):
+            if summary.mod is not mod:
+                continue
+            self._summarize_function(summary, aliases)
+
+    def _summarize_function(self, s: FunctionSummary, aliases) -> None:
+        mod, fn = s.mod, s.node
+        # local (nested) defs visible by bare name inside this body
+        local_defs = {
+            child.name: f"{s.qname}.<locals>.{child.name}"
+            for child in ast.iter_child_nodes(fn)
+            if isinstance(child, _FUNC_DEFS)}
+        local_defs = {k: v for k, v in local_defs.items()
+                      if v in self.functions}
+        # name -> resource label / factory callees, for the
+        # assign-then-return shape
+        assigned_resources: Dict[str, str] = {}
+        assigned_calls: Dict[str, Tuple[str, ...]] = {}
+        returns_calls: List[str] = []
+
+        def canonical(call: ast.Call) -> Tuple[str, ...]:
+            path = attr_path(call.func)
+            if not path:
+                return ()
+            head = aliases.get(path[0])
+            if head is not None:
+                path = tuple(head.split(".")) + path[1:]
+            return path
+
+        def visit(node, held: List[str], off_loop: bool = False) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return   # deferred execution: own summary
+            if isinstance(node, ast.Try) and _probes_loop(node):
+                # no-loop fallback idiom: the RuntimeError handlers of
+                # a try that attempted loop access only run off-loop
+                for part in (node.body, node.orelse, node.finalbody):
+                    for sub in part:
+                        visit(sub, held, off_loop)
+                for handler in node.handlers:
+                    h_off = off_loop or _catches_runtime_error(handler)
+                    for sub in handler.body:
+                        visit(sub, held, h_off)
+                    if handler.type is not None:
+                        visit(handler.type, held, off_loop)
+                return
+            if isinstance(node, (ast.Await, ast.AsyncFor, ast.AsyncWith)):
+                s.has_await = True
+            if isinstance(node, (ast.Yield, ast.YieldFrom)) and held:
+                s.yields_holding = tuple(
+                    sorted(set(s.yields_holding) | set(held)))
+            acquired: List[str] = []
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    name = _lock_name(item.context_expr)
+                    if name:
+                        lid = self._qualify_lock(mod, s.cls, name)
+                        s.acquires.append((lid, node.lineno))
+                        acquired.append(lid)
+            if isinstance(node, ast.Call):
+                self._summarize_call(s, node, held, canonical,
+                                     local_defs, off_loop)
+            if isinstance(node, ast.Return) and node.value is not None:
+                self._summarize_return(s, node.value, canonical,
+                                       assigned_resources,
+                                       assigned_calls, returns_calls,
+                                       local_defs)
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                tgt = node.targets[0].id
+                label = RESOURCE_CONSTRUCTORS.get(
+                    canonical(node.value), "")
+                if label:
+                    assigned_resources[tgt] = label
+                else:
+                    callees = self._resolve_call(mod, node.value, s.cls,
+                                                 local_defs)
+                    if callees:
+                        assigned_calls[tgt] = callees
+            for child in ast.iter_child_nodes(node):
+                visit(child, held + acquired, off_loop)
+
+        for stmt in fn.body:
+            visit(stmt, [])
+        s.returns_calls = tuple(dict.fromkeys(returns_calls))
+
+    def _summarize_call(self, s: FunctionSummary, call: ast.Call,
+                        held: List[str], canonical, local_defs,
+                        off_loop: bool = False) -> None:
+        mod = s.mod
+        path = canonical(call)
+        raw = attr_path(call.func)
+        if path in BLOCKING_PRIMITIVES and not off_loop:
+            s.blocking.append((".".join(path), call.lineno))
+        if raw and raw[-1] in _SPAWNERS:
+            s.spawns.append(call.lineno)
+        if raw and raw[-1] in _DEADLINE_LAUNDERERS:
+            s.launders_deadline = True
+        if path == ("urllib", "request", "urlopen"):
+            s.raw_outbound.append(call.lineno)
+            if self._headers_from_param(s, call):
+                s.headers_delegated = True
+        callees = self._resolve_call(mod, call, s.cls, local_defs)
+        if callees:
+            self.call_resolutions[id(call)] = callees
+        s.calls.append(CallSite(
+            lineno=call.lineno,
+            name=".".join(raw) if raw else "<expr>",
+            callees=callees, held_locks=tuple(held),
+            off_loop=off_loop))
+
+    def _headers_from_param(self, s: FunctionSummary,
+                            call: ast.Call) -> bool:
+        """Does the raw outbound call's request plausibly carry headers
+        handed in by the caller? True when a parameter whose name
+        mentions 'headers' exists — responsibility for the deadline
+        budget then sits with every caller."""
+        return any("headers" in p for p in s.params)
+
+    def _summarize_return(self, s, value, canonical, assigned_resources,
+                          assigned_calls, returns_calls,
+                          local_defs) -> None:
+        if isinstance(value, ast.Call):
+            label = RESOURCE_CONSTRUCTORS.get(canonical(value), "")
+            if label:
+                s.returns_resource = label
+            else:
+                for q in self._resolve_call(s.mod, value, s.cls,
+                                            local_defs):
+                    returns_calls.append(q)
+        elif isinstance(value, ast.Name):
+            if value.id in assigned_resources:
+                s.returns_resource = assigned_resources[value.id]
+            for q in assigned_calls.get(value.id, ()):
+                returns_calls.append(q)
+
+    @staticmethod
+    def _qualify_lock(mod: Module, cls: str, name: str) -> str:
+        """Same convention as the locks rule: module-prefixed, class-
+        qualified for self attributes — A._lock and B._lock never merge
+        across files."""
+        if name.startswith("self."):
+            owner = f"{mod.relpath}:{cls}" if cls else mod.relpath
+            return f"{owner}.{name[5:]}"
+        return f"{mod.relpath}:{name}"
+
+    # ------------------------------------------- transitive closures
+
+    def blocking_chain(self, qname: str,
+                       _stack: Optional[set] = None) -> Optional[Tuple]:
+        """Shortest-found chain of (qname, lineno, desc) steps from
+        qname to a blocking primitive, or None.
+
+        Cycle discipline: a node on the walk stack contributes nothing
+        to THIS traversal, and a negative computed while any ancestor
+        was on the stack is provisional — memoizing it would hide real
+        chains from other roots (a->b->a with a->c->fsync must still
+        find b's chain through a). Positives are always definitive
+        (existence proven); negatives memoize only when untainted."""
+        memo = self._blocking_chain_memo
+        if qname in memo:
+            return memo[qname]
+        _stack = _stack if _stack is not None else set()
+        if qname in _stack:
+            return None          # cycle-truncated: caller marks taint
+        s = self.functions.get(qname)
+        if s is None:
+            memo[qname] = None
+            return None
+        if s.blocking:
+            what, lineno = s.blocking[0]
+            memo[qname] = ((qname, lineno, f"{what}()"),)
+            return memo[qname]
+        _stack.add(qname)
+        best: Optional[Tuple] = None
+        tainted = False
+        try:
+            for site in s.calls:
+                if site.off_loop:
+                    continue
+                for callee in site.callees:
+                    if callee in _stack:
+                        tainted = True
+                        continue
+                    sub = self.blocking_chain(callee, _stack)
+                    if sub is None and callee not in memo:
+                        tainted = True   # callee's negative was provisional
+                    if sub is not None:
+                        cand = ((qname, site.lineno, site.name),) + sub
+                        if best is None or len(cand) < len(best):
+                            best = cand
+        finally:
+            _stack.discard(qname)
+        if best is not None or not tainted:
+            memo[qname] = best
+        return best
+
+    def transitive_acquires(self, qname: str,
+                            _stack=None) -> Dict[str, Tuple]:
+        """lock id -> (site relpath, lineno, via) for every lock this
+        function (or anything it calls) acquires. A set assembled while
+        a cycle truncated part of the walk is provisional and NOT
+        memoized (it may undercount for other roots)."""
+        if qname in self._acq_memo:
+            return self._acq_memo[qname]
+        _stack = _stack if _stack is not None else set()
+        if qname in _stack:
+            return {}
+        _stack.add(qname)
+        s = self.functions.get(qname)
+        out: Dict[str, Tuple] = {}
+        tainted = False
+        try:
+            if s is not None:
+                for lid, lineno in s.acquires:
+                    out.setdefault(lid, (s.mod.relpath, lineno, qname))
+                for site in s.calls:
+                    for callee in site.callees:
+                        if callee in _stack:
+                            tainted = True
+                            continue
+                        for lid, info in self.transitive_acquires(
+                                callee, _stack).items():
+                            out.setdefault(lid, info)
+                        if callee not in self._acq_memo:
+                            tainted = True
+        finally:
+            _stack.discard(qname)
+        if not tainted:
+            self._acq_memo[qname] = out
+        return out
+
+    def resource_label(self, qname: str, _stack=None) -> str:
+        """Constructor label when qname (transitively) returns a fresh
+        close-needing resource — the interprocedural 'factory' set.
+        Positives memoize always; a negative found through a cycle-
+        truncated walk stays unmemoized."""
+        if qname in self._resource_memo:
+            return self._resource_memo[qname]
+        _stack = _stack if _stack is not None else set()
+        if qname in _stack:
+            return ""
+        _stack.add(qname)
+        s = self.functions.get(qname)
+        label = ""
+        tainted = False
+        try:
+            if s is not None:
+                label = s.returns_resource
+                if not label:
+                    for callee in s.returns_calls:
+                        if callee in _stack:
+                            tainted = True
+                            continue
+                        label = self.resource_label(callee, _stack)
+                        if not label and \
+                                callee not in self._resource_memo:
+                            tainted = True
+                        if label:
+                            break
+        finally:
+            _stack.discard(qname)
+        if label or not tainted:
+            self._resource_memo[qname] = label
+        return label
+
+    def render_chain(self, chain: Iterable[Tuple]) -> str:
+        steps = []
+        for qname, lineno, name in chain:
+            short = qname.split(":", 1)[-1]
+            steps.append(f"{short} ({qname.split(':', 1)[0]}:{lineno})")
+        return " -> ".join(steps)
+
+
+# --------------------------------------------------------------- cache
+
+_CACHE: List[Tuple[Tuple[int, ...], CallGraph]] = []
+
+
+def get(mods: Sequence[Module]) -> CallGraph:
+    """One CallGraph per module set per run. Keyed on module object
+    identity (the engine holds them alive for the run's duration); a
+    tiny LRU so interleaved fixture checks don't thrash."""
+    key = tuple(id(m) for m in mods)
+    for k, g in _CACHE:
+        if k == key:
+            return g
+    g = CallGraph(mods)
+    _CACHE.append((key, g))
+    del _CACHE[:-4]
+    return g

@@ -128,13 +128,19 @@ def _statement_spans(tree: ast.Module) -> List[tuple]:
         if not isinstance(node, (ast.stmt, ast.excepthandler)) or \
                 not getattr(node, "end_lineno", None):
             continue
+        # a decorated def/class: the decorators ARE part of the logical
+        # header (node.lineno is the `def` line, so findings anchored at
+        # a decorator line used to live in no span and a suppression
+        # comment elsewhere in the header could never reach them)
+        start = node.lineno
+        for dec in getattr(node, "decorator_list", ()) or ():
+            start = min(start, dec.lineno)
         body = getattr(node, "body", None)
         if body and isinstance(body, list) and body \
                 and hasattr(body[0], "lineno"):
-            spans.append((node.lineno,
-                          max(node.lineno, body[0].lineno - 1)))
+            spans.append((start, max(node.lineno, body[0].lineno - 1)))
         else:
-            spans.append((node.lineno, node.end_lineno))
+            spans.append((start, node.end_lineno))
     return spans
 
 
@@ -372,32 +378,73 @@ def _iter_py_files(paths: Sequence[str]) -> Iterator[str]:
                     yield os.path.join(dirpath, name)
 
 
-def collect_modules(root: str, paths: Sequence[str]
+def _load_one(args: tuple) -> object:
+    """Process-pool worker: parse one file, returning either the Module
+    or a parse-error Diagnostic. Top-level (picklable) by necessity."""
+    apath, rel = args
+    try:
+        return load_module(apath, rel)
+    except SyntaxError as e:
+        return Diagnostic(rule="parse-error", path=rel,
+                          line=e.lineno or 1,
+                          message=f"does not parse: {e.msg}")
+
+
+def collect_modules(root: str, paths: Sequence[str], jobs: int = 1
                     ) -> tuple[List[Module], List[Diagnostic]]:
     """Parse every .py under paths. Unparseable files become findings
     (rule ``parse-error``) rather than crashing the run — a syntax error
-    in the tree is itself the worst lint finding there is."""
-    mods: List[Module] = []
-    errors: List[Diagnostic] = []
+    in the tree is itself the worst lint finding there is.
+
+    ``jobs > 1`` fans the parse (the dominant cost of a full-tree run)
+    across a process pool; results come back in deterministic file
+    order either way, so fingerprints and occurrence indexes match the
+    serial run exactly. Any pool-level failure falls back to serial —
+    a lint gate must never fail because fork/pickle did."""
+    work = []
     seen = set()
     for path in _iter_py_files(paths):
         apath = os.path.abspath(path)
         if apath in seen:
             continue
         seen.add(apath)
-        rel = os.path.relpath(apath, root).replace(os.sep, "/")
+        work.append((apath,
+                     os.path.relpath(apath, root).replace(os.sep, "/")))
+
+    results: List[object] = []
+    if jobs > 1 and len(work) > 1:
         try:
-            mods.append(load_module(apath, rel))
-        except SyntaxError as e:
-            errors.append(Diagnostic(
-                rule="parse-error", path=rel, line=e.lineno or 1,
-                message=f"does not parse: {e.msg}"))
+            import multiprocessing
+            from concurrent.futures import ProcessPoolExecutor
+            # spawn, never fork: a forked child of a multithreaded
+            # parent (pytest with JAX imported) inherits locks held
+            # mid-operation by threads that don't exist in the child —
+            # an intermittent hang the serial fallback cannot catch
+            # because a deadlocked map never raises
+            with ProcessPoolExecutor(
+                    max_workers=jobs,
+                    mp_context=multiprocessing.get_context("spawn")) \
+                    as pool:
+                results = list(pool.map(_load_one, work,
+                                        chunksize=max(1, len(work) // (jobs * 4))))
+        except Exception:
+            # pool/pickle trouble only — real parse errors come back as
+            # values, and anything genuine re-raises from the serial
+            # fallback below
+            results = []
+    if not results:
+        results = [_load_one(w) for w in work]
+
+    mods: List[Module] = []
+    errors: List[Diagnostic] = []
+    for r in results:
+        (errors if isinstance(r, Diagnostic) else mods).append(r)
     return mods, errors
 
 
 def run(root: str, paths: Sequence[str],
         rule_names: Optional[Sequence[str]] = None,
-        baseline: Optional[Baseline] = None) -> Report:
+        baseline: Optional[Baseline] = None, jobs: int = 1) -> Report:
     """Analyze paths (files or directories) against the registry.
 
     root anchors relpaths (and therefore fingerprints): pass the repo
@@ -411,7 +458,7 @@ def run(root: str, paths: Sequence[str],
                              f"known: {', '.join(sorted(rules))}")
         rules = {k: v for k, v in rules.items() if k in rule_names}
 
-    mods, parse_errors = collect_modules(root, paths)
+    mods, parse_errors = collect_modules(root, paths, jobs=jobs)
     # unparseable files still count as checked — they produced findings
     report = Report(files_checked=len(mods) + len(parse_errors),
                     rules_run=set(rules))

@@ -7,18 +7,8 @@ import ast
 import sys
 
 from ..astutil import resolve_call_path, walk_body
+from ..callgraph import BLOCKING_PRIMITIVES as BLOCKING
 from ..engine import Rule, register
-
-# (module, attr) pairs that block the calling thread — and therefore the
-# whole event loop — for unbounded time
-BLOCKING = {
-    ("os", "fsync"): "use run_in_executor",
-    ("os", "fdatasync"): "use run_in_executor",
-    ("time", "sleep"): "use asyncio.sleep (or run_in_executor)",
-    ("subprocess", "run"): "use asyncio.create_subprocess_exec",
-    ("subprocess", "check_output"): "use asyncio.create_subprocess_exec",
-    ("subprocess", "check_call"): "use asyncio.create_subprocess_exec",
-}
 
 
 @register

@@ -1,0 +1,390 @@
+"""Inter-procedural rules: the weedlint v2 layer.
+
+Every rule here is written against the call-graph/effect-summary plane
+(:mod:`..callgraph`) instead of a single function's AST — the whole
+point is that one level of indirection must not launder a blocking
+call, a held lock, a dropped deadline budget, or an escaping handle.
+
+Laundering via executor stays structural: a helper handed to
+``run_in_executor`` is an *argument*, not a call expression, so it
+never produces a call edge — only code that actually runs on the
+loop/thread at hand is on a chain.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict
+
+from .. import callgraph
+from ..astutil import walk_body
+from ..engine import Rule, register
+from .resources import classify_local_ownership, collect_finally_nodes
+
+
+@register
+class BlockingCallTransitive(Rule):
+    name = "blocking-call-transitive"
+    rationale = ("a coroutine that reaches os.fsync/time.sleep/"
+                 "subprocess through ANY chain of ordinary calls stalls "
+                 "its event loop exactly like a direct call — wrapping "
+                 "the blocker in a helper must not launder it (only "
+                 "run_in_executor does)")
+    scope = ("seaweedfs_tpu/",)
+    fixture = (
+        "import os\n"
+        "def _persist(fd):\n"
+        "    os.fsync(fd)\n"
+        "def _sync_all(fds):\n"
+        "    for fd in fds:\n"
+        "        _persist(fd)\n"
+        "async def bad(self, fd):\n"
+        "    _persist(fd)\n"           # depth 2: v1 cannot see this
+        "async def bad2(self, fds):\n"
+        "    _sync_all(fds)\n"         # depth 3
+    )
+    clean_fixture = (
+        "import os\n"
+        "def _persist(fd):\n"
+        "    os.fsync(fd)\n"
+        "async def good(self, loop, fd):\n"
+        "    await loop.run_in_executor(None, _persist, fd)\n"
+        "async def good2(self, loop, fd):\n"
+        "    def _job():\n"
+        "        _persist(fd)\n"
+        "    await loop.run_in_executor(None, _job)\n"
+        "def sync_path(fd):\n"
+        "    _persist(fd)\n"           # sync callers may block freely
+        # the no-loop fallback idiom: the RuntimeError handler of a
+        # loop probe only ever runs when NO loop exists to stall
+        "def schedule(self, fd):\n"
+        "    import asyncio\n"
+        "    try:\n"
+        "        asyncio.ensure_future(self._flush())\n"
+        "    except RuntimeError:\n"
+        "        _persist(fd)\n"
+        "async def caller(self, fd):\n"
+        "    self.schedule(fd)\n"
+    )
+
+    def check_project(self, mods):
+        graph = callgraph.get(mods)
+        for summary in graph.functions.values():
+            if not summary.is_async:
+                continue
+            for site in summary.calls:
+                if site.off_loop:
+                    continue
+                for callee in site.callees:
+                    chain = graph.blocking_chain(callee)
+                    if chain is None:
+                        continue
+                    # depth-1 (a blocking primitive called directly in
+                    # the coroutine) is async-blocking-call's finding;
+                    # re-reporting it here would double every baseline
+                    # fingerprint
+                    yield self.diag(
+                        summary.mod, site.lineno,
+                        f"async def {summary.node.name} reaches "
+                        f"{chain[-1][2]} on the event loop through "
+                        f"{graph.render_chain(chain)} — move the "
+                        f"blocking step into run_in_executor (no call "
+                        f"chain launders it)")
+                    break   # one finding per call site
+
+
+@register
+class LockHeldAwaitTransitive(Rule):
+    name = "lock-held-await-transitive"
+    rationale = ("holding a thread mutex across a call chain that "
+                 "blocks (or across a generator's yield consumed under "
+                 "awaits) parks every thread and coroutine sharing the "
+                 "lock — the lock-held-await rule for effects one or "
+                 "more calls away")
+    scope = ("seaweedfs_tpu/",)
+    fixture = (
+        "import os\n"
+        "def _persist(fd):\n"
+        "    os.fsync(fd)\n"
+        "async def bad(self, fd):\n"
+        "    with self._lock:\n"
+        "        _persist(fd)\n"       # mutex held across a disk flush
+        "def _locked_items(self):\n"
+        "    with self._lock:\n"
+        "        yield from self._items\n"
+        "async def bad2(self):\n"
+        "    for x in _locked_items(self):\n"
+        "        await self.process(x)\n"   # lock parked across awaits
+    )
+    clean_fixture = (
+        "import os\n"
+        "def _persist(fd):\n"
+        "    os.fsync(fd)\n"
+        "async def good(self, fd):\n"
+        "    with self._lock:\n"
+        "        state = dict(self._cache)\n"
+        "    _persist_via_executor = None\n"
+        "def _items(self):\n"
+        "    with self._lock:\n"
+        "        snapshot = list(self._items)\n"
+        "    yield from snapshot\n"
+        "async def good2(self):\n"
+        "    for x in _items(self):\n"
+        "        await self.process(x)\n"
+    )
+
+    def check_project(self, mods):
+        graph = callgraph.get(mods)
+        for summary in graph.functions.values():
+            if not summary.is_async:
+                continue
+            # (a) a sync call made while holding a lock, whose chain
+            #     blocks — the direct-await case is lock-held-await's
+            for site in summary.calls:
+                if not site.held_locks:
+                    continue
+                for callee in site.callees:
+                    chain = graph.blocking_chain(callee)
+                    if chain is None:
+                        continue
+                    yield self.diag(
+                        summary.mod, site.lineno,
+                        f"async def {summary.node.name} holds "
+                        f"{site.held_locks[0]} across "
+                        f"{graph.render_chain(chain)} reaching "
+                        f"{chain[-1][2]} — the mutex is parked for the "
+                        f"full blocking call; copy state out, release, "
+                        f"then do the slow work")
+                    break
+            # (b) iterating a generator that yields while holding a
+            #     lock, with awaits in the loop body: the generator
+            #     parks its lock across every suspension of the
+            #     consumer
+            yield from self._check_locked_generators(graph, summary)
+
+    def _check_locked_generators(self, graph, summary):
+        for node in ast.walk(summary.node):
+            if not isinstance(node, (ast.For, ast.AsyncFor)):
+                continue
+            it = node.iter
+            if not isinstance(it, ast.Call):
+                continue
+            callees = graph.call_resolutions.get(id(it), ())
+            for callee in callees:
+                gen = graph.functions.get(callee)
+                if gen is None or not gen.yields_holding:
+                    continue
+                has_await = any(
+                    isinstance(n, (ast.Await, ast.AsyncFor,
+                                   ast.AsyncWith))
+                    for stmt in node.body for n in ast.walk(stmt))
+                if not has_await:
+                    continue
+                yield self.diag(
+                    summary.mod, node.lineno,
+                    f"async def {summary.node.name} awaits inside a "
+                    f"loop over {gen.qname.split(':', 1)[-1]}(), which "
+                    f"yields while holding {gen.yields_holding[0]} — "
+                    f"the generator parks the lock across every await "
+                    f"of the consumer; snapshot under the lock, yield "
+                    f"outside it")
+
+
+# serving planes where a dropped deadline budget is a real bug: these
+# modules run under the trace middleware's bound budget (or are called
+# from code that does). shell/cli/integrations are interactive entry
+# points that START budgets instead of inheriting them.
+_DEADLINE_PLANES = (
+    "seaweedfs_tpu/server/", "seaweedfs_tpu/filer/",
+    "seaweedfs_tpu/storage/", "seaweedfs_tpu/replication/",
+    "seaweedfs_tpu/messaging/", "seaweedfs_tpu/mount/",
+    "seaweedfs_tpu/geo/", "seaweedfs_tpu/metaring/",
+    "seaweedfs_tpu/notification/", "seaweedfs_tpu/cluster/",
+    "seaweedfs_tpu/topology/", "seaweedfs_tpu/ec/",
+    "seaweedfs_tpu/cache/", "seaweedfs_tpu/s3/",
+)
+
+
+@register
+class DeadlinePropagation(Rule):
+    name = "deadline-propagation"
+    rationale = ("an outbound hop that neither forwards X-Seaweed-"
+                 "Deadline (retry.inject_deadline) nor caps its socket "
+                 "timeout by the remaining budget (retry.cap_timeout) "
+                 "lets one slow peer spend time the caller no longer "
+                 "has — the budget dies at that hop and every "
+                 "downstream retry is wasted work")
+    scope = ("seaweedfs_tpu/",)
+    fixture = (
+        "import urllib.request\n"
+        "def _post(url, headers):\n"
+        "    req = urllib.request.Request(url, headers=headers)\n"
+        "    return urllib.request.urlopen(req, timeout=5)\n"
+        "def bad_helper_caller(self, url):\n"
+        "    return _post(url, {'X-Thing': '1'})\n"   # budget dropped here
+        "def bad_direct(url):\n"
+        "    return urllib.request.urlopen(url, timeout=5)\n"
+    )
+    clean_fixture = (
+        "import urllib.request\n"
+        "from ..utils import retry\n"
+        "def _post(url, headers):\n"
+        "    req = urllib.request.Request(\n"
+        "        url, headers=retry.inject_deadline(dict(headers)))\n"
+        "    return urllib.request.urlopen(req, timeout=5)\n"
+        "def good_caller(self, url):\n"
+        "    return _post(url, {'X-Thing': '1'})\n"
+        "def good_external(url, timeout):\n"
+        "    return urllib.request.urlopen(\n"
+        "        url, timeout=retry.cap_timeout(timeout))\n"
+    )
+
+    def check_project(self, mods):
+        graph = callgraph.get(mods)
+        for summary in graph.functions.values():
+            if not summary.mod.relpath.startswith(_DEADLINE_PLANES) or \
+                    not summary.raw_outbound or summary.launders_deadline:
+                continue
+            if summary.headers_delegated:
+                # the helper forwards caller-built headers: every
+                # resolved caller that doesn't launder the budget owns
+                # the finding (the one-level-of-indirection case)
+                callers = graph.callers.get(summary.qname, ())
+                flagged_any = False
+                for caller_q, lineno in callers:
+                    caller = graph.functions.get(caller_q)
+                    if caller is None or caller.launders_deadline or \
+                            not caller.mod.relpath.startswith(
+                                _DEADLINE_PLANES):
+                        continue
+                    flagged_any = True
+                    yield self.diag(
+                        caller.mod, lineno,
+                        f"{caller.node.name} sends headers through "
+                        f"{summary.node.name} -> urlopen without the "
+                        f"deadline budget — wrap them in retry."
+                        f"inject_deadline(...) (or cap the timeout "
+                        f"with retry.cap_timeout) so X-Seaweed-"
+                        f"Deadline survives the hop")
+                if flagged_any or callers:
+                    continue
+            for lineno in summary.raw_outbound:
+                yield self.diag(
+                    summary.mod, lineno,
+                    f"{summary.node.name} makes a raw outbound "
+                    f"request that drops the deadline budget — "
+                    f"inject X-Seaweed-Deadline via retry."
+                    f"inject_deadline(headers) for intra-cluster "
+                    f"hops, or bound the socket with timeout="
+                    f"retry.cap_timeout(...) for external endpoints")
+
+
+@register
+class ResourceLeakInterproc(Rule):
+    name = "resource-leak-interproc"
+    rationale = ("a function that returns a fresh file/mmap/socket/"
+                 "session is a constructor: a caller that neither "
+                 "closes, transfers, nor `with`s the result leaks it — "
+                 "the resource-leak rule applied across the call edge "
+                 "the v1 rule had to trust blindly ('ownership "
+                 "transferred out')")
+    scope = ("seaweedfs_tpu/",)
+    fixture = (
+        "def open_index(p):\n"
+        "    return open(p, 'rb')\n"
+        "def open_index_checked(p):\n"
+        "    fh = open(p, 'rb')\n"
+        "    return fh\n"
+        "def bad(p):\n"
+        "    fh = open_index(p)\n"
+        "    data = fh.read()\n"       # raises -> fh leaks
+        "    fh.close()\n"
+        "    return data\n"
+        "def bad2(p):\n"
+        "    open_index_checked(p)\n"  # constructed and dropped
+    )
+    clean_fixture = (
+        "def open_index(p):\n"
+        "    return open(p, 'rb')\n"
+        "def good(p):\n"
+        "    with open_index(p) as fh:\n"
+        "        return fh.read()\n"
+        "def good2(p):\n"
+        "    fh = open_index(p)\n"
+        "    try:\n"
+        "        return fh.read()\n"
+        "    finally:\n"
+        "        fh.close()\n"
+        "def good3(p):\n"
+        "    return open_index(p)\n"   # still a constructor: callers own
+        "def good4(self, p):\n"
+        "    self._fh = open_index(p)\n"   # lifecycle-managed elsewhere
+    )
+
+    def check_project(self, mods):
+        graph = callgraph.get(mods)
+        factories: Dict[str, str] = {}
+        for qname in graph.functions:
+            label = graph.resource_label(qname)
+            if label:
+                factories[qname] = label
+
+        for summary in graph.functions.values():
+            fn = summary.node
+            finally_nodes = None
+            for node in walk_body(fn):
+                call, target = self._factory_site(node, graph, factories)
+                if call is None:
+                    continue
+                label = factories[
+                    graph.call_resolutions[id(call)][0]]
+                short = (graph.call_resolutions[id(call)][0]
+                         .split(":", 1)[-1])
+                if target is None:
+                    yield self.diag(
+                        summary.mod, node.lineno,
+                        f"{short}(...) returns a fresh {label} that is "
+                        f"immediately dropped — the handle can never "
+                        f"be closed")
+                    continue
+                if finally_nodes is None:
+                    finally_nodes = collect_finally_nodes(fn)
+                verdict = classify_local_ownership(fn, target,
+                                                   finally_nodes)
+                if verdict is None:
+                    continue
+                kind, close_line = verdict
+                if kind == "unclosed":
+                    yield self.diag(
+                        summary.mod, node.lineno,
+                        f"{short}(...) returns a fresh {label} "
+                        f"assigned to '{target}' but never closed in "
+                        f"this scope — use with, or close in a "
+                        f"finally")
+                else:
+                    yield self.diag(
+                        summary.mod, node.lineno,
+                        f"{short}(...) returns a fresh {label} "
+                        f"assigned to '{target}' closed only on the "
+                        f"happy path — an exception before "
+                        f"{target}.close() (line {close_line}) leaks "
+                        f"it; use with, or move the close into a "
+                        f"finally")
+
+    @staticmethod
+    def _factory_site(node, graph, factories):
+        """(call, local_name|None) when this statement materializes a
+        factory result: Expr-dropped (None target) or single-Name
+        assignment. Returns (None, None) otherwise."""
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            callees = graph.call_resolutions.get(id(node.value), ())
+            if callees and callees[0] in factories:
+                return node.value, None
+        elif isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            callees = graph.call_resolutions.get(id(node.value), ())
+            if callees and callees[0] in factories:
+                return node.value, node.targets[0].id
+        return None, None

@@ -88,7 +88,10 @@ class LockOrdering(Rule):
     name = "lock-ordering"
     rationale = ("two code paths that nest the same pair of locks in "
                  "opposite orders deadlock under load; acquisition "
-                 "edges are collected tree-wide and cycles rejected")
+                 "edges are collected tree-wide — lexical nestings AND "
+                 "summarized edges through call chains (a helper that "
+                 "takes lock B, called under lock A, is an A->B edge "
+                 "even across module boundaries)")
     scope = ("seaweedfs_tpu/",)
     fixture = (
         "class A:\n"
@@ -100,6 +103,19 @@ class LockOrdering(Rule):
         "        with self._flush_lock:\n"
         "            with self._map_lock:\n"
         "                pass\n"
+        "class B:\n"
+        # the call-mediated shape: one() nests j under i lexically;
+        # two() reaches i while holding j only THROUGH _grab_i()
+        "    def one(self):\n"
+        "        with self._i_lock:\n"
+        "            with self._j_lock:\n"
+        "                pass\n"
+        "    def _grab_i(self):\n"
+        "        with self._i_lock:\n"
+        "            pass\n"
+        "    def two(self):\n"
+        "        with self._j_lock:\n"
+        "            self._grab_i()\n"
     )
     clean_fixture = (
         "class A:\n"
@@ -152,11 +168,35 @@ class LockOrdering(Rule):
 
     def check_project(self, mods):
         graph: Dict[str, set] = {}
-        sites: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        sites: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
         for mod in mods:
             for a, b, line in self._edges(mod):
                 graph.setdefault(a, set()).add(b)
-                sites.setdefault((a, b), (mod.relpath, line))
+                sites.setdefault((a, b), (mod.relpath, line, ""))
+
+        # v2: summarized acquisition edges — a call made while holding
+        # lock A, to a function whose transitive closure acquires lock
+        # B, is an A->B edge even when the nesting spans modules
+        from .. import callgraph as cg
+        cgraph = cg.get(mods)
+        for summary in cgraph.functions.values():
+            for site in summary.calls:
+                if not site.held_locks:
+                    continue
+                for callee in site.callees:
+                    for b, (bpath, bline, via) in \
+                            cgraph.transitive_acquires(callee).items():
+                        for a in site.held_locks:
+                            if a == b:
+                                continue
+                            graph.setdefault(a, set()).add(b)
+                            sites.setdefault(
+                                (a, b),
+                                (summary.mod.relpath, site.lineno,
+                                 f" (via {via.split(':', 1)[-1]}, "
+                                 f"which acquires {b} at "
+                                 f"{bpath}:{bline})"))
+
         def reaches(src: str, dst: str) -> bool:
             seen, stack = set(), [src]
             while stack:
@@ -177,12 +217,13 @@ class LockOrdering(Rule):
             for b in sorted(graph[a]):
                 if not reaches(b, a):
                     continue
-                path, line = sites[(a, b)]
+                path, line, via = sites[(a, b)]
                 mod = by_path.get(path)
                 if mod is None:
                     continue
                 yield self.diag(
                     mod, line,
-                    f"lock-order cycle: {a} -> {b} acquired here, but "
-                    f"another path acquires {b} before {a} — opposite "
-                    f"nesting orders deadlock under load")
+                    f"lock-order cycle: {a} -> {b} acquired here"
+                    f"{via}, but another path acquires {b} before "
+                    f"{a} — opposite nesting orders deadlock under "
+                    f"load")

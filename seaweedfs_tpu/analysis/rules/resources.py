@@ -9,15 +9,8 @@ import ast
 from typing import Iterator, List
 
 from ..astutil import FUNC_DEFS, resolve_call_path, walk_body
+from ..callgraph import RESOURCE_CONSTRUCTORS as _CONSTRUCTORS
 from ..engine import Rule, register
-
-_CONSTRUCTORS = {
-    ("open",): "open",
-    ("os", "fdopen"): "os.fdopen",
-    ("mmap", "mmap"): "mmap.mmap",
-    ("socket", "socket"): "socket.socket",
-    ("aiohttp", "ClientSession"): "aiohttp.ClientSession",
-}
 
 # raw-handle constructors additionally tracked in comprehensions: a
 # failure mid-comprehension leaks every handle already produced (the
@@ -112,14 +105,8 @@ class ResourceLeak(Rule):
                 for item in node.items:
                     with_ctx_calls.add(id(item.context_expr))
 
-        # collect finally-block subtrees once: a close is error-safe
-        # only if it runs under one
-        finally_nodes = set()
-        for node in walk_body(fn):
-            if isinstance(node, ast.Try):
-                for stmt in node.finalbody:
-                    for n in ast.walk(stmt):
-                        finally_nodes.add(id(n))
+        # a close is error-safe only under a finally block
+        finally_nodes = collect_finally_nodes(fn)
 
         # a resource constructor as a comprehension element: a failure
         # mid-comprehension leaks every handle already opened, and no
@@ -170,50 +157,80 @@ class ResourceLeak(Rule):
 
     def _check_local(self, mod, fn, assign, name: str, label: str,
                      finally_nodes) -> Iterator:
-        closes: List[ast.AST] = []
-        transferred = False
-        in_with = False
-        for n in ast.walk(fn):
-            if isinstance(n, (ast.With, ast.AsyncWith)):
-                for item in n.items:
-                    ce = item.context_expr
-                    if isinstance(ce, ast.Name) and ce.id == name:
-                        in_with = True
-            elif isinstance(n, ast.Call):
-                f = n.func
-                if isinstance(f, ast.Attribute) and \
-                        f.attr in ("close", "detach", "release") and \
-                        isinstance(f.value, ast.Name) and \
-                        f.value.id == name:
-                    closes.append(n)
-                # bare handle passed to another call: ownership moves
-                for arg in list(n.args) + [k.value for k in n.keywords]:
-                    if isinstance(arg, ast.Name) and arg.id == name:
-                        transferred = True
-            elif isinstance(n, (ast.Return, ast.Yield, ast.YieldFrom)) \
-                    and isinstance(getattr(n, "value", None), ast.Name) \
-                    and n.value.id == name:
-                transferred = True
-            elif isinstance(n, ast.Assign):
-                # stored into an attribute/subscript/tuple: managed
-                # beyond this scope
-                if isinstance(n.value, ast.Name) and n.value.id == name:
-                    transferred = True
-            elif isinstance(n, ast.Await) and \
-                    isinstance(n.value, ast.Name) and \
-                    n.value.id == name:
-                transferred = True
-        if in_with or transferred:
+        verdict = classify_local_ownership(fn, name, finally_nodes)
+        if verdict is None:
             return
-        if not closes:
+        kind, close_line = verdict
+        if kind == "unclosed":
             yield self.diag(
                 mod, assign.lineno,
                 f"{label}(...) assigned to '{name}' but never closed "
                 f"in this scope — use with, or close in a finally")
-        elif not any(id(c) in finally_nodes for c in closes):
+        else:
             yield self.diag(
                 mod, assign.lineno,
                 f"{label}(...) assigned to '{name}' is closed only on "
                 f"the happy path — an exception before "
-                f"{name}.close() (line {closes[0].lineno}) leaks it; "
+                f"{name}.close() (line {close_line}) leaks it; "
                 f"use with, or move the close into a finally")
+
+
+def collect_finally_nodes(fn) -> set:
+    """ids of every node running under a finally block in this scope —
+    a close is error-safe only there."""
+    out = set()
+    for node in walk_body(fn):
+        if isinstance(node, ast.Try):
+            for stmt in node.finalbody:
+                for n in ast.walk(stmt):
+                    out.add(id(n))
+    return out
+
+
+def classify_local_ownership(fn, name: str, finally_nodes):
+    """Escape analysis for a local holding a fresh close-needing
+    handle. Returns None when the scope manages it (with/transfer/
+    finally-close), ('unclosed', None) when nothing ever closes it, or
+    ('happy-path', close_lineno) when the only closes can be skipped
+    by an exception. Shared by resource-leak (direct constructors) and
+    resource-leak-interproc (factory returns)."""
+    closes: List[ast.AST] = []
+    transferred = False
+    in_with = False
+    for n in ast.walk(fn):
+        if isinstance(n, (ast.With, ast.AsyncWith)):
+            for item in n.items:
+                ce = item.context_expr
+                if isinstance(ce, ast.Name) and ce.id == name:
+                    in_with = True
+        elif isinstance(n, ast.Call):
+            f = n.func
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in ("close", "detach", "release") and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id == name:
+                closes.append(n)
+            # bare handle passed to another call: ownership moves
+            for arg in list(n.args) + [k.value for k in n.keywords]:
+                if isinstance(arg, ast.Name) and arg.id == name:
+                    transferred = True
+        elif isinstance(n, (ast.Return, ast.Yield, ast.YieldFrom)) \
+                and isinstance(getattr(n, "value", None), ast.Name) \
+                and n.value.id == name:
+            transferred = True
+        elif isinstance(n, ast.Assign):
+            # stored into an attribute/subscript/tuple: managed
+            # beyond this scope
+            if isinstance(n.value, ast.Name) and n.value.id == name:
+                transferred = True
+        elif isinstance(n, ast.Await) and \
+                isinstance(n.value, ast.Name) and \
+                n.value.id == name:
+            transferred = True
+    if in_with or transferred:
+        return None
+    if not closes:
+        return ("unclosed", None)
+    if not any(id(c) in finally_nodes for c in closes):
+        return ("happy-path", closes[0].lineno)
+    return None

@@ -23,6 +23,7 @@ import urllib.request
 from typing import Optional
 from urllib.parse import quote
 
+from ..utils import retry
 from .entry import Entry
 from .stores import FilerStore, _split
 
@@ -80,7 +81,10 @@ class ElasticStore(FilerStore):
                         if self._auth else {})},
             method=method)
         try:
-            with urllib.request.urlopen(req, timeout=self._timeout) as r:
+            # external elasticsearch endpoint: honor any ambient budget
+            # by bounding the socket (no cluster headers leak out)
+            with urllib.request.urlopen(
+                    req, timeout=retry.cap_timeout(self._timeout)) as r:
                 body = r.read()
                 return json.loads(body) if body else {}
         except urllib.error.HTTPError as e:

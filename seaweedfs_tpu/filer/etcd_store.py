@@ -21,6 +21,7 @@ import json
 import urllib.request
 from typing import Optional
 
+from ..utils import retry
 from .entry import Entry
 from .stores import FilerStore, _split
 
@@ -64,7 +65,10 @@ class EtcdStore(FilerStore):
             f"{self._base}/v3/kv/{api}",
             data=json.dumps(payload).encode(),
             headers={"Content-Type": "application/json"}, method="POST")
-        with urllib.request.urlopen(req, timeout=self._timeout) as r:
+        # external etcd endpoint: honor any ambient budget by bounding
+        # the socket (no cluster headers leak out)
+        with urllib.request.urlopen(
+                req, timeout=retry.cap_timeout(self._timeout)) as r:
             return json.loads(r.read() or b"{}")
 
     def _put(self, key: bytes, value: bytes) -> None:

@@ -88,10 +88,12 @@ class FilerSegmentStore:
         name = f"{segment[0].ts_ns:020d}.log"
         body = "\n".join(json.dumps(e.to_dict(), separators=(",", ":"))
                          for e in segment).encode() + b"\n"
+        from ..utils import retry
         req = urllib.request.Request(
             f"http://{self.filer}{dir_path}/{name}", data=body, method="PUT",
-            headers={"Content-Type": "application/x-ndjson"})
-        urllib.request.urlopen(req, timeout=60).close()
+            headers=retry.inject_deadline(
+                {"Content-Type": "application/x-ndjson"}))
+        urllib.request.urlopen(req, timeout=retry.cap_timeout(60)).close()
 
     def drain(self) -> None:
         """Block until every segment write queued so far has landed.

@@ -16,6 +16,7 @@ import urllib.parse
 import urllib.request
 from typing import Callable, Iterator, Optional
 
+from ..utils import retry
 from ..utils.log_buffer import LogEntry
 
 
@@ -62,9 +63,11 @@ class Publisher:
         if not self.filer:
             return
         try:
+            req = urllib.request.Request(
+                f"http://{self.filer}/__meta__/brokers",
+                headers=retry.inject_deadline({}))
             with urllib.request.urlopen(
-                    f"http://{self.filer}/__meta__/brokers",
-                    timeout=10) as r:
+                    req, timeout=retry.cap_timeout(10)) as r:
                 brokers = json.load(r).get("brokers", [])
             if brokers:
                 self.brokers = brokers
@@ -79,9 +82,11 @@ class Publisher:
         for _ in range(3):
             req = urllib.request.Request(
                 url, data=body, method="POST",
-                headers={"Content-Type": "application/x-ndjson"})
+                headers=retry.inject_deadline(
+                    {"Content-Type": "application/x-ndjson"}))
             try:
-                with urllib.request.urlopen(req, timeout=60) as r:
+                with urllib.request.urlopen(
+                        req, timeout=retry.cap_timeout(60)) as r:
                     return json.load(r)
             except urllib.error.HTTPError as err:
                 if err.code in (301, 302, 307, 308):
@@ -154,7 +159,9 @@ class Subscriber:
                f"{self.partition}?"
                + urllib.parse.urlencode({"since": str(since)}))
         try:
-            with urllib.request.urlopen(url, timeout=timeout) as r:
+            req = urllib.request.Request(
+                url, headers=retry.inject_deadline({}))
+            with urllib.request.urlopen(req, timeout=timeout) as r:
                 for line in r:
                     line = line.strip()
                     if not line:

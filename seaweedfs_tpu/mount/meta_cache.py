@@ -14,6 +14,8 @@ import urllib.parse
 import urllib.request
 from typing import Callable, Optional
 
+from ..utils import retry
+
 
 class MetaCache:
     def __init__(self, ttl: float = 60.0):
@@ -67,7 +69,12 @@ class MetaCache:
                        + urllib.parse.urlencode({"since": str(since),
                                                  "prefix": prefix}))
                 try:
-                    with urllib.request.urlopen(url, timeout=None) as r:
+                    req = urllib.request.Request(
+                        url, headers=retry.inject_deadline({}))
+                    # long-lived tail: timeout=None is deliberate — the
+                    # stream lives as long as the mount, and the daemon
+                    # thread carries no ambient budget to cap it with
+                    with urllib.request.urlopen(req, timeout=None) as r:
                         for line in r:
                             if self._stop:
                                 return

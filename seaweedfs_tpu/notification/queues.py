@@ -94,13 +94,18 @@ class WebhookQueue:
 
     def _drain(self) -> None:
         import urllib.request
+
+        from ..utils import retry
         while True:
             body = self._q.get()
             req = urllib.request.Request(
                 self.url, data=body, method="POST",
                 headers={"Content-Type": "application/json"})
             try:
-                urllib.request.urlopen(req, timeout=self.timeout).close()
+                # external webhook: bound the socket by any ambient
+                # budget instead of leaking the cluster header
+                urllib.request.urlopen(
+                    req, timeout=retry.cap_timeout(self.timeout)).close()
             except Exception as e:
                 glog.warning("webhook notify %s failed: %s", self.url, e)
                 self._spool(body)

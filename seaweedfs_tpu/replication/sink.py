@@ -18,6 +18,7 @@ import urllib.request
 from typing import Callable, Optional
 
 from ..filer.entry import Entry
+from ..utils import retry
 
 
 class ReplicationSink:
@@ -114,26 +115,29 @@ class FilerSink(ReplicationSink):
             req = urllib.request.Request(
                 self._url(entry.full_path, op="mkdir",
                           signatures=self._sigs(signatures)),
-                method="POST")
+                method="POST", headers=retry.inject_deadline({}))
             try:
-                urllib.request.urlopen(req, timeout=60).close()
+                urllib.request.urlopen(
+                    req, timeout=retry.cap_timeout(60)).close()
             except urllib.error.HTTPError:
                 pass
             return
         req = urllib.request.Request(
             self._url(entry.full_path, signatures=self._sigs(signatures)),
             data=fetch_data(), method="PUT",
-            headers={"Content-Type": "application/octet-stream"})
-        urllib.request.urlopen(req, timeout=300).close()
+            headers=retry.inject_deadline(
+                {"Content-Type": "application/octet-stream"}))
+        urllib.request.urlopen(req, timeout=retry.cap_timeout(300)).close()
 
     def delete_entry(self, entry: Entry,
                      signatures: tuple[int, ...] = ()) -> None:
         req = urllib.request.Request(
             self._url(entry.full_path, recursive="true",
                       signatures=self._sigs(signatures)),
-            method="DELETE")
+            method="DELETE", headers=retry.inject_deadline({}))
         try:
-            urllib.request.urlopen(req, timeout=60).close()
+            urllib.request.urlopen(
+                req, timeout=retry.cap_timeout(60)).close()
         except urllib.error.HTTPError as e:
             if e.code != 404:
                 raise
@@ -226,7 +230,10 @@ class GcsSink(ReplicationSink):
             data=fetch_data(), method="POST",
             headers={"Content-Type": "application/octet-stream",
                      **self._headers()})
-        with urllib.request.urlopen(req, timeout=60) as r:
+        # external endpoint: honor the ambient budget by bounding the
+        # socket instead of leaking the cluster header
+        with urllib.request.urlopen(
+                req, timeout=retry.cap_timeout(60)) as r:
             r.read()
 
     def delete_entry(self, entry: Entry,
@@ -240,7 +247,8 @@ class GcsSink(ReplicationSink):
             f"{quote(key, safe='')}",
             method="DELETE", headers=self._headers())
         try:
-            with urllib.request.urlopen(req, timeout=60) as r:
+            with urllib.request.urlopen(
+                    req, timeout=retry.cap_timeout(60)) as r:
                 r.read()
         except urllib.error.HTTPError as e:
             if e.code != 404:
@@ -338,7 +346,10 @@ class AzureSink(ReplicationSink):
                + (f"?{qs}" if qs else ""))
         req = urllib.request.Request(url, data=body or None, method=verb,
                                      headers=headers)
-        with urllib.request.urlopen(req, timeout=60) as r:
+        # external endpoint: the budget bounds the socket; adding the
+        # cluster header here would also break the SharedKey signature
+        with urllib.request.urlopen(
+                req, timeout=retry.cap_timeout(60)) as r:
             r.read()
 
     def create_entry(self, entry: Entry,

@@ -1,0 +1,182 @@
+"""weedsan: the opt-in runtime concurrency sanitizer.
+
+weedlint judges the tree statically; weedsan watches the same
+invariants live, so chaos tests FAIL on the bugs static analysis can
+only guess at:
+
+  * :mod:`.lockgraph` — monkey-instruments ``threading.Lock``/``RLock``
+    and ``asyncio.Lock`` so every acquisition feeds a live
+    acquisition-order digraph. A cycle (lock A taken under B on one
+    stack, B under A on another) is reported with BOTH stacks — the
+    lockdep discipline, aggregated by lock creation site.
+  * :mod:`.loopwatch` — stamps every event-loop callback with a
+    wall-clock tripwire: a callback that holds the loop longer than
+    ``WEED_SANITIZE_BLOCK_MS`` (default 200) is a blocked event loop,
+    named by the coroutine that did it.
+  * :mod:`.restrack` — tracks task/ClientSession/mmap construction to
+    close: an object garbage-collected open (a task destroyed while
+    pending) is a leak, reported with its construction stack.
+
+Enable with ``WEED_SANITIZE=1`` (the pytest plugin in
+:mod:`.pytest_plugin` arms it for the chaos suites) or programmatically
+via :func:`enable`. Findings are :class:`Finding`s that render into
+the SAME content-addressed fingerprint scheme weedlint uses
+(rule|path|line-text|occurrence), so one suppression/baseline workflow
+covers static and dynamic findings alike: a ``# weedlint:
+disable=weedsan-lock-order`` comment at the anchored line suppresses
+the runtime finding exactly like a static one.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+ENV = "WEED_SANITIZE"
+BLOCK_MS_ENV = "WEED_SANITIZE_BLOCK_MS"
+
+#: repo root used to relativize finding paths AND to decide which
+#: construction sites are "ours" (stdlib/site-packages locks and tasks
+#: are never instrumented — wrapping logging's module locks would be
+#: all risk and no signal)
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_lock = threading.Lock()          # guards the finding list (never wrapped:
+                                  # created before enable() can run)
+_findings: List["Finding"] = []
+_enabled = False
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One runtime violation, anchored at a source line so it shares
+    weedlint's fingerprint scheme."""
+    rule: str          # weedsan-lock-order / weedsan-blocked-loop / ...
+    path: str          # repo-root-relative, posix
+    line: int
+    message: str       # includes the stack(s)
+
+    def to_diagnostic(self):
+        """The weedlint Diagnostic twin: line_text is read from the
+        live file so the fingerprint matches what a static rule
+        anchored at the same line would produce."""
+        from ..analysis.engine import Diagnostic
+        text = ""
+        try:
+            with open(os.path.join(REPO_ROOT, self.path),
+                      encoding="utf-8") as f:
+                lines = f.read().splitlines()
+            if 1 <= self.line <= len(lines):
+                text = lines[self.line - 1].strip()
+        except OSError:
+            pass
+        return Diagnostic(rule=self.rule, path=self.path, line=self.line,
+                          message=self.message, line_text=text)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def record(rule: str, path: str, line: int, message: str) -> None:
+    f = Finding(rule=rule, path=path, line=line, message=message)
+    with _lock:
+        _findings.append(f)
+
+
+def findings() -> List[Finding]:
+    with _lock:
+        return list(_findings)
+
+
+def mark() -> int:
+    """Position marker for findings_since — the pytest plugin brackets
+    each test with one."""
+    with _lock:
+        return len(_findings)
+
+
+def findings_since(marker: int) -> List[Finding]:
+    with _lock:
+        return list(_findings[marker:])
+
+
+def clear_findings() -> None:
+    with _lock:
+        del _findings[:]
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def block_ms_default() -> float:
+    try:
+        return float(os.environ.get(BLOCK_MS_ENV, "200"))
+    except ValueError:
+        return 200.0
+
+
+def enable(block_ms: Optional[float] = None) -> None:
+    """Idempotent. Instruments lock construction, event-loop callbacks
+    and resource constructors from this point on — objects created
+    before enable() stay untracked (the sanitizer must be armed before
+    the code under test builds its state, which is why the pytest
+    plugin arms it at configure time)."""
+    global _enabled
+    if _enabled:
+        return
+    from . import lockgraph, loopwatch, restrack
+    lockgraph.install()
+    loopwatch.install(block_ms if block_ms is not None
+                      else block_ms_default())
+    restrack.install()
+    _enabled = True
+
+
+def disable() -> None:
+    """Restore the patched constructors. Objects created while enabled
+    keep their (now inert) wrappers — tracking checks ``enabled()`` on
+    every hot-path hook, so a disabled sanitizer costs one boolean."""
+    global _enabled
+    if not _enabled:
+        return
+    from . import lockgraph, loopwatch, restrack
+    lockgraph.uninstall()
+    loopwatch.uninstall()
+    restrack.uninstall()
+    _enabled = False
+
+
+def site_from_stack(skip_modules=("sanitize",)) -> tuple:
+    """(relpath, lineno, stack_text) of the innermost repo-rooted frame
+    that is not the sanitizer itself; ('', 0, trace) when the event
+    originated entirely outside the repo.
+
+    The stack text is frame headers only — NO source-line rendering.
+    This runs on every tracked lock acquisition and task spawn; going
+    through traceback/linecache here turned a 14s chaos suite into a
+    timeout."""
+    import sys
+    frames = []
+    f = sys._getframe(1)
+    while f is not None and len(frames) < 40:
+        frames.append(f)
+        f = f.f_back
+    site = ("", 0)
+    for fr in frames:
+        fn = fr.f_code.co_filename
+        if not fn.startswith(REPO_ROOT):
+            continue
+        rel = os.path.relpath(fn, REPO_ROOT).replace(os.sep, "/")
+        if any(f"/{m}/" in f"/{rel}" for m in skip_modules):
+            continue
+        site = (rel, fr.f_lineno)
+        break
+    stack_text = "".join(
+        f'  File "{fr.f_code.co_filename}", line {fr.f_lineno}, '
+        f"in {fr.f_code.co_qualname if hasattr(fr.f_code, 'co_qualname') else fr.f_code.co_name}\n"
+        for fr in reversed(frames[:14]))
+    return site[0], site[1], stack_text

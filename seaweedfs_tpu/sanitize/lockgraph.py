@@ -1,0 +1,301 @@
+"""Live lock acquisition-order digraph (the lockdep discipline).
+
+Locks are aggregated by CREATION SITE, not instance — two ``Store``
+objects' ``self._lock`` are the same lock class, and a class-level
+ordering inversion deadlocks under load whether or not tonight's run
+interleaved the exact two instances. Edges record the acquisition
+stack; when a new edge closes a cycle, the finding carries BOTH stacks
+(this acquisition's and the stored reverse path's) so the report reads
+like the deadlock would.
+
+Only locks constructed from repo-rooted code are wrapped: stdlib
+internals (logging, concurrent.futures...) create locks constantly and
+instrumenting them is all risk and no signal. Same-site self-edges are
+ignored (hierarchical same-class locking is legitimate and cannot
+self-deadlock across classes).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from . import REPO_ROOT, record
+
+_real_Lock = threading.Lock
+_real_RLock = threading.RLock
+_real_async_Lock = asyncio.Lock
+
+# site -> site -> (stack_text, holder_desc) for the FIRST observation
+_edges: Dict[str, Dict[str, Tuple[str, str]]] = {}
+_graph_mutex = _real_Lock()
+# sites whose cycles were already reported (one finding per edge pair)
+_reported: set = set()
+
+_tls = threading.local()          # .held: list[(site, stack)] per thread
+_task_held: Dict[int, List[Tuple[str, str]]] = {}   # id(task) -> held
+
+
+def _creation_site() -> Optional[Tuple[str, int]]:
+    """Site only when the DIRECT constructor caller is repo code.
+
+    Walking further up would attribute stdlib-internal locks to
+    whatever repo line triggered them (a Condition built by
+    Thread.__init__, grpc channel internals behind dial()) — and
+    wrapping those is actively wrong: Condition drives its lock via
+    _release_save/_acquire_restore, bypassing the wrapper's
+    bookkeeping, so the held-list rots and fabricates cycles."""
+    f = sys._getframe(2)
+    while f is not None and "/sanitize/" in f.f_code.co_filename:
+        f = f.f_back
+    if f is None:
+        return None
+    fn = f.f_code.co_filename
+    if not fn.startswith(REPO_ROOT):
+        return None
+    return (os.path.relpath(fn, REPO_ROOT).replace(os.sep, "/"),
+            f.f_lineno)
+
+
+def _held_list() -> List[Tuple[str, str]]:
+    """The current execution context's held-lock list: thread-held
+    plus, when running inside an asyncio task, that task's held async
+    locks — a coroutine that mixes a thread mutex with an asyncio.Lock
+    can deadlock across the two worlds too."""
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    out = list(held)
+    try:
+        task = asyncio.current_task()
+    except RuntimeError:
+        task = None
+    if task is not None:
+        out += _task_held.get(id(task), [])
+    return out
+
+
+def _task_held_list() -> List[Tuple[str, str]]:
+    task = asyncio.current_task()
+    lst = _task_held.get(id(task))
+    if lst is None:
+        lst = _task_held[id(task)] = []
+        task.add_done_callback(
+            lambda t: _task_held.pop(id(t), None))
+    return lst
+
+
+def _on_acquired(site: str, holder: List[Tuple[str, str]]) -> str:
+    """Record edges held -> site; detect cycles. Returns the stack text
+    stored for this acquisition."""
+    from . import site_from_stack
+    _, _, stack = site_from_stack()
+    with _graph_mutex:
+        for held_site, held_stack in holder:
+            if held_site == site:
+                continue
+            bucket = _edges.setdefault(held_site, {})
+            first_time = site not in bucket
+            if first_time:
+                bucket[site] = (stack, held_stack)
+                self_cycle = _find_path(site, held_site)
+                if self_cycle is not None:
+                    key = tuple(sorted((held_site, site)))
+                    if key not in _reported:
+                        _reported.add(key)
+                        rev_stack = _reverse_stack(self_cycle)
+                        path, line = _site_parts(site)
+                        record(
+                            "weedsan-lock-order", path, line,
+                            f"lock acquired at {site} while holding "
+                            f"{held_site}, but another path orders them "
+                            f"{' -> '.join(self_cycle)} — opposite "
+                            f"acquisition orders deadlock under load.\n"
+                            f"--- this acquisition ---\n{stack}"
+                            f"--- reverse path's first acquisition ---\n"
+                            f"{rev_stack}")
+    return stack
+
+
+def _find_path(src: str, dst: str) -> Optional[List[str]]:
+    """Path src ~> dst through recorded edges (graph mutex held)."""
+    seen = set()
+    stack = [(src, [src])]
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        if node in seen:
+            continue
+        seen.add(node)
+        for nxt in _edges.get(node, ()):
+            stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _reverse_stack(path: List[str]) -> str:
+    for a, b in zip(path, path[1:]):
+        info = _edges.get(a, {}).get(b)
+        if info is not None:
+            return info[0]
+    return "(stack unavailable)\n"
+
+
+def _site_parts(site: str) -> Tuple[str, int]:
+    path, _, line = site.rpartition(":")
+    try:
+        return path, int(line)
+    except ValueError:
+        return site, 1
+
+
+def _bookkeeping_error() -> None:
+    """Instrumentation failed — report it as a finding (stderr would
+    vanish under daemon threads) but never disturb the program."""
+    import traceback
+    record("weedsan-internal", "seaweedfs_tpu/sanitize/lockgraph.py", 1,
+           "lock bookkeeping raised (sanitizer bug, not a product "
+           "finding):\n" + traceback.format_exc())
+
+
+class TrackedLock:
+    """threading.Lock/RLock wrapper: acquisition order bookkeeping on
+    top of the real primitive. Unknown attributes delegate, so
+    Condition-style duck typing keeps working against the real lock."""
+
+    __slots__ = ("_san_real", "_san_site", "_san_depth")
+
+    def __init__(self, real, site: str):
+        self._san_real = real
+        self._san_site = site
+        self._san_depth = 0     # reentrant depth (RLock)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        # order bookkeeping BEFORE blocking on the real lock — the
+        # lockdep discipline: an acquisition that actually deadlocks
+        # still records its edge, and the post-acquire critical window
+        # stays a handful of bytecodes (a daemon thread frozen by
+        # interpreter finalization mid-window held the lock forever)
+        stack = ""
+        track = False
+        try:
+            from . import enabled
+            track = enabled() and self._san_depth == 0
+            if track:
+                stack = _on_acquired(self._san_site, _held_list())
+        except BaseException:
+            _bookkeeping_error()
+        got = self._san_real.acquire(blocking, timeout)
+        if got:
+            # bookkeeping must NEVER leak an exception: the real lock
+            # is already held, and raising out of __enter__ would skip
+            # __exit__ and wedge the lock forever
+            try:
+                if track:
+                    getattr(_tls, "held").append((self._san_site, stack))
+                self._san_depth += 1
+            except BaseException:
+                _bookkeeping_error()
+        return got
+
+    def release(self):
+        try:
+            self._san_depth -= 1
+            if self._san_depth == 0:
+                held = getattr(_tls, "held", None)
+                if held:
+                    for i in range(len(held) - 1, -1, -1):
+                        if held[i][0] == self._san_site:
+                            del held[i]
+                            break
+        except BaseException:
+            _bookkeeping_error()
+        self._san_real.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._san_real.locked()
+
+    def __getattr__(self, name):
+        return getattr(self._san_real, name)
+
+
+class TrackedAsyncLock(_real_async_Lock):
+    """asyncio.Lock with per-task acquisition-order bookkeeping."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        site = _creation_site()
+        self._san_site = (f"{site[0]}:{site[1]}" if site else "")
+
+    async def acquire(self):
+        stack = ""
+        track = False
+        try:
+            from . import enabled
+            track = enabled() and bool(self._san_site)
+            if track:
+                stack = _on_acquired(self._san_site, _held_list())
+        except BaseException:
+            _bookkeeping_error()
+        got = await super().acquire()
+        if got and track:
+            try:
+                _task_held_list().append((self._san_site, stack))
+            except BaseException:
+                _bookkeeping_error()
+        return got
+
+    def release(self):
+        if getattr(self, "_san_site", ""):
+            try:
+                lst = _task_held.get(id(asyncio.current_task()), [])
+                for i in range(len(lst) - 1, -1, -1):
+                    if lst[i][0] == self._san_site:
+                        del lst[i]
+                        break
+            except RuntimeError:
+                pass
+        super().release()
+
+
+def _lock_factory(real_factory):
+    def make():
+        site = _creation_site()
+        if site is None:
+            return real_factory()    # stdlib caller: hands off
+        return TrackedLock(real_factory(), f"{site[0]}:{site[1]}")
+    return make
+
+
+def install() -> None:
+    threading.Lock = _lock_factory(_real_Lock)
+    threading.RLock = _lock_factory(_real_RLock)
+    asyncio.Lock = TrackedAsyncLock
+    # asyncio.locks.Lock is the same object pre-3.10 split; keep the
+    # module attribute coherent for code importing it from there
+    asyncio.locks.Lock = TrackedAsyncLock
+
+
+def uninstall() -> None:
+    threading.Lock = _real_Lock
+    threading.RLock = _real_RLock
+    asyncio.Lock = _real_async_Lock
+    asyncio.locks.Lock = _real_async_Lock
+
+
+def reset() -> None:
+    """Drop the recorded graph (tests)."""
+    with _graph_mutex:
+        _edges.clear()
+        _reported.clear()

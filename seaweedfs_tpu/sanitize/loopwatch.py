@@ -1,0 +1,92 @@
+"""Event-loop blocked-callback tripwire.
+
+``asyncio.events.Handle._run`` executes EVERY callback and task step
+the loop schedules — timing it there catches any synchronous stall, no
+matter how it got onto the loop. A step that holds the loop longer
+than the threshold becomes a ``weedsan-blocked-loop`` finding anchored
+at the offending coroutine/callback's definition, which is exactly
+where the static ``blocking-call-transitive`` rule would point — the
+two views cross-reference by construction.
+"""
+
+from __future__ import annotations
+
+import asyncio.events
+import os
+import time
+from typing import Optional
+
+from . import REPO_ROOT, record
+
+_orig_run = asyncio.events.Handle._run
+_threshold_ms: float = 200.0
+# one finding per anchor per run: a hot loop stalling 500 times is one
+# bug, not 500 baseline entries
+_reported: set = set()
+
+
+def _anchor(handle) -> Optional[tuple]:
+    """(relpath, lineno, name) of the callback's definition when it is
+    repo-rooted code; None otherwise (stdlib/jax internals stall too,
+    but a finding nobody can act on is noise)."""
+    cb = getattr(handle, "_callback", None)
+    # Task.__step: name the task's coroutine, not asyncio internals
+    owner = getattr(cb, "__self__", None)
+    if owner is not None and hasattr(owner, "get_coro"):
+        coro = owner.get_coro()
+        code = getattr(coro, "cr_code", None)
+        name = getattr(coro, "__qualname__", "?")
+    else:
+        while hasattr(cb, "func"):      # functools.partial chains
+            cb = cb.func
+        code = getattr(cb, "__code__", None)
+        name = getattr(cb, "__qualname__", repr(cb))
+    if code is None or not code.co_filename.startswith(REPO_ROOT):
+        return None
+    rel = os.path.relpath(code.co_filename,
+                          REPO_ROOT).replace(os.sep, "/")
+    if "/sanitize/" in f"/{rel}":
+        return None
+    return rel, code.co_firstlineno, name
+
+
+def _timed_run(self):
+    from . import enabled
+    if not enabled():
+        return _orig_run(self)
+    t0 = time.perf_counter()
+    try:
+        return _orig_run(self)
+    finally:
+        dt_ms = (time.perf_counter() - t0) * 1000.0
+        if dt_ms > _threshold_ms:
+            a = _anchor(self)
+            if a is not None and a[:2] not in _reported:
+                _reported.add(a[:2])
+                rel, line, name = a
+                record(
+                    "weedsan-blocked-loop", rel, line,
+                    f"event-loop callback {name} held the loop for "
+                    f"{dt_ms:.0f}ms (threshold {_threshold_ms:.0f}ms) "
+                    f"— every in-flight request on this loop stalled "
+                    f"with it; move the blocking work into "
+                    f"run_in_executor")
+
+
+def install(block_ms: float) -> None:
+    global _threshold_ms
+    _threshold_ms = float(block_ms)
+    asyncio.events.Handle._run = _timed_run
+
+
+def uninstall() -> None:
+    asyncio.events.Handle._run = _orig_run
+
+
+def reset() -> None:
+    _reported.clear()
+
+
+def set_threshold(block_ms: float) -> None:
+    global _threshold_ms
+    _threshold_ms = float(block_ms)

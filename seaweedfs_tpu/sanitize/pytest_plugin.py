@@ -1,0 +1,78 @@
+"""pytest wiring for weedsan.
+
+Registered from tests/conftest.py; inert unless ``WEED_SANITIZE=1``.
+When armed (the nightly chaos posture):
+
+  * the sanitizer is enabled at configure time — before test modules
+    import the package, so locks/tasks/sessions constructed by the
+    code under test are born instrumented;
+  * after each test in a SANITIZED suite (the chaos suites, where
+    kill/restart churn makes leaks and inversions likely), a gc pass
+    flushes finalizers and any new unsuppressed finding FAILS that
+    test with the full runtime report;
+  * at session end, stragglers (findings that surfaced during
+    teardown of the last test) are printed loudly either way.
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+
+import pytest
+
+SANITIZED_SUITES = (
+    "test_metaring.py",
+    "test_geo_replication.py",
+    "test_self_heal.py",
+)
+
+
+def _armed() -> bool:
+    from seaweedfs_tpu import sanitize
+    return os.environ.get(sanitize.ENV) == "1"
+
+
+def _sanitized(item) -> bool:
+    return os.path.basename(str(item.fspath)) in SANITIZED_SUITES
+
+
+def pytest_configure(config):
+    if _armed():
+        from seaweedfs_tpu import sanitize
+        sanitize.enable()
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    if not (_armed() and _sanitized(item)):
+        yield
+        return
+    from seaweedfs_tpu import sanitize
+    from seaweedfs_tpu.sanitize import report
+    marker = sanitize.mark()
+    yield
+    gc.collect()          # flush destroyed-while-open finalizers
+    new = report.unsuppressed(sanitize.findings_since(marker))
+    if new:
+        pytest.fail(
+            "weedsan: runtime concurrency sanitizer findings during "
+            "this test:\n" + report.render(new), pytrace=False)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not _armed():
+        return
+    from seaweedfs_tpu import sanitize
+    from seaweedfs_tpu.sanitize import report
+    gc.collect()
+    left = report.unsuppressed(sanitize.findings())
+    if left:
+        tr = session.config.pluginmanager.get_plugin("terminalreporter")
+        lines = report.render(left)
+        if tr is not None:
+            tr.write_sep("=", "weedsan findings (whole run)", red=True)
+            tr.write_line(lines)
+        else:
+            from seaweedfs_tpu.utils import glog
+            glog.error("weedsan findings (whole run):\n%s", lines)

@@ -1,0 +1,50 @@
+"""One suppression/baseline workflow for static AND dynamic findings.
+
+A weedsan finding renders to the same Diagnostic fingerprint scheme
+weedlint uses, so the existing machinery applies unchanged: an inline
+``# weedlint: disable=weedsan-lock-order`` at the anchored line
+suppresses the runtime finding, and a ``.weedlint-baseline.json`` entry
+grandfathers it (the tree ships an empty baseline — this exists so the
+workflow is ONE workflow, not so leaks get parked)."""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from . import REPO_ROOT, Finding
+
+
+def unsuppressed(findings: List[Finding],
+                 baseline_path: Optional[str] = None) -> List[Finding]:
+    """Drop findings silenced by an inline weedlint suppression at
+    their anchor line or matched by the baseline."""
+    from ..analysis.engine import Baseline, load_module
+
+    baseline = None
+    bl = baseline_path or os.path.join(REPO_ROOT,
+                                       ".weedlint-baseline.json")
+    if os.path.exists(bl):
+        baseline = Baseline.load(bl)
+
+    mods = {}
+    out = []
+    for f in findings:
+        diag = f.to_diagnostic()
+        mod = mods.get(f.path)
+        if mod is None and f.path:
+            try:
+                mod = mods[f.path] = load_module(
+                    os.path.join(REPO_ROOT, f.path), f.path)
+            except (OSError, SyntaxError):
+                mod = mods[f.path] = False
+        if mod and mod.suppressed(diag):
+            continue
+        if baseline is not None and diag in baseline:
+            continue
+        out.append(f)
+    return out
+
+
+def render(findings: List[Finding]) -> str:
+    return "\n".join(f.render() for f in findings)

@@ -1,0 +1,157 @@
+"""Construction-to-close tracking for tasks, client sessions and mmaps.
+
+The judgment is deliberately conservative: a finding means the object
+was GARBAGE-COLLECTED while still open/pending — the definitive leak,
+the same signal asyncio's "Task was destroyed but it is pending!"
+warning keys on, but with the construction stack attached and failing
+the test instead of scrolling past. An object that is merely long-
+lived never fires (its finalizer hasn't run); one closed during
+teardown never fires (marked closed before collection).
+
+Tracked constructors:
+  * every ``loop.create_task`` (covers ``asyncio.create_task`` and
+    ``ensure_future``) from repo-rooted code
+  * ``aiohttp.ClientSession`` (patched subclass)
+  * ``mmap.mmap`` (patched subclass)
+"""
+
+from __future__ import annotations
+
+import asyncio.base_events
+import mmap as _mmap_mod
+import weakref
+from typing import Dict
+
+from . import record, site_from_stack
+
+_orig_create_task = asyncio.base_events.BaseEventLoop.create_task
+_real_mmap = _mmap_mod.mmap
+_real_session = None          # aiohttp imported lazily (optional dep)
+
+# id(obj) -> state cell; the weakref.finalize closure keeps the cell
+# alive, the table lets close() find it without holding the object
+_cells: Dict[int, dict] = {}
+
+
+def _register(obj, kind: str, rule: str) -> None:
+    rel, line, stack = site_from_stack()
+    if not rel:
+        return          # constructed entirely outside the repo: not ours
+    cell = {"closed": False, "kind": kind, "rule": rule,
+            "rel": rel, "line": line, "stack": stack}
+    _cells[id(obj)] = cell
+    weakref.finalize(obj, _finalize, id(obj), cell)
+
+
+def _mark_closed(obj) -> None:
+    cell = _cells.get(id(obj))
+    if cell is not None:
+        cell["closed"] = True
+
+
+def _finalize(obj_id: int, cell: dict) -> None:
+    _cells.pop(obj_id, None)
+    from . import enabled
+    if cell["closed"] or not enabled():
+        return
+    record(
+        cell["rule"], cell["rel"], cell["line"],
+        f"{cell['kind']} constructed here was garbage-collected while "
+        f"still open — nothing ever closed/awaited it, so its fd/"
+        f"connection/exception vanished silently.\n"
+        f"--- construction ---\n{cell['stack']}")
+
+
+# --- tasks ---
+
+def _tracking_create_task(self, coro, **kw):
+    task = _orig_create_task(self, coro, **kw)
+    from . import enabled
+    if enabled():
+        rel, line, stack = site_from_stack()
+        if rel:
+            cell = {"closed": False, "kind": "task", "rel": rel,
+                    "line": line, "stack": stack,
+                    "rule": "weedsan-task-leak"}
+            # done (incl. cancelled) = reaped: only destroyed-while-
+            # pending is a leak
+            task.add_done_callback(
+                lambda t, c=cell: c.__setitem__("closed", True))
+            _cells[id(task)] = cell
+            weakref.finalize(task, _finalize, id(task), cell)
+    return task
+
+
+# --- sessions ---
+
+def _patch_session():
+    global _real_session
+    try:
+        import aiohttp
+    except ImportError:
+        return
+    if _real_session is not None:
+        return
+    _real_session = aiohttp.ClientSession
+
+    import warnings
+    with warnings.catch_warnings():
+        # aiohttp discourages subclassing; a sanitizer shim that only
+        # brackets construction/close is exactly the sanctioned
+        # exception — silence the advisory at patch time
+        warnings.simplefilter("ignore", DeprecationWarning)
+
+        class TrackedClientSession(_real_session):
+            def __init__(self, *a, **kw):
+                super().__init__(*a, **kw)
+                from . import enabled
+                if enabled():
+                    _register(self, "aiohttp.ClientSession",
+                              "weedsan-session-leak")
+
+            async def close(self):
+                _mark_closed(self)
+                return await super().close()
+
+            def detach(self):
+                _mark_closed(self)
+                return super().detach()
+
+    TrackedClientSession.__qualname__ = "ClientSession"
+    aiohttp.ClientSession = TrackedClientSession
+
+
+def _unpatch_session():
+    global _real_session
+    if _real_session is not None:
+        import aiohttp
+        aiohttp.ClientSession = _real_session
+        _real_session = None
+
+
+# --- mmaps ---
+
+class TrackedMmap(_real_mmap):
+    def __init__(self, *a, **kw):
+        from . import enabled
+        if enabled():
+            _register(self, "mmap.mmap", "weedsan-mmap-leak")
+
+    def close(self):
+        _mark_closed(self)
+        return super().close()
+
+
+TrackedMmap.__qualname__ = "mmap"
+
+
+def install() -> None:
+    asyncio.base_events.BaseEventLoop.create_task = _tracking_create_task
+    _patch_session()
+    _mmap_mod.mmap = TrackedMmap
+
+
+def uninstall() -> None:
+    asyncio.base_events.BaseEventLoop.create_task = _orig_create_task
+    _unpatch_session()
+    _mmap_mod.mmap = _real_mmap

@@ -43,6 +43,7 @@ from .. import faults, observe, overload
 from ..lifecycle.heat import HeatTracker
 from ..storage.file_id import FileId
 from ..utils import compression, fast_multipart
+from ..utils import retry as _retry
 from ..storage.needle import (FLAG_IS_COMPRESSED,
                               FLAG_HAS_LAST_MODIFIED, FLAG_HAS_MIME,
                               FLAG_HAS_NAME, FLAG_HAS_TTL, Needle)
@@ -1414,9 +1415,11 @@ class VolumeServer:
                 return [u for u in shards.get(str(shard_id), [])
                         if u != self.url]
         try:
+            req = urllib.request.Request(
+                f"http://{self.master_url}/col/lookup/ec?volumeId={vid}",
+                headers=_retry.inject_deadline({}))
             with urllib.request.urlopen(
-                    f"http://{self.master_url}/col/lookup/ec?volumeId={vid}",
-                    timeout=5) as r:
+                    req, timeout=_retry.cap_timeout(5)) as r:
                 shards = _json.load(r).get("shards", {})
             self._shard_loc_cache[vid] = (shards, now)
         except Exception as e:

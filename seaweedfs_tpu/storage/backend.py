@@ -29,6 +29,8 @@ import os
 import threading
 from typing import Callable, Iterator, Optional
 
+from ..utils import retry
+
 
 def _fallocate_keep_size(fd: int, length: int) -> bool:
     """Reserve contiguous space without changing the visible file size —
@@ -219,7 +221,10 @@ class S3ObjectStore(ObjectStore):
                 self.access_key, self.secret_key, self.region)
         req = urllib.request.Request(url, data=data, method=method,
                                      headers=hdrs)
-        with urllib.request.urlopen(req, timeout=300) as r:
+        # external (possibly non-seaweed) endpoint: the ambient budget
+        # bounds the socket; the cluster header would break SigV4
+        with urllib.request.urlopen(
+                req, timeout=retry.cap_timeout(300)) as r:
             return r.read()
 
     def put(self, key: str, source_path: str) -> None:
@@ -252,7 +257,8 @@ class S3ObjectStore(ObjectStore):
             hdrs = sign_request("HEAD", url, hdrs, b"", self.access_key,
                                 self.secret_key, self.region)
         req = urllib.request.Request(url, method="HEAD", headers=hdrs)
-        with urllib.request.urlopen(req, timeout=60) as r:
+        with urllib.request.urlopen(
+                req, timeout=retry.cap_timeout(60)) as r:
             return int(r.headers["Content-Length"])
 
     def spec(self) -> dict:

@@ -78,7 +78,28 @@ class Cluster:
 
     def _loop_main(self) -> None:
         asyncio.set_event_loop(self.loop)
-        self.loop.run_forever()
+        try:
+            self.loop.run_forever()
+        finally:
+            # Drain on THIS thread instead of abandoning pending tasks
+            # (parked meta-subscribe handlers, watcher Event.waits) to
+            # interpreter-exit GC: a coroutine finalized at shutdown
+            # runs its finally-blocks (unsubscribe -> lock acquire) in
+            # GC context, which both spams "Task was destroyed but it
+            # is pending!" and can deadlock against a frozen daemon
+            # thread — weedsan's task tracker flags exactly this.
+            try:
+                tasks = asyncio.all_tasks(self.loop)
+                for t in tasks:
+                    t.cancel()
+                if tasks:
+                    self.loop.run_until_complete(asyncio.gather(
+                        *tasks, return_exceptions=True))
+                self.loop.run_until_complete(
+                    self.loop.shutdown_asyncgens())
+            except Exception:
+                pass
+            self.loop.close()
 
     def call(self, coro, timeout: float = 60.0):
         return asyncio.run_coroutine_threadsafe(coro, self.loop) \

@@ -17,6 +17,12 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# weedsan: the runtime concurrency sanitizer rides the chaos suites
+# when WEED_SANITIZE=1 (the nightly posture) — the plugin is inert
+# otherwise. Registered here so it arms BEFORE test modules import the
+# package and construct their locks/tasks/sessions.
+pytest_plugins = ("seaweedfs_tpu.sanitize.pytest_plugin",)
+
 
 def pytest_configure(config):
     assert jax.default_backend() == "cpu", jax.default_backend()

@@ -131,11 +131,14 @@ def _write_pkg_file(tmp_path, source, rel="seaweedfs_tpu/server/bad.py"):
     return path
 
 
+# fires BOTH http-timeout (v1) and deadline-propagation (v2): a raw
+# urlopen with no timeout and no budget laundering
 _VIOLATION = """\
 import urllib.request
 def fetch(u):
     return urllib.request.urlopen(u)
 """
+_VIOLATION_RULES = {"http-timeout", "deadline-propagation"}
 
 
 def test_cli_flags_seeded_violation(tmp_path):
@@ -364,21 +367,25 @@ def test_baseline_round_trip_and_stale_entries(tmp_path):
     bl_path = tmp_path / "bl.json"
 
     report = run(str(tmp_path), [str(tmp_path)])
-    assert [d.rule for d in report.new] == ["http-timeout"]
+    assert {d.rule for d in report.new} == _VIOLATION_RULES
 
     Baseline.from_findings(report.new).write(str(bl_path))
     report2 = run(str(tmp_path), [str(tmp_path)],
                   baseline=Baseline.load(str(bl_path)))
-    assert report2.clean and len(report2.baselined) == 1
+    assert report2.clean and len(report2.baselined) == 2
 
-    # fix the violation: the grandfathered entry is now stale
-    path.write_text("import urllib.request\n"
-                    "def fetch(u):\n"
-                    "    return urllib.request.urlopen(u, timeout=5)\n")
+    # fix the violation (bounded AND budget-laundered): every
+    # grandfathered entry is now stale
+    path.write_text(
+        "import urllib.request\n"
+        "from seaweedfs_tpu.utils import retry\n"
+        "def fetch(u):\n"
+        "    return urllib.request.urlopen(\n"
+        "        u, timeout=retry.cap_timeout(5))\n")
     report3 = run(str(tmp_path), [str(tmp_path)],
                   baseline=Baseline.load(str(bl_path)))
     assert not report3.new
-    assert len(report3.stale_baseline) == 1
+    assert len(report3.stale_baseline) == 2
     assert not report3.clean
     assert "STALE" in report3.render()
 
@@ -396,8 +403,8 @@ def test_baseline_fingerprint_survives_line_drift(tmp_path):
                   baseline=Baseline.load(str(bl_path)))
     assert report2.clean, (report2.render(),
                            [e for e in report2.stale_baseline])
-    assert len(report2.baselined) == 1
-    assert report2.baselined[0].line == 6  # drifted, still matched
+    assert len(report2.baselined) == 2
+    assert {d.line for d in report2.baselined} == {6}  # drifted, matched
 
 
 def test_baseline_entry_for_changed_line_goes_stale(tmp_path):
@@ -412,7 +419,8 @@ def test_baseline_entry_for_changed_line_goes_stale(tmp_path):
                     "    return urllib.request.urlopen(u or extra)\n")
     report = run(str(tmp_path), [str(tmp_path)],
                  baseline=Baseline.load(str(bl_path)))
-    assert len(report.new) == 1 and len(report.stale_baseline) == 1
+    # both rules re-open on the edited line; both old entries go stale
+    assert len(report.new) == 2 and len(report.stale_baseline) == 2
 
 
 def test_baseline_entry_for_deleted_file_goes_stale(tmp_path):
@@ -426,7 +434,7 @@ def test_baseline_entry_for_deleted_file_goes_stale(tmp_path):
     path.unlink()
     report = run(str(tmp_path), [str(tmp_path)],
                  baseline=Baseline.load(str(bl_path)))
-    assert len(report.stale_baseline) == 1 and not report.clean
+    assert len(report.stale_baseline) == 2 and not report.clean
 
 
 def test_write_baseline_subset_preserves_out_of_scope(tmp_path):
@@ -445,13 +453,14 @@ def test_write_baseline_subset_preserves_out_of_scope(tmp_path):
     p = subprocess.run(base_cmd + ["--write-baseline", pkg],
                        cwd=REPO_ROOT, capture_output=True, text=True,
                        timeout=120)
-    assert "wrote 2 entries" in p.stdout, p.stdout + p.stderr
-    # subset rewrite: only http-timeout re-judged; task-leak preserved
+    assert "wrote 3 entries" in p.stdout, p.stdout + p.stderr
+    # subset rewrite: only http-timeout re-judged; task-leak and
+    # deadline-propagation entries preserved
     p = subprocess.run(base_cmd + ["--write-baseline",
                                    "--rules", "http-timeout", pkg],
                        cwd=REPO_ROOT, capture_output=True, text=True,
                        timeout=120)
-    assert "wrote 2 entries" in p.stdout and "preserved" in p.stdout
+    assert "wrote 3 entries" in p.stdout and "preserved" in p.stdout
     p = subprocess.run(base_cmd + [pkg], cwd=REPO_ROOT,
                        capture_output=True, text=True, timeout=120)
     assert p.returncode == 0, p.stdout + p.stderr
@@ -467,8 +476,224 @@ def test_identical_lines_fingerprint_distinctly(tmp_path):
            "    return urllib.request.urlopen(u)\n")
     _write_pkg_file(tmp_path, src)
     report = run(str(tmp_path), [str(tmp_path)])
-    fps = [d.fingerprint for d in report.new]
+    fps = [d.fingerprint for d in report.new
+           if d.rule == "http-timeout"]
     assert len(fps) == 2 and len(set(fps)) == 2
+
+
+# ------------------------------------------------ v2: inter-procedural layer
+
+def test_suppression_reaches_decorator_line_finding():
+    """A finding anchored at a DECORATOR line is suppressible from
+    anywhere in the decorated statement's header — the decorator lines
+    are part of the logical statement (pre-fix, they belonged to no
+    span, so a trailing comment on the multi-line decorator's last
+    line, or on the def line, never reached the anchor)."""
+    rule = RULES["http-timeout"]
+    base = ("import functools\n"
+            "import urllib.request\n"
+            "@functools.lru_cache(\n"
+            "    urllib.request.urlopen('http://x'){comment})\n"
+            "def f():\n"
+            "    pass\n")
+    # finding anchors at line 4 (the urlopen call)
+    assert [d.line for d in
+            check_source(rule, base.format(comment=""))] == [4]
+    # trailing comment on the decorator's closing line reaches it
+    assert check_source(rule, base.format(
+        comment=",  # weedlint: disable=http-timeout\n")) == []
+
+
+def test_decorator_line_finding_suppressed_from_def_line():
+    rule = RULES["http-timeout"]
+    src = ("import functools\n"
+           "import urllib.request\n"
+           "@functools.lru_cache(urllib.request.urlopen('http://x'))\n"
+           "def f():  # weedlint: disable=http-timeout\n"
+           "    pass\n")
+    assert check_source(rule, src) == []
+
+
+def test_blocking_call_transitive_depth():
+    """The chain report names every hop; laundering is structural
+    (helpers handed to run_in_executor never form an edge)."""
+    rule = RULES["blocking-call-transitive"]
+    src = ("import os\n"
+           "def a(fd):\n"
+           "    b(fd)\n"
+           "def b(fd):\n"
+           "    c(fd)\n"
+           "def c(fd):\n"
+           "    os.fsync(fd)\n"
+           "async def handler(self, fd):\n"
+           "    a(fd)\n")
+    diags = check_source(rule, src)
+    assert len(diags) == 1 and diags[0].line == 9
+    assert "a (" in diags[0].message and "c (" in diags[0].message
+    assert "os.fsync()" in diags[0].message
+
+
+def test_blocking_call_transitive_through_a_cycle():
+    """Recursive helpers must not poison the memo: with a<->b mutually
+    recursive and a also reaching fsync, BOTH async roots report —
+    a cycle-truncated negative cached for b would hide h2's chain."""
+    rule = RULES["blocking-call-transitive"]
+    src = ("import os\n"
+           "def a(fd):\n"
+           "    b(fd)\n"
+           "    c(fd)\n"
+           "def b(fd):\n"
+           "    a(fd)\n"
+           "def c(fd):\n"
+           "    os.fsync(fd)\n"
+           "async def h1(self, fd):\n"
+           "    a(fd)\n"
+           "async def h2(self, fd):\n"
+           "    b(fd)\n")
+    diags = check_source(rule, src)
+    assert sorted(d.line for d in diags) == [10, 12], \
+        [(d.line, d.message) for d in diags]
+
+
+def test_blocking_call_transitive_no_loop_fallback_is_clean():
+    """The except-RuntimeError-after-loop-probe idiom (raft's
+    _schedule_flush) runs off-loop by construction and must not taint
+    chains."""
+    rule = RULES["blocking-call-transitive"]
+    src = ("import asyncio\n"
+           "import os\n"
+           "def save(self, fd):\n"
+           "    os.fsync(fd)\n"
+           "def schedule(self, fd):\n"
+           "    try:\n"
+           "        asyncio.ensure_future(self.flush())\n"
+           "    except RuntimeError:\n"
+           "        save(self, fd)\n"
+           "async def caller(self, fd):\n"
+           "    self.schedule(fd)\n")
+    assert check_source(rule, src) == []
+
+
+def test_blocking_call_transitive_through_methods_across_classes():
+    rule = RULES["blocking-call-transitive"]
+    src = ("import time\n"
+           "class Store:\n"
+           "    def compact(self):\n"
+           "        time.sleep(1)\n"
+           "class Server:\n"
+           "    def __init__(self):\n"
+           "        self.store = Store()\n"
+           "    def _sync_compact(self):\n"
+           "        return Store.compact(self)\n"
+           "    async def handler(self):\n"
+           "        self._sync_compact()\n")
+    diags = check_source(rule, src)
+    assert len(diags) == 1 and "time.sleep" in diags[0].message
+
+
+def test_lock_ordering_call_mediated_cycle():
+    """A helper that takes lock B, called under lock A in one module's
+    view, plus the lexical B-under-A nesting elsewhere = cycle, with
+    the via-function named."""
+    rule = RULES["lock-ordering"]
+    src = ("class S:\n"
+           "    def lexical(self):\n"
+           "        with self._a_lock:\n"
+           "            with self._b_lock:\n"
+           "                pass\n"
+           "    def helper(self):\n"
+           "        with self._a_lock:\n"
+           "            pass\n"
+           "    def mediated(self):\n"
+           "        with self._b_lock:\n"
+           "            self.helper()\n")
+    diags = check_source(rule, src)
+    assert diags, "call-mediated cycle missed"
+    assert any("via" in d.message for d in diags)
+
+
+def test_lock_held_await_transitive_generator_shape():
+    rule = RULES["lock-held-await-transitive"]
+    src = ("def locked_iter(self):\n"
+           "    with self._lock:\n"
+           "        yield from self._items\n"
+           "async def consumer(self):\n"
+           "    for x in locked_iter(self):\n"
+           "        await self.handle(x)\n")
+    diags = check_source(rule, src)
+    assert len(diags) == 1 and diags[0].line == 5
+    assert "yields while holding" in diags[0].message
+
+
+def test_deadline_propagation_laundering_forms():
+    """inject_deadline OR cap_timeout anywhere on the function
+    satisfies the budget contract; entry-point planes (shell/) are out
+    of scope."""
+    rule = RULES["deadline-propagation"]
+    capped = ("import urllib.request\n"
+              "from ..utils import retry\n"
+              "def external(url, t):\n"
+              "    return urllib.request.urlopen(\n"
+              "        url, timeout=retry.cap_timeout(t))\n")
+    assert check_source(rule, capped) == []
+    shell_src = ("import urllib.request\n"
+                 "def cmd(url):\n"
+                 "    return urllib.request.urlopen(url, timeout=5)\n")
+    assert check_source(rule, shell_src,
+                        relpath="seaweedfs_tpu/shell/x_commands.py") == []
+    assert len(check_source(rule, shell_src)) == 1  # server plane: fires
+
+
+def test_resource_leak_interproc_transitive_factory():
+    """A function returning another factory's result is itself a
+    factory (the closure follows returns-of-calls)."""
+    rule = RULES["resource-leak-interproc"]
+    src = ("def raw(p):\n"
+           "    return open(p, 'rb')\n"
+           "def wrapped(p):\n"
+           "    return raw(p)\n"
+           "def bad(p):\n"
+           "    fh = wrapped(p)\n"
+           "    data = fh.read()\n"
+           "    fh.close()\n"
+           "    return data\n")
+    diags = check_source(rule, src)
+    assert len(diags) == 1 and diags[0].line == 6
+    assert "happy path" in diags[0].message
+
+
+def test_jobs_parallel_parse_identical_findings(tmp_path):
+    """--jobs N must produce byte-identical findings and fingerprints
+    to the serial run (deterministic order is part of the contract)."""
+    for i in range(6):
+        _write_pkg_file(tmp_path, _VIOLATION,
+                        rel=f"seaweedfs_tpu/server/bad{i}.py")
+    serial = run(str(tmp_path), [str(tmp_path)], jobs=1)
+    parallel = run(str(tmp_path), [str(tmp_path)], jobs=4)
+    ser = [(d.rule, d.path, d.line, d.fingerprint) for d in serial.new]
+    par = [(d.rule, d.path, d.line, d.fingerprint) for d in parallel.new]
+    assert ser == par and len(ser) == 6 * len(_VIOLATION_RULES)
+
+
+def test_cli_github_format_annotations(tmp_path):
+    _write_pkg_file(tmp_path, _VIOLATION)
+    p = subprocess.run(
+        [sys.executable, "-m", "seaweedfs_tpu.analysis",
+         "--format", "github", "--root", str(tmp_path),
+         str(tmp_path / "seaweedfs_tpu")],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert p.returncode == 1
+    assert "::error file=seaweedfs_tpu/server/bad.py,line=3," in p.stdout
+    assert "title=weedlint http-timeout::" in p.stdout
+
+
+def test_cli_jobs_flag(tmp_path):
+    _write_pkg_file(tmp_path, _VIOLATION)
+    p = subprocess.run(
+        [sys.executable, "-m", "seaweedfs_tpu.analysis", "--jobs", "2",
+         "--root", str(tmp_path), str(tmp_path / "seaweedfs_tpu")],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert p.returncode == 1 and "http-timeout" in p.stdout
 
 
 # ---------------------------------------------- legacy walker parity checks

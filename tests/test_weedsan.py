@@ -1,0 +1,318 @@
+"""weedsan (seaweedfs_tpu/sanitize) self-tests: the sanitizer must
+DETECT each class of bug it claims to — a provoked lock-order
+inversion, a blocked event loop, and a leaked (destroyed-while-
+pending) task — and its findings must ride weedlint's fingerprint/
+suppression machinery so one workflow covers both.
+
+Each test enables the sanitizer in-process and disables it on the way
+out; nothing here depends on WEED_SANITIZE being set in the
+environment (that path is the chaos suites' job, wired in conftest).
+"""
+
+import asyncio
+import gc
+import os
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu import sanitize
+from seaweedfs_tpu.sanitize import lockgraph, loopwatch, report
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def san():
+    """Armed sanitizer with a clean slate. If the session-level plugin
+    already armed it (WEED_SANITIZE=1 nightly), leave it armed on the
+    way out — only a fixture-local arm is fixture-local."""
+    was_enabled = sanitize.enabled()
+    sanitize.clear_findings()
+    lockgraph.reset()
+    loopwatch.reset()
+    sanitize.enable(block_ms=150.0)
+    loopwatch.set_threshold(150.0)   # enable() is idempotent re: config
+    try:
+        yield sanitize
+    finally:
+        if not was_enabled:
+            sanitize.disable()
+        else:
+            loopwatch.set_threshold(sanitize.block_ms_default())
+        sanitize.clear_findings()
+        lockgraph.reset()
+        loopwatch.reset()
+
+
+# ------------------------------------------------------------ lock order
+
+def test_lock_order_inversion_detected_with_both_stacks(san):
+    """Two threads taking the same pair of locks in opposite orders —
+    sequentially, so the test never actually deadlocks — must produce
+    a weedsan-lock-order finding carrying BOTH acquisition stacks
+    (the lockdep discipline: the cycle is the bug, not tonight's
+    interleaving)."""
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+
+    def path_one():
+        with lock_a:
+            with lock_b:
+                pass
+
+    def path_two():
+        with lock_b:
+            with lock_a:
+                pass
+
+    t1 = threading.Thread(target=path_one)
+    t1.start()
+    t1.join()
+    t2 = threading.Thread(target=path_two)
+    t2.start()
+    t2.join()
+
+    found = [f for f in san.findings() if f.rule == "weedsan-lock-order"]
+    assert found, "inversion went undetected"
+    msg = found[0].message
+    assert "path_one" in msg and "path_two" in msg, msg
+    assert "this acquisition" in msg and "reverse path" in msg
+    assert found[0].path.startswith("tests/")
+
+
+def test_consistent_lock_order_is_clean(san):
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+    for _ in range(3):
+        with lock_a:
+            with lock_b:
+                pass
+    assert not [f for f in san.findings()
+                if f.rule == "weedsan-lock-order"]
+
+
+def test_async_lock_inversion_detected(san):
+    """asyncio.Lock acquisitions feed the same digraph: two tasks
+    ordering a pair of async locks oppositely is the same deadlock."""
+
+    async def main():
+        la = asyncio.Lock()
+        lb = asyncio.Lock()
+
+        async def one():
+            async with la:
+                async with lb:
+                    pass
+
+        async def two():
+            async with lb:
+                async with la:
+                    pass
+
+        await asyncio.gather(one())
+        await asyncio.gather(two())
+
+    asyncio.run(main())
+    assert [f for f in san.findings() if f.rule == "weedsan-lock-order"]
+
+
+# ------------------------------------------------------------ blocked loop
+
+def test_blocked_event_loop_detected(san):
+    """A coroutine that time.sleep()s on the loop past the threshold
+    trips the tripwire, anchored at repo code."""
+
+    async def main():
+        async def stall():
+            time.sleep(0.3)     # deliberate: the bug under test
+
+        await asyncio.create_task(stall())
+
+    asyncio.run(main())
+    found = [f for f in san.findings()
+             if f.rule == "weedsan-blocked-loop"]
+    assert found, "blocked loop went undetected"
+    assert "stall" in found[0].message
+    assert "run_in_executor" in found[0].message
+
+
+def test_fast_callbacks_do_not_trip(san):
+    async def main():
+        async def quick():
+            await asyncio.sleep(0)
+
+        await asyncio.create_task(quick())
+
+    asyncio.run(main())
+    assert not [f for f in san.findings()
+                if f.rule == "weedsan-blocked-loop"]
+
+
+# ------------------------------------------------------------ leaked task
+
+def test_task_destroyed_while_pending_is_a_leak(san):
+    """A pending task whose loop is torn down around it (never awaited,
+    never cancelled) is collected pending — the classic 'Task was
+    destroyed but it is pending!' — and must become a finding with the
+    construction stack."""
+
+    async def forever():
+        await asyncio.get_event_loop().create_future()
+
+    loop = asyncio.new_event_loop()
+    try:
+        task = loop.create_task(forever())
+        loop.call_soon(loop.stop)
+        loop.run_forever()      # one beat: the task starts, then stalls
+    finally:
+        loop.close()
+    del task, loop
+    gc.collect()
+
+    found = [f for f in san.findings() if f.rule == "weedsan-task-leak"]
+    assert found, "pending-task leak went undetected"
+    assert "garbage-collected" in found[0].message
+    assert "construction" in found[0].message
+    assert found[0].path.startswith("tests/")
+
+
+def test_completed_task_is_not_a_leak(san):
+    async def main():
+        t = asyncio.create_task(asyncio.sleep(0))
+        await t
+
+    asyncio.run(main())
+    gc.collect()
+    assert not [f for f in san.findings()
+                if f.rule == "weedsan-task-leak"]
+
+
+def test_cancelled_task_is_not_a_leak(san):
+    async def main():
+        async def forever():
+            await asyncio.get_event_loop().create_future()
+
+        t = asyncio.create_task(forever())
+        await asyncio.sleep(0)
+        t.cancel()
+        try:
+            await t
+        except asyncio.CancelledError:
+            pass
+
+    asyncio.run(main())
+    gc.collect()
+    assert not [f for f in san.findings()
+                if f.rule == "weedsan-task-leak"]
+
+
+# ------------------------------------------------ resource leak tracking
+
+def test_leaked_mmap_is_detected(san):
+    import mmap
+
+    def make(path):
+        with open(path, "wb") as f:
+            f.write(b"x" * 4096)
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            return mmap.mmap(fd, 0, prot=mmap.PROT_READ)
+        finally:
+            os.close(fd)
+
+    import tempfile
+    with tempfile.NamedTemporaryFile() as tf:
+        mm = make(tf.name)
+        del mm              # never closed
+        gc.collect()
+
+    assert [f for f in san.findings() if f.rule == "weedsan-mmap-leak"]
+
+
+def test_closed_mmap_is_clean(san):
+    import mmap
+    import tempfile
+    with tempfile.NamedTemporaryFile() as tf:
+        tf.write(b"x" * 4096)
+        tf.flush()
+        fd = os.open(tf.name, os.O_RDONLY)
+        try:
+            mm = mmap.mmap(fd, 0, prot=mmap.PROT_READ)
+        finally:
+            os.close(fd)
+        mm.close()
+        del mm
+        gc.collect()
+    assert not [f for f in san.findings()
+                if f.rule == "weedsan-mmap-leak"]
+
+
+# ------------------------------- fingerprint/suppression cross-reference
+
+def test_finding_shares_weedlint_fingerprint_scheme(san):
+    """A weedsan finding's Diagnostic twin fingerprints exactly like a
+    static finding anchored at the same (rule, path, line-text) — one
+    baseline covers both planes."""
+    from seaweedfs_tpu.analysis.engine import Diagnostic
+
+    f = sanitize.Finding(rule="weedsan-lock-order",
+                         path="tests/test_weedsan.py", line=1,
+                         message="x")
+    d = f.to_diagnostic()
+    twin = Diagnostic(rule="weedsan-lock-order",
+                      path="tests/test_weedsan.py", line=999,
+                      message="different message",
+                      line_text=d.line_text)
+    assert d.fingerprint == twin.fingerprint  # line/message-independent
+    assert d.line_text.startswith('"""')      # anchored text was read
+
+
+def test_inline_suppression_reaches_runtime_finding(tmp_path, san):
+    """# weedlint: disable=weedsan-task-leak at the anchor line drops
+    the runtime finding through the same Module.suppressed machinery."""
+    rel = "tests/_weedsan_suppressed_fixture.py"
+    p = os.path.join(REPO_ROOT, rel)
+    with open(p, "w") as f:
+        f.write("def spawn(loop, coro):\n"
+                "    return loop.create_task(coro)"
+                "  # weedlint: disable=weedsan-task-leak\n")
+    try:
+        hit = sanitize.Finding(rule="weedsan-task-leak", path=rel,
+                               line=2, message="leak")
+        miss = sanitize.Finding(rule="weedsan-lock-order", path=rel,
+                                line=2, message="other rule")
+        kept = report.unsuppressed([hit, miss])
+        assert kept == [miss]
+    finally:
+        os.unlink(p)
+
+
+def test_baseline_matches_runtime_finding(tmp_path, san):
+    """A baseline entry written from the Diagnostic twin grandfathers
+    the runtime finding — the ONE workflow requirement."""
+    from seaweedfs_tpu.analysis.engine import Baseline
+    rel = "tests/_weedsan_baseline_fixture.py"
+    p = os.path.join(REPO_ROOT, rel)
+    with open(p, "w") as f:
+        f.write("HELD = object()\n")
+    bl = tmp_path / "bl.json"
+    try:
+        f0 = sanitize.Finding(rule="weedsan-session-leak", path=rel,
+                              line=1, message="leaked session")
+        Baseline.from_findings([f0.to_diagnostic()]).write(str(bl))
+        assert report.unsuppressed([f0], baseline_path=str(bl)) == []
+        assert report.unsuppressed([f0]) == [f0]  # empty tree baseline
+    finally:
+        os.unlink(p)
+
+
+def test_enable_disable_restores_primitives(san):
+    """disable() puts the real constructors back (the fixture calls
+    disable; verify from a nested arm/disarm cycle)."""
+    sanitize.disable()
+    assert threading.Lock is lockgraph._real_Lock
+    assert asyncio.Lock is lockgraph._real_async_Lock
+    sanitize.enable()
+    assert threading.Lock is not lockgraph._real_Lock
