@@ -2530,6 +2530,104 @@ def phase_metadata(work: str, budget_s: float = 240.0) -> dict:
     return out
 
 
+def phase_recovery(work: str, budget_s: float = 240.0,
+                   target_mb: int = 1024) -> dict:
+    """Crash-consistency plane: cold-start recovery wall time for a
+    torn ~1GB volume (the ISSUE 15 acceptance shape) plus crashsim
+    sweep throughput (crash points/sec).
+
+    The volume is built with a mid-stream sync() watermark, an un-synced
+    tail, and a deliberate tear (truncate mid-record + garbage stump).
+    recovery_wall_s is the watermarked open — the production cold-start
+    cost; full_scan_gbps prices the legacy no-watermark CRC scan the
+    same open would pay on a pre-`.swm` volume."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from seaweedfs_tpu.crashsim.harness import sweep_all
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    t_start = time.perf_counter()
+    out: dict = {"target_mb": target_mb}
+    vdir = os.path.join(work, "recovery_vol")
+    os.makedirs(vdir, exist_ok=True)
+
+    # budget-aware sizing: the 1GB target needs ~30s of build headroom
+    if budget_s < 120:
+        target_mb = min(target_mb, 256)
+        out["target_mb"] = target_mb
+
+    payload = (b"\xa5" * 65536)
+    t0 = time.perf_counter()
+    v = Volume(vdir, "", 77, create=True)
+    nid = 0
+    target = target_mb * MB
+    while v.data_file_size() < target * 0.97:
+        nid += 1
+        v.write_needle(Needle(cookie=0xCC, id=nid,
+                              data=payload + nid.to_bytes(8, "big")))
+    v.sync()
+    synced_ids = nid
+    wm_size = v.data_file_size()
+    for _ in range(12):                       # un-synced tail
+        nid += 1
+        v.write_needle(Needle(cookie=0xCC, id=nid, data=payload))
+    torn_size = v.data_file_size()
+    v.nm.close()
+    v._dat.close()
+    out["build_s"] = round(time.perf_counter() - t0, 2)
+    out["volume_bytes"] = torn_size
+    base = v.base_file_name()
+    with open(base + ".dat", "r+b") as f:     # tear the last record
+        f.truncate(torn_size - 30000)
+        f.seek(torn_size - 62000)
+        f.write(os.urandom(4096))
+    _phase_checkpoint(work, "recovery", out)
+
+    t0 = time.perf_counter()
+    v2 = Volume(vdir, "", 77)
+    out["recovery_wall_s"] = round(time.perf_counter() - t0, 3)
+    # the cut may keep whole un-synced tail records before the tear —
+    # legal (un-acked, intact); everything acked must be byte-exact
+    recovered_ok = (wm_size <= v2.data_file_size() < torn_size
+                    and len(v2.nm) >= synced_ids)
+    sample = {1, synced_ids // 2, synced_ids}
+    for sid in sample:
+        n = v2.read_needle(sid)
+        recovered_ok = recovered_ok and \
+            n.data == payload + sid.to_bytes(8, "big")
+    out["recovered_byte_exact"] = recovered_ok
+    # legacy cost: the full CRC scan a watermark-less volume would pay
+    t0 = time.perf_counter()
+    cut, records = v2._scan_valid_records(
+        v2.super_block.block_size(), v2.data_file_size())
+    full_scan_s = time.perf_counter() - t0
+    out["full_scan_s"] = round(full_scan_s, 3)
+    out["full_scan_gbps"] = round(
+        v2.data_file_size() / max(full_scan_s, 1e-9) / 1e9, 3)
+    out["full_scan_records"] = len(records)
+    v2.close()
+    shutil.rmtree(vdir, ignore_errors=True)
+    _phase_checkpoint(work, "recovery", out)
+
+    t0 = time.perf_counter()
+    summary = sweep_all(seeds=2, points=20)
+    sweep_s = time.perf_counter() - t0
+    out["crashsim_points"] = summary["total_points"]
+    out["crashsim_violations"] = summary["total_violations"]
+    out["crashsim_points_per_s"] = round(
+        summary["total_points"] / max(sweep_s, 1e-9), 1)
+    out["crashsim_sweep_s"] = round(sweep_s, 2)
+    out["accept"] = {
+        "recovered_byte_exact": bool(recovered_ok),
+        "zero_sweep_violations": summary["total_violations"] == 0,
+        "sweep_points_ge_200": summary["total_points"] >= 200,
+    }
+    out["phase_wall_s"] = round(time.perf_counter() - t_start, 2)
+    _phase_checkpoint(work, "recovery", out)
+    return out
+
+
 V2_RULES = ("blocking-call-transitive,lock-held-await-transitive,"
             "deadline-propagation,resource-leak-interproc,lock-ordering")
 
@@ -2819,6 +2917,22 @@ def main() -> None:
         detail["lint"] = lint
         _checkpoint(detail)
 
+        recovery: dict = {"error": "skipped (budget)"}
+        if left() > 60:
+            try:
+                recovery = phase_recovery(
+                    work, budget_s=min(240.0, left() - 20.0))
+                _log(f"recovery: torn-{recovery.get('target_mb')}MB "
+                     f"cold start {recovery.get('recovery_wall_s')}s, "
+                     f"crashsim {recovery.get('crashsim_points')} pts @ "
+                     f"{recovery.get('crashsim_points_per_s')}/s, "
+                     f"{recovery.get('crashsim_violations')} violations")
+            except Exception as e:
+                recovery = {"error": str(e),
+                            **_load_partial(work, "recovery")}
+        detail["recovery"] = recovery
+        _checkpoint(detail)
+
         try:
             needle_map = bench_needle_map(work)
         except Exception as e:
@@ -2909,6 +3023,11 @@ def main() -> None:
                     else None,
                 "lint_wall_s": lint.get("lint_wall_s"),
                 "lint_v2_wall_s": lint.get("lint_v2_wall_s"),
+                "recovery_wall_s": recovery.get("recovery_wall_s"),
+                "recovery_full_scan_gbps":
+                    recovery.get("full_scan_gbps"),
+                "crashsim_points_per_s":
+                    recovery.get("crashsim_points_per_s"),
                 "detail_file": "BENCH_DETAIL.json",
             },
         }))
@@ -2935,6 +3054,7 @@ if __name__ == "__main__":
               "georepl": lambda w: phase_georepl(w, budget_s=budget),
               "metadata": lambda w: phase_metadata(w, budget_s=budget),
               "lint": lambda w: phase_lint(w, budget_s=budget),
+              "recovery": lambda w: phase_recovery(w, budget_s=budget),
               }[name]
         print(json.dumps(fn(work)))
     else:

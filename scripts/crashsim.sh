@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# crashsim CI gate: power-loss simulation sweep over every persistence
+# path (volume append, needle-map flush, EC encode/.ecm, raft/metalog
+# snapshots, replication offsets, filer KV). Fails on any durability-
+# contract violation: acked-write loss, silent corruption load, or a
+# recovery that does not converge.
+#
+#   scripts/crashsim.sh                      # the CI budget (>=200 points)
+#   scripts/crashsim.sh --seeds 5 --points 50    # deeper sweep
+#   scripts/crashsim.sh --workloads volume_append --json
+#
+# Runs beside scripts/lint.sh; JAX is not needed (CPU-only numpy paths).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m seaweedfs_tpu.crashsim \
+    --seeds 2 --points 20 "$@"

@@ -11,6 +11,7 @@ from ..engine import Rule, register
 _EXEMPT = (
     "seaweedfs_tpu/cli.py",
     "seaweedfs_tpu/analysis/__main__.py",
+    "seaweedfs_tpu/crashsim/__main__.py",
 )
 
 

@@ -1,0 +1,172 @@
+"""atomic-replace: every temp-file-and-rename commit must carry the
+full durability recipe (fsync file -> os.replace -> fsync directory).
+
+PR 4's raft state writer carried all three barriers because a vanished
+vote breaks election safety; the `.ecm`/`.vif`/offset/snapshot writers
+each re-invented part of the dance and a power loss could revoke their
+commits. The recipe now lives ONCE in ``utils/durable.py`` — this rule
+holds every other ``os.replace`` in the tree to it, riding the PR 13
+call graph so a helper that fsyncs on the caller's behalf (or a caller
+that delegates to ``durable.*``) is recognized wherever it lives.
+
+A finding fires at an ``os.replace`` call site whose enclosing function
+
+  * cannot transitively reach an ``os.fsync``/``os.fdatasync`` (the
+    temp file's pages may still be dirty when the rename lands:
+    power loss surfaces an empty/partial file), or
+  * reaches a file fsync but never a directory fsync (``durable``
+    helper): the rename itself is revocable.
+
+Deliberately loss-tolerant writers (e.g. the disk cache tier) carry an
+inline ``# weedlint: disable=atomic-replace`` with their justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional, Set
+
+from .. import callgraph
+from ..astutil import attr_path, walk_body
+from ..engine import Rule, register
+
+_DURABLE_HELPERS = ("fsync_dir", "replace_atomic", "write_atomic",
+                    "write_json_atomic")
+_DURABLE_MODULE = "seaweedfs_tpu/utils/durable.py"
+
+
+def _canonical(mod, call: ast.Call) -> tuple:
+    path = attr_path(call.func)
+    if not path:
+        return ()
+    aliases = mod.aliases()
+    head = aliases.get(path[0], path[0])
+    return tuple(head.split(".")) + tuple(path[1:])
+
+
+def _is_durable_call(mod, call: ast.Call) -> bool:
+    """Name-level recognition of the durable helpers: resolution-free so
+    it works on single-module fixture runs too."""
+    path = _canonical(mod, call)
+    return bool(path) and path[-1] in _DURABLE_HELPERS and (
+        len(path) == 1 or "durable" in path[:-1]
+        or path[-2:-1] == ("durable",))
+
+
+@register
+class AtomicReplace(Rule):
+    name = "atomic-replace"
+    rationale = ("an os.replace whose temp file was never fsynced — or "
+                 "whose directory never is — commits state a power loss "
+                 "can tear or revoke; route it through "
+                 "utils/durable.py's fsync-file -> rename -> fsync-dir "
+                 "recipe")
+    scope = ("seaweedfs_tpu/",)
+    fixture = (
+        "import os, json\n"
+        "def save_no_fsync(path, obj):\n"
+        "    tmp = path + '.tmp'\n"
+        "    with open(tmp, 'w') as f:\n"
+        "        json.dump(obj, f)\n"
+        "    os.replace(tmp, path)\n"          # no fsync at all
+        "def _persist(f):\n"
+        "    f.flush()\n"
+        "    os.fsync(f.fileno())\n"
+        "def save_no_dirsync(path, data):\n"
+        "    tmp = path + '.tmp'\n"
+        "    with open(tmp, 'wb') as f:\n"
+        "        f.write(data)\n"
+        "        _persist(f)\n"
+        "    os.replace(tmp, path)\n"          # file synced, dir not
+    )
+    clean_fixture = (
+        "import os\n"
+        "from ..utils import durable\n"
+        "def good(path, data):\n"
+        "    durable.write_atomic(path, data)\n"
+        "def good2(tmp, path, f):\n"
+        "    os.fsync(f.fileno())\n"
+        "    durable.replace_atomic(tmp, path, sync_file=False)\n"
+        "def good3(tmp, path, f):\n"
+        "    os.fsync(f.fileno())\n"
+        "    os.replace(tmp, path)\n"
+        "    durable.fsync_dir(os.path.dirname(path))\n"
+    )
+
+    def check_project(self, mods):
+        graph = callgraph.get(mods)
+
+        # transitive effect closures over the call graph, cycle-safe
+        fsync_memo: Dict[str, bool] = {}
+        durable_memo: Dict[str, bool] = {}
+
+        def reaches(qname: str, memo: Dict[str, bool], probe,
+                    stack: Optional[Set[str]] = None) -> bool:
+            # positives memoize (definitive); negatives are re-derived —
+            # a negative computed under a cycle would be provisional
+            # (PR 13's cycle-taint discipline), and the tree has few
+            # os.replace roots so the re-walk is cheap
+            if memo.get(qname):
+                return True
+            if stack is None:
+                stack = set()
+            if qname in stack:
+                return False
+            summary = graph.functions.get(qname)
+            if summary is None:
+                return False
+            if probe(summary):
+                memo[qname] = True
+                return True
+            stack.add(qname)
+            try:
+                for site in summary.calls:
+                    for callee in site.callees:
+                        if reaches(callee, memo, probe, stack):
+                            memo[qname] = True
+                            return True
+            finally:
+                stack.discard(qname)
+            return False
+
+        def has_own_fsync(summary) -> bool:
+            if any(label in ("os.fsync", "os.fdatasync")
+                   for label, _ln in summary.blocking):
+                return True
+            return self._calls_durable(summary)
+
+        def has_own_durable(summary) -> bool:
+            return self._calls_durable(summary)
+
+        for summary in graph.functions.values():
+            if summary.mod.relpath == _DURABLE_MODULE:
+                continue
+            for node in walk_body(summary.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _canonical(summary.mod, node) != ("os", "replace"):
+                    continue
+                if not reaches(summary.qname, fsync_memo,
+                               has_own_fsync):
+                    yield self.diag(
+                        summary.mod, node.lineno,
+                        f"os.replace in {summary.node.name} commits a "
+                        f"temp file that is never fsynced (transitively)"
+                        f" — power loss can surface an empty/partial "
+                        f"file; use utils/durable.replace_atomic")
+                elif not reaches(summary.qname, durable_memo,
+                                 has_own_durable):
+                    yield self.diag(
+                        summary.mod, node.lineno,
+                        f"os.replace in {summary.node.name} fsyncs the "
+                        f"file but never the directory — the rename "
+                        f"itself is revocable by power loss; use "
+                        f"utils/durable.replace_atomic (or fsync_dir)")
+
+    @staticmethod
+    def _calls_durable(summary) -> bool:
+        for node in walk_body(summary.node):
+            if isinstance(node, ast.Call) and \
+                    _is_durable_call(summary.mod, node):
+                return True
+        return False

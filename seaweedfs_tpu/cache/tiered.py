@@ -116,7 +116,10 @@ class _DiskTier:
                 tmp = self._path(key) + ".tmp"
                 with open(tmp, "wb") as f:
                     f.write(data)
-                os.replace(tmp, self._path(key))
+                # cache tier: losing an entry to power loss just means a
+                # re-fetch — fsync here would serialize every put on the
+                # platter for data that is a COPY by definition
+                os.replace(tmp, self._path(key))  # weedlint: disable=atomic-replace
             except OSError:
                 return evicted
             self._index[key] = (len(data), expires)

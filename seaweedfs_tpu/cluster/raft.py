@@ -32,6 +32,8 @@ import os
 import random
 from typing import Awaitable, Callable, Optional
 
+from ..utils import durable
+
 log = logging.getLogger("raft")
 
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
@@ -178,20 +180,10 @@ class RaftNode:
                            "snap_state": self.snap_state})
 
     def _write_state(self, data: str) -> None:
-        tmp = self.state_path + ".tmp"
-        with open(tmp, "w") as f:
-            f.write(data)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.state_path)
-        # fsync the directory so the rename itself survives power loss —
-        # a vote that vanishes lets this node vote twice in one term,
-        # breaking election safety
-        dfd = os.open(os.path.dirname(self.state_path) or ".", os.O_RDONLY)
-        try:
-            os.fsync(dfd)
-        finally:
-            os.close(dfd)
+        # full fsync-file -> rename -> fsync-dir dance (utils/durable is
+        # this recipe, extracted from here): a vote that vanishes lets
+        # this node vote twice in one term, breaking election safety
+        durable.write_atomic(self.state_path, data)
 
     async def _flush_state(self) -> None:
         """Durable save without blocking the event loop: the two fsyncs run

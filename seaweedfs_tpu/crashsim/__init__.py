@@ -1,0 +1,35 @@
+"""crashsim — power-loss simulation for every persistence path.
+
+All prior chaos coverage (PRs 4, 10, 12) kills *processes*; the disk
+always survived intact. This plane simulates the failure mode the
+Haystack design actually stakes its recovery story on: a power loss
+that tears a sector, drops an un-synced page, or revokes a rename that
+was never followed by a directory fsync.
+
+Three layers:
+
+- :mod:`.shim`      — a record layer interposed on the process's file
+  API (``open``/``os.replace``/``os.fsync``/``os.pwrite``/...), scoped
+  to one directory tree. It lets the workload run against the real
+  filesystem while logging every mutation with its fsync barriers.
+- :mod:`.replay`    — rebuilds the disk state a crash at any point in
+  that log could have left behind, honoring ONLY synced ordering:
+  fsync-covered ops are guaranteed; everything else is independently
+  kept, dropped, or sector-torn by a seeded RNG; renames without a
+  directory fsync are revocable.
+- :mod:`.harness` / :mod:`.workloads` — per-subsystem workloads (volume
+  append, needle-map flush, EC encode, raft/metalog snapshot, offset
+  commits, filer KV) that declare *acked* state at durability barriers,
+  then restart the subsystem on each reconstructed tree and assert the
+  durability contract: every acked write present and intact, no torn
+  state loaded silently, recovery converges.
+
+CI mode: ``python -m seaweedfs_tpu.crashsim`` (scripts/crashsim.sh).
+"""
+
+from .shim import DiskRecorder, Op
+from .replay import build_crash_state
+from .harness import CrashWorkload, SweepResult, sweep, sweep_all
+
+__all__ = ["DiskRecorder", "Op", "build_crash_state", "CrashWorkload",
+           "SweepResult", "sweep", "sweep_all"]

@@ -44,6 +44,7 @@ from typing import Iterator, Optional, Sequence
 import numpy as np
 
 from .. import observe
+from ..utils import durable
 from . import feed as feed_mod
 from . import governor
 from .coder import ErasureCoder
@@ -133,6 +134,10 @@ class _FanOut:
                 while True:
                     item = q.get()
                     if item is _SENTINEL:
+                        # sync before the .ecm marker commits the set:
+                        # shards a power loss can drop must not be
+                        # reachable from a durable marker
+                        os.fsync(fd)
                         return
                     batch = [item]
                     while len(batch) < self.MAX_COALESCE and not q.empty():
@@ -147,6 +152,7 @@ class _FanOut:
                             cb()
                     batch = []
                     if stop:
+                        os.fsync(fd)
                         return
             finally:
                 os.close(fd)
@@ -760,10 +766,7 @@ def stamp_shard_digests(base_file_name: str,
         digests[sid] = int(shard_file_digest(base_file_name, [sid])[0])
     meta["shard_digests"] = {str(k): v
                              for k, v in sorted(digests.items())}
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json_mod.dump(meta, f)
-    os.replace(tmp, path)
+    durable.write_json_atomic(path, meta)
     return digests
 
 

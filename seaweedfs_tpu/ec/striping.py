@@ -27,6 +27,7 @@ import numpy as np
 from ..storage import idx as idx_mod
 from ..storage import types as t
 from ..storage.needle_map import SortedNeedleMap
+from ..utils import durable
 from .coder import ErasureCoder
 from .geometry import DEFAULT, Geometry, to_ext
 
@@ -133,6 +134,13 @@ def write_ec_files(base_file_name: str, coder: ErasureCoder,
                             min(buffer_size, g.small_block_size), outputs, g)
                 remaining -= g.small_row_size
                 processed += g.small_row_size
+        # shard bytes must be on the platter BEFORE the .ecm marker
+        # commits the set: lifecycle retires the source .dat once the
+        # shard set verifies, so un-synced shards dropped by a power
+        # loss after retirement would be unrecoverable acked data
+        for f in outputs:
+            f.flush()
+            os.fsync(f.fileno())
     finally:
         for f in outputs:
             f.close()
@@ -161,10 +169,8 @@ def write_layout_marker(base_file_name: str, dat_size: int,
             "large_block_size": geometry.large_block_size,
             "small_block_size": geometry.small_block_size,
         }
-    tmp = base_file_name + ".ecm.tmp"
-    with open(tmp, "w") as f:
-        json_mod.dump(meta, f)
-    os.replace(tmp, base_file_name + ".ecm")
+    # durable commit point of the whole shard set (see write_ec_files)
+    durable.write_json_atomic(base_file_name + ".ecm", meta)
 
 
 def read_marker_geometry(base_file_name: str) -> Optional[Geometry]:

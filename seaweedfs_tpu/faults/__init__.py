@@ -120,6 +120,12 @@ KNOWN_POINTS = frozenset({
     "master.log.apply",     # master metadata-log apply (assign
                             # batches, volume create/retire, geometry
                             # stamps riding the raft plane)
+    "disk.write",           # DiskFile.write_at — corrupt = bit-rot on
+                            # the way to the platter (CRC read-repair
+                            # drills), error = EIO, delay = slow disk
+    "disk.sync",            # DiskFile.sync fsync barrier — error =
+                            # fsync failure (crash-consistency drills
+                            # crash "at" a named barrier by erroring it)
 })
 
 _lock = threading.Lock()

@@ -21,6 +21,7 @@ import os
 import threading
 from typing import Optional
 
+from ..utils import durable
 from .entry import Entry
 from .stores import FilerStore, _split
 
@@ -51,25 +52,49 @@ class LevelDbStore(FilerStore):
         return os.path.join(self.dir, "segment.jsonl")
 
     def _load(self) -> None:
+        # errors="replace": a torn-sector WAL tail after power loss can
+        # hold arbitrary garbage bytes, which must read as a corrupt
+        # line to skip (loudly) — not a UnicodeDecodeError that keeps
+        # the whole store from opening
         seg = self._seg_path()
         if os.path.exists(seg):
-            with open(seg, encoding="utf-8") as f:
+            corrupt = 0
+            with open(seg, encoding="utf-8", errors="replace") as f:
                 for line in f:
                     try:
                         k, v = json.loads(line)
                     except ValueError:
+                        corrupt += 1
                         continue
                     self._seg_keys.append(k)
                     self._seg_vals.append(v)
+            if corrupt:
+                # the segment holds ACKED (compaction-barrier) data —
+                # only a pre-durable-writer segment can be torn, and
+                # losing its keys must be loud
+                self._warn_corrupt(seg, corrupt,
+                                   "segment (acked data at risk)")
         wal = self._wal_path()
         if os.path.exists(wal):
-            with open(wal, encoding="utf-8") as f:
+            corrupt = 0
+            with open(wal, encoding="utf-8", errors="replace") as f:
                 for line in f:
                     try:
                         rec = json.loads(line)
-                    except ValueError:
-                        continue  # torn tail write: stop-gap like leveldb's
-                    self._mem[rec["k"]] = rec.get("v")
+                        key = rec["k"]
+                    except (ValueError, TypeError, KeyError):
+                        corrupt += 1  # torn tail write: skip, keep rest
+                        continue
+                    self._mem[key] = rec.get("v")
+            if corrupt:
+                self._warn_corrupt(wal, corrupt, "WAL torn tail")
+
+    @staticmethod
+    def _warn_corrupt(path: str, corrupt: int, what: str) -> None:
+        import logging
+        logging.getLogger("filer.leveldb").warning(
+            "%s: skipped %d corrupt line(s) after crash (%s)",
+            path, corrupt, what)
 
     def _append_wal(self, key: str, value: Optional[str]) -> None:
         rec = {"k": key}
@@ -95,7 +120,11 @@ class LevelDbStore(FilerStore):
             for k in keys:
                 f.write(json.dumps([k, merged[k]],
                                    separators=(",", ":")) + "\n")
-        os.replace(tmp, self._seg_path())
+            f.flush()
+            os.fsync(f.fileno())
+        # the segment absorbs the WAL it is about to reset: an un-synced
+        # segment rename + truncated WAL would drop every folded entry
+        durable.replace_atomic(tmp, self._seg_path(), sync_file=False)
         self._seg_keys = keys
         self._seg_vals = [merged[k] for k in keys]
         self._mem.clear()

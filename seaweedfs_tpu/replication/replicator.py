@@ -18,7 +18,7 @@ from .. import faults
 from ..cache.http_pool import shared_pool
 from ..filer.entry import Entry
 from ..filer.filer import MetaEvent
-from ..utils import glog
+from ..utils import durable, glog
 from ..utils import metrics as metrics_mod
 from ..utils.retry import RetryPolicy
 from .sink import ReplicationSink
@@ -54,10 +54,7 @@ class Replicator:
     def save_offset(self, tsns: int) -> None:
         if not self.offset_path:
             return
-        tmp = self.offset_path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump({"since": tsns}, f)
-        os.replace(tmp, self.offset_path)
+        durable.write_json_atomic(self.offset_path, {"since": tsns})
         self._last_save = time.monotonic()
 
     def _maybe_save_offset(self, tsns: int) -> None:

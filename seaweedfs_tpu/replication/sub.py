@@ -26,6 +26,7 @@ import time
 from typing import Iterator, Optional
 
 from ..filer.filer import MetaEvent
+from ..utils import durable
 
 log = logging.getLogger("replication.sub")
 
@@ -65,10 +66,12 @@ class FileQueueInput(NotificationInput):
             pass
 
     def ack(self) -> None:
-        tmp = self.position_path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump({"file": self._file, "offset": self._offset}, f)
-        os.replace(tmp, self.position_path)
+        # durable: a position that rolls back after power loss re-applies
+        # events (safe but wasteful); a TORN position file used to read
+        # as {} and restart from the epoch
+        durable.write_json_atomic(
+            self.position_path,
+            {"file": self._file, "offset": self._offset})
 
     def _spool_files(self) -> list[str]:
         try:
@@ -165,10 +168,8 @@ class BrokerQueueInput(NotificationInput):
 
     def ack(self) -> None:
         if self.position_path:
-            tmp = self.position_path + ".tmp"
-            with open(tmp, "w", encoding="utf-8") as f:
-                json.dump({"since": self._since}, f)
-            os.replace(tmp, self.position_path)
+            durable.write_json_atomic(self.position_path,
+                                      {"since": self._since})
 
 
 class KafkaQueueInput(NotificationInput):
@@ -224,10 +225,8 @@ class KafkaQueueInput(NotificationInput):
 
     def ack(self) -> None:
         if self.position_path:
-            tmp = self.position_path + ".tmp"
-            with open(tmp, "w", encoding="utf-8") as f:
-                json.dump({"offset": self._offset}, f)
-            os.replace(tmp, self.position_path)
+            durable.write_json_atomic(self.position_path,
+                                      {"offset": self._offset})
 
     def close(self) -> None:
         self._client.close()

@@ -23,6 +23,7 @@ import grpc
 from ..pb import volume_server_pb2 as pb
 from ..pb.rpc import volume_service_handler
 from ..storage.store import safe_collection
+from ..utils import durable
 
 log = logging.getLogger("volume.grpc")
 
@@ -199,6 +200,12 @@ class VolumeGrpcServicer:
                                      collection, ext, base + ext)
             from ..storage.needle_map import remove_sidecars
             remove_sidecars(base + ".idx")  # never trust a leftover .sdx
+            try:
+                # a stale sync watermark from a prior same-id volume
+                # would mis-anchor the pulled copy's recovery scan
+                os.remove(base + ".swm")
+            except FileNotFoundError:
+                pass
             from ..storage.volume import Volume
             v = await _run(lambda: Volume(
                 loc.directory, collection, vid,
@@ -565,7 +572,10 @@ async def pull_file_grpc(source_http_url: str, vid: int, collection: str,
                         f.write(chunk.data)
                     if chunk.is_last:
                         break
-            os.replace(tmp, dest_path)
+            # a pulled replica/shard becomes load-bearing the moment the
+            # repair plan counts it — commit it durably, off the loop
+            await asyncio.get_event_loop().run_in_executor(
+                None, durable.replace_atomic, tmp, dest_path)
         finally:
             # transport errors (RpcError) land here too — never leave a
             # partial multi-GB .tmp in the data directory

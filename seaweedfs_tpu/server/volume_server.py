@@ -46,7 +46,8 @@ from ..utils import compression, fast_multipart
 from ..utils import retry as _retry
 from ..storage.needle import (FLAG_IS_COMPRESSED,
                               FLAG_HAS_LAST_MODIFIED, FLAG_HAS_MIME,
-                              FLAG_HAS_NAME, FLAG_HAS_TTL, Needle)
+                              FLAG_HAS_NAME, FLAG_HAS_TTL, CrcError,
+                              Needle)
 from ..storage import types as t
 from ..storage.store import Store, safe_collection
 from ..storage.volume import (NeedleDeleted, NeedleExpired, NeedleNotFound,
@@ -648,6 +649,24 @@ class VolumeServer:
                                              status=404)
             except NeedleDeleted:
                 return web.json_response({"error": "deleted"}, status=404)
+            except CrcError as rot:
+                # on-disk corruption (bit-rot / torn write) on a volume
+                # we host: repair from a healthy replica and serve the
+                # good copy instead of surfacing the rot to the client.
+                # The repair re-appends the intact needle locally (the
+                # corrupt bytes become vacuumable garbage) and the event
+                # is reported for the scrubber/operators via metric+log.
+                self.metrics.count("read_crc_repair")
+                log.error("volume %d: CRC mismatch on needle %s (%s); "
+                          "attempting read-repair from replicas",
+                          fid.volume_id, fid, rot)
+                repaired = None
+                if self._repair_permitted(str(fid)):
+                    repaired = await self._read_repair(fid)
+                if repaired is None:
+                    return web.json_response(
+                        {"error": "data corruption"}, status=500)
+                n = repaired
         # lifecycle heat: one dict update per served read (EC reads —
         # the warm tier's un-EC signal — land here too)
         self.heat.record_read(fid.volume_id)

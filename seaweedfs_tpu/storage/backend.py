@@ -29,7 +29,8 @@ import os
 import threading
 from typing import Callable, Iterator, Optional
 
-from ..utils import retry
+from .. import faults
+from ..utils import durable, retry
 
 
 def _fallocate_keep_size(fd: int, length: int) -> bool:
@@ -104,6 +105,9 @@ class DiskFile(BackendStorageFile):
         return os.pread(self._f.fileno(), n, offset)
 
     def write_at(self, data: bytes, offset: int) -> int:
+        if faults.fire("disk.write"):
+            return len(data)  # drop: the kernel never saw the bytes
+        data = faults.corrupt("disk.write", data)
         return os.pwrite(self._f.fileno(), data, offset)
 
     def size(self) -> int:
@@ -116,6 +120,7 @@ class DiskFile(BackendStorageFile):
         self._f.flush()
 
     def sync(self) -> None:
+        faults.fire("disk.sync")
         self._f.flush()
         os.fsync(self._f.fileno())
 
@@ -363,10 +368,9 @@ def vif_path(base_file_name: str) -> str:
 
 
 def save_volume_info(base_file_name: str, info: dict) -> None:
-    tmp = vif_path(base_file_name) + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(info, f, indent=1)
-    os.replace(tmp, vif_path(base_file_name))
+    # the .vif is the only record of where a tiered .dat lives — losing
+    # it to a dropped rename strands the volume, so the write is durable
+    durable.write_json_atomic(vif_path(base_file_name), info, indent=1)
 
 
 def load_volume_info(base_file_name: str) -> Optional[dict]:

@@ -40,6 +40,12 @@ TTL_BYTES = 2
 PAIR_NAME_PREFIX = "Seaweed-"
 
 
+class CrcError(ValueError):
+    """Stored needle bytes fail their CRC — bit-rot or a torn write.
+    Typed so the read path can distinguish on-disk corruption (trigger
+    read-repair from a healthy replica) from a malformed request."""
+
+
 def crc32c_update(crc: int, data: bytes) -> int:
     return google_crc32c.extend(crc, data)
 
@@ -216,7 +222,7 @@ class Needle:
         stored_checksum = t.get_u32(record, trailer)
         n.checksum = crc32c_update(0, n.data)
         if verify and size > 0 and stored_checksum != crc_value(n.checksum):
-            raise ValueError(
+            raise CrcError(
                 f"needle {n.id:x} CRC mismatch: stored {stored_checksum:#x} "
                 f"computed {crc_value(n.checksum):#x}")
         if version == t.VERSION3:

@@ -17,6 +17,7 @@ from typing import Callable, Iterator, Optional
 
 from . import idx as idx_mod
 from . import types as t
+from ..utils import durable
 
 
 @dataclass(frozen=True)
@@ -24,6 +25,26 @@ class NeedleValue:
     key: int
     offset: int  # stored units (multiply by 8 for byte offset)
     size: int    # signed
+
+
+def _truncate_torn_tail(index_path: str, offset_size: int) -> None:
+    """Align-truncate an .idx journal whose last record was torn by a
+    power loss mid-append. The partial entry carries no usable data
+    (iter_index_* already skip it) but appending AFTER it would corrupt
+    the journal's alignment for every later record — so the torn bytes
+    are cut before the journal is reopened for append."""
+    if not os.path.exists(index_path):
+        return
+    entry = t.needle_map_entry_size(offset_size)
+    size = os.path.getsize(index_path)
+    torn = size % entry
+    if torn:
+        import logging
+        logging.getLogger("needle_map").warning(
+            "%s: truncating %d torn tail bytes (crash recovery)",
+            index_path, torn)
+        with open(index_path, "r+b") as f:
+            f.truncate(size - torn)
 
 
 class NeedleMap:
@@ -45,6 +66,7 @@ class NeedleMap:
         self.deleted_byte_count = 0
         self.maximum_key = 0
         if index_path is not None:
+            _truncate_torn_tail(index_path, offset_size)
             self._load(index_path)
             self._index_file = open(index_path, "ab")
 
@@ -142,6 +164,15 @@ class NeedleMap:
     def values(self):
         """All current entries (live + tombstoned), unordered."""
         return list(self._map.values())
+
+    def sync(self) -> None:
+        """Durability barrier: flush + fsync the .idx journal. Entries
+        journaled before this call survive power loss (the .dat record
+        they point at must be synced FIRST — Volume.sync orders the
+        two)."""
+        if self._index_file is not None:
+            self._index_file.flush()
+            os.fsync(self._index_file.fileno())
 
     def close(self) -> None:
         if self._index_file is not None:
@@ -501,19 +532,21 @@ class DiskNeedleMap(NeedleMap):
         tmp = sdx + ".tmp"
         with open(tmp, "wb") as f:
             f.write(self._header_bytes(n))
-            skeys.tofile(f)
+            f.write(memoryview(skeys))
             # gather offsets/sizes in bounded chunks instead of whole-array
             # permuted copies — the cold build of a 100M-entry volume must
             # not transiently cost 3x the index size in RAM
             step = 2_000_000
             for lo in range(0, n, step):
-                rec["o"][order[lo:lo + step]].astype(np.uint64).tofile(f)
+                f.write(memoryview(
+                    rec["o"][order[lo:lo + step]].astype(np.uint64)))
             for lo in range(0, n, step):
-                rec["s"][order[lo:lo + step]].astype(np.int32).tofile(f)
+                f.write(memoryview(
+                    rec["s"][order[lo:lo + step]].astype(np.int32)))
             f.flush()
             os.fsync(f.fileno())
         del rec, order, skeys
-        os.replace(tmp, sdx)
+        durable.replace_atomic(tmp, sdx, sync_file=False)
         self._open_sdx(sdx)
         return True
 
@@ -594,14 +627,14 @@ class DiskNeedleMap(NeedleMap):
         tmp = sdx + ".tmp"
         with open(tmp, "wb") as f:
             f.write(self._header_bytes(len(keys)))
-            keys.tofile(f)
-            offs.tofile(f)
-            sizes.tofile(f)
+            f.write(memoryview(keys))
+            f.write(memoryview(offs))
+            f.write(memoryview(sizes))
             f.flush()
             os.fsync(f.fileno())
         # replacing a live memmap's backing file is safe on linux: the old
         # inode stays until unmapped, and _open_sdx re-points us at the new
-        os.replace(tmp, sdx)
+        durable.replace_atomic(tmp, sdx, sync_file=False)
         self._map.clear()
         self._open_sdx(sdx)
 

@@ -280,7 +280,7 @@ class Store:
                 if v is not None:
                     base = v.base_file_name()
                     v.close()
-                    for ext in (".dat", ".idx"):
+                    for ext in (".dat", ".idx", ".swm"):
                         if os.path.exists(base + ext):
                             os.remove(base + ext)
                     return True

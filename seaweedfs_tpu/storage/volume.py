@@ -11,6 +11,8 @@ synchronous and thread-safe via a single lock.
 
 from __future__ import annotations
 
+import json
+import logging
 import os
 import threading
 import time
@@ -19,11 +21,21 @@ from typing import Optional
 from . import backend as backend_mod
 from . import idx as idx_mod
 from . import types as t
+from ..utils import durable
 from .backend import DiskFile
 from .needle import (FLAG_HAS_LAST_MODIFIED, FLAG_HAS_TTL, Needle)
-from .needle_map import (NeedleValue, create_needle_map,
-                         remove_sidecars)
+from .needle_map import (NeedleValue, _truncate_torn_tail,
+                         create_needle_map, remove_sidecars)
 from .superblock import SUPER_BLOCK_SIZE, SuperBlock
+
+log = logging.getLogger("volume")
+
+
+def _remove_quiet(path: str) -> None:
+    try:
+        os.remove(path)
+    except FileNotFoundError:
+        pass
 
 
 class NeedleNotFound(KeyError):
@@ -77,6 +89,7 @@ class Volume:
             if os.path.exists(base + ".idx"):
                 os.remove(base + ".idx")
             remove_sidecars(base + ".idx")
+            _remove_quiet(base + ".swm")
             self.nm = create_needle_map(self.needle_map_kind, base + ".idx",
                                         offset_size=self.offset_size)
         elif not has_local:
@@ -91,6 +104,10 @@ class Volume:
         else:
             self._dat = DiskFile(dat_path)
             self.super_block = self._read_superblock()
+            # crash recovery BEFORE the map loads: reconcile a torn .dat
+            # tail / torn or stale .idx on disk so the in-memory map is
+            # built from a consistent pair
+            self._crash_recover(base)
             self.nm = create_needle_map(self.needle_map_kind, base + ".idx",
                                         offset_size=self.offset_size)
             # conservative freshness floor for TTL expiry across restarts:
@@ -344,6 +361,227 @@ class Volume:
             self._dat.write_at(bytes([rp.to_byte()]), 1)
             self._dat.flush()
 
+    # --- crash recovery (power-loss consistency) ---
+
+    def _load_sync_watermark(self, base: str) -> Optional[dict]:
+        """The durable checkpoint `.swm` written by sync(): every .dat
+        byte below synced_size and every .idx byte below idx_synced_size
+        was fsynced BEFORE the checkpoint committed, so recovery only
+        scans/validates past them. None = no checkpoint (legacy volume /
+        first boot)."""
+        try:
+            with open(base + ".swm") as f:
+                d = json.load(f)
+            v = d.get("synced_size")
+            if not isinstance(v, int) or v < 0:
+                return None
+            iv = d.get("idx_synced_size")
+            return {"synced_size": v,
+                    "idx_synced_size": iv if isinstance(iv, int)
+                    and iv >= 0 else 0}
+        except (OSError, ValueError):
+            return None
+
+    def _save_sync_watermark(self, base: str, synced_size: int,
+                             idx_synced_size: int) -> None:
+        durable.write_json_atomic(
+            base + ".swm", {"synced_size": synced_size,
+                            "idx_synced_size": idx_synced_size})
+
+    def _scan_valid_records(self, start: int, end: int) -> tuple[int, list]:
+        """Walk .dat records in [start, end); returns (cut_offset, records)
+        where cut_offset is `end` when every record parses and CRC-checks,
+        else the offset of the first torn/invalid record. records are
+        (needle, byte_offset) for the valid prefix."""
+        offset = start
+        records = []
+        while offset + t.NEEDLE_HEADER_SIZE <= end:
+            try:
+                head = self._read_header_at(offset)
+                if head is None:
+                    return offset, records
+                # a dropped un-synced page reads back as zeros: an
+                # all-zero "record" is a hole, not a needle (real ids
+                # are never 0)
+                if head.id == 0 and head.cookie == 0 and head.size == 0:
+                    return offset, records
+                size = head.size if head.size > 0 else 0
+                length = t.get_actual_size(size, self.version)
+                if offset + length > end:
+                    return offset, records
+                record = self._dat.read_at(length, offset)
+                if len(record) < length:
+                    return offset, records
+                n = Needle.from_bytes(record, self.version)
+                records.append((n, offset))
+                offset += length
+            except Exception:
+                return offset, records
+        # loop exit leaves `offset` at the clean end — or at a torn
+        # partial header (< 16B of tail), which the caller truncates
+        return offset, records
+
+    def _crash_recover(self, base: str) -> None:
+        """Reconcile .dat <-> .idx after a potential power loss:
+
+        1. align-truncate a torn .idx tail;
+        2. CRC-scan the un-synced .dat suffix (from the `.swm` durable
+           watermark, or from the last .idx-referenced record on legacy
+           volumes) and truncate the first torn record and everything
+           after it;
+        3. drop .idx entries referencing truncated bytes (durable
+           rewrite; sidecars invalidated);
+        4. re-derive .idx entries for valid .dat records the journal
+           never recorded (the .dat is written first, so the journal can
+           trail it).
+
+        Acked data is never touched: sync() fsyncs the .dat BEFORE the
+        watermark commits, so everything below the watermark is durable
+        and the torn region can only hold un-acked appends."""
+        idx_path = base + ".idx"
+        # interrupted compaction commit: a surviving .cpd means the swap
+        # never reached its point of no return — roll back (the old
+        # .dat/.idx pair is intact). A lone .cpx means the fsynced .dat
+        # swap landed but the .idx swap didn't — roll forward so the
+        # pair can't load crossed.
+        if os.path.exists(base + ".cpd"):
+            log.warning("volume %d: discarding interrupted compaction "
+                        "(crash recovery)", self.vid)
+            _remove_quiet(base + ".cpx")
+            _remove_quiet(base + ".cpd")
+        elif os.path.exists(base + ".cpx"):
+            log.warning("volume %d: completing interrupted compaction "
+                        "commit (crash recovery)", self.vid)
+            remove_sidecars(idx_path)
+            durable.replace_atomic(base + ".cpx", idx_path,
+                                   sync_file=False)
+        if not os.path.exists(idx_path):
+            open(idx_path, "wb").close()
+        _truncate_torn_tail(idx_path, self.offset_size)
+        dat_size = self._dat.size()
+        entry_w = t.needle_map_entry_size(self.offset_size)
+        idx_size = os.path.getsize(idx_path)
+
+        wm = self._load_sync_watermark(base)
+        if wm is not None:
+            scan_start = min(wm["synced_size"], dat_size)
+            idx_wm = min(wm["idx_synced_size"], idx_size)
+        else:
+            # legacy volume (no watermark): anchor the scan at the last
+            # journal-referenced record — the exact span the old
+            # check_integrity trusted blindly. One streaming pass; no
+            # per-entry state is kept (100M-entry journals stay O(1)).
+            last_ref = self.super_block.block_size()
+            for key, stored_offset, size in idx_mod.iter_index_file(
+                    idx_path, offset_size=self.offset_size):
+                if stored_offset > 0:
+                    last_ref = max(last_ref,
+                                   t.stored_to_offset(stored_offset))
+            scan_start = min(last_ref, dat_size)
+            idx_wm = 0
+        idx_wm -= idx_wm % entry_w
+        scan_start = max(scan_start, self.super_block.block_size())
+        cut, records = self._scan_valid_records(scan_start, dat_size)
+        rec_map = {off: n for n, off in records}
+
+        if cut < dat_size:
+            log.warning(
+                "volume %d: torn .dat tail — truncating %d -> %d "
+                "(crash recovery; %d valid records salvaged after "
+                "watermark %s)", self.vid, dat_size, cut, len(records),
+                wm)
+            self._dat.truncate(cut)
+            self._dat.sync()
+
+        # validate the journal tail: entries below the idx watermark
+        # were fsynced (and, by sync() ordering, reference only synced
+        # .dat bytes) — trusted without inspection. Entries past it may
+        # be torn-sector garbage or reference .dat bytes that never hit
+        # the platter: each must check out against the scanned record
+        # map (or, on a watermarked volume, against the on-disk header
+        # for a synced-region reference). Both passes stream — journal
+        # size never bounds recovery RAM.
+        def entry_ok(key: int, stored_offset: int, size: int) -> bool:
+            if stored_offset == 0:
+                # offset-less tombstone: no .dat reference to check
+                return size == t.TOMBSTONE_FILE_SIZE
+            off = t.stored_to_offset(stored_offset)
+            if off >= cut:
+                return False
+            if off >= scan_start:
+                n = rec_map.get(off)
+                return (n is not None and n.id == key and
+                        (n.size == size or
+                         (size == t.TOMBSTONE_FILE_SIZE
+                          and len(n.data) == 0)))
+            if wm is None:
+                # legacy: references below the anchor were always
+                # trusted; keep that contract (no per-entry preads)
+                return True
+            # references the synced region: one header pread
+            head = self._read_header_at(off)
+            return head is not None and head.id == key
+
+        tail_offsets: set[int] = set()
+        dropped = 0
+        for key, stored_offset, size in idx_mod.iter_index_file(
+                idx_path, start=idx_wm, offset_size=self.offset_size):
+            if entry_ok(key, stored_offset, size):
+                if stored_offset > 0:
+                    off = t.stored_to_offset(stored_offset)
+                    if off >= scan_start:
+                        tail_offsets.add(off)
+            else:
+                dropped += 1
+        if dropped:
+            log.warning("volume %d: dropping %d un-synced .idx entries "
+                        "that reference torn/absent data (crash "
+                        "recovery)", self.vid, dropped)
+            remove_sidecars(idx_path)
+            tmp = idx_path + ".tmp"
+            with open(tmp, "wb") as out, open(idx_path, "rb") as src:
+                remaining = idx_wm
+                while remaining > 0:
+                    chunk = src.read(min(1 << 20, remaining))
+                    if not chunk:
+                        break
+                    out.write(chunk)
+                    remaining -= len(chunk)
+                for key, stored_offset, size in idx_mod.iter_index_file(
+                        idx_path, start=idx_wm,
+                        offset_size=self.offset_size):
+                    if entry_ok(key, stored_offset, size):
+                        out.write(idx_mod.pack_entry(
+                            key, stored_offset, size,
+                            offset_size=self.offset_size))
+                out.flush()
+                os.fsync(out.fileno())
+            durable.replace_atomic(tmp, idx_path, sync_file=False)
+
+        # re-derive journal entries the crash dropped: valid .dat records
+        # past the journal's coverage (writes land in the .dat first;
+        # entries for records >= scan_start can only live in the journal
+        # tail, so tail_offsets is the complete reference set). Zero-
+        # length records are SKIPPED, not re-derived: a tombstone and an
+        # empty-body overwrite are indistinguishable on disk, and both
+        # are un-acked here (an acked one has its journal entry below
+        # the fsynced watermark) — re-deriving the wrong interpretation
+        # would tombstone an acked value, while not applying an un-acked
+        # mutation is always a legal post-crash state.
+        missing = [(n, off) for n, off in records
+                   if off not in tail_offsets and off < cut
+                   and len(n.data) > 0]
+        if missing:
+            log.warning("volume %d: re-deriving %d .idx entries from the "
+                        ".dat tail (crash recovery)", self.vid,
+                        len(missing))
+            with open(idx_path, "ab") as f:
+                for n, off in missing:
+                    stored = t.offset_to_stored(off, self.offset_size)
+                    f.write(idx_mod.pack_entry(
+                        n.id, stored, n.size,
+                        offset_size=self.offset_size))
+
     def check_integrity(self) -> None:
         """Verify the last .idx entry points at a valid needle at the .dat
         tail (CheckVolumeDataIntegrity, volume_checking.go:14)."""
@@ -497,16 +735,34 @@ class Volume:
                             cpx.write(idx_mod.pack_entry(
                                 key, 0, t.TOMBSTONE_FILE_SIZE,
                                 offset_size=self.offset_size))
+                # the swap REPLACES the only copy of every live needle:
+                # both compacted files must be on the platter before the
+                # rename can make them load-bearing (an un-synced rename
+                # that persists over dropped data pages is a torn .dat)
+                cpd.flush()
+                os.fsync(cpd.fileno())
+                cpx.flush()
+                os.fsync(cpx.fileno())
             self._dat.close()
             self.nm.close()
-            os.replace(base + ".cpd", base + ".dat")
+            # the old watermark describes the PRE-compaction byte layout;
+            # it must not survive into a crash window where it could
+            # vouch for the new file's unrelated offsets
+            _remove_quiet(base + ".swm")
+            durable.replace_atomic(base + ".cpd", base + ".dat",
+                                   sync_file=False)
             remove_sidecars(base + ".idx")  # derived from the OLD journal
-            os.replace(base + ".cpx", base + ".idx")
+            durable.replace_atomic(base + ".cpx", base + ".idx",
+                                   sync_file=False)
             self._dat = DiskFile(base + ".dat")
             self.super_block = new_sb
             self.nm = create_needle_map(self.needle_map_kind, base + ".idx",
                                         offset_size=self.offset_size)
             self._append_offset = self._dat.size()
+            # everything in the compacted .dat/.idx is already fsynced:
+            # stamp a fresh watermark so the next open scans nothing
+            self._save_sync_watermark(base, self._append_offset,
+                                      os.path.getsize(base + ".idx"))
             self._compacting = False
 
     def cleanup_compact(self) -> None:
@@ -551,6 +807,12 @@ class Volume:
 
     def close(self) -> None:
         with self._lock:
+            # clean shutdown is a durability barrier too: everything
+            # appended so far becomes acked, and the watermark lets the
+            # next open skip the recovery scan entirely
+            if not self._dat.closed and self._dat.writable \
+                    and not self.read_only:
+                self._sync_locked()
             self.nm.close()
             if self._retired_dat is not None:
                 self._retired_dat.close()
@@ -560,5 +822,23 @@ class Volume:
                 self._dat.close()
 
     def sync(self) -> None:
+        """Durability barrier: after this returns, every append so far
+        survives power loss. Order matters — .dat pages first, then the
+        .idx journal, then the `.swm` watermark that recovery trusts
+        (the watermark must never claim bytes still in flight)."""
         with self._lock:
-            self._dat.sync()
+            self._sync_locked()
+
+    def _sync_locked(self) -> None:
+        self._dat.sync()
+        nm_sync = getattr(self.nm, "sync", None)
+        if nm_sync is not None:
+            nm_sync()
+        if self._dat.writable:
+            base = self.base_file_name()
+            try:
+                idx_size = os.path.getsize(base + ".idx")
+            except OSError:
+                idx_size = 0
+            self._save_sync_watermark(base, self._append_offset,
+                                      idx_size)

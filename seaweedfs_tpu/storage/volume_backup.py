@@ -155,8 +155,13 @@ def rebuild_idx(volume_dir: str, collection: str, vid: int) -> int:
                     offset_size=w))
                 count += 1
         v.scan(visit)
+        out.flush()
+        os.fsync(out.fileno())
     v.close()
     from .needle_map import remove_sidecars
+    from ..utils import durable
     remove_sidecars(base + ".idx")
-    os.replace(tmp, base + ".idx")
+    # the rebuilt index replaces the only copy — a revoked rename after
+    # a crash must yield the (deleted) old state loudly, never a torn mix
+    durable.replace_atomic(tmp, base + ".idx", sync_file=False)
     return count

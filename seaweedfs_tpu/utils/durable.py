@@ -1,0 +1,88 @@
+"""The atomic-replace recipe, extracted from cluster/raft.py.
+
+Every persistence path that commits state by writing a temp file and
+renaming it over the live one needs the SAME three barriers or a power
+loss can undo it:
+
+  1. fsync the temp file     — otherwise the rename can land while the
+                               data pages are still dirty, surfacing an
+                               empty or partial file after the crash;
+  2. os.replace              — the atomic commit point;
+  3. fsync the directory     — otherwise the rename itself is only in
+                               the directory's dirty page and the OLD
+                               file (or nothing) comes back.
+
+raft._write_state carried the full dance since PR 4 because a vanished
+vote breaks election safety; the `.ecm`/`.vif`/offset/snapshot writers
+each re-invented the first two steps and skipped the third (or all
+three). This module is the single home; the weedlint `atomic-replace`
+rule holds every other `os.replace` in the tree to it.
+
+The helpers are synchronous and block on fsync — event-loop callers
+must run them in an executor (weedlint's blocking-call rules enforce
+that side).
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import os
+from typing import Union
+
+# filesystems that cannot fsync a directory at all answer one of these;
+# a real write-barrier failure (EIO, ENOSPC, ...) is NOT in this set
+_FSYNC_UNSUPPORTED = (errno.EINVAL, errno.ENOTSUP, errno.EBADF)
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so namespace ops (create/rename/unlink) inside
+    it survive power loss. Only not-supported errnos are swallowed
+    (exotic mounts with no directory barrier available — there is no
+    stronger call to make there); a failing barrier (EIO) propagates:
+    the caller must NOT report the rename as durable."""
+    fd = os.open(path or ".", os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    except OSError as e:
+        if e.errno not in _FSYNC_UNSUPPORTED:
+            raise
+    finally:
+        os.close(fd)
+
+
+def replace_atomic(tmp: str, dst: str, sync_file: bool = True) -> None:
+    """fsync `tmp`, rename it over `dst`, fsync the directory.
+
+    Pass sync_file=False only when the caller already fsynced the temp
+    file through its own handle (e.g. right before closing it)."""
+    if sync_file:
+        fd = os.open(tmp, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    os.replace(tmp, dst)
+    fsync_dir(os.path.dirname(dst))
+
+
+def write_atomic(path: str, data: Union[bytes, str],
+                 encoding: str = "utf-8") -> None:
+    """Write `data` to `path` with full crash-consistency: temp file in
+    the same directory, fsync, atomic rename, directory fsync. After
+    this returns the new content is durable; a crash at any point leaves
+    either the complete old file or the complete new one."""
+    tmp = path + ".tmp"
+    if isinstance(data, str):
+        data = data.encode(encoding)
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    replace_atomic(tmp, path, sync_file=False)
+
+
+def write_json_atomic(path: str, obj, **json_kwargs) -> None:
+    """write_atomic for the many JSON sidecar/offset writers."""
+    write_json = json.dumps(obj, **json_kwargs)
+    write_atomic(path, write_json)

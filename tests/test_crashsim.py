@@ -1,0 +1,354 @@
+"""Crash-consistency plane: shim/replay mechanics, the per-subsystem
+power-loss sweeps (the ISSUE 15 acceptance: >= 200 randomly-seeded
+crash points with zero acked loss / zero silent corruption / converging
+recovery), torn-tail volume recovery proven byte-exact, and the CRC
+read-repair path driven by a `corrupt` fault on `disk.write`.
+"""
+
+import json
+import os
+import random
+
+import pytest
+
+from seaweedfs_tpu import faults
+from seaweedfs_tpu.crashsim import (DiskRecorder, build_crash_state,
+                                    harness, sweep)
+from seaweedfs_tpu.crashsim import workloads as wl
+from seaweedfs_tpu.crashsim.harness import CrashWorkload
+from seaweedfs_tpu.storage.needle import CrcError, Needle
+from seaweedfs_tpu.storage.volume import Volume
+from seaweedfs_tpu.utils import durable
+
+COOKIE = 0xBEEF
+
+
+# ------------------------------------------------------ shim + replay
+
+def _crash_tree(rec, crash, seed, dest):
+    build_crash_state(rec.baseline, rec.ops, crash, random.Random(seed),
+                      dest)
+
+
+def test_shim_records_and_full_replay_roundtrips(tmp_path):
+    root = tmp_path / "r"
+    root.mkdir()
+    (root / "base.txt").write_bytes(b"baseline")
+    rec = DiskRecorder(str(root))
+    with rec:
+        with open(root / "a.bin", "wb") as f:
+            f.write(b"hello ")
+            f.write(b"world")
+            f.flush()
+            os.fsync(f.fileno())
+        durable.write_atomic(str(root / "b.json"), b'{"k": 1}')
+    kinds = [op.kind for op in rec.ops]
+    assert "create" in kinds and "write" in kinds
+    assert "fsync" in kinds and "rename" in kinds and "dirsync" in kinds
+    # crash AFTER everything: all barriers passed -> tree is exact
+    dest = tmp_path / "crash"
+    _crash_tree(rec, len(rec.ops), 7, str(dest))
+    assert (dest / "base.txt").read_bytes() == b"baseline"
+    assert (dest / "a.bin").read_bytes() == b"hello world"
+    assert (dest / "b.json").read_bytes() == b'{"k": 1}'
+
+
+def test_unsynced_write_can_drop_or_tear(tmp_path):
+    root = tmp_path / "r"
+    root.mkdir()
+    (root / "f.bin").write_bytes(b"S" * 1024)   # durable baseline
+    rec = DiskRecorder(str(root))
+    with rec:
+        with open(root / "f.bin", "r+b") as f:
+            f.seek(1024)
+            f.write(b"U" * 2048)              # never fsynced
+    outcomes = set()
+    for seed in range(40):
+        dest = tmp_path / f"c{seed}"
+        _crash_tree(rec, len(rec.ops), seed, str(dest))
+        got = (dest / "f.bin").read_bytes()
+        assert got[:1024] == b"S" * 1024      # synced prefix inviolate
+        tail = got[1024:]
+        if not tail:
+            outcomes.add("dropped")
+        elif tail == b"U" * 2048:
+            outcomes.add("kept")
+        else:
+            outcomes.add("torn")
+            assert len(tail) <= 2048
+    assert {"dropped", "kept", "torn"} <= outcomes
+
+
+def test_rename_without_dirsync_is_revocable_with_durable_it_is_not(
+        tmp_path):
+    root = tmp_path / "r"
+    root.mkdir()
+    (root / "live").write_bytes(b"old")
+
+    rec = DiskRecorder(str(root))
+    with rec:   # the BAD recipe: fsync file, rename, no dirsync
+        with open(root / "live.tmp", "wb") as f:
+            f.write(b"new")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(str(root / "live.tmp"), str(root / "live"))
+    seen = set()
+    for seed in range(30):
+        dest = tmp_path / f"bad{seed}"
+        _crash_tree(rec, len(rec.ops), seed, str(dest))
+        seen.add((dest / "live").read_bytes())
+    assert seen == {b"old", b"new"}           # revocable, never torn
+
+    (root / "live").write_bytes(b"old")
+    rec = DiskRecorder(str(root))
+    with rec:   # the durable recipe: rename survives every crash state
+        durable.write_atomic(str(root / "live"), b"new")
+    for seed in range(30):
+        dest = tmp_path / f"good{seed}"
+        _crash_tree(rec, len(rec.ops), seed, str(dest))
+        assert (dest / "live").read_bytes() == b"new"
+
+
+def test_harness_flags_a_non_durable_writer(tmp_path):
+    """Negative control: the sweep must DETECT the pre-PR recipe, or
+    every green sweep above is vacuous."""
+
+    def setup(root):
+        pass
+
+    def run(root, ack, rng):
+        for i in range(1, 6):
+            tmp = os.path.join(root, "pos.tmp")
+            with open(tmp, "w") as f:
+                json.dump({"v": i}, f)
+            os.replace(tmp, os.path.join(root, "pos"))  # no fsyncs
+            ack("pos", i)
+
+    def recover(crash_dir):
+        try:
+            with open(os.path.join(crash_dir, "pos")) as f:
+                return {"pos": json.load(f)["v"]}
+        except (OSError, ValueError):
+            return {}
+
+    w = CrashWorkload("bad_writer", setup, run, recover)
+    violations = 0
+    for seed in range(1, 8):
+        violations += len(sweep(w, seed=seed, points=25).violations)
+    assert violations > 0
+
+
+# ---------------------------------------------- the acceptance sweeps
+
+@pytest.mark.parametrize("workload", wl.registry(),
+                         ids=lambda w: w.name)
+def test_subsystem_sweep_zero_violations(workload):
+    """Per-subsystem power-loss sweep: every acked write survives, no
+    corrupt state loads silently, recovery converges. Across the six
+    workloads x 2 seeds x 17 points this is 204 crash points — the
+    >= 200 acceptance budget (scripts/crashsim.sh runs the same)."""
+    for seed in (1, 2):
+        r = sweep(workload, seed=seed, points=17)
+        assert r.points == 17
+        assert r.ok, "\n".join(
+            f"crash@{c}: {m}" for c, m in r.violations)
+
+
+def test_sweep_all_counts_points():
+    summary = harness.sweep_all(seeds=1, points=3,
+                                workload_names=["offset_commit"])
+    assert summary["ok"]
+    assert summary["total_points"] == 3
+    assert "offset_commit" in summary["workloads"]
+
+
+# ------------------------------------------- torn-tail volume recovery
+
+def _fill_volume(vdir, n_synced=8, n_unsynced=3):
+    v = Volume(str(vdir), "", 9, create=True)
+    acked = {}
+    for nid in range(1, n_synced + 1):
+        data = bytes([nid]) * (500 + 37 * nid)
+        v.write_needle(Needle(cookie=COOKIE, id=nid, data=data))
+        acked[nid] = data
+    v.sync()
+    for nid in range(100, 100 + n_unsynced):
+        v.write_needle(Needle(cookie=COOKIE, id=nid, data=b"x" * 700))
+    return v, acked
+
+
+def test_torn_dat_tail_recovery_byte_exact(tmp_path):
+    v, acked = _fill_volume(tmp_path)
+    base = v.base_file_name()
+    wm = json.load(open(base + ".swm"))["synced_size"]
+    v.nm.close()
+    v._dat.close()
+
+    # tear the un-synced tail: chop mid-record and garbage the stump
+    size = os.path.getsize(base + ".dat")
+    assert size > wm
+    with open(base + ".dat", "r+b") as f:
+        f.truncate(wm + 300)
+        f.seek(wm + 120)
+        f.write(bytes(range(180)))
+
+    v2 = Volume(str(tmp_path), "", 9)
+    # torn tail truncated exactly back to the durable watermark
+    assert v2.data_file_size() == wm
+    for nid, data in acked.items():
+        assert v2.read_needle(nid).data == data        # byte-exact
+    # no un-acked write is half-visible: the torn ids are plain misses
+    for nid in (100, 101, 102):
+        with pytest.raises(KeyError):
+            v2.read_needle(nid)
+    # the volume keeps working and a re-open is clean (convergence)
+    v2.write_needle(Needle(cookie=COOKIE, id=200, data=b"after-crash"))
+    v2.sync()
+    v2.close()
+    v3 = Volume(str(tmp_path), "", 9)
+    assert v3.read_needle(200).data == b"after-crash"
+    v3.close()
+
+
+def test_torn_idx_tail_truncated_and_journal_validated(tmp_path):
+    v, acked = _fill_volume(tmp_path, n_unsynced=0)
+    base = v.base_file_name()
+    v.close()
+    # torn journal: a partial trailing entry + a garbage full entry
+    with open(base + ".idx", "ab") as f:
+        f.write(bytes(range(16)))   # garbage entry (un-synced region)
+        f.write(b"\xff" * 7)        # torn partial entry
+    v2 = Volume(str(tmp_path), "", 9)
+    assert os.path.getsize(base + ".idx") % 16 == 0
+    for nid, data in acked.items():
+        assert v2.read_needle(nid).data == data
+    assert len(v2.nm) == len(acked)   # the garbage entry was dropped
+    v2.close()
+
+
+def test_interrupted_compaction_rolls_forward_and_back(tmp_path):
+    v, acked = _fill_volume(tmp_path, n_unsynced=0)
+    v.delete_needle(Needle(cookie=COOKIE, id=1))
+    del acked[1]
+    v.sync()
+    base = v.base_file_name()
+    v.close()
+
+    # (a) crash before the swap: .cpd + .cpx left behind -> roll back
+    with open(base + ".cpd", "wb") as f:
+        f.write(b"partial compaction")
+    with open(base + ".cpx", "wb") as f:
+        f.write(b"partial index")
+    v2 = Volume(str(tmp_path), "", 9)
+    assert not os.path.exists(base + ".cpd")
+    assert not os.path.exists(base + ".cpx")
+    for nid, data in acked.items():
+        assert v2.read_needle(nid).data == data
+    v2.close()
+
+    # (b) crash between the two renames: fresh .dat landed, .idx still
+    # old, fsynced .cpx waiting -> roll forward
+    v3 = Volume(str(tmp_path), "", 9)
+    v3.begin_compact()
+    # freeze the state commit_compact would see mid-swap
+    import shutil
+    shutil.copy(base + ".cpx", base + ".cpx.keep")
+    v3.commit_compact()
+    v3.close()
+    compacted_idx = open(base + ".idx", "rb").read()
+    os.replace(base + ".cpx.keep", base + ".cpx")
+    with open(base + ".idx", "wb") as f:
+        f.write(b"\0" * 16)          # pretend the old (bogus) idx
+    os.remove(base + ".swm")
+    v4 = Volume(str(tmp_path), "", 9)
+    assert open(base + ".idx", "rb").read() == compacted_idx
+    for nid, data in acked.items():
+        assert v4.read_needle(nid).data == data
+    v4.close()
+
+
+def test_clean_shutdown_skips_recovery_scan(tmp_path):
+    v, acked = _fill_volume(tmp_path, n_unsynced=2)
+    base = v.base_file_name()
+    v.close()    # durability barrier: acks everything incl. the tail
+    wm = json.load(open(base + ".swm"))
+    assert wm["synced_size"] == os.path.getsize(base + ".dat")
+    assert wm["idx_synced_size"] == os.path.getsize(base + ".idx")
+    v2 = Volume(str(tmp_path), "", 9)
+    assert v2.read_needle(100).data == b"x" * 700
+    v2.close()
+
+
+# ------------------------------------------------ fault plane additions
+
+def test_disk_fault_points_registered():
+    assert "disk.write" in faults.KNOWN_POINTS
+    assert "disk.sync" in faults.KNOWN_POINTS
+
+
+def test_disk_sync_fault_crashes_at_the_barrier(tmp_path):
+    v, _ = _fill_volume(tmp_path, n_synced=2, n_unsynced=0)
+    faults.set_fault("disk.sync", "error", count=1)
+    try:
+        with pytest.raises(faults.FaultError):
+            v.sync()
+    finally:
+        faults.clear("disk.sync")
+        v.close()
+
+
+def test_disk_write_corrupt_flips_stored_bytes(tmp_path):
+    v = Volume(str(tmp_path), "", 3, create=True)
+    faults.set_fault("disk.write", "corrupt", count=1, seed=5)
+    try:
+        v.write_needle(Needle(cookie=COOKIE, id=1, data=b"p" * 4000))
+    finally:
+        faults.clear("disk.write")
+    with pytest.raises((CrcError, ValueError)):
+        v.read_needle(1)
+    v.nm.close()
+    v._dat.close()
+
+
+# ------------------------------------- CRC read-repair (satellite 3)
+
+def test_crc_mismatch_triggers_read_repair_from_replica():
+    import urllib.request
+    from cluster_util import Cluster
+
+    # "010": one replica on a different rack — the two test servers
+    # register as rack0/rack1
+    c = Cluster(n_volume_servers=2, default_replication="010")
+    try:
+        # first upload creates the replicated volume (superblock writes
+        # happen here, outside the fault window)
+        c.client.upload(b"warmup", collection="crc")
+        c.wait_heartbeats()
+
+        payload = bytes(range(256)) * 16        # 4KB, body-heavy record
+        faults.set_fault("disk.write", "corrupt", count=1, seed=11)
+        try:
+            fid = c.client.upload(payload, collection="crc")
+        finally:
+            faults.clear("disk.write")
+
+        # the primary's stored copy is corrupt, the replica's is clean:
+        # reading from EVERY holder must return the good bytes (the
+        # corrupt holder repairs from its replica instead of erroring)
+        vid = fid.split(",")[0]
+        with urllib.request.urlopen(
+                f"http://{c.master_url}/dir/lookup?volumeId={vid}",
+                timeout=10) as r:
+            locs = [entry["url"]
+                    for entry in json.load(r)["locations"]]
+        assert len(locs) == 2
+        for url in locs:
+            with urllib.request.urlopen(f"http://{url}/{fid}",
+                                        timeout=20) as r:
+                assert r.read() == payload
+
+        repairs = sum(vs.metrics._counters.get("read_crc_repair", 0)
+                      for vs in c.volume_servers)
+        assert repairs >= 1
+    finally:
+        c.shutdown()
