@@ -1541,6 +1541,151 @@ def phase_overload(work: str, budget_s: float = 150.0) -> dict:
 
 
 
+def phase_observe(work: str, budget_s: float = 180.0) -> dict:
+    """Telemetry-plane overhead gate: read p50 with the whole plane
+    armed (19Hz sampling profiler + per-request wide events + trace
+    spans — the shipping default) vs fully disarmed, same server shape
+    and workload. Acceptance: armed p50 regression <= 3% — the number
+    that justifies always-on in production. Each config boots its own
+    server (the knobs are read at startup) and is measured twice with
+    the min taken, so a one-off host hiccup can't fail the gate.
+
+    Both configs get the same fault-injected 2ms service time on
+    volume.read (phase_overload's determinism trick): without a floor
+    the raw p50 on this host is ~0.8ms and swings +-25% run to run from
+    scheduler noise alone — far above the ~10us/request the plane
+    actually costs (measured separately and reported as
+    per_request_overhead_us, so the absolute cost stays visible and
+    isn't laundered by the floor)."""
+    import socket
+    import urllib.request
+
+    started = time.perf_counter()
+
+    def left() -> float:
+        return budget_s - (time.perf_counter() - started)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from seaweedfs_tpu.client import Client
+
+    import seaweedfs_tpu
+    pkg_root = os.path.dirname(os.path.dirname(seaweedfs_tpu.__file__))
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def measure(tag: str, env_extra: dict, armed: bool = False) -> dict:
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   SEAWEEDFS_FORCE_CPU="1", **env_extra)
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        mport, vport = free_port(), free_port()
+        data_dir = os.path.join(work, f"observe_{tag}")
+        os.makedirs(data_dir, exist_ok=True)
+        with open(os.path.join(work, f"observe_{tag}.log"), "ab") as logf:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "seaweedfs_tpu.cli", "server",
+                 "-ip", "127.0.0.1", "-master_port", str(mport),
+                 "-port", str(vport), "-dir", data_dir],
+                cwd=data_dir, env=env, stdout=logf, stderr=logf)
+            try:
+                deadline = time.time() + 45
+                while True:
+                    try:
+                        with urllib.request.urlopen(
+                                f"http://127.0.0.1:{mport}/dir/assign",
+                                timeout=2) as r:
+                            if "fid" in json.loads(r.read()):
+                                break
+                    except Exception:
+                        pass
+                    if time.time() > deadline:
+                        raise RuntimeError(
+                            f"observe/{tag} server failed to start")
+                    time.sleep(0.3)
+                client = Client(f"127.0.0.1:{mport}")
+                fids = [client.upload(b"telemetry overhead " * 50)
+                        for _ in range(32)]
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{vport}/admin/faults",
+                    data=json.dumps({"set": [
+                        {"point": "volume.read", "action": "delay",
+                         "ms": 2},
+                    ]}).encode(),
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=10).close()
+                # 2 closed-loop readers (not more): the storm threads
+                # share this process's GIL, and their own scheduling
+                # noise at higher counts dwarfs the ~10us/request being
+                # measured. min over several storms estimates the
+                # interference-free p50 (min-statistics: noise is
+                # strictly additive here)
+                secs = min(3.0, max(left() / 16.0, 1.5))
+                _reader_storm(vport, fids, 2, 0, secs)  # warm
+                runs = [_reader_storm(vport, fids, 2, 0, secs)
+                        for _ in range(4)]
+                best = min(runs, key=lambda r: r["p50_ms"] or 1e9)
+                res = {"p50_ms": best["p50_ms"],
+                       "p99_ms": best["p99_ms"],
+                       "goodput_req_s": best["goodput_req_s"]}
+                if armed:
+                    # prove the plane was actually live while measured
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{vport}/debug/pprof"
+                            "?format=stats", timeout=10) as r:
+                        res["profiler"] = json.loads(r.read())
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{vport}/debug/events"
+                            "?limit=1", timeout=10) as r:
+                        res["wide_events_seen"] = json.loads(
+                            r.read())["count"]
+                return res
+            finally:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                time.sleep(0.5)
+
+    configs = {"off": {"WEED_PROFILE": "0", "WEED_WIDE_EVENTS": "0"},
+               "armed": {"WEED_PROFILE": "1", "WEED_WIDE_EVENTS": "1"}}
+    # alternate boots (off, armed, off, armed): server-process placement
+    # varies boot to boot, and a drifting host biases any
+    # all-of-A-then-all-of-B ordering; min across boots cancels it
+    out: dict = {}
+    rounds: dict = {"off": [], "armed": []}
+    for rnd in range(2):
+        if rnd == 1 and left() < 40:
+            break
+        for tag, env_extra in configs.items():
+            rounds[tag].append(measure(f"{tag}{rnd}", env_extra,
+                                       armed=(tag == "armed")))
+            _phase_checkpoint(work, "observe",
+                              {**out, "rounds": rounds})
+    for tag in configs:
+        out[tag] = min(rounds[tag], key=lambda r: r["p50_ms"] or 1e9)
+    out["boots_per_config"] = len(rounds["off"])
+    out["round_p50s"] = {tag: [r["p50_ms"] for r in rs]
+                         for tag, rs in rounds.items()}
+    p50_off = out["off"]["p50_ms"] or 1e-9
+    out["p50_regression_pct"] = round(
+        (out["armed"]["p50_ms"] - p50_off) / p50_off * 100.0, 2)
+    out["per_request_overhead_us"] = round(
+        (out["armed"]["p50_ms"] - p50_off) * 1000.0, 1)
+    out["acceptance"] = {
+        "plane_live_while_measured":
+            out["armed"].get("profiler", {}).get("samples", 0) > 0
+            and out["armed"].get("wide_events_seen", 0) > 0,
+        "p50_regression_le_3pct": out["p50_regression_pct"] <= 3.0,
+    }
+    _phase_checkpoint(work, "observe", out)
+    return out
+
+
 def phase_georepl(work: str, budget_s: float = 240.0) -> dict:
     """Cluster-to-cluster replication lag: steady-state vs under the
     overload storm.  Two combined servers (master+volume+filer) boot as
@@ -2908,6 +3053,20 @@ def main() -> None:
         detail["metadata"] = metadata
         _checkpoint(detail)
 
+        observe_res: dict = {"error": "skipped (budget)"}
+        if left() > 90:
+            try:
+                observe_res = phase_observe(
+                    work, budget_s=min(150.0, left() - 60.0))
+                _log(f"observe: p50 off {observe_res['off']['p50_ms']}ms "
+                     f"armed {observe_res['armed']['p50_ms']}ms "
+                     f"({observe_res['p50_regression_pct']}%)")
+            except Exception as e:
+                observe_res = {"error": str(e),
+                               **_load_partial(work, "observe")}
+        detail["observe"] = observe_res
+        _checkpoint(detail)
+
         try:
             lint = phase_lint(work)
             _log(f"lint: {lint.get('lint_wall_s')}s over "
@@ -3001,6 +3160,8 @@ def main() -> None:
                 "overload_goodput_ratio": overload.get("goodput_ratio"),
                 "overload_p99_ms":
                     (overload.get("overload") or {}).get("p99_ms"),
+                "observe_p50_regression_pct":
+                    observe_res.get("p50_regression_pct"),
                 "lifecycle_time_to_warm_s":
                     lifecycle.get("time_to_warm_all_s"),
                 "lifecycle_hot_p50_ratio":
@@ -3050,6 +3211,7 @@ if __name__ == "__main__":
               "degraded": lambda w: phase_degraded(w, budget_s=budget),
               "largefile": phase_largefile,
               "overload": lambda w: phase_overload(w, budget_s=budget),
+              "observe": lambda w: phase_observe(w, budget_s=budget),
               "lifecycle": lambda w: phase_lifecycle(w, budget_s=budget),
               "georepl": lambda w: phase_georepl(w, budget_s=budget),
               "metadata": lambda w: phase_metadata(w, budget_s=budget),

@@ -206,15 +206,18 @@ class TieredChunkCache:
 
     # --- read path ---
     def get(self, key: str) -> Optional[bytes]:
+        from ..observe import wideevents
         with observe.span("cache.lookup", tags={"key": key}) as sp:
             data, tier = self._get_inner(key)
             sp.tags["tier"] = tier
             if data is None:
                 self.misses += 1
                 self._count("miss", tier="-")
+                wideevents.annotate_add("cache_miss", 1)
             else:
                 self.hits += 1
                 self._count("hit", tier=tier)
+                wideevents.annotate_add("cache_hit", 1)
             return data
 
     def _get_inner(self, key: str) -> tuple[Optional[bytes], str]:

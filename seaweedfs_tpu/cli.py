@@ -939,7 +939,7 @@ def main(argv=None) -> None:
     from .utils import glog
     glog.setup(args.verbosity, args.vmodule, args.log_file)
     if args.cpuprofile:
-        from .utils.profiling import setup_cpu_profile
+        from .observe.profiler import setup_cpu_profile
         setup_cpu_profile(args.cpuprofile)
     args.fn(args)
 

@@ -39,6 +39,7 @@ import contextlib
 import os
 import queue
 import threading
+import time
 from typing import Iterator, Optional, Sequence
 
 import numpy as np
@@ -284,7 +285,7 @@ def _run_pipeline(batches: Iterator[np.ndarray], dispatch, consume,
             if batch is _SENTINEL:
                 drained = True
                 break
-            from ..utils.profiling import trace_annotation
+            from ..observe.profiler import trace_annotation
             with contextlib.ExitStack() as stack:
                 if trace_ctx is not None:
                     stack.enter_context(observe.stage(
@@ -347,6 +348,7 @@ def stream_encode(base_file_name: str, coder: ErasureCoder,
     """
     g = geometry
     assert coder.k == g.data_shards and coder.m == g.parity_shards
+    run_t0 = time.perf_counter()
     dat_size = os.path.getsize(base_file_name + ".dat")
     if _op is not None:
         op, governed = _op, False
@@ -364,7 +366,7 @@ def stream_encode(base_file_name: str, coder: ErasureCoder,
     tctx = observe.ensure_ctx("ec")
 
     def consume(data: np.ndarray, handle) -> None:
-        from ..utils.profiling import trace_annotation
+        from ..observe.profiler import trace_annotation
         with observe.stage("ec.kernel", tctx), \
                 trace_annotation("ec_pipeline_kernel_wait"):
             parity = coder.materialize(handle)
@@ -392,6 +394,13 @@ def stream_encode(base_file_name: str, coder: ErasureCoder,
     if governed:
         governor.get().finish_run(tctx.trace_id, op, dat_size,
                                   g.data_shards)
+    # chip-side runs report through the same wide-event plane as serving
+    # requests, so cluster.tail attributes encode time by stage too
+    from ..observe import wideevents
+    wideevents.emit_stages(
+        "ec", f"ec.encode {os.path.basename(base_file_name)}",
+        tctx.trace_id, int((time.perf_counter() - run_t0) * 1e6),
+        observe.stage_totals(tctx.trace_id, prefix="ec."))
 
 
 def stream_encode_many(base_file_names: Sequence[str], coder: ErasureCoder,
@@ -790,6 +799,7 @@ def stream_rebuild(base_file_name: str, coder: ErasureCoder,
     governed operating point as stream_encode.
     """
     g = geometry
+    run_t0 = time.perf_counter()
     present = [i for i in range(g.total_shards)
                if os.path.exists(base_file_name + to_ext(i))]
     missing = [i for i in range(g.total_shards) if i not in present]
@@ -813,7 +823,7 @@ def stream_rebuild(base_file_name: str, coder: ErasureCoder,
     tctx = observe.ensure_ctx("ec")
 
     def consume(survivors: np.ndarray, handle) -> None:
-        from ..utils.profiling import trace_annotation
+        from ..observe.profiler import trace_annotation
         with observe.stage("ec.kernel", tctx), \
                 trace_annotation("ec_pipeline_kernel_wait"):
             rebuilt = coder.materialize(handle)
@@ -837,4 +847,9 @@ def stream_rebuild(base_file_name: str, coder: ErasureCoder,
         governor.get().finish_run(tctx.trace_id, op,
                                   g.data_shards * shard_size,
                                   g.data_shards)
+    from ..observe import wideevents
+    wideevents.emit_stages(
+        "ec", f"ec.rebuild {os.path.basename(base_file_name)}",
+        tctx.trace_id, int((time.perf_counter() - run_t0) * 1e6),
+        observe.stage_totals(tctx.trace_id, prefix="ec."))
     return missing

@@ -232,7 +232,13 @@ def fire(point: str) -> bool:
     if f is None:
         return False
     if f.action == "delay":
-        time.sleep(f.ms / 1000.0)
+        # record the injected delay as a fault.<point> span: chaos-drill
+        # latency must show up in the wide event's stage breakdown
+        # attributed to the faulted point, not vanish into the handler
+        # remainder (observe.stage_bucket strips the fault. prefix)
+        from .. import observe
+        with observe.span(f"fault.{point}"):
+            time.sleep(f.ms / 1000.0)
         return False
     if f.action == "error":
         raise FaultError(f"injected fault at {point}")
@@ -247,7 +253,10 @@ async def fire_async(point: str) -> bool:
         return False
     if f.action == "delay":
         import asyncio
-        await asyncio.sleep(f.ms / 1000.0)
+
+        from .. import observe
+        with observe.span(f"fault.{point}"):
+            await asyncio.sleep(f.ms / 1000.0)
         return False
     if f.action == "error":
         raise FaultError(f"injected fault at {point}")

@@ -34,6 +34,9 @@ from typing import Iterable, NamedTuple, Optional
 TRACE_HEADER = "X-Seaweed-Trace"
 GRPC_TRACE_KEY = "x-seaweed-trace"
 
+from . import profiler, wideevents  # noqa: E402  (no circular import:
+# neither submodule imports this package's namespace back)
+
 
 def _ring_size() -> int:
     """A config typo must not stop every server from importing —
@@ -158,7 +161,7 @@ class Span:
 
     __slots__ = ("name", "tags", "_ctx", "_root", "trace_id", "span_id",
                  "parent_id", "_service", "_instance", "_t0", "_start_us",
-                 "_tokens")
+                 "_tokens", "dur_us")
 
     def __init__(self, name: str, tags: Optional[dict] = None,
                  ctx: Optional[TraceCtx] = None,
@@ -188,6 +191,7 @@ class Span:
 
     def __exit__(self, exc_type, exc, tb) -> None:
         dur_us = int((time.perf_counter() - self._t0) * 1e6)
+        self.dur_us = dur_us
         for var, tok in zip((_trace_id, _span_id, _service, _instance),
                             self._tokens):
             var.reset(tok)
@@ -217,6 +221,9 @@ def span(name: str, tags: Optional[dict] = None,
 
 
 def record(span_dict: dict) -> None:
+    # feed the ambient request's wide-event stage accumulator BEFORE
+    # taking the ring lock (absorb is contextvar-local, lock-free)
+    wideevents.absorb(span_dict)
     with _ring_lock:
         _ring.append(span_dict)
 
@@ -311,6 +318,14 @@ def maybe_log_slow(span_obj: Span) -> None:
                      span_obj.name, dur)
 
 
+# histogram exemplars: every metrics.observe() made under a traced
+# request stamps its bucket with the ambient trace id, so a p99 bucket
+# on /metrics?exemplars=1 links straight to its /debug/trace span
+from ..utils import metrics as _metrics  # noqa: E402
+
+_metrics.set_exemplar_source(lambda: _trace_id.get(""))
+
+
 # --- Chrome trace-event export (Perfetto / chrome://tracing) ---
 
 def to_chrome_trace(span_dicts: Iterable[dict]) -> dict:
@@ -354,25 +369,58 @@ def to_chrome_trace(span_dicts: Iterable[dict]) -> dict:
 def trace_middleware(service: str, instance: str = ""):
     """Per-request root span: extract/mint the trace id, bind context for
     the handler (so nested spans and outbound calls ride along), record,
-    and log slow requests."""
+    log slow requests, tag the serving thread for the continuous
+    profiler, and emit the request's wide event."""
     from aiohttp import web
+
+    from .. import overload as _ov
+
+    # telemetry classification uses THIS surface's system set (the same
+    # one its admission controller carries), so a user file named
+    # /heartbeat on a catch-all surface isn't mislabeled system
+    surface_paths = {"master": _ov.MASTER_SYSTEM_PATHS,
+                     "volume": _ov.VOLUME_SYSTEM_PATHS,
+                     "filer": _ov.FILER_SYSTEM_PATHS,
+                     }.get(service, _ov.GATEWAY_SYSTEM_PATHS)
 
     @web.middleware
     async def trace_mw(request: web.Request, handler):
         tid, parent = parse_header(request.headers.get(TRACE_HEADER, ""))
         ctx = TraceCtx(tid or new_id(), parent, service, instance)
         sp = Span(f"{request.method} {request.path}", ctx=ctx)
+        cls = _ov.classify(request.headers.get(_ov.PRIORITY_HEADER, ""),
+                           request.path, surface_paths)
         # bind the caller's deadline budget (X-Seaweed-Deadline) so the
         # handler's own outbound requests inherit what's LEFT of it —
         # piggybacked here because this is the one middleware every
         # server installs (utils/retry.py owns the semantics)
         from ..utils import retry as _retry
         _dl_token = _retry.bind_deadline(request.headers)
+        wide = wideevents.enabled()
         streamed = False
+        acc = None
+        status = 0
+        bytes_out = 0
+        shed = False
+        error = ""
         try:
             with sp:
-                resp = await handler(request)
+                acc_tok = wideevents.begin(sp.span_id) if wide else None
+                try:
+                    with profiler.request_tag(cls, sp.trace_id):
+                        resp = await handler(request)
+                except Exception as e:
+                    status = getattr(e, "status", 500)
+                    error = type(e).__name__
+                    raise
+                finally:
+                    if acc_tok is not None:
+                        acc = wideevents.current()
+                        wideevents.end(acc_tok)
                 sp.tags["status"] = resp.status
+                status = resp.status
+                bytes_out = resp.content_length or 0
+                shed = resp.headers.get(_ov.SHED_HEADER) == "1"
                 # a bare StreamResponse is a long-lived stream
                 # (/cluster/watch, meta subscribe, tail): its lifetime is
                 # not latency — same exemption the gRPC stream wrapper
@@ -385,6 +433,20 @@ def trace_middleware(service: str, instance: str = ""):
             _retry.reset_deadline(_dl_token)
             if not streamed:
                 maybe_log_slow(sp)
+                if wide:
+                    tenant = ""
+                    if cls != _ov.CLASS_SYSTEM:
+                        try:
+                            tenant = _ov.tenant_from_request(request)
+                        except Exception:
+                            tenant = ""
+                    wideevents.finish(
+                        acc, name=sp.name, trace=sp.trace_id,
+                        svc=service, inst=instance, cls=cls,
+                        dur_us=getattr(sp, "dur_us", 0), status=status,
+                        tenant=tenant,
+                        bytes_in=request.content_length or 0,
+                        bytes_out=bytes_out, shed=shed, error=error)
 
     return trace_mw
 

@@ -88,7 +88,8 @@ _BG_VALUES = frozenset({"bg", "background", "low"})
 # WEED_FAULTS_ADMIN=1 (see faults_admin_paths below) — exempting a
 # route that resolves to user data is an admission bypass
 OPS_PATHS = frozenset({"/healthz", "/metrics", "/debug/trace",
-                       "/debug/profile"})
+                       "/debug/profile", "/debug/pprof",
+                       "/debug/events"})
 OPS_PREFIXES: tuple = ()
 
 # master has no user namespace: the whole control plane is exempt

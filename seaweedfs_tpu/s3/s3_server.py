@@ -129,8 +129,10 @@ class S3Server:
         # over the {bucket} patterns; these names are reserved like the
         # reference's /status endpoints)
         self._trace_handler = observe.trace_handler()
-        from ..utils.profiling import profile_handler
-        self._profile_handler = profile_handler()
+        from ..observe import profiler, wideevents
+        self._profile_handler = profiler.profile_handler()
+        self._pprof_handler = profiler.pprof_handler()
+        self._events_handler = wideevents.events_handler()
         # registered via overload.reserve_ops (all other methods 405):
         # a GET-only route would let PUT /metrics fall through to the
         # {bucket} catch-all and mint a bucket the gateway can never
@@ -140,7 +142,9 @@ class S3Server:
                 ("/healthz", overload.healthz_handler(self.admission)),
                 ("/metrics", self.metrics_handler),
                 ("/debug/trace", self.trace_handler),
-                ("/debug/profile", self.profile_handler)):
+                ("/debug/profile", self.profile_handler),
+                ("/debug/pprof", self.pprof_handler),
+                ("/debug/events", self.events_handler)):
             overload.reserve_ops(app, path, handler,
                                  reserved=self._reserved)
         if faults.admin_enabled():
@@ -168,8 +172,8 @@ class S3Server:
         err = self._check_auth(request, action=auth_mod.ACTION_ADMIN)
         if err is not None:
             return err
-        return web.Response(text=(self.metrics.render()
-                          + metrics_mod.render_shared()),
+        return web.Response(text=metrics_mod.exposition(self.metrics,
+                                                        request),
                             content_type="text/plain")
 
     async def trace_handler(self, request: web.Request) -> web.Response:
@@ -184,7 +188,23 @@ class S3Server:
             return err
         return await self._profile_handler(request)
 
+    async def pprof_handler(self, request: web.Request) -> web.Response:
+        err = self._check_auth(request, action=auth_mod.ACTION_ADMIN)
+        if err is not None:
+            return err
+        return await self._pprof_handler(request)
+
+    async def events_handler(self, request: web.Request) -> web.Response:
+        # wide events carry object keys + tenant ids: Admin-only, same
+        # fence as /debug/trace
+        err = self._check_auth(request, action=auth_mod.ACTION_ADMIN)
+        if err is not None:
+            return err
+        return await self._events_handler(request)
+
     async def _on_startup(self, app) -> None:
+        from ..observe import profiler
+        profiler.ensure_started()
         await self.admission.start()
         self._session = aiohttp.ClientSession(
             # inactivity-bounded, no total cap (large object streams)

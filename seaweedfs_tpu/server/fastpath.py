@@ -27,6 +27,7 @@ import time
 from typing import Optional
 
 from .. import faults, observe, overload
+from ..observe import profiler, wideevents
 from ..security.guard import token_from_request
 from ..storage.file_id import FileId
 from ..storage.needle import (FLAG_HAS_LAST_MODIFIED, FLAG_HAS_MIME,
@@ -83,6 +84,9 @@ class FastVolumeProtocol(asyncio.Protocol):
         self._closed = False
         self._paused = False
         self._proxied = False
+        # last response written by _send, for the request's wide event
+        self._status = 0
+        self._sent = 0
 
     # --- connection lifecycle ---
     def connection_made(self, transport) -> None:
@@ -166,24 +170,57 @@ class FastVolumeProtocol(asyncio.Protocol):
                                self.TRACE_SERVICE,
                                getattr(self.server, "url", ""))
         sp = observe.Span(f"fast {method} {path}", ctx=ctx)
+        ctl = getattr(self.server, "admission", None)
+        cls = overload.classify(
+            headers.get(b"x-seaweed-priority", b"").decode("latin-1"),
+            path, ctl.system_paths, ctl.system_prefixes) \
+            if ctl is not None else overload.classify(
+                headers.get(b"x-seaweed-priority", b"").decode("latin-1"),
+                path)
         self._proxied = False
+        self._status = 0
+        self._sent = 0
+        wide = wideevents.enabled()
+        acc = None
+        error = ""
         try:
             with sp:
+                acc_tok = wideevents.begin(sp.span_id) if wide else None
                 try:
-                    await self._dispatch(method, path, query, headers,
-                                         body, raw)
+                    with profiler.request_tag(cls, sp.trace_id):
+                        await self._dispatch(method, path, query, headers,
+                                             body, raw)
+                except Exception as e:
+                    error = type(e).__name__
+                    raise
                 finally:
+                    if acc_tok is not None:
+                        acc = wideevents.current()
+                        wideevents.end(acc_tok)
                     if ptok is not None:
                         overload.reset_priority(ptok)
                     if ticket is not None:
                         ticket.release()
         finally:
             # proxied requests re-enter the aiohttp app, whose middleware
-            # applies the proper slow-log rules (streams exempt); logging
-            # here too would double-count — and charge stream lifetime
-            # (/cluster/watch, tails) as latency
+            # applies the proper slow-log rules (streams exempt) and
+            # emits the request's wide event; doing either here too would
+            # double-count — and charge stream lifetime (/cluster/watch,
+            # tails) as latency
             if not self._proxied:
                 observe.maybe_log_slow(sp)
+                if wide:
+                    tenant = ""
+                    if cls != overload.CLASS_SYSTEM and "collection" in query:
+                        tenant = _parse_query(query).get("collection", "")
+                    wideevents.finish(
+                        acc, name=sp.name, trace=sp.trace_id,
+                        svc=self.TRACE_SERVICE,
+                        inst=getattr(self.server, "url", ""), cls=cls,
+                        dur_us=getattr(sp, "dur_us", 0),
+                        status=self._status, tenant=tenant,
+                        bytes_in=len(body), bytes_out=self._sent,
+                        shed=False, error=error)
 
     async def _admission_gate(self, path: str, query: str, headers: dict):
         """Admission hook for the raw-socket listener: classify, meter,
@@ -207,6 +244,17 @@ class FastVolumeProtocol(asyncio.Protocol):
                        json.dumps({"error":
                                    f"overloaded: {e.reason}"}).encode(),
                        extra=e.raw_headers())
+            if wideevents.enabled():
+                # shed before dispatch: no accumulator ever opened, emit
+                # the minimal record so the tail sees its own backpressure
+                tid, _ = observe.parse_header(
+                    headers.get(b"x-seaweed-trace", b"").decode("latin-1"))
+                wideevents.finish(
+                    None, name=f"fast {path}",
+                    trace=tid or observe.new_id(),
+                    svc=self.TRACE_SERVICE,
+                    inst=getattr(self.server, "url", ""), cls=cls,
+                    dur_us=0, status=e.status, shed=True)
             return _SHED, None
         ptok = (overload.set_priority(overload.CLASS_BG)
                 if cls == overload.CLASS_BG else None)
@@ -338,6 +386,8 @@ class FastVolumeProtocol(asyncio.Protocol):
         head = (f"HTTP/1.1 {status} {reason}\r\n"
                 f"Content-Type: {ctype}\r\n"
                 f"Content-Length: {len(body)}\r\n{extra}\r\n")
+        self._status = status
+        self._sent = len(body)
         self.transport.write(head.encode("latin-1") + body)
 
     # --- admission (matches the aiohttp guard middleware; runs BEFORE
@@ -399,8 +449,11 @@ class FastVolumeProtocol(asyncio.Protocol):
         if vol is None:
             await self._proxy(raw)  # EC volume / redirect logic
             return
+        start_us = int(time.time() * 1e6)
+        t0 = time.perf_counter()
         try:
             n = vol.read_needle_nowait(fid.key, fid.cookie)
+            read_s = time.perf_counter() - t0
         except NeedleExpired:
             server.metrics.count("read")
             self._send(404, _E404)
@@ -435,6 +488,15 @@ class FastVolumeProtocol(asyncio.Protocol):
             self._send(500, json.dumps({"error": str(e)}).encode())
             return
         server.metrics.count("read")
+        # the inline fast shape must feed the same read-latency histogram
+        # as the aiohttp handler's timed("read") — fast GETs are the hot
+        # data plane, and skipping them leaves /metrics (and its trace
+        # exemplars) describing only the slow shapes. The observation
+        # covers the needle read itself, not the injected fault delay:
+        # faults charge their own fault.<point> span, same as aiohttp.
+        server.metrics.observe("read", read_s)
+        observe.record_span("volume.read", observe.capture(), start_us,
+                            int(read_s * 1e6), tags={"fid": str(fid)})
         # lifecycle heat: the inline fast shape must feed the same
         # tracker as the aiohttp handler or hot volumes look cold
         server.heat.record_read(fid.volume_id)

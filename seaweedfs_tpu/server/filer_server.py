@@ -241,9 +241,13 @@ class FilerServer:
             _faults_handler = faults.admin_handler()
             overload.reserve_ops(app, "/admin/faults", _faults_handler,
                                  post_handler=_faults_handler)
-        from ..utils.profiling import profile_handler
-        overload.reserve_ops(app, "/debug/profile", profile_handler())
+        from ..observe import profiler, wideevents
+        overload.reserve_ops(app, "/debug/profile",
+                             profiler.profile_handler())
         overload.reserve_ops(app, "/debug/trace", observe.trace_handler())
+        overload.reserve_ops(app, "/debug/pprof", profiler.pprof_handler())
+        overload.reserve_ops(app, "/debug/events",
+                             wideevents.events_handler())
         overload.reserve_ops(app, "/ui", self.status_ui)
         # entry-level meta API: the JSON face of the reference's filer gRPC
         # (weed/pb/filer.proto LookupDirectoryEntry/ListEntries/CreateEntry/
@@ -911,6 +915,8 @@ class FilerServer:
             await asyncio.sleep(1.0)
 
     async def _on_startup(self, app) -> None:
+        from ..observe import profiler
+        profiler.ensure_started()
         self._loop = asyncio.get_event_loop()
         # outbound chunk reads/writes and master calls carry the ambient
         # trace header so one filer request merges with its volume spans
@@ -1670,8 +1676,8 @@ class FilerServer:
         return web.json_response({"ok": True}, status=202)
 
     async def metrics_handler(self, request: web.Request) -> web.Response:
-        return web.Response(text=(self.metrics.render()
-                          + metrics_mod.render_shared()),
+        return web.Response(text=metrics_mod.exposition(self.metrics,
+                                                        request),
                             content_type="text/plain")
 
     async def status_ui(self, request: web.Request) -> web.Response:

@@ -53,7 +53,8 @@ MAX_ASSIGN_COUNT = 10000
 # leader instead of buffering the stream through the proxy)
 _LOCAL_PATHS = ("/healthz", "/metrics", "/cluster/status", "/cluster/watch",
                 "/cluster/raft/vote", "/cluster/raft/append",
-                "/ui", "/debug/profile", "/debug/trace",
+                "/ui", "/debug/profile", "/debug/trace", "/debug/pprof",
+                "/debug/events",
                 # fault injection is per-PROCESS state: proxying it to the
                 # leader would arm the fault on the wrong node
                 "/admin/faults")
@@ -368,15 +369,20 @@ class MasterServer:
         app.router.add_get("/metrics", self.metrics_handler)
         app.router.add_get("/healthz",
                            overload.healthz_handler(self.admission))
-        from ..utils.profiling import profile_handler
-        app.router.add_get("/debug/profile", profile_handler())
+        from ..observe import profiler, wideevents
+        app.router.add_get("/debug/profile", profiler.profile_handler())
         app.router.add_get("/debug/trace", observe.trace_handler())
+        overload.reserve_ops(app, "/debug/pprof", profiler.pprof_handler())
+        overload.reserve_ops(app, "/debug/events",
+                             wideevents.events_handler())
         app.router.add_get("/ui", self.status_ui)
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         return app
 
     async def _on_startup(self, app) -> None:
+        from ..observe import profiler
+        profiler.ensure_started()
         await self.admission.start()
         await self.raft.start()
         if self.vacuum_interval_seconds > 0:
@@ -1616,8 +1622,8 @@ class MasterServer:
         # refresh the cluster-heat gauges at scrape time so the heat
         # view is exported even when the lifecycle daemon is disabled
         self.lifecycle.export_gauges()
-        return web.Response(text=(self.metrics.render()
-                          + metrics_mod.render_shared()),
+        return web.Response(text=metrics_mod.exposition(self.metrics,
+                                                        request),
                             content_type="text/plain")
 
     async def status_ui(self, request: web.Request) -> web.Response:

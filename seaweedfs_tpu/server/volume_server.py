@@ -328,9 +328,12 @@ class VolumeServer:
         app.router.add_get("/metrics", self.metrics_handler)
         app.router.add_get("/healthz",
                            overload.healthz_handler(self.admission))
-        from ..utils.profiling import profile_handler
-        app.router.add_get("/debug/profile", profile_handler())
+        from ..observe import profiler, wideevents
+        app.router.add_get("/debug/profile", profiler.profile_handler())
         app.router.add_get("/debug/trace", observe.trace_handler())
+        overload.reserve_ops(app, "/debug/pprof", profiler.pprof_handler())
+        overload.reserve_ops(app, "/debug/events",
+                             wideevents.events_handler())
         app.router.add_get("/ui", self.status_ui)
         app.router.add_route("*", "/{fid:[^{}]*}", self.data_handler)
         app.on_startup.append(self._on_startup)
@@ -338,6 +341,8 @@ class VolumeServer:
         return app
 
     async def _on_startup(self, app) -> None:
+        from ..observe import profiler
+        profiler.ensure_started()
         self._session = aiohttp.ClientSession(
             # connect/inactivity bounds with no total cap: replicate
             # fan-out and heartbeats must never hang on a dead peer,
@@ -1783,8 +1788,8 @@ class VolumeServer:
     async def metrics_handler(self, request: web.Request) -> web.Response:
         # shared registries carry non-server subsystems hosted in this
         # process (the EC feed governor's operating point + stage model)
-        return web.Response(text=(self.metrics.render()
-                                  + metrics_mod.render_shared()),
+        return web.Response(text=metrics_mod.exposition(self.metrics,
+                                                        request),
                             content_type="text/plain")
 
     async def status_ui(self, request: web.Request) -> web.Response:

@@ -175,12 +175,14 @@ class WebDavServer:
         # of the webdav protocol surface, these carry no auth — deploy
         # this gateway on trusted networks only.
         from .. import faults
-        from ..utils.profiling import profile_handler
+        from ..observe import profiler, wideevents
         for path, handler in (
                 ("/healthz", overload.healthz_handler(self.admission)),
                 ("/metrics", self.metrics_handler),
                 ("/debug/trace", observe.trace_handler()),
-                ("/debug/profile", profile_handler())):
+                ("/debug/profile", profiler.profile_handler()),
+                ("/debug/pprof", profiler.pprof_handler()),
+                ("/debug/events", wideevents.events_handler())):
             overload.reserve_ops(app, path, handler)
         if faults.admin_enabled():
             # opt-in only (WEED_FAULTS_ADMIN=1): the webdav surface
@@ -194,11 +196,13 @@ class WebDavServer:
         return app
 
     async def metrics_handler(self, request: web.Request) -> web.Response:
-        return web.Response(text=(self.metrics.render()
-                          + metrics_mod.render_shared()),
+        return web.Response(text=metrics_mod.exposition(self.metrics,
+                                                        request),
                             content_type="text/plain")
 
     async def _on_startup(self, app) -> None:
+        from ..observe import profiler
+        profiler.ensure_started()
         await self.admission.start()
         self._session = aiohttp.ClientSession(
             # inactivity-bounded, no total cap (large file streams)

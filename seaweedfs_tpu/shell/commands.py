@@ -140,6 +140,7 @@ def _register_all() -> None:
     from . import geo_commands  # noqa: F401
     from . import lock_commands  # noqa: F401
     from . import ring_commands  # noqa: F401
+    from . import telemetry_commands  # noqa: F401
     from . import trace_commands  # noqa: F401
     from . import volume_commands  # noqa: F401
     from . import ec_shell  # noqa: F401

@@ -18,6 +18,18 @@ from collections import defaultdict
 
 _BUCKETS = [0.0001, 0.001, 0.01, 0.1, 1.0, 10.0]
 
+# Pluggable exemplar source: a zero-arg callable returning the ambient
+# request's trace id ("" when none).  observe/ installs one at import
+# time; keeping it injected (rather than importing observe here) keeps
+# utils/ free of an upward dependency.  Exemplars let a p99 histogram
+# bucket link straight to a concrete trace in /debug/trace.
+_exemplar_source = None
+
+
+def set_exemplar_source(fn) -> None:
+    global _exemplar_source
+    _exemplar_source = fn
+
 
 def _escape(value) -> str:
     """Prometheus exposition label-value escaping: backslash, quote,
@@ -63,6 +75,9 @@ class Registry:
         self._hist: dict[str, list[int]] = {}
         self._hist_sum: dict[str, float] = defaultdict(float)
         self._hist_count: dict[str, int] = defaultdict(int)
+        # key -> per-bucket [(trace_id, seconds) | None]: the most recent
+        # traced observation that landed in each bucket
+        self._hist_ex: dict[str, list] = {}
 
     def count(self, name: str, value: float = 1.0,
               labels: dict | None = None) -> None:
@@ -77,16 +92,30 @@ class Registry:
     def observe(self, name: str, seconds: float,
                 labels: dict | None = None) -> None:
         key = _key(name, labels)
+        # read the trace id OUTSIDE the lock (contextvar, cheap, and a
+        # misbehaving source callable must not run under our lock)
+        trace = ""
+        if _exemplar_source is not None:
+            try:
+                trace = _exemplar_source() or ""
+            except Exception:
+                trace = ""
         with self._lock:
             buckets = self._hist.setdefault(key, [0] * (len(_BUCKETS) + 1))
             for i, b in enumerate(_BUCKETS):
                 if seconds <= b:
                     buckets[i] += 1
+                    idx = i
                     break
             else:
                 buckets[-1] += 1
+                idx = len(_BUCKETS)
             self._hist_sum[key] += seconds
             self._hist_count[key] += 1
+            if trace:
+                ex = self._hist_ex.setdefault(
+                    key, [None] * (len(_BUCKETS) + 1))
+                ex[idx] = (trace, seconds)
 
     async def push_loop(self, gateway_url: str, job: str,
                         interval_seconds: float = 15.0) -> None:
@@ -145,6 +174,17 @@ class Registry:
                     out[key] = v
             return out
 
+    def exemplars(self, name: str,
+                  labels: dict | None = None) -> list:
+        """Per-bucket [(trace_id, seconds) | None] for one histogram —
+        bucket i covers observations <= _BUCKETS[i], the last entry is
+        the +Inf overflow.  Empty list when the histogram has never seen
+        a traced observation."""
+        key = _key(name, labels)
+        with self._lock:
+            ex = self._hist_ex.get(key)
+            return list(ex) if ex else []
+
     @staticmethod
     def _split(key: str) -> tuple[str, str]:
         """'read{a="b"}' -> ('read', '{a="b"}')."""
@@ -163,7 +203,11 @@ class Registry:
             fams.setdefault(cls._split(key)[0], []).append(key)
         return dict(sorted(fams.items()))
 
-    def render(self) -> str:
+    def render(self, exemplars: bool = False) -> str:
+        """Prometheus exposition text.  ``exemplars=True`` appends the
+        OpenMetrics ``# {trace_id="..."} value`` exemplar suffix to each
+        histogram bucket that has one (served at /metrics?exemplars=1 —
+        off by default because plain-Prometheus scrapers reject it)."""
         with self._lock:
             lines = []
             p = f"seaweedfs_tpu_{self.subsystem}"
@@ -189,14 +233,24 @@ class Registry:
                     # merge the key's labels with the per-bucket le label
                     inner = lbl[1:-1] + "," if lbl else ""
                     buckets = self._hist[key]
+                    ex = (self._hist_ex.get(key)
+                          if exemplars else None) or []
                     acc = 0
                     for i, b in enumerate(_BUCKETS):
                         acc += buckets[i]
-                        lines.append(f"{p}_{name}_seconds_bucket"
-                                     f'{{{inner}le="{b}"}} {acc}')
+                        line = (f"{p}_{name}_seconds_bucket"
+                                f'{{{inner}le="{b}"}} {acc}')
+                        if i < len(ex) and ex[i]:
+                            line += (f' # {{trace_id="{ex[i][0]}"}}'
+                                     f" {ex[i][1]}")
+                        lines.append(line)
                     acc += buckets[-1]
-                    lines.append(f"{p}_{name}_seconds_bucket"
-                                 f'{{{inner}le="+Inf"}} {acc}')
+                    line = (f"{p}_{name}_seconds_bucket"
+                            f'{{{inner}le="+Inf"}} {acc}')
+                    if len(ex) > len(_BUCKETS) and ex[-1]:
+                        line += (f' # {{trace_id="{ex[-1][0]}"}}'
+                                 f" {ex[-1][1]}")
+                    lines.append(line)
                     lines.append(f"{p}_{name}_seconds_sum{lbl} "
                                  f"{self._hist_sum[key]}")
                     lines.append(f"{p}_{name}_seconds_count{lbl} "
@@ -229,8 +283,17 @@ def shared(subsystem: str) -> "Registry":
         return reg
 
 
-def render_shared() -> str:
+def exposition(registry: "Registry", request) -> str:
+    """The full /metrics body for one server: its own registry plus the
+    shared subsystem registries, with OpenMetrics exemplars when the
+    scrape asks for them (?exemplars=1)."""
+    ex = request.query.get("exemplars", "") in ("1", "true")
+    return registry.render(exemplars=ex) + render_shared(exemplars=ex)
+
+
+def render_shared(exemplars: bool = False) -> str:
     """Exposition text of every non-empty shared registry, stable order."""
     with _shared_lock:
         regs = [_shared[name] for name in sorted(_shared)]
-    return "".join(r.render() for r in regs if not r.is_empty())
+    return "".join(r.render(exemplars=exemplars)
+                   for r in regs if not r.is_empty())

@@ -292,6 +292,7 @@ class RetryPolicy:
         return max(0.0, d)
 
     def delays(self):
+        from ..observe import wideevents
         for attempt in range(self.max_attempts - 1):
             d = self.backoff(attempt)
             left = remaining_budget()
@@ -299,6 +300,9 @@ class RetryPolicy:
                 if left <= d:
                     return  # budget can't cover the sleep, let alone a try
                 d = min(d, left)
+            # each yielded delay is one retry the caller is about to make:
+            # count it on the ambient request's wide event (no-op outside)
+            wideevents.annotate_add("retries", 1)
             yield d
 
     def call(self, fn, *args, retry_on=(ConnectionError, OSError),
@@ -333,6 +337,8 @@ class RetryPolicy:
                 d = min(d, left)
             if on_retry is not None:
                 on_retry(attempt, last)
+            from ..observe import wideevents
+            wideevents.annotate_add("retries", 1)
             time.sleep(d)
 
 

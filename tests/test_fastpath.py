@@ -424,3 +424,114 @@ def test_mark_internal_strips_spoofed_swfs_headers():
     head = marked.split(b"\r\n\r\n", 1)[0]
     assert head.count(b"X-Swfs-Tunnel:") == 1
     assert b"X-Swfs-Tunnel: 1" in head
+
+
+def test_fastpath_emits_wide_events(srv):
+    """The raw-socket listener bypasses aiohttp middleware, so it emits
+    its own wide events: one canonical record per fast-served request,
+    carrying the propagated trace id, priority class, and byte counts —
+    and no duplicate record for the proxied surface (the aiohttp
+    middleware owns those)."""
+    import time
+
+    from seaweedfs_tpu.observe import wideevents
+
+    def _wait_events(trace, n=1, deadline_s=5.0):
+        # the record lands in the listener's finally block AFTER the
+        # response bytes hit the wire — poll rather than race it
+        deadline = time.time() + deadline_s
+        while time.time() < deadline:
+            evs = wideevents.events(trace=trace)
+            if len(evs) >= n:
+                return evs
+            time.sleep(0.02)
+        return wideevents.events(trace=trace)
+
+    wideevents.reset()
+    payload = b"wide event payload" * 30
+    body, ct = _multipart(payload)
+    status, _, _ = _req(srv.port, "POST", f"/{FID}", body,
+                        {"Content-Type": ct})
+    assert status == 201
+
+    tid = "feedfacefastwide"
+    status, _, got = _req(srv.port, "GET", f"/{FID}",
+                          headers={"X-Seaweed-Trace": f"{tid}:",
+                                   "X-Seaweed-Priority": "bg"})
+    assert status == 200 and got == payload
+
+    evs = _wait_events(tid)
+    assert len(evs) == 1, evs
+    ev = evs[0]
+    assert ev["svc"] == "volume"
+    assert ev["name"].startswith("fast GET /")
+    assert ev["cls"] == "bg"
+    assert ev["status"] == 200
+    assert ev["bytes_out"] == len(payload)
+    assert ev["shed"] is False
+    assert ev["dur_us"] > 0
+
+    # the proxied surface (/status goes through the loopback tunnel to
+    # aiohttp) produces exactly ONE event — the middleware's, not a
+    # second one from the fastpath listener
+    tid2 = "feedfaceproxied0"
+    status, _, _ = _req(srv.port, "GET", "/status",
+                        headers={"X-Seaweed-Trace": f"{tid2}:"})
+    assert status == 200
+    evs = _wait_events(tid2)
+    time.sleep(0.2)  # give a would-be duplicate emitter time to land
+    evs = wideevents.events(trace=tid2)
+    assert len(evs) == 1, evs
+    assert not evs[0]["name"].startswith("fast ")
+    wideevents.reset()
+
+
+def test_fastpath_shed_emits_wide_event(tmp_path, monkeypatch):
+    """A request refused at the fastpath admission gate still leaves a
+    wide event (shed=True, 503) — sheds are exactly the traffic a tail
+    investigation must be able to see."""
+    import time
+
+    from seaweedfs_tpu import faults
+    from seaweedfs_tpu.observe import wideevents
+
+    monkeypatch.setenv("WEED_ADMISSION_FG_CONCURRENCY", "1")
+    monkeypatch.setenv("WEED_ADMISSION_FG_QUEUE", "0")
+    monkeypatch.setenv("WEED_ADMISSION_LAG_SAMPLE_MS", "100")
+    srv = _Srv(str(tmp_path))
+    try:
+        payload = b"shed and observe" * 8
+        body, ct = _multipart(payload)
+        status, _, _ = _req(srv.port, "POST", f"/{FID}", body,
+                            {"Content-Type": ct})
+        assert status == 201
+
+        wideevents.reset()
+        faults.set_fault("volume.read", "delay", ms=600)
+        t = threading.Thread(target=_req,
+                             args=(srv.port, "GET", f"/{FID}"))
+        t.start()
+        time.sleep(0.2)  # the slow read owns the single fg slot
+        tid = "feedfaceshedwide"
+        status, hdrs, _ = _req(srv.port, "GET", f"/{FID}",
+                               headers={"X-Seaweed-Trace": f"{tid}:"})
+        assert status == 503 and hdrs.get("x-seaweed-shed") == "1"
+        t.join(10)
+        faults.clear()
+
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            evs = wideevents.events(trace=tid)
+            if evs:
+                break
+            time.sleep(0.02)
+        assert len(evs) == 1, evs
+        assert evs[0]["shed"] is True
+        assert evs[0]["status"] == 503
+        # the shed tail is queryable the way cluster.tail reads it
+        assert any(e["trace"] == tid
+                   for e in wideevents.events(shed=True))
+        wideevents.reset()
+    finally:
+        faults.clear()
+        srv.stop()

