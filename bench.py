@@ -968,6 +968,117 @@ def bench_system(work: str, n: int = 6000, size: int = 1024,
     return out
 
 
+def phase_saturation(work: str, budget_s: float = 240.0,
+                     n: int = 2500, size: int = 1024,
+                     concurrency: int = 16) -> dict:
+    """Share-nothing shard-fleet saturation: boots a master plus a
+    WEED_SERVE_SHARDS=N volume server (the SO_REUSEPORT fleet forked
+    by the CLI) and runs the same 1KB write/read benchmark once at
+    shards=1 (the single-process path) and once at shards=min(4,
+    host cores, 2 minimum). Acceptance on multi-core hosts is
+    saturation throughput >= 2.5x the single-shard run; on a 1-core
+    host the fleet only adds context switching, so host_cores is
+    recorded and the slope stands as measured-ceiling evidence
+    (same verdict idiom as bench_system's worker-scaling row)."""
+    import urllib.request
+
+    from seaweedfs_tpu.utils.bench_client import run_benchmark
+
+    import seaweedfs_tpu
+    pkg_root = os.path.dirname(os.path.dirname(seaweedfs_tpu.__file__))
+    cores = os.cpu_count() or 1
+    fleet = max(2, min(4, cores))
+    deadline = time.time() + budget_s
+
+    def _one(shards: int, tag: str) -> dict:
+        mport, vport = 19666, 18666
+        base = os.path.join(work, f"sat_{tag}")
+        mdir, vdir = os.path.join(base, "m"), os.path.join(base, "v")
+        os.makedirs(mdir, exist_ok=True)
+        os.makedirs(vdir, exist_ok=True)
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   SEAWEEDFS_FORCE_CPU="1",
+                   WEED_SERVE_SHARDS=str(shards))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        procs = [subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu.cli", "master",
+             "-port", str(mport), "-mdir", mdir, "-grpc_port", "0",
+             "-pulse", "1"], env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)]
+        try:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "seaweedfs_tpu.cli", "volume",
+                 "-port", str(vport), "-dir", vdir,
+                 "-mserver", f"127.0.0.1:{mport}", "-grpc_port", "0",
+                 "-pulse", "1"], env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+            boot_deadline = time.time() + 60
+            while True:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{mport}/dir/assign",
+                            timeout=2) as r:
+                        if "fid" in json.loads(r.read()):
+                            break
+                except Exception:
+                    pass
+                if time.time() > boot_deadline:
+                    raise RuntimeError(
+                        f"shards={shards} fleet failed to start")
+                time.sleep(0.3)
+            time.sleep(1.0)  # first stripe tick publishes shard routes
+            # warm pass (discarded): volume growth + route discovery
+            run_benchmark(f"127.0.0.1:{mport}", n=min(300, n),
+                          size=size, concurrency=concurrency)
+            out = run_benchmark(f"127.0.0.1:{mport}", n=n, size=size,
+                                concurrency=concurrency)
+            out["shards"] = shards
+            return out
+        finally:
+            for p in reversed(procs):
+                p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            time.sleep(0.5)  # let the reuseport group free the port
+
+    single = _one(1, "s1")
+    out: dict = {
+        "host_cores": cores,
+        "shards": fleet,
+        "single": {"write_req_s": single["write"]["req_s"],
+                   "read_req_s": single["read"]["req_s"]},
+    }
+    if time.time() > deadline:
+        out["fleet"] = {"error": "skipped (budget)"}
+        return out
+    try:
+        multi = _one(fleet, f"s{fleet}")
+        out["fleet"] = {"write_req_s": multi["write"]["req_s"],
+                        "read_req_s": multi["read"]["req_s"]}
+        w_x = round(multi["write"]["req_s"]
+                    / max(single["write"]["req_s"], 1), 3)
+        r_x = round(multi["read"]["req_s"]
+                    / max(single["read"]["req_s"], 1), 3)
+        out["speedup"] = {"write": w_x, "read": r_x}
+        out["accept"] = {
+            "target": "fleet >= 2.5x single (multi-core hosts only)",
+            "applies": cores >= fleet,
+            "write_2_5x": w_x >= 2.5,
+            "read_2_5x": r_x >= 2.5,
+            "note": (None if cores >= fleet else
+                     f"host has {cores} core(s): {fleet} shards time-"
+                     "slice one core, so the slope measures context-"
+                     "switch overhead, not per-core scaling"),
+        }
+    except Exception as e:  # noqa: BLE001 - recorded, not fatal
+        out["fleet"] = {"error": str(e)}
+    return out
+
+
 def phase_largefile(work: str, size_mb: int = 64) -> dict:
     """Write-tier number beyond req/s: single-stream large-file filer
     PUT and GET MB/s through the pipelined chunk-upload window + fid
@@ -2954,6 +3065,19 @@ def main() -> None:
         except Exception as e:
             system = {"error": str(e)}
         detail["system_req_s"] = system
+        _checkpoint(detail)
+
+        saturation: dict = {"error": "skipped (budget)"}
+        if left() > 150:
+            try:
+                saturation = phase_saturation(
+                    work, budget_s=min(240.0, left() - 90.0))
+                _log(f"saturation: {saturation.get('host_cores')} cores,"
+                     f" shards={saturation.get('shards')}, speedup "
+                     f"{saturation.get('speedup')}")
+            except Exception as e:
+                saturation = {"error": str(e)}
+        detail["saturation"] = saturation
         _checkpoint(detail)
 
         largefile: dict = {"error": "skipped (budget)"}

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Shard-fleet saturation smoke: master + WEED_SERVE_SHARDS=2 volume
+# server (SO_REUSEPORT fork fleet), ~5s of concurrent PUT/GET traffic.
+# Fails on any 5xx/transport error, any non-byte-identical read-back,
+# or /healthz reporting fewer live shards than configured.
+#
+#   scripts/saturation.sh                          # 2 shards, 5s
+#   WEED_SERVE_SHARDS=4 SAT_SECONDS=10 scripts/saturation.sh
+#   WEED_VOLUME_GROUP_COMMIT_US=500 scripts/saturation.sh   # + group commit
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python scripts/saturation.py "$@"

@@ -15,3 +15,4 @@ from . import propagation        # noqa: F401  ctx-propagation
 from . import registries         # noqa: F401  fault-point-registry, metric-label-registry
 from . import interproc          # noqa: F401  blocking-call-transitive, lock-held-await-transitive, deadline-propagation, resource-leak-interproc
 from . import durability         # noqa: F401  atomic-replace
+from . import fork_asyncio       # noqa: F401  fork-then-asyncio

@@ -1,0 +1,88 @@
+"""Fork/event-loop ordering: ``os.fork()`` must happen before any
+event loop exists.  A loop's epoll fd created pre-fork is inherited by
+every child — the shards then steal each other's readiness events and
+the fleet livelocks in ways that only reproduce under load.  The shard
+runner (server/sharded.py) forks from the CLI for exactly this reason;
+this rule pins the ordering tree-wide."""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import resolve_call_path, walk_body
+from ..engine import Rule, register
+
+#: calls that create (or imply) an event loop in this process
+_LOOP_MAKERS = {
+    ("asyncio", "new_event_loop"),
+    ("asyncio", "get_event_loop"),
+    ("asyncio", "get_running_loop"),
+    ("asyncio", "run"),
+}
+
+_FORK = ("os", "fork")
+
+
+@register
+class ForkThenAsyncio(Rule):
+    name = "fork-then-asyncio"
+    rationale = ("os.fork() after an event loop exists shares the "
+                 "loop's epoll fd with every child — shards steal each "
+                 "other's readiness events; fork first, then build the "
+                 "loop per process (server/sharded.py ordering)")
+    scope = ("seaweedfs_tpu/",)
+    fixture = (
+        "import asyncio\n"
+        "import os\n"
+        "def bad():\n"
+        "    loop = asyncio.new_event_loop()\n"
+        "    pid = os.fork()\n"
+        "async def worse():\n"
+        "    os.fork()\n"
+    )
+    clean_fixture = (
+        "import asyncio\n"
+        "import os\n"
+        "def good():\n"
+        "    pid = os.fork()\n"
+        "    if pid == 0:\n"
+        "        loop = asyncio.new_event_loop()\n"
+        "def fork_only():\n"
+        "    return os.fork()\n"
+        "def loop_only():\n"
+        "    return asyncio.new_event_loop()\n"
+    )
+
+    def check_module(self, mod):
+        aliases = mod.aliases()
+        for node in mod.walk():
+            if isinstance(node, ast.AsyncFunctionDef):
+                # a coroutine runs ON a loop by definition: any fork
+                # inside one inherits that loop's fds
+                for n in walk_body(node):
+                    if isinstance(n, ast.Call) and \
+                            tuple(resolve_call_path(n, aliases)) == _FORK:
+                        yield self.diag(
+                            mod, n.lineno,
+                            f"async def {node.name} calls os.fork() — "
+                            f"the child inherits this loop's epoll fd; "
+                            f"fork before any loop exists")
+            elif isinstance(node, ast.FunctionDef):
+                # lexical ordering within one sync function: a loop-
+                # creating call before os.fork() (ast.walk is not
+                # source-ordered, so sort by line first)
+                calls = sorted(
+                    (n for n in walk_body(node) if isinstance(n, ast.Call)),
+                    key=lambda n: (n.lineno, n.col_offset))
+                loop_line = None
+                for n in calls:
+                    path = tuple(resolve_call_path(n, aliases))
+                    if path in _LOOP_MAKERS and loop_line is None:
+                        loop_line = n.lineno
+                    elif path == _FORK and loop_line is not None:
+                        yield self.diag(
+                            mod, n.lineno,
+                            f"def {node.name} calls os.fork() after "
+                            f"creating an event loop (line {loop_line})"
+                            f" — the child shares its epoll fd; fork "
+                            f"first, loop per process")

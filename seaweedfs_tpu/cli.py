@@ -29,6 +29,22 @@ def _run_forever(coro) -> None:
         pass
 
 
+def _maybe_sharded(boot_fn) -> None:
+    """Run ``boot_fn(shard_ctx) -> coroutine`` across the WEED_SERVE_SHARDS
+    fleet.  The fork MUST happen here, before _run_forever news an event
+    loop — a pre-fork epoll fd would be shared by every child (weedlint's
+    fork-then-asyncio rule pins the ordering).  One shard (the default)
+    skips all of it: boot_fn(None) on today's proven path."""
+    from .server import sharded
+    n = sharded.shards_from_env()
+    if n <= 1:
+        _run_forever(boot_fn(None))
+        return
+    import secrets
+    ctx = sharded.ShardContext.create(n, secrets.token_hex(16))
+    sharded.run_sharded(ctx, lambda c: _run_forever(boot_fn(c)))
+
+
 def _load_guard():
     """Build a security Guard from security.toml (weed/command/scaffold.go
     security section; keys jwt.signing.key etc.)."""
@@ -85,25 +101,42 @@ def cmd_master(args) -> None:
 
 
 def cmd_volume(args) -> None:
-    from .ec.geometry import Geometry
-    from .server.volume_server import run_volume_server
-    from .storage.store import Store
-    geometry = Geometry(
-        large_block_size=args.ec_large_block,
-        small_block_size=args.ec_small_block)
-    store = Store(args.dir.split(","),
-                  max_volume_counts=[args.max] * len(args.dir.split(",")),
-                  coder_name=args.coder, geometry=geometry,
-                  needle_map_kind=args.index,
-                  min_free_space_percent=args.min_free_space_percent,
-                  preallocate=args.preallocate * 1024 * 1024)
-    _run_forever(run_volume_server(
-        args.ip, args.port, store, args.mserver,
-        data_center=args.data_center, rack=args.rack,
-        pulse_seconds=args.pulse, guard=_load_guard(), tls=_load_tls(),
-        use_grpc_heartbeat=args.grpc_heartbeat,
-        grpc_port=(args.port + 10000 if args.grpc_port < 0
-                   else args.grpc_port)))
+    def boot(shard_ctx):
+        from .ec.geometry import Geometry
+        from .server.volume_server import run_volume_server
+        from .storage.store import Store
+        dirs = args.dir.split(",")
+        if shard_ctx is not None and shard_ctx.index > 0:
+            # share-nothing: every shard owns private volume dirs;
+            # shard 0 keeps the base dirs so pre-sharding (legacy)
+            # volumes stay served where they already live
+            dirs = [os.path.join(d, f"shard{shard_ctx.index}")
+                    for d in dirs]
+            for d in dirs:
+                os.makedirs(d, exist_ok=True)
+        geometry = Geometry(
+            large_block_size=args.ec_large_block,
+            small_block_size=args.ec_small_block)
+        store = Store(dirs,
+                      max_volume_counts=[args.max] * len(dirs),
+                      coder_name=args.coder, geometry=geometry,
+                      needle_map_kind=args.index,
+                      min_free_space_percent=args.min_free_space_percent,
+                      preallocate=args.preallocate * 1024 * 1024)
+        shard0 = shard_ctx is None or shard_ctx.index == 0
+        return run_volume_server(
+            args.ip, args.port, store, args.mserver,
+            data_center=args.data_center, rack=args.rack,
+            pulse_seconds=args.pulse, guard=_load_guard(), tls=_load_tls(),
+            # the gRPC surfaces bind fixed ports: shard 0 owns them,
+            # siblings serve HTTP/fastpath only
+            use_grpc_heartbeat=args.grpc_heartbeat and shard0,
+            grpc_port=((args.port + 10000 if args.grpc_port < 0
+                        else args.grpc_port) if shard0 else 0),
+            internal_token=(shard_ctx.token if shard_ctx else None),
+            shard_ctx=shard_ctx)
+
+    _maybe_sharded(boot)
 
 
 def cmd_server(args) -> None:
@@ -191,19 +224,25 @@ def cmd_filer(args) -> None:
         ring_config = RingConfig(
             peers=[p for p in args.ring_peers.split(",") if p],
             vnodes=base.vnodes, replicas=base.replicas)
-    _run_forever(run_filer(
-        args.ip, args.port, args.mserver, store_name=args.store,
-        store_kwargs=store_kwargs, chunk_size=args.chunk_size_mb * 1024 * 1024,
-        default_replication=args.default_replication,
-        default_collection=args.collection,
-        meta_log_path=args.meta_log,
-        peers=[p for p in args.peers.split(",") if p],
-        notifier=notifier, guard=_load_guard(), tls=_load_tls(),
-        cipher=args.encrypt_volume_data,
-        url=f"{args.ip}:{args.port}",
-        ring_config=ring_config,
-        grpc_port=(args.port + 10000 if args.grpc_port < 0
-                   else args.grpc_port)))
+    def boot(shard_ctx):
+        shard0 = shard_ctx is None or shard_ctx.index == 0
+        return run_filer(
+            args.ip, args.port, args.mserver, store_name=args.store,
+            store_kwargs=store_kwargs,
+            chunk_size=args.chunk_size_mb * 1024 * 1024,
+            default_replication=args.default_replication,
+            default_collection=args.collection,
+            meta_log_path=args.meta_log,
+            peers=[p for p in args.peers.split(",") if p],
+            notifier=notifier, guard=_load_guard(), tls=_load_tls(),
+            cipher=args.encrypt_volume_data,
+            url=f"{args.ip}:{args.port}",
+            ring_config=ring_config,
+            grpc_port=((args.port + 10000 if args.grpc_port < 0
+                        else args.grpc_port) if shard0 else 0),
+            shard_ctx=shard_ctx)
+
+    _maybe_sharded(boot)
 
 
 def cmd_filer_copy(args) -> None:
@@ -380,10 +419,11 @@ def cmd_s3(args) -> None:
     if args.config:
         from .s3.auth import Iam
         iam = Iam.from_file(args.config)
-    _run_forever(run_s3(args.ip, args.port, args.filer,
-                        access_key=args.access_key,
-                        secret_key=args.secret_key,
-                        iam=iam))
+    _maybe_sharded(lambda shard_ctx: run_s3(
+        args.ip, args.port, args.filer,
+        access_key=args.access_key,
+        secret_key=args.secret_key,
+        iam=iam, shard_ctx=shard_ctx))
 
 
 def cmd_upload(args) -> None:

@@ -24,10 +24,13 @@ trusting buffered ``tell()`` semantics. Payloads are copied — recorded
 workloads are MBs, not the 30GB production volumes.
 
 Recording is process-global state (the patches live in ``builtins`` and
-``os``); one recorder may be active at a time. Workloads that write
-through unpatchable syscalls (``os.writev`` fan-out threads,
-``sendfile``) are out of scope — the sweep drives the ``open``/pwrite
-paths, which is where every durability contract in this tree lives.
+``os``); one recorder may be active at a time. ``os.pwritev`` (the
+group-commit gathered write) is recorded as one ``write`` op per buffer
+at its computed offset — the crash sweep can therefore land BETWEEN
+records of a single group, which is exactly the torn-group window the
+``volume_group_commit`` workload exists to prove safe. ``sendfile``
+remains out of scope: it is a read-side syscall and carries no
+durability contract.
 """
 
 from __future__ import annotations
@@ -224,6 +227,7 @@ class DiskRecorder:
             "rename": os.rename, "remove": os.remove,
             "unlink": os.unlink, "fsync": os.fsync,
             "fdatasync": os.fdatasync, "pwrite": os.pwrite,
+            "pwritev": os.pwritev,
             "ftruncate": os.ftruncate, "truncate": os.truncate,
         }
 
@@ -290,6 +294,22 @@ class DiskRecorder:
                            data=_as_bytes(data))
             return out
 
+        def p_pwritev(fd, buffers, offset, *a, **kw):
+            # materialize first: the real pwritev consumes nothing, but
+            # the recorded ops must carry stable payload copies
+            bufs = [_as_bytes(b) for b in buffers]
+            out = o["pwritev"](fd, bufs, offset, *a, **kw)
+            rel = rec._fds.get(fd)
+            if rel is not None:
+                # one op per buffer so the crash sweep can tear the
+                # group between records (the whole point of proving the
+                # group-commit barrier)
+                off = offset
+                for b in bufs:
+                    rec.record("write", rel, offset=off, data=b)
+                    off += len(b)
+            return out
+
         def p_ftruncate(fd, length):
             out = o["ftruncate"](fd, length)
             rel = rec._fds.get(fd)
@@ -316,6 +336,7 @@ class DiskRecorder:
         os.fsync = p_fsync
         os.fdatasync = p_fsync
         os.pwrite = p_pwrite
+        os.pwritev = p_pwritev
         os.ftruncate = p_ftruncate
         os.truncate = p_truncate
         return self
@@ -332,6 +353,7 @@ class DiskRecorder:
         os.fsync = o["fsync"]
         os.fdatasync = o["fdatasync"]
         os.pwrite = o["pwrite"]
+        os.pwritev = o["pwritev"]
         os.ftruncate = o["ftruncate"]
         os.truncate = o["truncate"]
         DiskRecorder._active = None

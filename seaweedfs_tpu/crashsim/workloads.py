@@ -6,6 +6,9 @@ Covered paths (the acceptance sweep spans all of them):
 
 - volume_append       — .dat append + .idx journal + .swm watermark;
                         torn-tail truncation and index re-derivation
+- volume_group_commit — coalesced pwritev + single-fsync group barrier;
+                        acked groups survive crashes landing between
+                        the barrier and the index journal
 - needle_map_flush    — DiskNeedleMap .idx journal + .sdx segment
                         (fingerprint adoption, torn-journal tolerance)
 - ec_encode           — shard files + the .ecm commit marker
@@ -102,6 +105,85 @@ def _make_volume_workload() -> CrashWorkload:
         return observed
 
     return CrashWorkload("volume_append", setup, run, recover)
+
+
+# --------------------------------------------------------- group commit
+
+def _make_group_commit_workload() -> CrashWorkload:
+    """Crash mid-group-commit: write_needles_batch(group_commit=True)
+    turns a whole batch into one pwritev + one fsync barrier, and the
+    server acks the entire group the moment the call returns.  The
+    sweep must therefore prove BOTH directions: a crash before/inside
+    the pwritev or before the fsync loses only candidates (never an
+    ack), and a crash after the barrier — including mid-index-journal —
+    loses nothing acked, because load-time recovery re-derives index
+    entries from the fsynced .dat."""
+    from ..storage.needle import Needle
+    from ..storage.volume import Volume
+
+    def setup(root):
+        v = Volume(root, "", 1, create=True)
+        for nid in (1, 2, 3):
+            v.write_needle(Needle(cookie=_COOKIE, id=nid,
+                                  data=b"baseline-%d" % nid))
+        v.close()
+
+    def run(root, ack, rng):
+        v = Volume(root, "", 1)
+        for nid in (1, 2, 3):
+            ack(f"n{nid}", b"baseline-%d" % nid)
+        nid = 200
+        for _round in range(4):
+            group = []
+            batch = {}
+            for _ in range(rng.randrange(2, 6)):
+                nid += 1
+                data = _volume_payload(rng, nid)
+                batch[nid] = data
+                ack.candidate(f"n{nid}", data)
+                group.append(Needle(cookie=_COOKIE, id=nid, data=data))
+            results = v.write_needles_batch(group, group_commit=True)
+            for n, res in zip(group, results):
+                if isinstance(res, Exception):
+                    raise res
+                # the group barrier already ran: this ack is the
+                # server-visible 201
+                ack(f"n{n.id}", batch[n.id])
+        # an un-committed trailing group: the "crash" lands before its
+        # barrier completes, so these stay candidates
+        tail = []
+        for _ in range(3):
+            nid += 1
+            data = _volume_payload(rng, nid)
+            ack.candidate(f"n{nid}", data)
+            tail.append(Needle(cookie=_COOKIE, id=nid, data=data))
+        v.write_needles_batch(tail, group_commit=True)
+        # crash here: abandon the handles without the close() barrier —
+        # the tail group's acks were never issued
+        v.nm.close()
+        v._dat.close()
+
+    def read_all(vdir):
+        v = Volume(vdir, "", 1)
+        observed = {}
+        for nv in v.nm.values():
+            if nv.size > 0:
+                n = v.read_needle(nv.key)
+                observed[f"n{nv.key}"] = n.data
+            else:
+                observed[f"n{nv.key}"] = None
+        v.close()
+        return observed
+
+    def recover(crash_dir):
+        observed = read_all(crash_dir)
+        again = read_all(crash_dir)
+        if again != observed:
+            raise AssertionError("recovery did not converge: "
+                                 "second open disagrees")
+        return observed
+
+    return CrashWorkload("volume_group_commit", setup, run, recover)
 
 
 # ----------------------------------------------------------- needle map
@@ -392,6 +474,7 @@ def registry() -> list:
     """Fresh workload instances (closures hold per-recording state)."""
     return [
         _make_volume_workload(),
+        _make_group_commit_workload(),
         _make_needle_map_workload(),
         _make_ec_workload(),
         _make_raft_workload(),

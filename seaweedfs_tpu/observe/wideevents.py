@@ -240,6 +240,7 @@ _STAGE_BUCKETS: tuple[tuple[str, str], ...] = (
     ("lock", "lock"),
     ("volume.read_repair", "remote-hop"),
     ("volume.replicate", "remote-hop"),
+    ("disk.sendfile", "disk"),
     ("volume.read", "disk"),
     ("volume.write", "disk"),
     ("volume.scrub", "disk"),

@@ -191,6 +191,18 @@ class AdmissionController:
         self.tenant_buckets = (TenantBuckets(t_rps, t_burst,
                                              clock=time_fn)
                                if t_rps > 0 else None)
+        # base (whole-node) rates, kept so a striped shard can be
+        # re-tuned repeatedly without compounding: apply_stripe always
+        # scales from these, never from the current stripe
+        self._base_global = (g_rps, g_burst)
+        self._base_tenant = (t_rps, t_burst)
+        self.stripe_share = 1.0
+        # demand/inversion tallies for the shard stats segment: plain
+        # ints bumped on the event loop (no lock), read cross-process
+        # only via the segment publisher
+        self.demand = 0
+        self.sheds = 0
+        self.inversions = 0
         self.sampler = LoopLagSampler(interval=lag_sample_s,
                                       metrics=metrics)
         if metrics is not None and self.global_bucket is not None:
@@ -217,6 +229,32 @@ class AdmissionController:
 
     def stop(self) -> None:
         self.sampler.stop()
+
+    # --- striped admission (share-nothing shard fleet) ---
+
+    def apply_stripe(self, share: float) -> None:
+        """Scale this shard's rate buckets to ``share`` of the node's
+        configured budget (0 < share <= 1).
+
+        Called once at shard startup with ``1/N`` and then periodically
+        by the rebalance tick with a demand-weighted share, so an idle
+        shard's unspent budget flows to hot ones while the SUM across
+        shards stays at the configured whole-node rate.  Always scales
+        from the base rates captured at construction — repeated calls
+        do not compound.  Concurrency caps and queues stay per-shard
+        untouched: they bound event-loop work, which really is
+        per-process.
+        """
+        share = min(1.0, max(1e-4, float(share)))
+        self.stripe_share = share
+        g_rps, g_burst = self._base_global
+        if self.global_bucket is not None and g_rps > 0:
+            self.global_bucket.set_rate(g_rps * share,
+                                        max(1.0, g_burst * share))
+        t_rps, t_burst = self._base_tenant
+        if self.tenant_buckets is not None and t_rps > 0:
+            self.tenant_buckets.set_rate(t_rps * share,
+                                         max(1.0, t_burst * share))
 
     # --- metrics helpers ---
 
@@ -258,6 +296,7 @@ class AdmissionController:
                 # user traffic is being refused, repair traffic gets
                 # NOTHING
                 self._fg_pressure_until = now + self.window
+        self.sheds += 1
         self._count("admission_shed", cls)
         return ShedError(status, self.retry_after(), reason, cls)
 
@@ -274,6 +313,7 @@ class AdmissionController:
             self._count("admission_admitted", CLASS_SYSTEM)
             return _SYSTEM_TICKET
         now = self._now()
+        self.demand += 1
         if cls == CLASS_BG and self._fg_pressure(now):
             raise self._shed(cls, 503, "foreground pressure")
         lag = self.sampler.lag
@@ -323,6 +363,7 @@ class AdmissionController:
         if cls == CLASS_BG and self._fg_pressure(self._now()):
             # belt-and-suspenders invariant counter: by construction
             # this is unreachable; the bench asserts it stays 0
+            self.inversions += 1
             self._count("admission_inversion", cls)
         self._count("admission_admitted", cls)
         self._gauge_class(cls)
@@ -480,15 +521,23 @@ def admission_middleware(controller: AdmissionController,
     return admission_mw
 
 
-def healthz_handler(controller: AdmissionController):
+def healthz_handler(controller: AdmissionController, shard_ctx=None):
     """aiohttp /healthz handler reporting liveness AND shedding state.
     Status stays 200 while shedding — a load balancer that drains on
     /healthz failure would amplify an overload into an outage; it
-    should key on the ``admission.shedding`` field instead."""
+    should key on the ``admission.shedding`` field instead.
+
+    ``shard_ctx``: a ``server.sharded.ShardContext`` when this process
+    is one stripe of a SO_REUSEPORT shard fleet — the response then
+    carries the whole-node ``shards`` view read from the shared stats
+    segment, so an LB polling ANY shard sees one node (a dead shard
+    shows up as ``alive: false`` in every survivor's answer)."""
     from aiohttp import web
 
     async def handler(request: web.Request) -> web.Response:
-        return web.json_response({"ok": True,
-                                  "admission": controller.health()})
+        body = {"ok": True, "admission": controller.health()}
+        if shard_ctx is not None:
+            body["shards"] = shard_ctx.aggregate_health()
+        return web.json_response(body)
 
     return handler

@@ -57,6 +57,23 @@ class TokenBucket:
                 return True
             return False
 
+    def set_rate(self, rate: float, burst: Optional[float] = None) -> None:
+        """Retune the bucket in place (striped admission rebalance).
+
+        Refills at the OLD rate up to now before switching, so a rate
+        change mid-interval never grants or steals tokens retroactively;
+        shrinking the burst clamps the balance so a shard whose stripe
+        just shrank can't spend a stale surplus.
+        """
+        if rate <= 0:
+            raise ValueError("rate must be > 0")
+        with self._lock:
+            self._refill_locked(self._clock())
+            self.rate = float(rate)
+            if burst is not None:
+                self.burst = float(burst) if burst > 0 else float(rate)
+                self._tokens = min(self._tokens, self.burst)
+
     def tokens(self) -> float:
         """Current token count (refreshes refill) — for gauges/tests."""
         with self._lock:
@@ -93,6 +110,17 @@ class TenantBuckets:
             else:
                 self._buckets.move_to_end(tenant)
             return b
+
+    def set_rate(self, rate: float, burst: float) -> None:
+        """Retune the tier: future buckets are born at the new rate and
+        every live tenant bucket is retuned in place (striped admission
+        rebalance must reach tenants already being hammered)."""
+        with self._lock:
+            self.rate = float(rate)
+            self.burst = float(burst) if burst > 0 else float(rate)
+            live = list(self._buckets.values())
+        for b in live:
+            b.set_rate(self.rate, self.burst)
 
     def try_acquire(self, tenant: str, n: float = 1.0) -> bool:
         if not tenant:

@@ -63,8 +63,13 @@ class S3Server:
                  access_key: str = "", secret_key: str = "",
                  iam: Optional["auth_mod.Iam"] = None,
                  url: str = "",
-                 replica_filer_url: str = ""):
+                 replica_filer_url: str = "",
+                 shard_ctx=None):
         self.filer_url = filer_url
+        # SO_REUSEPORT shard fleet handle (server/sharded.py); None in
+        # the single-process path
+        self.shard_ctx = shard_ctx
+        self._stripe_task: Optional[asyncio.Task] = None
         # replica-cluster read failover (geo plane): when the primary
         # filer's circuit breaker is open (or a fetch fails live), GETs
         # are served from the replica cluster's filer instead, marked
@@ -139,7 +144,8 @@ class S3Server:
         # read back; S3 keeps its XML error shape via `reserved`
         from .. import faults
         for path, handler in (
-                ("/healthz", overload.healthz_handler(self.admission)),
+                ("/healthz", overload.healthz_handler(
+                    self.admission, shard_ctx=self.shard_ctx)),
                 ("/metrics", self.metrics_handler),
                 ("/debug/trace", self.trace_handler),
                 ("/debug/profile", self.profile_handler),
@@ -172,9 +178,10 @@ class S3Server:
         err = self._check_auth(request, action=auth_mod.ACTION_ADMIN)
         if err is not None:
             return err
-        return web.Response(text=metrics_mod.exposition(self.metrics,
-                                                        request),
-                            content_type="text/plain")
+        text = metrics_mod.exposition(self.metrics, request)
+        if self.shard_ctx is not None and self.shard_ctx.shards > 1:
+            text += self.shard_ctx.metrics_lines()
+        return web.Response(text=text, content_type="text/plain")
 
     async def trace_handler(self, request: web.Request) -> web.Response:
         err = self._check_auth(request, action=auth_mod.ACTION_ADMIN)
@@ -1743,7 +1750,24 @@ async def run_s3(host: str, port: int, filer_url: str,
     server = S3Server(filer_url, **kwargs)
     runner = web.AppRunner(server.app, access_log=None)
     await runner.setup()
-    site = web.TCPSite(runner, host, port)
+    ctx = server.shard_ctx
+    sharding = ctx is not None and ctx.shards > 1
+    site = web.TCPSite(runner, host, port, reuse_port=sharding or None)
     await site.start()
+    if sharding:
+        from ..server import sharded
+
+        def _blob() -> dict:
+            if ctx.index == 0 and ctx.child_pids:
+                ctx.reap_children()
+            return {}
+
+        ctx.publish_meta(internal_port=port,
+                         stripe_share=1.0 / ctx.shards)
+        server.admission.apply_stripe(1.0 / ctx.shards)
+        server._stripe_task = asyncio.create_task(
+            sharded.run_stripe_loop(ctx, server.admission, blob_fn=_blob))
+        log.info("s3 shard %d/%d on %s:%d", ctx.index, ctx.shards,
+                 host, port)
     log.info("s3 gateway on %s:%d -> filer %s", host, port, filer_url)
     return runner

@@ -25,6 +25,7 @@ import logging
 import os
 import time
 from typing import Optional
+from urllib.parse import unquote_plus
 
 from .. import faults, observe, overload
 from ..observe import profiler, wideevents
@@ -49,20 +50,54 @@ _E400 = json.dumps({"error": "missing file id"}).encode()
 
 # _admission_gate answered a shed response itself; no ticket to release
 _SHED = object()
+
+
+def server_sendfile_min(server) -> int:
+    """Resolve (once per server object) the sendfile eligibility floor:
+    -1 = sendfile disabled (WEED_VOLUME_SENDFILE=0/false/off), else the
+    minimum body size in bytes (WEED_SENDFILE_MIN, default 4096 — below
+    that the extra validation preads cost more than the copy saves)."""
+    m = getattr(server, "_sendfile_min", None)
+    if m is None:
+        env = os.environ
+        if env.get("WEED_VOLUME_SENDFILE", "").lower() in (
+                "0", "false", "off", "no"):
+            m = -1
+        else:
+            try:
+                m = int(env.get("WEED_SENDFILE_MIN", "") or 4096)
+            except ValueError:
+                m = 4096
+        try:
+            server._sendfile_min = m
+        except AttributeError:
+            pass
+    return m
 # _read_request answered the request inline (403/shed on a body-less
 # request): nothing to dispatch, keep serving the connection
 _HANDLED = object()
 
 
+# the no-query fast shape (every benchmark GET) shares ONE dict: the
+# hot path must not allocate per request.  Callers treat query dicts as
+# read-only — anything mutating this would poison every later request,
+# which the allocation-pinning test in test_fastpath guards against.
+_EMPTY_QUERY: dict = {}
+
+
 def _parse_query(q: str) -> dict:
+    if not q:
+        return _EMPTY_QUERY
     out = {}
-    if q:
-        from urllib.parse import unquote_plus
-        for pair in q.split("&"):
-            k, _, v = pair.partition("=")
+    for pair in q.split("&"):
+        k, _, v = pair.partition("=")
+        if "%" in pair or "+" in pair:
             # decode like the aiohttp handlers do, or the same request
-            # means different things on the two code paths
+            # means different things on the two code paths — but only
+            # pay for it when an escape is actually present
             out[unquote_plus(k)] = unquote_plus(v)
+        else:
+            out[k] = v
     return out
 
 
@@ -307,7 +342,17 @@ class FastVolumeProtocol(asyncio.Protocol):
             # release it immediately (any client dodges the caps by
             # adding Transfer-Encoding: chunked).
             self.buf = b""
-            await self._proxy_tunnel(head + b"\r\n\r\n" + rest)
+            rport = None
+            route = getattr(self.server, "shard_route", None)
+            if route is not None:
+                fid_str = path.lstrip("/").split("/", 1)[0]
+                if "," in fid_str:
+                    try:
+                        rport = route(FileId.parse(fid_str).volume_id)
+                    except ValueError:
+                        rport = None
+            await self._proxy_tunnel(head + b"\r\n\r\n" + rest,
+                                     port=rport)
             return None
         # strict HTTP grammar: digits only (int() would also accept
         # '+5' / '5_0', a framing-desync risk behind stricter proxies)
@@ -414,6 +459,15 @@ class FastVolumeProtocol(asyncio.Protocol):
         except ValueError as e:
             self._send(400, json.dumps({"error": str(e)}).encode())
             return
+        # shard fleet: a volume owned by a sibling shard is served by
+        # proxying the whole request to that shard's aiohttp listener
+        # over loopback (auth/EC/replica logic all run there)
+        route = getattr(self.server, "shard_route", None)
+        if route is not None:
+            rport = route(fid.volume_id)
+            if rport:
+                await self._proxy(raw, port=rport)
+                return
         q = _parse_query(query)
         token = token_from_request(_HeaderView(headers), q)
         if method in ("GET", "HEAD"):
@@ -449,6 +503,29 @@ class FastVolumeProtocol(asyncio.Protocol):
         if vol is None:
             await self._proxy(raw)  # EC volume / redirect logic
             return
+        # zero-copy GET: whole plain-shape needle bodies go straight
+        # from the .dat fd to the socket via the kernel (os.sendfile).
+        # Eligibility is decided conservatively; anything else falls
+        # through to the existing pread path below, byte-identically.
+        if (method == "GET" and server_sendfile_min(server) >= 0
+                and self.transport.get_extra_info("sslcontext") is None):
+            try:
+                ext = vol.needle_sendfile_extent(fid.key, fid.cookie)
+            except NeedleExpired:
+                server.metrics.count("read")
+                self._send(404, _E404)
+                return
+            except NeedleDeleted:
+                server.metrics.count("read")
+                self._send(404, json.dumps({"error": "deleted"}).encode())
+                return
+            except (NeedleNotFound, KeyError):
+                await self._proxy(raw)  # read-repair / replica logic
+                return
+            if (ext is not None
+                    and ext[2] >= server_sendfile_min(server)):
+                await self._sendfile_read(fid, ext, headers)
+                return
         start_us = int(time.time() * 1e6)
         t0 = time.perf_counter()
         try:
@@ -527,6 +604,75 @@ class FastVolumeProtocol(asyncio.Protocol):
             self.transport.write(head.encode("latin-1"))
             return
         self._send(200, body, ctype=mime, extra="".join(extra))
+
+    async def _sendfile_read(self, fid: FileId, ext: tuple,
+                             headers: dict) -> None:
+        """Serve a whole-needle GET body via the kernel: HTTP head from
+        userspace, body straight from the .dat fd with ``sendfile``.
+        The extent was validated by Volume.needle_sendfile_extent; the
+        ETag is the stored CRC so conditional requests behave exactly
+        like the parsed path.  If the native syscall is unavailable the
+        response head is already on the wire, so the body is delivered
+        with a positioned pread instead — never a seek on the shared
+        file object (concurrent requests share the .dat handle)."""
+        server = self.server
+        (fobj, data_off, data_size, etag_hex, last_modified,
+         name, mime) = ext
+        start_us = int(time.time() * 1e6)
+        t0 = time.perf_counter()
+        # same named fault point as the pread fast shape: fired once
+        # the read is committed to be served inline
+        try:
+            if await faults.fire_async("volume.read"):
+                server.metrics.count("read")
+                self._send(404, json.dumps({"error": "injected drop"}
+                                           ).encode())
+                return
+        except faults.FaultError as e:
+            server.metrics.count("read")
+            self._send(500, json.dumps({"error": str(e)}).encode())
+            return
+        server.metrics.count("read")
+        server.heat.record_read(fid.volume_id)
+        etag = f'"{etag_hex}"'
+        if headers.get(b"if-none-match", b"").decode("latin-1") == etag:
+            self._send(304, b"")
+            return
+        extra = [f"ETag: {etag}\r\n", "Accept-Ranges: bytes\r\n"]
+        if last_modified:
+            extra.append(f"X-Last-Modified: {last_modified}\r\n")
+        # identical decoration to the parsed pread path: stored mime
+        # wins, a stored name becomes the inline disposition
+        ctype = (mime.decode("utf-8", "replace") if mime
+                 else "application/octet-stream")
+        if name:
+            fname = name.decode("utf-8", "replace")
+            extra.append(f'Content-Disposition: inline; '
+                         f'filename="{fname}"\r\n')
+        head = ("HTTP/1.1 200 OK\r\n"
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {data_size}\r\n{''.join(extra)}\r\n")
+        self._status = 200
+        self._sent = data_size
+        self.transport.write(head.encode("latin-1"))
+        loop = asyncio.get_event_loop()
+        try:
+            await loop.sendfile(self.transport, fobj, data_off,
+                                data_size, fallback=False)
+        except (asyncio.SendfileNotAvailableError, NotImplementedError,
+                AttributeError):
+            data = await loop.run_in_executor(
+                None, os.pread, fobj.fileno(), data_size, data_off)
+            self.transport.write(data)
+        read_s = time.perf_counter() - t0
+        # the read-latency histogram covers the kernel send too — that
+        # IS the disk+copy work this stage replaces
+        server.metrics.observe("read", read_s)
+        # distinct stage name so cluster.tail attributes sendfile time
+        # separately from parsed reads (wideevents buckets it under
+        # "disk")
+        observe.record_span("disk.sendfile", observe.capture(), start_us,
+                            int(read_s * 1e6), tags={"fid": str(fid)})
 
     # --- data plane: write (volume_server_handlers_write.go:19 fast shape) ---
     async def _write(self, fid: FileId, q: dict, headers: dict,
@@ -679,14 +825,17 @@ class FastVolumeProtocol(asyncio.Protocol):
             if hdr_end + 4 <= len(raw) else b""
         return [new_head, body]
 
-    async def _proxy_tunnel(self, initial: bytes) -> None:
+    async def _proxy_tunnel(self, initial: bytes,
+                            port: Optional[int] = None) -> None:
         """Bidirectional relay for requests we cannot frame (chunked,
         Expect: 100-continue): everything from here on belongs to the
         aiohttp listener; the client connection closes when either side
-        does."""
+        does.  ``port`` overrides the loopback target — cross-shard
+        routing sends the tunnel straight to the owning shard's aiohttp
+        listener."""
         self._proxied = True
         reader, writer = await asyncio.open_connection(
-            "127.0.0.1", self.internal_port)
+            "127.0.0.1", port or self.internal_port)
         for part in self._mark_internal(initial, tunnel=True):
             writer.write(part)
         await writer.drain()
@@ -721,10 +870,16 @@ class FastVolumeProtocol(asyncio.Protocol):
             self.transport.close()
 
     # --- loopback proxy to the aiohttp app ---
-    async def _proxy(self, raw: bytes) -> None:
+    async def _proxy(self, raw: bytes, port: Optional[int] = None) -> None:
+        """Relay one framed request/response over loopback.  ``port``
+        overrides the target: None = this process's own aiohttp
+        listener; a shard-fleet peer's internal port when the volume
+        lives on another shard (the request carries the fleet-shared
+        internal token, so the peer's guard and admission treat it as
+        pre-admitted exactly like a same-process proxy)."""
         self._proxied = True
         reader, writer = await asyncio.open_connection(
-            "127.0.0.1", self.internal_port)
+            "127.0.0.1", port or self.internal_port)
         try:
             for part in self._mark_internal(raw):
                 writer.write(part)
@@ -858,6 +1013,8 @@ class FastMasterProtocol(FastVolumeProtocol):
 class _HeaderView:
     """dict-of-bytes -> .get(str) view for token_from_request."""
 
+    __slots__ = ("_h",)
+
     def __init__(self, headers: dict):
         self._h = headers
 
@@ -867,10 +1024,15 @@ class _HeaderView:
 
 
 async def start_fastpath(server, host: str, port: int, internal_port: int,
-                         ssl_context=None, protocol=FastVolumeProtocol):
+                         ssl_context=None, protocol=FastVolumeProtocol,
+                         reuse_port: bool = False):
     """Listen on the public (host, port) with the fast protocol, proxying
-    non-hot-path requests to the aiohttp listener at internal_port."""
+    non-hot-path requests to the aiohttp listener at internal_port.
+    ``reuse_port`` sets SO_REUSEPORT so every process of a shard fleet
+    binds the same port and the kernel spreads accepted connections."""
     loop = asyncio.get_event_loop()
+    kwargs = {"ssl": ssl_context}
+    if reuse_port:
+        kwargs["reuse_port"] = True
     return await loop.create_server(
-        lambda: protocol(server, internal_port), host, port,
-        ssl=ssl_context)
+        lambda: protocol(server, internal_port), host, port, **kwargs)

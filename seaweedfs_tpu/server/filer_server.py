@@ -83,7 +83,8 @@ class FilerServer:
                  grpc_port: int = 0,
                  tls=None,
                  url: str = "",
-                 ring_config=None):
+                 ring_config=None,
+                 shard_ctx=None):
         # comma-separated HA master list; rotates on failure like the
         # Client/VolumeServer (wdclient/masterclient.go)
         self.masters = [m.strip() for m in master_url.split(",")
@@ -168,6 +169,12 @@ class FilerServer:
             "filer", metrics=self.metrics,
             system_paths=(overload.FILER_SYSTEM_PATHS
                           | overload.faults_admin_paths()))
+        # SO_REUSEPORT shard fleet handle (server/sharded.py); None in
+        # the single-process path.  NOTE: sharding a filer requires a
+        # shared metadata store (sqlite on one path, redis, ...) — the
+        # in-memory store would give each shard a private namespace.
+        self.shard_ctx = shard_ctx
+        self._stripe_task: Optional[asyncio.Task] = None
         # --- metadata scale-out ring (metaring/) ---
         # off unless peers are configured; when on, every namespace op
         # routes to the parent directory's ring owner, writes mirror to
@@ -231,7 +238,8 @@ class FilerServer:
         # methods, or `PUT /healthz` falls through to the path catch-all
         # as a never-metered system-classified file write
         overload.reserve_ops(app, "/healthz",
-                             overload.healthz_handler(self.admission))
+                             overload.healthz_handler(
+                                 self.admission, shard_ctx=self.shard_ctx))
         overload.reserve_ops(app, "/metrics", self.metrics_handler)
         from .. import faults
         if faults.admin_enabled():
@@ -1676,9 +1684,10 @@ class FilerServer:
         return web.json_response({"ok": True}, status=202)
 
     async def metrics_handler(self, request: web.Request) -> web.Response:
-        return web.Response(text=metrics_mod.exposition(self.metrics,
-                                                        request),
-                            content_type="text/plain")
+        text = metrics_mod.exposition(self.metrics, request)
+        if self.shard_ctx is not None and self.shard_ctx.shards > 1:
+            text += self.shard_ctx.metrics_lines()
+        return web.Response(text=text, content_type="text/plain")
 
     async def status_ui(self, request: web.Request) -> web.Response:
         """Status page with a root-directory table
@@ -1714,9 +1723,27 @@ async def run_filer(host: str, port: int, master_url: str,
     runner = web.AppRunner(server.app, access_log=None)
     await runner.setup()
     tls = kwargs.get("tls")
+    ctx = server.shard_ctx
+    sharding = ctx is not None and ctx.shards > 1
     site = web.TCPSite(runner, host, port,
                        ssl_context=(tls.server_ssl_context()
-                                    if tls is not None else None))
+                                    if tls is not None else None),
+                       reuse_port=sharding or None)
     await site.start()
+    if sharding:
+        from . import sharded
+
+        def _blob() -> dict:
+            if ctx.index == 0 and ctx.child_pids:
+                ctx.reap_children()
+            return {}
+
+        ctx.publish_meta(internal_port=port,
+                         stripe_share=1.0 / ctx.shards)
+        server.admission.apply_stripe(1.0 / ctx.shards)
+        server._stripe_task = asyncio.create_task(
+            sharded.run_stripe_loop(ctx, server.admission, blob_fn=_blob))
+        log.info("filer shard %d/%d on %s:%d", ctx.index, ctx.shards,
+                 host, port)
     log.info("filer on %s:%d -> master %s", host, port, master_url)
     return runner

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import asyncio
 import email.parser
+import functools
 import logging
 import os
 import socket
@@ -92,10 +93,22 @@ class WriteBatcher:
     INLINE_BYTES = 256 * 1024  # below this a batch writes on the loop
     IDLE_SECONDS = 30.0  # worker exits after this long with no writes
 
-    def __init__(self, store: Store):
+    def __init__(self, store: Store, group_commit_us: Optional[int] = None):
         self.store = store
         self._queues: dict[int, asyncio.Queue] = {}
         self._workers: dict[int, asyncio.Task] = {}
+        # group commit: hold the batch open for this many µs so
+        # concurrent small writes coalesce into ONE gathered writev +
+        # ONE fsync barrier; acks release only after the barrier
+        # (storage/volume.py _write_needles_group). 0 = off (default):
+        # the proven drain-what's-queued path with no added latency.
+        if group_commit_us is None:
+            try:
+                group_commit_us = int(os.environ.get(
+                    "WEED_VOLUME_GROUP_COMMIT_US", "0") or 0)
+            except ValueError:
+                group_commit_us = 0
+        self.group_commit_us = max(0, group_commit_us)
 
     async def write(self, vid: int, needle) -> tuple[int, int, bool]:
         # (measured: an uncontended inline shortcut here is neutral at
@@ -135,6 +148,26 @@ class WriteBatcher:
                 n2, f2 = q.get_nowait()
                 batch.append((n2, f2))
                 size += len(n2.data)
+            if self.group_commit_us > 0:
+                # hold the commit window open: anything arriving before
+                # the deadline rides this group's single fsync
+                deadline = loop.time() + self.group_commit_us / 1e6
+                while (len(batch) < self.MAX_BATCH
+                       and size < self.MAX_BYTES):
+                    remaining = deadline - loop.time()
+                    if remaining <= 0:
+                        break
+                    try:
+                        n2, f2 = await asyncio.wait_for(q.get(), remaining)
+                    except asyncio.TimeoutError:
+                        break
+                    batch.append((n2, f2))
+                    size += len(n2.data)
+                    while (len(batch) < self.MAX_BATCH
+                           and size < self.MAX_BYTES and not q.empty()):
+                        n3, f3 = q.get_nowait()
+                        batch.append((n3, f3))
+                        size += len(n3.data)
             v = self.store.find_volume(vid)
             if v is None:
                 # volume deleted/unmounted (or bogus vid): fail the batch
@@ -151,7 +184,14 @@ class WriteBatcher:
             try:
                 ns = [n for n, _ in batch]
                 results = None
-                if size <= self.INLINE_BYTES:
+                if self.group_commit_us > 0:
+                    # the group path always takes the executor: it ends
+                    # in an fsync barrier (never loop-inline), and the
+                    # acks below release only after that barrier
+                    results = await loop.run_in_executor(
+                        None, functools.partial(
+                            v.write_needles_batch, ns, group_commit=True))
+                elif size <= self.INLINE_BYTES:
                     # small batches: buffered page-cache appends finish in
                     # microseconds, while the executor handoff costs two GIL
                     # convoys (~ms on few-core hosts). The nowait variant
@@ -184,7 +224,9 @@ class VolumeServer:
                  master_grpc_target: str = "",
                  grpc_port: int = 0,
                  tls=None,
-                 scrub_interval_seconds: Optional[float] = None):
+                 scrub_interval_seconds: Optional[float] = None,
+                 internal_token: Optional[str] = None,
+                 shard_ctx=None):
         self.use_grpc_heartbeat = use_grpc_heartbeat
         # explicit gRPC endpoint override; default follows the
         # HTTP-port+10000 convention (grpc_client_server.go)
@@ -240,10 +282,20 @@ class VolumeServer:
             else HeatTracker()
         # per-process secret marking requests proxied from the fastpath
         # listener (server/fastpath.py): they arrive from 127.0.0.1 but
-        # were already whitelist-checked against the REAL peer IP
-        import secrets as _secrets
-        self._internal_token = _secrets.token_hex(16)
+        # were already whitelist-checked against the REAL peer IP.  In a
+        # shard fleet the token is minted pre-fork and shared, so any
+        # shard's fastpath can proxy cross-shard to the owner's loopback
+        # app and still be treated as pre-admitted.
+        if internal_token:
+            self._internal_token = internal_token
+        else:
+            import secrets as _secrets
+            self._internal_token = _secrets.token_hex(16)
         self._fast_srv = None
+        # share-nothing shard fleet handle (server/sharded.py); None in
+        # the single-process path
+        self.shard_ctx = shard_ctx
+        self._stripe_task: Optional[asyncio.Task] = None
         # overload plane: repair/scrub/vacuum traffic (tagged bg by its
         # originators) sheds before the user data plane
         self.admission = overload.AdmissionController(
@@ -252,6 +304,21 @@ class VolumeServer:
         self.app = self._build_app()
         # the EC read path fetches missing shards from peers through this
         store._remote_shard_reader = self._make_shard_reader
+
+    def shard_route(self, vid: int) -> Optional[int]:
+        """Loopback port of the sibling shard owning ``vid``, or None to
+        handle locally.  Local store ALWAYS wins (legacy volumes all
+        live in shard 0's base dir — the modulo map must never shadow
+        them); EC volumes stay local too (the EC read path does its own
+        peer fetches).  Called per-request from the fastpath dispatch,
+        so the checks are dict probes, not IO."""
+        ctx = self.shard_ctx
+        if ctx is None or ctx.shards <= 1:
+            return None
+        if self.store.find_volume(vid) is not None \
+                or self.store.find_ec_volume(vid) is not None:
+            return None
+        return ctx.lookup_volume_port(vid)
 
     def _build_app(self) -> web.Application:
         @web.middleware
@@ -327,7 +394,8 @@ class VolumeServer:
         app.router.add_get("/status", self.status)
         app.router.add_get("/metrics", self.metrics_handler)
         app.router.add_get("/healthz",
-                           overload.healthz_handler(self.admission))
+                           overload.healthz_handler(self.admission,
+                                                    shard_ctx=self.shard_ctx))
         from ..observe import profiler, wideevents
         app.router.add_get("/debug/profile", profiler.profile_handler())
         app.router.add_get("/debug/trace", observe.trace_handler())
@@ -380,6 +448,8 @@ class VolumeServer:
             self._hb_task.cancel()
         if self._scrub_task:
             self._scrub_task.cancel()
+        if self._stripe_task:
+            self._stripe_task.cancel()
         if self._batcher is not None:
             self._batcher.stop()
         if self._session:
@@ -540,8 +610,18 @@ class VolumeServer:
         self.metrics.gauge("ec_read_flight_shared", shared)
 
     async def send_heartbeat(self) -> None:
+        ctx = self.shard_ctx
+        if ctx is not None and ctx.shards > 1 and ctx.index != 0:
+            # non-zero shards publish their volume list through the
+            # shared segment (stripe tick blob); shard 0 unions it into
+            # the single master heartbeat.  Heat stays queued in the
+            # tracker (advisory — see _report_heat's contract).
+            self._update_volume_gauges(self._hb_payload(include_heat=False))
+            return
         payload = self._hb_payload()
         self._update_volume_gauges(payload)
+        if ctx is not None and ctx.shards > 1:
+            payload = ctx.merged_heartbeat(payload)
         try:
             await self._send_heartbeat(payload)
         except BaseException:
@@ -1109,6 +1189,30 @@ class VolumeServer:
     # --- admin ---
     async def admin_assign_volume(self, request: web.Request) -> web.Response:
         body = await request.json()
+        ctx = self.shard_ctx
+        if ctx is not None and ctx.shards > 1:
+            # new volumes land on their modulo owner so the fleet's
+            # capacity actually spreads; forward if that's not me
+            owner = ctx.owner(int(body["volume_id"]))
+            if owner != ctx.index:
+                m = ctx.read_meta(owner)
+                if m["alive"] and m["internal_port"]:
+                    try:
+                        async with self._session.post(
+                                f"http://127.0.0.1:{m['internal_port']}"
+                                "/admin/assign_volume", json=body,
+                                headers={"X-Swfs-Internal":
+                                         self._internal_token},
+                                timeout=aiohttp.ClientTimeout(
+                                    total=15)) as r:
+                            return web.json_response(await r.json(),
+                                                     status=r.status)
+                    except Exception as e:
+                        log.warning("assign forward to shard %d failed:"
+                                    " %s; allocating locally", owner, e)
+                # owner dead/unpublished: allocate locally — capacity
+                # beats placement purity, and routing follows the
+                # published volume lists anyway
         try:
             self.store.add_volume(
                 int(body["volume_id"]), body.get("collection", ""),
@@ -1788,9 +1892,13 @@ class VolumeServer:
     async def metrics_handler(self, request: web.Request) -> web.Response:
         # shared registries carry non-server subsystems hosted in this
         # process (the EC feed governor's operating point + stage model)
-        return web.Response(text=metrics_mod.exposition(self.metrics,
-                                                        request),
-                            content_type="text/plain")
+        text = metrics_mod.exposition(self.metrics, request)
+        if self.shard_ctx is not None and self.shard_ctx.shards > 1:
+            # whatever shard the LB's scrape landed on appends the
+            # fleet-wide per-shard series from the shared segment, so
+            # one node keeps looking like one node
+            text += self.shard_ctx.metrics_lines()
+        return web.Response(text=text, content_type="text/plain")
 
     async def status_ui(self, request: web.Request) -> web.Response:
         """Status page with volume + EC tables
@@ -1847,15 +1955,45 @@ async def run_volume_server(host: str, port: int, store: Store,
     await runner.setup()
     tls = kwargs.get("tls")
     ssl_ctx = tls.server_ssl_context() if tls is not None else None
+    ctx = server.shard_ctx
+    sharding = ctx is not None and ctx.shards > 1
+    internal_port = 0
     if fastpath:
         site = web.TCPSite(runner, "127.0.0.1", 0)
         await site.start()
         internal_port = site._server.sockets[0].getsockname()[1]
         from .fastpath import start_fastpath
         server._fast_srv = await start_fastpath(
-            server, host, port, internal_port, ssl_context=ssl_ctx)
+            server, host, port, internal_port, ssl_context=ssl_ctx,
+            reuse_port=sharding)
     else:
-        site = web.TCPSite(runner, host, port, ssl_context=ssl_ctx)
+        if sharding:
+            log.warning("WEED_SERVE_SHARDS>1 without the fastpath: "
+                        "cross-shard volume routing is unavailable")
+        site = web.TCPSite(runner, host, port, ssl_context=ssl_ctx,
+                           reuse_port=sharding or None)
         await site.start()
+    if sharding:
+        from . import sharded
+
+        # the loopback app port is the fleet-visible address for
+        # cross-shard proxying; publish it before the first tick so
+        # siblings can route immediately, and start this shard at an
+        # even 1/N stripe until demand data accumulates
+        ctx.publish_meta(internal_port=internal_port,
+                         stripe_share=1.0 / ctx.shards)
+        server.admission.apply_stripe(1.0 / ctx.shards)
+
+        def _blob() -> dict:
+            if ctx.index == 0 and ctx.child_pids:
+                died = ctx.reap_children()
+                if died:
+                    log.warning("shard children died: %s", died)
+            return {"heartbeat": server._hb_payload(include_heat=False)}
+
+        server._stripe_task = asyncio.create_task(
+            sharded.run_stripe_loop(ctx, server.admission, blob_fn=_blob))
+        log.info("volume shard %d/%d on %s:%d (internal %d)",
+                 ctx.index, ctx.shards, host, port, internal_port)
     log.info("volume server on %s:%d -> master %s", host, port, master_url)
     return runner

@@ -66,6 +66,23 @@ class BackendStorageFile:
     def write_at(self, data: bytes, offset: int) -> int:
         raise NotImplementedError
 
+    def writev_at(self, buffers, offset: int) -> int:
+        """Gathered positioned write (group commit).  The base shape
+        concatenates and delegates — one write_at call, so remote
+        backends keep their single-request semantics; DiskFile
+        overrides with a true pwritev."""
+        return self.write_at(b"".join(buffers), offset)
+
+    def fileno(self) -> int:
+        """Raw fd for kernel-assisted IO (sendfile).  Backends without
+        a local fd raise — callers must check ``is_local`` first."""
+        raise OSError("backend has no file descriptor")
+
+    def raw_file(self):
+        """The underlying binary file object (sendfile needs an object
+        carrying the fd whose lifetime tracks the backend's)."""
+        raise OSError("backend has no file object")
+
     def size(self) -> int:
         raise NotImplementedError
 
@@ -104,11 +121,38 @@ class DiskFile(BackendStorageFile):
     def read_at(self, n: int, offset: int) -> bytes:
         return os.pread(self._f.fileno(), n, offset)
 
+    def fileno(self) -> int:
+        return self._f.fileno()
+
+    def raw_file(self):
+        return self._f
+
     def write_at(self, data: bytes, offset: int) -> int:
         if faults.fire("disk.write"):
             return len(data)  # drop: the kernel never saw the bytes
         data = faults.corrupt("disk.write", data)
         return os.pwrite(self._f.fileno(), data, offset)
+
+    def writev_at(self, buffers, offset: int) -> int:
+        """One gathered pwritev for the whole group-commit batch.  The
+        same disk.write fault point guards it (crashsim patches
+        os.pwritev alongside os.pwrite), and corruption injection runs
+        over the concatenation so a flipped byte can land in ANY record
+        of the group — recovery must survive mid-batch torn writes."""
+        buffers = [b for b in buffers if len(b)]
+        total = sum(len(b) for b in buffers)
+        if not buffers:
+            return 0
+        if faults.fire("disk.write"):
+            return total  # drop the whole group pre-kernel
+        corrupted = faults.corrupt("disk.write", b"".join(buffers))
+        if corrupted is not buffers and len(corrupted) == total:
+            # corruption rewrote the stream: fall back to one pwrite of
+            # the mutated bytes so the injected damage reaches disk
+            joined = b"".join(buffers)
+            if corrupted != joined:
+                return os.pwrite(self._f.fileno(), corrupted, offset)
+        return os.pwritev(self._f.fileno(), buffers, offset)
 
     def size(self) -> int:
         return os.fstat(self._f.fileno()).st_size

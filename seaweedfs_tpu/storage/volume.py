@@ -23,7 +23,10 @@ from . import idx as idx_mod
 from . import types as t
 from ..utils import durable
 from .backend import DiskFile
-from .needle import (FLAG_HAS_LAST_MODIFIED, FLAG_HAS_TTL, Needle)
+from .needle import (FLAG_HAS_LAST_MODIFIED, FLAG_HAS_MIME,
+                     FLAG_HAS_NAME, FLAG_HAS_PAIRS, FLAG_HAS_TTL,
+                     FLAG_IS_CHUNK_MANIFEST, FLAG_IS_COMPRESSED,
+                     LAST_MODIFIED_BYTES, Needle)
 from .needle_map import (NeedleValue, _truncate_torn_tail,
                          create_needle_map, remove_sidecars)
 from .superblock import SUPER_BLOCK_SIZE, SuperBlock
@@ -222,12 +225,23 @@ class Volume:
         self._append_offset = offset + len(record)
         return offset
 
-    def write_needles_batch(self, needles: list[Needle]
+    def write_needles_batch(self, needles: list[Needle],
+                            group_commit: bool = False
                             ) -> list[tuple[int, int, bool] | Exception]:
         """Append many needles under one lock acquisition — the engine half
         of the reference's async write batching (<=128 reqs / 4MB per
         batch, weed/storage/volume_read_write.go:297-327). Per-needle
-        failures are returned in-place, not raised."""
+        failures are returned in-place, not raised.
+
+        ``group_commit=True`` takes the coalesced path: ONE gathered
+        ``writev`` for every record in the batch followed by ONE fsync
+        barrier, and only then are the index entries journaled and the
+        results returned — a successful return therefore means every
+        needle's bytes are durable on the .dat, which is what lets the
+        server ack group-committed writes immediately (the PR 14
+        contract: never ack what a crash can lose)."""
+        if group_commit:
+            return self._write_needles_group(needles)
         out: list = []
         with self._lock:
             for n in needles:
@@ -235,6 +249,84 @@ class Volume:
                     out.append(self.write_needle(n))
                 except Exception as e:
                     out.append(e)
+        return out
+
+    def _write_needles_group(self, needles: list[Needle]) -> list:
+        """Group commit: stage every record, one writev, one fsync,
+        then the index entries.
+
+        Ordering is the whole point: .dat bytes reach the kernel in one
+        ``pwritev`` and are fsynced BEFORE any ``nm.put`` journals an
+        index entry, preserving the invariant that the .idx never
+        references unwritten bytes.  If the process dies after the
+        barrier but before (or mid-) index journaling, load-time
+        ``_crash_recover`` re-derives the lost entries by scanning the
+        fsynced .dat from the sync watermark — the crashsim
+        ``volume_group_commit`` workload sweeps exactly this window.
+        Padding gaps between records are written as literal zero bytes
+        (the scattered path leaves holes) so the gathered buffers stay
+        contiguous; the scanner skips zeros either way.
+        """
+        out: list = [None] * len(needles)
+        with self._lock:
+            staged: list = []      # (result-slot, needle, offset, old nv)
+            bufs: list = []
+            base = self._append_offset
+            cur = base
+            for i, n in enumerate(needles):
+                try:
+                    if self.read_only:
+                        raise VolumeReadOnly(
+                            f"volume {self.vid} is read-only")
+                    if (self.super_block.ttl.minutes()
+                            and not n.ttl.minutes()):
+                        n.set_flag(FLAG_HAS_TTL)
+                        n.ttl = self.super_block.ttl
+                    nv = self.nm.get(n.id)
+                    if nv is not None and self._is_unchanged(n, nv):
+                        out[i] = (t.stored_to_offset(nv.offset), nv.size,
+                                  True)
+                        continue
+                    if nv is not None:
+                        existing = self._read_header_at(
+                            t.stored_to_offset(nv.offset))
+                        if (existing is not None
+                                and existing.cookie != n.cookie):
+                            raise ValueError(
+                                f"needle {n.id:x}: cookie mismatch "
+                                f"{existing.cookie:#x} != {n.cookie:#x}")
+                    n.append_at_ns = time.time_ns()
+                    pad = (-cur) % t.NEEDLE_PADDING_SIZE
+                    if pad:
+                        bufs.append(b"\x00" * pad)
+                        cur += pad
+                    record = n.to_bytes(self.version)
+                    staged.append((i, n, cur, nv))
+                    bufs.append(record)
+                    cur += len(record)
+                except Exception as e:
+                    out[i] = e
+            if not staged:
+                return out
+            try:
+                self._dat.writev_at(bufs, base)
+                self._append_offset = cur
+                self._dat.sync()           # the group barrier
+            except Exception as e:
+                # the whole group shares one fate: none of it was
+                # proven durable, so none of it may be acked, and the
+                # index must not reference any of it
+                for i, _n, _off, _nv in staged:
+                    out[i] = e
+                return out
+            for i, n, offset, nv in staged:
+                self.last_append_at_ns = n.append_at_ns
+                if nv is None or t.stored_to_offset(nv.offset) < offset:
+                    self.nm.put(n.id, t.offset_to_stored(
+                        offset, self.offset_size), n.size)
+                if n.last_modified > self.last_modified_ts:
+                    self.last_modified_ts = n.last_modified
+                out[i] = (offset, n.size, False)
         return out
 
     def write_needles_batch_nowait(self, needles: list[Needle]
@@ -332,6 +424,114 @@ class Volume:
         if len(head) < t.NEEDLE_HEADER_SIZE:
             return None
         return Needle.parse_header(head)
+
+    # flag bits that force the parsed read path: compressed bodies are
+    # re-inflated (or served with Content-Encoding) by the handler, TTL
+    # needs an expiry verdict, pairs become response headers, chunk
+    # manifests are redirections.  Name/mime ARE allowed — every
+    # multipart upload stores a filename, so excluding them would leave
+    # the zero-copy path cold on exactly the common client traffic;
+    # their small trailer fields decode from one bounded pread.
+    _SENDFILE_EXCLUDED_FLAGS = (FLAG_IS_COMPRESSED | FLAG_HAS_TTL
+                                | FLAG_HAS_PAIRS | FLAG_IS_CHUNK_MANIFEST)
+
+    def needle_sendfile_extent(self, needle_id: int,
+                               cookie: Optional[int] = None):
+        """Locate a needle's raw data bytes for a zero-copy sendfile.
+
+        Returns ``(file_obj, data_offset, data_size, etag,
+        last_modified, name, mime)`` when the stored record is a
+        whole-body shape — uncompressed, no pairs, no TTL; a stored
+        name/mime is decoded from the trailer and returned for the
+        response headers — or ``None`` when the caller must take the
+        parsed pread path (remote backend, contended lock, excluded
+        flags, empty body, or a header that doesn't validate).  Raises
+        the same not-found / deleted errors as ``read_needle``.
+
+        Two small preads (header+data_size, then flags/trailer) carry
+        the validation; the body itself is NEVER read in userspace —
+        which also means the CRC is not verified on this path (the
+        scrubber owns bit-rot detection; the kernel copies whatever is
+        on disk, exactly like any mmap/sendfile server).  The returned
+        file object is the live .dat handle: a concurrent compaction
+        swapping it out closes the old fd and the in-flight sendfile
+        fails the connection — same contract as the reference's
+        lock-free readers.  ``etag`` is the stored masked-CRC hex, byte
+        identical to ``Needle.etag()`` on the parsed path.
+        """
+        if not getattr(self._dat, "is_local", False):
+            return None
+        if not self._lock.acquire(blocking=False):
+            return None
+        try:
+            nv = self.nm.get(needle_id)
+            if nv is None or nv.offset == 0:
+                raise NeedleNotFound(f"needle {needle_id:x} not found")
+            if t.size_is_deleted(nv.size):
+                raise NeedleDeleted(f"needle {needle_id:x} deleted")
+            base = t.stored_to_offset(nv.offset)
+            head = self._dat.read_at(t.NEEDLE_HEADER_SIZE + 4, base)
+            if len(head) < t.NEEDLE_HEADER_SIZE + 4:
+                return None
+            n = Needle.parse_header(head[:t.NEEDLE_HEADER_SIZE])
+            if cookie is not None and n.cookie != cookie:
+                raise NeedleNotFound(
+                    f"needle {needle_id:x} cookie mismatch")
+            if n.id != needle_id or n.size != nv.size:
+                return None      # index/record disagree: parsed path
+            data_size = t.get_u32(head, t.NEEDLE_HEADER_SIZE)
+            if data_size == 0 or data_size + 5 > nv.size:
+                return None
+            flags_off = base + t.NEEDLE_HEADER_SIZE + 4 + data_size
+            # one bounded pread covers the entire permitted trailer:
+            # flags(1) + name(1+255) + mime(1+255) + last_modified(5)
+            tail = self._dat.read_at(
+                min(518, nv.size - 4 - data_size), flags_off)
+            if len(tail) < 1:
+                return None
+            flags = tail[0]
+            if flags & self._SENDFILE_EXCLUDED_FLAGS:
+                return None
+            pos = 1
+            name = b""
+            mime = b""
+            last_modified = 0
+            if flags & FLAG_HAS_NAME:
+                if pos >= len(tail):
+                    return None
+                ln = tail[pos]
+                name = bytes(tail[pos + 1:pos + 1 + ln])
+                if len(name) != ln:
+                    return None
+                pos += 1 + ln
+            if flags & FLAG_HAS_MIME:
+                if pos >= len(tail):
+                    return None
+                lm = tail[pos]
+                mime = bytes(tail[pos + 1:pos + 1 + lm])
+                if len(mime) != lm:
+                    return None
+                pos += 1 + lm
+            if flags & FLAG_HAS_LAST_MODIFIED:
+                raw_lm = tail[pos:pos + LAST_MODIFIED_BYTES]
+                if len(raw_lm) < LAST_MODIFIED_BYTES:
+                    return None
+                last_modified = int.from_bytes(raw_lm, "big")
+                pos += LAST_MODIFIED_BYTES
+            if 4 + data_size + pos != nv.size:
+                return None      # unexpected trailing fields
+            crc_raw = self._dat.read_at(
+                4, base + t.NEEDLE_HEADER_SIZE + nv.size)
+            if len(crc_raw) < 4:
+                return None
+            try:
+                fobj = self._dat.raw_file()
+            except (OSError, AttributeError):
+                return None
+            return (fobj, base + t.NEEDLE_HEADER_SIZE + 4, data_size,
+                    crc_raw.hex(), last_modified, name, mime)
+        finally:
+            self._lock.release()
 
     # --- stats / maintenance ---
     def content_size(self) -> int:

@@ -65,6 +65,8 @@ def test_main_checkpoints_every_phase(monkeypatch, tmp_path):
                         lambda w: {"write": {"req_s": 1},
                                    "read": {"req_s": 1}})
     monkeypatch.setattr(bench, "bench_needle_map", lambda w: {})
+    monkeypatch.setattr(bench, "phase_saturation",
+                        lambda w, **k: {"host_cores": 1, "shards": 2})
     monkeypatch.setattr(bench, "HARD_BUDGET_S", 10_000.0)
     # main() imports ec.pipeline for parent-side shard gen: stub the
     # real module attribute (patching sys.modules is not enough once the
@@ -79,6 +81,6 @@ def test_main_checkpoints_every_phase(monkeypatch, tmp_path):
     final = json.load(open(path))
     assert "incomplete" not in final
     for key in ("encode", "kernel_phase", "rebuild",
-                "fused_compact_gzip_rs", "system_req_s",
+                "fused_compact_gzip_rs", "system_req_s", "saturation",
                 "disk_needle_map"):
         assert key in final, key

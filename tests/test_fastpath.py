@@ -535,3 +535,48 @@ def test_fastpath_shed_emits_wide_event(tmp_path, monkeypatch):
     finally:
         faults.clear()
         srv.stop()
+
+
+def test_hot_parse_allocations_pinned():
+    """The per-request parse path must not allocate on the benchmark
+    shapes: the no-query GET shares ONE dict (_EMPTY_QUERY) and
+    _HeaderView is slotted so token extraction costs one fixed-size
+    object, not a dict copy. Regressions here (an f-string, a
+    per-request dict, a dropped __slots__) show up as net block
+    growth across iterations."""
+    import gc
+    import sys
+
+    from seaweedfs_tpu.server import fastpath
+
+    # the no-query fast shape returns the module-level shared dict
+    assert fastpath._parse_query("") is fastpath._EMPTY_QUERY
+    assert fastpath._parse_query("") is fastpath._parse_query("")
+    # escaped and plain pairs still decode like aiohttp would
+    assert fastpath._parse_query("a=1&b=x%20y") == {"a": "1", "b": "x y"}
+    # _HeaderView carries no per-instance __dict__
+    view = fastpath._HeaderView({b"authorization": b"Bearer t"})
+    assert not hasattr(view, "__dict__")
+    assert view.get("Authorization") == "Bearer t"
+
+    headers = {b"content-length": b"0", b"authorization": b""}
+
+    def hot() -> None:
+        q = fastpath._parse_query("")
+        assert not q
+        fastpath._HeaderView(headers).get("Authorization")
+
+    for _ in range(200):  # warm caches/interning before measuring
+        hot()
+    gc.collect()
+    before = sys.getallocatedblocks()
+    for _ in range(5000):
+        hot()
+    gc.collect()
+    grown = sys.getallocatedblocks() - before
+    # transient objects are freed each iteration; anything that sticks
+    # (a cache keyed per call, a leaked view) grows net blocks linearly
+    assert grown < 500, f"hot parse path leaked {grown} blocks"
+    # callers treat query dicts as read-only; the shared empty dict
+    # must never pick up keys from a request
+    assert len(fastpath._EMPTY_QUERY) == 0

@@ -1,0 +1,482 @@
+"""Share-nothing shard fleet (server/sharded.py): the shared stats
+segment, demand-proportional admission striping, volume routing, the
+group-commit write window, and the zero-copy sendfile extent.
+
+The storm tests drive admission through a FakeClock shared by both
+shards' token buckets, so the "global rps stays bounded while budget
+flows between shards" invariants are deterministic — no wall-clock
+racing.  The fork runner itself is exercised end-to-end by
+scripts/saturation.sh (real processes, real SO_REUSEPORT); here the
+two "shards" are two ShardContext views over ONE mmap segment, exactly
+what two forked processes see.
+"""
+
+import asyncio
+import struct
+import time
+
+import pytest
+
+from seaweedfs_tpu.overload import AdmissionController
+from seaweedfs_tpu.server import sharded
+from seaweedfs_tpu.server.sharded import ShardContext
+from seaweedfs_tpu.server.volume_server import WriteBatcher
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _fleet(n: int = 2):
+    """N ShardContext views over one segment — what N forked shards
+    inherit."""
+    ctx0 = ShardContext.create(n, token="tok")
+    views = [ctx0]
+    for i in range(1, n):
+        v = ShardContext(n, ctx0._mm, "tok", index=i)
+        views.append(v)
+    return views
+
+
+# ------------------------------------------------------------ segment
+
+def test_shards_from_env_clamps():
+    assert sharded.shards_from_env({}) == 1
+    assert sharded.shards_from_env({"WEED_SERVE_SHARDS": "4"}) == 4
+    assert sharded.shards_from_env({"WEED_SERVE_SHARDS": "0"}) == 1
+    assert sharded.shards_from_env({"WEED_SERVE_SHARDS": "junk"}) == 1
+    assert sharded.shards_from_env(
+        {"WEED_SERVE_SHARDS": "9999"}) == sharded.MAX_SHARDS
+
+
+def test_meta_roundtrip_and_staleness(monkeypatch):
+    c0, c1 = _fleet(2)
+    c0.publish_meta(internal_port=4242, stripe_share=0.5)
+    c1.publish_meta(internal_port=4343, stripe_share=0.5)
+    m = c1.read_meta(0)
+    assert m["alive"] and m["internal_port"] == 4242
+    assert m["pid"] > 0
+    # a slot whose heartbeat is old reads dead even with the flag set
+    # (SIGKILL never clears it)
+    real = time.time()
+    monkeypatch.setattr(sharded.time, "time",
+                        lambda: real + sharded.STALE_AFTER_S + 1)
+    m = c1.read_meta(0)
+    assert not m["alive"] and m["stale"]
+
+
+def test_touch_preserves_identity_words():
+    c0, c1 = _fleet(2)
+    c0.publish_meta(internal_port=4242)
+    c0.touch(demand=10, shed=2, inversions=0, requests=10,
+             stripe_share=0.7)
+    m = c1.read_meta(0)
+    assert m["internal_port"] == 4242 and m["demand"] == 10
+    assert abs(m["stripe_share"] - 0.7) < 1e-9
+
+
+def test_blob_roundtrip_and_torn_write_skipped():
+    c0, c1 = _fleet(2)
+    c0.publish_meta()
+    c0.write_blob({"health": {"shedding": False}, "n": 3})
+    assert c1.read_blob(0) == {"health": {"shedding": False}, "n": 3}
+    # simulate a writer dying mid-blob: odd generation must read as
+    # absent, not half-parsed
+    off = c0._slot_off(0) + sharded._BLOB_OFF
+    c0._mm[off:off + 4] = struct.pack("<I", 7)
+    assert c1.read_blob(0) is None
+
+
+def test_oversize_blob_degrades_to_empty():
+    c0, _ = _fleet(2)
+    c0.publish_meta()
+    c0.write_blob({"big": "x" * (2 * sharded._BLOB_MAX)})
+    assert c0.read_blob(0) == {}
+
+
+def test_aggregate_health_and_metrics_lines():
+    c0, c1 = _fleet(2)
+    c0.publish_meta(internal_port=1111)
+    c0.write_blob({"health": {"shedding": True, "loop_lag_ms": 3.5}})
+    c1.publish_meta(internal_port=2222)
+    c1.mark_dead()
+    agg = c0.aggregate_health()
+    assert agg["count"] == 2 and agg["alive"] == 1
+    assert agg["shedding"] is True
+    assert agg["per_shard"][0]["loop_lag_ms"] == 3.5
+    assert agg["per_shard"][1]["alive"] is False
+    text = c1.metrics_lines()
+    assert 'swfs_shard_alive{shard="0"} 1' in text
+    assert 'swfs_shard_alive{shard="1"} 0' in text
+    assert "# TYPE swfs_shard_stripe_share gauge" in text
+
+
+def test_merged_heartbeat_union():
+    c0, c1 = _fleet(2)
+    c0.publish_meta()
+    c1.publish_meta()
+    c1.write_blob({"heartbeat": {
+        "volumes": [{"id": 5, "size": 10}, {"id": 1, "size": 99}],
+        "ec_shards": [{"id": 9, "shard_ids": [0, 1]}],
+        "max_file_key": 77, "max_volume_count": 8}})
+    mine = {"volumes": [{"id": 1, "size": 11}], "ec_shards": [],
+            "max_file_key": 50, "max_volume_count": 8, "url": "n1"}
+    merged = c0.merged_heartbeat(mine)
+    vols = {v["id"]: v for v in merged["volumes"]}
+    assert set(vols) == {1, 5}
+    assert vols[1]["size"] == 11          # my payload wins on overlap
+    assert merged["max_file_key"] == 77
+    assert merged["max_volume_count"] == 16
+    assert [e["id"] for e in merged["ec_shards"]] == [9]
+    assert merged["url"] == "n1"
+
+
+def test_dead_shard_excluded_from_heartbeat_union():
+    c0, c1 = _fleet(2)
+    c0.publish_meta()
+    c1.publish_meta()
+    c1.write_blob({"heartbeat": {"volumes": [{"id": 5}],
+                                 "max_volume_count": 8}})
+    c1.mark_dead()
+    merged = c0.merged_heartbeat({"volumes": [], "ec_shards": [],
+                                  "max_file_key": 0,
+                                  "max_volume_count": 8})
+    assert merged["volumes"] == [] and merged["max_volume_count"] == 8
+
+
+# ------------------------------------------------------------ routing
+
+def test_legacy_volume_routes_to_publisher_not_modulo():
+    """A pre-sharding volume lives on shard 0 even when vid % N says
+    otherwise: the published-volume-list route must win."""
+    c0, c1 = _fleet(2)
+    c0.publish_meta(internal_port=1111)
+    c1.publish_meta(internal_port=2222)
+    # vid=1: modulo owner is shard 1, but shard 0 actually holds it
+    c0.write_blob({"heartbeat": {"volumes": [{"id": 1}]}})
+    c1.rebuild_routes()
+    assert c1.lookup_volume_port(1) == 1111
+    c0.rebuild_routes()
+    assert c0.lookup_volume_port(1) is None      # mine: serve locally
+
+
+def test_unpublished_volume_falls_back_to_modulo():
+    c0, c1 = _fleet(2)
+    c0.publish_meta(internal_port=1111)
+    c1.publish_meta(internal_port=2222)
+    c0.rebuild_routes()
+    # vid=3 published by nobody (assign in flight): modulo owner is
+    # shard 1 -> its port; vid=4 is mine -> None
+    assert c0.lookup_volume_port(3) == 2222
+    assert c0.lookup_volume_port(4) is None
+
+
+def test_route_to_dead_owner_fails_closed():
+    c0, c1 = _fleet(2)
+    c0.publish_meta(internal_port=1111)
+    c1.publish_meta(internal_port=2222)
+    c1.write_blob({"heartbeat": {"volumes": [{"id": 7}]}})
+    c0.rebuild_routes()
+    assert c0.lookup_volume_port(7) == 2222
+    c1.mark_dead()
+    # dead owner: no proxy target — the local slow path answers
+    # authoritatively instead of bouncing to a corpse
+    assert c0.lookup_volume_port(7) is None
+    assert c0.route_port(7) is None
+
+
+# ------------------------------------- striped admission (the storm)
+
+def _striped_pair(rps: float, burst: float, clk: FakeClock):
+    views = _fleet(2)
+    ctrls = []
+    for v in views:
+        c = AdmissionController("test", env={}, global_rps=rps,
+                                global_burst=burst, time_fn=clk)
+        c.apply_stripe(1.0 / 2)
+        v.publish_meta(internal_port=1000 + v.index,
+                       stripe_share=0.5)
+        ctrls.append(c)
+    return views, ctrls
+
+
+def test_striped_storm_bounds_global_rps():
+    """Two shards hammered symmetrically for 2 simulated seconds: the
+    fleet-wide admitted count must stay within burst + rps*T (never
+    exceeding the whole-node bound by more than 10%), demand must stay
+    roughly balanced, and no admission inversions may occur."""
+    async def main():
+        clk = FakeClock()
+        views, ctrls = _striped_pair(rps=200.0, burst=20.0, clk=clk)
+        admitted = [0, 0]
+        steps = 2000                   # 2 simulated seconds
+        for step in range(steps):
+            clk.advance(0.001)
+            for i in (0, 1):
+                try:
+                    t = await ctrls[i].admit("fg")
+                    t.release()
+                    admitted[i] += 1
+                except Exception:
+                    pass
+            if step % 100 == 99:       # the rebalance tick, both shards
+                for i in (0, 1):
+                    sharded.stripe_tick(views[i], ctrls[i])
+        total = sum(admitted)
+        # hard bound: burst capacity + rate * elapsed, +10% tolerance
+        assert total <= (20.0 + 200.0 * 2.0) * 1.10, (total, admitted)
+        # and striping must not starve the node either
+        assert total >= 200.0 * 2.0 * 0.5, (total, admitted)
+        # symmetric load -> roughly symmetric admission
+        assert abs(admitted[0] - admitted[1]) <= 0.3 * total, admitted
+        assert ctrls[0].inversions == 0 and ctrls[1].inversions == 0
+        share_sum = ctrls[0].stripe_share + ctrls[1].stripe_share
+        assert 0.9 <= share_sum <= 1.1, share_sum
+
+    asyncio.run(main())
+
+
+def test_idle_budget_flows_to_hot_shard():
+    """One hot shard + one idle shard: after rebalance ticks the hot
+    shard's stripe share grows past an even split, so the idle budget
+    is actually spendable where the demand is."""
+    async def main():
+        clk = FakeClock()
+        views, ctrls = _striped_pair(rps=100.0, burst=10.0, clk=clk)
+        for step in range(2000):
+            clk.advance(0.001)
+            try:
+                t = await ctrls[0].admit("fg")   # shard 0 only
+                t.release()
+            except Exception:
+                pass
+            if step % 100 == 99:
+                for i in (0, 1):
+                    sharded.stripe_tick(views[i], ctrls[i])
+        assert ctrls[0].stripe_share > 0.6, ctrls[0].stripe_share
+        assert ctrls[1].stripe_share < 0.4, ctrls[1].stripe_share
+        share_sum = ctrls[0].stripe_share + ctrls[1].stripe_share
+        assert 0.9 <= share_sum <= 1.1, share_sum
+
+    asyncio.run(main())
+
+
+def test_kill_one_shard_survivor_inherits_budget():
+    """Shard 1 dies (marked dead / reaped): the survivor's next ticks
+    take its share to ~1.0 and /healthz aggregation reports the death —
+    the LB sees one node at reduced capacity, not a healthy lie."""
+    async def main():
+        clk = FakeClock()
+        views, ctrls = _striped_pair(rps=100.0, burst=10.0, clk=clk)
+        for _ in range(3):
+            for i in (0, 1):
+                sharded.stripe_tick(views[i], ctrls[i])
+        views[1].mark_dead()
+        for _ in range(2):
+            sharded.stripe_tick(views[0], ctrls[0])
+        assert ctrls[0].stripe_share == 1.0
+        agg = views[0].aggregate_health()
+        assert agg["alive"] == 1 and agg["count"] == 2
+        assert agg["per_shard"][1]["alive"] is False
+
+    asyncio.run(main())
+
+
+def test_apply_stripe_never_compounds():
+    clk = FakeClock()
+    c = AdmissionController("test", env={}, global_rps=100.0,
+                            global_burst=50.0, time_fn=clk)
+    for _ in range(50):
+        c.apply_stripe(0.5)
+    assert c.global_bucket.rate == pytest.approx(50.0)
+    c.apply_stripe(1.0)
+    assert c.global_bucket.rate == pytest.approx(100.0)
+
+
+# ------------------------------------------------- group-commit window
+
+class _SpyVolume:
+    def __init__(self):
+        self.calls = []
+
+    def write_needles_batch_nowait(self, needles):
+        self.calls.append(("nowait", len(needles)))
+        return [(n.id, len(n.data), False) for n in needles]
+
+    def write_needles_batch(self, needles, group_commit=False):
+        self.calls.append(("group" if group_commit else "plain",
+                           len(needles)))
+        return [(n.id, len(n.data), False) for n in needles]
+
+
+class _SpyStore:
+    def __init__(self):
+        self.volumes = {}
+
+    def find_volume(self, vid):
+        return self.volumes.get(vid)
+
+
+class _N:
+    def __init__(self, i):
+        self.id = i
+        self.data = b"x" * 8
+
+
+def test_group_commit_window_coalesces_and_uses_barrier_path():
+    """With a commit window open, concurrent writes land in ONE
+    group-committed engine call (never the inline nowait path — acks
+    must wait for the fsync barrier)."""
+    async def run():
+        store = _SpyStore()
+        store.volumes[1] = v = _SpyVolume()
+        b = WriteBatcher(store, group_commit_us=30000)
+        results = await asyncio.gather(
+            *[b.write(1, _N(i)) for i in range(8)])
+        assert sorted(r[0] for r in results) == list(range(8))
+        assert all(kind == "group" for kind, _ in v.calls), v.calls
+        assert len(v.calls) < 8, v.calls          # coalescing happened
+        assert sum(n for _, n in v.calls) == 8
+        b.stop()
+
+    asyncio.run(run())
+
+
+def test_group_commit_env_zero_means_off(monkeypatch):
+    monkeypatch.delenv("WEED_VOLUME_GROUP_COMMIT_US", raising=False)
+    assert WriteBatcher(_SpyStore()).group_commit_us == 0
+    monkeypatch.setenv("WEED_VOLUME_GROUP_COMMIT_US", "250")
+    assert WriteBatcher(_SpyStore()).group_commit_us == 250
+    monkeypatch.setenv("WEED_VOLUME_GROUP_COMMIT_US", "junk")
+    assert WriteBatcher(_SpyStore()).group_commit_us == 0
+
+
+# --------------------------------- group commit + sendfile on a volume
+
+@pytest.fixture
+def volume(tmp_path):
+    from seaweedfs_tpu.storage.volume import Volume
+    v = Volume(str(tmp_path), "", 1, create=True)
+    yield v
+    v.close()
+
+
+def _needle(i: int, data: bytes):
+    from seaweedfs_tpu.storage.needle import Needle
+    return Needle(id=i, cookie=0x1234, data=data)
+
+
+def test_group_commit_one_writev_one_fsync(volume, monkeypatch):
+    """The whole group lands through one gathered writev_at and one
+    sync barrier; results match the per-needle path."""
+    calls = {"writev": 0, "sync": 0}
+    real_writev = volume._dat.writev_at
+    real_sync = volume._dat.sync
+
+    def spy_writev(bufs, off):
+        calls["writev"] += 1
+        return real_writev(bufs, off)
+
+    def spy_sync():
+        calls["sync"] += 1
+        return real_sync()
+
+    monkeypatch.setattr(volume._dat, "writev_at", spy_writev)
+    monkeypatch.setattr(volume._dat, "sync", spy_sync)
+    needles = [_needle(i + 1, b"payload-%d" % i * 3) for i in range(6)]
+    out = volume.write_needles_batch(needles, group_commit=True)
+    assert calls["writev"] == 1
+    assert calls["sync"] == 1
+    for i, r in enumerate(out):
+        assert not isinstance(r, Exception), r
+        offset, size, unchanged = r
+        assert not unchanged
+    for i in range(6):
+        n = volume.read_needle(i + 1, cookie=0x1234)
+        assert n.data == b"payload-%d" % i * 3
+
+
+def test_group_commit_reopen_converges(volume, tmp_path):
+    needles = [_needle(i + 1, bytes([i]) * 64) for i in range(4)]
+    volume.write_needles_batch(needles, group_commit=True)
+    volume.close()
+    from seaweedfs_tpu.storage.volume import Volume
+    v2 = Volume(str(tmp_path), "", 1)
+    try:
+        for i in range(4):
+            assert v2.read_needle(i + 1, cookie=0x1234).data == \
+                bytes([i]) * 64
+    finally:
+        v2.close()
+
+
+def test_sendfile_extent_byte_identical(volume):
+    """The (fd, offset, size) extent the fastpath hands to
+    os.sendfile must select exactly the stored body bytes, and the
+    pread fallback therefore serves the identical payload."""
+    import os
+    data = b"the-zero-copy-body" * 300       # > default 4096 floor
+    volume.write_needle(_needle(42, data))
+    ext = volume.needle_sendfile_extent(42, cookie=0x1234)
+    assert ext is not None
+    fobj, off, size, etag, last_modified, name, mime = ext
+    assert size == len(data)
+    assert os.pread(fobj.fileno(), size, off) == data
+    n = volume.read_needle(42, cookie=0x1234)
+    assert n.etag() == etag
+    assert (name, mime) == (b"", b"")
+
+
+def test_sendfile_extent_decodes_name_and_mime(volume):
+    """Every multipart upload stores a filename, so named/mimed
+    needles MUST stay sendfile-eligible — the trailer fields come back
+    decoded for the response headers, and the extent still selects
+    exactly the body bytes."""
+    import os
+    from seaweedfs_tpu.storage.needle import (FLAG_HAS_MIME,
+                                              FLAG_HAS_NAME, Needle)
+    n = Needle(id=7, cookie=0x1234, data=b"z" * 5000, name=b"a.txt",
+               mime=b"text/plain")
+    n.set_flag(FLAG_HAS_NAME)
+    n.set_flag(FLAG_HAS_MIME)
+    volume.write_needle(n)
+    ext = volume.needle_sendfile_extent(7, cookie=0x1234)
+    assert ext is not None
+    fobj, off, size, etag, _lm, name, mime = ext
+    assert (name, mime) == (b"a.txt", b"text/plain")
+    assert os.pread(fobj.fileno(), size, off) == b"z" * 5000
+    assert volume.read_needle(7, cookie=0x1234).etag() == etag
+
+
+def test_sendfile_extent_declines_decorated_shapes(volume):
+    """Compressed bodies and TTL'd needles must fall back (the body
+    on disk is not the response body / expiry needs a verdict)."""
+    from seaweedfs_tpu.storage.needle import (FLAG_HAS_TTL,
+                                              FLAG_IS_COMPRESSED,
+                                              Needle)
+    import gzip
+    comp = Needle(id=17, cookie=0x1234,
+                  data=gzip.compress(b"z" * 5000, mtime=0))
+    comp.set_flag(FLAG_IS_COMPRESSED)
+    volume.write_needle(comp)
+    assert volume.needle_sendfile_extent(17, cookie=0x1234) is None
+    from seaweedfs_tpu.storage import types as t
+    ttl = Needle(id=18, cookie=0x1234, data=b"q" * 5000,
+                 ttl=t.TTL.parse("1h"))
+    ttl.set_flag(FLAG_HAS_TTL)
+    volume.write_needle(ttl)
+    assert volume.needle_sendfile_extent(18, cookie=0x1234) is None
+
+
+def test_sendfile_extent_wrong_cookie_raises(volume):
+    from seaweedfs_tpu.storage.volume import NeedleNotFound
+    volume.write_needle(_needle(8, b"q" * 5000))
+    with pytest.raises(NeedleNotFound):
+        volume.needle_sendfile_extent(8, cookie=0xBEEF)

@@ -100,6 +100,20 @@ def test_observe_scope_pinned():
                 f"rule {name} no longer covers {path}"
 
 
+def test_sharded_scope_pinned():
+    """The shard runner is the one module that forks, owns a shared
+    mmap segment, and renders cross-process Prometheus lines by hand —
+    exactly the failure modes the async-blocking / resource-leak /
+    metric-label-registry / fork-then-asyncio guards exist for. A scope
+    edit that drops server/sharded.py from any of them silently
+    un-lints the fleet supervisor."""
+    for name in ("async-blocking-call", "resource-leak",
+                 "metric-label-registry", "fork-then-asyncio"):
+        rule = RULES[name]
+        assert rule.applies_to("seaweedfs_tpu/server/sharded.py"), \
+            f"rule {name} no longer covers seaweedfs_tpu/server/sharded.py"
+
+
 # ------------------------------------------------------- tree enforcement
 
 @pytest.fixture(scope="module")
