@@ -28,9 +28,13 @@ Recording is process-global state (the patches live in ``builtins`` and
 group-commit gathered write) is recorded as one ``write`` op per buffer
 at its computed offset — the crash sweep can therefore land BETWEEN
 records of a single group, which is exactly the torn-group window the
-``volume_group_commit`` workload exists to prove safe. ``sendfile``
-remains out of scope: it is a read-side syscall and carries no
-durability contract.
+``volume_group_commit`` workload exists to prove safe. ``os.writev``
+(the EC fan-out shard writers' coalesced append) is recorded the same
+way, at a per-fd cursor the recorder models for ``os.open`` handles —
+those writers are strict appenders (open O_TRUNC, never seek), which
+is the only position model the cursor implements. ``sendfile`` remains
+out of scope: it is a read-side syscall and carries no durability
+contract.
 """
 
 from __future__ import annotations
@@ -174,6 +178,7 @@ class DiskRecorder:
         self.baseline: dict[str, bytes] = {}
         self._lock = threading.Lock()
         self._fds: dict[int, str] = {}
+        self._fd_pos: dict[int, int] = {}   # os.open appenders' cursor
         self._orig: dict = {}
 
     # --- path helpers ---
@@ -204,6 +209,7 @@ class DiskRecorder:
     def unregister_fd(self, fd: int) -> None:
         with self._lock:
             self._fds.pop(fd, None)
+            self._fd_pos.pop(fd, None)
 
     def _snapshot_baseline(self) -> None:
         self.baseline = {}
@@ -227,7 +233,7 @@ class DiskRecorder:
             "rename": os.rename, "remove": os.remove,
             "unlink": os.unlink, "fsync": os.fsync,
             "fdatasync": os.fdatasync, "pwrite": os.pwrite,
-            "pwritev": os.pwritev,
+            "pwritev": os.pwritev, "writev": os.writev,
             "ftruncate": os.ftruncate, "truncate": os.truncate,
         }
 
@@ -250,11 +256,25 @@ class DiskRecorder:
             return _TracedFile(rec, f, rel, mode, existed)
 
         def p_os_open(path, flags, *a, **kw):
+            existed = isinstance(path, (str, os.PathLike)) \
+                and os.path.exists(path)
             fd = o["os_open"](path, flags, *a, **kw)
             rel = rec.rel(path) if isinstance(path, (str, os.PathLike)) \
                 else None
             if rel is not None:
                 rec.register_fd(fd, rel)
+                # the writev cursor: appenders either truncate (cursor
+                # 0) or O_APPEND onto the existing size; anything that
+                # seeks is outside the model (nothing in-tree does)
+                pos = 0
+                if existed and not flags & os.O_TRUNC \
+                        and flags & os.O_APPEND:
+                    try:
+                        pos = os.path.getsize(path)
+                    except OSError:
+                        pos = 0
+                with rec._lock:
+                    rec._fd_pos[fd] = pos
                 if flags & os.O_CREAT and flags & (os.O_WRONLY | os.O_RDWR):
                     rec.record("create", rel)
             return fd
@@ -310,6 +330,28 @@ class DiskRecorder:
                     off += len(b)
             return out
 
+        def p_writev(fd, buffers):
+            # materialize first (the recorded ops need stable copies);
+            # the kernel may write a prefix, so only `out` bytes are
+            # logged — the caller's retry loop re-enters with the rest
+            bufs = [_as_bytes(b) for b in buffers]
+            out = o["writev"](fd, bufs)
+            rel = rec._fds.get(fd)
+            if rel is not None and out > 0:
+                with rec._lock:
+                    off = rec._fd_pos.get(fd, 0)
+                remaining = out
+                for b in bufs:
+                    if remaining <= 0:
+                        break
+                    chunk = b[:remaining]
+                    rec.record("write", rel, offset=off, data=chunk)
+                    off += len(chunk)
+                    remaining -= len(chunk)
+                with rec._lock:
+                    rec._fd_pos[fd] = off
+            return out
+
         def p_ftruncate(fd, length):
             out = o["ftruncate"](fd, length)
             rel = rec._fds.get(fd)
@@ -337,6 +379,7 @@ class DiskRecorder:
         os.fdatasync = p_fsync
         os.pwrite = p_pwrite
         os.pwritev = p_pwritev
+        os.writev = p_writev
         os.ftruncate = p_ftruncate
         os.truncate = p_truncate
         return self
@@ -354,6 +397,7 @@ class DiskRecorder:
         os.fdatasync = o["fdatasync"]
         os.pwrite = o["pwrite"]
         os.pwritev = o["pwritev"]
+        os.writev = o["writev"]
         os.ftruncate = o["ftruncate"]
         os.truncate = o["truncate"]
         DiskRecorder._active = None

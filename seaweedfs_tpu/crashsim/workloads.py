@@ -12,6 +12,10 @@ Covered paths (the acceptance sweep spans all of them):
 - needle_map_flush    — DiskNeedleMap .idx journal + .sdx segment
                         (fingerprint adoption, torn-journal tolerance)
 - ec_encode           — shard files + the .ecm commit marker
+- fused_warmdown      — the one-pass warm-down (ec/fused.py) through
+                        staging + promote: a crash anywhere mid-pass
+                        leaves the source volume readable or a fully
+                        committed shard set — never neither
 - raft_snapshot       — raft/metalog state snapshots (term/vote/log/
                         snap_state through RaftNode._save_state)
 - offset_commit       — replication consume positions (FileQueueInput
@@ -316,6 +320,132 @@ def _make_ec_workload() -> CrashWorkload:
     return CrashWorkload("ec_encode", setup, run, recover, check)
 
 
+# -------------------------------------------------------- fused warm-down
+
+def _make_fused_warmdown_workload() -> CrashWorkload:
+    """The one-pass warm-down end to end: fused compact+gzip+RS+digest
+    into a staging base, then the store's promote (shards -> .ecx ->
+    .ecm marker LAST). The contract has two sides: the source volume's
+    needles are durable BEFORE the pass and the pass never writes a
+    source file, so they must read back CRC-clean after every crash;
+    and if a committed .ecm exists at the volume base, the full shard
+    set it vouches for must be present, byte-exact, and match the
+    digests the marker carries. Crash anywhere in the pass leaves the
+    source volume or a committed shard set — never neither."""
+    from ..ec.coder import NumpyCoder
+    from ..ec.geometry import Geometry, to_ext
+    from ..ec import fused as ec_fused
+    from ..storage.needle import Needle
+    from ..storage.store import Store
+    from ..storage.volume import Volume
+
+    g = Geometry(data_shards=3, parity_shards=2,
+                 large_block_size=8192, small_block_size=1024)
+    deleted = (4, 9, 14)
+    ctx: dict = {}
+
+    def _payload(nid: int) -> bytes:
+        if nid % 3 == 0:    # compressible: exercises the gzip splice
+            return (b"fused crashsim compressible text block. " * 64
+                    )[: 900 + nid * 13]
+        import random as random_mod          # incompressible: declined
+        r = random_mod.Random(nid)
+        return bytes(r.getrandbits(8)
+                     for _ in range(300 + (nid * 37) % 1200))
+
+    def setup(root):
+        v = Volume(root, "", 7, create=True)
+        for nid in range(1, 25):
+            v.write_needle(Needle(cookie=_COOKIE, id=nid,
+                                  data=_payload(nid)))
+        for nid in deleted:
+            v.delete_needle(Needle(cookie=_COOKIE, id=nid))
+        v.close()
+
+    def run(root, ack, rng):
+        v = Volume(root, "", 7)
+        # source side of the contract: durable before the pass starts,
+        # never written by it — must survive EVERY crash prefix
+        for nid in range(1, 25):
+            ack(f"src_n{nid}",
+                None if nid in deleted else _payload(nid))
+        base = os.path.join(root, "7")
+        staging = base + ".fusing"
+        coder = NumpyCoder(g.data_shards, g.parity_shards)
+        ec_fused.fused_vacuum_gzip_encode(v, staging, coder, g)
+        # the production promote, not a model of it (the method is
+        # self-free: pure renames in commit order)
+        Store._ec_fused_promote(None, base, staging, g)
+        ctx.clear()
+        for sid in range(g.total_shards):
+            with open(base + to_ext(sid), "rb") as f:
+                ctx[sid] = f.read()
+            ack(f"shard{sid}", ctx[sid])
+        with open(base + ".ecm") as f:
+            ctx["ecm"] = json.load(f)
+        ack("ecm", ctx["ecm"])
+        v.close()
+
+    def _read_src(vdir):
+        v = Volume(vdir, "", 7)
+        observed = {}
+        for nv in v.nm.values():
+            if nv.size > 0:
+                observed[f"src_n{nv.key}"] = v.read_needle(nv.key).data
+            else:
+                observed[f"src_n{nv.key}"] = None
+        v.close()
+        return observed
+
+    def recover(crash_dir):
+        observed = _read_src(crash_dir)
+        base = os.path.join(crash_dir, "7")
+        try:
+            with open(base + ".ecm") as f:
+                observed["ecm"] = json.load(f)
+        except (FileNotFoundError, ValueError):
+            pass   # absent/torn markers are check()'s business
+        for sid in range(g.total_shards):
+            try:
+                with open(base + to_ext(sid), "rb") as f:
+                    observed[f"shard{sid}"] = f.read()
+            except FileNotFoundError:
+                pass
+        return observed
+
+    def check(crash_dir, observed, expected):
+        # commit-marker invariant, acked or not: a base .ecm vouches
+        # for a COMPLETE, byte-exact, digest-matching shard set
+        base = os.path.join(crash_dir, "7")
+        out = []
+        if not os.path.exists(base + ".ecm"):
+            return out   # uncommitted: the (always-checked) source
+        try:                                 # volume is the truth
+            with open(base + ".ecm") as f:
+                meta = json.load(f)
+        except ValueError:
+            return [".ecm exists but is torn/unparseable "
+                    "(non-atomic marker commit)"]
+        if "layout_version" not in meta or "shard_digests" not in meta:
+            return [".ecm parsed but incomplete (torn marker)"]
+        for sid in range(g.total_shards):
+            got = observed.get(f"shard{sid}")
+            if got is None:
+                out.append(f".ecm committed but shard {sid} is missing")
+                continue
+            if ctx and got != ctx.get(sid):
+                out.append(f".ecm committed but shard {sid} bytes "
+                           f"diverge (un-synced shard pages dropped)")
+            want = meta["shard_digests"].get(str(sid))
+            have = sum(got) & 0xFFFFFFFF
+            if want is not None and have != want:
+                out.append(f".ecm digest for shard {sid} is {want} "
+                           f"but the bytes sum to {have}")
+        return out
+
+    return CrashWorkload("fused_warmdown", setup, run, recover, check)
+
+
 # -------------------------------------------------------- raft snapshot
 
 def _make_raft_workload() -> CrashWorkload:
@@ -477,6 +607,7 @@ def registry() -> list:
         _make_group_commit_workload(),
         _make_needle_map_workload(),
         _make_ec_workload(),
+        _make_fused_warmdown_workload(),
         _make_raft_workload(),
         _make_offset_workload(),
         _make_filer_kv_workload(),

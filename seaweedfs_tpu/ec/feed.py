@@ -255,6 +255,47 @@ class _ReaderPool:
                 item[1].job_done(RuntimeError("feed closed"))
 
 
+def ordered_pool_map(pool: "_ReaderPool", fns: "Iterator[Callable]",
+                     lookahead: int):
+    """Run zero-arg callables on a reader pool, yielding their results
+    strictly in submission order while up to ``lookahead`` later calls
+    execute concurrently — the same ordered-window discipline as
+    ``_FeedBase._ordered_parallel``, for work that isn't a stripe batch
+    (the fused warm-down's compaction-filter chunks ride this). The
+    first job error is re-raised at its in-order yield position; the
+    ``finally`` waits the in-flight tail out (``_ReaderPool.close``
+    fails unrun jobs, so the wait always terminates)."""
+    window: deque = deque()
+    it = iter(fns)
+    exhausted = False
+    try:
+        while True:
+            while not exhausted and len(window) <= max(int(lookahead), 0):
+                fn = next(it, None)
+                if fn is None:
+                    exhausted = True
+                    break
+                slot: list = [None]
+                pend = _Pending(None, None, 1)
+
+                def job(fn=fn, slot=slot):
+                    slot[0] = fn()
+
+                pool.submit(job, pend)
+                window.append((slot, pend))
+            if not window:
+                return
+            slot, pend = window.popleft()
+            pend.event.wait()
+            if pend.errors:
+                raise pend.errors[0]
+            yield slot[0]
+    finally:
+        while window:
+            _, pend = window.popleft()
+            pend.event.wait()
+
+
 _PLANS_DONE = object()
 
 

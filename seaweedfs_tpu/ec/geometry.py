@@ -117,9 +117,18 @@ class GeometryPolicy:
                 mapping[name] = g
         return cls(mapping, default)
 
+    # what an unset WEED_EC_GEOMETRY ships: RS(10,4) for everything,
+    # except the archive collection at RS(20,4) — wide geometries are
+    # where the fused warm-down's economics land (the kernel amortizes
+    # expand/repack over k, parity overhead drops 40% -> 20%, and the
+    # durability profile — any 4 of 24 lost — is one archival data is
+    # happy with). Operators override the whole policy with the env.
+    DEFAULT_SPEC = "default=10+4,archive=20+4"
+
     @classmethod
     def from_env(cls) -> "GeometryPolicy":
-        return cls.parse(os.environ.get("WEED_EC_GEOMETRY", ""))
+        return cls.parse(os.environ.get("WEED_EC_GEOMETRY",
+                                        cls.DEFAULT_SPEC))
 
     def for_collection(self, collection: str = "") -> Geometry:
         return self.per_collection.get(collection or "", self.default)

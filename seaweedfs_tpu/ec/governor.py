@@ -34,6 +34,8 @@ Env knobs (all optional):
   WEED_EC_HOST_BUDGET_MB        pooled staging budget (512 MiB)
   WEED_EC_READERS               starting reader-pool width (cores, <=4)
   WEED_EC_READERS_MIN/MAX       reader bounds         (1 / min(8, cores))
+  WEED_EC_GZIP_WORKERS          fused compaction/gzip pool (cores, <=4)
+  WEED_EC_GZIP_MIN/MAX          gzip-worker bounds    (1 / min(8, cores))
   WEED_EC_MMAP=0                force the preadv feed (see ec/feed.py)
   WEED_EC_ODIRECT=1             page-cache-bypassing reads (ec/feed.py)
 """
@@ -65,6 +67,7 @@ class OperatingPoint(NamedTuple):
     write_depth: int  # per-shard-file writer queue depth
     readers: int = 1  # feed reader-pool width (ec/feed.py)
     chips: int = 1    # device-mesh width (parallel/mesh_coder.py)
+    gzip_workers: int = 1  # fused warm-down compaction/gzip pool (ec/fused.py)
 
 
 # per-batch read time below this is dispatch/syscall-overhead-dominated:
@@ -89,6 +92,9 @@ class FeedGovernor:
         self.readers_min = _env_int("WEED_EC_READERS_MIN", 1)
         self.readers_max = _env_int(
             "WEED_EC_READERS_MAX", max(1, min(8, os.cpu_count() or 1)))
+        self.gzip_min = _env_int("WEED_EC_GZIP_MIN", 1)
+        self.gzip_max = _env_int(
+            "WEED_EC_GZIP_MAX", max(1, min(8, os.cpu_count() or 1)))
         self._batch = min(max(_env_int("WEED_EC_BATCH_BYTES", 8 * MB),
                               self.batch_min), self.batch_max)
         self._depth = min(max(_env_int("WEED_EC_DEPTH", 4),
@@ -96,6 +102,9 @@ class FeedGovernor:
         self._write_depth = self._depth
         self._readers = min(max(feed_mod.reader_count_default(),
                                 self.readers_min), self.readers_max)
+        self._gzip_workers = min(
+            max(feed_mod.env_thread_count("WEED_EC_GZIP_WORKERS", 64),
+                self.gzip_min), self.gzip_max)
         self.metrics = metrics_mod.shared("ec")
         self.stage_gbps: dict[str, float] = {}
         self.runs = 0
@@ -127,14 +136,19 @@ class FeedGovernor:
                 else:
                     break
             op = OperatingPoint(batch, depth, self._write_depth,
-                                self._readers, max(chips, 1))
+                                self._readers, max(chips, 1),
+                                self._gzip_workers)
             self._export(op)
             return op
 
     # --- measurement + retune ---
 
     _STAGES = {"read": "ec.read", "dispatch": "ec.dispatch",
-               "kernel": "ec.kernel", "write": "ec.write"}
+               "kernel": "ec.kernel", "write": "ec.write",
+               # fused warm-down stages (ec/fused.py): compaction-filter
+               # reads+splices, payload deflate, inline shard digests
+               "compact": "ec.compact", "gzip": "ec.gzip",
+               "digest": "ec.digest"}
 
     def finish_run(self, trace_id: str, op: OperatingPoint,
                    nbytes: int, k: int) -> None:
@@ -169,7 +183,7 @@ class FeedGovernor:
                 self._retune(stages, op)
             self._export(OperatingPoint(self._batch, self._depth,
                                         self._write_depth, self._readers,
-                                        op.chips))
+                                        op.chips, self._gzip_workers))
 
     def _retune(self, stages: dict[str, tuple[int, float]],
                 op: OperatingPoint) -> None:
@@ -208,6 +222,14 @@ class FeedGovernor:
                 # the chip is the slow stage: keep more host batches
                 # queued so it never waits on the feed
                 self._depth = min(op.depth + 1, self.depth_max)
+        elif slowest in ("gzip", "compact"):
+            if share > _BIND_FRACTION and op.gzip_workers < self.gzip_max:
+                # the fused pass is host-compaction/deflate-bound: widen
+                # the chunk-job pool — deflate and preads both release
+                # the GIL, so extra workers add real cores when the box
+                # has them (a 1-core container stays at 1)
+                self._gzip_workers = min(max(op.gzip_workers * 2, 2),
+                                         self.gzip_max)
         elif slowest == "write":
             if share > _BIND_FRACTION:
                 # deeper writer queues absorb disk jitter without
@@ -229,6 +251,7 @@ class FeedGovernor:
                            labels={"queue": "write"})
         self.metrics.gauge("feed_reader_threads", op.readers)
         self.metrics.gauge("feed_mesh_devices", op.chips)
+        self.metrics.gauge("feed_gzip_workers", op.gzip_workers)
         self.metrics.gauge("feed_governor_enabled", 1.0 if self.enabled
                            else 0.0)
         self.metrics.gauge("feed_runs", self.runs)

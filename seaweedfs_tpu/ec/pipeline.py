@@ -332,6 +332,55 @@ def _run_pipeline(batches: Iterator[np.ndarray], dispatch, consume,
         raise errors[0]
 
 
+def _stream_encode_core(batches: Iterator[np.ndarray], coder: ErasureCoder,
+                        shard_paths: Sequence[str],
+                        op: "governor.OperatingPoint",
+                        tctx: "observe.TraceCtx",
+                        recycle=None,
+                        digests: "np.ndarray | None" = None) -> None:
+    """The encode engine shared by stream_encode and the fused warm-down
+    (ec/fused.py): host batches -> async dispatch -> materialize -> one
+    writer thread per shard file. Returns with every shard file written
+    AND fsynced; writes NO .ecm marker — committing the set is the
+    caller's decision (the fused path orders the marker after its own
+    .dat/.idx/.ecx finalization).
+
+    `digests` (uint64[total_shards]) accumulates each shard row's
+    wrapping byte-sum inline while the rows stream through — the
+    scrubber's reference digest comes out of the encode pass itself and
+    the host never re-reads the fresh shards to compute it."""
+    fan = _FanOut(list(shard_paths), op.write_depth)
+
+    def consume(data: np.ndarray, handle) -> None:
+        from ..observe.profiler import trace_annotation
+        with observe.stage("ec.kernel", tctx), \
+                trace_annotation("ec_pipeline_kernel_wait"):
+            parity = coder.materialize(handle)
+        rows = [*data, *parity]
+        if digests is not None:
+            with observe.stage("ec.digest", tctx):
+                for i, row in enumerate(rows):
+                    digests[i] += np.sum(row, dtype=np.uint64)
+        with observe.stage("ec.write", tctx):
+            # data rows are written straight from the host batch (a
+            # page-cache view or a pooled staging buffer); the buffer
+            # recycles only after every row has been handed off
+            cb = None
+            if recycle is not None:
+                cb = (lambda b=data: recycle(b))
+            fan.put_rows(iter(rows), on_done=cb)
+
+    try:
+        _run_pipeline(
+            _traced_batches(batches, tctx),
+            coder.encode_async, consume, op.depth, trace_ctx=tctx,
+            recycle=recycle)
+    finally:
+        fan.close()
+    if fan.errors:
+        raise fan.errors[0]
+
+
 def stream_encode(base_file_name: str, coder: ErasureCoder,
                   geometry: Geometry = DEFAULT,
                   batch_size: Optional[int] = None,
@@ -358,39 +407,23 @@ def stream_encode(base_file_name: str, coder: ErasureCoder,
     src = feed_mod.open_feed(base_file_name + ".dat", g.data_shards,
                              op.batch_size, pool_buffers=op.depth + 2,
                              readers=op.readers)
-    fan = _FanOut([base_file_name + to_ext(i) for i in range(g.total_shards)],
-                  op.write_depth)
     # per-stage spans share the caller's trace (volume server passes its
     # request context into this thread via observe.run_with); a fresh
     # root is minted when none is active (CLI/bench encodes)
     tctx = observe.ensure_ctx("ec")
-
-    def consume(data: np.ndarray, handle) -> None:
-        from ..observe.profiler import trace_annotation
-        with observe.stage("ec.kernel", tctx), \
-                trace_annotation("ec_pipeline_kernel_wait"):
-            parity = coder.materialize(handle)
-        with observe.stage("ec.write", tctx):
-            # data rows are written straight from the host batch (a
-            # page-cache view or a pooled staging buffer); the buffer
-            # recycles only after every row has been handed off
-            fan.put_rows(iter([*data, *parity]),
-                         on_done=lambda b=data: src.recycle(b))
-
+    digests = np.zeros(g.total_shards, dtype=np.uint64)
     try:
-        _run_pipeline(
-            _traced_batches(
-                src.batches(stripe_segments(dat_size, g, op.batch_size)),
-                tctx),
-            coder.encode_async, consume, op.depth, trace_ctx=tctx,
-            recycle=src.recycle)
+        _stream_encode_core(
+            src.batches(stripe_segments(dat_size, g, op.batch_size)),
+            coder, [base_file_name + to_ext(i)
+                    for i in range(g.total_shards)],
+            op, tctx, recycle=src.recycle, digests=digests)
     finally:
-        fan.close()
         src.close()
-    if fan.errors:
-        raise fan.errors[0]
     from .striping import write_layout_marker
-    write_layout_marker(base_file_name, dat_size, g)
+    write_layout_marker(base_file_name, dat_size, g,
+                        shard_digests={i: int(digests[i]) & 0xFFFFFFFF
+                                       for i in range(g.total_shards)})
     if governed:
         governor.get().finish_run(tctx.trace_id, op, dat_size,
                                   g.data_shards)
@@ -768,11 +801,20 @@ def stamp_shard_digests(base_file_name: str,
         return {}
     digests = {int(k): int(v)
                for k, v in (meta.get("shard_digests") or {}).items()}
+    from ..utils import metrics as metrics_mod
+    recomputed = 0
     for sid in range(geometry.total_shards):
         if sid in digests or not os.path.exists(
                 base_file_name + to_ext(sid)):
             continue
         digests[sid] = int(shard_file_digest(base_file_name, [sid])[0])
+        recomputed += 1
+    if recomputed:
+        # encode passes that stamp digests inline (stream_encode, the
+        # fused warm-down) leave nothing to recompute; this counter is
+        # how the bench proves "scrubber re-digest count 0"
+        metrics_mod.shared("ec").count("ec_digest_host_recompute",
+                                       recomputed)
     meta["shard_digests"] = {str(k): v
                              for k, v in sorted(digests.items())}
     durable.write_json_atomic(path, meta)

@@ -151,7 +151,9 @@ LAYOUT_VERSION = 2  # padded-final-large-row tail rule (see write_ec_files)
 
 
 def write_layout_marker(base_file_name: str, dat_size: int,
-                        geometry: Optional[Geometry] = None) -> None:
+                        geometry: Optional[Geometry] = None,
+                        shard_digests: "Optional[dict[int, int]]" = None
+                        ) -> None:
     """Record the striping layout version — and, round 10 on, the RS
     geometry the shards were encoded under — in a .ecm sidecar so a
     shard set encoded under the PRE-round-3 tail rule (small rows where
@@ -159,7 +161,13 @@ def write_layout_marker(base_file_name: str, dat_size: int,
     silently misaddressing, and so rebuild/mount/decode never have to
     consult the (mutable) cluster geometry policy: the geometry travels
     with the shards. The marker is a sidecar — shard bytes stay
-    bit-exact vs the reference's own fixture."""
+    bit-exact vs the reference's own fixture.
+
+    `shard_digests` ({shard id: uint32 wrapping byte-sum}) stamps the
+    scrubber's reference digests in the SAME commit: pipelines that
+    accumulate digests while the rows stream through (stream_encode, the
+    fused warm-down) establish the truth at encode time and the host
+    never re-reads the fresh shards to digest them."""
     import json as json_mod
     meta: dict = {"layout_version": LAYOUT_VERSION, "dat_size": dat_size}
     if geometry is not None:
@@ -169,6 +177,9 @@ def write_layout_marker(base_file_name: str, dat_size: int,
             "large_block_size": geometry.large_block_size,
             "small_block_size": geometry.small_block_size,
         }
+    if shard_digests:
+        meta["shard_digests"] = {str(k): int(v) & 0xFFFFFFFF
+                                 for k, v in sorted(shard_digests.items())}
     # durable commit point of the whole shard set (see write_ec_files)
     durable.write_json_atomic(base_file_name + ".ecm", meta)
 

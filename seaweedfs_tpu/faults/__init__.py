@@ -126,6 +126,16 @@ KNOWN_POINTS = frozenset({
     "disk.sync",            # DiskFile.sync fsync barrier — error =
                             # fsync failure (crash-consistency drills
                             # crash "at" a named barrier by erroring it)
+    "ec.fused.read",        # fused warm-down compaction-chunk reads
+                            # (ec/fused.py) — drop FAILS the chunk
+                            # (skipping live extents would compact
+                            # acked needles away)
+    "ec.fused.gzip",        # fused warm-down payload transform — drop
+                            # fails the gzip/splice stage
+    "ec.fused.commit",      # fused warm-down commit barrier, fired
+                            # after shards/.dat/.idx/.ecx are durable
+                            # and BEFORE the .ecm marker — the crash
+                            # window the crashsim workload walks
 })
 
 _lock = threading.Lock()

@@ -66,38 +66,45 @@ class _EncodeBatcher:
                 1, int(os.environ.get("WEED_EC_ENCODE_WINDOW", "8")))
         except ValueError:
             self.max_window = 8
-        # source url -> [(vid, future)] awaiting the next window
-        self._waiting: dict[str, list] = {}
+        # (source url, fused?) -> [(vid, future)] awaiting the next
+        # window — fused warm-downs window SEPARATELY from plain
+        # encodes: they hit a different endpoint (ec/fused) and a mixed
+        # window would force half the batch through the wrong pass
+        self._waiting: dict[tuple, list] = {}
 
-    async def encode(self, source: str, vid: int) -> None:
+    async def encode(self, source: str, vid: int,
+                     fused: bool = False) -> None:
         fut = asyncio.get_event_loop().create_future()
-        batch = self._waiting.setdefault(source, [])
+        key = (source, fused)
+        batch = self._waiting.setdefault(key, [])
         batch.append((vid, fut))
         if len(batch) >= self.max_window:
-            self._waiting.pop(source, None)
-            await self._post(source, batch)
+            self._waiting.pop(key, None)
+            await self._post(key, batch)
         elif len(batch) == 1:
-            task = asyncio.create_task(self._flush_after(source, batch))
+            task = asyncio.create_task(self._flush_after(key, batch))
             self.daemon._tasks.add(task)
             task.add_done_callback(self.daemon._tasks.discard)
         await fut
 
-    async def _flush_after(self, source: str, batch: list) -> None:
+    async def _flush_after(self, key: tuple, batch: list) -> None:
         await asyncio.sleep(self.linger)
         # flush only OUR batch: if a full window already flushed it (and
         # a newer batch is forming under the same source), this stale
         # linger must not fire the newer batch early
-        if self._waiting.get(source) is batch:
-            self._waiting.pop(source, None)
-            await self._post(source, batch)
+        if self._waiting.get(key) is batch:
+            self._waiting.pop(key, None)
+            await self._post(key, batch)
 
-    async def _post(self, source: str, batch: list) -> None:
+    async def _post(self, key: tuple, batch: list) -> None:
+        source, fused = key
         vids = [vid for vid, _ in batch]
         body = ({"volume_id": vids[0]} if len(vids) == 1
                 else {"volume_ids": vids})
         try:
             await self.daemon.master._admin_post(
-                source, "ec/generate", body, timeout=900.0 * len(vids))
+                source, "ec/fused" if fused else "ec/generate", body,
+                timeout=900.0 * len(vids))
         except Exception as e:
             for _, fut in batch:
                 if not fut.done():
@@ -300,23 +307,37 @@ class LifecycleDaemon:
                                      {"volume_id": vid,
                                       "read_only": True})
         source = holders[0]
-        # 2. vacuum when compaction would actually shrink the .dat —
-        #    encoding tombstoned bytes into 14 shards wastes the tier
-        try:
-            garbage = (await self._get_json(
-                source, f"/admin/vacuum/check?volume_id={vid}")
-            )["garbage_level"]
-        except Exception:
-            garbage = 0.0
-        if garbage > 0.01:
-            await master._admin_post(source, "vacuum",
-                                     {"volume_id": vid}, timeout=600.0)
-        # 3. encode on the source through the governed EC feed — via the
-        #    encode batcher, so a burst of warm transitions sharing a
-        #    source streams as ONE multi-volume window through a single
-        #    governed executable (store.ec_generate_many)
-        self._check_leader()
-        await self._encode_batcher.encode(source, vid)
+        if self.cfg.ec_fused:
+            # 2+3 fused (WEED_EC_FUSED, default on): the one-pass
+            # warm-down compacts, gzips, encodes and digests in a
+            # single governed pass on the source (ec/fused.py via
+            # store.ec_fused_generate) — no separate vacuum round-trip,
+            # and the shard set holds the compacted volume either way.
+            # Same verify-then-retire discipline below: the source
+            # volume survives untouched until 14/14 mounted shards are
+            # read back.
+            self._check_leader()
+            await self._encode_batcher.encode(source, vid, fused=True)
+        else:
+            # 2. vacuum when compaction would actually shrink the .dat —
+            #    encoding tombstoned bytes into 14 shards wastes the tier
+            try:
+                garbage = (await self._get_json(
+                    source, f"/admin/vacuum/check?volume_id={vid}")
+                )["garbage_level"]
+            except Exception:
+                garbage = 0.0
+            if garbage > 0.01:
+                await master._admin_post(source, "vacuum",
+                                         {"volume_id": vid},
+                                         timeout=600.0)
+            # 3. encode on the source through the governed EC feed —
+            #    via the encode batcher, so a burst of warm transitions
+            #    sharing a source streams as ONE multi-volume window
+            #    through a single governed executable
+            #    (store.ec_generate_many)
+            self._check_leader()
+            await self._encode_batcher.encode(source, vid)
         # 4. spread with the same balanced plan the ec.encode shell uses
         from ..shell.ec_commands import collect_ec_nodes, plan_shard_spread
         nodes = collect_ec_nodes(master.topology.to_dict())

@@ -75,6 +75,10 @@ class LifecycleConfig:
     scan_limit: int = 10000          # WEED_LIFECYCLE_S3_SCAN_LIMIT
     heat_export_top: int = 64        # WEED_LIFECYCLE_HEAT_EXPORT_TOP
     force_enabled: Optional[bool] = None  # WEED_LIFECYCLE_ENABLED override
+    # WEED_EC_FUSED (default on): warm transitions use the one-pass
+    # fused warm-down (compact + gzip + encode + digest, ec/fused.py)
+    # instead of the chained vacuum -> ec/generate steps
+    ec_fused: bool = True
 
     @property
     def enabled(self) -> bool:
@@ -113,6 +117,8 @@ class LifecycleConfig:
                 env.get("WEED_LIFECYCLE_HEAT_EXPORT_TOP", "64") or 64),
             force_enabled=(None if force == ""
                            else force not in ("0", "false", "no")),
+            ec_fused=env.get("WEED_EC_FUSED", "1") not in ("0", "false",
+                                                           "no"),
         )
 
 

@@ -371,6 +371,7 @@ class VolumeServer:
         app.router.add_post("/admin/tier/upload", self.admin_tier_upload)
         app.router.add_post("/admin/tier/download", self.admin_tier_download)
         app.router.add_post("/admin/ec/generate", self.admin_ec_generate)
+        app.router.add_post("/admin/ec/fused", self.admin_ec_fused)
         app.router.add_post("/admin/ec/mount", self.admin_ec_mount)
         app.router.add_post("/admin/ec/unmount", self.admin_ec_unmount)
         app.router.add_post("/admin/ec/rebuild", self.admin_ec_rebuild)
@@ -1381,31 +1382,49 @@ class VolumeServer:
         form streams every volume through one governed executable
         back-to-back (store.ec_generate_many), which is how the
         lifecycle daemon's encode queue amortizes compiles + program
-        loads across a whole batch of sealed volumes."""
+        loads across a whole batch of sealed volumes. ``"fused": true``
+        (or the /admin/ec/fused route) runs the one-pass warm-down
+        instead: compaction + gzip + encode + digests fused
+        (store.ec_fused_generate), so the shard set holds the COMPACTED
+        volume and no separate vacuum precedes the encode."""
         body = await request.json()
+        return await self._ec_generate_impl(
+            body, fused=bool(body.get("fused", False)))
+
+    async def admin_ec_fused(self, request: web.Request) -> web.Response:
+        """The one-pass warm-down route (always fused)."""
+        return await self._ec_generate_impl(await request.json(),
+                                            fused=True)
+
+    async def _ec_generate_impl(self, body: dict,
+                                fused: bool) -> web.Response:
         vids = ([int(v) for v in body["volume_ids"]]
                 if "volume_ids" in body else [int(body["volume_id"])])
         if not vids:
             return web.json_response({"error": "empty volume_ids"},
                                      status=400)
+        gen_one = (self.store.ec_fused_generate if fused
+                   else self.store.ec_generate)
+        gen_many = (self.store.ec_fused_generate_many if fused
+                    else self.store.ec_generate_many)
         tctx = observe.capture()
         try:
             if len(vids) == 1:
                 shards = await asyncio.get_event_loop().run_in_executor(
                     None, lambda: observe.run_with(
-                        tctx, self.store.ec_generate, vids[0]))
+                        tctx, gen_one, vids[0]))
                 per_volume = {str(vids[0]): shards}
             else:
                 per_volume_raw = await asyncio.get_event_loop() \
                     .run_in_executor(
                         None, lambda: observe.run_with(
-                            tctx, self.store.ec_generate_many, vids))
+                            tctx, gen_many, vids))
                 per_volume = {str(k): v for k, v in per_volume_raw.items()}
                 shards = per_volume.get(str(vids[0]), [])
         except KeyError as e:
             return web.json_response({"error": str(e)}, status=404)
         return web.json_response({"ok": True, "shards": shards,
-                                  "volumes": per_volume})
+                                  "fused": fused, "volumes": per_volume})
 
     async def admin_ec_mount(self, request: web.Request) -> web.Response:
         body = await request.json()

@@ -159,14 +159,19 @@ class EcCommands:
                                 else self.client.dir_status())
 
     def encode(self, vid: int, collection: str = "",
-               apply: bool = True) -> dict:
+               apply: bool = True, fused: bool = False) -> dict:
         """ec.encode one volume (doEcEncode, command_ec_encode.go:92-158):
         mark readonly -> generate on source -> spread -> mount -> delete
-        original."""
-        return self.encode_many([vid], collection, apply=apply)
+        original. fused=True runs the one-pass warm-down instead of a
+        plain encode: the source compacts + gzips + encodes + digests in
+        a single governed pass (ec/fused), so the shard set holds the
+        compacted volume and no vacuum needs to precede the encode."""
+        return self.encode_many([vid], collection, apply=apply,
+                                fused=fused)
 
     def encode_many(self, vids: list[int], collection: str = "",
-                    apply: bool = True, parallel: int = 1) -> dict:
+                    apply: bool = True, parallel: int = 1,
+                    fused: bool = False) -> dict:
         """ec.encode a WINDOW of volumes: every volume sharing a source
         is generated in ONE multi-volume `ec/generate` call, so the
         volume server streams the batch through a single governed
@@ -203,7 +208,7 @@ class EcCommands:
 
         def run_source(source: str, svids: list[int]) -> None:
             self.client.volume_admin(
-                source, "ec/generate",
+                source, "ec/fused" if fused else "ec/generate",
                 {"volume_id": svids[0]} if len(svids) == 1
                 else {"volume_ids": svids})
             for vid in svids:

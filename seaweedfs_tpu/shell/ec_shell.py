@@ -32,6 +32,28 @@ def ec_encode(env: CommandEnv, argv: list[str]):
                           parallel=args.parallel)
 
 
+@command("ec.warmdown",
+         "one-pass warm-down (ec.warmdown -volumeId N[,N2,...] "
+         "[-collection c] [-parallel N] [-dryRun]) — compaction + gzip "
+         "+ RS encode + shard digests fused into a single governed "
+         "pass on each source (ec/fused); otherwise the same "
+         "spread/mount/retire flow as ec.encode", destructive=True)
+def ec_warmdown(env: CommandEnv, argv: list[str]):
+    p = parser("ec.warmdown")
+    p.add_argument("-volumeId", required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-parallel", type=int, default=1)
+    p.add_argument("-dryRun", action="store_true")
+    args = p.parse_args(argv)
+    vids = [int(v) for v in str(args.volumeId).split(",") if v]
+    ec = _ec(env)
+    if len(vids) == 1:
+        return ec.encode(vids[0], args.collection, apply=not args.dryRun,
+                         fused=True)
+    return ec.encode_many(vids, args.collection, apply=not args.dryRun,
+                          parallel=args.parallel, fused=True)
+
+
 @command("ec.rebuild",
          "rebuild missing EC shards (ec.rebuild -volumeId N "
          "[-collection c] [-dryRun])", destructive=True)

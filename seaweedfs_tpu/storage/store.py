@@ -14,7 +14,9 @@ import threading
 from typing import Optional
 
 from .. import ec as ec_mod
+from ..ec import fused as ec_fused
 from ..ec import pipeline as ec_pipeline
+from ..utils import durable
 from ..ec.coder import ErasureCoder
 from ..ec.ec_volume import EcVolume
 from . import types as t
@@ -540,6 +542,94 @@ class Store:
             # sealed with nothing to show for it: lift seals we applied
             # on volumes whose encode never completed (stream_encode
             # writes the .ecm marker only at the end of each volume)
+            for v, base, was_read_only in sealed:
+                if not was_read_only and not os.path.exists(base + ".ecm"):
+                    v.read_only = False
+            raise
+        return out
+
+    # --- fused warm-down: compact + gzip + RS + digest in one pass ---
+
+    def _ec_fused_promote(self, base: str, staging: str,
+                          g: ec_mod.Geometry) -> None:
+        """Move a completed fused pass's shard set from its staging base
+        to the volume's base. Every staged file is already fsynced (the
+        fused pass orders its own durability), so promotion is renames:
+        shards first, then .ecx, and the .ecm marker LAST — the marker
+        rename is the commit point that makes the set mountable. The
+        compacted .dat/.idx were only the encode vehicle (EC reads ride
+        shards + .ecx; un-EC rebuilds a .dat from shards) and are
+        dropped; the SOURCE volume files are untouched, so the PR 7
+        verify-then-retire discipline still holds: until the lifecycle
+        daemon verifies mounted shards and retires the original, both
+        copies exist."""
+        for i in range(g.total_shards):
+            durable.replace_atomic(staging + ec_mod.to_ext(i),
+                                   base + ec_mod.to_ext(i))
+        durable.replace_atomic(staging + ".ecx", base + ".ecx")
+        for ext in (".dat", ".idx"):
+            try:
+                os.remove(staging + ext)
+            except OSError:
+                pass
+        durable.replace_atomic(staging + ".ecm", base + ".ecm")
+
+    def _ec_fused_clean_staging(self, base: str,
+                                g: ec_mod.Geometry) -> None:
+        """Drop stale staging files a crashed prior pass left behind
+        (they are uncommitted by construction — no .ecm at the volume
+        base — so a re-run just starts over)."""
+        staging = base + ".fusing"
+        for ext in ([".dat", ".idx", ".ecx", ".ecm"]
+                    + [ec_mod.to_ext(i) for i in range(g.total_shards)]):
+            try:
+                os.remove(staging + ext)
+            except OSError:
+                pass
+
+    def ec_fused_generate(self, vid: int) -> list[int]:
+        """One-pass warm-down (ec/fused.py): compaction, payload gzip,
+        RS encode and shard digests in a single fused pass — the shard
+        set encodes the COMPACTED volume, so tombstoned bytes never
+        reach the archive tier and no separate vacuum precedes the
+        encode. Output promotes to the volume base only after the whole
+        pass is durable."""
+        v, base, g = self._ec_seal(vid)
+        self._ec_fused_clean_staging(base, g)
+        staging = base + ".fusing"
+        ec_fused.fused_vacuum_gzip_encode(v, staging, self.coder(g), g)
+        self._ec_fused_promote(base, staging, g)
+        return list(range(g.total_shards))
+
+    def ec_fused_generate_many(self, vids: list[int]) -> dict[int,
+                                                              list[int]]:
+        """Fused warm-down for a WINDOW of volumes: one governed
+        operating point (and one compiled [k, B] executable) per
+        geometry group — the fused twin of ec_generate_many."""
+        absent = [vid for vid in vids if self.find_volume(vid) is None]
+        if absent:
+            raise KeyError(f"volume(s) {absent} not found")
+        by_geometry: dict[ec_mod.Geometry, list] = {}
+        sealed: list = []
+        for vid in vids:
+            was_read_only = self.find_volume(vid).read_only
+            v, base, g = self._ec_seal(vid)
+            self._ec_fused_clean_staging(base, g)
+            by_geometry.setdefault(g, []).append((vid, v, base))
+            sealed.append((v, base, was_read_only))
+        out: dict[int, list[int]] = {}
+        try:
+            for g, items in by_geometry.items():
+                ec_fused.fused_vacuum_gzip_encode_many(
+                    [v for _, v, _ in items],
+                    [base + ".fusing" for _, _, base in items],
+                    self.coder(g), g)
+                for vid, v, base in items:
+                    self._ec_fused_promote(base, base + ".fusing", g)
+                    out[vid] = list(range(g.total_shards))
+        except BaseException:
+            # mirror ec_generate_many: volumes whose shard set never
+            # committed get their seal lifted so the batch can retry
             for v, base, was_read_only in sealed:
                 if not was_read_only and not os.path.exists(base + ".ecm"):
                     v.read_only = False

@@ -1,0 +1,343 @@
+"""One-pass warm-down (ec/fused.py): byte-identity against the
+sequential vacuum -> gzip -> encode chain, the gated incremental
+layout, fail-closed fault handling, the store promote, and the
+governor's gzip-worker axis.
+
+The identity tests are the contract that lets the fused pass replace
+the chained path everywhere: the compacted .dat/.idx, the sorted .ecx,
+every shard file and the .ecm digests must match what the serial
+pipeline produces, byte for byte, across geometries, odd needle sizes,
+gzip-declined payloads, zero-live volumes and multi-worker pools.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu import faults
+from seaweedfs_tpu.ec import get_coder, striping
+from seaweedfs_tpu.ec import governor
+from seaweedfs_tpu.ec.fused import (_Watermark, _gated_segments,
+                                    fused_vacuum_gzip_encode)
+from seaweedfs_tpu.ec.geometry import Geometry, to_ext
+from seaweedfs_tpu.ec.pipeline import (read_stamped_digests,
+                                       shard_file_digest, stream_encode)
+from seaweedfs_tpu.storage import idx as idx_mod
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.storage.needle import (FLAG_HAS_MIME, FLAG_HAS_NAME,
+                                          FLAG_IS_COMPRESSED, Needle)
+from seaweedfs_tpu.storage.superblock import SuperBlock
+from seaweedfs_tpu.storage.volume import Volume
+from seaweedfs_tpu.utils import compression
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    faults.clear()
+    governor.reset()
+    yield
+    faults.clear()
+    governor.reset()
+
+
+# ------------------------------------------------ the serial reference
+
+def sequential_reference(volume, dst_base, coder, g, gzip_level=1):
+    """The chained path the fused pass replaces: compact + gzip into
+    dst via per-needle reads, then stream_encode, then the sorted .ecx
+    — reimplemented here (not imported) so a regression in EITHER path
+    breaks the comparison instead of moving both sides."""
+    with volume._lock:
+        snapshot = [nv for nv in volume.nm.values()
+                    if t.size_is_valid(nv.size)]
+        sb = SuperBlock(
+            version=volume.super_block.version,
+            replica_placement=volume.super_block.replica_placement,
+            ttl=volume.super_block.ttl,
+            compaction_revision=(volume.super_block.compaction_revision
+                                 + 1),
+            extra=volume.super_block.extra)
+    snapshot.sort(key=lambda nv: nv.offset)
+    with open(dst_base + ".dat", "wb") as dat, \
+            open(dst_base + ".idx", "wb") as idx:
+        dat.write(sb.to_bytes())
+        offset = len(sb.to_bytes())
+        for nv in snapshot:
+            n = volume.read_needle_at(t.stored_to_offset(nv.offset),
+                                      nv.size)
+            if n.data and not n.is_compressed \
+                    and volume.version != t.VERSION1:
+                head = n.data[:4096]
+                trial = compression.compress(head, level=gzip_level)
+                if len(trial) * 10 < len(head) * 9:
+                    comp = compression.compress(n.data, level=gzip_level)
+                    if len(comp) * 10 < len(n.data) * 9:
+                        n.data = comp
+                        n.set_flag(FLAG_IS_COMPRESSED)
+            record = n.to_bytes(volume.version)
+            if offset % t.NEEDLE_PADDING_SIZE:
+                pad = (-offset) % t.NEEDLE_PADDING_SIZE
+                dat.write(bytes(pad))
+                offset += pad
+            dat.write(record)
+            idx.write(idx_mod.pack_entry(
+                nv.key, t.offset_to_stored(offset, volume.offset_size),
+                n.size, offset_size=volume.offset_size))
+            offset += len(record)
+    stream_encode(dst_base, coder, g)
+    striping.write_sorted_ecx_from_idx(
+        dst_base, offset_size=volume.offset_size)
+
+
+def build_volume(d, vid, n_needles, rng):
+    """Five payload kinds (compressible / gzip-declined random / tiny
+    odd sizes / pre-compressed / bulky), names+mimes on every third,
+    half the ids tombstoned — the full splice surface."""
+    v = Volume(d, "", vid, create=True)
+    for i in range(n_needles):
+        kind = i % 5
+        if kind == 0:
+            data = b"compressible text block " * int(rng.integers(1, 400))
+        elif kind == 1:
+            data = rng.integers(0, 256, size=int(rng.integers(1, 9000)),
+                                dtype=np.uint8).tobytes()
+        elif kind == 2:
+            data = b"x" * int(rng.integers(1, 7))
+        elif kind == 3:
+            data = compression.compress(b"already " * 600)
+        else:
+            data = b"padme" * int(rng.integers(100, 5000))
+        n = Needle(cookie=int(rng.integers(0, 2**32)), id=i + 1,
+                   data=data)
+        if kind == 3:
+            n.set_flag(FLAG_IS_COMPRESSED)
+        if i % 3 == 0:
+            n.name = f"file-{i}.txt".encode()
+            n.mime = b"text/plain"
+            n.set_flag(FLAG_HAS_NAME)
+            n.set_flag(FLAG_HAS_MIME)
+        v.write_needle(n)
+    for i in range(n_needles):
+        if i % 4 in (1, 2):
+            v.delete_needle(Needle(cookie=0, id=i + 1))
+    return v
+
+
+def assert_identical(base_seq, base_fused, g):
+    for ext in [".dat", ".idx", ".ecx"] + [to_ext(i)
+                                           for i in range(g.total_shards)]:
+        with open(base_seq + ext, "rb") as fa, \
+                open(base_fused + ext, "rb") as fb:
+            a, b = fa.read(), fb.read()
+        if a != b:
+            common = min(len(a), len(b))
+            first = next((i for i in range(common) if a[i] != b[i]),
+                         common)
+            pytest.fail(f"{ext}: fused diverges from sequential at "
+                        f"byte {first} (sizes {len(a)} vs {len(b)})")
+    # the scrubber's first verification rides the pass: the fused .ecm
+    # carries a digest for EVERY shard and they equal the true file
+    # digests — no host re-digest is ever needed for a fused volume
+    stamped = read_stamped_digests(base_fused)
+    true = shard_file_digest(base_fused, range(g.total_shards))
+    assert set(stamped) == set(range(g.total_shards))
+    for i in range(g.total_shards):
+        assert stamped[i] == int(true[i])
+    with open(base_seq + ".ecm") as fa, open(base_fused + ".ecm") as fb:
+        assert json.load(fa)["dat_size"] == json.load(fb)["dat_size"]
+
+
+@pytest.mark.parametrize("kmbb,needles,seed", [
+    ((10, 4, 64 * 1024, 4 * 1024), 120, 1),
+    ((20, 4, 32 * 1024, 2 * 1024), 90, 2),
+], ids=["rs10+4", "rs20+4"])
+def test_fused_identity(tmp_path, kmbb, needles, seed):
+    k, m, lb, sb = kmbb
+    g = Geometry(k, m, lb, sb)
+    coder = get_coder("numpy", k, m)
+    v = build_volume(str(tmp_path), 7, needles, np.random.default_rng(seed))
+    stats = fused_vacuum_gzip_encode(v, str(tmp_path / "fused"), coder, g)
+    sequential_reference(v, str(tmp_path / "seq"), coder, g)
+    assert_identical(str(tmp_path / "seq"), str(tmp_path / "fused"), g)
+    assert stats["gzipped_needles"] > 0       # the splice actually ran
+    assert stats["live_needles"] < needles    # tombstones actually left
+    v.close()
+
+
+def test_fused_identity_zero_live(tmp_path):
+    """Every needle deleted: the fused pass still emits a valid (header
+    -only) volume + full shard set, identical to the serial path."""
+    g = Geometry(10, 4, 64 * 1024, 4 * 1024)
+    coder = get_coder("numpy", 10, 4)
+    v = build_volume(str(tmp_path), 7, 40, np.random.default_rng(3))
+    for i in range(40):
+        v.delete_needle(Needle(cookie=0, id=i + 1))
+    stats = fused_vacuum_gzip_encode(v, str(tmp_path / "fused"), coder, g)
+    sequential_reference(v, str(tmp_path / "seq"), coder, g)
+    assert_identical(str(tmp_path / "seq"), str(tmp_path / "fused"), g)
+    assert stats["live_needles"] == 0
+    v.close()
+
+
+def test_fused_identity_governed_multiworker(tmp_path, monkeypatch):
+    """Multi-worker pools (parallel chunk jobs, strictly-ordered yield)
+    must not reorder a single output byte."""
+    monkeypatch.setenv("WEED_EC_GZIP_WORKERS", "4")
+    monkeypatch.setenv("WEED_EC_GZIP_MAX", "8")   # 1-core boxes clamp
+    monkeypatch.setenv("WEED_EC_READERS", "3")
+    governor.reset()
+    g = Geometry(10, 4, 64 * 1024, 4 * 1024)
+    coder = get_coder("numpy", 10, 4)
+    v = build_volume(str(tmp_path), 7, 120, np.random.default_rng(5))
+    stats = fused_vacuum_gzip_encode(v, str(tmp_path / "fused"), coder, g)
+    sequential_reference(v, str(tmp_path / "seq"), coder, g)
+    assert_identical(str(tmp_path / "seq"), str(tmp_path / "fused"), g)
+    assert stats["gzip_workers"] == 4
+    v.close()
+
+
+# ---------------------------------------------- gated incremental layout
+
+def _finished_wm(total):
+    wm = _Watermark()
+    wm.advance(total)
+    wm.finish(total)
+    return wm
+
+
+@pytest.mark.parametrize("total", [
+    0, 1, 4095, 4096 * 10 - 1, 4096 * 10, 4096 * 10 + 1,
+    65536 * 10 - 4096, 65536 * 10, 65536 * 10 + 4096 * 3,
+    65536 * 10 + 65536 * 10 - 4096 * 10 + 1,   # the ambiguity window
+    65536 * 25 + 1234,
+])
+def test_gated_segments_match_stripe_segments(total):
+    """With the watermark already final, the gated generator must be
+    segment-for-segment identical to the offline layout for every tail
+    shape — including the pad-a-large-row ambiguity window."""
+    g = Geometry(10, 4, 65536, 4096)
+    got = list(_gated_segments(g, 4096 * 4, _finished_wm(total)))
+    want = list(striping.stripe_segments(total, g, 4096 * 4))
+    assert got == want
+
+
+def test_gated_segments_stream_before_total_is_known():
+    """The overlap property itself: once the flushed watermark proves
+    the remainder exceeds the large/small threshold, segments yield
+    WITHOUT waiting for the compactor to finish."""
+    g = Geometry(3, 2, 8192, 1024)
+    wm = _Watermark()
+    seg_iter = _gated_segments(g, 1024, wm)
+    got = []
+    grabber = threading.Thread(
+        target=lambda: got.extend([next(seg_iter), next(seg_iter)]))
+    # flushed far past (large_row - small_row) + the first segments'
+    # cover: the first large-row segments must yield while the total
+    # is still unknown
+    wm.advance(g.large_row_size + g.small_row_size)
+    grabber.start()
+    grabber.join(timeout=10)
+    assert not grabber.is_alive(), \
+        "gated segments did not stream ahead of the compactor"
+    total = g.large_row_size + g.small_row_size  # now finish and drain
+    wm.finish(total)
+    rest = list(seg_iter)
+    assert got + rest == list(striping.stripe_segments(total, g, 1024))
+
+
+def test_watermark_fail_propagates():
+    wm = _Watermark()
+    wm.fail(ValueError("boom"))
+    with pytest.raises(RuntimeError):
+        wm.wait_cover(10)
+
+
+# ------------------------------------------------- fail-closed fault paths
+
+def _assert_no_dst(base, g):
+    leftovers = [base + ext for ext in
+                 [".dat", ".idx", ".ecx", ".ecm"]
+                 + [to_ext(i) for i in range(g.total_shards)]
+                 if os.path.exists(base + ext)]
+    assert not leftovers, f"partial dst files left behind: {leftovers}"
+
+
+@pytest.mark.parametrize("point", ["ec.fused.read", "ec.fused.gzip",
+                                   "ec.fused.commit"])
+def test_fused_fault_fails_closed(tmp_path, point):
+    """A drop armed at any fused fault point aborts the pass AND
+    removes every partial dst file — the source volume stays the only
+    copy, exactly the crash-consistency dual-state contract."""
+    g = Geometry(3, 2, 8192, 1024)
+    coder = get_coder("numpy", 3, 2)
+    v = build_volume(str(tmp_path), 7, 30, np.random.default_rng(9))
+    base = str(tmp_path / "fused")
+    faults.set_fault(point, "drop")
+    with pytest.raises((RuntimeError, OSError)):
+        fused_vacuum_gzip_encode(v, base, coder, g)
+    _assert_no_dst(base, g)
+    faults.clear()
+    # the source is untouched: the same call now succeeds end to end
+    fused_vacuum_gzip_encode(v, base, coder, g)
+    assert os.path.exists(base + ".ecm")
+    v.close()
+
+
+# ------------------------------------------------------ store-level flow
+
+def test_store_fused_generate_promotes_atomically(tmp_path):
+    from seaweedfs_tpu.ec.geometry import GeometryPolicy
+    from seaweedfs_tpu.storage.store import Store
+
+    policy = GeometryPolicy.parse("arc=3+2")
+    store = Store([str(tmp_path)], coder_name="numpy",
+                  geometry_policy=policy)
+    vid = 7
+    store.add_volume(vid, collection="arc")
+    for i in range(12):
+        data = (b"store fused text " * 40) if i % 2 else os.urandom(900)
+        store.write_needle(vid, Needle(id=i + 1, cookie=1, data=data))
+    store.delete_needle(vid, Needle(id=3, cookie=1))
+    base = store.find_volume(vid).base_file_name()
+    # stale staging junk from a "crashed" earlier pass must be swept
+    with open(base + ".fusing.dat", "wb") as f:
+        f.write(b"stale")
+    shards = store.ec_fused_generate(vid)
+    assert shards == list(range(5))
+    for sid in range(5):
+        assert os.path.exists(base + to_ext(sid))
+    assert os.path.exists(base + ".ecx")
+    assert os.path.exists(base + ".ecm")
+    # nothing staging-named survives a successful promote
+    assert not any(name.startswith("7.fusing")
+                   for name in os.listdir(str(tmp_path)))
+    # the SOURCE volume files are untouched (verify-then-retire: the
+    # lifecycle daemon retires them only after mounted-shard verify)
+    assert os.path.exists(base + ".dat")
+    assert os.path.exists(base + ".idx")
+    # digests stamped in the same commit: scrubber re-digest count 0
+    stamped = read_stamped_digests(base)
+    true = shard_file_digest(base, range(5))
+    assert all(stamped[i] == int(true[i]) for i in range(5))
+
+
+# ------------------------------------------------- governor gzip axis
+
+def test_governor_widens_gzip_workers_when_gzip_bound(monkeypatch):
+    from seaweedfs_tpu import observe
+    monkeypatch.setenv("WEED_EC_GZIP_WORKERS", "1")
+    monkeypatch.setenv("WEED_EC_GZIP_MAX", "8")
+    gov = governor.FeedGovernor()
+    assert gov.plan(100 * 1024 * 1024, 10).gzip_workers == 1
+    ctx = observe.TraceCtx(observe.new_id(), "", "ec", "")
+    for name, secs in (("ec.read", 0.1), ("ec.dispatch", 0.1),
+                       ("ec.kernel", 0.1), ("ec.write", 0.1),
+                       ("ec.compact", 0.4), ("ec.gzip", 5.0)):
+        for _ in range(8):
+            observe.record_span(name, ctx, 0, int(secs / 8 * 1e6))
+    op = gov.plan(100 * 1024 * 1024, 10)
+    gov.finish_run(ctx.trace_id, op, 100 * 1024 * 1024, 10)
+    assert gov.plan(100 * 1024 * 1024, 10).gzip_workers == 2

@@ -347,12 +347,20 @@ def test_idle_volume_auto_ec_time_driven(tmp_path):
                               for vs in c.volume_servers),
               timeout=30, what="original volume retired everywhere")
 
-        # shards byte-identical to a manual ec.encode of the same volume
+        # shards byte-identical to a manual one-pass warm-down of the
+        # same snapshot (the daemon's warm path is fused by default:
+        # compact+gzip+encode in one pass, so the reference must be the
+        # fused transform of the sealed volume, not a plain encode)
         from seaweedfs_tpu import ec as ec_mod
-        from seaweedfs_tpu.ec import pipeline as ec_pipeline
+        from seaweedfs_tpu.ec import fused as ec_fused
+        from seaweedfs_tpu.storage.volume import Volume as _Vol
         coder = ec_mod.get_coder("numpy", TEST_GEOMETRY.data_shards,
                                  TEST_GEOMETRY.parity_shards)
-        ec_pipeline.stream_encode(ref_base, coder, TEST_GEOMETRY)
+        ref_v = _Vol(str(tmp_path), "warmtest", vid)
+        ref_out = ref_base + ".warm"
+        ec_fused.fused_vacuum_gzip_encode(ref_v, ref_out, coder,
+                                          TEST_GEOMETRY)
+        ref_v.close()
         for sid in range(TOTAL):
             ext = ec_mod.to_ext(sid)
             live = None
@@ -365,9 +373,9 @@ def test_idle_volume_auto_ec_time_driven(tmp_path):
                 if live:
                     break
             assert live is not None, f"shard {sid} file not found"
-            with open(live, "rb") as a, open(ref_base + ext, "rb") as b:
+            with open(live, "rb") as a, open(ref_out + ext, "rb") as b:
                 assert a.read() == b.read(), \
-                    f"shard {sid} differs from the manual encode"
+                    f"shard {sid} differs from the manual warm-down"
 
         # the data is intact through the warm tier
         c.client._vid_cache.clear()

@@ -100,6 +100,26 @@ def test_observe_scope_pinned():
                 f"rule {name} no longer covers {path}"
 
 
+def test_fused_scope_pinned():
+    """The fused warm-down pass (ec/fused.py) owns a reader pool, two
+    all-or-nothing dst file handles, and three fault points fired from
+    worker threads — exactly what the resource-leak / async-blocking /
+    fault-point-registry guards exist for. A scope edit that narrows
+    any of them away from seaweedfs_tpu/ec/fused.py silently un-lints
+    the one pass that holds a volume's only compacted copy mid-flight."""
+    for name in ("resource-leak", "async-blocking-call",
+                 "fault-point-registry"):
+        rule = RULES[name]
+        assert rule.applies_to("seaweedfs_tpu/ec/fused.py"), \
+            f"rule {name} no longer covers seaweedfs_tpu/ec/fused.py"
+    # and the fused fault points must stay in the registry: firing an
+    # unknown point is exactly what fault-point-registry exists to catch
+    from seaweedfs_tpu import faults
+    for point in ("ec.fused.read", "ec.fused.gzip", "ec.fused.commit"):
+        assert point in faults.KNOWN_POINTS, \
+            f"fault point {point} dropped from faults.KNOWN_POINTS"
+
+
 def test_sharded_scope_pinned():
     """The shard runner is the one module that forks, owns a shared
     mmap segment, and renders cross-process Prometheus lines by hand —
