@@ -3009,6 +3009,66 @@ def phase_lint(work: str = "", budget_s: float = 60.0) -> dict:
     return out
 
 
+def phase_scale(work: str = "", budget_s: float = 240.0) -> dict:
+    """Planet-scale control plane at 1000 virtual nodes (clustersim):
+    planner decision latency over a fully-registered skewed topology,
+    then the scenario sweep's moved-bytes ratio / convergence /
+    violation counts.  Pure CPU python — no TPU, no sockets, virtual
+    clock — so the numbers are control-plane algorithm costs, not I/O.
+    Checkpointed per scenario: a timeout keeps every scenario already
+    measured."""
+    from seaweedfs_tpu.balance.planner import plan_moves
+    from seaweedfs_tpu.clustersim import scenarios
+    from seaweedfs_tpu.clustersim.sim import ClusterSim
+
+    deadline = time.perf_counter() + budget_s
+    out: dict = {"nodes": 1000}
+
+    # planner decision latency: a 1000-node topology with 3 hot nodes,
+    # registered through the real heartbeat intake, planned repeatedly
+    sim = ClusterSim(nodes=1000, seed=0)
+    for i in range(3):
+        for vid in sorted(sim.node(i).volumes):
+            sim.at(1, "heat", i, vid, 2.0)
+    sim.run(10)
+    durs = []
+    for _ in range(30):
+        t0 = time.perf_counter()
+        plan = plan_moves(sim.topology, sim.cfg, sim.clock.now(),
+                          seed=0, frozen=frozenset())
+        durs.append((time.perf_counter() - t0) * 1000.0)
+    durs.sort()
+    out["plan_p50_ms"] = round(durs[len(durs) // 2], 2)
+    out["plan_p95_ms"] = round(durs[int(len(durs) * 0.95)], 2)
+    out["plan_moves_proposed"] = len(plan)
+    _phase_checkpoint(work, "scale", out)
+
+    total_violations = 0
+    for name in ("skew", "churn", "rackloss"):
+        if time.perf_counter() > deadline - 30:
+            out[name] = {"error": "skipped (budget)"}
+            continue
+        t0 = time.perf_counter()
+        rep = scenarios.run_scenario(name, seed=0, nodes=1000)
+        total_violations += len(rep["violations"])
+        out[name] = {
+            "wall_s": round(time.perf_counter() - t0, 2),
+            "ticks": rep["ticks"],
+            "moves": rep["moves"],
+            "repairs": rep["repairs"],
+            "moved_bytes_ratio": rep["moved_bytes_ratio"],
+            "converge_tick": rep.get("converge_tick"),
+            "violations": rep["violations"],
+        }
+        _phase_checkpoint(work, "scale", out)
+    out["moved_bytes_ratio"] = (out.get("skew") or {}).get(
+        "moved_bytes_ratio")
+    out["violations_total"] = total_violations
+    out["accept"] = {"zero_violations": total_violations == 0,
+                     "plan_under_1s": out["plan_p50_ms"] < 1000.0}
+    return out
+
+
 # ------------------------------------------------------------ orchestration
 
 def _run_phase(name: str, work: str, timeout_s: float) -> dict:
@@ -3280,6 +3340,19 @@ def main() -> None:
         detail["lint"] = lint
         _checkpoint(detail)
 
+        scale: dict = {"error": "skipped (budget)"}
+        if left() > 60:
+            try:
+                scale = phase_scale(work, budget_s=min(180.0, left() - 30.0))
+                _log(f"scale: 1000-node plan p50 "
+                     f"{scale.get('plan_p50_ms')}ms, skew moved-bytes "
+                     f"ratio {scale.get('moved_bytes_ratio')}, "
+                     f"{scale.get('violations_total')} violations")
+            except Exception as e:
+                scale = {"error": str(e), **_load_partial(work, "scale")}
+        detail["scale"] = scale
+        _checkpoint(detail)
+
         recovery: dict = {"error": "skipped (budget)"}
         if left() > 60:
             try:
@@ -3396,6 +3469,10 @@ def main() -> None:
                     recovery.get("full_scan_gbps"),
                 "crashsim_points_per_s":
                     recovery.get("crashsim_points_per_s"),
+                "scale_plan_p50_ms": scale.get("plan_p50_ms"),
+                "scale_moved_bytes_ratio":
+                    scale.get("moved_bytes_ratio"),
+                "scale_violations": scale.get("violations_total"),
                 "detail_file": "BENCH_DETAIL.json",
             },
         }))
@@ -3423,6 +3500,7 @@ if __name__ == "__main__":
               "georepl": lambda w: phase_georepl(w, budget_s=budget),
               "metadata": lambda w: phase_metadata(w, budget_s=budget),
               "lint": lambda w: phase_lint(w, budget_s=budget),
+              "scale": lambda w: phase_scale(w, budget_s=budget),
               "recovery": lambda w: phase_recovery(w, budget_s=budget),
               }[name]
         print(json.dumps(fn(work)))

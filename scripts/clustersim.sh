@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# clustersim CI gate: deterministic 1000-node control-plane sweep over
+# every churn scenario (steady, heat skew, node kills/flaps, rack
+# loss).  Every cell runs twice and must produce an identical event-log
+# digest (determinism), and fails on any control-plane contract
+# violation: rebalance non-convergence, placement oscillation
+# (double-move inside the cooldown window / A->B->A ping-pong),
+# unbounded ring movement under churn, an unrepaired deficit, or
+# balance work starving repair slots.
+#
+#   scripts/clustersim.sh                          # the CI budget
+#   scripts/clustersim.sh --seeds 5 --nodes 2000   # deeper sweep
+#   scripts/clustersim.sh --scenarios skew --seed-base 7 --json  # replay
+#
+# Runs beside scripts/crashsim.sh and scripts/lint.sh; JAX is not
+# needed (pure control-plane python).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m seaweedfs_tpu.clustersim \
+    --seeds 2 --nodes 1000 "$@"

@@ -12,6 +12,7 @@ _EXEMPT = (
     "seaweedfs_tpu/cli.py",
     "seaweedfs_tpu/analysis/__main__.py",
     "seaweedfs_tpu/crashsim/__main__.py",
+    "seaweedfs_tpu/clustersim/__main__.py",
 )
 
 

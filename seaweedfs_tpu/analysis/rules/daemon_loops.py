@@ -68,12 +68,13 @@ def _daemon_loop_violations(node: ast.AsyncFunctionDef):
 @register
 class DaemonLoopShedable(Rule):
     name = "daemon-loop-shedable"
-    rationale = ("every lifecycle/geo/metaring daemon loop must bind "
-                 "CLASS_BG (so its fan-out sheds before foreground "
+    rationale = ("every lifecycle/geo/metaring/balance daemon loop must "
+                 "bind CLASS_BG (so its fan-out sheds before foreground "
                  "traffic) and sleep on a jittered interval (no "
                  "fleet-wide lockstep scans)")
     scope = ("seaweedfs_tpu/lifecycle/", "seaweedfs_tpu/geo/",
-             "seaweedfs_tpu/metaring/")
+             "seaweedfs_tpu/metaring/", "seaweedfs_tpu/balance/",
+             "seaweedfs_tpu/clustersim/")
     fixture_relpath = "seaweedfs_tpu/lifecycle/_fixture.py"
     fixture = (
         "async def scan_loop():\n"

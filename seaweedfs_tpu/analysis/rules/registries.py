@@ -80,6 +80,10 @@ class FaultPointRegistry(Rule):
         "    faults.fire('geo.straem')\n"                # typo
         "async def ring_hop(self):\n"
         "    await faults.fire_async('ring.proxi')\n"    # typo
+        "async def balance_pass(self):\n"
+        "    await faults.fire_async('master.balance.pln')\n"  # typo
+        "def sim_beat(self):\n"
+        "    faults.fire('sim.heartbeet')\n"             # typo
     )
     clean_fixture = (
         "from . import faults\n"
@@ -95,6 +99,12 @@ class FaultPointRegistry(Rule):
         "    await faults.fire_async('ring.handoff')\n"
         "def log_apply(self):\n"
         "    faults.fire('master.log.apply')\n"
+        "async def balance_pass(self):\n"
+        "    await faults.fire_async('master.balance.plan')\n"
+        "async def balance_move(self):\n"
+        "    await faults.fire_async('master.balance.move')\n"
+        "def sim_beat(self):\n"
+        "    faults.fire('sim.heartbeat')\n"
     )
 
     def check_project(self, mods):

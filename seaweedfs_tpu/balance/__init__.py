@@ -1,0 +1,116 @@
+"""Heat-driven auto-balancer: the control plane that finally MOVES load.
+
+Heat has been tracked per volume since the lifecycle plane landed and
+repair has been rack-aware since the repair daemon, but placement was
+static-at-assign: one hot node could bottleneck a cluster while the
+rest of the rack idled, forever.  This package closes the loop in the
+established planner/daemon split:
+
+* **planner.py** — pure, clock-injected, seeded: consumes the
+  topology's per-node heat + capacity view and proposes volume moves
+  (hot node -> cold node) under hard invariants it can never break:
+  rack-aware replica spread is preserved (the distinct-rack / distinct-
+  DC count of a volume's holder set never decreases), the destination
+  stays under its capacity watermark, under-replicated volumes are the
+  repair planner's business, and only sealed (read_only or size-full)
+  volumes move — a mid-write copy would race acked writes.  Identical
+  inputs + seed => byte-identical plan, which is what lets clustersim
+  replay a thousand-node run from one integer.
+
+* **PlannerState** (planner.py) — the oscillation guard both the live
+  daemon and clustersim run: two-pass confirmation (a move fires only
+  when two consecutive passes propose the same src->dst), a per-volume
+  cooldown window after every completed move, and an A->B->A veto that
+  refuses to undo a recent move even after the cooldown lapses.
+
+* **daemon.py** — the leader-only master daemon (sibling of the
+  repair/lifecycle/geo daemons: leader gate, CLASS_BG priority,
+  jittered interval, the shared ``_repair_sem`` worker slots and
+  ``_repair_backoff`` bookkeeping) that executes confirmed moves with
+  the replicate->verify->retire primitives: copy to the destination,
+  read its /status back AND wait for its heartbeat to register the new
+  location, only then delete the source — a crash at any point leaves
+  source or destination complete, never neither.
+
+``/dir/assign`` placement also becomes heat-aware when the balancer is
+enabled: Topology.find_empty_slots sorts candidates coldest-first from
+the same node_rates view instead of shuffling (balance/planner.py).
+
+All knobs ride WEED_BALANCE_* (see BalanceConfig / README
+"Planet-scale control").
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+
+def _env_float(env: dict, key: str, default: float) -> float:
+    try:
+        return float(env.get(key, "") or default)
+    except ValueError:
+        return default
+
+
+@dataclass
+class BalanceConfig:
+    """All WEED_BALANCE_* knobs in one place (README "Planet-scale
+    control")."""
+    interval: float = 30.0      # WEED_BALANCE_INTERVAL seconds per pass
+    hot_ratio: float = 1.5      # WEED_BALANCE_HOT_RATIO x mean = hot
+    cold_ratio: float = 0.8     # WEED_BALANCE_COLD_RATIO x mean = cold
+    min_rate: float = 0.05      # WEED_BALANCE_MIN_RATE reads/s floor
+    max_moves: int = 4          # WEED_BALANCE_MAX_MOVES per pass
+    cooldown: float = 600.0     # WEED_BALANCE_COOLDOWN s between moves
+                                # of one volume (oscillation window)
+    watermark: float = 0.85     # WEED_BALANCE_WATERMARK destination
+                                # volume-slot utilization cap
+    assign_heat_aware: bool = True   # WEED_BALANCE_ASSIGN
+    force_enabled: Optional[bool] = None  # WEED_BALANCE_ENABLED
+
+    @property
+    def enabled(self) -> bool:
+        """The daemon runs unless explicitly disabled — unlike
+        lifecycle there is no "no rules configured" state (the hot/cold
+        thresholds always exist), and a cluster with uniform heat plans
+        zero moves, so the default-on loop is behavior-neutral until
+        skew actually appears."""
+        if self.force_enabled is not None:
+            return self.force_enabled
+        return True
+
+    @classmethod
+    def from_env(cls, env: Optional[dict] = None) -> "BalanceConfig":
+        env = env if env is not None else os.environ
+        force = env.get("WEED_BALANCE_ENABLED", "")
+        return cls(
+            interval=max(_env_float(env, "WEED_BALANCE_INTERVAL", 30.0),
+                         0.05),
+            hot_ratio=max(_env_float(env, "WEED_BALANCE_HOT_RATIO", 1.5),
+                          1.0),
+            cold_ratio=min(max(_env_float(env, "WEED_BALANCE_COLD_RATIO",
+                                          0.8), 0.0), 1.0),
+            min_rate=max(_env_float(env, "WEED_BALANCE_MIN_RATE", 0.05),
+                         0.0),
+            max_moves=max(int(_env_float(env, "WEED_BALANCE_MAX_MOVES",
+                                         4)), 1),
+            cooldown=max(_env_float(env, "WEED_BALANCE_COOLDOWN", 600.0),
+                         0.0),
+            watermark=min(max(_env_float(env, "WEED_BALANCE_WATERMARK",
+                                         0.85), 0.05), 1.0),
+            assign_heat_aware=env.get("WEED_BALANCE_ASSIGN", "1")
+            not in ("0", "false", "no"),
+            force_enabled=(None if force == ""
+                           else force not in ("0", "false", "no")),
+        )
+
+
+from .planner import (Move, PlannerState, node_rates,  # noqa: E402
+                      pick_replica_target, plan_moves)
+
+__all__ = [
+    "BalanceConfig", "Move", "PlannerState", "node_rates",
+    "pick_replica_target", "plan_moves",
+]

@@ -1,0 +1,283 @@
+"""Leader-only balancer daemon: executes what balance/planner.py plans.
+
+Sibling of the repair/lifecycle/geo daemons and shares their discipline
+end to end:
+
+* leader-only — a follower's stale topology must never move a volume,
+  and two masters must never both drive one move;
+* the SAME concurrency semaphore as the repair planner
+  (master._repair_sem) and the same numbered worker slots, so balance
+  moves, deficit rebuilds and lifecycle encodes drain through one
+  bounded, visible budget instead of stampeding volume servers;
+* the SAME per-key exponential-backoff bookkeeping
+  (master._repair_backoff, key ("balance", vid));
+* overload CLASS_BG priority bound for the loop and re-stamped in every
+  move task, so every admin call it fans out is shed FIRST under load;
+* two-pass confirmation + cooldown + ping-pong veto live in
+  PlannerState — the exact object clustersim replays at 1000 nodes.
+
+Moves are crash-safe by ordering, not by journal: copy the volume to
+the destination, read the destination's /status back (never trust the
+copy response), wait until the master's own topology lists the new
+location (so reads route to BOTH sides), and only then delete the
+source.  A crash at any point leaves source or destination complete —
+never neither — and the next pass converges: destination live -> just
+retire the source; destination incomplete -> re-copy.
+
+Named fault points: ``master.balance.plan`` gates a planning pass,
+``master.balance.move`` gates every move before its copy step — the
+chaos suite kills a move at the worst moment and proves convergence.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from collections import deque
+from dataclasses import asdict
+from typing import Optional
+
+import aiohttp
+
+from .. import faults, observe, overload
+from ..lifecycle import jittered
+from . import BalanceConfig
+from .planner import Move, PlannerState, node_rates, plan_moves
+
+log = logging.getLogger("balance")
+
+
+class BalancerDaemon:
+    def __init__(self, master, cfg: Optional[BalanceConfig] = None):
+        self.master = master
+        self.cfg = cfg or BalanceConfig.from_env()
+        self.state = PlannerState(self.cfg)
+        self._inflight: dict[tuple, float] = {}
+        self._tasks: set = set()
+        self.recent: deque = deque(maxlen=64)
+        self.last_pass = 0.0
+        self.passes = 0
+        self.moves_done = 0
+        self.moved_bytes = 0
+
+    # --- loop ---
+
+    async def run_loop(self) -> None:
+        # balance work is background by definition: every admin call
+        # the daemon (and its move tasks) fans out carries
+        # X-Seaweed-Priority: bg and sheds before user traffic
+        overload.set_priority(overload.CLASS_BG)
+        while True:
+            await asyncio.sleep(jittered(self.cfg.interval))
+            try:
+                await self.pass_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.warning("balance pass failed: %s", e)
+
+    def stop(self) -> None:
+        for task in list(self._tasks):
+            task.cancel()
+
+    # --- one planning pass ---
+
+    async def pass_once(self) -> dict:
+        master = self.master
+        if not master.raft.is_leader or not await master.raft.ensure_ready():
+            # a demoted leader forgets its two-pass counters so a later
+            # re-election starts from fresh confirmation
+            self.state.reset()
+            return {"skipped": "not leader"}
+        if await faults.fire_async("master.balance.plan"):
+            return {"skipped": "injected drop at master.balance.plan"}
+        # prune FIRST, plan against the same view: a dead node's decayed
+        # EWMA must never propose a move to/from it (the stale-heat
+        # hazard); the planner additionally filters on last_seen, so
+        # dead-but-unpruned nodes are invisible either way
+        for ev in master.topology.prune_dead_nodes():
+            master.metrics.count("dead_nodes_pruned")
+            master._broadcast_location(ev)
+        now = time.time()
+        self.last_pass = now
+        self.passes += 1
+        frozen = self.state.frozen(now)
+        # seed is FIXED: two-pass confirmation needs consecutive passes
+        # to agree on (src, dst), and a rotating seed would re-shuffle
+        # the tie-break among equally-cold destinations every pass —
+        # the plan would never confirm
+        plan = plan_moves(master.topology, self.cfg, now,
+                          seed=0, frozen=frozen)
+        confirmed = self.state.confirm(plan, now)
+        launched = []
+        for mv in confirmed:
+            if not self._due(mv.key):
+                continue
+            self._launch(mv)
+            launched.append(mv.to_dict())
+        master.metrics.gauge("balance_inflight", len(self._inflight))
+        return {"planned": len(plan), "confirmed": len(confirmed),
+                "frozen": len(frozen), "launched": launched}
+
+    def _due(self, key: tuple) -> bool:
+        if key in self._inflight:
+            return False
+        back = self.master._repair_backoff.get(key)
+        if back is not None and time.monotonic() < back[1]:
+            return False
+        return True
+
+    def _launch(self, mv: Move) -> None:
+        self._inflight[mv.key] = time.monotonic()
+        task = asyncio.create_task(self._run_move(mv))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def _run_move(self, mv: Move) -> None:
+        # explicit stamp: moves can also be launched from the
+        # /balance/run admin path, outside the bg-tagged loop context
+        overload.set_priority(overload.CLASS_BG)
+        key = mv.key
+        try:
+            async with self.master._repair_sem:
+                # same numbered worker pool as the repair daemon: a
+                # balance wave and a rebuild storm drain through one
+                # visible budget, repair never starved below it
+                worker = self.master._checkout_worker()
+                log.info("worker %d: balance move of volume %d %s -> %s "
+                         "(trace %s)", worker, mv.vid, mv.src, mv.dst,
+                         observe.ensure_ctx("master").trace_id)
+                try:
+                    with observe.span("balance.move",
+                                      tags={"vid": mv.vid, "src": mv.src,
+                                            "dst": mv.dst,
+                                            "worker": worker}):
+                        await self._execute_move(mv)
+                finally:
+                    self.master._checkin_worker(worker)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            failures = self.master._repair_backoff.get(key, (0, 0.0))[0] + 1
+            delay = min(self.cfg.interval * (2 ** failures), 300.0)
+            self.master._repair_backoff[key] = (failures,
+                                                time.monotonic() + delay)
+            self._record(mv, "failed", error=str(e))
+            log.warning("balance move of volume %d failed (attempt %d, "
+                        "next in %.1fs): %s", mv.vid, failures, delay, e)
+        else:
+            self.master._repair_backoff.pop(key, None)
+            self.state.record_done(mv, time.time())
+            self.moves_done += 1
+            self.moved_bytes += mv.bytes
+            self._record(mv, "ok")
+            log.info("balance move of volume %d %s -> %s done (%s)",
+                     mv.vid, mv.src, mv.dst, mv.reason)
+        finally:
+            self._inflight.pop(key, None)
+
+    def _record(self, mv: Move, outcome: str, error: str = "") -> None:
+        self.master.metrics.count("balance_moves",
+                                  labels={"outcome": outcome})
+        entry = {"volume": mv.vid, "src": mv.src, "dst": mv.dst,
+                 "outcome": outcome, "at": time.time(),
+                 "reason": mv.reason}
+        if error:
+            entry["error"] = error
+        self.recent.appendleft(entry)
+
+    # --- the move itself: copy -> verify -> retire ---
+
+    def _check_leader(self) -> None:
+        if not self.master.raft.is_leader:
+            raise RuntimeError("lost leadership mid-move")
+
+    async def _dst_has_volume(self, mv: Move) -> bool:
+        """Does the destination ACTUALLY hold a complete copy?  A
+        /status read-back (size >= the planned size), never a trusted
+        copy response — nothing is destroyed on trust."""
+        async with self.master._maint_http().get(
+                f"http://{mv.dst_url}/status",
+                timeout=aiohttp.ClientTimeout(total=30)) as r:
+            st = await r.json()
+            if r.status != 200:
+                raise RuntimeError(f"{mv.dst_url}/status: {r.status}")
+        for v in st.get("volumes", []):
+            if v.get("id") == mv.vid:
+                return int(v.get("size", 0)) >= mv.bytes
+        return False
+
+    async def _execute_move(self, mv: Move) -> None:
+        master = self.master
+        self._check_leader()
+        if await faults.fire_async("master.balance.move"):
+            raise RuntimeError("injected drop at master.balance.move")
+        # resume path: a prior attempt crashed after the copy — the
+        # destination already holds a complete volume, only the retire
+        # is left. volume/copy would 409 on it, so check first.
+        if not await self._dst_has_volume(mv):
+            src_live = {n.id for n in master.topology.lookup(mv.vid)}
+            if mv.src not in src_live:
+                raise RuntimeError(
+                    f"volume {mv.vid}: source {mv.src} no longer holds "
+                    f"it and destination has no copy — stale plan")
+            self._check_leader()
+            await master._admin_post(mv.dst_url, "volume/copy",
+                                     {"volume_id": mv.vid,
+                                      "collection": mv.collection,
+                                      "source": mv.src_url},
+                                     timeout=600.0)
+            if not await self._dst_has_volume(mv):
+                raise RuntimeError(
+                    f"volume {mv.vid}: copy to {mv.dst} did not verify "
+                    f"({mv.bytes} bytes expected); keeping the source")
+        # wait until the master's OWN topology lists the destination,
+        # so lookups route to both sides before the source disappears —
+        # the zero-acked-read-loss window. Bounded: a destination whose
+        # heartbeat never lands fails the move (source kept, backoff).
+        pulse = master.topology.pulse_seconds
+        for _ in range(20):
+            if any(n.id == mv.dst
+                   for n in master.topology.lookup(mv.vid)):
+                break
+            await asyncio.sleep(max(pulse / 2.0, 0.05))
+        else:
+            raise RuntimeError(
+                f"volume {mv.vid}: destination {mv.dst} verified on "
+                f"disk but its heartbeat never registered the copy — "
+                f"keeping the source")
+        self._check_leader()
+        await master._admin_post(mv.src_url, "volume/delete",
+                                 {"volume_id": mv.vid})
+
+    # --- heat-aware /dir/assign ---
+
+    def assign_rank(self) -> Optional[dict]:
+        """node id -> heat score for find_empty_slots' coldest-first
+        placement; None when heat-aware assignment is off."""
+        if not (self.cfg.enabled and self.cfg.assign_heat_aware):
+            return None
+        return node_rates(self.master.topology, time.time())
+
+    # --- observability ---
+
+    def status(self) -> dict:
+        now = time.monotonic()
+        return {
+            "enabled": self.cfg.enabled,
+            "is_leader": self.master.raft.is_leader,
+            "last_pass": self.last_pass,
+            "passes": self.passes,
+            "moves_done": self.moves_done,
+            "moved_bytes": self.moved_bytes,
+            "node_rates": {nid: round(r, 4) for nid, r in sorted(
+                node_rates(self.master.topology, time.time()).items())},
+            "pending": [{"volume": v, "for_s": round(now - t0, 1)}
+                        for (_, v), t0 in sorted(self._inflight.items(),
+                                                 key=lambda kv: kv[0][1])],
+            "state": self.state.to_dict(),
+            "recent": list(self.recent),
+            "config": {k: v for k, v in asdict(self.cfg).items()
+                       if k != "force_enabled"},
+        }

@@ -1,0 +1,374 @@
+"""Balance planning: pure, clock-injected, seeded — no sockets, no
+ambient time.  ``plan_moves`` consumes the master Topology exactly as
+the repair planner does and returns the volume moves that would reduce
+heat imbalance this pass; ``PlannerState`` is the oscillation guard
+(two-pass confirmation, per-volume cooldown, A->B->A veto) that the
+live daemon AND clustersim both run, so the simulator proves the same
+discipline production executes.
+
+Invariants the planner can never break (tests/test_balance.py pins
+each one):
+
+* determinism: same topology view + config + seed => byte-identical
+  plan (the seed only rotates among ties);
+* a move never shrinks a volume's rack/DC diversity (rack-aware
+  replica spread is preserved), never targets a holder, and never
+  pushes the destination past the capacity watermark;
+* the one exception to "never targets a holder": a volume with MORE
+  live holders than its placement wants (the signature of a move that
+  crashed between copy and retire) plans a retire-only move to an
+  existing holder — the daemon's resume path skips the copy and just
+  deletes the source, which is how a half-finished move converges to
+  exactly one complete copy instead of leaving a surplus forever;
+* only sealed volumes (read_only, or size past FULL_FRACTION of the
+  volume size limit) move — copying a volume mid-write races acked
+  writes;
+* under-replicated volumes are the repair planner's business, EC /
+  vacuuming / frozen (cooldown) volumes are skipped;
+* every move is a strict improvement (destination post-move rate stays
+  below the source's pre-move rate), so sum(rate^2) over nodes is a
+  strictly decreasing potential — under steady heat the move sequence
+  terminates and a lone super-hot volume stays put instead of
+  ping-ponging around the cluster.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..storage.superblock import ReplicaPlacement
+
+# a volume counts as sealed (movable) past this fraction of the size
+# limit — mirrors WEED_LIFECYCLE_FULL_FRACTION's default
+FULL_FRACTION = 0.9
+
+
+@dataclass
+class Move:
+    vid: int
+    collection: str
+    src: str            # source node id
+    dst: str            # destination node id
+    src_url: str
+    dst_url: str
+    bytes: int
+    rate: float         # the per-holder read rate being moved
+    reason: str
+
+    @property
+    def key(self) -> tuple:
+        return ("balance", self.vid)
+
+    def to_dict(self) -> dict:
+        return {"vid": self.vid, "collection": self.collection,
+                "src": self.src, "dst": self.dst, "bytes": self.bytes,
+                "rate": round(self.rate, 6), "reason": self.reason}
+
+
+def node_rates(topology, now: float) -> dict[str, float]:
+    """node id -> summed decayed read rate over its normal volumes,
+    LIVE nodes only (a node past the prune window contributes nothing:
+    its stale EWMA must never rank it hot or cold)."""
+    timeout = topology.pulse_seconds * 5
+    out: dict[str, float] = {}
+    for nid, node in topology.nodes.items():
+        if now - node.last_seen > timeout:
+            continue
+        total = 0.0
+        for vid in node.volumes:
+            vh = node.heat.get(vid)
+            if vh is not None:
+                total += vh.rate_now(now)
+        out[nid] = total
+    return out
+
+
+def pick_replica_target(topology, replication: str, holders: list,
+                        pending: Optional[dict] = None):
+    """Rack-aware target choice for re-replicating one volume — the
+    exact rule the master repair daemon executes, factored pure so
+    clustersim drives the REAL placement logic.  When the placement
+    spreads racks/DCs, prefer a rack the surviving copies don't already
+    occupy (the same constraint find_empty_slots enforces at grow
+    time); ties on free slots break on node id for determinism.
+
+    ``pending`` (node id -> in-flight additions) discounts copies
+    already heading to a node, so a rack-loss storm planning hundreds
+    of rebuilds in one pass spreads them instead of stampeding the
+    single currently-emptiest server."""
+    rp = ReplicaPlacement.parse(replication)
+    held = {n.id for n in holders}
+    pending = pending or {}
+
+    def free(n):
+        return n.free_slots() - pending.get(n.id, 0)
+
+    candidates = [n for n in topology.nodes.values()
+                  if free(n) > 0 and n.id not in held]
+    if not candidates or not holders:
+        return None
+    used_racks = {(n.data_center, n.rack) for n in holders}
+    if rp.diff_rack_count or rp.diff_data_center_count:
+        spread = [n for n in candidates
+                  if (n.data_center, n.rack) not in used_racks]
+        if spread:
+            candidates = spread
+    return max(sorted(candidates, key=lambda n: n.id), key=free)
+
+
+def _spread_after_retire_ok(rp: ReplicaPlacement, holders: list,
+                            src) -> bool:
+    """Would dropping `src`'s copy leave a holder set that still
+    satisfies the placement?  Guards the retire-only moves that finish
+    a crashed copy->retire (the extra complete copy is the crash
+    signature) — never retire below copy_count or below the placement's
+    DC/rack diversity."""
+    others = [n for n in holders if n.id != src.id]
+    if len(others) < rp.copy_count():
+        return False
+    if len({n.data_center for n in others}) \
+            < rp.diff_data_center_count + 1:
+        return False
+    if len({(n.data_center, n.rack) for n in others}) \
+            < rp.diff_data_center_count + rp.diff_rack_count + 1:
+        return False
+    return True
+
+
+def _spread_ok(rp: ReplicaPlacement, holders: list, src, dst) -> bool:
+    """Would moving the `src` replica to `dst` preserve the placement?
+    The holder set's distinct-rack and distinct-DC counts must not
+    decrease, and a same-rack placement keeps the dst in the rack the
+    other copies occupy."""
+    if any(n.id == dst.id for n in holders):
+        return False  # dst already holds a replica
+    others = [n for n in holders if n.id != src.id]
+    after = others + [dst]
+
+    def racks(ns):
+        return {(n.data_center, n.rack) for n in ns}
+
+    def dcs(ns):
+        return {n.data_center for n in ns}
+
+    if len(racks(after)) < len(racks(holders)):
+        return False
+    if len(dcs(after)) < len(dcs(holders)):
+        return False
+    if rp.same_rack_count > 0 and others:
+        if (dst.data_center, dst.rack) not in racks(others):
+            return False
+    return True
+
+
+def plan_moves(topology, cfg, now: float, seed: int = 0,
+               frozen: frozenset = frozenset()) -> list[Move]:
+    """One planning pass: propose up to cfg.max_moves volume moves from
+    hot nodes to the coldest eligible destinations.  Pure and
+    deterministic — `now` is an argument, the only randomness is
+    Random(seed) breaking exact ties among equally-cold destinations.
+
+    ``frozen`` is the cooldown set from PlannerState: volumes that
+    moved recently are not reconsidered at all this pass."""
+    timeout = topology.pulse_seconds * 5
+    live = {nid: n for nid, n in sorted(topology.nodes.items())
+            if now - n.last_seen <= timeout and n.max_volume_count > 0}
+    if len(live) < 2:
+        return []
+
+    # per-(node, volume) decayed rates and per-node totals, one walk
+    vol_rate: dict[tuple, float] = {}
+    rates: dict[str, float] = {}
+    ec_vids: set[int] = set()
+    for nid, node in live.items():
+        total = 0.0
+        for vid in node.volumes:
+            vh = node.heat.get(vid)
+            r = vh.rate_now(now) if vh is not None else 0.0
+            vol_rate[(nid, vid)] = r
+            total += r
+        rates[nid] = total
+        ec_vids.update(node.ec_shards)
+    mean = sum(rates.values()) / len(rates)
+    hot_cut = max(mean * cfg.hot_ratio, cfg.min_rate)
+    hots = sorted((nid for nid in live if rates[nid] > hot_cut),
+                  key=lambda nid: (-rates[nid], nid))
+    if not hots:
+        return []
+
+    vacuuming = {vid for layout in topology.layouts.values()
+                 for vid in layout.vacuuming}
+    # live holders per vid (dead holders don't count toward replication
+    # here — an under-replicated volume belongs to the repair planner)
+    holders: dict[int, list] = {}
+    for nid, node in live.items():
+        for vid in node.volumes:
+            holders.setdefault(vid, []).append(node)
+
+    rng = random.Random(seed)
+    # one stable random priority per node: the deterministic tie-break
+    # that keeps a fleet of equal-rate cold nodes from all being picked
+    # in id order (and thus stampeded) while staying replayable
+    tie = {nid: rng.random() for nid in sorted(live)}
+    proj = dict(rates)                       # projected rates
+    pending_add = {nid: 0 for nid in live}   # slots claimed this plan
+    planned_vids: set[int] = set()
+    moves: list[Move] = []
+
+    for src_id in hots:
+        if len(moves) >= cfg.max_moves:
+            break
+        src = live[src_id]
+        vids = sorted((vid for vid in src.volumes
+                       if vol_rate[(src_id, vid)] > 0.0),
+                      key=lambda vid: (-vol_rate[(src_id, vid)], vid))
+        for vid in vids:
+            if len(moves) >= cfg.max_moves or proj[src_id] <= hot_cut:
+                break
+            if vid in frozen or vid in planned_vids or vid in ec_vids \
+                    or vid in vacuuming:
+                continue
+            vi = src.volumes[vid]
+            rp = ReplicaPlacement.parse(vi.replica_placement)
+            held = holders.get(vid, [])
+            if len(held) < rp.copy_count():
+                continue  # the repair planner's business
+            # MORE live holders than the placement wants is the
+            # signature of a move that crashed between copy and retire:
+            # the destination's complete copy registered, the source
+            # was never deleted.  Finishing it is a retire-only move to
+            # an existing holder — the daemon's resume path skips the
+            # copy — and while it stands, a fresh copy elsewhere would
+            # only widen the surplus, so copy moves are off the table.
+            extra = len(held) > rp.copy_count()
+            sealed = (vi.read_only or vi.size >= FULL_FRACTION
+                      * topology.volume_size_limit)
+            if not sealed:
+                continue
+            r = vol_rate[(src_id, vid)]
+            # coldest-first eligible destinations
+            for dst_id in sorted(
+                    live, key=lambda nid: (proj[nid], tie[nid], nid)):
+                if dst_id == src_id:
+                    continue
+                dst = live[dst_id]
+                dst_holds = any(n.id == dst_id for n in held)
+                if extra != dst_holds:
+                    continue
+                if not extra:
+                    # capacity: a free slot AND under the watermark
+                    # after every move already planned against this
+                    # destination (retire-only moves copy nothing)
+                    used = dst.max_volume_count - dst.free_slots()
+                    adds = pending_add[dst_id]
+                    if dst.free_slots() - adds <= 0:
+                        continue
+                    if used + adds + 1 > cfg.watermark \
+                            * dst.max_volume_count:
+                        continue
+                # strict improvement: the destination must stay BELOW
+                # the source's pre-move rate.  Every accepted move then
+                # strictly decreases sum(rate^2) by 2r(src-dst-r) > 0 —
+                # a monotone potential, so under steady heat the plan
+                # sequence terminates and a lone super-hot volume stays
+                # put instead of ping-ponging around the cluster
+                if proj[dst_id] + r >= proj[src_id]:
+                    continue
+                if extra:
+                    if not _spread_after_retire_ok(rp, held, src):
+                        continue
+                elif not _spread_ok(rp, held, src, dst):
+                    continue
+                moves.append(Move(
+                    vid=vid, collection=vi.collection, src=src_id,
+                    dst=dst_id, src_url=src.url, dst_url=dst.url,
+                    bytes=vi.size, rate=r,
+                    reason=("retire surplus replica of a crashed move"
+                            if extra else
+                            f"node rate {rates[src_id]:.2f}/s > "
+                            f"{hot_cut:.2f}/s hot cut")))
+                planned_vids.add(vid)
+                proj[src_id] -= r
+                proj[dst_id] += r
+                if not extra:
+                    pending_add[dst_id] += 1
+                break
+    return moves
+
+
+@dataclass
+class PlannerState:
+    """The oscillation guard both the daemon and clustersim run.
+
+    * two-pass confirmation: a move fires only when two consecutive
+      passes propose the SAME (src, dst) for a volume — one heartbeat
+      round of heat lag must not move data;
+    * cooldown: a volume that completed a move is frozen for
+      cfg.cooldown seconds (no volume moves twice in a window);
+    * ping-pong veto: while a completed A->B move is remembered
+      (4x cooldown), the reverse B->A move is refused outright — under
+      steady heat a volume never retraces its path.
+
+    Clock-free: every method takes `now`, so clustersim replays it on
+    the virtual clock."""
+    cfg: object
+    _proposed: dict = field(default_factory=dict)   # vid -> (sig, count)
+    _last_move: dict = field(default_factory=dict)  # vid -> (t, src, dst)
+
+    def frozen(self, now: float) -> frozenset:
+        self._expire(now)
+        return frozenset(vid for vid, (t, _, _) in self._last_move.items()
+                         if now - t < self.cfg.cooldown)
+
+    def _expire(self, now: float) -> None:
+        horizon = self.cfg.cooldown * 4
+        for vid in [v for v, (t, _, _) in self._last_move.items()
+                    if now - t >= horizon]:
+            self._last_move.pop(vid, None)
+
+    def vetoed(self, move: Move) -> bool:
+        last = self._last_move.get(move.vid)
+        return (last is not None
+                and last[1] == move.dst and last[2] == move.src)
+
+    def confirm(self, moves: list, now: float) -> list:
+        """Fold this pass's proposals into the two-pass counter; returns
+        the moves confirmed (seen twice with an unchanged src->dst).
+        Proposals absent this pass reset — a deficit must be seen on
+        CONSECUTIVE passes, exactly the repair-planner discipline."""
+        cold = self.frozen(now)
+        confirmed: list = []
+        fresh: dict = {}
+        for m in moves:
+            if m.vid in cold or self.vetoed(m):
+                continue
+            sig = (m.src, m.dst)
+            prev = self._proposed.get(m.vid)
+            count = prev[1] + 1 if prev is not None and prev[0] == sig \
+                else 1
+            if count >= 2:
+                # launching drops the counter: the next pass (which may
+                # still see pre-move topology) re-confirms from scratch
+                confirmed.append(m)
+            else:
+                fresh[m.vid] = (sig, count)
+        self._proposed = fresh
+        return confirmed
+
+    def record_done(self, move: Move, now: float) -> None:
+        self._last_move[move.vid] = (now, move.src, move.dst)
+
+    def reset(self) -> None:
+        """A demoted leader forgets its pass counters, so a later
+        re-election starts from a fresh two-pass confirmation."""
+        self._proposed.clear()
+
+    def to_dict(self) -> dict:
+        return {"proposed": {str(v): {"src": s[0][0], "dst": s[0][1],
+                                      "count": s[1]}
+                             for v, s in sorted(self._proposed.items())},
+                "recent_moves": {str(v): {"at": t, "src": s, "dst": d}
+                                 for v, (t, s, d)
+                                 in sorted(self._last_move.items())}}

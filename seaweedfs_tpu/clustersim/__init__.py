@@ -1,0 +1,39 @@
+"""clustersim: deterministic in-process 1000-node control-plane simulator.
+
+The control-plane twin of crashsim (scripts/crashsim.sh): where crashsim
+proves the DATA plane survives a kill at any barrier, clustersim proves
+the CONTROL plane — the real ``Topology``, ``DirectoryRing``, balance
+planner, ``PlannerState`` and repair placement rule, the exact objects
+production masters run — converges, stays bounded, and never oscillates
+at planet scale.  Nothing is mocked at the decision layer; only the
+physical substrate (volume servers, wires, disks) is modeled:
+
+* a **virtual clock** (clock.py) injected into ``Topology`` — zero
+  wall-clock sleeps, so a 1000-node, 200-virtual-second run finishes in
+  seconds and every liveness window (prune timeout, heat decay,
+  cooldown) behaves exactly as in production;
+* **seeded everything** — node layout, scripted kills/flaps/rack loss,
+  heat skew, and the planner's tie-break all derive from one integer.
+  Identical seed => identical event log (the run digest is the sha256
+  of the canonical event log, and the CI gate runs every scenario twice
+  to prove it);
+* **scripted heartbeats** drive the real ``Topology.register_heartbeat``
+  / ``merge_heat`` / ``prune_dead_nodes`` intake, each beat gated by the
+  ``sim.heartbeat`` fault point so flap drills ride the same faults
+  plane as every other chaos drill;
+* a **slot pool** models the master's shared ``_repair_sem`` worker
+  budget with repair-before-balance priority, so the repair-storm
+  scenario proves a rack-loss rebuild drains without balance moves
+  starving it.
+
+Scenarios and their assertions (convergence in bounded ticks, zero
+placement oscillation, ring-bounded movement under churn, repair-storm
+drain) live in scenarios.py; ``python -m seaweedfs_tpu.clustersim``
+(scripts/clustersim.sh) is the CI gate that sweeps seeds x scenarios
+and exits 1 on any violation.
+"""
+
+from .clock import VirtualClock
+from .sim import ClusterSim, SimNode
+
+__all__ = ["VirtualClock", "ClusterSim", "SimNode"]

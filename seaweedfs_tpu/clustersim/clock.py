@@ -1,0 +1,33 @@
+"""The virtual clock every simulated component shares.
+
+``Topology`` takes this as its injected ``clock`` callable, the balance
+planner and ``PlannerState`` take ``now`` arguments — so the whole
+control plane runs on simulated time.  Time only moves when the
+simulator says so; there is no wall-clock anywhere in a run, which is
+what makes a 1000-node, minutes-of-virtual-time scenario finish in
+seconds and replay bit-identically from its seed.
+
+The epoch is deliberately far from zero: production code compares
+timestamps against ``last_seen``/``first_seen`` defaults and a
+zero-epoch sim would sit inside decay half-lives of t=0.
+"""
+
+from __future__ import annotations
+
+EPOCH = 1_700_000_000.0
+
+
+class VirtualClock:
+    __slots__ = ("_now",)
+
+    def __init__(self, start: float = EPOCH):
+        self._now = float(start)
+
+    def now(self) -> float:
+        return self._now
+
+    def advance(self, dt: float) -> float:
+        if dt < 0:
+            raise ValueError("virtual time never rewinds")
+        self._now += dt
+        return self._now

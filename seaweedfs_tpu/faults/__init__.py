@@ -136,6 +136,15 @@ KNOWN_POINTS = frozenset({
                             # after shards/.dat/.idx/.ecx are durable
                             # and BEFORE the .ecm marker — the crash
                             # window the crashsim workload walks
+    "master.balance.plan",  # balancer planning pass — drop = pass
+                            # skipped, error = planner crash drills
+    "master.balance.move",  # balancer volume move, fired BEFORE the
+                            # copy — error/drop here is the worst-case
+                            # kill window the chaos suite proves leaves
+                            # a complete copy on exactly one side
+    "sim.heartbeat",        # clustersim virtual-node heartbeat — drop
+                            # = that node's beat lost this tick (flap /
+                            # dead-node drills at 1000 nodes)
 })
 
 _lock = threading.Lock()

@@ -29,6 +29,9 @@ from aiohttp import web
 from .. import faults, observe, overload
 from ..cluster.raft import RaftNode, _endpoint_ips
 from ..ec.geometry import GeometryPolicy
+from ..balance import BalanceConfig
+from ..balance.daemon import BalancerDaemon
+from ..balance.planner import pick_replica_target
 from ..geo import GeoConfig
 from ..geo.daemon import GeoDaemon
 from ..lifecycle.daemon import LifecycleDaemon
@@ -81,7 +84,8 @@ class MasterServer:
                  ec_geometry_policy: Optional[GeometryPolicy] = None,
                  lifecycle_config: Optional[LifecycleConfig] = None,
                  geo_config: Optional[GeoConfig] = None,
-                 ring_config: Optional[RingConfig] = None):
+                 ring_config: Optional[RingConfig] = None,
+                 balance_config: Optional[BalanceConfig] = None):
         self.topology = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds)
@@ -220,6 +224,13 @@ class MasterServer:
         # source filer is configured (WEED_GEO_FILER / geo_config).
         self.geo = GeoDaemon(self, geo_config or GeoConfig.from_env())
         self._geo_task: Optional[asyncio.Task] = None
+        # balance plane: a leader-only daemon (same sibling discipline)
+        # that moves sealed volumes off heat-hot nodes via the
+        # copy->verify->retire primitives; its planner also makes
+        # /dir/assign heat-aware (coldest-first placement)
+        self.balancer = BalancerDaemon(
+            self, balance_config or BalanceConfig.from_env())
+        self._balance_task: Optional[asyncio.Task] = None
         self.app = self._build_app()
 
     _METALOG_CMDS = ("assign_batch", "seq_floor", "volume_create",
@@ -363,6 +374,8 @@ class MasterServer:
         app.router.add_post("/lifecycle/run", self.lifecycle_run)
         app.router.add_get("/geo/status", self.geo_status)
         app.router.add_post("/geo/run", self.geo_run)
+        app.router.add_get("/balance/status", self.balance_status)
+        app.router.add_post("/balance/run", self.balance_run)
         _faults_handler = faults.admin_handler()
         app.router.add_get("/admin/faults", _faults_handler)
         app.router.add_post("/admin/faults", _faults_handler)
@@ -394,6 +407,9 @@ class MasterServer:
                 self.lifecycle.run_loop())
         if self.geo.cfg.enabled:
             self._geo_task = asyncio.create_task(self.geo.run_loop())
+        if self.balancer.cfg.enabled:
+            self._balance_task = asyncio.create_task(
+                self.balancer.run_loop())
         if self.grpc_port:
             from .master_grpc import serve_master_grpc
             host = (self.url.rsplit(":", 1)[0] if ":" in self.url
@@ -417,6 +433,9 @@ class MasterServer:
         if self._geo_task:
             self._geo_task.cancel()
         await self.geo.aclose()
+        if self._balance_task:
+            self._balance_task.cancel()
+        self.balancer.stop()
         for task in list(self._repair_tasks):
             task.cancel()
         if self._grpc_server is not None:
@@ -797,8 +816,14 @@ class MasterServer:
         # failover)
         if not await self.raft.ensure_ready():
             return None
+        # heat-aware placement: when the balancer is on, new volumes
+        # prefer the coldest racks (same node_rates view the balance
+        # planner ranks by) instead of random shuffle — heat the
+        # balancer would otherwise have to move later never lands
+        heat_rank = self.balancer.assign_rank()
         for _ in range(count):
-            nodes = self.topology.find_empty_slots(replication, data_center)
+            nodes = self.topology.find_empty_slots(replication, data_center,
+                                                   heat_rank=heat_rank)
             if not nodes:
                 break
             # replicate the new MaxVolumeId through raft before allocating
@@ -1309,20 +1334,12 @@ class MasterServer:
         """Re-replicate an under-replicated volume onto a fresh node,
         rack-aware: when the placement spreads racks/DCs, prefer a rack
         the surviving copies don't already occupy (the same constraint
-        find_empty_slots enforces at grow time)."""
-        rp = ReplicaPlacement.parse(replication)
-        held = {n.id for n in holders}
-        candidates = [n for n in self.topology.nodes.values()
-                      if n.free_slots() > 0 and n.id not in held]
-        if not candidates or not holders:
+        find_empty_slots enforces at grow time).  The choice itself
+        lives in balance.planner.pick_replica_target so clustersim
+        drives the identical placement rule."""
+        target = pick_replica_target(self.topology, replication, holders)
+        if target is None:
             return False
-        used_racks = {(n.data_center, n.rack) for n in holders}
-        if rp.diff_rack_count or rp.diff_data_center_count:
-            spread = [n for n in candidates
-                      if (n.data_center, n.rack) not in used_racks]
-            if spread:
-                candidates = spread
-        target = max(candidates, key=lambda n: n.free_slots())
         if not self.raft.is_leader:
             return False
         await self._admin_post(target.url, "volume/copy",
@@ -1389,6 +1406,23 @@ class MasterServer:
         """Trigger one evaluation pass now (operators / tests) — the
         same pass the timer loop runs."""
         out = await self.lifecycle.pass_once()
+        return web.json_response({"ok": True, **out})
+
+    # --- balance plane (heat-driven auto-balancer daemon state) ---
+
+    async def balance_status(self, request: web.Request) -> web.Response:
+        """Balancer state: per-node heat rates, pending/recent moves,
+        two-pass/cooldown bookkeeping (the `cluster.balance.status`
+        shell command's backend)."""
+        return web.json_response(self.balancer.status())
+
+    async def balance_run(self, request: web.Request) -> web.Response:
+        """Trigger one planning pass now (operators / tests / the
+        `cluster.balance.run` shell command) — the same pass the timer
+        loop runs; confirmed moves launch through the shared worker
+        slots."""
+        with overload.priority(overload.CLASS_BG):
+            out = await self.balancer.pass_once()
         return web.json_response({"ok": True, **out})
 
     # --- geo plane (cluster-to-cluster replication daemon state) ---

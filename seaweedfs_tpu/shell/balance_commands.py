@@ -1,0 +1,56 @@
+"""cluster.balance.status + cluster.balance.run: the operator face of
+the heat-driven auto-balancer (balance/daemon.py on the master leader).
+
+``cluster.balance.status`` prints the daemon's full state — per-node
+heat rates (hottest first), in-flight and recent moves, and the
+two-pass/cooldown bookkeeping that explains WHY a proposed move hasn't
+fired yet.  ``cluster.balance.run`` triggers one planning pass
+immediately, the same pass the timer loop runs, and reports what it
+planned/confirmed/launched — the first thing to reach for when a node
+looks hot and you don't want to wait out the interval.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+from .commands import CommandEnv, command, parser
+
+
+def _master_json(env: CommandEnv, path: str, post: bool = False,
+                 timeout: float = 30.0) -> dict:
+    req = urllib.request.Request(
+        f"http://{env.client.master}{path}",
+        data=b"{}" if post else None,
+        headers={"Content-Type": "application/json"} if post else {})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.load(r)
+
+
+@command("cluster.balance.status",
+         "show the auto-balancer's state: per-node heat rates, pending/"
+         "recent moves, two-pass + cooldown bookkeeping "
+         "(cluster.balance.status [-hot N])")
+def cluster_balance_status(env: CommandEnv, argv: list[str]):
+    p = parser("cluster.balance.status")
+    p.add_argument("-hot", type=int, default=10,
+                   help="show only the N hottest nodes (0 = all)")
+    args = p.parse_args(argv)
+    out = _master_json(env, "/balance/status")
+    rates = out.get("node_rates", {})
+    ranked = sorted(rates.items(), key=lambda kv: (-kv[1], kv[0]))
+    if args.hot > 0:
+        ranked = ranked[:args.hot]
+    out["node_rates"] = dict(ranked)
+    out["nodes_tracked"] = len(rates)
+    return out
+
+
+@command("cluster.balance.run",
+         "trigger one balance planning pass now (the same pass the "
+         "timer loop runs); confirmed moves launch through the shared "
+         "repair worker slots (cluster.balance.run)")
+def cluster_balance_run(env: CommandEnv, argv: list[str]):
+    parser("cluster.balance.run").parse_args(argv)
+    return _master_json(env, "/balance/run", post=True, timeout=120.0)

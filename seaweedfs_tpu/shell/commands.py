@@ -135,6 +135,7 @@ def run_command(env: CommandEnv, line_or_argv) -> object:
 def _register_all() -> None:
     """Import every command module for its registration side effects
     (the reference does the same via init() imports, shell/commands.go:42)."""
+    from . import balance_commands  # noqa: F401
     from . import bucket_commands  # noqa: F401
     from . import fs_commands  # noqa: F401
     from . import geo_commands  # noqa: F401

@@ -53,7 +53,8 @@ class EcShardInfo:
 
 class DataNode:
     def __init__(self, node_id: str, url: str, public_url: str,
-                 data_center: str, rack: str, max_volume_count: int):
+                 data_center: str, rack: str, max_volume_count: int,
+                 last_seen: Optional[float] = None):
         self.id = node_id
         self.url = url
         self.public_url = public_url or url
@@ -66,7 +67,7 @@ class DataNode:
         # (lifecycle/heat.py); first_seen anchors idleness for volumes
         # that have never been accessed since this master booted
         self.heat: dict[int, VolumeHeat] = {}
-        self.last_seen = time.time()
+        self.last_seen = last_seen if last_seen is not None else time.time()
 
     def free_slots(self) -> int:
         # EC shards consume fractional slots (TotalShards per volume-equivalent)
@@ -138,12 +139,16 @@ class VolumeLayout:
 
 class Topology:
     def __init__(self, volume_size_limit: int = 30 * 1024 * 1024 * 1024,
-                 pulse_seconds: float = 5.0):
+                 pulse_seconds: float = 5.0, clock=None):
         self.nodes: dict[str, DataNode] = {}
         self.layouts: dict[tuple, VolumeLayout] = {}
         self.volume_size_limit = volume_size_limit
         self.pulse_seconds = pulse_seconds
         self.max_volume_id = 0
+        # injectable clock: every liveness/heat timestamp flows through
+        # it, so clustersim drives the REAL topology against a virtual
+        # clock (zero wall-clock sleeps, replayable from the seed)
+        self._clock = clock if clock is not None else time.time
 
     # --- registration (heartbeat intake,
     #     weed/server/master_grpc_server.go:20-176) ---
@@ -157,9 +162,10 @@ class Topology:
         node = self.nodes.get(node_id)
         if node is None:
             node = DataNode(node_id, url, public_url, data_center or "DefaultDataCenter",
-                            rack or "DefaultRack", max_volume_count)
+                            rack or "DefaultRack", max_volume_count,
+                            last_seen=self._clock())
             self.nodes[node_id] = node
-        node.last_seen = time.time()
+        node.last_seen = self._clock()
         node.max_volume_count = max_volume_count
         before = set(node.volumes) | set(node.ec_shards)
 
@@ -189,9 +195,10 @@ class Topology:
         # heat bookkeeping: every held volume has a record (first_seen
         # anchors idleness); deltas arrive only for changed volumes, so
         # the merge is O(changed); records of departed volumes go
+        born = self._clock()
         for vid in after:
             if vid not in node.heat:
-                node.heat[vid] = VolumeHeat()
+                node.heat[vid] = VolumeHeat(first_seen=born, updated=born)
         for vid in [v for v in node.heat if v not in after]:
             node.heat.pop(vid, None)
         self.merge_heat(node.url, payload.get("heat", []))
@@ -216,12 +223,19 @@ class Topology:
     def prune_dead_nodes(self, timeout: Optional[float] = None
                          ) -> list[dict]:
         timeout = timeout or self.pulse_seconds * 5
-        now = time.time()
+        now = self._clock()
         dead = [nid for nid, n in self.nodes.items()
                 if now - n.last_seen > timeout]
         events = []
         for nid in dead:
+            node = self.nodes.get(nid)
             ev = self.unregister_node(nid)
+            # stale-heat hazard: the pruned node's decayed EWMAs must
+            # vanish WITH it — any retained DataNode reference (a
+            # planner holding last pass's candidate list) would
+            # otherwise keep proposing moves to/from a dead node
+            if node is not None:
+                node.heat.clear()
             if ev:
                 events.append(ev)
         return events
@@ -269,21 +283,32 @@ class Topology:
         node = self.nodes.get(url)
         if node is None:
             return False
-        now = time.time()
+        now = self._clock()
         for entry in entries:
             vh = node.heat.get(entry.get("id"))
             if vh is not None:
                 vh.merge(entry, now)
         return True
 
-    def heat_view(self, now: Optional[float] = None) -> dict[int, dict]:
+    def heat_view(self, now: Optional[float] = None,
+                  live_only: bool = False) -> dict[int, dict]:
         """Cluster-wide per-volume heat, aggregated across holders:
         counts sum (each replica saw distinct requests), last_access is
         the max, read_rate sums (load spreads over replicas), first_seen
-        is the earliest sighting."""
-        now = now if now is not None else time.time()
+        is the earliest sighting.
+
+        ``live_only`` additionally drops nodes that have missed the
+        prune window (pulse*5) but are not pruned yet — the balancer's
+        view, where a dead node's decayed EWMA must never justify a
+        move. The default keeps every registered node: lifecycle policy
+        evaluates idleness with `now` far in the future, where a
+        liveness filter would blind it to the whole cluster."""
+        now = now if now is not None else self._clock()
+        timeout = self.pulse_seconds * 5
         out: dict[int, dict] = {}
         for node in self.nodes.values():
+            if live_only and now - node.last_seen > timeout:
+                continue
             for vid, vh in node.heat.items():
                 d = vh.to_dict(now)
                 agg = out.get(vid)
@@ -311,15 +336,25 @@ class Topology:
 
     # --- growth (weed/topology/volume_growth.go:113-208) ---
     def find_empty_slots(self, replication: str,
-                         data_center: str = "") -> list[DataNode]:
+                         data_center: str = "",
+                         heat_rank: Optional[dict] = None
+                         ) -> list[DataNode]:
         """Pick copy_count nodes satisfying the XYZ placement constraints.
-        Returns [] if impossible."""
+        Returns [] if impossible.  ``heat_rank`` (node id -> heat score,
+        balance/planner.node_rates) makes placement heat-aware: coldest
+        candidates are tried first instead of a uniform shuffle, so new
+        volumes land away from hot nodes — the XYZ spread constraints
+        below apply identically either way."""
         rp = ReplicaPlacement.parse(replication)
         candidates = [n for n in self.nodes.values() if n.free_slots() > 0
                       and (not data_center or n.data_center == data_center)]
         if not candidates:
             return []
-        random.shuffle(candidates)
+        if heat_rank is not None:
+            candidates.sort(key=lambda n: (heat_rank.get(n.id, 0.0),
+                                           -n.free_slots(), n.id))
+        else:
+            random.shuffle(candidates)
         for main in candidates:
             picked = [main]
             used_nodes = {main.id}

@@ -27,3 +27,7 @@ pytest_plugins = ("seaweedfs_tpu.sanitize.pytest_plugin",)
 def pytest_configure(config):
     assert jax.default_backend() == "cpu", jax.default_backend()
     assert len(jax.devices()) == 8, jax.devices()
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 run (-m 'not slow'); the full "
+        "1000-node sweeps and long soaks live here")

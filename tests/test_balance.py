@@ -1,0 +1,387 @@
+"""Unit pins for the balance planner's hard invariants
+(seaweedfs_tpu/balance/planner.py module docstring lists them):
+
+* determinism — same topology view + config + seed => byte-identical
+  plan, even across a full topology rebuild;
+* a move never shrinks a volume's rack/DC diversity, never lands on a
+  holder, never pushes the destination past the capacity watermark;
+* only sealed volumes move; under-replicated / frozen volumes are
+  skipped;
+* PlannerState's oscillation guard: two-pass confirmation, cooldown
+  freeze, A->B->A veto, leader-demotion reset;
+* the stale-heat regression: a dead node's decayed EWMA must never
+  rank it (node_rates / heat_view(live_only=True)), and pruning drops
+  its heat with it;
+* pick_replica_target is rack-aware and `pending` spreads a storm.
+
+Everything here is pure: injected clock, no sockets, no sleeps.
+"""
+
+import json
+
+from seaweedfs_tpu.balance import (BalanceConfig, PlannerState, node_rates,
+                                   pick_replica_target, plan_moves)
+from seaweedfs_tpu.balance.planner import Move
+from seaweedfs_tpu.topology.topology import Topology
+
+MB = 1 << 20
+
+
+class Clock:
+    def __init__(self, t: float = 1_000_000.0):
+        self.t = t
+
+    def now(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def make_topo(clock: Clock, limit: int = 30 * MB,
+              pulse: float = 5.0) -> Topology:
+    return Topology(volume_size_limit=limit, pulse_seconds=pulse,
+                    clock=clock.now)
+
+
+def vol(vid: int, size: int = MB, read_only: bool = True,
+        repl: str = "000") -> dict:
+    return {"id": vid, "collection": "", "size": size,
+            "read_only": read_only, "replica_placement": repl, "ttl": ""}
+
+
+def beat(topo: Topology, clock: Clock, nid: str, dc: str, rack: str,
+         vols: list, rates: dict | None = None, maxv: int = 16) -> None:
+    rates = rates or {}
+    heat = [{"id": v["id"], "reads": 10, "writes": 0,
+             "last_access": clock.now(), "read_rate": rates[v["id"]]}
+            for v in vols if v["id"] in rates]
+    topo.register_heartbeat(nid, nid, nid, dc, rack, maxv,
+                            {"volumes": vols, "ec_shards": [],
+                             "heat": heat})
+
+
+def cfg(**kw) -> BalanceConfig:
+    base = dict(interval=1.0, cooldown=10.0, max_moves=4, min_rate=0.05)
+    base.update(kw)
+    return BalanceConfig(**base)
+
+
+def skewed_topo(clock: Clock) -> Topology:
+    """One hot node (3 hot sealed volumes), five cold empty-ish nodes
+    across two racks."""
+    t = make_topo(clock)
+    beat(t, clock, "hot:80", "dc1", "r0",
+         [vol(1), vol(2), vol(3)], rates={1: 5.0, 2: 4.0, 3: 3.0})
+    for i in range(5):
+        beat(t, clock, f"cold{i}:80", "dc1", f"r{i % 2}",
+             [vol(100 + i)], rates={})
+    return t
+
+
+# ------------------------------------------------------- determinism
+
+def test_plan_deterministic_byte_identical():
+    clock = Clock()
+    c = cfg()
+    plans = []
+    for _ in range(2):  # full rebuild each time: no hidden shared state
+        t = skewed_topo(clock)
+        plan = plan_moves(t, c, clock.now(), seed=7)
+        plans.append(json.dumps([m.to_dict() for m in plan],
+                                sort_keys=True))
+    assert plans[0] == plans[1]
+    assert json.loads(plans[0])  # and the skew actually planned moves
+
+
+def test_seed_only_rotates_ties_never_validity():
+    clock = Clock()
+    t = skewed_topo(clock)
+    c = cfg()
+    for seed in range(5):
+        plan = plan_moves(t, c, clock.now(), seed=seed)
+        assert plan, f"seed {seed} must still drain the hot node"
+        for m in plan:
+            assert m.src == "hot:80" and m.dst != "hot:80"
+
+
+def test_hot_node_drains_to_cold_with_strict_improvement():
+    clock = Clock()
+    t = skewed_topo(clock)
+    plan = plan_moves(t, cfg(), clock.now(), seed=0)
+    assert plan
+    # every move ships heat off the single hot node, and a lone
+    # super-hot volume would not move at all (strict improvement):
+    total = 12.0
+    drained = sum(m.rate for m in plan)
+    assert 0 < drained < total
+    assert {m.vid for m in plan} <= {1, 2, 3}
+
+
+def test_lone_superhot_volume_stays_put():
+    """One node, one hot volume: moving it would only relocate the
+    hotspot — strict improvement refuses, sum(rate^2) stays minimal."""
+    clock = Clock()
+    t = make_topo(clock)
+    beat(t, clock, "hot:80", "dc1", "r0", [vol(1)], rates={1: 50.0})
+    for i in range(4):
+        beat(t, clock, f"cold{i}:80", "dc1", "r1", [], rates={})
+    assert plan_moves(t, cfg(), clock.now(), seed=0) == []
+
+
+# ------------------------------------------------------- invariants
+
+def _rack_topo(clock: Clock, extra_rack: bool) -> Topology:
+    """vids 1,2 replicated 010 across (r0, r1); every cold node sits in
+    r1 (the other holder's rack) unless extra_rack adds one in r2."""
+    t = make_topo(clock)
+    vols = [vol(1, repl="010"), vol(2, repl="010")]
+    beat(t, clock, "a:80", "dc1", "r0", vols, rates={1: 5.0, 2: 4.0})
+    beat(t, clock, "b:80", "dc1", "r1", vols, rates={})
+    for i in range(3):
+        beat(t, clock, f"cold{i}:80", "dc1", "r1", [], rates={})
+    if extra_rack:
+        beat(t, clock, "fresh:80", "dc1", "r2", [], rates={})
+    return t
+
+
+def test_move_never_shrinks_rack_spread():
+    clock = Clock()
+    # all destinations share the surviving holder's rack: moving the r0
+    # replica anywhere would collapse 2 racks -> 1, so nothing moves
+    assert plan_moves(_rack_topo(clock, extra_rack=False),
+                      cfg(), clock.now(), seed=0) == []
+    # one destination in a third rack: now the drain is legal
+    plan = plan_moves(_rack_topo(clock, extra_rack=True),
+                      cfg(), clock.now(), seed=0)
+    assert plan and all(m.dst == "fresh:80" for m in plan)
+
+
+def test_move_never_targets_a_holder():
+    clock = Clock()
+    t = _rack_topo(clock, extra_rack=True)
+    for m in plan_moves(t, cfg(), clock.now(), seed=0):
+        assert m.dst not in ("a:80", "b:80")
+
+
+def test_watermark_caps_destination():
+    clock = Clock()
+    t = make_topo(clock)
+    beat(t, clock, "hot:80", "dc1", "r0",
+         [vol(1), vol(2)], rates={1: 5.0, 2: 4.0})
+    # destinations have free slots, but one more volume would cross the
+    # 50% watermark (2+1 > 0.5 * 4)
+    for i in range(3):
+        beat(t, clock, f"cold{i}:80", "dc1", "r1",
+             [vol(200 + 2 * i), vol(201 + 2 * i)], rates={}, maxv=4)
+    assert plan_moves(t, cfg(watermark=0.5), clock.now(), seed=0) == []
+    assert plan_moves(t, cfg(watermark=1.0), clock.now(), seed=0)
+
+
+def test_unsealed_volume_never_moves():
+    clock = Clock()
+    t = make_topo(clock)
+    # writable and far from full: a mid-write copy would race acks
+    beat(t, clock, "hot:80", "dc1", "r0",
+         [vol(1, read_only=False), vol(2, read_only=False)],
+         rates={1: 5.0, 2: 4.0})
+    beat(t, clock, "cold:80", "dc1", "r1", [], rates={})
+    assert plan_moves(t, cfg(), clock.now(), seed=0) == []
+    # size past FULL_FRACTION of the limit counts as sealed even if
+    # not read_only
+    t2 = make_topo(clock)
+    beat(t2, clock, "hot:80", "dc1", "r0",
+         [vol(1, size=29 * MB, read_only=False),
+          vol(2, size=29 * MB, read_only=False)],
+         rates={1: 5.0, 2: 4.0})
+    beat(t2, clock, "cold:80", "dc1", "r1", [], rates={})
+    assert plan_moves(t2, cfg(), clock.now(), seed=0)
+
+
+def test_under_replicated_volume_is_repairs_business():
+    clock = Clock()
+    t = make_topo(clock)
+    # 010 wants 2 copies but only one live holder reports it
+    beat(t, clock, "hot:80", "dc1", "r0",
+         [vol(1, repl="010"), vol(2, repl="010")],
+         rates={1: 5.0, 2: 4.0})
+    beat(t, clock, "cold:80", "dc1", "r1", [], rates={})
+    assert plan_moves(t, cfg(), clock.now(), seed=0) == []
+
+
+def test_frozen_vids_skipped():
+    clock = Clock()
+    t = skewed_topo(clock)
+    plan = plan_moves(t, cfg(), clock.now(), seed=0,
+                      frozen=frozenset({1, 2, 3}))
+    assert plan == []
+
+
+def test_overreplicated_hot_volume_plans_retire_only():
+    """The crashed-move signature: a 000 volume with TWO live holders.
+    The plan must target the existing holder (retire-only — the daemon
+    skips the copy), never a third node (which would widen the
+    surplus)."""
+    clock = Clock()
+    t = make_topo(clock)
+    beat(t, clock, "hot:80", "dc1", "r0",
+         [vol(1), vol(2)], rates={1: 5.0, 2: 4.0})
+    beat(t, clock, "half:80", "dc1", "r1", [vol(1)], rates={})
+    beat(t, clock, "colder:80", "dc1", "r1", [], rates={})
+    plan = plan_moves(t, cfg(), clock.now(), seed=0)
+    by_vid = {m.vid: m for m in plan}
+    assert by_vid[1].dst == "half:80"
+    assert "retire" in by_vid[1].reason
+    # the healthy hot volume still plans a normal copy move
+    assert 2 not in by_vid or by_vid[2].dst == "colder:80"
+
+
+def test_retire_never_breaks_spread():
+    """An over-replicated 010 volume whose surplus copy is the ONLY one
+    in its rack cannot be retired — dropping it would collapse the
+    2-rack spread the placement demands."""
+    clock = Clock()
+    t = make_topo(clock)
+    vols = [vol(1, repl="010"), vol(2, repl="010")]
+    beat(t, clock, "a:80", "dc1", "r0", vols, rates={1: 5.0, 2: 4.0})
+    beat(t, clock, "b:80", "dc1", "r1", [vol(1, repl="010")], rates={})
+    beat(t, clock, "c:80", "dc1", "r1", [vol(1, repl="010")], rates={})
+    beat(t, clock, "d:80", "dc1", "r1", vols[1:], rates={})
+    # vid 1 has 3 holders for copy_count 2: retiring a:80's copy would
+    # leave both copies in r1 -> refused; no copy move either (surplus)
+    for m in plan_moves(t, cfg(), clock.now(), seed=0):
+        assert m.vid != 1
+
+
+# ------------------------------------------------------- PlannerState
+
+def _mv(vid=1, src="a:80", dst="b:80") -> Move:
+    return Move(vid=vid, collection="", src=src, dst=dst, src_url=src,
+                dst_url=dst, bytes=MB, rate=1.0, reason="test")
+
+
+def test_two_pass_confirmation():
+    st = PlannerState(cfg())
+    assert st.confirm([_mv()], 0.0) == []          # first sighting
+    out = st.confirm([_mv()], 1.0)                 # same src->dst again
+    assert [m.vid for m in out] == [1]
+    # launching dropped the counter: the next identical pass starts over
+    assert st.confirm([_mv()], 2.0) == []
+
+
+def test_changed_destination_resets_confirmation():
+    st = PlannerState(cfg())
+    st.confirm([_mv(dst="b:80")], 0.0)
+    assert st.confirm([_mv(dst="c:80")], 1.0) == []
+    assert st.confirm([_mv(dst="c:80")], 2.0)
+
+
+def test_absence_resets_confirmation():
+    st = PlannerState(cfg())
+    st.confirm([_mv()], 0.0)
+    st.confirm([], 1.0)            # proposal vanished for one pass
+    assert st.confirm([_mv()], 2.0) == []
+
+
+def test_cooldown_freeze_and_pingpong_veto():
+    c = cfg(cooldown=10.0)
+    st = PlannerState(c)
+    st.record_done(_mv(src="a:80", dst="b:80"), now=100.0)
+    assert 1 in st.frozen(105.0)           # inside the cooldown window
+    assert 1 not in st.frozen(111.0)       # window over
+    rev = _mv(src="b:80", dst="a:80")
+    assert st.vetoed(rev)                  # ...but B->A stays refused
+    st.confirm([rev], 111.0)
+    assert st.confirm([rev], 112.0) == []  # veto blocks confirmation too
+    # the veto memory itself expires after 4x cooldown
+    assert not st.frozen(150.0) and not st.vetoed(rev)
+
+
+def test_leader_demotion_reset_clears_counters():
+    st = PlannerState(cfg())
+    st.confirm([_mv()], 0.0)
+    st.reset()
+    assert st.confirm([_mv()], 1.0) == []  # back to pass one
+
+
+# ----------------------------------------------- stale-heat regression
+
+def test_dead_node_heat_never_ranks(pruned: bool = False):
+    """The stale-heat hazard: a node that stopped heartbeating keeps a
+    decayed EWMA in its DataNode until pruned — node_rates and
+    heat_view(live_only=True) must both ignore it immediately, and
+    pruning must drop the heat with the node."""
+    clock = Clock()
+    t = make_topo(clock, pulse=1.0)
+    beat(t, clock, "dead:80", "dc1", "r0", [vol(1)], rates={1: 9.0})
+    beat(t, clock, "live:80", "dc1", "r1", [vol(2)], rates={2: 1.0})
+    clock.advance(20.0)  # past the prune window (pulse * 5)
+    beat(t, clock, "live:80", "dc1", "r1", [vol(2)], rates={2: 1.0})
+
+    now = clock.now()
+    rates = node_rates(t, now)
+    assert "dead:80" not in rates and "live:80" in rates
+    view = t.heat_view(now, live_only=True)
+    assert 1 not in view
+    assert view[2]["read_rate"] > 0.0
+    # the planner sees the same: no move can involve the dead node
+    for m in plan_moves(t, cfg(), now, seed=0):
+        assert "dead:80" not in (m.src, m.dst)
+
+    pruned_events = t.prune_dead_nodes()
+    assert [e["url"] for e in pruned_events] == ["dead:80"]
+    assert "dead:80" not in t.nodes
+    assert 1 not in t.heat_view(now)  # default view is clean post-prune
+
+
+def test_heat_view_default_keeps_idle_nodes():
+    """Lifecycle evaluates idleness with `now` far in the future — the
+    default (non-live_only) view must keep every registered node."""
+    clock = Clock()
+    t = make_topo(clock, pulse=1.0)
+    beat(t, clock, "a:80", "dc1", "r0", [vol(1)], rates={1: 2.0})
+    future = clock.now() + 3600.0
+    assert 1 in t.heat_view(future)
+    assert 1 not in t.heat_view(future, live_only=True)
+
+
+# ------------------------------------------- repair target placement
+
+def _target_topo(clock: Clock) -> Topology:
+    t = make_topo(clock)
+    beat(t, clock, "h0:80", "dc1", "r0", [vol(1, repl="010")], maxv=8)
+    beat(t, clock, "same:80", "dc1", "r0", [], maxv=8)
+    beat(t, clock, "other1:80", "dc1", "r1", [], maxv=8)
+    beat(t, clock, "other2:80", "dc1", "r1", [], maxv=8)
+    return t
+
+
+def test_pick_replica_target_prefers_fresh_rack():
+    clock = Clock()
+    t = _target_topo(clock)
+    holders = [t.nodes["h0:80"]]
+    tgt = pick_replica_target(t, "010", holders)
+    assert tgt is not None and tgt.rack == "r1"
+
+
+def test_pick_replica_target_pending_spreads_storm():
+    clock = Clock()
+    t = _target_topo(clock)
+    holders = [t.nodes["h0:80"]]
+    pending: dict[str, int] = {}
+    picked = []
+    for _ in range(2):
+        tgt = pick_replica_target(t, "010", holders, pending=pending)
+        pending[tgt.id] = pending.get(tgt.id, 0) + 1
+        picked.append(tgt.id)
+    # without the pending discount both picks stampede the same node
+    assert len(set(picked)) == 2, picked
+
+
+def test_pick_replica_target_never_picks_holder():
+    clock = Clock()
+    t = make_topo(clock)
+    beat(t, clock, "h0:80", "dc1", "r0", [vol(1, repl="010")])
+    beat(t, clock, "h1:80", "dc1", "r1", [vol(1, repl="010")])
+    holders = [t.nodes["h0:80"], t.nodes["h1:80"]]
+    assert pick_replica_target(t, "010", holders) is None

@@ -1,0 +1,229 @@
+"""Live mini-cluster chaos for the auto-balancer (the ISSUE acceptance
+drills): a heat-skewed node drains through the real copy->verify->retire
+move path with ZERO acked-read/write loss while the
+``master.balance.move`` fault kills the first attempt at the worst
+moment, and a crash between copy and retire leaves a complete copy on
+at least one side (here: both) that the next pass converges to exactly
+one.
+
+Heat is REAL end to end: client downloads bump the volume server's
+HeatTracker, heartbeats drain the deltas, the master's topology merges
+them, and the balancer daemon — running on its timer, not poked by the
+test — plans from that view.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from cluster_util import Cluster
+from seaweedfs_tpu import faults
+from seaweedfs_tpu.balance import BalanceConfig
+
+
+def _wait(predicate, timeout=40.0, what=""):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timeout waiting for {what}")
+
+
+@pytest.fixture()
+def fast_heat():
+    """Shrink the volume servers' heat EWMA window so a burst of reads
+    ranks a node hot within a couple of heartbeats."""
+    old = os.environ.get("WEED_LIFECYCLE_HEAT_HALFLIFE")
+    os.environ["WEED_LIFECYCLE_HEAT_HALFLIFE"] = "2"
+    faults.clear()
+    yield
+    faults.clear()
+    if old is None:
+        os.environ.pop("WEED_LIFECYCLE_HEAT_HALFLIFE", None)
+    else:
+        os.environ["WEED_LIFECYCLE_HEAT_HALFLIFE"] = old
+
+
+def _balance_cluster(interval: float) -> Cluster:
+    return Cluster(
+        n_volume_servers=3, pulse=0.15,
+        master_kwargs={"balance_config": BalanceConfig(
+            interval=interval, cooldown=3.0, max_moves=2,
+            min_rate=0.01, watermark=1.0, force_enabled=True)})
+
+
+def _sealed_pair(c: Cluster):
+    """Upload ~0.95MB blobs (volume limit is 1MB: each seals its
+    volume by size) into distinct collections — one volume each — until
+    one server holds two of them; a single hot volume would (correctly)
+    never move, the strict-improvement guard refuses to relocate a lone
+    hotspot.  Returns (node_url, [(vid, fid, data), (vid, fid, data)])."""
+    held: dict[str, list] = {}
+    for i in range(8):
+        data = bytes([65 + i]) * 972_800
+        fid = c.client.upload(data, collection=f"hot{i}")
+        vid = int(fid.split(",")[0])
+        c.wait_heartbeats()
+        holder = next(vs.url for vs in c.volume_servers
+                      if vs.store.find_volume(vid) is not None)
+        held.setdefault(holder, []).append((vid, fid, data))
+        if len(held[holder]) >= 2:
+            return holder, held[holder][:2]
+    raise AssertionError(f"no server ever held two volumes: {held}")
+
+
+class _Reader(threading.Thread):
+    """Hammers both hot blobs for the whole test: the heat source AND
+    the zero-acked-read-loss probe.  Every successful read must return
+    the exact bytes; transient lookup races during the move window are
+    tolerated but counted."""
+
+    def __init__(self, c: Cluster, blobs):
+        super().__init__(daemon=True)
+        self.c, self.blobs = c, blobs
+        self.stop = threading.Event()
+        self.ok = 0
+        self.transient = 0
+        self.corrupt = 0
+
+    def run(self):
+        while not self.stop.is_set():
+            for vid, fid, data in self.blobs:
+                self.c.client._vid_cache.clear()
+                try:
+                    got = self.c.client.download(fid)
+                except Exception:
+                    self.transient += 1
+                    continue
+                if got == data:
+                    self.ok += 1
+                else:
+                    self.corrupt += 1
+            time.sleep(0.01)
+
+
+def test_hot_node_drains_zero_loss_through_injected_move_kill(fast_heat):
+    """The headline acceptance: reads heat one node, the balancer
+    drains it; the FIRST move attempt dies on the master.balance.move
+    fault (fired before the copy — the worst-case kill window) leaving
+    the source complete; the retry converges; no read ever returned
+    wrong bytes and every acked write stays readable."""
+    c = _balance_cluster(interval=0.25)
+    try:
+        leader = c.master
+        src_url, blobs = _sealed_pair(c)
+        # worst-case kill: the first move dies before its copy starts
+        faults.set_fault("master.balance.move", "error", count=1)
+
+        reader = _Reader(c, blobs)
+        reader.start()
+        writer_fids = []
+        try:
+            _wait(lambda: leader.balancer.recent
+                  and any(e["outcome"] == "failed"
+                          for e in leader.balancer.recent),
+                  timeout=45, what="injected move failure")
+            failed = next(e for e in leader.balancer.recent
+                          if e["outcome"] == "failed")
+            assert "master.balance.move" in failed["error"]
+            # the killed move destroyed nothing: the source still
+            # holds both volumes (the reader is proving it continuously)
+            src_vs = next(vs for vs in c.volume_servers
+                          if vs.url == src_url)
+            for vid, _, _ in blobs:
+                assert src_vs.store.find_volume(vid) is not None
+
+            # acked writes during the move window must never be lost
+            for i in range(3):
+                writer_fids.append(
+                    (c.client.upload(b"w%d" % i * 64), b"w%d" % i * 64))
+
+            _wait(lambda: leader.balancer.moves_done >= 1, timeout=60,
+                  what="retried move to complete")
+        finally:
+            reader.stop.set()
+            reader.join(timeout=10)
+
+        moved = next(e for e in leader.balancer.recent
+                     if e["outcome"] == "ok")
+        assert moved["src"] == src_url
+        vid = moved["volume"]
+        c.wait_heartbeats()
+        # exactly one complete copy, on the destination
+        holders = [vs.url for vs in c.volume_servers
+                   if vs.store.find_volume(vid) is not None]
+        assert holders == [moved["dst"]], holders
+        # zero acked-read loss: plenty of reads landed, none corrupt,
+        # and both blobs read back exactly after the move
+        assert reader.ok > 0 and reader.corrupt == 0, vars(reader)
+        for _, fid, data in blobs:
+            c.client._vid_cache.clear()
+            assert c.client.download(fid) == data
+        for fid, data in writer_fids:
+            assert c.client.download(fid) == data
+    finally:
+        faults.clear()
+        c.shutdown()
+
+
+def test_crash_between_copy_and_retire_leaves_complete_copy(fast_heat):
+    """Kill the move AFTER the copy verified but BEFORE the source
+    retires (a daemon crash in the other half of the window): both
+    sides hold a complete copy — never neither — and the retry's
+    resume path (_dst_has_volume short-circuit) retires the source
+    without re-copying."""
+    c = _balance_cluster(interval=0.3)
+    try:
+        leader = c.master
+        src_url, blobs = _sealed_pair(c)
+
+        copies, crashed = [], []
+        orig = leader._admin_post
+
+        async def flaky(url, op, body, timeout=60.0):
+            if op == "volume/copy":
+                copies.append(url)
+            if op == "volume/delete" and not crashed:
+                crashed.append(url)
+                raise RuntimeError("injected crash before retire")
+            return await orig(url, op, body, timeout=timeout)
+
+        leader._admin_post = flaky
+        reader = _Reader(c, blobs)
+        reader.start()
+        try:
+            _wait(lambda: crashed, timeout=45,
+                  what="move to crash between copy and retire")
+            # the window the invariant is about: copy landed, retire
+            # didn't — BOTH sides complete, reads keep flowing
+            failed = next(e for e in leader.balancer.recent
+                          if e["outcome"] == "failed")
+            vid = failed["volume"]
+            holders = [vs.url for vs in c.volume_servers
+                       if vs.store.find_volume(vid) is not None]
+            assert len(holders) == 2 and src_url in holders, holders
+
+            _wait(lambda: leader.balancer.moves_done >= 1, timeout=60,
+                  what="resume path to retire the source")
+        finally:
+            reader.stop.set()
+            reader.join(timeout=10)
+
+        moved = next(e for e in leader.balancer.recent
+                     if e["outcome"] == "ok")
+        assert moved["volume"] == vid
+        # resume path: the retry never re-copied (one copy total)
+        assert len(copies) == 1, copies
+        c.wait_heartbeats()
+        holders = [vs.url for vs in c.volume_servers
+                   if vs.store.find_volume(vid) is not None]
+        assert holders == [moved["dst"]], holders
+        assert reader.corrupt == 0 and reader.ok > 0, vars(reader)
+        for _, fid, data in blobs:
+            c.client._vid_cache.clear()
+            assert c.client.download(fid) == data
+    finally:
+        c.shutdown()

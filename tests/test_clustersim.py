@@ -1,0 +1,106 @@
+"""clustersim pins: the deterministic control-plane simulator drives
+REAL Topology/planner/PlannerState/pick_replica_target code over
+scripted fleets (seaweedfs_tpu/clustersim/).
+
+Fast cells here run the full scenario suite at small node counts so
+the tier-1 suite exercises every scenario's assertions on every run;
+the 1000-node sweep itself is the CI gate (scripts/clustersim.sh) and
+a `slow`-marked test below.
+"""
+
+import pytest
+
+from seaweedfs_tpu.clustersim import ClusterSim, VirtualClock
+from seaweedfs_tpu.clustersim.scenarios import (SCENARIOS, TICKS,
+                                                run_scenario)
+
+
+def test_virtual_clock_monotone():
+    c = VirtualClock()
+    t0 = c.now()
+    c.advance(2.5)
+    assert c.now() == t0 + 2.5
+    with pytest.raises(ValueError):
+        c.advance(-1.0)
+
+
+def test_identical_seed_identical_digest():
+    """The determinism contract: every scenario, run twice from one
+    seed, produces a byte-identical event log — including `churn`,
+    whose kills/flaps AND seeded heartbeat-drop fault must replay."""
+    for name in SCENARIOS:
+        a = run_scenario(name, seed=3, nodes=40)
+        b = run_scenario(name, seed=3, nodes=40)
+        assert a["digest"] == b["digest"], f"{name} diverged"
+
+
+def test_different_seed_different_churn():
+    a = run_scenario("churn", seed=1, nodes=40)
+    b = run_scenario("churn", seed=2, nodes=40)
+    assert a["digest"] != b["digest"]  # the seed actually steers it
+
+
+def test_steady_cluster_plans_nothing():
+    rep = run_scenario("steady", seed=0, nodes=30)
+    assert rep["violations"] == []
+    assert rep["moves"] == 0 and rep["moved_bytes"] == 0
+
+
+def test_skew_converges_without_oscillation():
+    rep = run_scenario("skew", seed=0, nodes=60)
+    assert rep["violations"] == []
+    assert rep["moves"] > 0
+    assert rep["converge_tick"] is not None
+    assert rep["moved_bytes_ratio"] < 0.2  # drained, not reshuffled
+
+
+def test_churn_keeps_movement_bounded():
+    rep = run_scenario("churn", seed=1, nodes=60)
+    assert rep["violations"] == []
+    assert rep["moves"] == 0          # churn alone never triggers balance
+    assert rep["deficits_left"] == 0  # kills healed
+    assert rep["ring_moved_dirs"] <= rep["ring_moved_bound"]
+
+
+def test_rackloss_drains_without_starving_repair():
+    rep = run_scenario("rackloss", seed=0, nodes=60)
+    assert rep["violations"] == []
+    assert rep["repairs"] > 0
+    assert rep["deficits_left"] == 0
+    assert rep["balance_start_while_repair_pending"] == 0
+
+
+def test_sim_runs_real_topology():
+    """The sim's whole point: state lives in the production Topology,
+    not a model — heartbeats register real DataNodes, kills prune them."""
+    sim = ClusterSim(nodes=12, seed=0)
+    sim.at(3, "kill", 0)
+    sim.run(40)
+    assert len(sim.topology.nodes) == 11
+    assert sim.nodes[0].id not in sim.topology.nodes
+    assert any(e["e"] == "pruned" for e in sim.events)
+
+
+def test_sim_script_replay_is_exact():
+    """Same scripted kills + heat => identical digest, tick for tick."""
+    def build():
+        sim = ClusterSim(nodes=24, seed=5)
+        sim.at(2, "kill", 3)
+        sim.at(6, "revive", 3)
+        for vid in sorted(sim.node(1).volumes):
+            sim.at(4, "heat", 1, vid, 3.0)
+        sim.run(60)
+        return sim
+    assert build().digest() == build().digest()
+
+
+@pytest.mark.slow
+def test_full_scale_sweep_1000_nodes():
+    """The acceptance cell: every scenario at 1000 nodes, clean and
+    deterministic (scripts/clustersim.sh runs the same sweep in CI)."""
+    for name in SCENARIOS:
+        a = run_scenario(name, seed=0, nodes=1000)
+        b = run_scenario(name, seed=0, nodes=1000)
+        assert a["digest"] == b["digest"], f"{name} nondeterministic"
+        assert a["violations"] == [], f"{name}: {a['violations']}"
+        assert a["ticks"] == TICKS[name]

@@ -80,7 +80,34 @@ def test_metaring_scope_pinned():
     # its per-plane "guards something" check keys off these prefixes
     assert tuple(RULES["daemon-loop-shedable"].scope) == (
         "seaweedfs_tpu/lifecycle/", "seaweedfs_tpu/geo/",
-        "seaweedfs_tpu/metaring/")
+        "seaweedfs_tpu/metaring/", "seaweedfs_tpu/balance/",
+        "seaweedfs_tpu/clustersim/")
+
+
+def test_balance_scope_pinned():
+    """The balance plane moves data (a bad daemon loop stampedes volume
+    servers; a leaked session pins sockets for the life of the master)
+    and clustersim is the harness later scale claims are verified
+    against — both must stay inside the daemon-loop / async-blocking /
+    resource-leak guards. A scope edit that drops either directory
+    silently un-lints the control plane."""
+    for name in ("daemon-loop-shedable", "async-blocking-call",
+                 "resource-leak"):
+        rule = RULES[name]
+        for path in ("seaweedfs_tpu/balance/daemon.py",
+                     "seaweedfs_tpu/balance/planner.py",
+                     "seaweedfs_tpu/clustersim/sim.py",
+                     "seaweedfs_tpu/clustersim/scenarios.py"):
+            assert rule.applies_to(path), \
+                f"rule {name} no longer covers {path}"
+    # and the balance/sim fault points must stay in the registry:
+    # firing an unknown point silently no-ops the chaos drills the
+    # acceptance criteria lean on
+    from seaweedfs_tpu import faults
+    for point in ("master.balance.plan", "master.balance.move",
+                  "sim.heartbeat"):
+        assert point in faults.KNOWN_POINTS, \
+            f"fault point {point} dropped from faults.KNOWN_POINTS"
 
 
 def test_observe_scope_pinned():
