@@ -591,10 +591,14 @@ def phase_rebuild(work: str, budget_s: float = 580.0) -> dict:
     return out
 
 
-def bench_kernel(k: int, m: int, n: int, reps: int, tile=None, rounds=1):
+def bench_kernel(k: int, m: int, n: int, reps: int, tile=None, rounds=1,
+                 method=None):
     """Pinned kernel measurement (unchanged from round 3): fixed n and
     reps, one warm+correctness pass, `rounds` timed rounds; returns
-    (median GB/s, spread)."""
+    (median GB/s, spread). `method` selects the GF formulation
+    (rs_jax.FORMULATIONS; on TPU the Pallas twin where one exists) —
+    None keeps the historical default so pinned-anchor numbers stay
+    comparable across bench rounds."""
     import jax
 
     from seaweedfs_tpu.ops import gf256, rs_jax, rs_pallas
@@ -602,10 +606,18 @@ def bench_kernel(k: int, m: int, n: int, reps: int, tile=None, rounds=1):
     data = jax.numpy.asarray(
         np.random.default_rng(0).integers(0, 256, (k, n), dtype=np.uint8))
     if jax.default_backend() == "tpu":
-        fn = rs_pallas.gf_apply_pallas(
-            gf256.parity_matrix(k, m), tile=tile or rs_pallas.DEFAULT_TILE)
-    else:
+        if method in (None, "bitplane", "xorsched"):
+            fn = rs_pallas.gf_apply_pallas(
+                gf256.parity_matrix(k, m),
+                tile=tile or rs_pallas.DEFAULT_TILE,
+                formulation=method or "bitplane")
+        else:  # lut has no Pallas twin: measure the XLA program
+            fn = jax.jit(rs_jax.gf_apply(method,
+                                         gf256.parity_matrix(k, m)))
+    elif method is None:
         fn = jax.jit(rs_jax.gf_apply_bitplane(gf256.parity_matrix(k, m)))
+    else:
+        fn = jax.jit(rs_jax.gf_apply(method, gf256.parity_matrix(k, m)))
     out = fn(data)
     out.block_until_ready()
 
@@ -705,9 +717,29 @@ def phase_kernel(work: str = "", budget_s: float = 390.0) -> dict:
     tiles: dict = {tl: not_reached
                    for tl in dict.fromkeys(
                        (rs_pallas.DEFAULT_TILE, 65536, 131072))}
+    forms: dict = {f"{f}:{k},{m}": not_reached
+                   for f in ("lut", "bitplane", "xorsched")
+                   for (k, m) in ((10, 4), (12, 4), (20, 4))}
     out["sweep_kernel_gbps"] = sweep
     out["tile_sweep_gbps"] = tiles
+    out["formulation_sweep_gbps"] = forms
     ckpt()
+
+    # 2a) static formulation metric: compiled-HLO element-ops per input
+    # byte for each formulation's RS(10,4) encode program (xorsched's is
+    # the packed bit-plane-resident per-batch program — the one the
+    # windowed path actually launches). Cheap (lower+compile, no timed
+    # loop) and meaningful without a TPU, so it lands before the sweeps.
+    from seaweedfs_tpu.ops import rs_jax as _rs_jax
+    hlo: dict = {}
+    out["hlo_ops_per_byte"] = hlo
+    for f in ("lut", "bitplane", "xorsched"):
+        try:
+            hlo[f] = round(
+                _rs_jax.encode_hlo_ops_per_byte(10, 4, method=f), 2)
+        except Exception as e:
+            hlo[f] = f"error: {type(e).__name__}: {str(e)[:160]}"
+        ckpt()
     for (k, m) in ((20, 4), (12, 4), (6, 3)):
         if left() < last * 1.2:
             sweep[f"{k},{m}"] = (f"skipped: budget ({left():.0f}s left, "
@@ -747,6 +779,33 @@ def phase_kernel(work: str = "", budget_s: float = 390.0) -> dict:
         tiles[tl] = round(g, 2)
         ckpt()
 
+    # 4) formulation sweep: {lut, bitplane, xorsched} x geometry. On CPU
+    # hosts this times the XLA programs (relative ordering only); the
+    # TPU round times the Pallas twins where they exist. Same budget
+    # convention as the other sweeps: every unvisited cell keeps a
+    # reason string, never a null.
+    for key in list(forms):
+        f, geo = key.split(":")
+        k, m = (int(x) for x in geo.split(","))
+        if left() < last * 1.2:
+            forms[key] = (f"skipped: budget ({left():.0f}s left, "
+                          f"cell needs ~{last * 1.2:.0f}s)")
+            ckpt()
+            continue
+        t0 = time.perf_counter()
+        nn = n - n % (16384 * 8)
+        try:
+            g, _, _ = bench_kernel(k, m, nn, reps, method=f)
+        except Exception as e:
+            forms[key] = (f"error: {type(e).__name__}: "
+                          f"{str(e)[:160]}")
+            last = max(45.0, time.perf_counter() - t0)
+            ckpt()
+            continue
+        last = max(45.0, time.perf_counter() - t0)
+        forms[key] = round(g, 2)
+        ckpt()
+
     # arithmetic context for the kernel number
     ops_per_s = 128 * 4 * out["kernel"]["gbps"] * 1e9
     out["kernel"]["mxu_fraction"] = round(ops_per_s / 394e12, 4)
@@ -754,10 +813,18 @@ def phase_kernel(work: str = "", budget_s: float = 390.0) -> dict:
                                           4)
     out["kernel"]["bound"] = (
         "VPU (bitplane expand/repack): ~18 int32 VPU ops/input byte puts "
-        "the formulation's ceiling near 52 GB/s on v5e; an MXU-repack "
+        "that formulation's ceiling near 52 GB/s on v5e; an MXU-repack "
         "variant measured SLOWER (32.4 vs 35.4 GB/s — M=4 rows occupy "
         "~3% of the systolic array; see ops/rs_pallas.py). Wider "
-        "geometries amortize the expand: RS(20,4) exceeds 60 GB/s.")
+        "geometries amortize the expand: RS(20,4) exceeds 60 GB/s. The "
+        "xorsched formulation (ops/xor_schedule.py) removes the bound's "
+        "cause instead of amortizing it: a CSE'd XOR schedule over "
+        "uint32-packed bit-plane words cuts RS(10,4) to ~2.3 compiled "
+        "element-ops/input byte (hlo_ops_per_byte; schedule 499 XORs vs "
+        "the 1192 dense popcount bound) with zero expansion traffic "
+        "when batches stay bit-plane-resident across the window "
+        "(ec/coder.py stage-time pack) — its ceiling is HBM streaming, "
+        "not the VPU; chip-side GB/s lands at the next TPU-host round.")
     return out
 
 
@@ -1030,6 +1097,86 @@ def bench_system(work: str, n: int = 6000, size: int = 1024,
         }
     except Exception as e:
         out["scaling"] = {"error": str(e)}
+
+    def _one_sharded(shards: int) -> dict:
+        # the share-nothing SO_REUSEPORT fleet (server/sharded.py): the
+        # combined `server` command doesn't fork shards, so this boots
+        # the phase_saturation shape — master + WEED_SERVE_SHARDS=N
+        # volume — on this phase's ports
+        mport, vport = 19555, 18555
+        base = os.path.join(work, f"sysbench_sh{shards}")
+        mdir, vdir = os.path.join(base, "m"), os.path.join(base, "v")
+        os.makedirs(mdir, exist_ok=True)
+        os.makedirs(vdir, exist_ok=True)
+        senv = dict(env, WEED_SERVE_SHARDS=str(shards))
+        procs = [subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu.cli", "master",
+             "-port", str(mport), "-mdir", mdir, "-grpc_port", "0",
+             "-pulse", "1"], env=senv,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)]
+        try:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "seaweedfs_tpu.cli", "volume",
+                 "-port", str(vport), "-dir", vdir,
+                 "-mserver", f"127.0.0.1:{mport}", "-grpc_port", "0",
+                 "-pulse", "1"], env=senv,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+            deadline = time.time() + 60
+            while True:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{mport}/dir/assign",
+                            timeout=2) as r:
+                        if "fid" in json.loads(r.read()):
+                            break
+                except Exception:
+                    pass
+                if time.time() > deadline:
+                    raise RuntimeError(
+                        f"shards={shards} fleet failed to start")
+                time.sleep(0.3)
+            time.sleep(1.0)  # first stripe tick publishes shard routes
+            run_benchmark(f"127.0.0.1:{mport}", n=400, size=size,
+                          concurrency=concurrency)
+            return run_benchmark(f"127.0.0.1:{mport}", n=n, size=size,
+                                 concurrency=concurrency)
+        finally:
+            for p in reversed(procs):
+                p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            time.sleep(0.5)
+
+    # multi-core re-baseline row: the single-process numbers above stand
+    # next to the sharded fleet's, so the next bench round on a
+    # multi-core host re-anchors the serving baseline without a code
+    # change; a 1-core host records WHY there's no row instead of a null
+    cores = os.cpu_count() or 1
+    if cores > 1:
+        shards = max(2, min(4, cores))
+        try:
+            sh = _one_sharded(shards)
+            out["sharded"] = {
+                "shards": shards,
+                "write_req_s": sh["write"]["req_s"],
+                "read_req_s": sh["read"]["req_s"],
+                "write_slope_vs_single": round(
+                    sh["write"]["req_s"] / max(out["write"]["req_s"], 1),
+                    3),
+                "read_slope_vs_single": round(
+                    sh["read"]["req_s"] / max(out["read"]["req_s"], 1),
+                    3),
+            }
+        except Exception as e:
+            out["sharded"] = {"error": f"{type(e).__name__}: "
+                                       f"{str(e)[:160]}"}
+    else:
+        out["sharded"] = ("skipped: 1-core host (the fleet only adds "
+                          "context switching; boots when "
+                          "os.cpu_count() > 1)")
     out["cpu_count"] = os.cpu_count()
     out["volume_workers"] = workers
     out["vs_reference"] = {
@@ -1350,17 +1497,29 @@ def phase_degraded(work: str, budget_s: float = 240.0,
                                 "-pulse", "1"], f"vs{i}"))
         client = Client(master)
         deadline = time.time() + 45
+        nodes_up = 0
         while time.time() < deadline:
             try:
-                if len(client.dir_status().get("nodes", [])) >= 4:
+                nodes_up = len(client.dir_status().get("nodes", []))
+                if nodes_up >= 4:
                     break
             except Exception:
                 pass
             time.sleep(0.3)
+        if nodes_up == 0:
+            raise RuntimeError("degraded cluster never booted "
+                               "(0/4 volume servers after 45s)")
 
+        # setup is budget-governed too: on a slow host, uploads against
+        # a half-booted cluster retry forever — without these checks the
+        # phase hangs PAST its budget instead of recording an error
         rng = random_mod.Random(5)
         blobs: dict[str, bytes] = {}
         for _ in range(60):
+            if left() < budget_s * 0.5:
+                raise RuntimeError(
+                    f"setup over half budget after {len(blobs)}/60 "
+                    f"uploads ({nodes_up}/4 volume servers up)")
             data = bytes(rng.getrandbits(8)
                          for _ in range(rng.randint(4096, 32768)))
             blobs[client.upload(data, collection="deg")] = data
@@ -1368,6 +1527,9 @@ def phase_degraded(work: str, budget_s: float = 240.0,
         vids = sorted({int(f.split(",")[0]) for f in blobs})
         shell = EcCommands(client)  # production RS(10,4) geometry
         for vid in vids:
+            if left() < 60:
+                raise RuntimeError(
+                    f"budget exhausted before encoding volume {vid}")
             shell.encode(vid, "deg", apply=True)
         time.sleep(2.0)
 
@@ -3185,6 +3347,15 @@ def main() -> None:
             # leave ~180s for fused+system+needle_map after rebuild
             rebuild = _run_phase("rebuild", work,
                                  min(650.0, max(left() - 180.0, 60.0)))
+        if rebuild.get("rebuild_p50_s") is None:
+            # a skipped/unreached phase has no p50: print its reason
+            # instead of the literal "p50 Nones (Nones)" (BENCH_r05
+            # tail); the JSON keeps the "skipped: ..." string as-is
+            reason = str(rebuild.get("error", "not reached"))
+            if not reason.startswith("skipped"):
+                reason = f"skipped ({reason})"
+            _log(f"rebuild: {reason}")
+        else:
             _log(f"rebuild: p50 {rebuild.get('rebuild_p50_s')}s "
                  f"({rebuild.get('phase_wall_s')}s)")
         detail["rebuild"] = rebuild
@@ -3202,6 +3373,12 @@ def main() -> None:
             system = bench_system(work)
             _log(f"system: w {system['write']['req_s']} r "
                  f"{system['read']['req_s']}")
+            sh = system.get("sharded")
+            if isinstance(sh, dict) and "write_req_s" in sh:
+                _log(f"system (sharded x{sh['shards']}): "
+                     f"w {sh['write_req_s']} r {sh['read_req_s']}")
+            elif isinstance(sh, str):
+                _log(f"system (sharded): {sh}")
         except Exception as e:
             system = {"error": str(e)}
         detail["system_req_s"] = system

@@ -5,12 +5,16 @@ The reference binds directly to klauspost/reedsolomon
 through one interface with interchangeable backends:
 
 - NumpyCoder   — pure-python/numpy reference (always available, slow)
-- JaxCoder     — jit'd XLA (CPU or TPU; bitplane-MXU or nibble-LUT method)
+- JaxCoder     — jit'd XLA (CPU or TPU; bitplane-MXU, nibble-LUT, or
+                 packed-word xorsched formulation — rs_jax.FORMULATIONS)
 - PallasCoder  — hand-tiled TPU kernel (rs_pallas.py)
 - CppCoder     — native C++ table coder (native/, klauspost-equivalent CPU path)
 
 All backends produce bit-identical shards (enforced by tests), so the choice
-is purely a placement/performance decision.
+is purely a placement/performance decision. WEED_EC_FORMULATION pins the
+JaxCoder/PallasCoder kernel formulation; unset, the JaxCoder defaults to
+bitplane and lets the feed governor's formulation axis retune it between
+runs from measured kernel spans (retune_formulation).
 """
 
 from __future__ import annotations
@@ -269,6 +273,51 @@ def _fused_digest_multi_dyn():
     return fn
 
 
+def _fused_digest_multi_dyn_packed():
+    """_fused_digest_multi_dyn over uint32-packed bit-plane batches
+    (method="xorsched"): fn(acc, w, *planes) applies the expanded binary
+    matrix as word masks (rs_jax.gf_apply_planes_dyn) — batches arrive
+    already bit-plane-resident from stage_async, so the per-batch program
+    contains NO expand transpose, and the only byte repack is the m
+    output rows feeding the digest sum.
+
+    Same one-executable-per-shape contract as the byte-domain dyn
+    program: the matrix is runtime data, so the encode window and every
+    zero-padded rec matrix share one compiled program per
+    (n_batches, packed shape) and rebuild windows never recompile."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops import xor_schedule
+
+    @jax.jit
+    def fn(acc, w, *planes):
+        for p in planes:
+            out = rs_jax.gf_apply_planes_dyn(w, p)
+            rows = xor_schedule.unpack_planes(out, int(p.shape[1]) * 32)
+            acc = acc + jnp.sum(rows.astype(jnp.uint32), axis=1,
+                                dtype=jnp.uint32)
+        return acc
+
+    return fn
+
+
+def _aot_compile_window_dyn_packed(m_rows: int, k: int, n_batches: int,
+                                   shape: tuple):
+    """AOT-compile the packed dynamic-matrix window executable from the
+    BYTE batch shape callers plan with (the packed staged shape is
+    derived here). compiled(acc, w, *planes)."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops import xor_schedule
+    jfn = _fused_digest_multi_dyn_packed()
+    sds = jax.ShapeDtypeStruct(
+        (int(shape[0]) * 8, xor_schedule.packed_width(int(shape[1]))),
+        jnp.uint32)
+    w_sds = jax.ShapeDtypeStruct((m_rows * 8, k * 8), jnp.int8)
+    acc_sds = jax.ShapeDtypeStruct((m_rows,), jnp.uint32)
+    return jfn.lower(acc_sds, w_sds, *([sds] * n_batches)).compile()
+
+
 def _aot_compile_window_dyn(m_rows: int, k: int, n_batches: int,
                             shape: tuple):
     """AOT-compile the dynamic-matrix window executable (abstract shapes
@@ -301,10 +350,33 @@ def _aot_compile_window(apply_fn, m_rows: int, n_batches: int,
 
 
 class JaxCoder(ErasureCoder):
+    # subclasses may accept extra kernel backends (MeshCoder: "pallas")
+    _VALID_METHODS = frozenset(rs_jax.FORMULATIONS)
+
     def __init__(self, data_shards: int, parity_shards: int,
-                 method: str = "bitplane"):
+                 method: str | None = None):
         super().__init__(data_shards, parity_shards)
-        self.method = method
+        env = rs_jax.formulation_env()
+        # an explicit method or the env var pins the formulation; only an
+        # unpinned coder lets the governor's formulation axis retune it
+        self._method_pinned = method is not None or env is not None
+        self.method = method or env or "bitplane"
+        if self.method not in self._VALID_METHODS:
+            raise ValueError(f"unknown formulation {self.method!r}; "
+                             f"have {sorted(self._VALID_METHODS)}")
+
+    def retune_formulation(self, method: str) -> str:
+        """Governor hook (pipeline._steer_formulation): switch the kernel
+        formulation BETWEEN runs. Pinned coders (explicit method or
+        WEED_EC_FORMULATION) ignore the request; returns the method
+        actually in use so finish_run attributes kernel spans to what
+        ran. The cached fused digest fn is method-bound and dropped on a
+        switch; window caches key by method (or are method-generic)."""
+        if (not self._method_pinned and method != self.method
+                and method in rs_jax.FORMULATIONS):
+            self.method = method
+            self._digest_fn = None
+        return self.method
 
     def encode(self, data: np.ndarray) -> np.ndarray:
         out = rs_jax.encode_parity(np.asarray(data, dtype=np.uint8), self.m,
@@ -339,7 +411,34 @@ class JaxCoder(ErasureCoder):
             acc = jnp.zeros(self.m, dtype=jnp.uint32)
         return fn(jax.device_put(np.asarray(data, dtype=np.uint8)), acc)
 
-    stage_async = staticmethod(_jax_stage)
+    def stage_async(self, data: np.ndarray):
+        """H2D staging; under method="xorsched" the batch is ALSO
+        transposed to uint32-packed bit-plane rows here — once per batch
+        on the stager pool, fused with the H2D put — so every window
+        kernel (encode, digests, rebuild) consumes the resident layout
+        and the expand/repack cost amortizes from per-kernel to
+        per-window. The packed form is the same total bytes as the
+        input (no 8x lane expansion)."""
+        if self.method != "xorsched":
+            return _jax_stage(data)
+        from .. import faults, observe
+        if faults.fire("ec.stage.pack"):
+            # a dropped pack has no silent fallback: the window kernels
+            # need the resident layout, so failing the stage is the
+            # honest degradation (the sink's error path surfaces it)
+            raise faults.FaultError("dropped at ec.stage.pack")
+        import jax
+        with observe.span("ec.stage.pack"):
+            arr = jax.device_put(np.asarray(data, dtype=np.uint8))
+            return self._pack_fn()(arr)
+
+    def _pack_fn(self):
+        fn = getattr(self, "_pack_jit", None)
+        if fn is None:
+            import jax
+            from ..ops import xor_schedule
+            fn = self._pack_jit = jax.jit(xor_schedule.pack_planes)
+        return fn
 
     def _encode_fn(self):
         return lambda d: rs_jax.encode_parity(d, self.m, method=self.method)
@@ -391,15 +490,43 @@ class JaxCoder(ErasureCoder):
             fn = cache[key] = _fused_digest_multi_dyn()
         return fn
 
+    def _packed_shape(self, shape: tuple) -> tuple:
+        from ..ops import xor_schedule
+        return (shape[0] * 8, xor_schedule.packed_width(shape[1]))
+
+    def _dyn_window_fn_packed(self, n_batches: int, shape: tuple):
+        # shape is the PACKED per-batch shape (staged batches are already
+        # bit-plane words under xorsched); keyed separately from "dynw"
+        # so byte- and packed-domain programs never collide
+        cache = self._wcache()
+        key = ("dynwp", n_batches, tuple(shape))
+        fn = cache.get(key)
+        if fn is None:
+            fn = cache[key] = _fused_digest_multi_dyn_packed()
+        return fn
+
+    def _dyn_window_builder(self):
+        """The matrix-as-data window builder for this formulation, or
+        None when the formulation has no dyn path (lut): bitplane windows
+        consume byte batches, xorsched windows consume the bit-plane-
+        resident batches stage_async produces. Either way encode and
+        every rebuild share ONE executable per (n_batches, shape)."""
+        if self.method == "bitplane":
+            return self._dyn_window_fn
+        if self.method == "xorsched":
+            return self._dyn_window_fn_packed
+        return None
+
     def encode_digest_window_async(self, staged, acc=None):
         import jax.numpy as jnp
         if acc is None:
             acc = jnp.zeros(self.m, dtype=jnp.uint32)
-        if self.method == "bitplane":
-            fn = self._dyn_window_fn(len(staged), staged[0].shape)
+        dyn = self._dyn_window_builder()
+        if dyn is not None:
+            fn = dyn(len(staged), staged[0].shape)
             return fn(acc, self._dyn_w_enc(), *staged)
         cache = self._wcache()
-        key = ("enc", len(staged), tuple(staged[0].shape))
+        key = ("enc", self.method, len(staged), tuple(staged[0].shape))
         fn = cache.get(key)
         if fn is None:
             fn = cache[key] = _fused_digest_multi(self._encode_fn())
@@ -409,7 +536,8 @@ class JaxCoder(ErasureCoder):
         import jax.numpy as jnp
         present, missing = tuple(present), tuple(missing)
         cap = _rec_window_cap()
-        if self.method == "bitplane":
+        dyn = self._dyn_window_builder()
+        if dyn is not None:
             n_missing = len(missing)
             if acc is None:
                 full = jnp.zeros(self.m, dtype=jnp.uint32)
@@ -420,14 +548,14 @@ class JaxCoder(ErasureCoder):
                                (0, self.m - n_missing))
             w = self._dyn_w_rec(present, missing)
             for chunk in _chunks(list(staged), cap):
-                fn = self._dyn_window_fn(len(chunk), chunk[0].shape)
+                fn = dyn(len(chunk), chunk[0].shape)
                 full = fn(full, w, *chunk)
             return full if n_missing == self.m else full[:n_missing]
         if acc is None:
             acc = jnp.zeros(len(missing), dtype=jnp.uint32)
         cache = self._wcache()
         for chunk in _chunks(list(staged), cap):
-            key = ("rec", present, missing, len(chunk),
+            key = ("rec", self.method, present, missing, len(chunk),
                    tuple(chunk[0].shape))
             fn = cache.get(key)
             if fn is None:
@@ -442,7 +570,14 @@ class JaxCoder(ErasureCoder):
             self._wcache()[key] = _aot_compile_window_dyn(
                 self.m, self.k, n_batches, shape)
             return
-        key = ("enc", n_batches, tuple(shape))
+        if self.method == "xorsched":
+            # warm takes the BYTE batch shape (what the pipeline knows);
+            # the packed shape it compiles for is what stage_async emits
+            key = ("dynwp", n_batches, self._packed_shape(tuple(shape)))
+            self._wcache()[key] = _aot_compile_window_dyn_packed(
+                self.m, self.k, n_batches, shape)
+            return
+        key = ("enc", self.method, n_batches, tuple(shape))
         self._wcache()[key] = _aot_compile_window(
             self._encode_fn(), self.m, n_batches, shape)
 
@@ -458,9 +593,16 @@ class JaxCoder(ErasureCoder):
                     self._wcache()[key] = _aot_compile_window_dyn(
                         self.m, self.k, n, shape)
             return
+        if self.method == "xorsched":
+            for n in sizes:
+                key = ("dynwp", n, self._packed_shape(tuple(shape)))
+                if key not in self._wcache():
+                    self._wcache()[key] = _aot_compile_window_dyn_packed(
+                        self.m, self.k, n, shape)
+            return
         present, missing = tuple(present), tuple(missing)
         for n in sizes:
-            key = ("rec", present, missing, n, tuple(shape))
+            key = ("rec", self.method, present, missing, n, tuple(shape))
             self._wcache()[key] = _aot_compile_window(
                 self._rec_apply(present, missing), len(missing), n, shape)
 
@@ -469,13 +611,21 @@ class PallasCoder(ErasureCoder):
     """Fused TPU kernel path (rs_pallas.py); interpret-mode on CPU."""
 
     def __init__(self, data_shards: int, parity_shards: int,
-                 tile: int | None = None):
+                 tile: int | None = None,
+                 formulation: str | None = None):
         super().__init__(data_shards, parity_shards)
         from ..ops import rs_pallas
         self._mod = rs_pallas
         self._tile = tile or rs_pallas.DEFAULT_TILE
+        # env pin: xorsched swaps the kernel body (schedule-driven, no
+        # matrix operand); lut has no Pallas twin so any other value
+        # keeps the bitplane kernel
+        env = rs_jax.formulation_env()
+        self.formulation = formulation or (
+            "xorsched" if env == "xorsched" else "bitplane")
         self._encode = rs_pallas.gf_apply_pallas(
-            gf256.parity_matrix(data_shards, parity_shards), tile=self._tile)
+            gf256.parity_matrix(data_shards, parity_shards),
+            tile=self._tile, formulation=self.formulation)
         self._rec_cache: dict = {}
         self._digest_cache: dict = {}
 
@@ -494,7 +644,8 @@ class PallasCoder(ErasureCoder):
             self._tile, self._tile // 4)
         self._tile //= 4
         self._encode = self._mod.gf_apply_pallas(
-            gf256.parity_matrix(self.k, self.m), tile=self._tile)
+            gf256.parity_matrix(self.k, self.m), tile=self._tile,
+            formulation=self.formulation)
         self._rec_cache.clear()
 
     def _run_encode(self, data):
@@ -521,7 +672,8 @@ class PallasCoder(ErasureCoder):
         if fn is None:
             rec = gf256.reconstruction_matrix(self.k, self.m, present,
                                               missing)
-            fn = self._mod.gf_apply_pallas(rec, tile=self._tile)
+            fn = self._mod.gf_apply_pallas(rec, tile=self._tile,
+                                           formulation=self.formulation)
             self._rec_cache[key] = fn
         return fn
 
@@ -650,6 +802,8 @@ def _mesh_factory(data_shards: int, parity_shards: int) -> ErasureCoder:
 register_coder("numpy", NumpyCoder)
 register_coder("jax", JaxCoder)
 register_coder("jax_lut", lambda k, m: JaxCoder(k, m, method="lut"))
+register_coder("jax_xorsched",
+               lambda k, m: JaxCoder(k, m, method="xorsched"))
 register_coder("pallas", PallasCoder)
 register_coder("cpp", CppCoder)
 register_coder("mesh", _mesh_factory)

@@ -26,11 +26,16 @@ shard write-back parallelizes across the 14 files):
 
 Batch size and queue depths default to the adaptive governor's operating
 point (ec/governor.py), tuned from the per-stage observe spans this module
-emits; explicit arguments pin them. Only parity bytes (m/k of the input)
-cross device->host. Layout semantics are identical to
-striping.write_ec_files: row-major two-tier striping, final batch
-zero-padded and written full-length (tests assert byte-identical output
-between the two paths).
+emits — including the kernel FORMULATION axis (_steer_formulation:
+governed runs apply the governor's planned lut/bitplane/xorsched choice
+to the coder between runs, and under "xorsched" the staged-window sinks'
+stage step also transposes each batch to uint32-packed bit-plane rows on
+the stager pool, so every window kernel runs bit-plane-resident and the
+expand/repack cost amortizes per-window, not per-batch). Explicit
+arguments pin the plan. Only parity bytes (m/k of the input) cross
+device->host. Layout semantics are identical to striping.write_ec_files:
+row-major two-tier striping, final batch zero-padded and written
+full-length (tests assert byte-identical output between the two paths).
 """
 
 from __future__ import annotations
@@ -84,6 +89,23 @@ def coder_chips(coder: ErasureCoder) -> int:
     every single-chip backend; parallel/mesh_coder.MeshCoder exports
     mesh_devices)."""
     return int(getattr(coder, "mesh_devices", 1) or 1)
+
+
+def _steer_formulation(coder: ErasureCoder,
+                       op: "governor.OperatingPoint"
+                       ) -> "governor.OperatingPoint":
+    """Apply the governor's planned kernel formulation to the coder
+    BEFORE the run starts (a formulation switch swaps executables, so
+    like every governor axis it lands between runs only). The coder
+    reports the formulation it actually runs — env-pinned or explicitly
+    constructed coders ignore the plan — and the returned op carries
+    that, so finish_run's formulation model never attributes one
+    kernel's spans to another. Coders without the hook (numpy, pallas,
+    cpp) report "" which opts the run out of the formulation model."""
+    retune = getattr(coder, "retune_formulation", None)
+    if retune is None:
+        return op._replace(formulation="")
+    return op._replace(formulation=retune(op.formulation))
 
 
 def stager_count_default() -> int:
@@ -404,6 +426,8 @@ def stream_encode(base_file_name: str, coder: ErasureCoder,
     else:
         op, governed = _resolve_op(batch_size, depth, dat_size,
                                    g.data_shards, coder_chips(coder))
+        if governed:
+            op = _steer_formulation(coder, op)
     src = feed_mod.open_feed(base_file_name + ".dat", g.data_shards,
                              op.batch_size, pool_buffers=op.depth + 2,
                              readers=op.readers)
@@ -455,6 +479,8 @@ def stream_encode_many(base_file_names: Sequence[str], coder: ErasureCoder,
     total = sum(os.path.getsize(b + ".dat") for b in bases)
     op, governed = _resolve_op(batch_size, depth, total, g.data_shards,
                                coder_chips(coder))
+    if governed:
+        op = _steer_formulation(coder, op)
     tctx = observe.ensure_ctx("ec")
     for base in bases:
         with observe.stage("ec.volume", tctx, tags={"base": base}):
@@ -851,12 +877,15 @@ def stream_rebuild(base_file_name: str, coder: ErasureCoder,
         raise ValueError(
             f"need {g.data_shards} shards to rebuild, have {len(present)}")
     survivors_ids = tuple(present[:g.data_shards])
-    fn = coder.rec_apply_async(survivors_ids, tuple(missing))
-
     shard_size = os.path.getsize(base_file_name + to_ext(survivors_ids[0]))
     op, governed = _resolve_op(batch_size, depth,
                                g.data_shards * shard_size, g.data_shards,
                                coder_chips(coder))
+    if governed:
+        # steer BEFORE rec_apply_async binds the reconstruction program
+        # to a formulation
+        op = _steer_formulation(coder, op)
+    fn = coder.rec_apply_async(survivors_ids, tuple(missing))
     src = feed_mod.ShardFeed(
         [base_file_name + to_ext(i) for i in survivors_ids],
         op.batch_size, pool_buffers=op.depth + 2, readers=op.readers)

@@ -62,6 +62,39 @@ def _gf_kernel(w_ref, data_ref, out_ref, *, rows: int, cols: int):
     out_ref[:] = out.astype(jnp.uint8)
 
 
+def _gf_kernel_xorsched(data_ref, out_ref, *, sched, rows: int,
+                        cols: int):
+    """Schedule-driven twin of _gf_kernel (formulation="xorsched"): the
+    precomputed XOR schedule (ops/xor_schedule.py, greedy shared-pair
+    CSE) replaces the 8x int8 plane concat + MXU dot_general + mod-2
+    entirely — each scheduled XOR is ONE VPU op on a 0/1 plane row, and
+    the CSE'd count sits ~60% below the dense popcount bound. The matrix
+    never enters the kernel: the schedule IS the matrix, baked in as
+    straight-line code. int32 widening as in _gf_kernel (Mosaic has no
+    uint8 shift); on-chip the win over the bitplane kernel is the removed
+    expansion/accumulator traffic — chip-side GB/s lands at the next
+    TPU-host bench round (this container drives it interpret-mode only).
+    """
+    data = data_ref[:].astype(jnp.int32)  # [C, T]
+    vals = []
+    for c in range(cols):
+        row = data[c:c + 1, :]
+        for j in range(8):
+            vals.append((row >> j) & 1)
+    for a, b in sched.ops:
+        vals.append(vals[a] ^ vals[b])
+    zero = jnp.zeros_like(vals[0])
+    outs = []
+    for r in range(rows):
+        acc = zero
+        for i in range(8):
+            oid = sched.out_ids[r * 8 + i]
+            if oid is not None:
+                acc = acc | (vals[oid] << i)
+        outs.append(acc)
+    out_ref[:] = jnp.concatenate(outs, axis=0).astype(jnp.uint8)
+
+
 def _nibble_weights(rows: int) -> np.ndarray:
     """[rows, 4*rows] int8 selector: out[r] = sum_i 2^i * planes[i*rows+r]
     for 4 planes — the byte-repack as an MXU contraction (two of these
@@ -114,8 +147,35 @@ def _gf_kernel_mxu_repack(w_ref, w2_ref, data_ref, out_ref, *, rows: int,
 
 @functools.lru_cache(maxsize=128)
 def _build_apply(matrix_bytes: bytes, rows: int, cols: int, tile: int,
-                 interpret: bool, repack: str = "vpu"):
+                 interpret: bool, repack: str = "vpu",
+                 formulation: str = "bitplane"):
     w = np.frombuffer(matrix_bytes, dtype=np.uint8).reshape(rows, cols)
+
+    if formulation == "xorsched":
+        from .xor_schedule import schedule_for_matrix
+        kernel = functools.partial(_gf_kernel_xorsched,
+                                   sched=schedule_for_matrix(w),
+                                   rows=rows, cols=cols)
+
+        @jax.jit
+        def apply_sched(data: jnp.ndarray) -> jnp.ndarray:
+            n = data.shape[1]
+            assert n % tile == 0, (n, tile)
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct((rows, n), jnp.uint8),
+                grid=(n // tile,),
+                in_specs=[
+                    pl.BlockSpec((cols, tile), lambda i: (0, i),
+                                 memory_space=pltpu.VMEM),
+                ],
+                out_specs=pl.BlockSpec((rows, tile), lambda i: (0, i),
+                                       memory_space=pltpu.VMEM),
+                interpret=interpret,
+            )(data)
+
+        return apply_sched
+
     wp = jnp.asarray(_plane_major_matrix(w))  # [8R, 8C] int8
 
     if repack == "mxu":
@@ -155,11 +215,14 @@ def _build_apply(matrix_bytes: bytes, rows: int, cols: int, tile: int,
 
 
 def gf_apply_pallas(matrix: np.ndarray, tile: int = DEFAULT_TILE,
-                    interpret: bool | None = None, repack: str = "vpu"):
+                    interpret: bool | None = None, repack: str = "vpu",
+                    formulation: str = "bitplane"):
     """Return fn: data [C, n] uint8 -> [R, n] uint8; n padded to tile inside.
 
     repack: "vpu" (8-iteration or/shift chain) or "mxu" (two nibble
-    matmuls — see _gf_kernel_mxu_repack)."""
+    matmuls — see _gf_kernel_mxu_repack); formulation: "bitplane" (the
+    expand/dot/repack kernel) or "xorsched" (the CSE'd XOR-schedule
+    kernel, _gf_kernel_xorsched — repack is moot there)."""
     matrix = np.asarray(matrix, dtype=np.uint8)
     rows, cols = matrix.shape
     if interpret is None:
@@ -169,7 +232,7 @@ def gf_apply_pallas(matrix: np.ndarray, tile: int = DEFAULT_TILE,
         # would turn small test inputs into quarter-million-column runs
         tile = min(tile, 16384)
     raw = _build_apply(matrix.tobytes(), rows, cols, tile, interpret,
-                       repack)
+                       repack, formulation)
 
     def apply_fn(data: jnp.ndarray) -> jnp.ndarray:
         n = data.shape[1]

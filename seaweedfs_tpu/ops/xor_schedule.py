@@ -1,0 +1,193 @@
+"""Packed-word XOR schedules for binary (bit-plane) GF matrices.
+
+The bitplane formulation (rs_jax.gf_apply_bitplane) expands every input
+byte into 8 int8 lanes, contracts them against the expanded binary Cauchy
+matrix on the MXU, and repacks — ~18 VPU ops and ~25x intermediate
+traffic per input byte, which is the measured ceiling on both the XLA and
+Pallas paths. But applying a binary matrix over GF(2) is just XOR of the
+selected input bit-planes, and for a *static* matrix the XOR expression
+tree can be precomputed, shared, and executed over machine words:
+
+1. ``build_schedule`` turns the binary matrix [R, C] into a straight-line
+   program of 2-operand XORs. Greedy common-subexpression elimination
+   (Plank-style shared pair extraction: repeatedly hoist the operand pair
+   that co-occurs in the most rows into a fresh intermediate) drops the
+   XOR count below the dense popcount bound ``sum(popcount(row) - 1)``.
+2. ``pack_planes`` transposes [C, n] uint8 shards into bit-plane-major
+   ``uint32``-packed words [C*8, ceil(n/32)] — 32 stripe columns per
+   word, total footprint identical to the input (no 8x lane expansion).
+3. ``run_schedule`` executes the schedule as bitwise XORs over those
+   packed rows: a handful of word-ops per input byte, no ``dot_general``,
+   no int32 accumulator.
+
+The pack/unpack transpose is the only non-XOR cost, and the windowed
+encode path (ec/coder.py JaxCoder method="xorsched") hoists it out of the
+per-batch program entirely: batches are packed once at stage time and
+stay bit-plane-resident for every kernel in the window.
+
+Schedules are deterministic (pure argmax greedy over a co-occurrence
+count matrix) and cached per matrix; building one is a few hundred
+numpy matmuls on a <=2600-bit matrix — milliseconds for RS(10,4),
+single-digit seconds for RS(20,4), paid once per (geometry, matrix).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+
+class XorSchedule(NamedTuple):
+    """A straight-line XOR program over bit-plane rows.
+
+    Value ids: ``0..n_in-1`` are the input rows; each ``ops[t]`` =
+    ``(a, b)`` defines value ``n_in + t = vals[a] ^ vals[b]``. Output row
+    ``r`` is value ``out_ids[r]`` (``None`` = all-zero matrix row ->
+    zero output). ``dense_xors`` is the popcount bound the greedy CSE is
+    measured against; ``sched_xors == len(ops)``.
+    """
+
+    n_in: int
+    n_rows: int
+    ops: tuple
+    out_ids: tuple
+    dense_xors: int
+    sched_xors: int
+
+
+def build_schedule(w: np.ndarray) -> XorSchedule:
+    """Greedy shared-pair CSE schedule for a binary matrix [R, C].
+
+    Each iteration counts, for every pair of live value ids, how many
+    rows contain both (one float32 matmul on the 0/1 membership matrix),
+    hoists the most-shared pair into a new intermediate, and substitutes
+    it. When no pair is shared by >= 2 rows, the remaining per-row
+    operand sets fold into left-to-right XOR chains.
+    """
+    w = np.asarray(w)
+    if w.ndim != 2:
+        raise ValueError(f"want a 2-D binary matrix, got shape {w.shape}")
+    n_rows, n_in = w.shape
+    m = (w != 0)  # [rows, value ids], grows a column per intermediate
+    dense = int(sum(max(int(row.sum()) - 1, 0) for row in m))
+    ops: list[tuple[int, int]] = []
+    while True:
+        mf = m.astype(np.float32)
+        co = mf.T @ mf  # co[a, b] = rows containing BOTH a and b
+        np.fill_diagonal(co, 0.0)
+        if co.size == 0 or co.max() < 2.0:
+            break
+        a, b = np.unravel_index(int(np.argmax(co)), co.shape)
+        a, b = int(min(a, b)), int(max(a, b))
+        both = m[:, a] & m[:, b]
+        new_col = np.zeros((n_rows, 1), dtype=bool)
+        new_col[both, 0] = True
+        m[both, a] = False
+        m[both, b] = False
+        m = np.hstack([m, new_col])
+        ops.append((a, b))
+    next_id = m.shape[1]
+    out_ids: list[Optional[int]] = []
+    for r in range(n_rows):
+        idx = np.nonzero(m[r])[0].tolist()
+        if not idx:
+            out_ids.append(None)
+        elif len(idx) == 1:
+            out_ids.append(int(idx[0]))
+        else:
+            cur = int(idx[0])
+            for x in idx[1:]:
+                ops.append((cur, int(x)))
+                cur = next_id
+                next_id += 1
+            out_ids.append(cur)
+    return XorSchedule(n_in=n_in, n_rows=n_rows, ops=tuple(ops),
+                       out_ids=tuple(out_ids), dense_xors=dense,
+                       sched_xors=len(ops))
+
+
+@functools.lru_cache(maxsize=128)
+def _schedule_cached(matrix_bytes: bytes, rows: int,
+                     cols: int) -> XorSchedule:
+    from .rs_jax import bitplane_matrix  # lazy: rs_jax imports us back
+    matrix = np.frombuffer(matrix_bytes, dtype=np.uint8).reshape(rows,
+                                                                 cols)
+    return build_schedule(bitplane_matrix(matrix))
+
+
+def schedule_for_matrix(matrix: np.ndarray) -> XorSchedule:
+    """The (cached) schedule for a GF(2^8) coefficient matrix [R, C]:
+    built from its expanded binary form (rs_jax.bitplane_matrix), so the
+    schedule's n_in = C*8 input bit-plane rows and n_rows = R*8 output
+    bit-plane rows."""
+    m = np.asarray(matrix, dtype=np.uint8)
+    return _schedule_cached(m.tobytes(), m.shape[0], m.shape[1])
+
+
+def apply_schedule_numpy(sched: XorSchedule, bits: np.ndarray) -> np.ndarray:
+    """Dense-domain reference executor: bits [n_in, n] 0/1 -> [n_rows, n].
+    Tests pit this against the mod-2 matmul (dense popcount) reference."""
+    bits = np.asarray(bits, dtype=np.uint8)
+    vals = [bits[i] for i in range(sched.n_in)]
+    for a, b in sched.ops:
+        vals.append(vals[a] ^ vals[b])
+    zero = np.zeros(bits.shape[1], dtype=np.uint8)
+    return np.stack([vals[i] if i is not None else zero
+                     for i in sched.out_ids])
+
+
+def packed_width(n: int) -> int:
+    """uint32 words per bit-plane row for an n-column stripe batch."""
+    return (n + 31) // 32
+
+
+def pack_planes(x):
+    """[C, n] uint8 shards -> [C*8, ceil(n/32)] uint32 bit-plane words.
+
+    Row c*8+j holds bit j of shard row c; bit b of word q is stripe
+    column q*32+b. Zero-padding the tail word is invisible to GF math
+    (parity of zero columns is zero) and to the digest sinks (zero bytes
+    sum to zero). Jit-friendly; same total bytes as the input.
+    """
+    import jax.numpy as jnp
+    c, n = x.shape
+    pad = (-n) % 32
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad)))
+    nw = x.shape[1] // 32
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (x[:, None, :] >> shifts[None, :, None]) & jnp.uint8(1)
+    bits = bits.reshape(c * 8, nw, 32).astype(jnp.uint32)
+    weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+    # planes are disjoint bit positions: sum == or
+    return jnp.sum(bits * weights[None, None, :], axis=2,
+                   dtype=jnp.uint32)
+
+
+def unpack_planes(p, n: int):
+    """[R*8, nw] uint32 bit-plane words -> [R, n] uint8 (pack_planes^-1,
+    the D2H/write-boundary repack)."""
+    import jax.numpy as jnp
+    r8, nw = p.shape
+    rows = r8 // 8
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (p[:, :, None] >> shifts[None, None, :]) & jnp.uint32(1)
+    bits = bits.reshape(rows, 8, nw * 32).astype(jnp.uint8)
+    weights = jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8)
+    out = jnp.sum(bits * weights[None, :, None], axis=1, dtype=jnp.uint8)
+    return out[:, :n]
+
+
+def run_schedule(sched: XorSchedule, planes):
+    """Execute the schedule over packed rows: [n_in, nw] uint32 ->
+    [n_rows, nw] uint32. Pure bitwise XOR straight-line code — the whole
+    per-batch encode program once inputs are bit-plane-resident."""
+    import jax.numpy as jnp
+    vals = [planes[i] for i in range(sched.n_in)]
+    for a, b in sched.ops:
+        vals.append(vals[a] ^ vals[b])
+    zero = jnp.zeros_like(planes[0])
+    return jnp.stack([vals[i] if i is not None else zero
+                      for i in sched.out_ids])

@@ -79,11 +79,15 @@ def mesh_device_count() -> int:
 
 def coder(data_shards: int, parity_shards: int,
           n_devices: Optional[int] = None,
-          method: str = "bitplane"):
+          method: Optional[str] = None):
     """The mesh-or-single factory: a MeshCoder over n_devices (default:
     WEED_EC_MESH_DEVICES, then all local devices) when that resolves to
     more than one chip, else the proven single-chip backend for
-    `method` (JaxCoder, or PallasCoder for method="pallas")."""
+    `method` (JaxCoder, or PallasCoder for method="pallas").
+
+    method=None defers to WEED_EC_FORMULATION (rs_jax.formulation_env),
+    falling back to "bitplane" — so the operator's pin reaches the mesh
+    path exactly like the single-chip one."""
     if n_devices is None:
         if os.environ.get("WEED_EC_MESH_DEVICES", "").strip():
             n_devices = mesh_device_count() or 1
@@ -120,11 +124,17 @@ class MeshCoder(JaxCoder):
     shape; everything the JaxCoder exposes (digest windows, staged
     sinks, reconstruct) works here, mesh-sharded where it counts."""
 
+    _VALID_METHODS = frozenset(rs_jax.FORMULATIONS) | {"pallas"}
+
     def __init__(self, data_shards: int, parity_shards: int,
                  n_devices: Optional[int] = None,
-                 method: str = "bitplane"):
-        if method not in ("bitplane", "lut", "pallas"):
+                 method: Optional[str] = None):
+        method = method or rs_jax.formulation_env() or "bitplane"
+        if method not in self._VALID_METHODS:
             raise ValueError(f"unknown mesh coder method {method!r}")
+        # always pass the resolved method down: a mesh coder's sharded
+        # executables are built for one formulation, so it stays pinned
+        # (retune_formulation is a no-op here)
         super().__init__(data_shards, parity_shards, method=method)
         import jax
         from jax.sharding import Mesh
@@ -198,6 +208,11 @@ class MeshCoder(JaxCoder):
             return rs_pallas.gf_apply_pallas(matrix)
         if self.method == "bitplane":
             return rs_jax.gf_apply_bitplane(matrix)
+        if self.method == "xorsched":
+            # pure elementwise per-chip (pack -> XOR schedule -> unpack,
+            # no cross-column ops), so shard_map stays collective-free —
+            # tests assert it on the compiled HLO
+            return rs_jax.gf_apply_xorsched(matrix)
         return rs_jax.gf_apply_lut(matrix)
 
     # inherited digest windows route through these two hooks, so the
@@ -324,6 +339,16 @@ class MeshCoder(JaxCoder):
     # warming is a tunneled-link optimization whose unsharded abstract
     # shapes would compile a single-device program the sharded call
     # could not reuse — on a mesh the compile happens at first dispatch.
+
+    def _dyn_window_builder(self):
+        # mesh staging is per-chip BYTE column slices (the packed
+        # bit-plane transpose would couple stripe columns across the
+        # 32-bit word, fighting the column sharding), so xorsched windows
+        # ride the byte-domain dyn program here; the sharded encode
+        # kernel itself (_apply_matrix_fn) still runs the XOR schedule
+        if self.method in ("bitplane", "xorsched"):
+            return self._dyn_window_fn
+        return None
 
     def warm_encode_digest_window(self, n_batches: int,
                                   shape: tuple) -> None:

@@ -4,7 +4,7 @@ import pytest
 from seaweedfs_tpu.ops import gf256, rs_jax
 
 
-@pytest.mark.parametrize("method", ["lut", "bitplane"])
+@pytest.mark.parametrize("method", ["lut", "bitplane", "xorsched"])
 @pytest.mark.parametrize("k,m", [(10, 4), (6, 3), (12, 4), (20, 4)])
 def test_encode_matches_numpy(method, k, m):
     rng = np.random.default_rng(10)
@@ -15,7 +15,7 @@ def test_encode_matches_numpy(method, k, m):
     assert np.array_equal(got, want)
 
 
-@pytest.mark.parametrize("method", ["lut", "bitplane"])
+@pytest.mark.parametrize("method", ["lut", "bitplane", "xorsched"])
 def test_encode_odd_width(method):
     # widths that don't align to TPU lanes must still be exact
     rng = np.random.default_rng(11)
@@ -26,7 +26,7 @@ def test_encode_odd_width(method):
         assert np.array_equal(got, want), n
 
 
-@pytest.mark.parametrize("method", ["lut", "bitplane"])
+@pytest.mark.parametrize("method", ["lut", "bitplane", "xorsched"])
 def test_reconstruct_matches_numpy(method):
     rng = np.random.default_rng(12)
     k, m = 10, 4
